@@ -1,0 +1,101 @@
+"""Flat env-var configuration, drop-in compatible with the reference.
+
+The reference reads all configuration from environment variables with inline
+defaults at import time (reference: heatmap_stream.py:21-37, app.py:11-13,
+mbta_to_kafka.py:17-19; documented in its README.md:163-188).  We honor the
+same names and defaults so a reference deployment can switch frameworks
+without touching its environment, and add TPU-specific knobs on top.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Mapping, Sequence
+
+
+def _int(env: Mapping[str, str], name: str, default: int) -> int:
+    return int(env.get(name, default))
+
+
+def _float(env: Mapping[str, str], name: str, default: float) -> float:
+    return float(env.get(name, default))
+
+
+def _ints(env: Mapping[str, str], name: str, default: str) -> tuple[int, ...]:
+    return tuple(int(x) for x in str(env.get(name, default)).split(",") if x != "")
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    # --- reference-compatible knobs (heatmap_stream.py:21-37) ---
+    mongo_uri: str = "mongodb://127.0.0.1:27017"
+    mongo_db: str = "mobility"
+    city: str = "ath"
+    h3_res: int = 8                    # typical 7-9 for city heatmaps
+    tile_minutes: int = 5              # aggregation window size
+    ttl_minutes: int = 45              # tile TTL after window end
+    kafka_bootstrap: str = "localhost:9092"
+    kafka_topic: str = "mobility.positions.v1"
+    checkpoint_dir: str = "/tmp/heatmap-checkpoint"
+    # --- reference-compatible knobs (app.py:11-13, mbta_to_kafka.py:17-19) ---
+    refresh_ms: int = 5000
+    mbta_api_key: str = ""
+    # --- watermark (heatmap_stream.py:107 hardcodes "10 minutes") ---
+    watermark_minutes: int = 10
+    # --- TPU-native extensions (BASELINE.json) ---
+    backend: str = "tpu"               # HEATMAP_BACKEND: "tpu" | "cpu"
+    resolutions: tuple[int, ...] = (8,)     # multi-res hex pyramid, e.g. 7,8,9
+    windows_minutes: tuple[int, ...] = (5,)  # sliding multi-window, e.g. 1,5,15
+    batch_size: int = 1 << 17          # events per fixed-shape micro-batch
+    state_capacity_log2: int = 17      # open-addressing table slots per shard
+    speed_hist_bins: int = 32          # per-cell speed histogram (p95 stats)
+    speed_hist_max_kmh: float = 256.0
+    num_shards: int = 0                # 0 = use all local devices
+    trigger_ms: int = 0                # 0 = as fast as possible (ref default)
+    serve_host: str = "127.0.0.1"
+    serve_port: int = 5000
+    store: str = "auto"                # "auto" | "memory" | "mongo" | "jsonl"
+
+    @property
+    def tile_seconds(self) -> int:
+        return self.tile_minutes * 60
+
+    @property
+    def grid_name(self) -> str:
+        """Grid label used in tile _ids, e.g. "h3r8" (heatmap_stream.py:179)."""
+        return f"h3r{self.h3_res}"
+
+
+def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
+    """Build a Config from env vars (same names as the reference) + overrides."""
+    e = dict(os.environ if env is None else env)
+    cfg = Config(
+        mongo_uri=e.get("MONGO_URI", Config.mongo_uri),
+        mongo_db=e.get("MONGO_DB", Config.mongo_db),
+        city=e.get("CITY", Config.city),
+        h3_res=_int(e, "H3_RES", Config.h3_res),
+        tile_minutes=_int(e, "TILE_MINUTES", Config.tile_minutes),
+        ttl_minutes=_int(e, "TTL_MINUTES", Config.ttl_minutes),
+        kafka_bootstrap=e.get("KAFKA_BOOTSTRAP", Config.kafka_bootstrap),
+        kafka_topic=e.get("KAFKA_TOPIC", Config.kafka_topic),
+        checkpoint_dir=e.get("CHECKPOINT", Config.checkpoint_dir),
+        refresh_ms=_int(e, "REFRESH_MS", Config.refresh_ms),
+        mbta_api_key=e.get("MBTA_API_KEY", ""),
+        watermark_minutes=_int(e, "WATERMARK_MINUTES", Config.watermark_minutes),
+        backend=e.get("HEATMAP_BACKEND", Config.backend),
+        resolutions=_ints(e, "H3_RESOLUTIONS", e.get("H3_RES", "8")),
+        windows_minutes=_ints(e, "WINDOW_MINUTES", e.get("TILE_MINUTES", "5")),
+        batch_size=_int(e, "BATCH_SIZE", Config.batch_size),
+        state_capacity_log2=_int(e, "STATE_CAPACITY_LOG2", Config.state_capacity_log2),
+        speed_hist_bins=_int(e, "SPEED_HIST_BINS", Config.speed_hist_bins),
+        speed_hist_max_kmh=_float(e, "SPEED_HIST_MAX_KMH", Config.speed_hist_max_kmh),
+        num_shards=_int(e, "NUM_SHARDS", Config.num_shards),
+        trigger_ms=_int(e, "TRIGGER_MS", Config.trigger_ms),
+        serve_host=e.get("SERVE_HOST", Config.serve_host),
+        serve_port=_int(e, "SERVE_PORT", Config.serve_port),
+        store=e.get("HEATMAP_STORE", Config.store),
+    )
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return cfg

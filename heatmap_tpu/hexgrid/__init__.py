@@ -1,0 +1,41 @@
+"""hexgrid — icosahedral aperture-7 hexagonal grid (H3-compatible) for TPU.
+
+This package is the TPU-native replacement for the C ``h3`` library the
+reference drives through per-row Python UDFs (reference: heatmap_stream.py:65-75
+``geo_to_h3``/``latlng_to_cell``; app.py:19-41 ``cell_to_boundary``).  It
+implements the full grid system from first principles:
+
+- ``constants``  — fundamental icosahedron geometry (face centers, axis
+                   azimuths, gnomonic scale).
+- ``mathlib``    — host-side f64 spherical + hex-lattice math.
+- ``gen_tables`` — derives the base-cell / rotation / face-neighbor lookup
+                   tables from the geometry (run once; output committed as
+                   ``_tables.py``).
+- ``host``       — host NumPy reference implementation: ``latlng_to_cell``,
+                   ``cell_to_latlng``, ``cell_to_boundary``, index packing.
+- ``device``     — vectorized JAX implementation (trig-free gnomonic
+                   formulation) used on the TPU hot path.
+
+Index compatibility note: the 64-bit index layout, cell geometry (icosahedral
+gnomonic aperture-7 grid, Class II/III alternation), and base-cell numbering
+(descending latitude) follow the public H3 specification.  The environment
+provides no ``h3`` library to cross-check against, so bit-fidelity is
+validated with recorded golden values plus exhaustive internal-consistency
+properties (round-trips, cross-face agreement, hierarchy, pentagon count).
+"""
+
+try:
+    from heatmap_tpu.hexgrid.host import (  # noqa: F401
+        latlng_to_cell,
+        latlng_to_cell_int,
+        cell_to_latlng,
+        cell_to_boundary,
+        h3_to_string,
+        string_to_h3,
+        get_resolution,
+        get_base_cell,
+        is_pentagon,
+    )
+except ImportError as _e:  # during bootstrap, before _tables.py is generated
+    if not (_e.name or "").endswith("_tables"):
+        raise

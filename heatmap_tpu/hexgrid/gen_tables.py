@@ -1,0 +1,485 @@
+"""Derive the hex-grid lookup tables from first-principles geometry.
+
+Run as ``python -m heatmap_tpu.hexgrid.gen_tables``; writes ``_tables.py``.
+
+The fundamental constants (icosahedron face centers + Class II axis azimuths,
+constants.py) fix the grid completely; everything else — the 122 base cells,
+their latitude-ordered numbering, per-(face, ijk) base-cell and rotation
+lookup, face-neighbor (overage) isometries, pentagon offsets — is *derived*
+here and validated by internal-consistency properties:
+
+- exactly 122 base cells, 12 of them pentagons at icosahedron vertices;
+- pentagon base-cell numbers must equal the published H3 set
+  {4,14,24,38,49,58,63,72,83,97,107,117} (validates the descending-latitude
+  numbering rule *and* the geometry jointly);
+- all (face, ijk) entries of the same cell agree after rotation (cross-face
+  consistency sampled near every face edge);
+- encode/decode round-trips at several resolutions.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import sys
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from heatmap_tpu.hexgrid import host
+from heatmap_tpu.hexgrid import mathlib as ml
+from heatmap_tpu.hexgrid.constants import (
+    FACE_AXES_AZ_CII,
+    FACE_CENTER_GEO,
+    NUM_BASE_CELLS,
+    NUM_ICOSA_FACES,
+    RES0_U_GNOMONIC,
+    geo_to_xyz,
+)
+
+# Published H3 pentagon base cells — used as a validation checksum only.
+EXPECTED_PENTAGONS = [4, 14, 24, 38, 49, 58, 63, 72, 83, 97, 107, 117]
+
+# The three res-0 vertex lattice positions of every face (i-axis first).
+VERTEX_IJK = ((2, 0, 0), (0, 2, 0), (0, 0, 2))
+
+# Edge name -> (vertex slot A, vertex slot B) using VERTEX_IJK slots.
+EDGE_VERTS = {"IJ": (0, 1), "JK": (1, 2), "KI": (2, 0)}
+
+
+def axial(ijk) -> Tuple[int, int]:
+    return (ijk[0] - ijk[2], ijk[1] - ijk[2])
+
+
+def axial_rot_ccw(pq: Tuple[int, int]) -> Tuple[int, int]:
+    p, q = pq
+    return (p - q, p)
+
+
+def axial_rot_k(pq, k: int) -> Tuple[int, int]:
+    for _ in range(k % 6):
+        pq = axial_rot_ccw(pq)
+    return pq
+
+
+def solve_rotation(d_from: Tuple[int, int], d_to: Tuple[int, int]) -> int:
+    """k such that R_ccw^k(d_from) == d_to, for nonzero axial vectors."""
+    for k in range(6):
+        if axial_rot_k(d_from, k) == d_to:
+            return k
+    raise ValueError(f"no rotation maps {d_from} to {d_to}")
+
+
+def res0_center(face: int, ijk) -> Tuple[float, float]:
+    x, y = ml.ijk_to_hex2d(*ijk)
+    return ml.hex2d_to_geo(x, y, face, 0, substrate=False)
+
+
+def build_geometry():
+    """Vertices, per-face vertex ids, res-0 cell clusters, numbering."""
+    # --- icosahedron vertices ---
+    rv = math.atan(2 * RES0_U_GNOMONIC)
+    vert_geo_all = []  # (face, slot) -> geo
+    for f in range(NUM_ICOSA_FACES):
+        lat, lng = FACE_CENTER_GEO[f]
+        for s, dtheta in enumerate((0.0, 2 * math.pi / 3, 4 * math.pi / 3)):
+            vert_geo_all.append(ml.geo_az_distance(lat, lng, FACE_AXES_AZ_CII[f] - dtheta, rv))
+    vx = geo_to_xyz(np.array(vert_geo_all))
+    vert_id = -np.ones(60, dtype=int)
+    verts_xyz: List[np.ndarray] = []
+    for a in range(60):
+        if vert_id[a] >= 0:
+            continue
+        grp = [b for b in range(60) if vert_id[b] < 0 and vx[a] @ vx[b] > 0.999999]
+        vid = len(verts_xyz)
+        for b in grp:
+            vert_id[b] = vid
+        verts_xyz.append(np.mean(vx[grp], axis=0))
+    assert len(verts_xyz) == 12, len(verts_xyz)
+    verts_xyz = np.array([v / np.linalg.norm(v) for v in verts_xyz])
+    face_vert = vert_id.reshape(20, 3)  # face -> 3 vertex ids (slots i,j,k)
+
+    # --- res-0 lattice enumeration ---
+    all_ijk = [
+        t
+        for t in itertools.product(range(3), repeat=3)
+        if min(t) == 0  # normalized
+    ]
+    assert len(all_ijk) == 19
+    entries = []  # (face, ijk, geo, xyz, on_face)
+    for f in range(NUM_ICOSA_FACES):
+        for ijk in all_ijk:
+            g = res0_center(f, ijk)
+            entries.append((f, ijk, g, geo_to_xyz(np.array(g)), sum(ijk) <= 2))
+
+    # --- cluster: canonical positions from on-face entries ---
+    canon: List[Dict] = []  # {"xyz", "geo", "members": [(f, ijk, on_face)]}
+    for f, ijk, g, x, on in entries:
+        if not on:
+            continue
+        for c in canon:
+            if c["xyz"] @ x > 0.9999:
+                c["members"].append((f, ijk, True))
+                break
+        else:
+            canon.append({"xyz": x, "geo": g, "members": [(f, ijk, True)]})
+    assert len(canon) == NUM_BASE_CELLS, len(canon)
+    # assign beyond-edge entries to the nearest canonical center
+    for f, ijk, g, x, on in entries:
+        if on:
+            continue
+        dots = np.array([c["xyz"] @ x for c in canon])
+        best = int(np.argmax(dots))
+        second = float(np.sort(dots)[-2])
+        assert dots[best] > math.cos(0.20), (f, ijk, dots[best])
+        assert second < math.cos(0.17), (f, ijk, second, "ambiguous cluster")
+        canon[best]["members"].append((f, ijk, False))
+
+    # --- numbering: descending latitude of cell center ---
+    lats = np.array([c["geo"][0] for c in canon])
+    lngs = np.array([c["geo"][1] for c in canon])
+    order = np.lexsort((lngs, -lats))  # primary: -lat; tie-break: lng asc
+    gaps = np.diff(np.sort(-lats))
+    if (gaps < 1e-9).any():
+        n_ties = int((gaps < 1e-9).sum())
+        print(f"WARNING: {n_ties} near-ties in latitude ordering", file=sys.stderr)
+    cells = [canon[i] for i in order]
+
+    # pentagons: centers at icosahedron vertices
+    pent = np.zeros(NUM_BASE_CELLS, dtype=bool)
+    for bc, c in enumerate(cells):
+        dots = verts_xyz @ c["xyz"]
+        if dots.max() > 0.9999:
+            pent[bc] = True
+    assert pent.sum() == 12
+    got = sorted(np.nonzero(pent)[0].tolist())
+    assert got == EXPECTED_PENTAGONS, f"pentagon numbering mismatch: {got}"
+    return verts_xyz, face_vert, cells, pent
+
+
+def build_tables(verts_xyz, face_vert, cells, pent):
+    face_ijk_bc = -np.ones((20, 3, 3, 3), dtype=np.int16)
+    face_ijk_rot = np.zeros((20, 3, 3, 3), dtype=np.int16)
+    bc_home_face = np.zeros(NUM_BASE_CELLS, dtype=np.int16)
+    bc_home_ijk = np.zeros((NUM_BASE_CELLS, 3), dtype=np.int16)
+    bc_center_geo = np.array([c["geo"] for c in cells])
+
+    # home = lowest-index face among on-face members.  Pentagons must sit on
+    # their home face's I axis (home ijk == (2,0,0)): the deleted-subsequence
+    # machinery (overage translate origin (maxDim,0,0), leading-I handling)
+    # assumes it, so restrict to faces whose slot-0 vertex is the pentagon.
+    for bc, c in enumerate(cells):
+        on = sorted(m for m in c["members"] if m[2])
+        if pent[bc]:
+            on = [m for m in on if m[1] == (2, 0, 0)]
+            assert on, f"pentagon {bc}: no face has it on the I axis"
+        f, ijk, _ = on[0]
+        bc_home_face[bc] = f
+        bc_home_ijk[bc] = ijk
+
+    # per-(face, ijk) base cell + rotation
+    for bc, c in enumerate(cells):
+        hf = int(bc_home_face[bc])
+        h_ijk = tuple(int(v) for v in bc_home_ijk[bc])
+        for f, ijk, _on in c["members"]:
+            face_ijk_bc[f][ijk] = bc
+            if f == hf and ijk == h_ijk:
+                face_ijk_rot[f][ijk] = 0
+                continue
+            if pent[bc]:
+                face_ijk_rot[f][ijk] = 0  # filled by the pentagon search
+                continue
+            # shared vertex of f and home face nearest the cell
+            shared = [
+                (sf, sh)
+                for sf in range(3)
+                for sh in range(3)
+                if face_vert[f][sf] == face_vert[hf][sh]
+            ]
+            assert shared, f"faces {f},{hf} share no vertex (bc={bc})"
+            ks = set()
+            for sf, sh in shared:
+                d_f = tuple(
+                    a - b for a, b in zip(axial(ijk), axial(VERTEX_IJK[sf]))
+                )
+                d_h = tuple(
+                    a - b for a, b in zip(axial(h_ijk), axial(VERTEX_IJK[sh]))
+                )
+                if d_f == (0, 0):
+                    continue
+                ks.add(solve_rotation(d_f, d_h))
+            assert len(ks) == 1, f"ambiguous rotation bc={bc} f={f}: {ks}"
+            face_ijk_rot[f][ijk] = ks.pop()
+
+    # fill unnormalized raw coords by normalizing first
+    for raw in itertools.product(range(3), repeat=3):
+        n = ml.ijk_normalize(*raw)
+        if n == raw:
+            continue
+        if max(n) <= 2:
+            for f in range(20):
+                face_ijk_bc[f][raw] = face_ijk_bc[f][n]
+                face_ijk_rot[f][raw] = face_ijk_rot[f][n]
+    assert (face_ijk_bc >= 0).all()
+
+    # --- face neighbor (overage) isometries ---
+    face_neighbors = {}
+    for f in range(20):
+        nbrs = {}
+        for edge, (sa, sb) in EDGE_VERTS.items():
+            va, vb = face_vert[f][sa], face_vert[f][sb]
+            g = next(
+                g2
+                for g2 in range(20)
+                if g2 != f and va in face_vert[g2] and vb in face_vert[g2]
+            )
+            ga = list(face_vert[g]).index(va)
+            gb = list(face_vert[g]).index(vb)
+            a_f, b_f = axial(VERTEX_IJK[sa]), axial(VERTEX_IJK[sb])
+            a_g, b_g = axial(VERTEX_IJK[ga]), axial(VERTEX_IJK[gb])
+            k = solve_rotation(
+                (a_f[0] - b_f[0], a_f[1] - b_f[1]),
+                (a_g[0] - b_g[0], a_g[1] - b_g[1]),
+            )
+            ra = axial_rot_k(a_f, k)
+            t = (a_g[0] - ra[0], a_g[1] - ra[1])  # axial translate
+            nbrs[edge] = (int(g), int(k), (int(t[0]), int(t[1]), 0))
+        face_neighbors[f] = nbrs
+
+    return {
+        "FACE_IJK_BC": face_ijk_bc,
+        "FACE_IJK_ROT": face_ijk_rot,
+        "BC_HOME_FACE": bc_home_face,
+        "BC_HOME_IJK": bc_home_ijk,
+        "BC_PENT": pent,
+        "PENT_CW_OFFSET": np.zeros((NUM_BASE_CELLS, 20), dtype=bool),
+        "FACE_NEIGHBORS": face_neighbors,
+        "BC_CENTER_GEO": bc_center_geo,
+    }
+
+
+class _Ns:
+    def __init__(self, d):
+        self.__dict__.update(d)
+
+
+def make_tables_obj(d) -> host.Tables:
+    return host.Tables(_Ns(d))
+
+
+# ---------------------------------------------------------------------------
+# Pentagon parameter search + rotation-sign validation
+# ---------------------------------------------------------------------------
+
+_angdist = ml.angdist
+_unit_angle = ml.unit_angle
+
+
+def _apply_candidate(digits, res, bc, rot, cw_off):
+    digits = list(digits)
+    if host._leading_nonzero(digits) == ml.K_AXES_DIGIT:
+        digits = host._rotate_digits(
+            digits, ml.ROTATE60_CW if cw_off else ml.ROTATE60_CCW
+        )
+    for _ in range(rot):
+        digits = host.rotate_pent60_ccw(digits)
+    return host.pack(bc, digits, res)
+
+
+def _wedge_samples(verts_xyz, face: int, vid: int):
+    """Points on `face` fanning out from vertex `vid` across the face's wedge."""
+    v = verts_xyz[vid]
+    c = geo_to_xyz(FACE_CENTER_GEO[face])
+    d1 = c - (c @ v) * v
+    d1 = d1 / np.linalg.norm(d1)
+    n = np.cross(v, d1)
+    out = []
+    for t in np.linspace(0.006, 0.11, 10):
+        for phi in np.linspace(-0.9, 0.9, 11):  # radians around the wedge
+            d = math.cos(phi) * d1 + math.sin(phi) * n
+            q = math.cos(t) * v + math.sin(t) * d
+            q = q / np.linalg.norm(q)
+            out.append((math.asin(q[2]), math.atan2(q[1], q[0])))
+    return out
+
+
+def pentagon_search(tabs: dict, verts_xyz, face_vert, cells, pent):
+    """Fill FACE_IJK_ROT + PENT_CW_OFFSET for pentagon entries.
+
+    For each (pentagon, face) the candidate (rotation, cw-offset) is scored by
+    the encode->decode round-trip distance over a fan of sample points in that
+    face's wedge at the vertex; the decode path is candidate-independent, so
+    each face is pinned independently and global consistency follows.
+    """
+    T = make_tables_obj(tabs)
+    for bc in np.nonzero(pent)[0]:
+        bc = int(bc)
+        members = [(f, ijk) for f, ijk, _ in cells[bc]["members"]]
+        faces = sorted({f for f, _ in members})
+        assert len(faces) == 5, (bc, faces)
+        home = int(tabs["BC_HOME_FACE"][bc])
+        vid = int(np.argmax(verts_xyz @ cells[bc]["xyz"]))
+
+        for f in faces:
+            samples = _wedge_samples(verts_xyz, f, vid)
+            # raw forwards, filtered to this face + this pentagon
+            raws = []
+            for lat, lng in samples:
+                for res in (2, 3):
+                    face2, ijk, digits = host.forward_raw(lat, lng, res)
+                    if face2 != f:
+                        continue
+                    if int(T.FACE_IJK_BC[face2][tuple(ijk)]) != bc:
+                        continue
+                    raws.append((lat, lng, tuple(digits), res))
+            assert len(raws) >= 30, (bc, f, len(raws))
+            cand_rots = [0] if f == home else list(range(6))
+            scored = []
+            for rot in cand_rots:
+                for cw in (False, True):
+                    dsum = 0.0
+                    for lat, lng, digits, res in raws:
+                        h = _apply_candidate(digits, res, bc, rot, cw)
+                        clat, clng = host.cell_to_latlng_rad(h, T)
+                        dsum += min(
+                            _angdist(lat, lng, clat, clng), 4.0 * _unit_angle(res)
+                        ) / _unit_angle(res)
+                    scored.append((dsum / len(raws), rot, cw))
+            scored.sort()
+            best, runner = scored[0], scored[1]
+            assert best[0] < 0.75, (bc, f, scored[:3])
+            # cw flag may be a don't-care when no K-leading samples exist;
+            # require separation only between different rotations.
+            if runner[1] != best[1]:
+                assert runner[0] > best[0] * 1.3, (bc, f, scored[:3])
+            _, rot, cw = best
+            ijk_f = next(ijk for ff, ijk in members if ff == f)
+            tabs["FACE_IJK_ROT"][f][ijk_f] = rot
+            tabs["PENT_CW_OFFSET"][bc, f] = cw
+    return tabs
+
+
+def roundtrip_check(
+    tabs: dict,
+    n: int = 1500,
+    resolutions=(0, 1, 2, 3, 5),
+    seed=7,
+    skip_pent_bc: bool = False,
+    debug: bool = False,
+):
+    """Fraction of random points whose encode->decode center stays in-cell."""
+    T = make_tables_obj(tabs)
+    rng = np.random.default_rng(seed)
+    bad = 0
+    total = 0
+    for _ in range(n):
+        z = rng.uniform(-1, 1)
+        lng = rng.uniform(-math.pi, math.pi)
+        lat = math.asin(z)
+        for res in resolutions:
+            h = host.latlng_to_cell_int(lat, lng, res, T)
+            if skip_pent_bc and T.BC_PENT[host.get_base_cell(h)]:
+                continue
+            clat, clng = host.cell_to_latlng_rad(h, T)
+            total += 1
+            d = _angdist(lat, lng, clat, clng) / _unit_angle(res)
+            if d > 0.95:
+                bad += 1
+                if debug:
+                    bc = host.get_base_cell(h)
+                    face, ijk, _dig = host.forward_raw(lat, lng, res)
+                    print(
+                        f"  FAIL res={res} bc={bc} pent={bool(T.BC_PENT[bc])} "
+                        f"home={int(T.BC_HOME_FACE[bc])},{tuple(T.BC_HOME_IJK[bc])} "
+                        f"face={face} ijk0={ijk} dist={d:.2f}u"
+                    )
+    return 1.0 - bad / total
+
+
+# ---------------------------------------------------------------------------
+# Emission
+# ---------------------------------------------------------------------------
+
+def emit(tabs: dict, path: str):
+    def arr(a):
+        return np.array2string(
+            np.asarray(a), separator=",", threshold=10**9, max_line_width=100,
+            formatter={"float_kind": lambda x: repr(float(x))},
+        )
+
+    lines = [
+        '"""Derived hex-grid lookup tables. GENERATED by gen_tables.py — do not edit."""',
+        "import numpy as np",
+        "",
+        f"FACE_IJK_BC = np.array({arr(tabs['FACE_IJK_BC'])}, dtype=np.int16)",
+        f"FACE_IJK_ROT = np.array({arr(tabs['FACE_IJK_ROT'])}, dtype=np.int16)",
+        f"BC_HOME_FACE = np.array({arr(tabs['BC_HOME_FACE'])}, dtype=np.int16)",
+        f"BC_HOME_IJK = np.array({arr(tabs['BC_HOME_IJK'])}, dtype=np.int16)",
+        f"BC_PENT = np.array({arr(tabs['BC_PENT'])}, dtype=bool)",
+        f"PENT_CW_OFFSET = np.array({arr(tabs['PENT_CW_OFFSET'])}, dtype=bool)",
+        f"BC_CENTER_GEO = np.array({arr(tabs['BC_CENTER_GEO'])})",
+        f"FACE_NEIGHBORS = {tabs['FACE_NEIGHBORS']!r}",
+        "",
+    ]
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines))
+
+
+GOLDENS = [
+    # (lat_deg, lng_deg, res, cell) — recorded public H3 example values.
+    (37.7752702151959, -122.418307270836, 9, "8928308280fffff"),
+    (37.3615593, -122.0553238, 5, "85283473fffffff"),
+]
+
+
+def main():
+    import os
+
+    print("deriving geometry ...")
+    verts_xyz, face_vert, cells, pent = build_geometry()
+    print("building tables ...")
+    tabs = build_tables(verts_xyz, face_vert, cells, pent)
+
+    # Rotation-sign auto-detection: hexagon-only round-trip with each global
+    # sign convention; keep whichever one decodes consistently.
+    rate_a = roundtrip_check(tabs, n=600, resolutions=(1, 2, 3), skip_pent_bc=True)
+    print(f"hexagon roundtrip (ccw convention): {rate_a:.4f}")
+    if rate_a < 0.998:
+        flipped = tabs["FACE_IJK_ROT"].copy()
+        nz = flipped != 0
+        flipped[nz] = 6 - flipped[nz]
+        tabs["FACE_IJK_ROT"] = flipped
+        rate_b = roundtrip_check(tabs, n=600, resolutions=(1, 2, 3), skip_pent_bc=True)
+        print(f"hexagon roundtrip (cw convention): {rate_b:.4f}")
+        if rate_b < rate_a:
+            # restore ccw and show failures for debugging
+            flipped2 = tabs["FACE_IJK_ROT"].copy()
+            nz = flipped2 != 0
+            flipped2[nz] = 6 - flipped2[nz]
+            tabs["FACE_IJK_ROT"] = flipped2
+            roundtrip_check(tabs, n=150, resolutions=(1, 2, 3), skip_pent_bc=True, debug=True)
+            raise AssertionError((rate_a, rate_b))
+        assert rate_b > 0.998, (rate_a, rate_b)
+
+    print("pentagon parameter search ...")
+    tabs = pentagon_search(tabs, verts_xyz, face_vert, cells, pent)
+
+    rate = roundtrip_check(tabs, n=1500)
+    print(f"full roundtrip pass rate: {rate:.5f}")
+    assert rate > 0.999, rate
+
+    T = make_tables_obj(tabs)
+    for lat, lng, res, want in GOLDENS:
+        got = host.h3_to_string(
+            host.latlng_to_cell_int(math.radians(lat), math.radians(lng), res, T)
+        )
+        status = "OK" if got == want else "MISMATCH"
+        print(f"golden ({lat},{lng},r{res}): want {want} got {got}  [{status}]")
+
+    out = os.path.join(os.path.dirname(__file__), "_tables.py")
+    emit(tabs, out)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

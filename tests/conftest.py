@@ -1,0 +1,23 @@
+"""Test harness config: run JAX on CPU with 8 virtual devices.
+
+The multi-chip sharding path (SURVEY.md SS4(d)) is exercised without TPUs via
+XLA's host-platform device-count override; these env vars must be set before
+jax is imported anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

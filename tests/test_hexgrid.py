@@ -1,0 +1,211 @@
+"""Tests for the hexgrid subsystem (host reference implementation).
+
+The environment has no ``h3`` C library to use as an oracle (SURVEY.md §4
+test seam (a) is adapted): correctness rests on recorded golden values from
+the public H3 documentation plus internal-consistency properties.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from heatmap_tpu.hexgrid import (
+    cell_to_boundary,
+    cell_to_latlng,
+    get_base_cell,
+    get_resolution,
+    h3_to_string,
+    is_pentagon,
+    latlng_to_cell,
+    latlng_to_cell_int,
+    string_to_h3,
+)
+from heatmap_tpu.hexgrid import host, mathlib as ml
+
+EXPECTED_PENTAGONS = [4, 14, 24, 38, 49, 58, 63, 72, 83, 97, 107, 117]
+
+
+unit_angle = ml.unit_angle
+angdist = ml.angdist
+
+
+class TestGoldens:
+    def test_sf_res9(self):
+        # h3-py docs: latlng_to_cell(37.7752702151959, -122.418307270836, 9)
+        assert latlng_to_cell(37.7752702151959, -122.418307270836, 9) == "8928308280fffff"
+
+    def test_bayarea_res5(self):
+        # H3 docs quickstart example cell
+        assert latlng_to_cell(37.3615593, -122.0553238, 5) == "85283473fffffff"
+
+    def test_cell_center_golden(self):
+        lat, lng = cell_to_latlng("85283473fffffff")
+        assert abs(lat - 37.345793375368) < 1e-9
+        assert abs(lng - (-121.976375972551)) < 1e-9
+
+    def test_base_cell_numbering_structure(self):
+        # base cells are numbered by strictly descending center latitude, and
+        # the numbering is antipodally symmetric: bc i is the antipode of
+        # bc 121-i
+        T = host.tables()
+        lats = T.BC_CENTER_GEO[:, 0]
+        assert (np.diff(lats) < 0).all()
+        for i in range(122):
+            a = T.BC_CENTER_GEO[i]
+            b = T.BC_CENTER_GEO[121 - i]
+            assert abs(a[0] + b[0]) < 1e-9
+            d = abs(a[1] - b[1])
+            assert abs(d - math.pi) < 1e-9
+
+    def test_polar_cells(self):
+        # the northernmost cells: points near the pole land in bc 0 or 1
+        assert latlng_to_cell(89.9, 38.0, 0) == "8001fffffffffff"
+        assert latlng_to_cell(-89.9, -142.0, 0) == "80f3fffffffffff"
+
+
+class TestIndexFormat:
+    def test_string_roundtrip(self):
+        h = string_to_h3("8928308280fffff")
+        assert h3_to_string(h) == "8928308280fffff"
+        assert get_resolution(h) == 9
+        assert get_base_cell(h) == 20
+
+    def test_pack_layout(self):
+        # res 0, base cell 0: mode 1 header + all-7 digits
+        h = host.pack(0, [], 0)
+        assert h3_to_string(h) == "8001fffffffffff"
+
+    def test_pentagon_set(self):
+        T = host.tables()
+        got = sorted(np.nonzero(T.BC_PENT)[0].tolist())
+        assert got == EXPECTED_PENTAGONS
+        for bc in got:
+            assert is_pentagon(host.pack(bc, [], 0))
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("res", [0, 1, 2, 4, 7, 8, 9])
+    def test_random_points(self, rng, res):
+        n = 150
+        z = rng.uniform(-1, 1, n)
+        lats = np.arcsin(z)
+        lngs = rng.uniform(-math.pi, math.pi, n)
+        for lat, lng in zip(lats, lngs):
+            h = latlng_to_cell_int(lat, lng, res)
+            clat, clng = host.cell_to_latlng_rad(h)
+            # point must be within one cell circumradius (plus distortion) of
+            # its cell center, and the center must re-encode to the same cell
+            assert angdist(lat, lng, clat, clng) < 0.95 * unit_angle(res)
+            assert latlng_to_cell_int(clat, clng, res) == h
+
+    def test_city_res8(self, rng):
+        # Boston-area points at the reference's default resolution
+        # (reference: heatmap_stream.py:26)
+        for _ in range(200):
+            lat = math.radians(42.3601 + rng.uniform(-0.3, 0.3))
+            lng = math.radians(-71.0589 + rng.uniform(-0.3, 0.3))
+            h = latlng_to_cell_int(lat, lng, 8)
+            clat, clng = host.cell_to_latlng_rad(h)
+            assert latlng_to_cell_int(clat, clng, 8) == h
+            assert angdist(lat, lng, clat, clng) < 0.95 * unit_angle(8)
+
+
+class TestCrossFaceConsistency:
+    def test_edge_straddling_pairs(self, rng):
+        """Points an epsilon apart must index to the same cell (they cannot
+        straddle a cell boundary at eps=1e-9 except with ~0 probability) even
+        when the pair straddles an icosahedron face boundary."""
+        from heatmap_tpu.hexgrid.constants import FACE_CENTER_XYZ
+
+        checked = 0
+        for f in range(20):
+            for g in range(f + 1, 20):
+                if FACE_CENTER_XYZ[f] @ FACE_CENTER_XYZ[g] < 0.74:
+                    continue  # not edge-adjacent
+                mid = FACE_CENTER_XYZ[f] + FACE_CENTER_XYZ[g]
+                mid /= np.linalg.norm(mid)
+                nrm = np.cross(FACE_CENTER_XYZ[f], FACE_CENTER_XYZ[g])
+                nrm /= np.linalg.norm(nrm)
+                tang = np.cross(nrm, mid)
+                for t in rng.uniform(-0.3, 0.3, 8):
+                    p = mid * math.cos(t) + tang * math.sin(t)
+                    for eps in (1e-9, -1e-9):
+                        q = p + eps * nrm
+                        q /= np.linalg.norm(q)
+                        a = (math.asin(p[2]), math.atan2(p[1], p[0]))
+                        b = (math.asin(q[2]), math.atan2(q[1], q[0]))
+                        for res in (2, 5, 8):
+                            assert latlng_to_cell_int(*a, res) == latlng_to_cell_int(*b, res)
+                            checked += 1
+        assert checked > 500
+
+
+class TestBoundary:
+    def test_hexagon_ring(self):
+        h = "8928308280fffff"
+        ring = cell_to_boundary(h)
+        assert len(ring) == 6
+        clat, clng = cell_to_latlng(h)
+        for vlat, vlng in ring:
+            d = angdist(
+                math.radians(vlat), math.radians(vlng),
+                math.radians(clat), math.radians(clng),
+            )
+            assert 0.3 * unit_angle(9) < d < 0.8 * unit_angle(9)
+
+    def test_boundary_closed_ring_convention(self):
+        # serving layer closes the ring itself (reference: app.py:38-41);
+        # here we only guarantee distinct vertices
+        ring = cell_to_boundary("85283473fffffff")
+        assert len(ring) == len({(round(a, 9), round(b, 9)) for a, b in ring})
+
+    def test_pentagon_boundary(self):
+        h = host.pack(4, [0, 0], 2)
+        assert is_pentagon(h)
+        ring = cell_to_boundary(h)
+        assert len(ring) == 5
+
+    def test_center_inside_polygon(self):
+        # planar point-in-polygon check is valid at city scale
+        for cell in ["882a306603fffff", "8928308280fffff"]:
+            ring = cell_to_boundary(cell)
+            clat, clng = cell_to_latlng(cell)
+            sign = 0.0
+            n = len(ring)
+            for i in range(n):
+                a = ring[i]
+                b = ring[(i + 1) % n]
+                cross = (b[1] - a[1]) * (clat - a[0]) - (b[0] - a[0]) * (clng - a[1])
+                if sign == 0.0:
+                    sign = math.copysign(1.0, cross)
+                else:
+                    assert math.copysign(1.0, cross) == sign
+
+
+class TestHierarchy:
+    def test_parent_of_center(self, rng):
+        """A cell center indexed at coarser res gives the truncated index."""
+        for _ in range(100):
+            z = rng.uniform(-1, 1)
+            lat, lng = math.asin(z), rng.uniform(-math.pi, math.pi)
+            h = latlng_to_cell_int(lat, lng, 6)
+            bc, digits, res = host.unpack(h)
+            clat, clng = host.cell_to_latlng_rad(h)
+            parent = latlng_to_cell_int(clat, clng, 5)
+            pbc, pdigits, pres = host.unpack(parent)
+            assert pbc == bc
+            assert pdigits == digits[:5]
+
+    def test_distinct_cells_distinct_points(self, rng):
+        # a sampling-based injectivity check around one metro area
+        seen = {}
+        for _ in range(300):
+            lat = math.radians(42.36 + rng.uniform(-0.05, 0.05))
+            lng = math.radians(-71.06 + rng.uniform(-0.05, 0.05))
+            h = latlng_to_cell_int(lat, lng, 8)
+            clat, clng = host.cell_to_latlng_rad(h)
+            if h in seen:
+                assert seen[h] == (clat, clng)
+            seen[h] = (clat, clng)
+        assert len(seen) > 10
