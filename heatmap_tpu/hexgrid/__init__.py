@@ -24,18 +24,14 @@ validated with recorded golden values plus exhaustive internal-consistency
 properties (round-trips, cross-face agreement, hierarchy, pentagon count).
 """
 
-try:
-    from heatmap_tpu.hexgrid.host import (  # noqa: F401
-        latlng_to_cell,
-        latlng_to_cell_int,
-        cell_to_latlng,
-        cell_to_boundary,
-        h3_to_string,
-        string_to_h3,
-        get_resolution,
-        get_base_cell,
-        is_pentagon,
-    )
-except ImportError as _e:  # during bootstrap, before _tables.py is generated
-    if not (_e.name or "").endswith("_tables"):
-        raise
+from heatmap_tpu.hexgrid.host import (  # noqa: F401
+    latlng_to_cell,
+    latlng_to_cell_int,
+    cell_to_latlng,
+    cell_to_boundary,
+    h3_to_string,
+    string_to_h3,
+    get_resolution,
+    get_base_cell,
+    is_pentagon,
+)

@@ -1,0 +1,112 @@
+"""Device (JAX) hexgrid vs. the host float64 oracle.
+
+The float32 device path may legitimately differ from the oracle for points
+within ~2e-3 grid units of a cell edge (see device.py docstring); the float64
+path must agree exactly.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from heatmap_tpu.hexgrid import device, host
+
+
+def _random_points(rng, n, lat_range=None, lng_range=None):
+    if lat_range is None:
+        z = rng.uniform(-1, 1, n)
+        lat = np.arcsin(z)
+    else:
+        lat = np.radians(rng.uniform(*lat_range, n))
+    if lng_range is None:
+        lng = rng.uniform(-math.pi, math.pi, n)
+    else:
+        lng = np.radians(rng.uniform(*lng_range, n))
+    return lat, lng
+
+
+def _oracle(lat, lng, res):
+    return np.array(
+        [host.latlng_to_cell_int(a, o, res) for a, o in zip(lat, lng)], np.uint64
+    )
+
+
+@pytest.mark.parametrize("res", [0, 1, 5, 8, 9])
+def test_f64_exact_global(rng, res):
+    with jax.enable_x64(True):
+        lat, lng = _random_points(rng, 2000)
+        hi, lo = device.latlng_to_cell_vec(lat, lng, res, dtype=jnp.float64)
+        got = device.cells_to_uint64(hi, lo)
+    want = _oracle(lat, lng, res)
+    mismatch = got != want
+    assert mismatch.sum() == 0, (
+        f"res={res}: {mismatch.sum()}/{len(lat)} mismatches, "
+        f"first at {np.nonzero(mismatch)[0][:5]}"
+    )
+
+
+# float32 lat/lng quantizes ground position to ~0.6 m; the fraction of cell
+# area within that distance of an edge sets the attainable exact-match rate.
+_F32_MIN_RATE = {7: 0.9985, 8: 0.997, 9: 0.994}
+
+
+@pytest.mark.parametrize("res", [7, 8, 9])
+def test_f32_city_accuracy(rng, res):
+    # Boston-ish box (the reference's default city view, app.py:121)
+    lat, lng = _random_points(rng, 5000, (42.2, 42.5), (-71.3, -70.8))
+    hi, lo = device.latlng_to_cell_vec(lat, lng, res, dtype=jnp.float32)
+    got = device.cells_to_uint64(hi, lo)
+    want = _oracle(lat, lng, res)
+    rate = float((got == want).mean())
+    assert rate >= _F32_MIN_RATE[res], f"res={res}: exact-match rate {rate}"
+    # every mismatch must be a neighbor-cell snap: centers within 1.5 cell units
+    for idx in np.nonzero(got != want)[0]:
+        la1, lo1 = host.cell_to_latlng_rad(int(got[idx]))
+        la2, lo2 = host.cell_to_latlng_rad(int(want[idx]))
+        from heatmap_tpu.hexgrid import mathlib as ml
+
+        d = ml.angdist(la1, lo1, la2, lo2) / ml.unit_angle(res)
+        assert d < 1.5, f"non-neighbor mismatch at {idx}: {d} units"
+
+
+def test_f32_global_accuracy(rng):
+    lat, lng = _random_points(rng, 20000)
+    hi, lo = device.latlng_to_cell_vec(lat, lng, 8, dtype=jnp.float32)
+    got = device.cells_to_uint64(hi, lo)
+    want = _oracle(lat, lng, 8)
+    rate = float((got == want).mean())
+    assert rate >= 0.998, f"global res 8 exact-match rate {rate}"
+
+
+def test_goldens_f32():
+    # public H3 example values (also checked host-side in test_hexgrid)
+    pts = [
+        (37.7752702151959, -122.418307270836, 9, "8928308280fffff"),
+        (37.3615593, -122.0553238, 5, "85283473fffffff"),
+    ]
+    for lat, lng, res, want in pts:
+        hi, lo = device.latlng_deg_to_cell_vec(
+            np.array([lat]), np.array([lng]), res
+        )
+        assert device.cells_to_strings(hi, lo)[0] == want
+
+
+def test_batch_shapes_and_dtype():
+    hi, lo = device.latlng_to_cell_vec(np.zeros(17), np.zeros(17), 8)
+    assert hi.shape == (17,) and lo.shape == (17,)
+    assert hi.dtype == jnp.uint32 and lo.dtype == jnp.uint32
+
+
+def test_res0_and_pentagon_bases(rng):
+    # res-0: every base cell reachable from its own center coordinates
+    T = host.tables()
+    lat = T.BC_CENTER_GEO[:, 0]
+    lng = T.BC_CENTER_GEO[:, 1]
+    hi, lo = device.latlng_to_cell_vec(lat, lng, 0)
+    got = device.cells_to_uint64(hi, lo)
+    bcs = ((got >> np.uint64(45)) & np.uint64(0x7F)).astype(int)
+    assert (bcs == np.arange(122)).all()
