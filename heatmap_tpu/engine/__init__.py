@@ -1,0 +1,22 @@
+"""engine — on-device windowed tile aggregation.
+
+The TPU-native replacement for the reference's Spark shuffle aggregation
+(reference: heatmap_stream.py:112-133 ``groupBy(window(eventTs), cellId)``
+with count/avg aggregates, watermark at :107).  Instead of a hash-partitioned
+shuffle across JVM executors, the engine keeps a *compact, key-sorted state
+slab* in device memory and folds each fixed-shape micro-batch in with a
+single lexicographic sort + segment scatter — shapes are static, control flow
+is compiler-friendly, and the whole step is one fused XLA program.
+
+See ``state`` for the state layout and ``step`` for the batch fold.
+"""
+
+from heatmap_tpu.engine.state import TileState, init_state, EMPTY_KEY_HI  # noqa: F401
+from heatmap_tpu.engine.step import (  # noqa: F401
+    AggParams,
+    BatchEmit,
+    StepStats,
+    aggregate_batch,
+    merge_batch,
+    snap_and_window,
+)

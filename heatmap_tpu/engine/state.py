@@ -1,0 +1,63 @@
+"""Device-resident aggregation state for one (resolution, window-size) pair.
+
+Layout: a fixed-capacity compact slab of (cell, windowStart) groups, kept
+**sorted by key** with empty slots (key_hi == EMPTY_KEY_HI) at the tail.
+Sortedness is the invariant that lets each micro-batch be folded in with one
+merge-sort rather than hash probing (see step.merge_batch).
+
+The 64-bit cell index rides as two uint32 lanes (TPU-friendly; see
+hexgrid/device.py).  Aggregates mirror the reference's groupBy outputs —
+count, avg(speedKmh), avg(lon), avg(lat) (reference: heatmap_stream.py:118-123)
+— plus sum-of-squares and an optional per-cell speed histogram so the
+extended stats configs (p95 speed, BASELINE.json config #5) come from the
+same state.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+# Sentinel for empty slots.  Valid cell-index high words always have bit 31
+# (the reserved H3 bit 63) clear, so 0xFFFFFFFF can never collide.
+EMPTY_KEY_HI = jnp.uint32(0xFFFFFFFF)
+EMPTY_KEY_LO = jnp.uint32(0xFFFFFFFF)
+EMPTY_WS = jnp.int32(2**31 - 1)
+
+
+class TileState(NamedTuple):
+    """All arrays share leading dim = capacity C; hist is (C, B) (B may be 0)."""
+
+    key_hi: jnp.ndarray    # uint32 — cell index bits 32..63
+    key_lo: jnp.ndarray    # uint32 — cell index bits 0..31
+    key_ws: jnp.ndarray    # int32  — window start, epoch seconds
+    count: jnp.ndarray     # int32
+    sum_speed: jnp.ndarray   # float32 — Σ speedKmh
+    sum_speed2: jnp.ndarray  # float32 — Σ speedKmh²
+    sum_lat: jnp.ndarray     # float32 — Σ lat (degrees)
+    sum_lon: jnp.ndarray     # float32 — Σ lon (degrees)
+    hist: jnp.ndarray        # int32 (C, B) — speed histogram for p95
+
+    @property
+    def capacity(self) -> int:
+        return self.key_hi.shape[0]
+
+    @property
+    def hist_bins(self) -> int:
+        return self.hist.shape[1]
+
+
+def init_state(capacity: int, hist_bins: int = 0) -> TileState:
+    c = capacity
+    return TileState(
+        key_hi=jnp.full((c,), EMPTY_KEY_HI, jnp.uint32),
+        key_lo=jnp.full((c,), EMPTY_KEY_LO, jnp.uint32),
+        key_ws=jnp.full((c,), EMPTY_WS, jnp.int32),
+        count=jnp.zeros((c,), jnp.int32),
+        sum_speed=jnp.zeros((c,), jnp.float32),
+        sum_speed2=jnp.zeros((c,), jnp.float32),
+        sum_lat=jnp.zeros((c,), jnp.float32),
+        sum_lon=jnp.zeros((c,), jnp.float32),
+        hist=jnp.zeros((c, hist_bins), jnp.int32),
+    )

@@ -1,0 +1,252 @@
+"""The per-micro-batch aggregation fold (device hot path).
+
+Replaces one Spark micro-batch's parse → H3-UDF → shuffle → stateful-agg
+chain (reference: heatmap_stream.py:88-133 and call stack SURVEY.md §3.3)
+with a single jitted XLA program:
+
+  1. ``snap_and_window`` — vectorized H3 snap (hexgrid.device) + tumbling
+     window-start computation; invalid/late rows get the EMPTY key (the
+     moral equivalent of the reference's null/bounds filters,
+     heatmap_stream.py:96-108, and its 10-minute watermark drop, :107).
+  2. ``merge_batch`` — merge-sort the batch into the compact sorted state
+     slab: one ``lax.sort`` over (state ∥ batch) keys, segment-id
+     derivation, then masked scatters to rebuild the slab.  Watermark
+     eviction of closed windows is folded into the same sort (evicted rows
+     are relabeled EMPTY so they sink to the tail and their slots recycle).
+
+Everything is static-shape; the only dynamic quantities (number of distinct
+keys, number of touched groups) are carried as masks and counters.
+
+Degradation semantics: if the number of distinct live groups ever exceeds the
+slab capacity, the groups with the highest composite keys are dropped —
+including, possibly, pre-existing rows whose aggregates are then lost (their
+next re-emit restarts the count).  ``StepStats.state_overflow`` counts the
+dropped segments; the stream runtime treats any nonzero value as a loud
+misconfiguration error (capacity must be sized for the active-cell
+cardinality, SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from heatmap_tpu.engine.state import (
+    EMPTY_KEY_HI,
+    EMPTY_KEY_LO,
+    EMPTY_WS,
+    TileState,
+)
+from heatmap_tpu.hexgrid import device as hexdev
+
+I32_MIN = jnp.int32(-(2**31))
+
+
+class AggParams(NamedTuple):
+    """Static parameters of one (resolution, window) aggregation."""
+
+    res: int                 # H3 resolution (heatmap_stream.py:26)
+    window_s: int            # tumbling window seconds (heatmap_stream.py:29)
+    emit_capacity: int       # max groups emitted per batch (update mode)
+    speed_hist_max: float = 256.0   # km/h mapped onto the last hist bin
+
+
+class BatchEmit(NamedTuple):
+    """Update-mode output: current aggregates of every group touched by this
+    batch (the reference's outputMode("update") contract,
+    heatmap_stream.py:241-247).  Fixed capacity; ``valid`` marks live rows."""
+
+    key_hi: jnp.ndarray
+    key_lo: jnp.ndarray
+    key_ws: jnp.ndarray
+    count: jnp.ndarray
+    sum_speed: jnp.ndarray
+    sum_speed2: jnp.ndarray
+    sum_lat: jnp.ndarray
+    sum_lon: jnp.ndarray
+    hist: jnp.ndarray
+    valid: jnp.ndarray       # bool
+    n_emitted: jnp.ndarray   # int32 scalar — true touched-group count
+    overflowed: jnp.ndarray  # bool scalar — touched groups > emit capacity
+
+
+class StepStats(NamedTuple):
+    n_valid: jnp.ndarray       # events aggregated
+    n_late: jnp.ndarray        # events dropped by the watermark
+    n_evicted: jnp.ndarray     # state rows recycled (closed windows)
+    n_active: jnp.ndarray      # live groups after the merge
+    state_overflow: jnp.ndarray  # distinct keys beyond capacity (dropped)
+    batch_max_ts: jnp.ndarray  # int32 — max valid event ts (watermark input)
+
+
+def snap_and_window(lat_rad, lng_rad, ts_s, valid, params: AggParams):
+    """Compute (key_hi, key_lo, window_start) per event; invalid → EMPTY."""
+    hi, lo = hexdev.latlng_to_cell_vec(lat_rad, lng_rad, params.res)
+    ws = (ts_s // params.window_s) * params.window_s
+    hi = jnp.where(valid, hi, EMPTY_KEY_HI)
+    lo = jnp.where(valid, lo, EMPTY_KEY_LO)
+    ws = jnp.where(valid, ws, EMPTY_WS)
+    return hi, lo, ws
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def merge_batch(
+    state: TileState,
+    ev_hi,
+    ev_lo,
+    ev_ws,
+    ev_speed,
+    ev_lat_deg,
+    ev_lon_deg,
+    ev_ts,
+    ev_valid,
+    watermark_cutoff,          # int32 scalar: evict windows ending before this
+    params: AggParams,
+):
+    """Fold one batch into the state. Returns (state, BatchEmit, StepStats)."""
+    C = state.capacity
+    N = ev_hi.shape[0]
+    B = state.hist_bins
+
+    # --- late-event drop + window eviction (watermark semantics) ---------
+    # an event is late when its window closed: ws + window <= cutoff
+    late = ev_valid & (ev_ws + params.window_s <= watermark_cutoff)
+    ev_valid = ev_valid & ~late
+    ev_hi = jnp.where(ev_valid, ev_hi, EMPTY_KEY_HI)
+    ev_lo = jnp.where(ev_valid, ev_lo, EMPTY_KEY_LO)
+    ev_ws = jnp.where(ev_valid, ev_ws, EMPTY_WS)
+
+    live = state.key_hi != EMPTY_KEY_HI
+    evict = live & (state.key_ws + params.window_s <= watermark_cutoff)
+    keep = live & ~evict
+    st_hi = jnp.where(keep, state.key_hi, EMPTY_KEY_HI)
+    st_lo = jnp.where(keep, state.key_lo, EMPTY_KEY_LO)
+    st_ws = jnp.where(keep, state.key_ws, EMPTY_WS)
+
+    # --- merge-sort state ∥ batch by (hi, lo, ws); carry origin row ------
+    all_hi = jnp.concatenate([st_hi, ev_hi])
+    all_lo = jnp.concatenate([st_lo, ev_lo])
+    all_ws = jnp.concatenate([st_ws, ev_ws])
+    orig = jnp.arange(C + N, dtype=jnp.int32)  # <C: state row, >=C: batch row
+    s_hi, s_lo, s_ws, s_orig = jax.lax.sort(
+        (all_hi, all_lo, all_ws, orig), num_keys=3
+    )
+
+    nonempty = s_hi != EMPTY_KEY_HI
+    changed = (
+        (s_hi != jnp.roll(s_hi, 1))
+        | (s_lo != jnp.roll(s_lo, 1))
+        | (s_ws != jnp.roll(s_ws, 1))
+    )
+    is_start = changed.at[0].set(True)
+    seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1  # sorted-order segment id
+
+    # --- per-origin-row new segment (the scatter routing tables) ---------
+    # state row r (kept) lands in segment state_seg[r]; batch row i in batch_seg[i]
+    st_idx = jnp.where(s_orig < C, s_orig, C)
+    state_seg = jnp.full((C,), C, jnp.int32).at[st_idx].set(seg, mode="drop")
+    bt_idx = jnp.where(s_orig >= C, s_orig - C, N)
+    batch_seg = jnp.full((N,), C, jnp.int32).at[bt_idx].set(seg, mode="drop")
+    # route empties/evictions/lates to the drop bin
+    state_seg = jnp.where(keep, state_seg, C)
+    batch_seg = jnp.where(ev_valid, batch_seg, C)
+
+    # --- rebuild the slab ------------------------------------------------
+    def scat(init, idx, vals):
+        return init.at[idx].add(vals, mode="drop")
+
+    key_hi = jnp.full((C,), EMPTY_KEY_HI, jnp.uint32).at[seg].set(s_hi, mode="drop")
+    key_lo = jnp.full((C,), EMPTY_KEY_LO, jnp.uint32).at[seg].set(s_lo, mode="drop")
+    key_ws = jnp.full((C,), EMPTY_WS, jnp.int32).at[seg].set(s_ws, mode="drop")
+    # rows of the EMPTY segment must stay sentinel even though scatters above
+    # wrote EMPTY there anyway; values below only ever add masked amounts.
+
+    zc = jnp.zeros((C,), jnp.int32)
+    zf = jnp.zeros((C,), jnp.float32)
+    one = ev_valid.astype(jnp.int32)
+    count = scat(scat(zc, state_seg, jnp.where(keep, state.count, 0)), batch_seg, one)
+    fmask = ev_valid.astype(jnp.float32)
+    kf = keep.astype(jnp.float32)
+    sum_speed = scat(scat(zf, state_seg, state.sum_speed * kf), batch_seg, ev_speed * fmask)
+    sum_speed2 = scat(
+        scat(zf, state_seg, state.sum_speed2 * kf), batch_seg, ev_speed * ev_speed * fmask
+    )
+    sum_lat = scat(scat(zf, state_seg, state.sum_lat * kf), batch_seg, ev_lat_deg * fmask)
+    sum_lon = scat(scat(zf, state_seg, state.sum_lon * kf), batch_seg, ev_lon_deg * fmask)
+
+    if B > 0:
+        bin_w = params.speed_hist_max / B
+        ev_bin = jnp.clip((ev_speed / bin_w).astype(jnp.int32), 0, B - 1)
+        hist = jnp.zeros((C, B), jnp.int32)
+        hist = hist.at[state_seg].add(
+            state.hist * keep[:, None].astype(jnp.int32), mode="drop"
+        )
+        hist = hist.at[batch_seg, ev_bin].add(one, mode="drop")
+    else:
+        hist = state.hist
+
+    new_state = TileState(
+        key_hi=key_hi, key_lo=key_lo, key_ws=key_ws, count=count,
+        sum_speed=sum_speed, sum_speed2=sum_speed2,
+        sum_lat=sum_lat, sum_lon=sum_lon, hist=hist,
+    )
+
+    # --- update-mode emit: groups touched by this batch -------------------
+    E = params.emit_capacity
+    touched = jnp.zeros((C,), bool).at[batch_seg].set(True, mode="drop")
+    n_emitted = jnp.sum(touched.astype(jnp.int32))
+    emit_idx = jnp.nonzero(touched, size=E, fill_value=C)[0]
+    emit_ok = emit_idx < C
+    gi = jnp.where(emit_ok, emit_idx, 0)
+    emit = BatchEmit(
+        key_hi=jnp.where(emit_ok, key_hi[gi], EMPTY_KEY_HI),
+        key_lo=jnp.where(emit_ok, key_lo[gi], EMPTY_KEY_LO),
+        key_ws=jnp.where(emit_ok, key_ws[gi], EMPTY_WS),
+        count=jnp.where(emit_ok, count[gi], 0),
+        sum_speed=jnp.where(emit_ok, sum_speed[gi], 0.0),
+        sum_speed2=jnp.where(emit_ok, sum_speed2[gi], 0.0),
+        sum_lat=jnp.where(emit_ok, sum_lat[gi], 0.0),
+        sum_lon=jnp.where(emit_ok, sum_lon[gi], 0.0),
+        hist=hist[gi] * emit_ok[:, None].astype(jnp.int32) if B > 0
+        else jnp.zeros((E, 0), jnp.int32),
+        valid=emit_ok,
+        n_emitted=n_emitted,
+        overflowed=n_emitted > E,
+    )
+
+    # --- stats ------------------------------------------------------------
+    n_seg_total = seg[-1] + 1  # includes the single EMPTY segment if present
+    has_empty = ~nonempty[-1]  # empties (if any) sort last
+    n_distinct = n_seg_total - has_empty.astype(jnp.int32)
+    stats = StepStats(
+        n_valid=jnp.sum(one),
+        n_late=jnp.sum(late.astype(jnp.int32)),
+        n_evicted=jnp.sum(evict.astype(jnp.int32)),
+        n_active=jnp.sum((key_hi != EMPTY_KEY_HI).astype(jnp.int32)),
+        state_overflow=jnp.maximum(n_distinct - C, 0),
+        batch_max_ts=jnp.max(jnp.where(ev_valid, ev_ts, I32_MIN)),
+    )
+    return new_state, emit, stats
+
+
+def aggregate_batch(
+    state: TileState,
+    lat_rad,
+    lng_rad,
+    speed_kmh,
+    ts_s,
+    valid,
+    watermark_cutoff,
+    params: AggParams,
+):
+    """Convenience: snap + window + merge in one call (used by stream/)."""
+    hi, lo, ws = snap_and_window(lat_rad, lng_rad, ts_s, valid, params)
+    lat_deg = lat_rad * (180.0 / jnp.pi)
+    lon_deg = lng_rad * (180.0 / jnp.pi)
+    return merge_batch(
+        state, hi, lo, ws, speed_kmh, lat_deg, lon_deg, ts_s, valid,
+        watermark_cutoff, params,
+    )

@@ -1,0 +1,221 @@
+"""Golden micro-batch tests: device aggregation vs. a numpy dict aggregator.
+
+SURVEY.md §4(b): feed synthetic event arrays through the device aggregation
+and assert (cellId, window) -> (count, avgSpeed, ...) exactly.
+"""
+
+import numpy as np
+import pytest
+
+from heatmap_tpu.engine import AggParams, TileState, init_state, merge_batch
+from heatmap_tpu.engine.state import EMPTY_KEY_HI
+from heatmap_tpu.engine.step import snap_and_window
+
+PARAMS = AggParams(res=8, window_s=300, emit_capacity=512)
+
+
+def make_batch(rng, n, t0=1_700_000_000, spread_s=600, nan_frac=0.0):
+    lat = np.radians(rng.uniform(42.2, 42.5, n)).astype(np.float32)
+    lng = np.radians(rng.uniform(-71.3, -70.8, n)).astype(np.float32)
+    speed = rng.uniform(0, 120, n).astype(np.float32)
+    ts = (t0 + rng.integers(0, spread_s, n)).astype(np.int32)
+    valid = np.ones(n, bool)
+    if nan_frac:
+        valid[rng.random(n) < nan_frac] = False
+    return lat, lng, speed, ts, valid
+
+
+class DictAgg:
+    """Host-side oracle mirroring the reference groupBy semantics
+    (heatmap_stream.py:112-133) plus watermark eviction."""
+
+    def __init__(self, params):
+        self.p = params
+        self.groups = {}
+
+    def feed(self, keys_hi, keys_lo, ws, speed, lat_deg, lon_deg, valid, cutoff):
+        # evict closed windows first (mirrors merge_batch ordering)
+        self.groups = {
+            k: v for k, v in self.groups.items()
+            if k[2] + self.p.window_s > cutoff
+        }
+        touched = set()
+        for i in range(len(ws)):
+            if not valid[i]:
+                continue
+            if ws[i] + self.p.window_s <= cutoff:
+                continue  # late
+            k = (int(keys_hi[i]), int(keys_lo[i]), int(ws[i]))
+            g = self.groups.setdefault(k, [0, 0.0, 0.0, 0.0, 0.0])
+            g[0] += 1
+            g[1] += float(speed[i])
+            g[2] += float(speed[i]) ** 2
+            g[3] += float(lat_deg[i])
+            g[4] += float(lon_deg[i])
+            touched.add(k)
+        return touched
+
+
+def run_both(rng, n_batches=4, n=256, cap=4096, cutoff_fn=None, nan_frac=0.0,
+             params=PARAMS):
+    state = init_state(cap, hist_bins=0)
+    oracle = DictAgg(params)
+    all_touched = []
+    for b in range(n_batches):
+        lat, lng, speed, ts, valid = make_batch(
+            rng, n, t0=1_700_000_000 + b * 120, nan_frac=nan_frac
+        )
+        cutoff = np.int32(cutoff_fn(b) if cutoff_fn else -2**31)
+        hi, lo, ws = snap_and_window(lat, lng, ts, valid, params)
+        hi, lo, ws = np.asarray(hi), np.asarray(lo), np.asarray(ws)
+        lat_deg = np.degrees(lat.astype(np.float64)).astype(np.float32)
+        lon_deg = np.degrees(lng.astype(np.float64)).astype(np.float32)
+        state, emit, stats = merge_batch(
+            state, hi, lo, ws, speed, lat_deg, lon_deg, ts, valid, cutoff, params
+        )
+        touched = oracle.feed(hi, lo, ws, speed, lat_deg, lon_deg, valid, cutoff)
+        all_touched.append((emit, touched))
+    return state, oracle, all_touched, stats
+
+
+def state_as_dict(state):
+    out = {}
+    hi = np.asarray(state.key_hi)
+    live = hi != np.uint32(0xFFFFFFFF)
+    for i in np.nonzero(live)[0]:
+        k = (int(hi[i]), int(np.asarray(state.key_lo)[i]),
+             int(np.asarray(state.key_ws)[i]))
+        out[k] = [
+            int(np.asarray(state.count)[i]),
+            float(np.asarray(state.sum_speed)[i]),
+            float(np.asarray(state.sum_speed2)[i]),
+            float(np.asarray(state.sum_lat)[i]),
+            float(np.asarray(state.sum_lon)[i]),
+        ]
+    return out
+
+
+def assert_groups_equal(got, want, rtol=2e-5):
+    assert set(got) == set(want)
+    for k, g in got.items():
+        w = want[k]
+        assert g[0] == w[0], (k, g, w)  # exact count
+        np.testing.assert_allclose(g[1:], w[1:], rtol=rtol, atol=1e-3)
+
+
+def test_multi_batch_exact_aggregation(rng):
+    state, oracle, _, stats = run_both(rng)
+    assert_groups_equal(state_as_dict(state), oracle.groups)
+    assert int(stats.n_active) == len(oracle.groups)
+    assert int(stats.state_overflow) == 0
+
+
+def test_invalid_rows_excluded(rng):
+    state, oracle, _, _ = run_both(rng, nan_frac=0.3)
+    assert_groups_equal(state_as_dict(state), oracle.groups)
+
+
+def test_sorted_invariant_and_empties_at_tail(rng):
+    state, _, _, _ = run_both(rng)
+    hi = np.asarray(state.key_hi).astype(np.uint64)
+    lo = np.asarray(state.key_lo).astype(np.uint64)
+    ws = np.asarray(state.key_ws).astype(np.int64) - (-2**31)
+    composite = [(int(h), int(l), int(w)) for h, l, w in zip(hi, lo, ws)]
+    assert composite == sorted(composite)
+    live = hi != 0xFFFFFFFF
+    n = live.sum()
+    assert not live[n:].any()
+
+
+def test_watermark_eviction_and_late_drop(rng):
+    # cutoff advances past the first batches' windows
+    t0 = 1_700_000_000
+    win = PARAMS.window_s
+
+    def cutoff(b):
+        # batch 3 carries a watermark that closes every window before t0+600
+        return t0 + 600 if b == 3 else -2**31
+
+    state, oracle, _, stats = run_both(rng, n_batches=4, cutoff_fn=cutoff)
+    got = state_as_dict(state)
+    assert_groups_equal(got, oracle.groups)
+    assert all(k[2] + win > t0 + 600 for k in got)
+    assert int(stats.n_evicted) > 0 or int(stats.n_late) > 0
+
+
+def test_emit_matches_touched_groups(rng):
+    state, oracle, touched_log, _ = run_both(rng, n_batches=2)
+    emit, touched = touched_log[-1]
+    valid = np.asarray(emit.valid)
+    got_keys = {
+        (int(np.asarray(emit.key_hi)[i]), int(np.asarray(emit.key_lo)[i]),
+         int(np.asarray(emit.key_ws)[i]))
+        for i in np.nonzero(valid)[0]
+    }
+    assert got_keys == touched
+    assert int(emit.n_emitted) == len(touched)
+    assert not bool(emit.overflowed)
+    # emitted aggregates equal current state values
+    sd = state_as_dict(state)
+    for i in np.nonzero(valid)[0]:
+        k = (int(np.asarray(emit.key_hi)[i]), int(np.asarray(emit.key_lo)[i]),
+             int(np.asarray(emit.key_ws)[i]))
+        assert int(np.asarray(emit.count)[i]) == sd[k][0]
+
+
+def test_emit_overflow_flag(rng):
+    params = AggParams(res=8, window_s=300, emit_capacity=4)
+    state = init_state(512, 0)
+    lat, lng, speed, ts, valid = make_batch(rng, 256)
+    hi, lo, ws = snap_and_window(lat, lng, ts, valid, params)
+    state, emit, _ = merge_batch(
+        state, np.asarray(hi), np.asarray(lo), np.asarray(ws), speed,
+        np.degrees(lat), np.degrees(lng), ts, valid, np.int32(-2**31), params
+    )
+    assert bool(emit.overflowed)
+    assert int(emit.n_emitted) > 4
+    assert np.asarray(emit.valid).sum() == 4
+
+
+def test_state_overflow_counted(rng):
+    state = init_state(8, 0)  # tiny capacity
+    lat, lng, speed, ts, valid = make_batch(rng, 512)
+    hi, lo, ws = snap_and_window(lat, lng, ts, valid, PARAMS)
+    state, _, stats = merge_batch(
+        state, np.asarray(hi), np.asarray(lo), np.asarray(ws), speed,
+        np.degrees(lat), np.degrees(lng), ts, valid, np.int32(-2**31), PARAMS
+    )
+    assert int(stats.state_overflow) > 0
+    assert int(stats.n_active) == 8
+
+
+def test_speed_histogram(rng):
+    params = AggParams(res=8, window_s=300, emit_capacity=128, speed_hist_max=128.0)
+    state = init_state(2048, hist_bins=16)
+    lat, lng, speed, ts, valid = make_batch(rng, 512)
+    hi, lo, ws = snap_and_window(lat, lng, ts, valid, params)
+    state, emit, _ = merge_batch(
+        state, np.asarray(hi), np.asarray(lo), np.asarray(ws), speed,
+        np.degrees(lat), np.degrees(lng), ts, valid, np.int32(-2**31), params
+    )
+    hist = np.asarray(state.hist)
+    count = np.asarray(state.count)
+    # per-row histogram mass equals the row count
+    np.testing.assert_array_equal(hist.sum(axis=1), count)
+    # total mass = number of valid events
+    assert hist.sum() == valid.sum()
+    # oracle per-bin check
+    keys = np.stack([np.asarray(hi), np.asarray(lo), np.asarray(ws)], 1)
+    bins = np.clip((speed / (128.0 / 16)).astype(int), 0, 15)
+    from collections import Counter
+
+    oracle = Counter()
+    for i in range(len(speed)):
+        oracle[(tuple(keys[i]), bins[i])] += 1
+    shi = np.asarray(state.key_hi)
+    for r in np.nonzero(shi != np.uint32(0xFFFFFFFF))[0]:
+        for b in range(16):
+            want = oracle.get(((np.asarray(state.key_hi)[r],
+                                np.asarray(state.key_lo)[r],
+                                np.asarray(state.key_ws)[r]), b), 0)
+            assert hist[r, b] == want
