@@ -1,0 +1,246 @@
+"""shard_map sharded aggregation step (the ICI shuffle).
+
+Dataflow per device (= one shard of the mesh axis "shards"):
+
+    local batch shard (N/D events)
+      → snap_and_window (hexgrid.device)
+      → owner = mix32(key) % D            # key-space partitioning
+      → bucket into (D, cap) padded lanes # stable-sort by owner + rank
+      → lax.all_to_all over "shards"      # the ICI exchange (≈ Spark shuffle)
+      → engine.merge_batch into the local state slab (keys owned exclusively)
+
+Bucket lanes are fixed-capacity (static shapes); events beyond a lane's
+capacity are dropped and counted in ``ShardStats.bucket_dropped`` — size
+``bucket_factor`` for the expected worst-case skew.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from heatmap_tpu.engine.state import (
+    EMPTY_KEY_HI,
+    EMPTY_KEY_LO,
+    EMPTY_WS,
+    TileState,
+    init_state,
+)
+from heatmap_tpu.engine.step import AggParams, BatchEmit, merge_batch, snap_and_window
+
+AXIS = "shards"
+
+
+class ShardStats(NamedTuple):
+    n_valid: jnp.ndarray
+    n_late: jnp.ndarray
+    n_evicted: jnp.ndarray
+    n_active: jnp.ndarray
+    state_overflow: jnp.ndarray
+    batch_max_ts: jnp.ndarray
+    bucket_dropped: jnp.ndarray
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    if n_devices:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (AXIS,))
+
+
+def _mix32(hi, lo, ws):
+    """Cheap avalanche mix of the composite key into uint32 (owner hash)."""
+    h = hi ^ (lo * jnp.uint32(2654435761))
+    h = h ^ (ws.astype(jnp.uint32) * jnp.uint32(0x9E3779B1))
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x7FEB352D)
+    h = h ^ (h >> 15)
+    return h
+
+
+def _bucket_and_exchange(fields, dest, valid, n_shards: int, cap: int):
+    """Route per-event field arrays to their owner shard.
+
+    fields: dict name -> (N,) array.  Returns (dict name -> (D*cap,) array
+    plus a "valid" mask, n_dropped scalar).  All fields are bitcast to
+    uint32 and packed into ONE all_to_all so the exchange is a single ICI
+    collective per step.
+    """
+    n = dest.shape[0]
+    # invalid events must not consume lane capacity: sink them to a
+    # nonexistent destination group before ranking
+    dest = jnp.where(valid, dest, jnp.int32(n_shards))
+    order = jnp.argsort(dest, stable=True)
+    dest_s = dest[order]
+    # rank of each event within its destination group
+    pos = jnp.arange(n, dtype=jnp.int32)
+    is_first = jnp.concatenate(
+        [jnp.ones((1,), bool), dest_s[1:] != dest_s[:-1]]
+    )
+    group_start = jax.lax.cummax(jnp.where(is_first, pos, 0))
+    rank = pos - group_start
+    slot = dest_s * cap + rank
+    ok = valid[order] & (rank < cap) & (dest_s < n_shards)
+    slot = jnp.where(ok, slot, n_shards * cap)  # OOB → dropped
+
+    names = sorted(fields)
+    out = []
+    for name in names:
+        arr = fields[name]
+        if arr.dtype == jnp.uint32:
+            init = jnp.full((n_shards * cap,), EMPTY_KEY_HI, jnp.uint32)
+        elif name == "ws":
+            init = jnp.full((n_shards * cap,), EMPTY_WS, jnp.int32)
+        else:
+            init = jnp.zeros((n_shards * cap,), arr.dtype)
+        out.append(init.at[slot].set(arr[order], mode="drop"))
+    sent_valid = (
+        jnp.zeros((n_shards * cap,), bool).at[slot].set(ok, mode="drop")
+    )
+    names.append("valid")
+    out.append(sent_valid)
+    n_dropped = jnp.sum((valid[order] & (rank >= cap)).astype(jnp.int32))
+
+    # pack every lane as uint32 → one ICI collective; block b goes to peer b
+    packed = jnp.stack(
+        [a.astype(jnp.uint32) if a.dtype == jnp.bool_
+         else jax.lax.bitcast_convert_type(a, jnp.uint32)
+         for a in out],
+        axis=-1,
+    ).reshape(n_shards, cap, len(out))
+    packed = jax.lax.all_to_all(packed, AXIS, split_axis=0, concat_axis=0)
+    packed = packed.reshape(n_shards * cap, len(out))
+
+    exchanged = {}
+    for i, name in enumerate(names):
+        lane = packed[:, i]
+        want = out[i].dtype
+        if want == jnp.bool_:
+            exchanged[name] = lane != 0
+        else:
+            exchanged[name] = jax.lax.bitcast_convert_type(lane, want)
+    return exchanged, n_dropped
+
+
+def _sharded_step_body(params: AggParams, n_shards: int, cap: int,
+                       state: TileState, lat, lng, speed, ts, valid, cutoff):
+    """Per-device body run under shard_map."""
+    hi, lo, ws = snap_and_window(lat, lng, ts, valid, params)
+    # drop late events BEFORE the exchange so a replay backlog neither
+    # wastes ICI bandwidth nor steals bucket-lane capacity
+    late = valid & (ws != EMPTY_WS) & (ws + params.window_s <= cutoff)
+    valid = valid & ~late
+    n_late_local = jnp.sum(late.astype(jnp.int32))
+    dest = (_mix32(hi, lo, ws) % jnp.uint32(n_shards)).astype(jnp.int32)
+    lat_deg = lat * jnp.float32(180.0 / np.pi)
+    lon_deg = lng * jnp.float32(180.0 / np.pi)
+    fields = {
+        "hi": hi, "lo": lo, "ws": ws, "speed": speed,
+        "lat_deg": lat_deg, "lon_deg": lon_deg, "ts": ts,
+    }
+    recv, n_dropped = _bucket_and_exchange(fields, dest, valid, n_shards, cap)
+
+    new_state, emit, st = merge_batch(
+        state, recv["hi"], recv["lo"], recv["ws"], recv["speed"],
+        recv["lat_deg"], recv["lon_deg"], recv["ts"], recv["valid"],
+        cutoff, params,
+    )
+    # per-shard scalars need a rank-1 axis to ride a sharded out_spec
+    emit = emit._replace(
+        n_emitted=emit.n_emitted[None], overflowed=emit.overflowed[None]
+    )
+    stats = ShardStats(
+        n_valid=jax.lax.psum(st.n_valid, AXIS),
+        n_late=jax.lax.psum(n_late_local + st.n_late, AXIS),
+        n_evicted=jax.lax.psum(st.n_evicted, AXIS),
+        n_active=jax.lax.psum(st.n_active, AXIS),
+        state_overflow=jax.lax.psum(st.state_overflow, AXIS),
+        batch_max_ts=jax.lax.pmax(st.batch_max_ts, AXIS),
+        bucket_dropped=jax.lax.psum(n_dropped, AXIS),
+    )
+    return new_state, emit, stats
+
+
+class ShardedAggregator:
+    """Host-facing wrapper owning the sharded device state.
+
+    One instance per (resolution, window) pair; batches are fed as global
+    (batch_size,) arrays, sharded over the mesh's ``shards`` axis.
+    ``bucket_factor`` oversizes the exchange lanes relative to the uniform
+    share (2.0 = tolerate 2x skew toward one shard).
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        params: AggParams,
+        capacity_per_shard: int,
+        batch_size: int,
+        hist_bins: int = 0,
+        bucket_factor: float = 2.0,
+    ):
+        self.mesh = mesh
+        self.params = params
+        self.n_shards = mesh.devices.size
+        if batch_size % self.n_shards:
+            raise ValueError(
+                f"batch_size {batch_size} not divisible by {self.n_shards} shards"
+            )
+        self.batch_size = batch_size
+        n_local = batch_size // self.n_shards
+        self.bucket_cap = max(1, int(bucket_factor * n_local / self.n_shards))
+        self.capacity_per_shard = capacity_per_shard
+
+        shard1 = NamedSharding(mesh, P(AXIS))
+        shard2 = NamedSharding(mesh, P(AXIS, None))
+        self.state: TileState = TileState(*[
+            jax.device_put(leaf, shard2 if leaf.ndim == 2 else shard1)
+            for leaf in init_state(self.n_shards * capacity_per_shard, hist_bins)
+        ])
+
+        body = functools.partial(
+            _sharded_step_body, params, self.n_shards, self.bucket_cap
+        )
+        spec1 = P(AXIS)
+        spec2 = P(AXIS, None)
+        state_specs = TileState(
+            key_hi=spec1, key_lo=spec1, key_ws=spec1, count=spec1,
+            sum_speed=spec1, sum_speed2=spec1, sum_lat=spec1, sum_lon=spec1,
+            hist=spec2,
+        )
+        emit_specs = BatchEmit(
+            key_hi=spec1, key_lo=spec1, key_ws=spec1, count=spec1,
+            sum_speed=spec1, sum_speed2=spec1, sum_lat=spec1, sum_lon=spec1,
+            hist=spec2, valid=spec1, n_emitted=P(AXIS), overflowed=P(AXIS),
+        )
+        stats_specs = ShardStats(*([P()] * 7))
+        self._step = jax.jit(
+            jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(state_specs, spec1, spec1, spec1, spec1, spec1, P()),
+                out_specs=(state_specs, emit_specs, stats_specs),
+            ),
+            donate_argnums=(0,),  # fold the state slab in place
+        )
+        self._in_sharding = shard1
+
+    def step(self, lat_rad, lng_rad, speed, ts, valid, watermark_cutoff):
+        """Fold one global batch; returns (BatchEmit, ShardStats) on device.
+
+        Per-shard scalar emit fields (n_emitted/overflowed) come back with a
+        leading (n_shards,) axis.
+        """
+        put = lambda x: jax.device_put(jnp.asarray(x), self._in_sharding)
+        self.state, emit, stats = self._step(
+            self.state,
+            put(lat_rad), put(lng_rad), put(speed), put(ts), put(valid),
+            jnp.int32(watermark_cutoff),
+        )
+        return emit, stats

@@ -1,18 +1,22 @@
 """Test harness config: run JAX on CPU with 8 virtual devices.
 
-The multi-chip sharding path (SURVEY.md SS4(d)) is exercised without TPUs via
-XLA's host-platform device-count override; these env vars must be set before
-jax is imported anywhere in the test process.
+The multi-chip sharding path (SURVEY.md §4(d)) is exercised without TPUs.
+The environment's sitecustomize imports jax at interpreter startup with
+JAX_PLATFORMS=axon, so env vars are too late here — the jax.config API is
+the only reliable override (backends initialize lazily on first use).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# The environment pins JAX_PLATFORMS=axon (one real TPU) and its
+# sitecustomize imports jax at interpreter startup, so env vars set here are
+# too late — use the config API instead (backends initialize lazily on first
+# use, which happens inside the tests).
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

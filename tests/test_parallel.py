@@ -1,0 +1,133 @@
+"""Sharded aggregation on the 8-virtual-device CPU mesh (SURVEY.md §4(d)).
+
+The union of all shard states must equal the single-device dict oracle, and
+keys must be disjoint across shards (the all_to_all routing contract).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from heatmap_tpu.engine import AggParams
+from heatmap_tpu.parallel import ShardedAggregator, make_mesh
+from tests.test_engine import DictAgg, make_batch
+from heatmap_tpu.engine.step import snap_and_window
+
+PARAMS = AggParams(res=8, window_s=300, emit_capacity=1024)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    return make_mesh(8)
+
+
+def shard_states_as_dict(agg: ShardedAggregator):
+    """Pull global state to host; return {key: [count, sums...]}, plus the
+    per-shard key sets for disjointness checks."""
+    hi = np.asarray(agg.state.key_hi)
+    lo = np.asarray(agg.state.key_lo)
+    ws = np.asarray(agg.state.key_ws)
+    cnt = np.asarray(agg.state.count)
+    ssp = np.asarray(agg.state.sum_speed)
+    ssp2 = np.asarray(agg.state.sum_speed2)
+    sla = np.asarray(agg.state.sum_lat)
+    slo = np.asarray(agg.state.sum_lon)
+    live = hi != np.uint32(0xFFFFFFFF)
+    out, per_shard = {}, []
+    C = agg.capacity_per_shard
+    for s in range(agg.n_shards):
+        keys = set()
+        for i in np.nonzero(live[s * C:(s + 1) * C])[0] + s * C:
+            k = (int(hi[i]), int(lo[i]), int(ws[i]))
+            keys.add(k)
+            out[k] = [int(cnt[i]), float(ssp[i]), float(ssp2[i]),
+                      float(sla[i]), float(slo[i])]
+        per_shard.append(keys)
+    return out, per_shard
+
+
+def test_sharded_matches_oracle(mesh, rng):
+    agg = ShardedAggregator(mesh, PARAMS, capacity_per_shard=1024,
+                            batch_size=1024)
+    oracle = DictAgg(PARAMS)
+    for b in range(3):
+        lat, lng, speed, ts, valid = make_batch(rng, 1024, t0=1_700_000_000 + b * 120)
+        emit, stats = agg.step(lat, lng, speed, ts, valid, -2**31)
+        hi, lo, ws = snap_and_window(lat, lng, ts, valid, PARAMS)
+        oracle.feed(np.asarray(hi), np.asarray(lo), np.asarray(ws), speed,
+                    np.degrees(lat.astype(np.float64)),
+                    np.degrees(lng.astype(np.float64)), valid, -2**31)
+        assert int(stats.bucket_dropped) == 0
+        assert int(stats.state_overflow) == 0
+        assert int(stats.n_valid) == 1024
+
+    got, per_shard = shard_states_as_dict(agg)
+    assert set(got) == set(oracle.groups)
+    for k, g in got.items():
+        w = oracle.groups[k]
+        assert g[0] == w[0], (k, g, w)
+        np.testing.assert_allclose(g[1:], w[1:], rtol=2e-5, atol=1e-3)
+    # shard disjointness: each key on exactly one shard
+    all_keys = [k for s in per_shard for k in s]
+    assert len(all_keys) == len(set(all_keys))
+    assert int(stats.n_active) == len(got)
+
+
+def test_sharded_emit_covers_touched(mesh, rng):
+    agg = ShardedAggregator(mesh, PARAMS, capacity_per_shard=1024,
+                            batch_size=1024)
+    lat, lng, speed, ts, valid = make_batch(rng, 1024)
+    emit, stats = agg.step(lat, lng, speed, ts, valid, -2**31)
+    ehi = np.asarray(emit.key_hi)
+    evalid = np.asarray(emit.valid)
+    emitted = {
+        (int(ehi[i]), int(np.asarray(emit.key_lo)[i]),
+         int(np.asarray(emit.key_ws)[i]))
+        for i in np.nonzero(evalid)[0]
+    }
+    got, _ = shard_states_as_dict(agg)
+    assert emitted == set(got)
+    assert int(np.asarray(emit.n_emitted).sum()) == len(emitted)
+    assert not np.asarray(emit.overflowed).any()
+
+
+def test_invalid_rows_do_not_steal_lanes(mesh, rng):
+    # 50% invalid rows: with per-lane capacity sized for valid traffic only,
+    # invalid events must not consume exchange capacity (review finding r1)
+    agg = ShardedAggregator(mesh, PARAMS, capacity_per_shard=1024,
+                            batch_size=1024, bucket_factor=1.5)
+    lat, lng, speed, ts, valid = make_batch(rng, 1024, nan_frac=0.5)
+    emit, stats = agg.step(lat, lng, speed, ts, valid, -2**31)
+    assert int(stats.bucket_dropped) == 0
+    assert int(stats.n_valid) == valid.sum()
+
+
+def test_late_events_dropped_before_exchange(mesh, rng):
+    # a fully-late batch must not drop on-time events via lane pressure
+    t0 = 1_700_000_000
+    agg = ShardedAggregator(mesh, PARAMS, capacity_per_shard=1024,
+                            batch_size=1024, bucket_factor=1.5)
+    lat, lng, speed, ts, valid = make_batch(rng, 1024, t0=t0 - 50_000)
+    lat2, lng2, speed2, ts2, _ = make_batch(rng, 1024, t0=t0)
+    # half late, half on-time
+    lat[:512], lng[:512], speed[:512], ts[:512] = (
+        lat2[:512], lng2[:512], speed2[:512], ts2[:512])
+    emit, stats = agg.step(lat, lng, speed, ts, valid, t0 - 1000)
+    assert int(stats.n_late) == 512
+    assert int(stats.n_valid) == 512
+    assert int(stats.bucket_dropped) == 0
+
+
+def test_watermark_eviction_sharded(mesh, rng):
+    agg = ShardedAggregator(mesh, PARAMS, capacity_per_shard=1024,
+                            batch_size=1024)
+    t0 = 1_700_000_000
+    lat, lng, speed, ts, valid = make_batch(rng, 1024, t0=t0)
+    agg.step(lat, lng, speed, ts, valid, -2**31)
+    # advance watermark past everything
+    _, stats = agg.step(lat, lng, speed, ts,
+                        np.zeros_like(valid), t0 + 10_000)
+    assert int(stats.n_active) == 0
+    assert int(stats.n_evicted) > 0
