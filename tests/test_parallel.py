@@ -111,9 +111,9 @@ def test_late_events_dropped_before_exchange(mesh, rng):
                             batch_size=1024, bucket_factor=1.5)
     lat, lng, speed, ts, valid = make_batch(rng, 1024, t0=t0 - 50_000)
     lat2, lng2, speed2, ts2, _ = make_batch(rng, 1024, t0=t0)
-    # half late, half on-time
-    lat[:512], lng[:512], speed[:512], ts[:512] = (
-        lat2[:512], lng2[:512], speed2[:512], ts2[:512])
+    # half late, half on-time, interleaved so every batch shard sees both
+    m = np.arange(1024) % 2 == 0
+    lat[m], lng[m], speed[m], ts[m] = lat2[m], lng2[m], speed2[m], ts2[m]
     emit, stats = agg.step(lat, lng, speed, ts, valid, t0 - 1000)
     assert int(stats.n_late) == 512
     assert int(stats.n_valid) == 512
