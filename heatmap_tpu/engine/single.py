@@ -1,0 +1,45 @@
+"""Single-device aggregator with the same host API as parallel.ShardedAggregator.
+
+Used when one chip is enough (the bench's single-chip runs) — skips the
+all_to_all exchange entirely; the state slab lives on the default device.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from heatmap_tpu.engine.state import TileState, init_state
+from heatmap_tpu.engine.step import AggParams, aggregate_batch
+
+
+class SingleAggregator:
+    n_shards = 1
+
+    def __init__(self, params: AggParams, capacity: int, batch_size: int,
+                 hist_bins: int = 0):
+        self.params = params
+        self.capacity_per_shard = capacity
+        self.batch_size = batch_size
+        self.state: TileState = init_state(capacity, hist_bins)
+
+        def _step(state, lat, lng, speed, ts, valid, cutoff):
+            return aggregate_batch(state, lat, lng, speed, ts, valid, cutoff,
+                                   self.params)
+
+        self._step = jax.jit(_step, donate_argnums=(0,))
+
+    def step(self, lat_rad, lng_rad, speed, ts, valid, watermark_cutoff):
+        self.state, emit, stats = self._step(
+            self.state,
+            jnp.asarray(lat_rad), jnp.asarray(lng_rad), jnp.asarray(speed),
+            jnp.asarray(ts), jnp.asarray(valid),
+            jnp.int32(watermark_cutoff),
+        )
+        # align emit scalar shapes with the sharded aggregator's (D,) form
+        emit = emit._replace(n_emitted=emit.n_emitted[None],
+                             overflowed=emit.overflowed[None])
+        return emit, stats

@@ -1,0 +1,49 @@
+"""sink — storage writers with the reference's MongoDB document contracts.
+
+The reference upserts two collections from its foreachBatch driver loop
+(reference: heatmap_stream.py:150-237):
+
+- ``tiles``: one doc per (cell, window) with
+  ``_id = "{CITY}|h3r{RES}|{cellId}|{windowStartISO}"``, count/avgSpeedKmh/
+  centroid aggregates and a ``staleAt`` TTL timestamp (:173-187).
+- ``positions_latest``: one doc per (provider, vehicleId) keyed
+  ``"{provider}|{vehicleId}"`` with a monotonic-ts guard so stale events
+  never overwrite newer docs (:217-228).
+
+Stores here implement the same contract behind one interface so the serving
+layer reads uniformly: an in-memory store (tests/dev, no external deps), a
+JSONL-backed store (durable single file), and a real MongoDB store (gated on
+pymongo being installed).  The reference's conditional-upsert race — an
+upsert colliding with the unique index when an equal-or-newer doc exists
+(SURVEY.md §2a "known defects") — is fixed in all three: the guard is
+"apply only if newer", never an insert that can collide.
+
+An AsyncWriter thread overlaps store I/O with device compute
+(SURVEY.md §2b: "write an async batched writer so Mongo I/O overlaps device
+compute").
+"""
+
+from heatmap_tpu.sink.base import PositionDoc, Store, TileDoc  # noqa: F401
+from heatmap_tpu.sink.memory import MemoryStore  # noqa: F401
+from heatmap_tpu.sink.jsonl import JsonlStore  # noqa: F401
+from heatmap_tpu.sink.writer import AsyncWriter  # noqa: F401
+
+
+def make_store(cfg) -> Store:
+    """Store factory honoring HEATMAP_STORE (auto | memory | jsonl | mongo)."""
+    kind = getattr(cfg, "store", "auto")
+    if kind == "memory":
+        return MemoryStore()
+    if kind == "jsonl":
+        return JsonlStore(cfg.checkpoint_dir)
+    if kind == "mongo":
+        from heatmap_tpu.sink.mongo import MongoStore
+
+        return MongoStore(cfg.mongo_uri, cfg.mongo_db)
+    # auto: mongo when pymongo is importable, else memory
+    try:
+        from heatmap_tpu.sink.mongo import MongoStore
+
+        return MongoStore(cfg.mongo_uri, cfg.mongo_db)
+    except ImportError:
+        return MemoryStore()

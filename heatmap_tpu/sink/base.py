@@ -1,0 +1,107 @@
+"""Document builders and the Store interface.
+
+Doc shapes mirror the reference exactly (heatmap_stream.py:176-187 tiles,
+:221-227 positions); timestamps are timezone-aware UTC datetimes like the
+ones pymongo round-trips for the reference.
+"""
+
+from __future__ import annotations
+
+import abc
+import datetime as dt
+from typing import Any, Iterable, Sequence
+
+UTC = dt.timezone.utc
+
+
+def iso_z(t: dt.datetime) -> str:
+    """The reference's windowStart key format '%Y-%m-%dT%H:%M:%SZ'
+    (heatmap_stream.py:173)."""
+    return t.astimezone(UTC).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def epoch_to_dt(sec: int | float) -> dt.datetime:
+    return dt.datetime.fromtimestamp(sec, UTC)
+
+
+def TileDoc(
+    city: str,
+    res: int,
+    cell_id: str,
+    window_start: dt.datetime,
+    window_end: dt.datetime,
+    count: int,
+    avg_speed_kmh: float,
+    avg_lat: float,
+    avg_lon: float,
+    ttl_minutes: int,
+    extra: dict[str, Any] | None = None,
+) -> dict:
+    """Build a tiles doc (reference: heatmap_stream.py:173-187).
+
+    ``extra`` carries TPU-native extensions (p95SpeedKmh, stddev, window
+    length tags for the multi-window configs) without disturbing the base
+    contract."""
+    grid = f"h3r{res}"
+    _id = f"{city}|{grid}|{cell_id}|{iso_z(window_start)}"
+    doc = {
+        "_id": _id,
+        "city": city,
+        "grid": grid,
+        "cellId": cell_id,
+        "windowStart": window_start,
+        "windowEnd": window_end,
+        "count": int(count),
+        "avgSpeedKmh": float(avg_speed_kmh),
+        "centroid": {"type": "Point", "coordinates": [float(avg_lon), float(avg_lat)]},
+        "staleAt": window_end + dt.timedelta(minutes=ttl_minutes),
+    }
+    if extra:
+        doc.update(extra)
+    return doc
+
+
+def PositionDoc(provider: str, vehicle_id: str, ts: dt.datetime,
+                lat: float, lon: float) -> dict:
+    """Build a positions_latest doc (reference: heatmap_stream.py:217-227)."""
+    return {
+        "_id": f"{provider}|{vehicle_id}",
+        "provider": provider,
+        "vehicleId": vehicle_id,
+        "ts": ts,
+        "loc": {"type": "Point", "coordinates": [float(lon), float(lat)]},
+    }
+
+
+class Store(abc.ABC):
+    """Write + read interface over the two collections.
+
+    Writes are idempotent upserts; ``upsert_positions`` must apply the
+    monotonic-ts guard (only-if-newer) race-free."""
+
+    @abc.abstractmethod
+    def upsert_tiles(self, docs: Sequence[dict]) -> int:
+        """Upsert tile docs by _id; returns number written."""
+
+    @abc.abstractmethod
+    def upsert_positions(self, docs: Sequence[dict]) -> int:
+        """Monotonic upsert position docs by _id; returns number applied."""
+
+    @abc.abstractmethod
+    def latest_window_start(self, grid: str | None = None) -> dt.datetime | None:
+        """Max windowStart over live tiles (app.py:51)."""
+
+    @abc.abstractmethod
+    def tiles_in_window(self, window_start: dt.datetime,
+                        grid: str | None = None) -> Iterable[dict]:
+        """All tile docs of one window (app.py:57)."""
+
+    @abc.abstractmethod
+    def all_positions(self) -> Iterable[dict]:
+        """Full scan of positions_latest (app.py:78)."""
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass

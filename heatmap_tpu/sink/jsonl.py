@@ -1,0 +1,88 @@
+"""JSONL-backed Store: durable single-file sink for demos without MongoDB.
+
+Append-only op log with an in-memory materialized view; compacts on close.
+Datetimes serialize as ISO-8601 Z strings and parse back on load, so a
+restarted process sees the same view the reference would read from Mongo.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import json
+import os
+from typing import Sequence
+
+from heatmap_tpu.sink.base import UTC
+from heatmap_tpu.sink.memory import MemoryStore
+
+_DT_FIELDS = ("windowStart", "windowEnd", "staleAt", "ts")
+
+
+def _enc(doc: dict) -> dict:
+    out = dict(doc)
+    for f in _DT_FIELDS:
+        if isinstance(out.get(f), dt.datetime):
+            out[f] = out[f].astimezone(UTC).isoformat()
+    return out
+
+
+def _dec(doc: dict) -> dict:
+    for f in _DT_FIELDS:
+        if isinstance(doc.get(f), str):
+            try:
+                doc[f] = dt.datetime.fromisoformat(doc[f])
+            except ValueError:
+                pass
+    return doc
+
+
+class JsonlStore(MemoryStore):
+    def __init__(self, directory: str, now_fn=None):
+        super().__init__(now_fn)
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, "store.jsonl")
+        if os.path.exists(self.path):
+            self._load()
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+    def _load(self) -> None:
+        with open(self.path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                op = json.loads(line)
+                doc = _dec(op["doc"])
+                if op["c"] == "tiles":
+                    super().upsert_tiles([doc])
+                else:
+                    super().upsert_positions([doc])
+
+    def _append(self, coll: str, docs: Sequence[dict]) -> None:
+        for d in docs:
+            self._fh.write(json.dumps({"c": coll, "doc": _enc(d)}) + "\n")
+
+    def upsert_tiles(self, docs: Sequence[dict]) -> int:
+        n = super().upsert_tiles(docs)
+        self._append("tiles", docs)
+        return n
+
+    def upsert_positions(self, docs: Sequence[dict]) -> int:
+        n = super().upsert_positions(docs)
+        self._append("positions", docs)
+        return n
+
+    def flush(self) -> None:
+        self._fh.flush()
+
+    def close(self) -> None:
+        self._fh.close()
+        # compact: rewrite the live view only
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            with self._lock:
+                for d in self._tiles.values():
+                    fh.write(json.dumps({"c": "tiles", "doc": _enc(d)}) + "\n")
+                for d in self._positions.values():
+                    fh.write(json.dumps({"c": "positions", "doc": _enc(d)}) + "\n")
+        os.replace(tmp, self.path)

@@ -1,0 +1,76 @@
+"""In-memory Store: the hermetic test/dev sink (SURVEY.md §4(c)).
+
+Implements the TTL index semantics of the reference's `staleAt` field
+(README.md:139-150: Mongo TTL index, expireAfterSeconds=0) lazily at read
+time, and the monotonic positions guard without the reference's
+DuplicateKeyError race (SURVEY.md §2a known defects).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import threading
+from typing import Iterable, Sequence
+
+from heatmap_tpu.sink.base import Store, UTC
+
+
+class MemoryStore(Store):
+    def __init__(self, now_fn=None):
+        self._tiles: dict[str, dict] = {}
+        self._positions: dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._now = now_fn or (lambda: dt.datetime.now(UTC))
+
+    # --- writes ---------------------------------------------------------
+    def upsert_tiles(self, docs: Sequence[dict]) -> int:
+        with self._lock:
+            for d in docs:
+                self._tiles[d["_id"]] = dict(d)
+        return len(docs)
+
+    def upsert_positions(self, docs: Sequence[dict]) -> int:
+        applied = 0
+        with self._lock:
+            for d in docs:
+                cur = self._positions.get(d["_id"])
+                if cur is None or cur.get("ts") is None or cur["ts"] < d["ts"]:
+                    self._positions[d["_id"]] = dict(d)
+                    applied += 1
+        return applied
+
+    # --- TTL ------------------------------------------------------------
+    def _gc(self) -> None:
+        now = self._now()
+        dead = [k for k, v in self._tiles.items()
+                if v.get("staleAt") is not None and v["staleAt"] <= now]
+        for k in dead:
+            del self._tiles[k]
+
+    # --- reads ----------------------------------------------------------
+    def latest_window_start(self, grid=None):
+        with self._lock:
+            self._gc()
+            ws = [v["windowStart"] for v in self._tiles.values()
+                  if grid is None or v.get("grid") == grid]
+        return max(ws) if ws else None
+
+    def tiles_in_window(self, window_start, grid=None) -> Iterable[dict]:
+        with self._lock:
+            self._gc()
+            return [dict(v) for v in self._tiles.values()
+                    if v["windowStart"] == window_start
+                    and (grid is None or v.get("grid") == grid)]
+
+    def all_positions(self) -> Iterable[dict]:
+        with self._lock:
+            return [dict(v) for v in self._positions.values()]
+
+    # --- test helpers ---------------------------------------------------
+    @property
+    def n_tiles(self) -> int:
+        return len(self._tiles)
+
+    @property
+    def n_positions(self) -> int:
+        return len(self._positions)

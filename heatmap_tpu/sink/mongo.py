@@ -1,0 +1,91 @@
+"""MongoDB Store (gated on pymongo; absent in the dev image).
+
+Keeps the reference's write shape — chunked unordered bulk upserts of 1000
+ops (heatmap_stream.py:188-196,230-235) — and fixes its conditional-upsert
+race: the reference's ``{$or: [ts missing, ts < incoming]} + upsert:true``
+attempts an _id insert when an equal-or-newer doc exists, colliding with the
+unique index (SURVEY.md §2a).  Here the same monotonic intent is expressed
+as a pipeline-style conditional $set on an upsert matched by _id only, which
+can never insert a duplicate.
+
+Index DDL the reference documents as a manual mongosh step
+(README.md:139-150) is applied automatically by ``ensure_indexes``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+from pymongo import MongoClient, UpdateOne
+
+from heatmap_tpu.sink.base import Store
+
+CHUNK = 1000  # reference flush size (heatmap_stream.py:191)
+
+
+class MongoStore(Store):
+    def __init__(self, uri: str, db_name: str, ensure_indexes: bool = True):
+        self.client = MongoClient(uri)
+        self.db = self.client[db_name]
+        if ensure_indexes:
+            self.ensure_indexes()
+
+    def ensure_indexes(self) -> None:
+        t = self.db["tiles"]
+        t.create_index([("city", 1), ("grid", 1), ("windowStart", -1)])
+        t.create_index([("cellId", 1), ("windowStart", -1)])
+        t.create_index([("centroid", "2dsphere")])
+        t.create_index("staleAt", expireAfterSeconds=0)
+        p = self.db["positions_latest"]
+        p.create_index([("provider", 1), ("vehicleId", 1)], unique=True)
+        p.create_index([("loc", "2dsphere")])
+        p.create_index([("ts", -1)])
+
+    def _bulk(self, coll: str, ops: list) -> None:
+        for i in range(0, len(ops), CHUNK):
+            self.db[coll].bulk_write(ops[i:i + CHUNK], ordered=False)
+
+    def upsert_tiles(self, docs: Sequence[dict]) -> int:
+        ops = [UpdateOne({"_id": d["_id"]}, {"$set": d}, upsert=True) for d in docs]
+        if ops:
+            self._bulk("tiles", ops)
+        return len(ops)
+
+    def upsert_positions(self, docs: Sequence[dict]) -> int:
+        # race-free monotonic upsert: match on _id alone (upsert can only
+        # insert when the doc is truly absent); the newer-ts condition moves
+        # into an aggregation-pipeline update so older events are no-ops.
+        ops = []
+        for d in docs:
+            cond = {
+                "$cond": [
+                    {"$or": [
+                        {"$lte": [{"$ifNull": ["$ts", None]}, None]},
+                        {"$lt": ["$ts", d["ts"]]},
+                    ]},
+                    d,
+                    "$$ROOT",
+                ]
+            }
+            ops.append(UpdateOne({"_id": d["_id"]}, [{"$replaceRoot": {"newRoot": cond}}],
+                                 upsert=True))
+        if ops:
+            self._bulk("positions_latest", ops)
+        return len(ops)
+
+    def latest_window_start(self, grid=None):
+        q = {} if grid is None else {"grid": grid}
+        doc = self.db["tiles"].find_one(q, sort=[("windowStart", -1)])
+        return doc["windowStart"] if doc else None
+
+    def tiles_in_window(self, window_start, grid=None) -> Iterable[dict]:
+        q = {"windowStart": window_start}
+        if grid is not None:
+            q["grid"] = grid
+        return self.db["tiles"].find(q)
+
+    def all_positions(self) -> Iterable[dict]:
+        return self.db["positions_latest"].find({})
+
+    def close(self) -> None:
+        self.client.close()

@@ -1,0 +1,86 @@
+"""AsyncWriter — background sink thread overlapping store I/O with compute.
+
+The reference's foreachBatch writes block the driver between micro-batches
+(SURVEY.md §3.3 bottleneck #2).  Here the device step for batch N+1 runs
+while batch N's docs are upserted; the runtime's checkpoint commit waits on
+``drain()`` so offsets only advance past durably-written batches
+(SURVEY.md §7 hard part #5).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Sequence
+
+from heatmap_tpu.sink.base import Store
+
+log = logging.getLogger(__name__)
+
+
+class AsyncWriter:
+    def __init__(self, store: Store, max_queue: int = 64):
+        self.store = store
+        self._q: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._exc: BaseException | None = None
+        self._written_tiles = 0
+        self._written_positions = 0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="sink-writer")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            try:
+                if item is None:
+                    return
+                kind, docs = item
+                if kind == "tiles":
+                    self._written_tiles += self.store.upsert_tiles(docs)
+                else:
+                    self._written_positions += self.store.upsert_positions(docs)
+            except BaseException as e:  # poisons the writer permanently
+                log.exception("sink write failed")
+                self._exc = e
+            finally:
+                self._q.task_done()
+
+    @property
+    def poisoned(self) -> bool:
+        return self._exc is not None
+
+    def _check(self) -> None:
+        # sticky: once a write is lost the writer stays failed, so a later
+        # checkpoint can never commit offsets past the dropped batch
+        if self._exc is not None:
+            raise RuntimeError("async sink write failed") from self._exc
+
+    def submit_tiles(self, docs: Sequence[dict]) -> None:
+        self._check()
+        if docs:
+            self._q.put(("tiles", docs))
+
+    def submit_positions(self, docs: Sequence[dict]) -> None:
+        self._check()
+        if docs:
+            self._q.put(("positions", docs))
+
+    def drain(self) -> None:
+        """Block until every submitted write has been applied."""
+        self._q.join()
+        self._check()
+        self.store.flush()
+
+    def close(self) -> None:
+        if not self.poisoned:
+            self.drain()
+        self._q.put(None)
+        self._thread.join(timeout=10)
+        self._check()
+
+    @property
+    def counters(self) -> dict:
+        return {"tiles_written": self._written_tiles,
+                "positions_written": self._written_positions}

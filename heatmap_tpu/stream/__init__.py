@@ -1,0 +1,30 @@
+"""stream — the micro-batch runtime (replaces Spark Structured Streaming).
+
+The reference delegates micro-batch scheduling, offset/state checkpointing
+and watermark bookkeeping to the Spark JVM (reference:
+heatmap_stream.py:41-48,79-86,241-249).  This package owns all of it
+in-framework:
+
+- ``events``      — the canonical 8-field GPS event schema + columnar
+                    parsing/validation (reference schema:
+                    heatmap_stream.py:52-61, filters :96-108).
+- ``source``      — pluggable pull sources with replayable offsets:
+                    in-memory, JSONL replay, synthetic generator, Kafka
+                    (gated on a client lib being installed).
+- ``runtime``     — the driver loop: poll → fixed-shape batch → device
+                    aggregation step(s) → async sink upserts → watermark →
+                    checkpoint commit.
+- ``checkpoint``  — offsets + device-state snapshots, atomic on disk
+                    (replaces the Spark checkpointLocation contract,
+                    heatmap_stream.py:37,244).
+- ``metrics``     — the counters/latency spans BASELINE.json measures.
+"""
+
+from heatmap_tpu.stream.events import EventColumns, parse_events  # noqa: F401
+from heatmap_tpu.stream.source import (  # noqa: F401
+    JsonlReplaySource,
+    MemorySource,
+    Source,
+    SyntheticSource,
+)
+from heatmap_tpu.stream.runtime import MicroBatchRuntime  # noqa: F401

@@ -1,0 +1,61 @@
+"""Offsets + device-state checkpointing (replaces Spark's checkpointLocation).
+
+The reference delegates offsets and windowed-aggregation state to Spark's
+checkpoint directory (reference: heatmap_stream.py:37,244; resume semantics
+SURVEY.md §5.4).  Here the framework owns both:
+
+- ``meta.json``  — source offset, watermark high-ts, epoch counter
+  (written atomically via rename).
+- ``state-<res>-<win>.npz`` — the aggregation slabs, one per configured
+  (resolution, window) pair.
+
+Commit ordering (SURVEY.md §7 hard part #5): the runtime drains the sink
+writer *before* committing, so a crash replays only events whose upserts
+are idempotent by deterministic _id — same correctness backstop the
+reference relies on (heatmap_stream.py:173,188).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+from heatmap_tpu.engine.state import TileState
+
+
+class CheckpointManager:
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.meta_path = os.path.join(directory, "meta.json")
+
+    # --- meta -----------------------------------------------------------
+    def load_meta(self) -> dict | None:
+        if not os.path.exists(self.meta_path):
+            return None
+        with open(self.meta_path, encoding="utf-8") as fh:
+            return json.load(fh)
+
+    def commit(self, offset: Any, max_event_ts: int, epoch: int,
+               states: dict[tuple[int, int], TileState] | None = None) -> None:
+        if states:
+            for (res, win), st in states.items():
+                path = os.path.join(self.dir, f"state-{res}-{win}.npz")
+                tmp = path + ".tmp.npz"
+                np.savez(tmp, **{k: np.asarray(v) for k, v in st._asdict().items()})
+                os.replace(tmp, path)
+        tmp = self.meta_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"offset": offset, "max_event_ts": int(max_event_ts),
+                       "epoch": int(epoch)}, fh)
+        os.replace(tmp, self.meta_path)
+
+    def load_state(self, res: int, win: int) -> TileState | None:
+        path = os.path.join(self.dir, f"state-{res}-{win}.npz")
+        if not os.path.exists(path):
+            return None
+        with np.load(path) as z:
+            return TileState(**{k: z[k] for k in TileState._fields})

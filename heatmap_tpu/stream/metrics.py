@@ -1,0 +1,54 @@
+"""Runtime metrics: the counters BASELINE.json measures (SURVEY.md §5.5).
+
+events/sec in, rows upserted, p50/p95 micro-batch latency, plus per-span
+timings (ingest / build / device / sink) so the bottleneck is visible.
+Exposed by the serving layer at /metrics.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Mapping
+
+
+class Percentiles:
+    def __init__(self, window: int = 512):
+        self.samples: collections.deque = collections.deque(maxlen=window)
+
+    def add(self, v: float) -> None:
+        self.samples.append(v)
+
+    def quantile(self, q: float) -> float:
+        if not self.samples:
+            return 0.0
+        s = sorted(self.samples)
+        i = min(len(s) - 1, int(q * len(s)))
+        return s[i]
+
+
+class Metrics:
+    def __init__(self):
+        self.t_start = time.monotonic()
+        self.counters: collections.Counter = collections.Counter()
+        self.batch_latency = Percentiles()
+        self.spans: dict[str, Percentiles] = collections.defaultdict(Percentiles)
+
+    def count(self, name: str, n: int = 1) -> None:
+        self.counters[name] += n
+
+    def observe_batch(self, latency_s: float, spans: Mapping[str, float]) -> None:
+        self.batch_latency.add(latency_s)
+        for k, v in spans.items():
+            self.spans[k].add(v)
+
+    def snapshot(self) -> dict:
+        elapsed = max(time.monotonic() - self.t_start, 1e-9)
+        out = dict(self.counters)
+        out["uptime_s"] = round(elapsed, 3)
+        out["events_per_sec"] = round(self.counters.get("events_valid", 0) / elapsed, 1)
+        out["batch_latency_p50_ms"] = round(self.batch_latency.quantile(0.5) * 1e3, 3)
+        out["batch_latency_p95_ms"] = round(self.batch_latency.quantile(0.95) * 1e3, 3)
+        for k, p in self.spans.items():
+            out[f"span_{k}_p50_ms"] = round(p.quantile(0.5) * 1e3, 3)
+        return out

@@ -1,0 +1,374 @@
+"""MicroBatchRuntime — the driver loop (replaces the Spark streaming query).
+
+One iteration ≈ one Spark micro-batch (SURVEY.md §3.3), but everything
+between the source poll and the sink upsert runs in-framework:
+
+    poll source → columnarize/validate → pad to the fixed batch shape
+      → per-(res, window) device aggregation step (engine / parallel)
+      → BatchEmit → tile docs → async sink upserts
+      → host positions_latest fold (monotonic per vehicle)
+      → watermark advance → periodic checkpoint commit (after sink drain)
+
+The reference's defaults are preserved: update-mode emission per touched
+group (heatmap_stream.py:243), as-fast-as-possible triggering unless
+``trigger_ms`` is set (:241-247, README.md:134-136), 10-minute watermark
+(:107), and the tiles/positions doc contracts via sink.base.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Sequence
+
+import numpy as np
+
+from heatmap_tpu.config import Config
+from heatmap_tpu.engine import AggParams
+from heatmap_tpu.engine.single import SingleAggregator
+from heatmap_tpu.engine.state import TileState
+from heatmap_tpu.hexgrid.device import cells_to_uint64
+from heatmap_tpu.sink import AsyncWriter, Store, TileDoc, PositionDoc
+from heatmap_tpu.sink.base import epoch_to_dt
+from heatmap_tpu.stream.checkpoint import CheckpointManager
+from heatmap_tpu.stream.events import EventColumns, parse_events
+from heatmap_tpu.stream.metrics import Metrics
+from heatmap_tpu.stream.source import Source
+
+log = logging.getLogger(__name__)
+
+I32_MIN = -(2**31)
+
+
+def _p95_from_hist(hist_row: np.ndarray, count: int, hist_max: float) -> float:
+    """95th-percentile speed by linear interpolation inside the hit bin."""
+    if count <= 0 or hist_row.size == 0:
+        return 0.0
+    b = hist_row.size
+    bin_w = hist_max / b
+    target = 0.95 * count
+    cum = np.cumsum(hist_row)
+    i = int(np.searchsorted(cum, target))
+    if i >= b:
+        return float(hist_max)
+    prev = float(cum[i - 1]) if i > 0 else 0.0
+    in_bin = float(hist_row[i])
+    frac = (target - prev) / in_bin if in_bin > 0 else 0.0
+    return (i + frac) * bin_w
+
+
+class MicroBatchRuntime:
+    def __init__(
+        self,
+        cfg: Config,
+        source: Source,
+        store: Store,
+        mesh=None,
+        positions_enabled: bool = True,
+        checkpoint_every: int = 20,
+    ):
+        self.cfg = cfg
+        self.source = source
+        self.store = store
+        self.writer = AsyncWriter(store)
+        self.metrics = Metrics()
+        self.positions_enabled = positions_enabled
+        self.checkpoint_every = checkpoint_every
+        self.ckpt = CheckpointManager(cfg.checkpoint_dir)
+        self.epoch = 0
+        self.max_event_ts = I32_MIN
+        self._intern_p: dict[str, int] = {}
+        self._intern_v: dict[str, int] = {}
+        self._positions: dict[int, tuple] = {}  # vid -> (ts, lat, lon, pid)
+        self._overflow_warned = False
+
+        # one aggregator per (resolution, window) pair (BASELINE configs 4/5)
+        self.aggs: dict[tuple[int, int], object] = {}
+        cap = 1 << cfg.state_capacity_log2
+        bins = cfg.speed_hist_bins
+        for res in cfg.resolutions:
+            for wmin in cfg.windows_minutes:
+                params = AggParams(
+                    res=res,
+                    window_s=wmin * 60,
+                    emit_capacity=min(cfg.batch_size, cap),
+                    speed_hist_max=cfg.speed_hist_max_kmh,
+                )
+                if mesh is not None and mesh.devices.size > 1:
+                    from heatmap_tpu.parallel import ShardedAggregator
+
+                    agg = ShardedAggregator(
+                        mesh, params, capacity_per_shard=cap,
+                        batch_size=cfg.batch_size, hist_bins=bins,
+                    )
+                else:
+                    agg = SingleAggregator(
+                        params, capacity=cap, batch_size=cfg.batch_size,
+                        hist_bins=bins,
+                    )
+                self.aggs[(res, wmin)] = agg
+        # the pair whose stats define the batch-level counters
+        self._primary = (
+            (cfg.h3_res, cfg.tile_minutes)
+            if (cfg.h3_res, cfg.tile_minutes) in self.aggs
+            else next(iter(self.aggs))
+        )
+
+        self._maybe_resume()
+
+    # ------------------------------------------------------------------
+    def _maybe_resume(self) -> None:
+        meta = self.ckpt.load_meta()
+        if not meta:
+            return
+        log.info("resuming from checkpoint: %s", meta)
+        self.epoch = meta.get("epoch", 0)
+        self.max_event_ts = meta.get("max_event_ts", I32_MIN)
+        self.source.seek(meta.get("offset"))
+        for (res, wmin), agg in self.aggs.items():
+            st = self.ckpt.load_state(res, wmin * 60)
+            if st is None:
+                continue
+            if (st.key_hi.shape != agg.state.key_hi.shape
+                    or st.hist.shape != agg.state.hist.shape):
+                # seeking past processed offsets with an unloadable state
+                # would silently lose aggregates — refuse instead
+                raise RuntimeError(
+                    f"checkpoint state for (res={res}, window={wmin}m) has "
+                    f"shape {st.key_hi.shape}/{st.hist.shape} but the config "
+                    f"expects {agg.state.key_hi.shape}/{agg.state.hist.shape}; "
+                    f"restore STATE_CAPACITY_LOG2/SPEED_HIST_BINS or clear "
+                    f"{self.cfg.checkpoint_dir}"
+                )
+            agg.state = TileState(*st)
+
+    def _checkpoint(self) -> None:
+        # commit AFTER the sink writes are durable (idempotent replay window)
+        self.writer.drain()
+        states = {
+            (res, wmin * 60): TileState(
+                *[np.asarray(leaf) for leaf in agg.state]
+            )
+            for (res, wmin), agg in self.aggs.items()
+        }
+        self.ckpt.commit(self.source.offset(), self.max_event_ts, self.epoch,
+                         states)
+        self.metrics.count("checkpoints")
+
+    # ------------------------------------------------------------------
+    def _build_batch(self, polled) -> EventColumns | None:
+        if isinstance(polled, EventColumns):
+            cols = polled
+        else:
+            if not polled:
+                return None
+            cols = parse_events(polled, self._intern_p, self._intern_v)
+        if cols.n_dropped:
+            self.metrics.count("events_invalid", cols.n_dropped)
+        return cols if len(cols) else None
+
+    def _pad(self, arr: np.ndarray, fill=0):
+        n = self.cfg.batch_size
+        if len(arr) == n:
+            return arr
+        out = np.full((n,), fill, dtype=arr.dtype)
+        out[: len(arr)] = arr
+        return out
+
+    def _emit_docs(self, res: int, wmin: int, emit) -> list[dict]:
+        valid = np.asarray(emit.valid)
+        idx = np.nonzero(valid)[0]
+        if idx.size == 0:
+            return []
+        hi = np.asarray(emit.key_hi)[idx]
+        lo = np.asarray(emit.key_lo)[idx]
+        ws = np.asarray(emit.key_ws)[idx]
+        count = np.asarray(emit.count)[idx]
+        ssp = np.asarray(emit.sum_speed)[idx]
+        ssp2 = np.asarray(emit.sum_speed2)[idx]
+        sla = np.asarray(emit.sum_lat)[idx]
+        slo = np.asarray(emit.sum_lon)[idx]
+        hist = np.asarray(emit.hist)[idx] if emit.hist.shape[1] else None
+        cells = cells_to_uint64(hi, lo)
+        cfg = self.cfg
+        # the reference's _id grid label for its single configured window;
+        # extra window lengths get a distinct label so ids never collide
+        docs = []
+        win_s = wmin * 60
+        for j in range(idx.size):
+            c = int(count[j])
+            if c <= 0:
+                continue
+            extra = {
+                "stddevSpeedKmh": float(
+                    max(ssp2[j] / c - (ssp[j] / c) ** 2, 0.0) ** 0.5
+                ),
+            }
+            if hist is not None:
+                extra["p95SpeedKmh"] = _p95_from_hist(
+                    hist[j], c, cfg.speed_hist_max_kmh
+                )
+            if wmin != cfg.tile_minutes:
+                extra["windowMinutes"] = wmin
+            doc = TileDoc(
+                city=cfg.city,
+                res=res,
+                cell_id=format(int(cells[j]), "x"),
+                window_start=epoch_to_dt(int(ws[j])),
+                window_end=epoch_to_dt(int(ws[j]) + win_s),
+                count=c,
+                avg_speed_kmh=float(ssp[j]) / c,
+                avg_lat=float(sla[j]) / c,
+                avg_lon=float(slo[j]) / c,
+                ttl_minutes=cfg.ttl_minutes,
+                extra=extra,
+            )
+            if wmin != cfg.tile_minutes:
+                # distinct grid label → distinct _id space (multi-window)
+                grid = f"h3r{res}m{wmin}"
+                doc["grid"] = grid
+                doc["_id"] = "|".join(
+                    [cfg.city, grid, doc["cellId"],
+                     doc["_id"].rsplit("|", 1)[-1]]
+                )
+            docs.append(doc)
+        return docs
+
+    def _fold_positions(self, cols: EventColumns) -> list[dict]:
+        """Latest position per vehicle, monotonic in ts (the *intent* of the
+        reference's conditional upsert, heatmap_stream.py:198-228, without
+        its duplicate-key race)."""
+        if not len(cols):
+            return []
+        vid = cols.vehicle_id
+        order = np.lexsort((cols.ts_s, vid))
+        last = np.nonzero(
+            np.concatenate([vid[order][1:] != vid[order][:-1], [True]])
+        )[0]
+        rows = order[last]
+        changed = []
+        for r in rows:
+            v = int(vid[r])
+            ts = int(cols.ts_s[r])
+            cur = self._positions.get(v)
+            if cur is None or cur[0] < ts:
+                self._positions[v] = (
+                    ts, float(cols.lat_deg[r]), float(cols.lng_deg[r]),
+                    int(cols.provider_id[r]),
+                )
+                changed.append(v)
+        docs = []
+        for v in changed:
+            ts, la, lo, p = self._positions[v]
+            provider = cols.providers[p] if p < len(cols.providers) else "?"
+            vehicle = cols.vehicles[v] if v < len(cols.vehicles) else str(v)
+            docs.append(PositionDoc(provider, vehicle, epoch_to_dt(ts), la, lo))
+        return docs
+
+    # ------------------------------------------------------------------
+    def step_once(self) -> bool:
+        """Run one micro-batch; returns False when the source yielded nothing."""
+        t0 = time.monotonic()
+        polled = self.source.poll(self.cfg.batch_size)
+        t_poll = time.monotonic()
+        cols = self._build_batch(polled)
+        if cols is None:
+            return False
+        n = len(cols)
+        valid = np.zeros(self.cfg.batch_size, bool)
+        valid[:n] = True
+        lat = self._pad(cols.lat_rad)
+        lng = self._pad(cols.lng_rad)
+        speed = self._pad(cols.speed_kmh)
+        ts = self._pad(cols.ts_s)
+        t_build = time.monotonic()
+
+        cutoff = (
+            self.max_event_ts - self.cfg.watermark_minutes * 60
+            if self.max_event_ts > I32_MIN else I32_MIN
+        )
+        batch_max = I32_MIN
+        for (res, wmin), agg in self.aggs.items():
+            emit, stats = agg.step(lat, lng, speed, ts, valid, cutoff)
+            docs = self._emit_docs(res, wmin, emit)
+            self.writer.submit_tiles(docs)
+            self.metrics.count("tiles_emitted", len(docs))
+            batch_max = max(batch_max, int(stats.batch_max_ts))
+            if int(stats.state_overflow) > 0 and not self._overflow_warned:
+                self._overflow_warned = True
+                log.error(
+                    "STATE OVERFLOW: %d distinct (cell,window) groups dropped; "
+                    "raise STATE_CAPACITY_LOG2 (currently 2^%d per shard)",
+                    int(stats.state_overflow), self.cfg.state_capacity_log2,
+                )
+            dropped = int(getattr(stats, "bucket_dropped", 0))
+            if dropped:
+                self.metrics.count("events_bucket_dropped", dropped)
+                log.error(
+                    "EXCHANGE OVERFLOW: %d events dropped by all_to_all lane "
+                    "skew for (res=%d, window=%dm); raise bucket_factor",
+                    dropped, res, wmin,
+                )
+            if (res, wmin) == self._primary:
+                self.metrics.count("events_valid", int(stats.n_valid))
+                self.metrics.count("events_late", int(stats.n_late))
+            else:
+                self.metrics.count(f"events_late_r{res}m{wmin}",
+                                   int(stats.n_late))
+        t_device = time.monotonic()
+
+        if self.positions_enabled:
+            pdocs = self._fold_positions(cols)
+            self.writer.submit_positions(pdocs)
+            self.metrics.count("positions_emitted", len(pdocs))
+
+        if batch_max > I32_MIN:
+            self.max_event_ts = max(self.max_event_ts, batch_max)
+        self.epoch += 1
+        t_end = time.monotonic()
+        self.metrics.observe_batch(
+            t_end - t0,
+            {
+                "poll": t_poll - t0,
+                "build": t_build - t_poll,
+                "device": t_device - t_build,
+                "sink_submit": t_end - t_device,
+            },
+        )
+        if self.checkpoint_every and self.epoch % self.checkpoint_every == 0:
+            self._checkpoint()
+        return True
+
+    def run(self, max_batches: int | None = None) -> None:
+        """Drive the loop until the source is exhausted (or forever)."""
+        trigger_s = self.cfg.trigger_ms / 1e3
+        n = 0
+        try:
+            while max_batches is None or n < max_batches:
+                t0 = time.monotonic()
+                progressed = self.step_once()
+                if progressed:
+                    n += 1
+                elif self.source.exhausted:
+                    break
+                else:
+                    time.sleep(0.05)
+                    continue
+                if trigger_s:
+                    dt_left = trigger_s - (time.monotonic() - t0)
+                    if dt_left > 0:
+                        time.sleep(dt_left)
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        try:
+            if not self.writer.poisoned:
+                self._checkpoint()
+        finally:
+            # a poisoned writer raises here, after source/store cleanup ran,
+            # and the uncommitted offsets make the lost batch replayable
+            try:
+                self.source.close()
+            finally:
+                self.writer.close()

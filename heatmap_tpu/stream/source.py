@@ -1,0 +1,326 @@
+"""Pull sources with replayable offsets.
+
+The reference's only source is the Kafka connector with Spark-managed
+offsets (reference: heatmap_stream.py:79-86; README.md:131-133).  The Source
+protocol here generalizes that: ``poll`` returns up to ``max_events`` events
+past the current position, ``offset``/``seek`` expose a serializable
+position for the checkpoint (resume = seek + idempotent replay,
+SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import abc
+import collections
+import json
+import math
+import os
+import time
+from typing import Any, Iterable, Sequence
+
+import numpy as np
+
+from heatmap_tpu.stream.events import EventColumns, columns_from_arrays
+
+
+class Source(abc.ABC):
+    @abc.abstractmethod
+    def poll(self, max_events: int) -> Sequence[dict] | EventColumns:
+        """Up to max_events events at the current position (may be empty)."""
+
+    def offset(self) -> Any:
+        """JSON-serializable replay position."""
+        return None
+
+    def seek(self, offset: Any) -> None:
+        pass
+
+    @property
+    def exhausted(self) -> bool:
+        """True when no more data will ever arrive (bounded replays)."""
+        return False
+
+    def close(self) -> None:
+        pass
+
+
+class MemorySource(Source):
+    """Deque-fed source for hermetic tests (SURVEY.md §4(c))."""
+
+    def __init__(self, events: Iterable[dict] = ()):
+        self._q: collections.deque = collections.deque(events)
+        self._consumed = 0
+        self._done = False
+
+    def push(self, events: Iterable[dict]) -> None:
+        self._q.extend(events)
+
+    def finish(self) -> None:
+        self._done = True
+
+    def poll(self, max_events: int):
+        out = []
+        while self._q and len(out) < max_events:
+            out.append(self._q.popleft())
+        self._consumed += len(out)
+        return out
+
+    def offset(self):
+        return self._consumed
+
+    @property
+    def exhausted(self) -> bool:
+        return self._done and not self._q
+
+
+class JsonlReplaySource(Source):
+    """Replay a JSON-lines event capture; offset = line number."""
+
+    def __init__(self, path: str, loop: bool = False):
+        self.path = path
+        self.loop = loop
+        self._fh = open(path, encoding="utf-8")
+        self._line = 0
+        self._eof = False
+
+    def poll(self, max_events: int):
+        out = []
+        wrapped = False
+        while len(out) < max_events:
+            line = self._fh.readline()
+            if not line:
+                if self.loop and not wrapped:
+                    # at most one wrap per poll, so an empty/unparseable
+                    # file can't spin this loop forever
+                    self._fh.seek(0)
+                    self._line = 0
+                    wrapped = True
+                    continue
+                self._eof = not self.loop
+                break
+            self._line += 1
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # malformed line -> dropped (ref: filters)
+        return out
+
+    def offset(self):
+        return self._line
+
+    def seek(self, offset) -> None:
+        self._fh.seek(0)
+        for _ in range(int(offset or 0)):
+            self._fh.readline()
+        self._line = int(offset or 0)
+        self._eof = False
+
+    @property
+    def exhausted(self) -> bool:
+        return self._eof and not self.loop
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+class SyntheticSource(Source):
+    """Deterministic synthetic city traffic (BASELINE.json config #3).
+
+    Every event is a pure function of its absolute index: vehicle
+    ``i % n_vehicles`` follows a parametric orbit around a per-vehicle
+    anchor inside the city box.  That makes ``seek`` exact and O(1) — a
+    resumed replay is bit-identical regardless of batch chunking — and the
+    generator is fully vectorized (no JSON on the bench hot path).
+    Offset = number of events emitted.
+    """
+
+    def __init__(
+        self,
+        n_events: int | None = None,
+        n_vehicles: int = 2000,
+        center=(42.3601, -71.0589),      # Boston (reference default view)
+        radius_deg: float = 0.15,
+        t0: int = 1_700_000_000,
+        events_per_second: int = 100_000,
+        seed: int = 0,
+    ):
+        self.n_events = n_events  # None = unbounded
+        self.n_vehicles = n_vehicles
+        self.center = center
+        self.radius = radius_deg
+        self.t0 = t0
+        self.eps = events_per_second
+        self.seed = seed
+        self._emitted = 0
+        rng = np.random.default_rng(seed)  # init-time only: fixed draw order
+        self._anchor = np.stack([
+            center[0] + rng.uniform(-radius_deg, radius_deg, n_vehicles),
+            center[1] + rng.uniform(-radius_deg, radius_deg, n_vehicles),
+        ], axis=1)
+        self._orbit_r = rng.uniform(0.002, 0.03, n_vehicles)      # deg
+        self._speed = rng.uniform(10, 90, n_vehicles).astype(np.float32)
+        # angular velocity (rad/s of sim time) consistent with the speed
+        self._omega = (self._speed / 3.6) / (self._orbit_r * 111_000.0)
+        self._phase = rng.uniform(0, 2 * math.pi, n_vehicles)
+        self._vehicles = [f"veh-{i}" for i in range(n_vehicles)]
+
+    def poll(self, max_events: int) -> EventColumns:
+        n = max_events
+        if self.n_events is not None:
+            n = min(n, self.n_events - self._emitted)
+        if n <= 0:
+            return columns_from_arrays([], [], [], [])
+        i = self._emitted + np.arange(n, dtype=np.int64)
+        vid = (i % self.n_vehicles).astype(np.int32)
+        sim_t = i / self.eps
+        ang = self._omega[vid] * sim_t + self._phase[vid]
+        lat = self._anchor[vid, 0] + self._orbit_r[vid] * np.cos(ang)
+        lng = self._anchor[vid, 1] + self._orbit_r[vid] * np.sin(ang)
+        # deterministic per-event speed jitter
+        speed = np.maximum(
+            self._speed[vid] + 2.0 * np.sin(0.7 * i).astype(np.float32), 0.0
+        )
+        ts = self.t0 + i // self.eps
+        cols = columns_from_arrays(
+            lat.astype(np.float32),
+            lng.astype(np.float32),
+            speed.astype(np.float32),
+            ts.astype(np.int32),
+            provider_id=np.zeros(n, np.int32),
+            vehicle_id=vid,
+            providers=["synthetic"],
+            vehicles=self._vehicles,
+        )
+        self._emitted += n
+        return cols
+
+    def offset(self):
+        return self._emitted
+
+    def seek(self, offset) -> None:
+        self._emitted = int(offset or 0)
+
+    @property
+    def exhausted(self) -> bool:
+        return self.n_events is not None and self._emitted >= self.n_events
+
+
+class KafkaSource(Source):
+    """Kafka consumer source (the reference's ingress contract,
+    mbta_to_kafka.py:33-39 / heatmap_stream.py:79-86).
+
+    Gated: requires either confluent_kafka or kafka-python at runtime;
+    neither ships in the dev image, so construction raises with guidance.
+    Offsets are tracked per partition and committed via the framework
+    checkpoint, not the broker, mirroring the reference's Spark-side offset
+    ownership (README.md:214-215).
+    """
+
+    def __init__(self, bootstrap: str, topic: str, group: str = "heatmap-tpu"):
+        try:
+            from confluent_kafka import Consumer  # type: ignore
+        except ImportError:
+            try:
+                from kafka import KafkaConsumer  # type: ignore
+            except ImportError as e:
+                raise ImportError(
+                    "KafkaSource needs confluent_kafka or kafka-python; "
+                    "neither is installed. Use the TCP bus producer/source "
+                    "(heatmap_tpu.stream.bus) or JsonlReplaySource instead."
+                ) from e
+            self._impl = _KafkaPythonImpl(bootstrap, topic)
+        else:
+            self._impl = _ConfluentImpl(bootstrap, topic, group)
+
+    def poll(self, max_events: int):
+        return self._impl.poll(max_events)
+
+    def offset(self):
+        return self._impl.offset()
+
+    def seek(self, offset) -> None:
+        self._impl.seek(offset)
+
+    def close(self) -> None:
+        self._impl.close()
+
+
+class _ConfluentImpl:
+    def __init__(self, bootstrap, topic, group):
+        from confluent_kafka import Consumer
+
+        self.c = Consumer({
+            "bootstrap.servers": bootstrap,
+            "group.id": group,
+            "enable.auto.commit": False,
+            "auto.offset.reset": "latest",  # ref: startingOffsets=latest
+        })
+        self.c.subscribe([topic])
+        self.topic = topic
+        self._offsets: dict[int, int] = {}
+
+    def poll(self, max_events):
+        out = []
+        msgs = self.c.consume(num_messages=max_events, timeout=0.05)
+        for m in msgs:
+            if m.error():
+                continue
+            try:
+                out.append(json.loads(m.value()))
+            except (json.JSONDecodeError, TypeError):
+                continue
+            self._offsets[m.partition()] = m.offset() + 1
+        return out
+
+    def offset(self):
+        return dict(self._offsets)
+
+    def seek(self, offset):
+        from confluent_kafka import TopicPartition
+
+        if offset:
+            self.c.assign([TopicPartition(self.topic, int(p), int(o))
+                           for p, o in offset.items()])
+            self._offsets = {int(p): int(o) for p, o in offset.items()}
+
+    def close(self):
+        self.c.close()
+
+
+class _KafkaPythonImpl:
+    def __init__(self, bootstrap, topic):
+        from kafka import KafkaConsumer
+
+        self.c = KafkaConsumer(
+            topic,
+            bootstrap_servers=bootstrap,
+            enable_auto_commit=False,
+            auto_offset_reset="latest",
+            value_deserializer=lambda b: json.loads(b.decode("utf-8")),
+            consumer_timeout_ms=50,
+        )
+        self._offsets: dict[int, int] = {}
+
+    def poll(self, max_events):
+        out = []
+        try:
+            for m in self.c:
+                out.append(m.value)
+                self._offsets[m.partition] = m.offset + 1
+                if len(out) >= max_events:
+                    break
+        except StopIteration:
+            pass
+        return out
+
+    def offset(self):
+        return dict(self._offsets)
+
+    def seek(self, offset):
+        pass  # assigned on rebalance; framework replay covers the gap
+
+    def close(self):
+        self.c.close()

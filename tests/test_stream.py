@@ -1,0 +1,232 @@
+"""End-to-end streaming runtime tests with hermetic source/sink
+(SURVEY.md §4(c)): synthetic events → device aggregation → MemoryStore,
+plus checkpoint/resume and the monotonic positions contract."""
+
+import datetime as dt
+import time
+
+import numpy as np
+import pytest
+
+from heatmap_tpu.config import load_config
+from heatmap_tpu.sink import MemoryStore
+from heatmap_tpu.sink.base import UTC
+from heatmap_tpu.stream import MemorySource, MicroBatchRuntime, SyntheticSource
+from heatmap_tpu.stream.events import parse_events
+
+
+def mk_cfg(tmp_path, **over):
+    over.setdefault("checkpoint_dir", str(tmp_path / "ckpt"))
+    over.setdefault("batch_size", 512)
+    over.setdefault("state_capacity_log2", 13)
+    over.setdefault("speed_hist_bins", 8)
+    over.setdefault("store", "memory")
+    return load_config({}, **over)
+
+
+# recent timestamps so the stores' staleAt TTL (windowEnd + TTL_MINUTES)
+# doesn't garbage-collect the tiles under the test
+T_NOW = int(time.time()) - 600
+
+
+def mk_events(n, t0=T_NOW, provider="mbta"):
+    rng = np.random.default_rng(42)
+    out = []
+    for i in range(n):
+        out.append({
+            "provider": provider,
+            "vehicleId": f"veh-{i % 20}",
+            "lat": float(rng.uniform(42.3, 42.4)),
+            "lon": float(rng.uniform(-71.1, -71.0)),
+            "speedKmh": float(rng.uniform(0, 80)),
+            "bearing": 0.0,
+            "accuracyM": 5.0,
+            "ts": dt.datetime.fromtimestamp(t0 + i, UTC).strftime(
+                "%Y-%m-%dT%H:%M:%SZ"),
+        })
+    return out
+
+
+def test_parse_events_validation():
+    good = mk_events(5)
+    bad = [
+        {"provider": None, "vehicleId": "x", "lat": 1, "lon": 1, "ts": 0},
+        {"provider": "p", "vehicleId": "x", "lat": 91.0, "lon": 1, "ts": 0},
+        {"provider": "p", "vehicleId": "x", "lat": 1, "lon": -181.0, "ts": 0},
+        {"provider": "p", "vehicleId": "x", "lat": 1, "lon": 1, "ts": "junk"},
+        {"provider": "p", "vehicleId": "x", "lon": 1, "ts": 0},  # no lat
+    ]
+    cols = parse_events(good + bad)
+    assert len(cols) == 5
+    assert cols.n_dropped == 5
+    assert cols.providers == ["mbta"]
+    assert len(cols.vehicles) == 5
+
+
+def test_end_to_end_memory(tmp_path):
+    cfg = mk_cfg(tmp_path)
+    store = MemoryStore()
+    src = MemorySource(mk_events(1000))
+    src.finish()
+    rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=0)
+    rt.run()
+    # tiles written with the reference doc shape
+    ws = store.latest_window_start()
+    assert ws is not None
+    tiles = list(store.tiles_in_window(ws))
+    assert tiles
+    t = tiles[0]
+    assert t["_id"].startswith(f"{cfg.city}|h3r8|")
+    assert t["grid"] == "h3r8"
+    assert set(t) >= {"city", "grid", "cellId", "windowStart", "windowEnd",
+                      "count", "avgSpeedKmh", "centroid", "staleAt",
+                      "p95SpeedKmh", "stddevSpeedKmh"}
+    assert t["centroid"]["type"] == "Point"
+    # total event mass across all windows equals the input
+    total = 0
+    seen_ws = set()
+    for doc in store._tiles.values():
+        total += doc["count"]
+        seen_ws.add(doc["windowStart"])
+    assert total == 1000
+    # positions: one per vehicle, ts = that vehicle's max
+    pos = list(store.all_positions())
+    assert len(pos) == 20
+    assert all(p["_id"].startswith("mbta|veh-") for p in pos)
+    snap = rt.metrics.snapshot()
+    assert snap["events_valid"] == 1000
+
+
+def test_positions_monotonic(tmp_path):
+    cfg = mk_cfg(tmp_path)
+    store = MemoryStore()
+    src = MemorySource()
+    rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=0)
+    t0 = T_NOW
+    newer = {"provider": "p", "vehicleId": "v1", "lat": 42.35, "lon": -71.05,
+             "speedKmh": 10, "ts": t0 + 100}
+    older = {"provider": "p", "vehicleId": "v1", "lat": 40.0, "lon": -70.0,
+             "speedKmh": 10, "ts": t0}
+    src.push([newer])
+    rt.step_once()
+    src.push([older])  # replay/stale event must not win
+    rt.step_once()
+    rt.writer.drain()
+    pos = list(store.all_positions())
+    assert len(pos) == 1
+    assert pos[0]["ts"] == dt.datetime.fromtimestamp(t0 + 100, UTC)
+    assert pos[0]["loc"]["coordinates"][1] == pytest.approx(42.35, abs=1e-4)
+
+
+def test_multi_res_multi_window(tmp_path):
+    cfg = mk_cfg(tmp_path, resolutions=(7, 8), windows_minutes=(1, 5))
+    store = MemoryStore()
+    src = MemorySource(mk_events(500))
+    src.finish()
+    rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=0)
+    rt.run()
+    grids = {d["grid"] for d in store._tiles.values()}
+    # default window (5 min) keeps the reference label; 1-min gets suffixed
+    assert grids == {"h3r7", "h3r8", "h3r7m1", "h3r8m1"}
+    # per-grid mass conservation
+    for g in grids:
+        tot = sum(d["count"] for d in store._tiles.values() if d["grid"] == g)
+        assert tot == 500, g
+
+
+def test_checkpoint_resume(tmp_path):
+    cfg = mk_cfg(tmp_path)
+    store = MemoryStore()
+    src = SyntheticSource(n_events=2048, n_vehicles=50, events_per_second=512)
+    rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=1)
+    for _ in range(2):
+        rt.step_once()
+    rt._checkpoint()
+    off = src.offset()
+    assert off == 1024
+
+    # new runtime resumes from the checkpoint; finishes the stream
+    src2 = SyntheticSource(n_events=2048, n_vehicles=50, events_per_second=512)
+    store2 = MemoryStore()
+    rt2 = MicroBatchRuntime(cfg, src2, store2, checkpoint_every=0)
+    assert src2.offset() == 1024  # seek applied by resume
+    assert rt2.epoch == rt.epoch
+    rt2.run()
+    assert src2.exhausted
+
+    # continuous single-runtime reference run for comparison
+    cfg3 = mk_cfg(tmp_path, checkpoint_dir=str(tmp_path / "ckpt3"))
+    src3 = SyntheticSource(n_events=2048, n_vehicles=50, events_per_second=512)
+    store3 = MemoryStore()
+    rt3 = MicroBatchRuntime(cfg3, src3, store3, checkpoint_every=0)
+    rt3.run()
+    # resumed state must equal the continuous run's state exactly
+    (res, wmin), agg2 = next(iter(rt2.aggs.items()))
+    agg3 = rt3.aggs[(res, wmin)]
+    for a, b in zip(agg2.state, agg3.state):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_watermark_drops_late_events(tmp_path):
+    cfg = mk_cfg(tmp_path, watermark_minutes=10)
+    store = MemoryStore()
+    src = MemorySource()
+    rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=0)
+    t0 = T_NOW
+    src.push(mk_events(100, t0=t0))
+    rt.step_once()
+    # events a full hour earlier: behind watermark -> dropped
+    src.push(mk_events(50, t0=t0 - 3600))
+    rt.step_once()
+    assert rt.metrics.counters["events_late"] == 50
+    rt.writer.drain()
+    total = sum(d["count"] for d in store._tiles.values())
+    assert total == 100
+
+
+def test_writer_failure_blocks_checkpoint(tmp_path):
+    """A lost sink write must poison the writer so offsets never commit past
+    the dropped batch (SURVEY.md §7 hard part #5)."""
+    from heatmap_tpu.sink import AsyncWriter
+
+    class FailingStore(MemoryStore):
+        def upsert_tiles(self, docs):
+            raise IOError("sink down")
+
+    w = AsyncWriter(FailingStore())
+    w.submit_tiles([{"_id": "x"}])
+    with pytest.raises(RuntimeError):
+        w.drain()
+    # sticky: still failed on the next attempt
+    with pytest.raises(RuntimeError):
+        w.submit_tiles([{"_id": "y"}])
+    assert w.poisoned
+
+
+def test_jsonl_replay_empty_loop_no_hang(tmp_path):
+    from heatmap_tpu.stream import JsonlReplaySource
+
+    p = tmp_path / "empty.jsonl"
+    p.write_text("")
+    src = JsonlReplaySource(str(p), loop=True)
+    assert src.poll(100) == []  # must return, not spin
+    assert not src.exhausted  # looping source never claims exhaustion
+
+
+def test_jsonl_store_roundtrip(tmp_path):
+    from heatmap_tpu.sink import JsonlStore
+
+    cfg = mk_cfg(tmp_path, store="jsonl")
+    store = JsonlStore(str(tmp_path / "data"))
+    src = MemorySource(mk_events(300))
+    src.finish()
+    rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=0)
+    rt.run()
+    n_tiles = store.n_tiles
+    store.close()
+    # reload from disk: identical live view
+    store2 = JsonlStore(str(tmp_path / "data"))
+    assert store2.n_tiles == n_tiles
+    assert store2.n_positions == 20
+    ws = store2.latest_window_start()
+    assert list(store2.tiles_in_window(ws))
