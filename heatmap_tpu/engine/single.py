@@ -6,11 +6,10 @@ all_to_all exchange entirely; the state slab lives on the default device.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
+
 
 from heatmap_tpu.engine.state import TileState, init_state
 from heatmap_tpu.engine.step import AggParams, aggregate_batch

@@ -1,0 +1,19 @@
+"""models — the five benchmark pipeline configurations (BASELINE.json).
+
+Each "model" is a fully-wired pipeline: source + aggregation layout +
+store, expressed as a Config plus a source factory.  These are the configs
+the reference's BASELINE.json enumerates:
+
+1. ``mbta_default``     — MBTA Boston feed, H3_RES=8, 5-min window
+                          (the reference's defaults, heatmap_stream.py:21-37).
+2. ``opensky_global``   — OpenSky aircraft, H3_RES=7, 5-min window.
+3. ``synthetic_backfill`` — 10M-event single-city replay, H3_RES=9.
+4. ``hex_pyramid``      — merged feeds, multi-resolution 7/8/9.
+5. ``multi_window``     — sliding 1/5/15-min windows, count+avg+p95 stats.
+"""
+
+from heatmap_tpu.models.pipelines import (  # noqa: F401
+    PIPELINES,
+    Pipeline,
+    get_pipeline,
+)

@@ -1,0 +1,94 @@
+"""Named pipeline configurations mapping BASELINE.json's five configs onto
+Config + source factories."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from heatmap_tpu.config import Config, load_config
+from heatmap_tpu.stream.source import Source, SyntheticSource
+
+
+@dataclasses.dataclass(frozen=True)
+class Pipeline:
+    name: str
+    description: str
+    config: Config
+    make_source: Callable[[Config], Source]
+
+
+def _kafka_or_synthetic(cfg: Config) -> Source:
+    """Live pipelines consume the Kafka ingress when a client lib exists
+    (the reference contract); otherwise fall back to synthetic data so the
+    pipeline still runs hermetically."""
+    from heatmap_tpu.stream.source import KafkaSource
+
+    try:
+        return KafkaSource(cfg.kafka_bootstrap, cfg.kafka_topic)
+    except ImportError:
+        return SyntheticSource(n_vehicles=1000, events_per_second=1000)
+
+
+def _synthetic_backfill(cfg: Config) -> Source:
+    return SyntheticSource(
+        n_events=10_000_000, n_vehicles=20_000, events_per_second=1_000_000,
+    )
+
+
+PIPELINES: dict[str, Pipeline] = {}
+
+
+def _register(name, description, make_source, **cfg_overrides):
+    cfg = load_config({}, **cfg_overrides)
+    PIPELINES[name] = Pipeline(name, description, cfg, make_source)
+
+
+# 1. the reference's default configuration (BASELINE config #1)
+_register(
+    "mbta_default",
+    "MBTA Boston feed, H3_RES=8, TILE_MINUTES=5 (reference defaults)",
+    _kafka_or_synthetic,
+    city="bos", h3_res=8, resolutions=(8,), windows_minutes=(5,),
+)
+
+# 2. OpenSky global aircraft (BASELINE config #2)
+_register(
+    "opensky_global",
+    "OpenSky global aircraft, H3_RES=7, 5-min window",
+    _kafka_or_synthetic,
+    city="global", h3_res=7, resolutions=(7,), windows_minutes=(5,),
+    state_capacity_log2=19,   # global cardinality
+)
+
+# 3. synthetic 10M-event backfill (BASELINE config #3)
+_register(
+    "synthetic_backfill",
+    "Synthetic replay: 10M-event single-city backfill, H3_RES=9",
+    _synthetic_backfill,
+    city="bos", h3_res=9, resolutions=(9,), windows_minutes=(5,),
+    batch_size=1 << 19, state_capacity_log2=20,
+)
+
+# 4. multi-resolution hex pyramid (BASELINE config #4)
+_register(
+    "hex_pyramid",
+    "Merged MBTA+OpenSky, multi-resolution 7/8/9 hex pyramid",
+    _kafka_or_synthetic,
+    city="bos", h3_res=8, resolutions=(7, 8, 9), windows_minutes=(5,),
+)
+
+# 5. sliding multi-window with extended stats (BASELINE config #5)
+_register(
+    "multi_window",
+    "Sliding multi-window (1/5/15-min), count + avgSpeed + p95-speed stats",
+    _kafka_or_synthetic,
+    city="bos", h3_res=8, resolutions=(8,), windows_minutes=(1, 5, 15),
+    speed_hist_bins=64,
+)
+
+
+def get_pipeline(name: str) -> Pipeline:
+    if name not in PIPELINES:
+        raise KeyError(f"unknown pipeline {name!r}; have {sorted(PIPELINES)}")
+    return PIPELINES[name]

@@ -1,0 +1,21 @@
+"""producers — live-feed pollers publishing canonical GPS events.
+
+The reference ships one producer (MBTA poller → Kafka,
+mbta_to_kafka.py:41-97) and *documents* a second (OpenSky aircraft,
+README.md:111-117) that is missing from its tree; BASELINE.json config #2
+requires it, so both are implemented here, plus the synthetic replay
+producer the benchmarks use (config #3).
+
+Producers are transport-agnostic: they emit to a ``Publisher`` (Kafka when a
+client lib is installed — the reference's ingress contract — or a JSONL
+capture file / in-process queue for hermetic runs).
+"""
+
+from heatmap_tpu.producers.base import (  # noqa: F401
+    JsonlPublisher,
+    MemoryPublisher,
+    Publisher,
+    make_publisher,
+)
+from heatmap_tpu.producers.mbta import MbtaProducer  # noqa: F401
+from heatmap_tpu.producers.opensky import OpenSkyProducer  # noqa: F401

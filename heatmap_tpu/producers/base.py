@@ -1,0 +1,154 @@
+"""Publisher transports + the shared producer polling loop.
+
+The reference publishes JSON to Kafka keyed by vehicleId with flush-per-poll
+(mbta_to_kafka.py:33-39,79-82) and survives API hiccups with tiered error
+handling and backoff (:86-97).  ``run_poll_loop`` reproduces that loop shape
+for any fetcher/publisher pair.
+"""
+
+from __future__ import annotations
+
+import abc
+import collections
+import json
+import logging
+import time
+from typing import Callable, Iterable, Sequence
+
+log = logging.getLogger(__name__)
+
+
+class Publisher(abc.ABC):
+    @abc.abstractmethod
+    def publish(self, events: Sequence[dict]) -> None:
+        """Send a batch of canonical events (keyed by vehicleId)."""
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryPublisher(Publisher):
+    """In-process queue; doubles as a stream.Source feeder in tests."""
+
+    def __init__(self):
+        self.queue: collections.deque = collections.deque()
+
+    def publish(self, events: Sequence[dict]) -> None:
+        self.queue.extend(events)
+
+
+class JsonlPublisher(Publisher):
+    """Append events to a JSONL capture (replayable by JsonlReplaySource)."""
+
+    def __init__(self, path: str):
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def publish(self, events: Sequence[dict]) -> None:
+        for e in events:
+            self._fh.write(json.dumps(e) + "\n")
+
+    def flush(self) -> None:
+        self._fh.flush()
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+class KafkaPublisher(Publisher):
+    """Kafka producer keyed by vehicleId (reference: mbta_to_kafka.py:33-39).
+
+    Gated on confluent_kafka or kafka-python being installed."""
+
+    def __init__(self, bootstrap: str, topic: str):
+        self.topic = topic
+        try:
+            from confluent_kafka import Producer  # type: ignore
+
+            self._p = Producer({"bootstrap.servers": bootstrap})
+            self._mode = "confluent"
+        except ImportError:
+            try:
+                from kafka import KafkaProducer  # type: ignore
+            except ImportError as e:
+                raise ImportError(
+                    "KafkaPublisher needs confluent_kafka or kafka-python; "
+                    "use JsonlPublisher or MemoryPublisher instead."
+                ) from e
+            self._p = KafkaProducer(
+                bootstrap_servers=bootstrap,
+                value_serializer=lambda v: json.dumps(v).encode("utf-8"),
+                key_serializer=lambda k: k.encode("utf-8"),
+            )
+            self._mode = "kafka-python"
+
+    def publish(self, events: Sequence[dict]) -> None:
+        for e in events:
+            key = str(e.get("vehicleId", ""))
+            if self._mode == "confluent":
+                self._p.produce(self.topic, key=key,
+                                value=json.dumps(e).encode("utf-8"))
+            else:
+                self._p.send(self.topic, key=key, value=e)
+
+    def flush(self) -> None:
+        if self._mode == "confluent":
+            self._p.flush()
+        else:
+            self._p.flush()
+
+    def close(self) -> None:
+        self.flush()
+
+
+def make_publisher(cfg, kind: str = "auto", path: str | None = None) -> Publisher:
+    if kind == "memory":
+        return MemoryPublisher()
+    if kind == "jsonl":
+        return JsonlPublisher(path or "events.jsonl")
+    if kind == "kafka":
+        return KafkaPublisher(cfg.kafka_bootstrap, cfg.kafka_topic)
+    try:
+        return KafkaPublisher(cfg.kafka_bootstrap, cfg.kafka_topic)
+    except ImportError:
+        log.warning("no kafka client installed; capturing to events.jsonl")
+        return JsonlPublisher(path or "events.jsonl")
+
+
+def run_poll_loop(
+    fetch: Callable[[], Iterable[dict]],
+    publisher: Publisher,
+    period_s: float,
+    max_polls: int | None = None,
+    error_backoff_s: float = 5.0,
+) -> int:
+    """The reference producer's loop shape (mbta_to_kafka.py:50-97):
+    fetch → publish → flush → sleep, with tiered error handling."""
+    import requests
+
+    n = 0
+    polls = 0
+    while max_polls is None or polls < max_polls:
+        polls += 1
+        try:
+            events = list(fetch())
+            publisher.publish(events)
+            publisher.flush()
+            n += len(events)
+            log.info("fetched %d events / published (total %d)", len(events), n)
+            time.sleep(period_s)
+        except KeyboardInterrupt:
+            log.info("interrupted; stopping")
+            break
+        except requests.HTTPError as e:
+            log.error("HTTP error from API: %s", e)
+            time.sleep(error_backoff_s)
+        except requests.RequestException as e:
+            log.error("network error: %s", e)
+            time.sleep(error_backoff_s)
+        except Exception:
+            log.exception("unexpected producer error")
+            time.sleep(error_backoff_s)
+    return n

@@ -1,0 +1,13 @@
+"""serve — REST API + embedded map UI (replaces the reference's Flask app).
+
+Endpoint + payload contracts match the reference exactly (reference:
+app.py:45-69 tiles, :71-88 positions, :92-189 UI): GeoJSON
+FeatureCollections, hex Polygon rings as closed [[lng, lat], ...] loops,
+Point features for vehicle positions.  Flask is not available in this
+environment, so the app is plain WSGI on the stdlib server (threaded); it
+runs either standalone against a Store or embedded in the streaming process,
+where /metrics additionally exposes the runtime counters
+(SURVEY.md §5.5 — the reference has no metrics endpoint at all).
+"""
+
+from heatmap_tpu.serve.api import make_wsgi_app, serve_forever, start_background  # noqa: F401

@@ -1,0 +1,17 @@
+"""Standalone server: ``python -m heatmap_tpu.serve``.
+
+Reads the same env config as the reference's app.py (MONGO_URI/MONGO_DB/
+REFRESH_MS) and serves the store selected by HEATMAP_STORE.
+"""
+
+import logging
+
+from heatmap_tpu.config import load_config
+from heatmap_tpu.serve.api import serve_forever
+from heatmap_tpu.sink import make_store
+
+logging.basicConfig(level=logging.INFO,
+                    format="%(asctime)s %(levelname)s %(name)s %(message)s")
+
+cfg = load_config()
+serve_forever(make_store(cfg), cfg)

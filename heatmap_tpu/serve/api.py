@@ -1,0 +1,170 @@
+"""WSGI application: tiles/positions GeoJSON + metrics + UI.
+
+Contract parity notes (all against /root/reference/app.py):
+- GET /api/tiles/latest  → FeatureCollection of Polygon features for the
+  newest windowStart, properties {cellId, count, avgSpeedKmh, windowStart,
+  windowEnd} (app.py:45-69).  TPU-native extras (p95SpeedKmh, stddev) ride
+  along when present.
+- GET /api/positions/latest → FeatureCollection of Point features,
+  properties {provider, vehicleId, ts} (app.py:71-88).
+- GET /            → embedded Leaflet UI (app.py:92-189).
+- GET /metrics     → runtime counters (new; the reference has none).
+- GET /healthz     → liveness.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import functools
+import json
+import logging
+import threading
+from wsgiref.simple_server import WSGIServer, WSGIRequestHandler, make_server
+from socketserver import ThreadingMixIn
+
+from heatmap_tpu import hexgrid
+from heatmap_tpu.serve.ui import render_index
+from heatmap_tpu.sink.base import Store
+
+log = logging.getLogger(__name__)
+
+
+@functools.lru_cache(maxsize=65536)
+def cell_ring(cell_id: str) -> tuple:
+    """Closed GeoJSON ring [[lng, lat], ...] for a hex cell.
+
+    Same output shape as the reference's h3_boundary_geojson (app.py:19-41),
+    computed by our own grid math instead of the C h3 library."""
+    verts = hexgrid.cell_to_boundary(cell_id)
+    coords = [[lng, lat] for (lat, lng) in verts]
+    if coords and coords[0] != coords[-1]:
+        coords.append(coords[0])
+    return tuple(tuple(c) for c in coords)
+
+
+def _iso(v) -> str:
+    if isinstance(v, dt.datetime):
+        return v.isoformat()
+    return str(v)
+
+
+def tiles_feature_collection(store: Store, grid: str | None = None) -> dict:
+    start = store.latest_window_start(grid)
+    if start is None:
+        return {"type": "FeatureCollection", "features": []}
+    features = []
+    for doc in store.tiles_in_window(start, grid):
+        props = {
+            "cellId": doc["cellId"],
+            "count": int(doc.get("count", 0)),
+            "avgSpeedKmh": float(doc.get("avgSpeedKmh", 0.0)),
+            "windowStart": _iso(doc["windowStart"]),
+            "windowEnd": _iso(doc["windowEnd"]),
+        }
+        for extra in ("p95SpeedKmh", "stddevSpeedKmh", "windowMinutes"):
+            if extra in doc:
+                props[extra] = doc[extra]
+        features.append({
+            "type": "Feature",
+            "geometry": {
+                "type": "Polygon",
+                "coordinates": [[list(c) for c in cell_ring(doc["cellId"])]],
+            },
+            "properties": props,
+        })
+    return {"type": "FeatureCollection", "features": features}
+
+
+def positions_feature_collection(store: Store) -> dict:
+    features = []
+    for doc in store.all_positions():
+        lon, lat = doc["loc"]["coordinates"]
+        features.append({
+            "type": "Feature",
+            "geometry": {"type": "Point", "coordinates": [lon, lat]},
+            "properties": {
+                "provider": doc.get("provider"),
+                "vehicleId": doc.get("vehicleId"),
+                "ts": _iso(doc.get("ts")),
+            },
+        })
+    return {"type": "FeatureCollection", "features": features}
+
+
+def make_wsgi_app(store: Store, cfg=None, runtime=None):
+    refresh_ms = getattr(cfg, "refresh_ms", 5000) if cfg else 5000
+
+    def app(environ, start_response):
+        path = environ.get("PATH_INFO", "/")
+        try:
+            if path == "/api/tiles/latest":
+                qs = environ.get("QUERY_STRING", "")
+                grid = None
+                for part in qs.split("&"):
+                    if part.startswith("grid="):
+                        grid = part[5:]
+                body = json.dumps(tiles_feature_collection(store, grid))
+                ctype = "application/json"
+            elif path == "/api/positions/latest":
+                body = json.dumps(positions_feature_collection(store))
+                ctype = "application/json"
+            elif path == "/metrics":
+                m = runtime.metrics.snapshot() if runtime is not None else {}
+                if runtime is not None:
+                    m.update(runtime.writer.counters)
+                body = json.dumps(m)
+                ctype = "application/json"
+            elif path == "/healthz":
+                body = json.dumps({"ok": True})
+                ctype = "application/json"
+            elif path == "/":
+                body = render_index(refresh_ms)
+                ctype = "text/html; charset=utf-8"
+            else:
+                start_response("404 Not Found", [("Content-Type", "text/plain")])
+                return [b"not found"]
+        except Exception:
+            log.exception("request failed: %s", path)
+            start_response("500 Internal Server Error",
+                           [("Content-Type", "application/json")])
+            return [b'{"error": "internal"}']
+        data = body.encode("utf-8")
+        start_response("200 OK", [("Content-Type", ctype),
+                                  ("Content-Length", str(len(data)))])
+        return [data]
+
+    return app
+
+
+class _ThreadingWSGIServer(ThreadingMixIn, WSGIServer):
+    daemon_threads = True
+
+
+class _QuietHandler(WSGIRequestHandler):
+    def log_message(self, fmt, *args):  # route access logs through logging
+        log.debug("%s %s", self.address_string(), fmt % args)
+
+
+def _make_http_server(store, cfg, runtime, host, port):
+    host = host or (getattr(cfg, "serve_host", None) or "127.0.0.1")
+    port = port if port is not None else (getattr(cfg, "serve_port", None) or 5000)
+    return make_server(host, port, make_wsgi_app(store, cfg, runtime),
+                       server_class=_ThreadingWSGIServer,
+                       handler_class=_QuietHandler)
+
+
+def serve_forever(store: Store, cfg=None, runtime=None,
+                  host: str | None = None, port: int | None = None):
+    httpd = _make_http_server(store, cfg, runtime, host, port)
+    log.info("serving on http://%s:%d/", *httpd.server_address)
+    httpd.serve_forever()
+
+
+def start_background(store: Store, cfg=None, runtime=None,
+                     host: str | None = None, port: int | None = None):
+    """Start the server on a daemon thread; returns (server, thread, port)."""
+    httpd = _make_http_server(store, cfg, runtime, host, port)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="serve-http")
+    t.start()
+    return httpd, t, httpd.server_address[1]

@@ -1,0 +1,137 @@
+"""Embedded Leaflet map UI (functional parity with reference app.py:92-189).
+
+Written from scratch: hex choropleth over the latest window, vehicle
+markers with popups, periodic refresh of both endpoints, waiting toast,
+auto-fit.  Additions over the reference: a live metrics readout (events/sec,
+batch p50) fed by /metrics, and a count/speed legend.
+"""
+
+from __future__ import annotations
+
+_PAGE = """<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8"/>
+<title>heatmap-tpu — live mobility</title>
+<meta name="viewport" content="width=device-width,initial-scale=1"/>
+<link rel="stylesheet" href="https://unpkg.com/leaflet@1.9.4/dist/leaflet.css"/>
+<style>
+  html, body, #map { height: 100%; margin: 0; }
+  .hud {
+    position: absolute; bottom: 12px; left: 12px; z-index: 1000;
+    background: rgba(255,255,255,.92); border-radius: 8px;
+    padding: 8px 12px; font: 12px/1.5 system-ui, sans-serif;
+    box-shadow: 0 1px 4px rgba(0,0,0,.3);
+  }
+  .hud .swatch { display:inline-block; width:12px; height:12px;
+                 border-radius:2px; margin-right:4px; vertical-align:-2px; }
+  #status {
+    position: absolute; top: 12px; left: 50%; transform: translateX(-50%);
+    z-index: 1000; background: rgba(20,20,20,.8); color: #fff;
+    padding: 5px 12px; border-radius: 14px; font: 12px system-ui, sans-serif;
+    visibility: hidden;
+  }
+</style>
+</head>
+<body>
+<div id="map"></div>
+<div id="status"></div>
+<div class="hud" id="hud">loading…</div>
+<script src="https://unpkg.com/leaflet@1.9.4/dist/leaflet.js"></script>
+<script>
+"use strict";
+const REFRESH_MS = __REFRESH_MS__;
+const RAMP = [[0,'#ffffcc'],[3,'#ffeda0'],[6,'#fed976'],[11,'#feb24c'],
+              [21,'#fd8d3c'],[51,'#f03b20'],[101,'#bd0026']];
+
+const map = L.map('map', {zoomControl: true}).setView([42.3601, -71.0589], 12);
+L.tileLayer('https://tile.openstreetmap.org/{z}/{x}/{y}.png', {
+  maxZoom: 19, attribution: '&copy; OpenStreetMap contributors'
+}).addTo(map);
+
+const hexes = L.geoJSON(null, {
+  style: f => ({weight: 0.7, color: '#666', fillOpacity: 0.55,
+                fillColor: rampColor(f.properties.count)}),
+  onEachFeature: (f, layer) => {
+    const p = f.properties;
+    let html = `<b>${esc(p.cellId)}</b><br/>count: ${Number(p.count)}` +
+               `<br/>avg speed: ${Number(p.avgSpeedKmh).toFixed(1)} km/h`;
+    if (p.p95SpeedKmh !== undefined)
+      html += `<br/>p95 speed: ${Number(p.p95SpeedKmh).toFixed(1)} km/h`;
+    layer.bindPopup(html);
+  }
+}).addTo(map);
+const vehicles = L.layerGroup().addTo(map);
+
+function rampColor(c) {
+  let col = RAMP[0][1];
+  for (const [min, color] of RAMP) if (c >= min) col = color;
+  return col;
+}
+
+function esc(v) {  // event fields are untrusted ingress data
+  return String(v).replace(/[&<>"']/g,
+    ch => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[ch]));
+}
+
+function status(msg) {
+  const el = document.getElementById('status');
+  el.textContent = msg;
+  el.style.visibility = 'visible';
+  clearTimeout(status._t);
+  status._t = setTimeout(() => el.style.visibility = 'hidden', 2000);
+}
+
+let fitted = false;
+async function tick() {
+  try {
+    const [tiles, pts, metrics] = await Promise.all([
+      fetch('/api/tiles/latest').then(r => r.json()),
+      fetch('/api/positions/latest').then(r => r.json()),
+      fetch('/metrics').then(r => r.json()).catch(() => ({})),
+    ]);
+    hexes.clearLayers();
+    if (tiles.features && tiles.features.length) {
+      hexes.addData(tiles);
+      if (!fitted) {
+        const b = hexes.getBounds();
+        if (b.isValid()) { map.fitBounds(b, {maxZoom: 14}); fitted = true; }
+      }
+    }
+    vehicles.clearLayers();
+    for (const f of (pts.features || [])) {
+      const [lng, lat] = f.geometry.coordinates;
+      const m = L.circleMarker([lat, lng],
+        {radius: 4, weight: 1, color: '#1451c4', fillOpacity: 0.9});
+      const p = f.properties;
+      m.bindPopup(`<b>${esc(p.provider)}</b> ${esc(p.vehicleId)}<br/>${esc(p.ts)}`);
+      vehicles.addLayer(m);
+    }
+    const nt = (tiles.features || []).length, np = (pts.features || []).length;
+    if (!nt && !np) status('Waiting for data…');
+    renderHud(nt, np, metrics);
+  } catch (err) {
+    console.error(err);
+    status('Fetch failed — is the pipeline up?');
+  }
+}
+
+function renderHud(nt, np, m) {
+  const sw = RAMP.map(([min, c]) =>
+    `<span class="swatch" style="background:${c}"></span>&ge;${min}`).join(' ');
+  let line = `${nt} tiles · ${np} vehicles`;
+  if (m && m.events_per_sec !== undefined)
+    line += ` · ${Number(m.events_per_sec).toLocaleString()} ev/s` +
+            ` · p50 ${m.batch_latency_p50_ms} ms`;
+  document.getElementById('hud').innerHTML = line + '<br/>' + sw;
+}
+
+tick();
+setInterval(tick, REFRESH_MS);
+</script>
+</body>
+</html>"""
+
+
+def render_index(refresh_ms: int = 5000) -> str:
+    return _PAGE.replace("__REFRESH_MS__", str(int(refresh_ms)))

@@ -34,6 +34,9 @@ class MongoStore(Store):
         t = self.db["tiles"]
         t.create_index([("city", 1), ("grid", 1), ("windowStart", -1)])
         t.create_index([("cellId", 1), ("windowStart", -1)])
+        # serves latest_window_start's unprefixed max-windowStart lookup
+        # (the reference's manual DDL lacks it, forcing a COLLSCAN)
+        t.create_index([("windowStart", -1)])
         t.create_index([("centroid", "2dsphere")])
         t.create_index("staleAt", expireAfterSeconds=0)
         p = self.db["positions_latest"]
@@ -41,9 +44,12 @@ class MongoStore(Store):
         p.create_index([("loc", "2dsphere")])
         p.create_index([("ts", -1)])
 
-    def _bulk(self, coll: str, ops: list) -> None:
+    def _bulk(self, coll: str, ops: list) -> int:
+        applied = 0
         for i in range(0, len(ops), CHUNK):
-            self.db[coll].bulk_write(ops[i:i + CHUNK], ordered=False)
+            r = self.db[coll].bulk_write(ops[i:i + CHUNK], ordered=False)
+            applied += r.modified_count + len(r.upserted_ids)
+        return applied
 
     def upsert_tiles(self, docs: Sequence[dict]) -> int:
         ops = [UpdateOne({"_id": d["_id"]}, {"$set": d}, upsert=True) for d in docs]
@@ -69,9 +75,8 @@ class MongoStore(Store):
             }
             ops.append(UpdateOne({"_id": d["_id"]}, [{"$replaceRoot": {"newRoot": cond}}],
                                  upsert=True))
-        if ops:
-            self._bulk("positions_latest", ops)
-        return len(ops)
+        # Store contract: return docs actually APPLIED (stale ones are no-ops)
+        return self._bulk("positions_latest", ops) if ops else 0
 
     def latest_window_start(self, grid=None):
         q = {} if grid is None else {"grid": grid}

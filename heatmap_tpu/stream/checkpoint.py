@@ -2,12 +2,16 @@
 
 The reference delegates offsets and windowed-aggregation state to Spark's
 checkpoint directory (reference: heatmap_stream.py:37,244; resume semantics
-SURVEY.md §5.4).  Here the framework owns both:
+SURVEY.md §5.4).  Here the framework owns both.
 
-- ``meta.json``  — source offset, watermark high-ts, epoch counter
-  (written atomically via rename).
-- ``state-<res>-<win>.npz`` — the aggregation slabs, one per configured
-  (resolution, window) pair.
+Atomicity: every commit writes a fresh ``commit-<epoch>/`` directory holding
+``meta.json`` (source offset, watermark high-ts, epoch) plus one
+``state-<res>-<win>.npz`` per configured (resolution, window) pair, then
+atomically renames the single ``LATEST`` pointer file at it.  A crash at any
+point leaves LATEST referencing a complete older commit — offsets and state
+can never be torn against each other (a torn pair would double-count
+replayed events into restored state).  Older commit dirs are pruned after
+the pointer moves.
 
 Commit ordering (SURVEY.md §7 hard part #5): the runtime drains the sink
 writer *before* committing, so a crash replays only events whose upserts
@@ -19,43 +23,77 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 from typing import Any
 
 import numpy as np
 
 from heatmap_tpu.engine.state import TileState
 
+KEEP_COMMITS = 2  # current + previous, for post-mortem debugging
+
 
 class CheckpointManager:
     def __init__(self, directory: str):
         self.dir = directory
         os.makedirs(directory, exist_ok=True)
-        self.meta_path = os.path.join(directory, "meta.json")
+        self.latest_path = os.path.join(directory, "LATEST")
 
-    # --- meta -----------------------------------------------------------
-    def load_meta(self) -> dict | None:
-        if not os.path.exists(self.meta_path):
+    def _commit_dir(self) -> str | None:
+        if not os.path.exists(self.latest_path):
             return None
-        with open(self.meta_path, encoding="utf-8") as fh:
+        with open(self.latest_path, encoding="utf-8") as fh:
+            name = fh.read().strip()
+        path = os.path.join(self.dir, name)
+        return path if os.path.isdir(path) else None
+
+    # --- read -----------------------------------------------------------
+    def load_meta(self) -> dict | None:
+        d = self._commit_dir()
+        if d is None:
+            return None
+        with open(os.path.join(d, "meta.json"), encoding="utf-8") as fh:
             return json.load(fh)
 
-    def commit(self, offset: Any, max_event_ts: int, epoch: int,
-               states: dict[tuple[int, int], TileState] | None = None) -> None:
-        if states:
-            for (res, win), st in states.items():
-                path = os.path.join(self.dir, f"state-{res}-{win}.npz")
-                tmp = path + ".tmp.npz"
-                np.savez(tmp, **{k: np.asarray(v) for k, v in st._asdict().items()})
-                os.replace(tmp, path)
-        tmp = self.meta_path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as fh:
-            json.dump({"offset": offset, "max_event_ts": int(max_event_ts),
-                       "epoch": int(epoch)}, fh)
-        os.replace(tmp, self.meta_path)
-
     def load_state(self, res: int, win: int) -> TileState | None:
-        path = os.path.join(self.dir, f"state-{res}-{win}.npz")
+        d = self._commit_dir()
+        if d is None:
+            return None
+        path = os.path.join(d, f"state-{res}-{win}.npz")
         if not os.path.exists(path):
             return None
         with np.load(path) as z:
             return TileState(**{k: z[k] for k in TileState._fields})
+
+    # --- write ----------------------------------------------------------
+    def commit(self, offset: Any, max_event_ts: int, epoch: int,
+               states: dict[tuple[int, int], TileState] | None = None) -> None:
+        name = f"commit-{epoch:012d}"
+        cdir = os.path.join(self.dir, name)
+        tmp = cdir + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        for (res, win), st in (states or {}).items():
+            np.savez(os.path.join(tmp, f"state-{res}-{win}.npz"),
+                     **{k: np.asarray(v) for k, v in st._asdict().items()})
+        with open(os.path.join(tmp, "meta.json"), "w", encoding="utf-8") as fh:
+            json.dump({"offset": offset, "max_event_ts": int(max_event_ts),
+                       "epoch": int(epoch)}, fh)
+        shutil.rmtree(cdir, ignore_errors=True)
+        os.replace(tmp, cdir)
+
+        # the atomic pointer flip
+        ptmp = self.latest_path + ".tmp"
+        with open(ptmp, "w", encoding="utf-8") as fh:
+            fh.write(name)
+        os.replace(ptmp, self.latest_path)
+        self._prune(keep=name)
+
+    def _prune(self, keep: str) -> None:
+        commits = sorted(
+            n for n in os.listdir(self.dir)
+            if n.startswith("commit-") and not n.endswith(".tmp")
+        )
+        for n in commits[:-KEEP_COMMITS]:
+            if n != keep:
+                shutil.rmtree(os.path.join(self.dir, n), ignore_errors=True)

@@ -84,8 +84,10 @@ def parse_events(events, intern_p=None, intern_v=None) -> EventColumns:
         except (KeyError, TypeError, ValueError):
             dropped += 1
             continue
-        # the reference's filters (heatmap_stream.py:96-104)
+        # the reference's filters (heatmap_stream.py:96-104), plus ts sanity:
+        # NaN/inf and out-of-epoch-seconds-range (e.g. milliseconds) dropped
         if (provider is None or vehicle is None or t is None
+                or not np.isfinite(t) or not (0.0 <= t < 2**31)
                 or not (-90.0 <= la <= 90.0) or not (-180.0 <= lo <= 180.0)
                 or not np.isfinite(la) or not np.isfinite(lo)):
             dropped += 1

@@ -14,8 +14,6 @@ import abc
 import collections
 import json
 import math
-import os
-import time
 from typing import Any, Iterable, Sequence
 
 import numpy as np

@@ -1,0 +1,117 @@
+"""Serving layer: endpoint contracts against a live threaded server."""
+
+import datetime as dt
+import json
+import time
+import urllib.request
+
+import pytest
+
+from heatmap_tpu.config import load_config
+from heatmap_tpu.serve import make_wsgi_app, start_background
+from heatmap_tpu.serve.api import cell_ring
+from heatmap_tpu.sink import MemoryStore
+from heatmap_tpu.sink.base import PositionDoc, TileDoc, UTC
+from heatmap_tpu import hexgrid
+
+
+@pytest.fixture()
+def store():
+    s = MemoryStore()
+    now = dt.datetime.now(UTC).replace(microsecond=0)
+    ws = now - dt.timedelta(minutes=2)
+    cell = hexgrid.latlng_to_cell(42.3601, -71.0589, 8)
+    s.upsert_tiles([
+        TileDoc("bos", 8, cell, ws, ws + dt.timedelta(minutes=5),
+                count=7, avg_speed_kmh=33.0, avg_lat=42.36, avg_lon=-71.05,
+                ttl_minutes=45, extra={"p95SpeedKmh": 55.0}),
+    ])
+    s.upsert_positions([
+        PositionDoc("mbta", "veh-1", now, 42.36, -71.05),
+    ])
+    return s
+
+
+@pytest.fixture()
+def server(store):
+    cfg = load_config({}, serve_port=0)
+    httpd, t, port = start_background(store, cfg)
+    yield f"http://127.0.0.1:{port}"
+    httpd.shutdown()
+
+
+def get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_tiles_latest_contract(server):
+    fc = get_json(server + "/api/tiles/latest")
+    assert fc["type"] == "FeatureCollection"
+    assert len(fc["features"]) == 1
+    f = fc["features"][0]
+    assert f["type"] == "Feature"
+    geom = f["geometry"]
+    assert geom["type"] == "Polygon"
+    ring = geom["coordinates"][0]
+    assert ring[0] == ring[-1]  # closed, like the reference (app.py:39-40)
+    assert len(ring) == 7       # hexagon + closing vertex
+    props = f["properties"]
+    assert set(props) >= {"cellId", "count", "avgSpeedKmh",
+                          "windowStart", "windowEnd"}
+    assert props["count"] == 7
+    assert props["p95SpeedKmh"] == 55.0
+    # ring coordinates are [lng, lat] pairs around the actual cell
+    lats = [c[1] for c in ring]
+    lngs = [c[0] for c in ring]
+    assert 42.2 < sum(lats) / len(lats) < 42.5
+    assert -71.2 < sum(lngs) / len(lngs) < -70.9
+
+
+def test_positions_latest_contract(server):
+    fc = get_json(server + "/api/positions/latest")
+    assert fc["type"] == "FeatureCollection"
+    f = fc["features"][0]
+    assert f["geometry"]["type"] == "Point"
+    lon, lat = f["geometry"]["coordinates"]
+    assert lat == pytest.approx(42.36, abs=1e-6)
+    props = f["properties"]
+    assert props["provider"] == "mbta"
+    assert props["vehicleId"] == "veh-1"
+    assert "T" in props["ts"]  # ISO format
+
+
+def test_empty_store_empty_collections():
+    cfg = load_config({}, serve_port=0)
+    httpd, t, port = start_background(MemoryStore(), cfg)
+    try:
+        fc = get_json(f"http://127.0.0.1:{port}/api/tiles/latest")
+        assert fc == {"type": "FeatureCollection", "features": []}
+        fc = get_json(f"http://127.0.0.1:{port}/api/positions/latest")
+        assert fc["features"] == []
+    finally:
+        httpd.shutdown()
+
+
+def test_index_and_health_and_metrics(server):
+    with urllib.request.urlopen(server + "/", timeout=10) as r:
+        html = r.read().decode()
+        assert r.headers["Content-Type"].startswith("text/html")
+    assert "leaflet" in html.lower()
+    assert "/api/tiles/latest" in html
+    assert "/api/positions/latest" in html
+    assert get_json(server + "/healthz") == {"ok": True}
+    assert get_json(server + "/metrics") == {}  # no runtime attached
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(server + "/nope", timeout=10)
+
+
+def test_cell_ring_consistency():
+    cell = hexgrid.latlng_to_cell(42.3601, -71.0589, 8)
+    ring = cell_ring(cell)
+    # center must be inside the ring's bounding box
+    lat, lng = hexgrid.cell_to_latlng(cell)
+    lats = [c[1] for c in ring]
+    lngs = [c[0] for c in ring]
+    assert min(lats) < lat < max(lats)
+    assert min(lngs) < lng < max(lngs)
