@@ -1,0 +1,148 @@
+#!/usr/bin/env python3
+"""Benchmark: synthetic GPS backfill through the TPU aggregation pipeline.
+
+Measures BASELINE.json's headline metric — GPS events/sec through the
+H3-snap + windowed-aggregate path at H3_RES=8 (north star: >=5M ev/s on a
+v5e-4; this harness uses however many chips are visible, typically one).
+
+Scenario: BASELINE config #3, a synthetic single-city backfill.  The replay
+capture is staged into HBM once (its H2D time is inside the measured wall),
+then micro-batches are folded into the windowed tile state by a
+``lax.scan`` running CHUNK batches per dispatch — the TPU-native shape for
+a backfill, where per-dispatch and device->host round trips (very expensive
+on remote-attached chips) amortize over many batches.  Each batch produces
+the full update-mode emit (packed, count/avg/p95 per touched group); emit
+pulls are issued async and overlap the next chunk's compute.
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
+ratio is against the BASELINE.json north-star target of 5M events/sec.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Env knobs: BENCH_EVENTS (default 16M), BENCH_BATCH (2^20), BENCH_RES (8),
+BENCH_CAP_LOG2 (17), BENCH_HIST_BINS (32), BENCH_CHUNK (8),
+BENCH_EMIT_CAP (4096).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from heatmap_tpu.engine import AggParams, init_state
+    from heatmap_tpu.engine.step import aggregate_batch, pack_emit, unpack_emit
+    from heatmap_tpu.stream.source import SyntheticSource
+
+    n_events = int(os.environ.get("BENCH_EVENTS", 16 * (1 << 20)))
+    batch = int(os.environ.get("BENCH_BATCH", 1 << 20))
+    res = int(os.environ.get("BENCH_RES", 8))
+    cap = 1 << int(os.environ.get("BENCH_CAP_LOG2", 17))
+    bins = int(os.environ.get("BENCH_HIST_BINS", 32))
+    chunk = int(os.environ.get("BENCH_CHUNK", 8))
+    emit_cap = int(os.environ.get("BENCH_EMIT_CAP", 4096))
+
+    dev = jax.devices()[0]
+    print(f"# device: {dev.platform} {dev.device_kind}", file=sys.stderr)
+
+    params = AggParams(res=res, window_s=300, emit_capacity=emit_cap,
+                       speed_hist_max=256.0)
+    n_batches = max(1, n_events // batch)
+    n_chunks = max(1, n_batches // chunk)
+    n_batches = n_chunks * chunk
+
+    # --- generate the synthetic capture (host, untimed: this stands in for
+    # the capture file a real backfill would replay) -----------------------
+    t0 = time.monotonic()
+    src = SyntheticSource(n_vehicles=50_000, t0=1_700_000_000,
+                          events_per_second=batch)
+    cols = src.poll(n_batches * batch)
+    host_events = {
+        "lat": cols.lat_rad.reshape(n_chunks, chunk, batch),
+        "lng": cols.lng_rad.reshape(n_chunks, chunk, batch),
+        "speed": cols.speed_kmh.reshape(n_chunks, chunk, batch),
+        "ts": cols.ts_s.reshape(n_chunks, chunk, batch),
+    }
+    print(f"# capture generated: {n_batches * batch:,} events "
+          f"in {time.monotonic() - t0:.1f}s (untimed)", file=sys.stderr)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run_chunk(state, ev):
+        valid = jnp.ones((batch,), bool)
+
+        def body(st, e):
+            st, emit, stats = aggregate_batch(
+                st, e["lat"], e["lng"], e["speed"], e["ts"], valid,
+                jnp.int32(-(2**31)), params,
+            )
+            return st, pack_emit(emit, params.speed_hist_max)
+
+        state, packed = jax.lax.scan(body, state, ev)
+        return state, packed  # packed: (chunk, E+1, 10) uint32
+
+    state = init_state(cap, bins)
+
+    # --- warmup / compile -------------------------------------------------
+    t0 = time.monotonic()
+    ev0 = {k: jax.device_put(v[0]) for k, v in host_events.items()}
+    state, packed = run_chunk(state, ev0)
+    np.asarray(packed[0, 0, 0])
+    print(f"# compile+warmup: {time.monotonic() - t0:.1f}s", file=sys.stderr)
+    state = init_state(cap, bins)  # reset after warmup
+
+    # --- timed run --------------------------------------------------------
+    emitted_rows = 0
+    chunk_walls = []
+    pending = None
+    t_start = time.monotonic()
+    last = t_start
+    for c in range(n_chunks):
+        ev = {k: jax.device_put(v[c]) for k, v in host_events.items()}  # H2D
+        state, packed = run_chunk(state, ev)
+        if pending is not None:
+            # ONE D2H for the whole chunk's emits (per-pull cost dominates)
+            bufs = np.asarray(pending)
+            for b in range(chunk):
+                emitted_rows += unpack_emit(bufs[b])["n_emitted"]
+        pending = packed  # pulled while the next chunk computes
+        now = time.monotonic()
+        chunk_walls.append(now - last)
+        last = now
+    bufs = np.asarray(pending)
+    for b in range(chunk):
+        emitted_rows += unpack_emit(bufs[b])["n_emitted"]
+    n_active = int(np.asarray(jnp.sum(state.count > 0)))
+    wall = time.monotonic() - t_start
+
+    total = n_batches * batch
+    eps = total / wall
+    chunk_walls.sort()
+    p50_batch = chunk_walls[len(chunk_walls) // 2] / chunk * 1e3
+    print(
+        f"# {total:,} events in {wall:.2f}s ({n_chunks} chunks x {chunk} "
+        f"batches of {batch:,}) | per-batch mean {wall/n_batches*1e3:.0f}ms "
+        f"(p50 chunk/“batch” {p50_batch:.0f}ms) | active groups "
+        f"{n_active:,} | emit rows {emitted_rows:,}",
+        file=sys.stderr,
+    )
+    result = {
+        "metric": f"GPS events/sec aggregated (H3 res {res}, 5-min windows, "
+                  f"count+avg+p95 update-mode emits)",
+        "value": round(eps, 1),
+        "unit": "events/sec",
+        "vs_baseline": round(eps / 5_000_000.0, 4),
+    }
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

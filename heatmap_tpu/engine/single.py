@@ -12,7 +12,7 @@ import jax.numpy as jnp
 
 
 from heatmap_tpu.engine.state import TileState, init_state
-from heatmap_tpu.engine.step import AggParams, aggregate_batch
+from heatmap_tpu.engine.step import AggParams, aggregate_batch, pack_emit
 
 
 class SingleAggregator:
@@ -31,6 +31,14 @@ class SingleAggregator:
 
         self._step = jax.jit(_step, donate_argnums=(0,))
 
+        def _step_packed(state, lat, lng, speed, ts, valid, cutoff):
+            state, emit, stats = aggregate_batch(
+                state, lat, lng, speed, ts, valid, cutoff, self.params
+            )
+            return state, pack_emit(emit, self.params.speed_hist_max), stats
+
+        self._step_packed = jax.jit(_step_packed, donate_argnums=(0,))
+
     def step(self, lat_rad, lng_rad, speed, ts, valid, watermark_cutoff):
         self.state, emit, stats = self._step(
             self.state,
@@ -42,3 +50,17 @@ class SingleAggregator:
         emit = emit._replace(n_emitted=emit.n_emitted[None],
                              overflowed=emit.overflowed[None])
         return emit, stats
+
+    def step_packed(self, lat_rad, lng_rad, speed, ts, valid, watermark_cutoff):
+        """Single-transfer variant: returns (packed_emit_device, stats_device).
+
+        The caller pulls the packed matrix with one device_get (see
+        engine.step.pack_emit) — the low-overhead path for remote-attached
+        devices; the bench hot loop uses it."""
+        self.state, packed, stats = self._step_packed(
+            self.state,
+            jnp.asarray(lat_rad), jnp.asarray(lng_rad), jnp.asarray(speed),
+            jnp.asarray(ts), jnp.asarray(valid),
+            jnp.int32(watermark_cutoff),
+        )
+        return packed, stats

@@ -44,6 +44,11 @@ from heatmap_tpu.hexgrid import device as hexdev
 
 I32_MIN = jnp.int32(-(2**31))
 
+# Events this many windows ahead of an active watermark are dropped as
+# clock-skew poison (and keep the live span well inside the 4096-window
+# sort-key compression, see merge_batch).
+FUTURE_WINDOWS = 2048
+
 
 class AggParams(NamedTuple):
     """Static parameters of one (resolution, window) aggregation."""
@@ -111,9 +116,20 @@ def merge_batch(
     N = ev_hi.shape[0]
     B = state.hist_bins
 
-    # --- late-event drop + window eviction (watermark semantics) ---------
-    # an event is late when its window closed: ws + window <= cutoff
+    # --- late/future-event drop + window eviction (watermark semantics) --
+    # late: the window already closed (ws + window <= cutoff).  future:
+    # more than FUTURE_WINDOWS ahead of the watermark — a clock-skewed
+    # producer poison pill; dropping it also guarantees the live window
+    # span stays < 4096 windows, which the 12-bit window-index sort-key
+    # compression below relies on.  (With the watermark disabled the span
+    # bound is the caller's responsibility — bounded replays only.)
     late = ev_valid & (ev_ws + params.window_s <= watermark_cutoff)
+    if FUTURE_WINDOWS:
+        has_wm = watermark_cutoff > jnp.int32(-(2**31))
+        future = ev_valid & has_wm & (
+            (ev_ws - watermark_cutoff) >= FUTURE_WINDOWS * params.window_s
+        )
+        late = late | future
     ev_valid = ev_valid & ~late
     ev_hi = jnp.where(ev_valid, ev_hi, EMPTY_KEY_HI)
     ev_lo = jnp.where(ev_valid, ev_lo, EMPTY_KEY_LO)
@@ -126,21 +142,31 @@ def merge_batch(
     st_lo = jnp.where(keep, state.key_lo, EMPTY_KEY_LO)
     st_ws = jnp.where(keep, state.key_ws, EMPTY_WS)
 
-    # --- merge-sort state ∥ batch by (hi, lo, ws); carry origin row ------
+    # --- merge-sort state ∥ batch; carry origin row -----------------------
+    # The 96-bit composite key (hi, lo, ws) is compressed EXACTLY into two
+    # u32 sort keys: with `res` static, hi's upper bits (mode/res) are
+    # constant and its variable part (base cell + coarse digits) fits 20
+    # bits; the window start is folded to a 12-bit window index (mod 4096).
+    # Distinct live keys stay distinct as long as the active window span is
+    # < 4096 windows — guaranteed by any sane watermark (4096 x 5 min ≈ 14
+    # days); k1 = 0xFFFFFFFF is unreachable for live rows (base cell <= 121)
+    # and marks empties.  Halving the sort operands nearly halves the cost
+    # of the dominant op in this fold.
     all_hi = jnp.concatenate([st_hi, ev_hi])
     all_lo = jnp.concatenate([st_lo, ev_lo])
     all_ws = jnp.concatenate([st_ws, ev_ws])
+    empty = all_hi == EMPTY_KEY_HI
+    wix = (all_ws // params.window_s).astype(jnp.uint32) & jnp.uint32(0xFFF)
+    k1 = jnp.where(
+        empty,
+        jnp.uint32(0xFFFFFFFF),
+        (wix << 20) | (all_hi & jnp.uint32(0xFFFFF)),
+    )
     orig = jnp.arange(C + N, dtype=jnp.int32)  # <C: state row, >=C: batch row
-    s_hi, s_lo, s_ws, s_orig = jax.lax.sort(
-        (all_hi, all_lo, all_ws, orig), num_keys=3
-    )
+    s_k1, s_k2, s_orig = jax.lax.sort((k1, all_lo, orig), num_keys=2)
 
-    nonempty = s_hi != EMPTY_KEY_HI
-    changed = (
-        (s_hi != jnp.roll(s_hi, 1))
-        | (s_lo != jnp.roll(s_lo, 1))
-        | (s_ws != jnp.roll(s_ws, 1))
-    )
+    nonempty = s_k1 != jnp.uint32(0xFFFFFFFF)
+    changed = (s_k1 != jnp.roll(s_k1, 1)) | (s_k2 != jnp.roll(s_k2, 1))
     is_start = changed.at[0].set(True)
     seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1  # sorted-order segment id
 
@@ -155,27 +181,50 @@ def merge_batch(
     batch_seg = jnp.where(ev_valid, batch_seg, C)
 
     # --- rebuild the slab ------------------------------------------------
-    def scat(init, idx, vals):
-        return init.at[idx].add(vals, mode="drop")
-
-    key_hi = jnp.full((C,), EMPTY_KEY_HI, jnp.uint32).at[seg].set(s_hi, mode="drop")
-    key_lo = jnp.full((C,), EMPTY_KEY_LO, jnp.uint32).at[seg].set(s_lo, mode="drop")
-    key_ws = jnp.full((C,), EMPTY_WS, jnp.int32).at[seg].set(s_ws, mode="drop")
-    # rows of the EMPTY segment must stay sentinel even though scatters above
-    # wrote EMPTY there anyway; values below only ever add masked amounts.
+    # keys scatter from the ORIGINAL arrays via the routing maps (the sort
+    # only carried the compressed keys); rows of one segment all write the
+    # same value, the EMPTY segment keeps its init sentinel.
+    key_hi = (
+        jnp.full((C,), EMPTY_KEY_HI, jnp.uint32)
+        .at[state_seg].set(state.key_hi, mode="drop")
+        .at[batch_seg].set(ev_hi, mode="drop")
+    )
+    key_lo = (
+        jnp.full((C,), EMPTY_KEY_LO, jnp.uint32)
+        .at[state_seg].set(state.key_lo, mode="drop")
+        .at[batch_seg].set(ev_lo, mode="drop")
+    )
+    key_ws = (
+        jnp.full((C,), EMPTY_WS, jnp.int32)
+        .at[state_seg].set(state.key_ws, mode="drop")
+        .at[batch_seg].set(ev_ws, mode="drop")
+    )
 
     zc = jnp.zeros((C,), jnp.int32)
-    zf = jnp.zeros((C,), jnp.float32)
     one = ev_valid.astype(jnp.int32)
-    count = scat(scat(zc, state_seg, jnp.where(keep, state.count, 0)), batch_seg, one)
+    count = (
+        zc.at[state_seg].add(jnp.where(keep, state.count, 0), mode="drop")
+        .at[batch_seg].add(one, mode="drop")
+    )
+    # the four float accumulators ride one (C, 4) scatter instead of four
     fmask = ev_valid.astype(jnp.float32)
     kf = keep.astype(jnp.float32)
-    sum_speed = scat(scat(zf, state_seg, state.sum_speed * kf), batch_seg, ev_speed * fmask)
-    sum_speed2 = scat(
-        scat(zf, state_seg, state.sum_speed2 * kf), batch_seg, ev_speed * ev_speed * fmask
+    st_vals = jnp.stack([
+        state.sum_speed * kf, state.sum_speed2 * kf,
+        state.sum_lat * kf, state.sum_lon * kf,
+    ], axis=1)
+    ev_vals = jnp.stack([
+        ev_speed * fmask, ev_speed * ev_speed * fmask,
+        ev_lat_deg * fmask, ev_lon_deg * fmask,
+    ], axis=1)
+    sums = (
+        jnp.zeros((C, 4), jnp.float32)
+        .at[state_seg].add(st_vals, mode="drop")
+        .at[batch_seg].add(ev_vals, mode="drop")
     )
-    sum_lat = scat(scat(zf, state_seg, state.sum_lat * kf), batch_seg, ev_lat_deg * fmask)
-    sum_lon = scat(scat(zf, state_seg, state.sum_lon * kf), batch_seg, ev_lon_deg * fmask)
+    sum_speed, sum_speed2, sum_lat, sum_lon = (
+        sums[:, 0], sums[:, 1], sums[:, 2], sums[:, 3]
+    )
 
     if B > 0:
         bin_w = params.speed_hist_max / B
@@ -230,6 +279,87 @@ def merge_batch(
         batch_max_ts=jnp.max(jnp.where(ev_valid, ev_ts, I32_MIN)),
     )
     return new_state, emit, stats
+
+
+def p95_from_hist_device(hist, count, hist_max: float):
+    """Vectorized 95th percentile from per-row speed histograms (device).
+
+    Same interpolation as the host version (stream.runtime._p95_from_hist);
+    computing it on device means the (E, B) histogram never has to cross
+    the device->host link."""
+    E, B = hist.shape
+    bin_w = hist_max / B
+    target = 0.95 * count.astype(jnp.float32)
+    cum = jnp.cumsum(hist, axis=1).astype(jnp.float32)
+    i = jnp.sum((cum < target[:, None]).astype(jnp.int32), axis=1)
+    ic = jnp.clip(i, 0, B - 1)
+    prev = jnp.where(
+        ic > 0,
+        jnp.take_along_axis(cum, jnp.maximum(ic - 1, 0)[:, None], axis=1)[:, 0],
+        0.0,
+    )
+    in_bin = jnp.take_along_axis(hist, ic[:, None], axis=1)[:, 0].astype(jnp.float32)
+    frac = jnp.where(in_bin > 0, (target - prev) / in_bin, 0.0)
+    p95 = jnp.where(i >= B, hist_max, (ic.astype(jnp.float32) + frac) * bin_w)
+    return jnp.where(count > 0, p95, 0.0)
+
+
+def pack_emit(emit: BatchEmit, speed_hist_max: float = 256.0) -> jnp.ndarray:
+    """Pack a BatchEmit into one (E+1, 10) uint32 matrix.
+
+    Remote-attached TPUs pay a full round trip per transferred leaf; one
+    packed matrix makes the per-batch device->host pull a single transfer.
+    Row 0 carries [n_emitted, overflowed, 0...]; rows 1.. are
+    [key_hi, key_lo, ws, count, sum_speed, sum_speed2, sum_lat, sum_lon,
+    valid, p95] with float lanes bitcast.  The histogram itself stays on
+    device — its p95 summary is computed here.  ``unpack_emit`` reverses
+    it host-side.
+    """
+    bc = lambda a: jax.lax.bitcast_convert_type(a, jnp.uint32)
+    E = emit.key_hi.shape[0]
+    if emit.hist.shape[1] > 0:
+        p95 = p95_from_hist_device(emit.hist, emit.count, speed_hist_max)
+    else:
+        p95 = jnp.zeros((E,), jnp.float32)
+    body = jnp.stack([
+        emit.key_hi,
+        emit.key_lo,
+        bc(emit.key_ws),
+        bc(emit.count),
+        bc(emit.sum_speed),
+        bc(emit.sum_speed2),
+        bc(emit.sum_lat),
+        bc(emit.sum_lon),
+        emit.valid.astype(jnp.uint32),
+        bc(p95),
+    ], axis=1)
+    head = jnp.zeros((1, body.shape[1]), jnp.uint32)
+    head = head.at[0, 0].set(emit.n_emitted.reshape(()).astype(jnp.uint32))
+    head = head.at[0, 1].set(emit.overflowed.reshape(()).astype(jnp.uint32))
+    return jnp.concatenate([head, body], axis=0)
+
+
+def unpack_emit(packed) -> dict:
+    """Host-side inverse of pack_emit: dict of numpy arrays + scalars."""
+    import numpy as np
+
+    p = np.asarray(packed)
+    body = p[1:]
+    f32 = lambda col: body[:, col].view(np.float32)
+    return {
+        "key_hi": body[:, 0],
+        "key_lo": body[:, 1],
+        "key_ws": body[:, 2].view(np.int32),
+        "count": body[:, 3].view(np.int32),
+        "sum_speed": f32(4),
+        "sum_speed2": f32(5),
+        "sum_lat": f32(6),
+        "sum_lon": f32(7),
+        "valid": body[:, 8] != 0,
+        "p95": f32(9),
+        "n_emitted": int(p[0, 0]),
+        "overflowed": bool(p[0, 1]),
+    }
 
 
 def aggregate_batch(

@@ -1,0 +1,146 @@
+"""native — C++ host components, loaded via ctypes.
+
+The hot ingest decode (JSON-lines → columnar arrays) runs in C++ at memory
+speed (decoder.cpp); the Python ``parse_events`` path stays as the portable
+fallback and the correctness oracle (they are differential-tested against
+each other).  The library builds lazily with g++ on first use and is cached
+next to the source keyed by its hash; if no compiler is available,
+``NativeDecoder.available()`` is False and callers fall back to Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "decoder.cpp")
+_LOCK = threading.Lock()
+_LIB = None
+_LIB_ERR: str | None = None
+
+
+def _build_lib() -> str:
+    with open(_SRC, "rb") as fh:
+        digest = hashlib.sha256(fh.read()).hexdigest()[:16]
+    cache_dir = os.environ.get(
+        "HEATMAP_NATIVE_CACHE",
+        os.path.join(tempfile.gettempdir(), "heatmap-tpu-native"),
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    so_path = os.path.join(cache_dir, f"_decoder-{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    tmp = so_path + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, so_path)
+    return so_path
+
+
+def _load():
+    global _LIB, _LIB_ERR
+    with _LOCK:
+        if _LIB is not None or _LIB_ERR is not None:
+            return _LIB
+        try:
+            lib = ctypes.CDLL(_build_lib())
+        except (OSError, subprocess.CalledProcessError, FileNotFoundError) as e:
+            _LIB_ERR = str(e)
+            log.warning("native decoder unavailable (%s); using Python parse",
+                        _LIB_ERR.splitlines()[0] if _LIB_ERR else e)
+            return None
+        lib.dec_new.restype = ctypes.c_void_p
+        lib.dec_free.argtypes = [ctypes.c_void_p]
+        lib.dec_intern_count.restype = ctypes.c_int64
+        lib.dec_intern_count.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.dec_intern_get.restype = ctypes.c_char_p
+        lib.dec_intern_get.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.c_int64]
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        lib.dec_decode.restype = ctypes.c_int64
+        lib.dec_decode.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            f32p, f32p, f32p, i32p, i32p, i32p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        _LIB = lib
+        return _LIB
+
+
+class NativeDecoder:
+    """Streaming JSON-lines event decoder with persistent string interning.
+
+    ``decode(data)`` accepts a bytes block of newline-separated event JSON
+    and returns (EventColumns, consumed_bytes); partial trailing lines are
+    left unconsumed so callers can stream chunked reads.
+    """
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native decoder unavailable: {_LIB_ERR}")
+        self._lib = lib
+        self._h = ctypes.c_void_p(lib.dec_new())
+        self._providers: list[str] = []
+        self._vehicles: list[str] = []
+
+    @staticmethod
+    def available() -> bool:
+        return _load() is not None
+
+    def close(self):
+        if self._h:
+            self._lib.dec_free(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover - gc timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _refresh_interns(self):
+        for which, cache in ((0, self._providers), (1, self._vehicles)):
+            n = self._lib.dec_intern_count(self._h, which)
+            for i in range(len(cache), n):
+                cache.append(
+                    self._lib.dec_intern_get(self._h, which, i).decode(
+                        "utf-8", "replace")
+                )
+
+    def decode(self, data: bytes, max_events: int | None = None):
+        from heatmap_tpu.stream.events import columns_from_arrays
+
+        cap = max_events if max_events is not None else max(1, data.count(b"\n") + 1)
+        lat = np.empty(cap, np.float32)
+        lon = np.empty(cap, np.float32)
+        speed = np.empty(cap, np.float32)
+        ts = np.empty(cap, np.int32)
+        pid = np.empty(cap, np.int32)
+        vid = np.empty(cap, np.int32)
+        dropped = ctypes.c_int64(0)
+        consumed = ctypes.c_int64(0)
+        n = self._lib.dec_decode(
+            self._h, data, len(data), cap,
+            lat, lon, speed, ts, pid, vid,
+            ctypes.byref(dropped), ctypes.byref(consumed),
+        )
+        self._refresh_interns()
+        cols = columns_from_arrays(
+            lat[:n], lon[:n], speed[:n], ts[:n],
+            provider_id=pid[:n], vehicle_id=vid[:n],
+            providers=self._providers, vehicles=self._vehicles,
+        )
+        cols.n_dropped = int(dropped.value)
+        return cols, int(consumed.value)

@@ -1,0 +1,273 @@
+// Fast JSON-lines GPS-event decoder: bytes in, columnar arrays out.
+//
+// The reference pays a per-row Python round trip for every event (JSON parse
+// in Spark + Python UDF, SURVEY.md §3.3 bottleneck #1); sustaining millions
+// of events/sec needs ingest decode at memory speed (SURVEY.md §7 hard part
+// #3).  This is a schema-specialized scanner for the canonical 8-field event
+// (reference: heatmap_stream.py:52-61) — not a general JSON parser: it walks
+// top-level key/value pairs per line, extracts lat/lon/speedKmh/ts/provider/
+// vehicleId, interns the two strings into stable int ids, validates with the
+// same rules as the Python path (stream/events.py), and writes straight into
+// caller-provided numpy buffers.
+//
+// C ABI (used via ctypes from heatmap_tpu/native/__init__.py):
+//   dec_new / dec_free                  — decoder with persistent interns
+//   dec_decode(buf, len, cap, out...)   — returns events decoded; *dropped
+//   dec_intern_count / dec_intern_get   — read back the string tables
+//
+// Build: g++ -O3 -shared -fPIC decoder.cpp -o _native.so   (no deps)
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Intern {
+    std::unordered_map<std::string, int32_t> map;
+    std::vector<std::string> names;
+    int32_t get(const char* s, size_t n) {
+        std::string key(s, n);
+        auto it = map.find(key);
+        if (it != map.end()) return it->second;
+        int32_t id = (int32_t)names.size();
+        names.push_back(key);
+        map.emplace(std::move(key), id);
+        return id;
+    }
+};
+
+struct Decoder {
+    Intern providers;
+    Intern vehicles;
+};
+
+// ---- scanning helpers -----------------------------------------------------
+
+inline const char* skip_ws(const char* p, const char* end) {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+    return p;
+}
+
+// Parse a JSON string starting at the opening quote; returns pointer past
+// the closing quote, sets [s, n) to the raw contents (escapes left as-is —
+// vehicle ids/providers with escapes are rare; they intern consistently).
+inline const char* parse_string(const char* p, const char* end,
+                                const char** s, size_t* n) {
+    ++p;  // opening quote
+    *s = p;
+    while (p < end && *p != '"') {
+        if (*p == '\\' && p + 1 < end) ++p;
+        ++p;
+    }
+    *n = (size_t)(p - *s);
+    return p < end ? p + 1 : p;
+}
+
+// Skip any JSON value (object/array/string/number/bool/null).
+const char* skip_value(const char* p, const char* end) {
+    p = skip_ws(p, end);
+    if (p >= end) return p;
+    if (*p == '"') {
+        const char* s; size_t n;
+        return parse_string(p, end, &s, &n);
+    }
+    if (*p == '{' || *p == '[') {
+        char open = *p, close = (*p == '{') ? '}' : ']';
+        int depth = 0;
+        while (p < end) {
+            if (*p == '"') {
+                const char* s; size_t n;
+                p = parse_string(p, end, &s, &n);
+                continue;
+            }
+            if (*p == open) ++depth;
+            else if (*p == close && --depth == 0) return p + 1;
+            ++p;
+        }
+        return p;
+    }
+    while (p < end && *p != ',' && *p != '}' && *p != ']' &&
+           *p != '\n') ++p;
+    return p;
+}
+
+// ISO-8601 "YYYY-MM-DD[T ]HH:MM:SS[.frac][Z|+hh:mm|-hh:mm]" -> epoch secs.
+// Days-from-civil (Howard Hinnant's algorithm), no locale, no libc tz.
+bool parse_iso8601(const char* s, size_t n, double* out) {
+    if (n < 19) return false;
+    auto digit = [&](size_t i) { return s[i] >= '0' && s[i] <= '9'; };
+    for (size_t i : {0u, 1u, 2u, 3u, 5u, 6u, 8u, 9u, 11u, 12u, 14u, 15u, 17u, 18u})
+        if (!digit(i)) return false;
+    if (s[4] != '-' || s[7] != '-' || (s[10] != 'T' && s[10] != ' ') ||
+        s[13] != ':' || s[16] != ':')
+        return false;
+    int y = (s[0]-'0')*1000 + (s[1]-'0')*100 + (s[2]-'0')*10 + (s[3]-'0');
+    unsigned m = (s[5]-'0')*10 + (s[6]-'0');
+    unsigned d = (s[8]-'0')*10 + (s[9]-'0');
+    int hh = (s[11]-'0')*10 + (s[12]-'0');
+    int mi = (s[14]-'0')*10 + (s[15]-'0');
+    int ss = (s[17]-'0')*10 + (s[18]-'0');
+    if (m < 1 || m > 12 || d < 1 || d > 31 || hh > 23 || mi > 59 || ss > 60)
+        return false;
+    size_t i = 19;
+    double frac = 0.0;
+    if (i < n && s[i] == '.') {
+        ++i;
+        double scale = 0.1;
+        while (i < n && digit(i)) { frac += (s[i]-'0') * scale; scale *= 0.1; ++i; }
+    }
+    long off = 0;  // seconds east of UTC
+    if (i < n) {
+        if (s[i] == 'Z') { ++i; }
+        else if (s[i] == '+' || s[i] == '-') {
+            int sign = (s[i] == '+') ? 1 : -1;
+            if (i + 5 < n + 1 && n - i >= 6 && digit(i+1) && digit(i+2) &&
+                s[i+3] == ':' && digit(i+4) && digit(i+5)) {
+                off = sign * (((s[i+1]-'0')*10 + (s[i+2]-'0')) * 3600 +
+                              ((s[i+4]-'0')*10 + (s[i+5]-'0')) * 60);
+                i += 6;
+            } else return false;
+        } else return false;
+    }
+    // days from civil
+    int yy = y - (m <= 2);
+    int era = (yy >= 0 ? yy : yy - 399) / 400;
+    unsigned yoe = (unsigned)(yy - era * 400);
+    unsigned doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+    unsigned doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+    long days = (long)era * 146097 + (long)doe - 719468;
+    *out = (double)days * 86400.0 + hh * 3600 + mi * 60 + ss + frac - off;
+    return true;
+}
+
+struct Fields {
+    double lat = NAN, lon = NAN, speed = NAN, ts = NAN;
+    const char* provider = nullptr; size_t provider_n = 0;
+    const char* vehicle = nullptr;  size_t vehicle_n = 0;
+    bool provider_null = true, vehicle_null = true;
+};
+
+inline bool key_is(const char* k, size_t n, const char* lit) {
+    return strlen(lit) == n && memcmp(k, lit, n) == 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* dec_new() { return new Decoder(); }
+void dec_free(void* d) { delete (Decoder*)d; }
+
+int64_t dec_intern_count(void* dv, int which) {
+    Decoder* d = (Decoder*)dv;
+    return (int64_t)(which == 0 ? d->providers.names.size()
+                                : d->vehicles.names.size());
+}
+
+const char* dec_intern_get(void* dv, int which, int64_t i) {
+    Decoder* d = (Decoder*)dv;
+    auto& v = which == 0 ? d->providers.names : d->vehicles.names;
+    if (i < 0 || (size_t)i >= v.size()) return "";
+    return v[(size_t)i].c_str();
+}
+
+// Decode up to `cap` events from newline-separated JSON in [buf, buf+len).
+// Writes columnar outputs; returns count decoded; *n_dropped counts invalid
+// lines; *consumed is the byte offset of the first unprocessed line (always
+// at a line boundary), so callers can stream arbitrarily chunked buffers.
+int64_t dec_decode(void* dv, const char* buf, int64_t len, int64_t cap,
+                   float* lat, float* lon, float* speed, int32_t* ts,
+                   int32_t* provider_id, int32_t* vehicle_id,
+                   int64_t* n_dropped, int64_t* consumed) {
+    Decoder* d = (Decoder*)dv;
+    const char* p = buf;
+    const char* end = buf + len;
+    int64_t out = 0, dropped = 0;
+    *consumed = 0;
+
+    while (p < end && out < cap) {
+        const char* line = p;
+        const char* nl = (const char*)memchr(p, '\n', (size_t)(end - p));
+        const char* lend = nl ? nl : end;
+        p = nl ? nl + 1 : end;
+
+        const char* q = skip_ws(line, lend);
+        if (q >= lend) { *consumed = (int64_t)(p - buf); continue; }
+        if (*q != '{') { ++dropped; *consumed = (int64_t)(p - buf); continue; }
+        ++q;
+
+        Fields f;
+        bool ok = true;
+        while (ok && q < lend) {
+            q = skip_ws(q, lend);
+            if (q < lend && *q == '}') break;
+            if (q >= lend || *q != '"') { ok = false; break; }
+            const char* k; size_t kn;
+            q = parse_string(q, lend, &k, &kn);
+            q = skip_ws(q, lend);
+            if (q >= lend || *q != ':') { ok = false; break; }
+            q = skip_ws(q + 1, lend);
+            if (q >= lend) { ok = false; break; }
+
+            if (*q == '"') {
+                const char* s; size_t sn;
+                q = parse_string(q, lend, &s, &sn);
+                if (key_is(k, kn, "provider")) {
+                    f.provider = s; f.provider_n = sn; f.provider_null = false;
+                } else if (key_is(k, kn, "vehicleId")) {
+                    f.vehicle = s; f.vehicle_n = sn; f.vehicle_null = false;
+                } else if (key_is(k, kn, "ts")) {
+                    double t;
+                    if (parse_iso8601(s, sn, &t)) f.ts = t;
+                }
+            } else if ((*q >= '0' && *q <= '9') || *q == '-' || *q == '+') {
+                char* numend = nullptr;
+                double v = strtod(q, &numend);
+                if (numend == q || numend > lend) { q = skip_value(q, lend); }
+                else {
+                    if (key_is(k, kn, "lat")) f.lat = v;
+                    else if (key_is(k, kn, "lon")) f.lon = v;
+                    else if (key_is(k, kn, "speedKmh")) f.speed = v;
+                    else if (key_is(k, kn, "ts")) f.ts = v;
+                    q = numend;
+                }
+            } else {
+                q = skip_value(q, lend);  // null / bool / nested
+            }
+            q = skip_ws(q, lend);
+            if (q < lend && *q == ',') ++q;
+        }
+
+        // validation — mirror stream/events.py (reference filters,
+        // heatmap_stream.py:96-104)
+        if (!ok || f.provider_null || f.vehicle_null ||
+            !std::isfinite(f.lat) || !std::isfinite(f.lon) ||
+            f.lat < -90.0 || f.lat > 90.0 ||
+            f.lon < -180.0 || f.lon > 180.0 ||
+            !std::isfinite(f.ts) || f.ts < 0.0 || f.ts >= 2147483648.0) {
+            ++dropped;
+            *consumed = (int64_t)(p - buf);
+            continue;
+        }
+        double sp = f.speed;
+        if (!std::isfinite(sp)) sp = 0.0;
+
+        lat[out] = (float)f.lat;
+        lon[out] = (float)f.lon;
+        speed[out] = (float)sp;
+        ts[out] = (int32_t)f.ts;
+        provider_id[out] = d->providers.get(f.provider, f.provider_n);
+        vehicle_id[out] = d->vehicles.get(f.vehicle, f.vehicle_n);
+        ++out;
+        *consumed = (int64_t)(p - buf);
+    }
+    *n_dropped = dropped;
+    return out;
+}
+
+}  // extern "C"

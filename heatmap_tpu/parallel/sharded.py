@@ -132,9 +132,17 @@ def _sharded_step_body(params: AggParams, n_shards: int, cap: int,
                        state: TileState, lat, lng, speed, ts, valid, cutoff):
     """Per-device body run under shard_map."""
     hi, lo, ws = snap_and_window(lat, lng, ts, valid, params)
-    # drop late events BEFORE the exchange so a replay backlog neither
-    # wastes ICI bandwidth nor steals bucket-lane capacity
+    # drop late/future events BEFORE the exchange so a replay backlog
+    # neither wastes ICI bandwidth nor steals bucket-lane capacity
+    # (future drop mirrors engine.step — see FUTURE_WINDOWS there)
+    from heatmap_tpu.engine.step import FUTURE_WINDOWS
+
     late = valid & (ws != EMPTY_WS) & (ws + params.window_s <= cutoff)
+    has_wm = cutoff > jnp.int32(-(2**31))
+    late = late | (
+        valid & has_wm & (ws != EMPTY_WS)
+        & ((ws - cutoff) >= FUTURE_WINDOWS * params.window_s)
+    )
     valid = valid & ~late
     n_late_local = jnp.sum(late.astype(jnp.int32))
     dest = (_mix32(hi, lo, ws) % jnp.uint32(n_shards)).astype(jnp.int32)

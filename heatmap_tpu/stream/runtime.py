@@ -19,14 +19,15 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Sequence
 
+import jax
 import numpy as np
 
 from heatmap_tpu.config import Config
 from heatmap_tpu.engine import AggParams
 from heatmap_tpu.engine.single import SingleAggregator
 from heatmap_tpu.engine.state import TileState
+from heatmap_tpu.engine.step import unpack_emit
 from heatmap_tpu.hexgrid.device import cells_to_uint64
 from heatmap_tpu.sink import AsyncWriter, Store, TileDoc, PositionDoc
 from heatmap_tpu.sink.base import epoch_to_dt
@@ -175,20 +176,22 @@ class MicroBatchRuntime:
         out[: len(arr)] = arr
         return out
 
-    def _emit_docs(self, res: int, wmin: int, emit) -> list[dict]:
-        valid = np.asarray(emit.valid)
-        idx = np.nonzero(valid)[0]
+    def _emit_docs(self, res: int, wmin: int, e: dict) -> list[dict]:
+        """Build tile docs from an unpacked emit dict (engine.unpack_emit
+        shape: key/count/sum arrays + 'p95' or 'hist')."""
+        idx = np.nonzero(e["valid"])[0]
         if idx.size == 0:
             return []
-        hi = np.asarray(emit.key_hi)[idx]
-        lo = np.asarray(emit.key_lo)[idx]
-        ws = np.asarray(emit.key_ws)[idx]
-        count = np.asarray(emit.count)[idx]
-        ssp = np.asarray(emit.sum_speed)[idx]
-        ssp2 = np.asarray(emit.sum_speed2)[idx]
-        sla = np.asarray(emit.sum_lat)[idx]
-        slo = np.asarray(emit.sum_lon)[idx]
-        hist = np.asarray(emit.hist)[idx] if emit.hist.shape[1] else None
+        hi = e["key_hi"][idx]
+        lo = e["key_lo"][idx]
+        ws = e["key_ws"][idx]
+        count = e["count"][idx]
+        ssp = e["sum_speed"][idx]
+        ssp2 = e["sum_speed2"][idx]
+        sla = e["sum_lat"][idx]
+        slo = e["sum_lon"][idx]
+        p95 = e["p95"][idx] if "p95" in e else None
+        hist = e["hist"][idx] if e.get("hist") is not None else None
         cells = cells_to_uint64(hi, lo)
         cfg = self.cfg
         # the reference's _id grid label for its single configured window;
@@ -204,7 +207,9 @@ class MicroBatchRuntime:
                     max(ssp2[j] / c - (ssp[j] / c) ** 2, 0.0) ** 0.5
                 ),
             }
-            if hist is not None:
+            if p95 is not None:
+                extra["p95SpeedKmh"] = float(p95[j])
+            elif hist is not None:
                 extra["p95SpeedKmh"] = _p95_from_hist(
                     hist[j], c, cfg.speed_hist_max_kmh
                 )
@@ -289,8 +294,26 @@ class MicroBatchRuntime:
         )
         batch_max = I32_MIN
         for (res, wmin), agg in self.aggs.items():
-            emit, stats = agg.step(lat, lng, speed, ts, valid, cutoff)
-            docs = self._emit_docs(res, wmin, emit)
+            # packed path: ONE device->host transfer for the whole emit
+            # (per-leaf pulls are ruinous over remote-attached TPUs);
+            # aggregators without step_packed fall back to a pytree get
+            if hasattr(agg, "step_packed"):
+                packed, stats = agg.step_packed(lat, lng, speed, ts, valid,
+                                                cutoff)
+                stats = jax.device_get(stats)
+                e = unpack_emit(packed)
+            else:
+                emit, stats = agg.step(lat, lng, speed, ts, valid, cutoff)
+                emit, stats = jax.device_get((emit, stats))
+                e = {
+                    "key_hi": emit.key_hi, "key_lo": emit.key_lo,
+                    "key_ws": emit.key_ws, "count": emit.count,
+                    "sum_speed": emit.sum_speed, "sum_speed2": emit.sum_speed2,
+                    "sum_lat": emit.sum_lat, "sum_lon": emit.sum_lon,
+                    "valid": emit.valid,
+                    "hist": emit.hist if emit.hist.shape[1] else None,
+                }
+            docs = self._emit_docs(res, wmin, e)
             self.writer.submit_tiles(docs)
             self.metrics.count("tiles_emitted", len(docs))
             batch_max = max(batch_max, int(stats.batch_max_ts))

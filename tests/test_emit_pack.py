@@ -1,0 +1,80 @@
+"""Bit-level serialization: pack_emit/unpack_emit round-trip, device p95 vs
+host p95, and the future-event (clock-skew) drop guard."""
+
+import numpy as np
+import pytest
+
+from heatmap_tpu.engine import AggParams, init_state, merge_batch
+from heatmap_tpu.engine.single import SingleAggregator
+from heatmap_tpu.engine.step import (
+    FUTURE_WINDOWS,
+    p95_from_hist_device,
+    pack_emit,
+    snap_and_window,
+    unpack_emit,
+)
+from heatmap_tpu.stream.runtime import _p95_from_hist
+from tests.test_engine import make_batch
+
+PARAMS = AggParams(res=8, window_s=300, emit_capacity=512,
+                   speed_hist_max=256.0)
+
+
+def _run_one(rng, bins=16):
+    state = init_state(4096, hist_bins=bins)
+    lat, lng, speed, ts, valid = make_batch(rng, 256)
+    hi, lo, ws = snap_and_window(lat, lng, ts, valid, PARAMS)
+    state, emit, _ = merge_batch(
+        state, np.asarray(hi), np.asarray(lo), np.asarray(ws), speed,
+        np.degrees(lat), np.degrees(lng), ts, valid, np.int32(-2**31), PARAMS
+    )
+    return emit
+
+
+def test_pack_unpack_roundtrip(rng):
+    emit = _run_one(rng)
+    got = unpack_emit(pack_emit(emit, PARAMS.speed_hist_max))
+    for field in ("key_hi", "key_lo", "key_ws", "count", "valid"):
+        np.testing.assert_array_equal(got[field], np.asarray(getattr(emit, field)))
+    for field in ("sum_speed", "sum_speed2", "sum_lat", "sum_lon"):
+        # bitcast round trip must be exact, not approximately equal
+        np.testing.assert_array_equal(got[field], np.asarray(getattr(emit, field)))
+    assert got["n_emitted"] == int(np.asarray(emit.n_emitted))
+    assert got["overflowed"] == bool(np.asarray(emit.overflowed))
+
+
+def test_device_p95_matches_host(rng):
+    emit = _run_one(rng, bins=16)
+    dev = np.asarray(p95_from_hist_device(emit.hist, emit.count, 256.0))
+    hist = np.asarray(emit.hist)
+    count = np.asarray(emit.count)
+    for i in range(len(count)):
+        host = _p95_from_hist(hist[i], int(count[i]), 256.0)
+        assert dev[i] == pytest.approx(host, abs=1e-3), i
+    # packed lane carries the same values
+    got = unpack_emit(pack_emit(emit, 256.0))
+    np.testing.assert_allclose(got["p95"], dev, atol=1e-5)
+
+
+def test_future_events_dropped_with_watermark(rng):
+    agg_params = AggParams(res=8, window_s=300, emit_capacity=512)
+    agg = SingleAggregator(agg_params, capacity=4096, batch_size=256)
+    t0 = 1_700_000_000
+    lat, lng, speed, ts, valid = make_batch(rng, 256, t0=t0)
+    # half the events jump ~15 days into the future (wix-alias poison)
+    ts = ts.copy()
+    ts[::2] = t0 + (FUTURE_WINDOWS + 7) * 300
+    cutoff = np.int32(t0 - 600)  # active watermark
+    _, stats = agg.step(lat, lng, speed, ts, valid, cutoff)
+    assert int(stats.n_late) == 128
+    assert int(stats.n_valid) == 128
+
+
+def test_future_events_kept_without_watermark(rng):
+    # watermark off (bounded replay): future guard must not engage
+    agg_params = AggParams(res=8, window_s=300, emit_capacity=512)
+    agg = SingleAggregator(agg_params, capacity=4096, batch_size=256)
+    lat, lng, speed, ts, valid = make_batch(rng, 256)
+    _, stats = agg.step(lat, lng, speed, ts, valid, -2**31)
+    assert int(stats.n_valid) == 256
+    assert int(stats.n_late) == 0

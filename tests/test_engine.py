@@ -117,12 +117,15 @@ def test_invalid_rows_excluded(rng):
 
 def test_sorted_invariant_and_empties_at_tail(rng):
     state, _, _, _ = run_both(rng)
-    hi = np.asarray(state.key_hi).astype(np.uint64)
-    lo = np.asarray(state.key_lo).astype(np.uint64)
-    ws = np.asarray(state.key_ws).astype(np.int64) - (-2**31)
-    composite = [(int(h), int(l), int(w)) for h, l, w in zip(hi, lo, ws)]
+    hi = np.asarray(state.key_hi)
+    lo = np.asarray(state.key_lo)
+    ws = np.asarray(state.key_ws)
+    live = hi != np.uint32(0xFFFFFFFF)
+    # slab order is the engine's compressed sort key (wix12 | hi20, lo)
+    wix = (ws[live].astype(np.int64) // PARAMS.window_s).astype(np.uint32) & 0xFFF
+    k1 = (wix.astype(np.uint64) << 20) | (hi[live].astype(np.uint64) & 0xFFFFF)
+    composite = list(zip(k1.tolist(), lo[live].astype(np.uint64).tolist()))
     assert composite == sorted(composite)
-    live = hi != 0xFFFFFFFF
     n = live.sum()
     assert not live[n:].any()
 

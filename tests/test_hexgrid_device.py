@@ -35,7 +35,8 @@ def _oracle(lat, lng, res):
     )
 
 
-@pytest.mark.parametrize("res", [0, 1, 5, 8, 9])
+# res 12 exercises the unpacked (N, res)-array fallback path (res > 10)
+@pytest.mark.parametrize("res", [0, 1, 5, 8, 9, 12])
 def test_f64_exact_global(rng, res):
     with jax.enable_x64(True):
         lat, lng = _random_points(rng, 2000)

@@ -1,0 +1,137 @@
+"""Native C++ decoder: differential tests against the Python oracle
+(stream.events.parse_events) plus streaming-chunk semantics."""
+
+import json
+
+import numpy as np
+import pytest
+
+from heatmap_tpu.native import NativeDecoder
+from heatmap_tpu.stream.events import parse_events
+
+pytestmark = pytest.mark.skipif(
+    not NativeDecoder.available(), reason="no C++ toolchain"
+)
+
+
+def events_bytes(events):
+    return ("\n".join(json.dumps(e) for e in events) + "\n").encode()
+
+
+def mk(n=200, seed=3):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        out.append({
+            "provider": "mbta" if i % 3 else "opensky",
+            "vehicleId": f"veh-{i % 17}",
+            "lat": float(rng.uniform(-90, 90)),
+            "lon": float(rng.uniform(-180, 180)),
+            "speedKmh": float(rng.uniform(0, 200)),
+            "bearing": float(rng.uniform(0, 360)),
+            "accuracyM": 5.0,
+            "ts": f"2026-07-{(i % 28) + 1:02d}T12:{i % 60:02d}:30Z",
+        })
+    return out
+
+
+def assert_matches_oracle(events):
+    data = events_bytes(events)
+    dec = NativeDecoder()
+    got, consumed = dec.decode(data)
+    want = parse_events(events)
+    assert consumed == len(data)
+    assert len(got) == len(want)
+    assert got.n_dropped == want.n_dropped
+    np.testing.assert_array_equal(got.lat_deg, want.lat_deg)
+    np.testing.assert_array_equal(got.lng_deg, want.lng_deg)
+    np.testing.assert_array_equal(got.speed_kmh, want.speed_kmh)
+    np.testing.assert_array_equal(got.ts_s, want.ts_s)
+    got_p = [got.providers[i] for i in got.provider_id]
+    want_p = [want.providers[i] for i in want.provider_id]
+    assert got_p == want_p
+    got_v = [got.vehicles[i] for i in got.vehicle_id]
+    want_v = [want.vehicles[i] for i in want.vehicle_id]
+    assert got_v == want_v
+
+
+def test_valid_events_match_oracle():
+    assert_matches_oracle(mk())
+
+
+def test_malformed_and_invalid_match_oracle():
+    events = mk(20)
+    bad = [
+        {"provider": None, "vehicleId": "x", "lat": 1.0, "lon": 1.0,
+         "ts": "2026-01-01T00:00:00Z"},
+        {"provider": "p", "vehicleId": "x", "lat": 91.0, "lon": 1.0,
+         "ts": "2026-01-01T00:00:00Z"},
+        {"provider": "p", "vehicleId": "x", "lat": 1.0, "lon": -181.0,
+         "ts": "2026-01-01T00:00:00Z"},
+        {"provider": "p", "vehicleId": "x", "lat": 1.0, "lon": 1.0,
+         "ts": "garbage"},
+        {"provider": "p", "vehicleId": "x", "lon": 1.0,
+         "ts": "2026-01-01T00:00:00Z"},  # missing lat
+        {"provider": "p", "vehicleId": "x", "lat": 1.0, "lon": 1.0,
+         "ts": 1.7e12},  # epoch millis out of range
+        {"provider": "p", "vehicleId": "x", "lat": 1.0, "lon": 1.0,
+         "ts": 1_700_000_000, "speedKmh": None},
+        {"provider": "p", "vehicleId": "Nächster Halt",
+         "lat": 1.0, "lon": 1.0, "ts": 1_700_000_000},
+        {"provider": "p", "vehicleId": "y", "lat": 2.0, "lon": 2.0,
+         "ts": 1_700_000_000, "extra": {"nested": [1, 2, {"a": "b"}]}},
+    ]
+    assert_matches_oracle(events + bad + mk(20, seed=9))
+
+
+def test_garbage_lines():
+    data = b'not json\n{"broken\n\n' + events_bytes(mk(3))
+    dec = NativeDecoder()
+    got, consumed = dec.decode(data)
+    assert len(got) == 3
+    assert got.n_dropped == 2
+    assert consumed == len(data)
+
+
+def test_iso_offsets_and_fractions():
+    events = [
+        {"provider": "p", "vehicleId": "a", "lat": 1.0, "lon": 1.0,
+         "ts": "2026-07-29T12:00:00+02:00"},
+        {"provider": "p", "vehicleId": "b", "lat": 1.0, "lon": 1.0,
+         "ts": "2026-07-29T12:00:00.500Z"},
+        {"provider": "p", "vehicleId": "c", "lat": 1.0, "lon": 1.0,
+         "ts": "2026-07-29 12:00:00-05:00"},
+    ]
+    assert_matches_oracle(events)
+
+
+def test_partial_trailing_line():
+    events = mk(5)
+    data = events_bytes(events)
+    cut = data[:-20]  # truncate mid-record, no trailing newline
+    dec = NativeDecoder()
+    got, consumed = dec.decode(cut)
+    assert len(got) == 4
+    # unconsumed tail starts at the last (partial) line boundary
+    assert cut[consumed:].startswith(b'{"provider"')
+
+
+def test_intern_stability_across_batches():
+    dec = NativeDecoder()
+    a, _ = dec.decode(events_bytes(mk(10)))
+    b, _ = dec.decode(events_bytes(mk(10)))
+    assert a.providers is b.providers or a.providers == b.providers
+    pa = [a.providers[i] for i in a.provider_id]
+    pb = [b.providers[i] for i in b.provider_id]
+    assert pa == pb
+
+
+def test_cap_limits_output():
+    dec = NativeDecoder()
+    data = events_bytes(mk(10))
+    got, consumed = dec.decode(data, max_events=4)
+    assert len(got) == 4
+    assert consumed < len(data)
+    # the rest decodes from the consumed offset
+    got2, consumed2 = dec.decode(data[consumed:])
+    assert len(got2) == 6
