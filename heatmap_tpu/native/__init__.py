@@ -63,8 +63,13 @@ def _load():
         lib.dec_free.argtypes = [ctypes.c_void_p]
         lib.dec_intern_count.restype = ctypes.c_int64
         lib.dec_intern_count.argtypes = [ctypes.c_void_p, ctypes.c_int]
-        lib.dec_intern_get.restype = ctypes.c_char_p
+        # void* (not c_char_p): names may contain NUL bytes, so they are
+        # read back by explicit length via string_at
+        lib.dec_intern_get.restype = ctypes.c_void_p
         lib.dec_intern_get.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.c_int64]
+        lib.dec_intern_len.restype = ctypes.c_int64
+        lib.dec_intern_len.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                        ctypes.c_int64]
         f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
         i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
@@ -83,7 +88,9 @@ class NativeDecoder:
 
     ``decode(data)`` accepts a bytes block of newline-separated event JSON
     and returns (EventColumns, consumed_bytes); partial trailing lines are
-    left unconsumed so callers can stream chunked reads.
+    left unconsumed so callers can stream chunked reads.  Pass
+    ``final=True`` on the last chunk so a complete terminal record without
+    a trailing newline is flushed rather than held back.
     """
 
     def __init__(self):
@@ -114,14 +121,25 @@ class NativeDecoder:
         for which, cache in ((0, self._providers), (1, self._vehicles)):
             n = self._lib.dec_intern_count(self._h, which)
             for i in range(len(cache), n):
-                cache.append(
-                    self._lib.dec_intern_get(self._h, which, i).decode(
-                        "utf-8", "replace")
-                )
+                ln = self._lib.dec_intern_len(self._h, which, i)
+                raw = ctypes.string_at(
+                    self._lib.dec_intern_get(self._h, which, i), ln)
+                # surrogatepass: the C side emits WTF-8 for lone \uD800-style
+                # escapes, matching what Python's json preserves in its strs
+                try:
+                    cache.append(raw.decode("utf-8", "surrogatepass"))
+                except UnicodeDecodeError:
+                    cache.append(raw.decode("utf-8", "replace"))
 
-    def decode(self, data: bytes, max_events: int | None = None):
+    def decode(self, data: bytes, max_events: int | None = None,
+               final: bool = False):
         from heatmap_tpu.stream.events import columns_from_arrays
 
+        orig_len = len(data)
+        if final and data and not data.endswith(b"\n"):
+            # flush mode: at EOF a complete last record may lack the
+            # newline the streaming contract waits for
+            data = data + b"\n"
         cap = max_events if max_events is not None else max(1, data.count(b"\n") + 1)
         lat = np.empty(cap, np.float32)
         lon = np.empty(cap, np.float32)
@@ -143,4 +161,4 @@ class NativeDecoder:
             providers=self._providers, vehicles=self._vehicles,
         )
         cols.n_dropped = int(dropped.value)
-        return cols, int(consumed.value)
+        return cols, min(int(consumed.value), orig_len)

@@ -13,7 +13,8 @@
 // C ABI (used via ctypes from heatmap_tpu/native/__init__.py):
 //   dec_new / dec_free                  — decoder with persistent interns
 //   dec_decode(buf, len, cap, out...)   — returns events decoded; *dropped
-//   dec_intern_count / dec_intern_get   — read back the string tables
+//   dec_intern_count / dec_intern_get / dec_intern_len — read the string
+//     tables (get+len: names may contain NUL bytes after unescaping)
 //
 // Build: g++ -O3 -shared -fPIC decoder.cpp -o _native.so   (no deps)
 
@@ -44,6 +45,7 @@ struct Intern {
 struct Decoder {
     Intern providers;
     Intern vehicles;
+    std::string scratch;  // reused unescape buffer
 };
 
 // ---- scanning helpers -----------------------------------------------------
@@ -54,8 +56,8 @@ inline const char* skip_ws(const char* p, const char* end) {
 }
 
 // Parse a JSON string starting at the opening quote; returns pointer past
-// the closing quote, sets [s, n) to the raw contents (escapes left as-is —
-// vehicle ids/providers with escapes are rare; they intern consistently).
+// the closing quote, sets [s, n) to the raw contents (escapes left as-is;
+// callers that need the decoded text run unescape() on the slice).
 inline const char* parse_string(const char* p, const char* end,
                                 const char** s, size_t* n) {
     ++p;  // opening quote
@@ -66,6 +68,81 @@ inline const char* parse_string(const char* p, const char* end,
     }
     *n = (size_t)(p - *s);
     return p < end ? p + 1 : p;
+}
+
+inline void append_utf8(std::string& out, uint32_t cp) {
+    if (cp < 0x80) out += (char)cp;
+    else if (cp < 0x800) {
+        out += (char)(0xC0 | (cp >> 6));
+        out += (char)(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+        out += (char)(0xE0 | (cp >> 12));
+        out += (char)(0x80 | ((cp >> 6) & 0x3F));
+        out += (char)(0x80 | (cp & 0x3F));
+    } else {
+        out += (char)(0xF0 | (cp >> 18));
+        out += (char)(0x80 | ((cp >> 12) & 0x3F));
+        out += (char)(0x80 | ((cp >> 6) & 0x3F));
+        out += (char)(0x80 | (cp & 0x3F));
+    }
+}
+
+inline int hex4(const char* s) {
+    int v = 0;
+    for (int i = 0; i < 4; ++i) {
+        char c = s[i];
+        int d = (c >= '0' && c <= '9')   ? c - '0'
+                : (c >= 'a' && c <= 'f') ? c - 'a' + 10
+                : (c >= 'A' && c <= 'F') ? c - 'A' + 10
+                                         : -1;
+        if (d < 0) return -1;
+        v = (v << 4) | d;
+    }
+    return v;
+}
+
+// Decode JSON escapes in [s, s+n) into `out` (UTF-8, surrogate pairs merged)
+// so interned names match what Python's json module produces.
+void unescape(const char* s, size_t n, std::string& out) {
+    out.clear();
+    out.reserve(n);
+    size_t i = 0;
+    while (i < n) {
+        char c = s[i];
+        if (c != '\\') { out += c; ++i; continue; }
+        if (i + 1 >= n) { out += c; break; }
+        char e = s[i + 1];
+        i += 2;
+        switch (e) {
+            case '"': out += '"'; break;
+            case '\\': out += '\\'; break;
+            case '/': out += '/'; break;
+            case 'b': out += '\b'; break;
+            case 'f': out += '\f'; break;
+            case 'n': out += '\n'; break;
+            case 'r': out += '\r'; break;
+            case 't': out += '\t'; break;
+            case 'u': {
+                if (i + 4 > n) { out += "\\u"; break; }
+                int hi = hex4(s + i);
+                if (hi < 0) { out += "\\u"; break; }
+                i += 4;
+                uint32_t cp = (uint32_t)hi;
+                if (hi >= 0xD800 && hi <= 0xDBFF && i + 6 <= n &&
+                    s[i] == '\\' && s[i + 1] == 'u') {
+                    int lo = hex4(s + i + 2);
+                    if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                        cp = 0x10000 + (((uint32_t)hi - 0xD800) << 10) +
+                             ((uint32_t)lo - 0xDC00);
+                        i += 6;
+                    }
+                }
+                append_utf8(out, cp);
+                break;
+            }
+            default: out += '\\'; out += e; break;
+        }
+    }
 }
 
 // Skip any JSON value (object/array/string/number/bool/null).
@@ -173,7 +250,16 @@ const char* dec_intern_get(void* dv, int which, int64_t i) {
     Decoder* d = (Decoder*)dv;
     auto& v = which == 0 ? d->providers.names : d->vehicles.names;
     if (i < 0 || (size_t)i >= v.size()) return "";
-    return v[(size_t)i].c_str();
+    return v[(size_t)i].data();
+}
+
+// Byte length of intern i (names may contain NUL from \u0000 escapes, so
+// readers must use this rather than strlen).
+int64_t dec_intern_len(void* dv, int which, int64_t i) {
+    Decoder* d = (Decoder*)dv;
+    auto& v = which == 0 ? d->providers.names : d->vehicles.names;
+    if (i < 0 || (size_t)i >= v.size()) return 0;
+    return (int64_t)v[(size_t)i].size();
 }
 
 // Decode up to `cap` events from newline-separated JSON in [buf, buf+len).
@@ -193,8 +279,9 @@ int64_t dec_decode(void* dv, const char* buf, int64_t len, int64_t cap,
     while (p < end && out < cap) {
         const char* line = p;
         const char* nl = (const char*)memchr(p, '\n', (size_t)(end - p));
-        const char* lend = nl ? nl : end;
-        p = nl ? nl + 1 : end;
+        if (!nl) break;  // partial trailing line: leave unconsumed for streaming
+        const char* lend = nl;
+        p = nl + 1;
 
         const char* q = skip_ws(line, lend);
         if (q >= lend) { *consumed = (int64_t)(p - buf); continue; }
@@ -261,8 +348,19 @@ int64_t dec_decode(void* dv, const char* buf, int64_t len, int64_t cap,
         lon[out] = (float)f.lon;
         speed[out] = (float)sp;
         ts[out] = (int32_t)f.ts;
-        provider_id[out] = d->providers.get(f.provider, f.provider_n);
-        vehicle_id[out] = d->vehicles.get(f.vehicle, f.vehicle_n);
+        // fast path: no escapes → intern the raw slice directly
+        if (memchr(f.provider, '\\', f.provider_n)) {
+            unescape(f.provider, f.provider_n, d->scratch);
+            provider_id[out] = d->providers.get(d->scratch.data(), d->scratch.size());
+        } else {
+            provider_id[out] = d->providers.get(f.provider, f.provider_n);
+        }
+        if (memchr(f.vehicle, '\\', f.vehicle_n)) {
+            unescape(f.vehicle, f.vehicle_n, d->scratch);
+            vehicle_id[out] = d->vehicles.get(d->scratch.data(), d->scratch.size());
+        } else {
+            vehicle_id[out] = d->vehicles.get(f.vehicle, f.vehicle_n);
+        }
         ++out;
         *consumed = (int64_t)(p - buf);
     }

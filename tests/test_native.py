@@ -126,6 +126,31 @@ def test_intern_stability_across_batches():
     assert pa == pb
 
 
+def test_final_flushes_unterminated_tail():
+    events = mk(3)
+    data = events_bytes(events)[:-1]  # complete last record, no newline
+    dec = NativeDecoder()
+    got, consumed = dec.decode(data, final=True)
+    assert len(got) == 3
+    assert consumed == len(data)
+
+
+def test_nul_and_lone_surrogate_names_match_oracle():
+    # a NUL escape inside a name must not truncate; a lone surrogate must
+    # round-trip the same way Python's json preserves it
+    lines = (
+        '{"provider": "p", "vehicleId": "a\\u0000x", "lat": 1.0, "lon": 1.0, "ts": 1700000000}\n'
+        '{"provider": "p", "vehicleId": "a\\u0000y", "lat": 1.0, "lon": 1.0, "ts": 1700000000}\n'
+        '{"provider": "p", "vehicleId": "\\ud800", "lat": 1.0, "lon": 1.0, "ts": 1700000000}\n'
+    ).encode()
+    dec = NativeDecoder()
+    got, consumed = dec.decode(lines)
+    assert consumed == len(lines)
+    assert len(got) == 3
+    names = [got.vehicles[i] for i in got.vehicle_id]
+    assert names == ["a\x00x", "a\x00y", "\ud800"]
+
+
 def test_cap_limits_output():
     dec = NativeDecoder()
     data = events_bytes(mk(10))
