@@ -40,10 +40,20 @@ def make_store(cfg) -> Store:
         from heatmap_tpu.sink.mongo import MongoStore
 
         return MongoStore(cfg.mongo_uri, cfg.mongo_db)
-    # auto: mongo when pymongo is importable, else memory
+    # auto: mongo when a server is reachable (pymongo or the built-in wire
+    # client — sink/mongowire.py — so no client library is required), else
+    # memory
     try:
         from heatmap_tpu.sink.mongo import MongoStore
 
         return MongoStore(cfg.mongo_uri, cfg.mongo_db)
-    except ImportError:
+    except Exception as e:
+        # covers ImportError / OSError / WireError and pymongo's
+        # ServerSelectionTimeoutError (which is neither OSError nor
+        # RuntimeError) — any unreachable-server shape degrades to memory
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "mongo unavailable (%s: %s); using in-memory store",
+            type(e).__name__, e)
         return MemoryStore()

@@ -1,12 +1,13 @@
-"""MongoDB Store (gated on pymongo; absent in the dev image).
+"""MongoDB Store over either backend: pymongo if installed, else the
+framework's own wire-protocol client (sink/mongowire.py).
 
 Keeps the reference's write shape — chunked unordered bulk upserts of 1000
 ops (heatmap_stream.py:188-196,230-235) — and fixes its conditional-upsert
 race: the reference's ``{$or: [ts missing, ts < incoming]} + upsert:true``
 attempts an _id insert when an equal-or-newer doc exists, colliding with the
 unique index (SURVEY.md §2a).  Here the same monotonic intent is expressed
-as a pipeline-style conditional $set on an upsert matched by _id only, which
-can never insert a duplicate.
+as a pipeline-style conditional $replaceRoot on an upsert matched by _id
+only, which can never insert a duplicate.
 
 Index DDL the reference documents as a manual mongosh step
 (README.md:139-150) is applied automatically by ``ensure_indexes``.
@@ -16,81 +17,174 @@ from __future__ import annotations
 
 from typing import Iterable, Sequence
 
-from pymongo import MongoClient, UpdateOne
-
 from heatmap_tpu.sink.base import Store
 
 CHUNK = 1000  # reference flush size (heatmap_stream.py:191)
 
+# (name→direction/kind maps, unique, ttl) triplets; shared by both backends
+_TILE_INDEXES = [
+    ({"city": 1, "grid": 1, "windowStart": -1}, False, None),
+    ({"cellId": 1, "windowStart": -1}, False, None),
+    # serves latest_window_start's unprefixed max-windowStart lookup
+    # (the reference's manual DDL lacks it, forcing a COLLSCAN)
+    ({"windowStart": -1}, False, None),
+    ({"centroid": "2dsphere"}, False, None),
+    ({"staleAt": 1}, False, 0),
+]
+_POSITION_INDEXES = [
+    ({"provider": 1, "vehicleId": 1}, True, None),
+    ({"loc": "2dsphere"}, False, None),
+    ({"ts": -1}, False, None),
+]
+
+
+def _monotonic_update_pipeline(doc: dict) -> list[dict]:
+    """Pipeline update applying ``doc`` only when it is newer than what is
+    stored (or nothing is stored); matched by _id alone so the upsert can
+    never collide with the unique index."""
+    return [{"$replaceRoot": {"newRoot": {
+        "$cond": [
+            {"$or": [
+                {"$lte": [{"$ifNull": ["$ts", None]}, None]},
+                {"$lt": ["$ts", doc["ts"]]},
+            ]},
+            doc,
+            "$$ROOT",
+        ]
+    }}}]
+
+
+class _PymongoBackend:
+    def __init__(self, uri: str, db_name: str):
+        from pymongo import MongoClient
+
+        # tz_aware: the Store contract promises timezone-aware UTC
+        # datetimes (sink/base.py), matching the wire backend's codec
+        self.client = MongoClient(uri, tz_aware=True)
+        self.db = self.client[db_name]
+
+    def ensure_indexes(self) -> None:
+        for coll, specs in (("tiles", _TILE_INDEXES),
+                            ("positions_latest", _POSITION_INDEXES)):
+            c = self.db[coll]
+            for keys, unique, ttl in specs:
+                kw: dict = {}
+                if unique:
+                    kw["unique"] = True
+                if ttl is not None:
+                    kw["expireAfterSeconds"] = ttl
+                c.create_index(list(keys.items()), **kw)
+
+    def bulk_update(self, coll: str, updates: list[dict]) -> int:
+        from pymongo import UpdateOne
+
+        ops = [UpdateOne(u["q"], u["u"], upsert=u.get("upsert", False))
+               for u in updates]
+        n = 0
+        for i in range(0, len(ops), CHUNK):
+            r = self.db[coll].bulk_write(ops[i:i + CHUNK], ordered=False)
+            n += r.modified_count + len(r.upserted_ids)
+        return n
+
+    def find(self, coll: str, filter: dict, sort: dict | None = None,
+             limit: int = 0) -> Iterable[dict]:
+        cur = self.db[coll].find(filter)
+        if sort:
+            cur = cur.sort(list(sort.items()))
+        if limit:
+            cur = cur.limit(limit)
+        return cur
+
+    def close(self) -> None:
+        self.client.close()
+
+
+class _WireBackend:
+    def __init__(self, uri: str, db_name: str):
+        from heatmap_tpu.sink.mongowire import WireClient
+
+        self.client = WireClient.from_uri(uri)
+        self.db_name = db_name
+
+    def ensure_indexes(self) -> None:
+        for coll, specs in (("tiles", _TILE_INDEXES),
+                            ("positions_latest", _POSITION_INDEXES)):
+            indexes = []
+            for keys, unique, ttl in specs:
+                name = "_".join(f"{k}_{v}" for k, v in keys.items())
+                idx: dict = {"key": keys, "name": name}
+                if unique:
+                    idx["unique"] = True
+                if ttl is not None:
+                    idx["expireAfterSeconds"] = ttl
+                indexes.append(idx)
+            self.client.create_indexes(self.db_name, coll, indexes)
+
+    def bulk_update(self, coll: str, updates: list[dict]) -> int:
+        n = 0
+        for i in range(0, len(updates), CHUNK):
+            r = self.client.update(self.db_name, coll, updates[i:i + CHUNK],
+                                   ordered=False)
+            n += int(r.get("nModified", 0)) + len(r.get("upserted", []))
+        return n
+
+    def find(self, coll: str, filter: dict, sort: dict | None = None,
+             limit: int = 0) -> Iterable[dict]:
+        return self.client.find(self.db_name, coll, filter, sort, limit)
+
+    def close(self) -> None:
+        self.client.close()
+
+
+def _make_backend(uri: str, db_name: str):
+    try:
+        return _PymongoBackend(uri, db_name)
+    except ImportError:
+        return _WireBackend(uri, db_name)
+
 
 class MongoStore(Store):
-    def __init__(self, uri: str, db_name: str, ensure_indexes: bool = True):
-        self.client = MongoClient(uri)
-        self.db = self.client[db_name]
+    def __init__(self, uri: str, db_name: str, ensure_indexes: bool = True,
+                 backend=None):
+        self._b = backend if backend is not None else _make_backend(uri, db_name)
         if ensure_indexes:
             self.ensure_indexes()
 
     def ensure_indexes(self) -> None:
-        t = self.db["tiles"]
-        t.create_index([("city", 1), ("grid", 1), ("windowStart", -1)])
-        t.create_index([("cellId", 1), ("windowStart", -1)])
-        # serves latest_window_start's unprefixed max-windowStart lookup
-        # (the reference's manual DDL lacks it, forcing a COLLSCAN)
-        t.create_index([("windowStart", -1)])
-        t.create_index([("centroid", "2dsphere")])
-        t.create_index("staleAt", expireAfterSeconds=0)
-        p = self.db["positions_latest"]
-        p.create_index([("provider", 1), ("vehicleId", 1)], unique=True)
-        p.create_index([("loc", "2dsphere")])
-        p.create_index([("ts", -1)])
-
-    def _bulk(self, coll: str, ops: list) -> int:
-        applied = 0
-        for i in range(0, len(ops), CHUNK):
-            r = self.db[coll].bulk_write(ops[i:i + CHUNK], ordered=False)
-            applied += r.modified_count + len(r.upserted_ids)
-        return applied
+        self._b.ensure_indexes()
 
     def upsert_tiles(self, docs: Sequence[dict]) -> int:
-        ops = [UpdateOne({"_id": d["_id"]}, {"$set": d}, upsert=True) for d in docs]
-        if ops:
-            self._bulk("tiles", ops)
-        return len(ops)
+        updates = [{"q": {"_id": d["_id"]}, "u": {"$set": d}, "upsert": True}
+                   for d in docs]
+        if updates:
+            self._b.bulk_update("tiles", updates)
+        return len(updates)
 
     def upsert_positions(self, docs: Sequence[dict]) -> int:
         # race-free monotonic upsert: match on _id alone (upsert can only
         # insert when the doc is truly absent); the newer-ts condition moves
         # into an aggregation-pipeline update so older events are no-ops.
-        ops = []
-        for d in docs:
-            cond = {
-                "$cond": [
-                    {"$or": [
-                        {"$lte": [{"$ifNull": ["$ts", None]}, None]},
-                        {"$lt": ["$ts", d["ts"]]},
-                    ]},
-                    d,
-                    "$$ROOT",
-                ]
-            }
-            ops.append(UpdateOne({"_id": d["_id"]}, [{"$replaceRoot": {"newRoot": cond}}],
-                                 upsert=True))
+        updates = [{"q": {"_id": d["_id"]},
+                    "u": _monotonic_update_pipeline(d),
+                    "upsert": True}
+                   for d in docs]
         # Store contract: return docs actually APPLIED (stale ones are no-ops)
-        return self._bulk("positions_latest", ops) if ops else 0
+        return self._b.bulk_update("positions_latest", updates) if updates else 0
 
     def latest_window_start(self, grid=None):
         q = {} if grid is None else {"grid": grid}
-        doc = self.db["tiles"].find_one(q, sort=[("windowStart", -1)])
-        return doc["windowStart"] if doc else None
+        for doc in self._b.find("tiles", q, sort={"windowStart": -1}, limit=1):
+            return doc["windowStart"]
+        return None
 
     def tiles_in_window(self, window_start, grid=None) -> Iterable[dict]:
         q = {"windowStart": window_start}
         if grid is not None:
             q["grid"] = grid
-        return self.db["tiles"].find(q)
+        return self._b.find("tiles", q)
 
     def all_positions(self) -> Iterable[dict]:
-        return self.db["positions_latest"].find({})
+        return self._b.find("positions_latest", {})
 
     def close(self) -> None:
-        self.client.close()
+        self._b.close()

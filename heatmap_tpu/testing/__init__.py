@@ -1,0 +1,9 @@
+"""testing — in-process wire-level fakes for the external services.
+
+The reference's test seams are external systems (Kafka broker, MongoDB
+server; SURVEY.md §4); these fakes speak the same wire protocols over real
+sockets so the framework's own protocol clients are exercised end-to-end
+with no daemons installed.
+"""
+
+from heatmap_tpu.testing.mock_mongod import MockMongod  # noqa: F401

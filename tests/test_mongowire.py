@@ -1,0 +1,211 @@
+"""Mongo wire stack: BSON codec goldens/round-trips, the OP_MSG client
+against the in-process mock mongod, and the MongoStore contract (idempotent
+tile upserts, race-free monotonic positions) over a real socket."""
+
+import datetime as dt
+
+import pytest
+
+from heatmap_tpu.sink import bson
+from heatmap_tpu.sink.base import PositionDoc, TileDoc, UTC, epoch_to_dt
+from heatmap_tpu.sink.mongo import MongoStore, _WireBackend
+from heatmap_tpu.sink.mongowire import WireClient, WireError, parse_uri
+from heatmap_tpu.testing import MockMongod
+
+
+# ---- BSON codec ------------------------------------------------------------
+
+def test_bson_golden_bytes():
+    # {"a": 1} per bsonspec.org: int32 doc
+    assert bson.encode({"a": 1}) == b"\x0c\x00\x00\x00\x10a\x00\x01\x00\x00\x00\x00"
+    # {"hello": "world"}
+    assert bson.encode({"hello": "world"}) == (
+        b"\x16\x00\x00\x00\x02hello\x00\x06\x00\x00\x00world\x00\x00")
+
+
+def test_bson_roundtrip_all_types():
+    doc = {
+        "f": 3.5, "i32": 42, "i64": 1 << 40, "neg": -7,
+        "s": "Nächster Halt", "b_true": True, "b_false": False,
+        "none": None,
+        "when": dt.datetime(2026, 7, 29, 12, 0, 30, 500000, tzinfo=UTC),
+        "nested": {"loc": {"type": "Point", "coordinates": [-71.06, 42.36]}},
+        "arr": [1, "two", 3.0, None, {"k": "v"}],
+        "blob": b"\x00\x01\xff",
+    }
+    out = bson.decode(bson.encode(doc))
+    assert out == doc
+    assert out["when"].tzinfo is not None
+
+
+def test_bson_int_width_and_overflow():
+    enc = bson.encode({"x": 2**31})
+    assert enc[4] == 0x12  # int64 tag
+    enc = bson.encode({"x": 2**31 - 1})
+    assert enc[4] == 0x10  # int32 tag
+    with pytest.raises(OverflowError):
+        bson.encode({"x": 2**63})
+
+
+def test_bson_naive_datetime_is_utc():
+    naive = dt.datetime(2026, 1, 1, 0, 0, 0)
+    out = bson.decode(bson.encode({"t": naive}))["t"]
+    assert out == dt.datetime(2026, 1, 1, tzinfo=UTC)
+
+
+def test_parse_uri():
+    assert parse_uri("mongodb://localhost:27017") == ("localhost", 27017, None)
+    assert parse_uri("mongodb://db.example:27018/mobility") == (
+        "db.example", 27018, "mobility")
+    assert parse_uri("localhost") == ("localhost", 27017, None)
+
+
+# ---- wire client against the mock server -----------------------------------
+
+@pytest.fixture()
+def mongod():
+    m = MockMongod()
+    yield m
+    m.close()
+
+
+def test_client_handshake_ping_and_errors(mongod):
+    c = WireClient.from_uri(mongod.uri)
+    assert c.max_wire_version >= 8
+    c.ping()
+    with pytest.raises(WireError):
+        c.command("admin", {"bogusCommand": 1})
+    c.close()
+
+
+def test_client_update_find_cursor_paging(mongod):
+    c = WireClient.from_uri(mongod.uri)
+    updates = [{"q": {"_id": f"k{i}"}, "u": {"$set": {"_id": f"k{i}", "v": i}},
+                "upsert": True} for i in range(25)]
+    r = c.update("testdb", "things", updates)
+    assert len(r["upserted"]) == 25
+    # force multi-batch iteration through getMore
+    docs = list(c.find("testdb", "things", {}, sort={"v": 1}, batch_size=7))
+    assert [d["v"] for d in docs] == list(range(25))
+    # re-update same keys: nModified counts only real changes
+    r = c.update("testdb", "things", updates)
+    assert r.get("upserted", []) == [] and r["nModified"] == 0
+    c.close()
+
+
+def test_client_poisons_connection_on_desync(mongod):
+    import socket
+    import struct
+    import threading
+
+    from heatmap_tpu.sink import bson as _bson
+
+    # server that answers the handshake correctly, then one reply with a
+    # wrong responseTo
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+
+    def run():
+        conn, _ = srv.accept()
+        for k, rto_offset in ((0, 0), (1, 999)):
+            hdr = b""
+            while len(hdr) < 16:
+                hdr += conn.recv(16 - len(hdr))
+            length, rid, _, _ = struct.unpack("<iiii", hdr)
+            rest = b""
+            while len(rest) < length - 16:
+                rest += conn.recv(length - 16 - len(rest))
+            payload = _bson.encode({"ok": 1.0, "maxWireVersion": 17})
+            conn.sendall(struct.pack("<iiii", 21 + len(payload), 0,
+                                     rid + rto_offset, 2013)
+                         + struct.pack("<i", 0) + b"\x00" + payload)
+        conn.close()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    c = WireClient(*srv.getsockname())
+    with pytest.raises(WireError, match="desynced"):
+        c.ping()
+    # connection now refuses further use instead of reading stale bytes
+    with pytest.raises(WireError, match="poisoned"):
+        c.ping()
+    srv.close()
+
+
+def _mk_store(mongod):
+    return MongoStore(mongod.uri, "mobility",
+                      backend=_WireBackend(mongod.uri, "mobility"))
+
+
+def test_store_tile_upsert_idempotent(mongod):
+    store = _mk_store(mongod)
+    ws = epoch_to_dt(1_700_000_000)
+    we = epoch_to_dt(1_700_000_300)
+    docs = [TileDoc("boston", 8, "88abc", ws, we, 5, 31.5, 42.3, -71.05, 45),
+            TileDoc("boston", 8, "88def", ws, we, 2, 10.0, 42.4, -71.10, 45)]
+    assert store.upsert_tiles(docs) == 2
+    assert store.upsert_tiles(docs) == 2  # idempotent re-apply
+    assert store.latest_window_start() == ws
+    got = sorted(store.tiles_in_window(ws), key=lambda d: d["cellId"])
+    assert [d["cellId"] for d in got] == ["88abc", "88def"]
+    assert got[0]["count"] == 5
+    assert got[0]["centroid"]["coordinates"] == [-71.05, 42.3]
+    assert got[0]["staleAt"] == we + dt.timedelta(minutes=45)
+    store.close()
+
+
+def test_store_positions_monotonic_guard(mongod):
+    store = _mk_store(mongod)
+    t1, t2 = epoch_to_dt(1_700_000_100), epoch_to_dt(1_700_000_200)
+    new = PositionDoc("mbta", "veh-1", t2, 42.36, -71.06)
+    old = PositionDoc("mbta", "veh-1", t1, 40.0, -70.0)
+    assert store.upsert_positions([new]) == 1
+    # stale event later: applied count 0, stored doc unchanged —
+    # the reference's racey upsert would DuplicateKeyError here
+    # (heatmap_stream.py:219-228, SURVEY.md §2a)
+    assert store.upsert_positions([old]) == 0
+    (got,) = list(store.all_positions())
+    assert got["ts"] == t2 and got["loc"]["coordinates"] == [-71.06, 42.36]
+    # equal-ts replay is also a no-op, not an error
+    assert store.upsert_positions([new]) == 0
+    store.close()
+
+
+def test_store_grid_filter_and_indexes(mongod):
+    store = _mk_store(mongod)
+    ws = epoch_to_dt(1_700_000_000)
+    we = epoch_to_dt(1_700_000_300)
+    store.upsert_tiles(
+        [TileDoc("boston", 7, "87aaa", ws, we, 1, 1.0, 42.0, -71.0, 45),
+         TileDoc("boston", 8, "88bbb", ws, we, 1, 1.0, 42.0, -71.0, 45)])
+    assert [d["cellId"] for d in store.tiles_in_window(ws, grid="h3r7")] == ["87aaa"]
+    # index DDL reached the server (README.md:139-150 contract)
+    idx = mongod.state.indexes[("mobility", "positions_latest")]
+    assert any(i.get("unique") for i in idx)
+    idx = mongod.state.indexes[("mobility", "tiles")]
+    assert any(i.get("expireAfterSeconds") == 0 for i in idx)
+    store.close()
+
+
+def test_runtime_end_to_end_through_wire(mongod, tmp_path):
+    """Full pipeline: synthetic events → device aggregation → MongoStore over
+    OP_MSG → serve-layer reads (SURVEY.md §4(c) seam at the wire level)."""
+    from heatmap_tpu.config import load_config
+    from heatmap_tpu.serve.api import tiles_feature_collection
+    from heatmap_tpu.stream import MicroBatchRuntime, SyntheticSource
+
+    cfg = load_config({}, batch_size=1 << 10,
+                      checkpoint_dir=str(tmp_path / "ckpt"))
+    store = _mk_store(mongod)
+    src = SyntheticSource(n_events=4096, n_vehicles=64,
+                          t0=1_700_000_000, events_per_second=1 << 10)
+    rt = MicroBatchRuntime(cfg, src, store)
+    rt.run()
+    fc = tiles_feature_collection(store)
+    assert fc["type"] == "FeatureCollection" and len(fc["features"]) > 0
+    f = fc["features"][0]
+    assert f["geometry"]["type"] == "Polygon"
+    assert set(f["properties"]) >= {"cellId", "count", "avgSpeedKmh",
+                                    "windowStart", "windowEnd"}
+    store.close()
