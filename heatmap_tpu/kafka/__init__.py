@@ -1,0 +1,27 @@
+"""kafka — native Kafka wire-protocol client (no librdkafka, no kafka-python).
+
+The reference rides external Kafka clients: kafka-python in the producer
+(mbta_to_kafka.py:33-39) and Spark's spark-sql-kafka connector in the
+consumer (heatmap_stream.py:79-86; README.md:131-133).  Neither exists in
+this image, and SURVEY.md §2b calls for an in-framework consumer feeding
+host buffers.  This package implements the Kafka binary protocol directly
+over stdlib sockets:
+
+- ``protocol`` — primitive codecs + request/response framing
+- ``records``  — RecordBatch v2 encode/decode with CRC32C
+- ``client``   — broker client: metadata, produce, fetch, list_offsets,
+                 with per-partition leader routing
+
+Design choice: **no consumer groups.**  The reference's offsets live in the
+Spark checkpoint, not the broker (README.md:214-215); this framework keeps
+the same ownership — per-partition offsets are committed through
+``stream.checkpoint``, so JoinGroup/SyncGroup/OffsetCommit are never
+needed and replay after crash is exact.
+"""
+
+from heatmap_tpu.kafka.client import (  # noqa: F401
+    BrokerClient, FetchResult, KafkaClient, KafkaError,
+)
+from heatmap_tpu.kafka.records import (  # noqa: F401
+    Record, decode_batches, decode_batches_tolerant, encode_batch,
+)

@@ -1,0 +1,366 @@
+"""Kafka broker client: metadata, produce, fetch, list_offsets.
+
+``BrokerClient`` is one TCP connection to one broker.  ``KafkaClient``
+adds cluster awareness: it bootstraps metadata, routes produce/fetch to
+each partition's leader, and refreshes + retries once on leadership
+errors (NOT_LEADER_OR_FOLLOWER / LEADER_NOT_AVAILABLE / UNKNOWN_TOPIC).
+
+API versions are pinned to non-flexible encodings (kafka/protocol.py);
+``BrokerClient`` verifies the broker still serves them via ApiVersions.
+Offsets are the caller's responsibility (framework checkpoint ownership,
+see package docstring).
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import struct
+import threading
+import time
+import typing
+from heatmap_tpu.kafka import records as rec
+from heatmap_tpu.kafka.protocol import (
+    API_FETCH, API_LIST_OFFSETS, API_METADATA, API_PRODUCE, API_VERSIONS,
+    ERRORS, Reader, Writer, frame_request, read_frame,
+)
+
+_corr = itertools.count(1)
+
+# version pins (non-flexible encodings)
+_VERSIONS = {API_PRODUCE: 3, API_FETCH: 4, API_LIST_OFFSETS: 1,
+             API_METADATA: 1, API_VERSIONS: 0}
+
+EARLIEST = -2
+LATEST = -1
+
+
+def murmur2(data: bytes) -> int:
+    """Kafka's murmur2 (the Java client's default partitioner hash), so
+    keys produced here land on the same partitions any stock client uses."""
+    mask = 0xFFFFFFFF
+    m, r = 0x5BD1E995, 24
+    h = (0x9747B28C ^ len(data)) & mask
+    i = 0
+    while len(data) - i >= 4:
+        k = int.from_bytes(data[i:i + 4], "little")
+        k = (k * m) & mask
+        k ^= k >> r
+        k = (k * m) & mask
+        h = (h * m) & mask
+        h ^= k
+        i += 4
+    rem = len(data) - i
+    if rem >= 3:
+        h ^= data[i + 2] << 16
+    if rem >= 2:
+        h ^= data[i + 1] << 8
+    if rem >= 1:
+        h ^= data[i]
+        h = (h * m) & mask
+    h ^= h >> 13
+    h = (h * m) & mask
+    h ^= h >> 15
+    return h
+
+
+def partition_for_key(key: bytes, n_partitions: int) -> int:
+    return (murmur2(key) & 0x7FFFFFFF) % n_partitions
+
+
+class KafkaError(RuntimeError):
+    def __init__(self, code: int, where: str):
+        super().__init__(f"{where}: {ERRORS.get(code, code)} ({code})")
+        self.code = code
+
+
+_RETRIABLE = {3, 5, 6}  # unknown topic/partition, leader not available/moved
+
+
+class FetchResult(typing.NamedTuple):
+    """``next_offset`` is where the next fetch should resume: past every
+    decoded record AND past any skipped (corrupt/compressed) batch, so a
+    poisoned batch or a tail tombstone can never wedge the consumer."""
+
+    high_watermark: int
+    records: list
+    next_offset: int
+    skipped_batches: int
+
+
+class BrokerClient:
+    """One connection, synchronous request/response."""
+
+    def __init__(self, host: str, port: int, client_id: str = "heatmap-tpu",
+                 timeout_s: float = 10.0):
+        self.host, self.port = host, port
+        self.client_id = client_id
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+        self._dead = False
+        self._check_versions()
+
+    def _recv_exact(self, n: int) -> bytes:
+        from heatmap_tpu.utils.netio import recv_exact
+
+        return recv_exact(self._sock, n)
+
+    def request(self, api_key: int, body: bytes) -> Reader:
+        if self._dead:
+            raise ConnectionError("connection poisoned; reconnect")
+        cid = next(_corr)
+        msg = frame_request(api_key, _VERSIONS[api_key], cid,
+                            self.client_id, body)
+        with self._lock:
+            try:
+                self._sock.sendall(msg)
+                got_cid, r = read_frame(self._recv_exact)
+            except OSError:
+                self._dead = True
+                self.close()
+                raise
+        if got_cid != cid:
+            self._dead = True
+            self.close()
+            raise ConnectionError(
+                f"correlation id {got_cid} != {cid} (desynced)")
+        return r
+
+    def _check_versions(self) -> None:
+        r = self.request(API_VERSIONS, b"")
+        err = r.i16()
+        if err:
+            raise KafkaError(err, "ApiVersions")
+        supported = {}
+        for _ in range(r.i32()):
+            k, lo, hi = r.i16(), r.i16(), r.i16()
+            supported[k] = (lo, hi)
+        for k, v in _VERSIONS.items():
+            if k == API_VERSIONS:
+                continue
+            lo, hi = supported.get(k, (0, -1))
+            if not lo <= v <= hi:
+                raise KafkaError(35, f"api {k} v{v} (broker serves {lo}..{hi})")
+
+    # ---- requests ---------------------------------------------------------
+
+    def metadata(self, topics: list[str] | None = None) -> dict:
+        w = Writer()
+        if topics is None:
+            w.i32(-1)
+        else:
+            w.array(topics, w.string)
+        r = self.request(API_METADATA, w.build())
+        brokers = {}
+        for _ in range(r.i32()):
+            node, host, port = r.i32(), r.string(), r.i32()
+            r.string()  # rack
+            brokers[node] = (host, port)
+        r.i32()  # controller id
+        topics_out = {}
+        for _ in range(r.i32()):
+            terr, name = r.i16(), r.string()
+            r.i8()  # is_internal
+            parts = {}
+            for _ in range(r.i32()):
+                perr, pid, leader = r.i16(), r.i32(), r.i32()
+                r.array(r.i32)  # replicas
+                r.array(r.i32)  # isr
+                parts[pid] = {"leader": leader, "error": perr}
+            topics_out[name] = {"error": terr, "partitions": parts}
+        return {"brokers": brokers, "topics": topics_out}
+
+    def list_offsets(self, topic: str, partitions: dict[int, int]) -> dict[int, int]:
+        """partitions: {partition: timestamp(-1 latest / -2 earliest)} →
+        {partition: offset}."""
+        w = Writer()
+        w.i32(-1)  # replica_id
+        w.i32(1)   # one topic
+        w.string(topic)
+        w.i32(len(partitions))
+        for p, ts in partitions.items():
+            w.i32(p).i64(ts)
+        r = self.request(API_LIST_OFFSETS, w.build())
+        out = {}
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                pid, err = r.i32(), r.i16()
+                r.i64()  # timestamp
+                off = r.i64()
+                if err:
+                    raise KafkaError(err, f"ListOffsets {topic}[{pid}]")
+                out[pid] = off
+        return out
+
+    def produce(self, topic: str, partition: int, batch: bytes,
+                acks: int = 1, timeout_ms: int = 10_000) -> int:
+        """Returns the base offset assigned to the batch."""
+        w = Writer()
+        w.string(None)  # transactional_id
+        w.i16(acks).i32(timeout_ms)
+        w.i32(1)
+        w.string(topic)
+        w.i32(1)
+        w.i32(partition)
+        w.bytes_(batch)
+        r = self.request(API_PRODUCE, w.build())
+        base = -1
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                pid, err, base = r.i32(), r.i16(), r.i64()
+                r.i64()  # log_append_time
+                if err:
+                    raise KafkaError(err, f"Produce {topic}[{pid}]")
+        return base
+
+    def fetch(self, topic: str, partition: int, offset: int,
+              max_bytes: int = 1 << 20, max_wait_ms: int = 100,
+              min_bytes: int = 1) -> tuple[int, bytes]:
+        """(high_watermark, raw records blob)."""
+        w = Writer()
+        w.i32(-1)                       # replica_id
+        w.i32(max_wait_ms).i32(min_bytes).i32(max_bytes)
+        w.i8(0)                         # isolation: read_uncommitted
+        w.i32(1)
+        w.string(topic)
+        w.i32(1)
+        w.i32(partition).i64(offset).i32(max_bytes)
+        r = self.request(API_FETCH, w.build())
+        r.i32()  # throttle
+        hw, blob = 0, b""
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                pid, err = r.i32(), r.i16()
+                hw = r.i64()
+                r.i64()       # last_stable_offset
+                r.array(lambda: (r.i64(), r.i64()))  # aborted txns
+                blob = r.bytes_() or b""
+                if err:
+                    raise KafkaError(err, f"Fetch {topic}[{pid}]")
+        return hw, blob
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _parse_bootstrap(bootstrap: str) -> list[tuple[str, int]]:
+    out = []
+    for hp in bootstrap.split(","):
+        hp = hp.strip()
+        if not hp:
+            continue
+        host, sep, port = hp.rpartition(":")
+        if sep and port.isdigit():
+            out.append((host or "localhost", int(port)))
+        else:
+            out.append((hp, 9092))  # bare hostname: Kafka default port
+    return out
+
+
+class KafkaClient:
+    """Cluster-aware client: leader routing + one metadata-refresh retry."""
+
+    def __init__(self, bootstrap: str, client_id: str = "heatmap-tpu",
+                 timeout_s: float = 10.0):
+        self.client_id = client_id
+        self.timeout_s = timeout_s
+        self._bootstrap = _parse_bootstrap(bootstrap)
+        self._conns: dict[tuple[str, int], BrokerClient] = {}
+        self._leaders: dict[tuple[str, int], tuple[str, int]] = {}
+        self._bootstrap_conn()  # fail fast when nothing is reachable
+
+    def _connect(self, host: str, port: int) -> BrokerClient:
+        key = (host, port)
+        c = self._conns.get(key)
+        if c is None or c._dead:
+            c = BrokerClient(host, port, self.client_id, self.timeout_s)
+            self._conns[key] = c
+        return c
+
+    def _bootstrap_conn(self) -> BrokerClient:
+        """A live connection to any bootstrap broker; reconnects after the
+        previous one was poisoned (a transient socket error must not kill
+        the client for good)."""
+        last_err: Exception | None = None
+        for host, port in self._bootstrap:
+            try:
+                return self._connect(host, port)
+            except OSError as e:
+                last_err = e
+        raise ConnectionError(f"no bootstrap broker reachable: {last_err}")
+
+    def refresh_metadata(self, topic: str) -> dict[int, tuple[str, int]]:
+        md = self._bootstrap_conn().metadata([topic])
+        t = md["topics"].get(topic)
+        if t is None or t["error"] not in (0, 5):
+            raise KafkaError(t["error"] if t else 3, f"Metadata {topic}")
+        for pid, p in t["partitions"].items():
+            if p["leader"] in md["brokers"]:
+                self._leaders[(topic, pid)] = md["brokers"][p["leader"]]
+        return {pid: self._leaders[(topic, pid)]
+                for pid in t["partitions"]
+                if (topic, pid) in self._leaders}
+
+    def partitions(self, topic: str) -> list[int]:
+        return sorted(self.refresh_metadata(topic))
+
+    def _leader_conn(self, topic: str, partition: int) -> BrokerClient:
+        key = (topic, partition)
+        if key not in self._leaders:
+            self.refresh_metadata(topic)
+        if key not in self._leaders:
+            raise KafkaError(5, f"no leader for {topic}[{partition}]")
+        return self._connect(*self._leaders[key])
+
+    def _with_retry(self, topic: str, partition: int, fn):
+        try:
+            return fn(self._leader_conn(topic, partition))
+        except (KafkaError, ConnectionError, OSError) as e:
+            if isinstance(e, KafkaError) and e.code not in _RETRIABLE:
+                raise
+            time.sleep(0.1)
+            self.refresh_metadata(topic)
+            return fn(self._leader_conn(topic, partition))
+
+    # ---- public ops -------------------------------------------------------
+
+    def produce(self, topic: str, partition: int,
+                records: list[rec.Record], acks: int = 1) -> int:
+        batch = rec.encode_batch(records)
+        return self._with_retry(
+            topic, partition, lambda c: c.produce(topic, partition, batch,
+                                                  acks=acks))
+
+    def fetch(self, topic: str, partition: int, offset: int,
+              max_bytes: int = 1 << 20,
+              max_wait_ms: int = 100) -> "FetchResult":
+        hw, blob = self._with_retry(
+            topic, partition,
+            lambda c: c.fetch(topic, partition, offset, max_bytes,
+                              max_wait_ms))
+        records, next_off, skipped = rec.decode_batches_tolerant(blob, offset)
+        records = [r for r in records if r.offset >= offset]
+        return FetchResult(hw, records, max(next_off, offset), skipped)
+
+    def list_offsets(self, topic: str, timestamp: int = LATEST) -> dict[int, int]:
+        parts = self.partitions(topic)
+        out: dict[int, int] = {}
+        by_leader: dict[tuple[str, int], list[int]] = {}
+        for p in parts:
+            by_leader.setdefault(self._leaders[(topic, p)], []).append(p)
+        for leader, pids in by_leader.items():
+            c = self._connect(*leader)
+            out.update(c.list_offsets(topic, {p: timestamp for p in pids}))
+        return out
+
+    def close(self) -> None:
+        for c in self._conns.values():
+            c.close()
+        self._conns.clear()

@@ -19,14 +19,20 @@ class Pipeline:
 
 
 def _kafka_or_synthetic(cfg: Config) -> Source:
-    """Live pipelines consume the Kafka ingress when a client lib exists
-    (the reference contract); otherwise fall back to synthetic data so the
-    pipeline still runs hermetically."""
+    """Live pipelines consume the Kafka ingress when a broker is reachable
+    (the reference contract; the framework's own wire client needs no
+    client library); otherwise fall back to synthetic data so the pipeline
+    still runs hermetically."""
+    import logging
+
     from heatmap_tpu.stream.source import KafkaSource
 
     try:
         return KafkaSource(cfg.kafka_bootstrap, cfg.kafka_topic)
-    except ImportError:
+    except (ImportError, ConnectionError, OSError, RuntimeError) as e:
+        # RuntimeError covers KafkaError (unknown topic / leaderless)
+        logging.getLogger(__name__).warning(
+            "kafka unreachable (%s); using synthetic source", e)
         return SyntheticSource(n_vehicles=1000, events_per_second=1000)
 
 

@@ -60,47 +60,86 @@ class JsonlPublisher(Publisher):
 class KafkaPublisher(Publisher):
     """Kafka producer keyed by vehicleId (reference: mbta_to_kafka.py:33-39).
 
-    Gated on confluent_kafka or kafka-python being installed."""
+    Uses confluent_kafka when installed; otherwise the framework's own
+    wire-protocol client (heatmap_tpu.kafka) — always available, partitions
+    by murmur2(key) exactly like stock clients.  Set HEATMAP_KAFKA_IMPL to
+    wire | confluent to pin one."""
 
-    def __init__(self, bootstrap: str, topic: str):
+    def __init__(self, bootstrap: str, topic: str, impl: str | None = None):
+        import os
+
         self.topic = topic
-        try:
-            from confluent_kafka import Producer  # type: ignore
-
-            self._p = Producer({"bootstrap.servers": bootstrap})
-            self._mode = "confluent"
-        except ImportError:
+        impl = impl or os.environ.get("HEATMAP_KAFKA_IMPL", "auto")
+        self._mode = "wire"
+        if impl in ("auto", "confluent"):
             try:
-                from kafka import KafkaProducer  # type: ignore
-            except ImportError as e:
-                raise ImportError(
-                    "KafkaPublisher needs confluent_kafka or kafka-python; "
-                    "use JsonlPublisher or MemoryPublisher instead."
-                ) from e
-            self._p = KafkaProducer(
-                bootstrap_servers=bootstrap,
-                value_serializer=lambda v: json.dumps(v).encode("utf-8"),
-                key_serializer=lambda k: k.encode("utf-8"),
-            )
-            self._mode = "kafka-python"
+                from confluent_kafka import Producer  # type: ignore
+
+                self._p = Producer({"bootstrap.servers": bootstrap})
+                self._mode = "confluent"
+            except ImportError:
+                if impl == "confluent":
+                    raise
+        if self._mode == "wire":
+            from heatmap_tpu.kafka import KafkaClient
+
+            self._p = KafkaClient(bootstrap)
+            self._parts: list[int] = []
+            self._pending: dict[int, list] = {}
+            # NOT resolved here: a topic mid-auto-creation would make the
+            # constructor raise and make_publisher permanently downgrade;
+            # publish() resolves lazily and the poll loop retries
+
+    def _ensure_parts(self) -> list[int]:
+        """Partition list, re-queried until the topic has leaders (a topic
+        mid-auto-creation reports none) so keys are never pinned to a
+        guessed partition count."""
+        if not self._parts:
+            self._parts = self._p.partitions(self.topic)
+            if not self._parts:
+                from heatmap_tpu.kafka import KafkaError
+
+                raise KafkaError(5, f"topic {self.topic} has no leaders yet")
+        return self._parts
 
     def publish(self, events: Sequence[dict]) -> None:
-        for e in events:
-            key = str(e.get("vehicleId", ""))
-            if self._mode == "confluent":
-                self._p.produce(self.topic, key=key,
+        if self._mode == "confluent":
+            for e in events:
+                self._p.produce(self.topic, key=str(e.get("vehicleId", "")),
                                 value=json.dumps(e).encode("utf-8"))
-            else:
-                self._p.send(self.topic, key=key, value=e)
+            return
+        from heatmap_tpu.kafka import Record
+        from heatmap_tpu.kafka.client import partition_for_key
+
+        parts = self._ensure_parts()
+        now_ms = int(time.time() * 1000)
+        for e in events:
+            key = str(e.get("vehicleId", "")).encode("utf-8")
+            p = partition_for_key(key, len(parts))
+            self._pending.setdefault(p, []).append(
+                Record(0, now_ms, key, json.dumps(e).encode("utf-8")))
 
     def flush(self) -> None:
         if self._mode == "confluent":
             self._p.flush()
-        else:
-            self._p.flush()
+            return
+        pending, self._pending = self._pending, {}
+        try:
+            for p in list(pending):
+                if pending[p]:
+                    self._p.produce(self.topic, self._parts[p], pending[p])
+                del pending[p]
+        except Exception:
+            # keep undelivered batches for the caller's retry (the poll
+            # loop backs off and re-flushes, reference mbta_to_kafka.py:86-97)
+            for p, recs in pending.items():
+                self._pending.setdefault(p, [])[:0] = recs
+            raise
 
     def close(self) -> None:
         self.flush()
+        if self._mode == "wire":
+            self._p.close()
 
 
 def make_publisher(cfg, kind: str = "auto", path: str | None = None) -> Publisher:
@@ -112,8 +151,9 @@ def make_publisher(cfg, kind: str = "auto", path: str | None = None) -> Publishe
         return KafkaPublisher(cfg.kafka_bootstrap, cfg.kafka_topic)
     try:
         return KafkaPublisher(cfg.kafka_bootstrap, cfg.kafka_topic)
-    except ImportError:
-        log.warning("no kafka client installed; capturing to events.jsonl")
+    except (ImportError, OSError, RuntimeError) as e:
+        # RuntimeError covers KafkaError (topic/leader not available)
+        log.warning("kafka unavailable (%s); capturing to events.jsonl", e)
         return JsonlPublisher(path or "events.jsonl")
 
 

@@ -22,6 +22,11 @@ UTC = dt.timezone.utc
 _EPOCH = dt.datetime(1970, 1, 1, tzinfo=UTC)
 
 
+class Int64(int):
+    """Force int64 encoding (type 0x12) regardless of magnitude — some
+    server fields (e.g. getMore's cursor id) are type-checked as long."""
+
+
 class ObjectId:
     """Opaque 12-byte id (decode-only; the sink always supplies string _ids)."""
 
@@ -53,6 +58,8 @@ def _encode_value(name: bytes, v, out: bytearray) -> None:
         out += b"\x08" + name + b"\x00" + (b"\x01" if v else b"\x00")
     elif isinstance(v, float):
         out += b"\x01" + name + b"\x00" + struct.pack("<d", v)
+    elif isinstance(v, Int64):
+        out += b"\x12" + name + b"\x00" + struct.pack("<q", v)
     elif isinstance(v, int):
         if -(2**31) <= v < 2**31:
             out += b"\x10" + name + b"\x00" + struct.pack("<i", v)
@@ -126,7 +133,7 @@ def _decode_value(t: int, buf: bytes, i: int):
     if t == 0x11:  # timestamp (internal) — surface as int
         return struct.unpack_from("<Q", buf, i)[0], i + 8
     if t == 0x12:
-        return struct.unpack_from("<q", buf, i)[0], i + 8
+        return Int64(struct.unpack_from("<q", buf, i)[0]), i + 8
     raise ValueError(f"unsupported BSON type 0x{t:02x}")
 
 

@@ -75,14 +75,12 @@ class WireClient:
     # ---- framing ----------------------------------------------------------
 
     def _recv_exact(self, n: int) -> bytes:
-        chunks = []
-        while n:
-            b = self._sock.recv(n)
-            if not b:
-                raise WireError("connection closed by server")
-            chunks.append(b)
-            n -= len(b)
-        return b"".join(chunks)
+        from heatmap_tpu.utils.netio import recv_exact
+
+        try:
+            return recv_exact(self._sock, n)
+        except ConnectionError as e:
+            raise WireError(str(e)) from e
 
     def command(self, db: str, doc: dict) -> dict:
         """Round-trip one command document; raises WireError on ok:0.
@@ -154,7 +152,8 @@ class WireClient:
         cursor = reply["cursor"]
         yield from cursor["firstBatch"]
         while cursor["id"]:
-            reply = self.command(db, {"getMore": cursor["id"],
+            # cursor id must encode as int64: mongod type-checks getMore
+            reply = self.command(db, {"getMore": bson.Int64(cursor["id"]),
                                       "collection": coll,
                                       "batchSize": batch_size})
             cursor = reply["cursor"]

@@ -210,28 +210,31 @@ class KafkaSource(Source):
     """Kafka consumer source (the reference's ingress contract,
     mbta_to_kafka.py:33-39 / heatmap_stream.py:79-86).
 
-    Gated: requires either confluent_kafka or kafka-python at runtime;
-    neither ships in the dev image, so construction raises with guidance.
-    Offsets are tracked per partition and committed via the framework
-    checkpoint, not the broker, mirroring the reference's Spark-side offset
-    ownership (README.md:214-215).
+    Default implementation is the framework's own wire-protocol client
+    (heatmap_tpu.kafka) — zero external dependencies; confluent_kafka is
+    preferred when installed (C client).  Set HEATMAP_KAFKA_IMPL to
+    wire | confluent | kafka-python to pin one.  Offsets are tracked per
+    partition and committed via the framework checkpoint, not the broker,
+    mirroring the reference's Spark-side offset ownership
+    (README.md:214-215).
     """
 
-    def __init__(self, bootstrap: str, topic: str, group: str = "heatmap-tpu"):
-        try:
-            from confluent_kafka import Consumer  # type: ignore
-        except ImportError:
+    def __init__(self, bootstrap: str, topic: str, group: str = "heatmap-tpu",
+                 impl: str | None = None):
+        import os
+
+        impl = impl or os.environ.get("HEATMAP_KAFKA_IMPL", "auto")
+        if impl in ("auto", "confluent"):
             try:
-                from kafka import KafkaConsumer  # type: ignore
-            except ImportError as e:
-                raise ImportError(
-                    "KafkaSource needs confluent_kafka or kafka-python; "
-                    "neither is installed. Use the TCP bus producer/source "
-                    "(heatmap_tpu.stream.bus) or JsonlReplaySource instead."
-                ) from e
+                self._impl = _ConfluentImpl(bootstrap, topic, group)
+                return
+            except ImportError:
+                if impl == "confluent":
+                    raise
+        if impl == "kafka-python":
             self._impl = _KafkaPythonImpl(bootstrap, topic)
-        else:
-            self._impl = _ConfluentImpl(bootstrap, topic, group)
+            return
+        self._impl = _WireImpl(bootstrap, topic)
 
     def poll(self, max_events: int):
         return self._impl.poll(max_events)
@@ -319,6 +322,108 @@ class _KafkaPythonImpl:
 
     def seek(self, offset):
         pass  # assigned on rebalance; framework replay covers the gap
+
+    def close(self):
+        self.c.close()
+
+
+class _WireImpl:
+    """Consumer over the framework's own Kafka wire client (no deps).
+
+    Starts at LATEST offsets like the reference (startingOffsets=latest,
+    heatmap_stream.py:84); ``seek`` with a checkpointed {partition: offset}
+    map overrides that on resume.  Round-robins partitions each poll so no
+    partition starves under a small max_events.
+    """
+
+    def __init__(self, bootstrap, topic):
+        import logging
+
+        from heatmap_tpu.kafka import KafkaClient
+
+        self.log = logging.getLogger(__name__)
+        self.c = KafkaClient(bootstrap)
+        self.topic = topic
+        self._offsets: dict[int, int] = {}
+        self._discover()
+        self._rr = 0  # round-robin cursor
+
+    def _discover(self) -> None:
+        """(Re)initialize offsets for newly visible partitions at LATEST.
+        Tolerates a topic mid-auto-creation (empty partition set): poll
+        retries until leaders exist."""
+        from heatmap_tpu.kafka import KafkaError
+        from heatmap_tpu.kafka.client import LATEST
+
+        try:
+            for p, off in self.c.list_offsets(self.topic, LATEST).items():
+                self._offsets.setdefault(p, off)
+        except (KafkaError, ConnectionError, OSError) as e:
+            self.log.warning("kafka partition discovery failed: %s", e)
+
+    def poll(self, max_events):
+        from heatmap_tpu.kafka import KafkaError
+        from heatmap_tpu.kafka.client import EARLIEST
+
+        out = []
+        if not self._offsets:
+            self._discover()
+        parts = sorted(self._offsets)
+        if not parts:
+            return out
+        for k in range(len(parts)):
+            if len(out) >= max_events:
+                break
+            p = parts[(self._rr + k) % len(parts)]
+            try:
+                fr = self.c.fetch(self.topic, p, self._offsets[p],
+                                  max_wait_ms=50)
+            except KafkaError as e:
+                if e.code == 1:  # OFFSET_OUT_OF_RANGE: retention truncated
+                    # past our checkpoint — resume from the log start
+                    try:
+                        earliest = self.c.list_offsets(self.topic, EARLIEST)
+                        self.log.warning(
+                            "offset %d for %s[%d] out of range; resetting "
+                            "to earliest %d", self._offsets[p], self.topic,
+                            p, earliest.get(p, 0))
+                        self._offsets[p] = earliest.get(p, 0)
+                    except (KafkaError, ConnectionError, OSError) as e2:
+                        self.log.warning("offset reset failed: %s", e2)
+                else:
+                    self.log.warning("fetch %s[%d]: %s", self.topic, p, e)
+                continue
+            except (ConnectionError, OSError) as e:
+                self.log.warning("fetch %s[%d]: %s", self.topic, p, e)
+                continue
+            if fr.skipped_batches:
+                self.log.warning("skipped %d undecodable batches on %s[%d]",
+                                 fr.skipped_batches, self.topic, p)
+            taken = 0
+            for r in fr.records:
+                if len(out) >= max_events:
+                    break
+                taken += 1
+                self._offsets[p] = r.offset + 1  # tombstones advance too
+                if r.value is None:
+                    continue
+                try:
+                    out.append(json.loads(r.value))
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    pass  # malformed record: count as dropped downstream
+            if taken == len(fr.records):
+                # consumed everything fetched: also jump past skipped
+                # batches / trailing tombstones
+                self._offsets[p] = max(self._offsets[p], fr.next_offset)
+        self._rr = (self._rr + 1) % max(len(parts), 1)
+        return out
+
+    def offset(self):
+        return dict(self._offsets)
+
+    def seek(self, offset):
+        if offset:
+            self._offsets.update({int(p): int(o) for p, o in offset.items()})
 
     def close(self):
         self.c.close()

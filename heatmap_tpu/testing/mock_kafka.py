@@ -1,0 +1,218 @@
+"""In-process mock Kafka broker speaking the binary protocol over TCP.
+
+Serves exactly the API versions the framework's client pins
+(kafka/protocol.py): ApiVersions v0, Metadata v1, ListOffsets v1,
+Produce v3, Fetch v4.  Partition logs are decoded Records in memory;
+Produce decodes the inbound batch (verifying CRC32C) and Fetch re-encodes
+from the requested offset, so both directions of the record codec are
+exercised against each other.
+
+Topics auto-create on first metadata request with ``num_partitions``
+(default 3, the reference topic's layout, README.md:100-101).
+"""
+
+from __future__ import annotations
+
+import socketserver
+import struct
+import threading
+from typing import Any
+
+from heatmap_tpu.kafka import records as rec
+from heatmap_tpu.kafka.protocol import (
+    API_FETCH, API_LIST_OFFSETS, API_METADATA, API_PRODUCE, API_VERSIONS,
+    Reader, Writer,
+)
+
+
+class _State:
+    def __init__(self, num_partitions: int):
+        self.num_partitions = num_partitions
+        self.topics: dict[str, list[list[rec.Record]]] = {}
+        self.lock = threading.Lock()
+
+    def logs(self, topic: str) -> list[list[rec.Record]]:
+        return self.topics.setdefault(
+            topic, [[] for _ in range(self.num_partitions)])
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def _recv_exact(self, n: int) -> bytes | None:
+        from heatmap_tpu.utils.netio import recv_exact_or_none
+
+        return recv_exact_or_none(self.request, n)
+
+    def handle(self):
+        while True:
+            raw = self._recv_exact(4)
+            if raw is None:
+                return
+            (size,) = struct.unpack(">i", raw)
+            body = self._recv_exact(size)
+            if body is None:
+                return
+            r = Reader(body)
+            api_key, api_version, corr_id = r.i16(), r.i16(), r.i32()
+            r.string()  # client_id
+            st: _State = self.server.state  # type: ignore[attr-defined]
+            with st.lock:
+                out = self._dispatch(st, api_key, api_version, r)
+            payload = struct.pack(">i", corr_id) + out
+            self.request.sendall(struct.pack(">i", len(payload)) + payload)
+
+    def _dispatch(self, st: _State, api_key: int, api_version: int,
+                  r: Reader) -> bytes:
+        if api_key == API_VERSIONS:
+            w = Writer().i16(0)
+            apis = [(API_PRODUCE, 0, 8), (API_FETCH, 0, 11),
+                    (API_LIST_OFFSETS, 0, 5), (API_METADATA, 0, 8),
+                    (API_VERSIONS, 0, 0)]
+            w.i32(len(apis))
+            for k, lo, hi in apis:
+                w.i16(k).i16(lo).i16(hi)
+            return w.build()
+        if api_key == API_METADATA:
+            topics = r.array(r.string)
+            if topics is None:
+                topics = list(st.topics)
+            host, port = self.server.server_address[:2]  # type: ignore
+            w = Writer()
+            w.i32(1)                    # one broker
+            w.i32(0).string(host).i32(port).string(None)
+            w.i32(0)                    # controller id
+            w.i32(len(topics))
+            for t in topics:
+                logs = st.logs(t)
+                w.i16(0).string(t).i8(0)
+                w.i32(len(logs))
+                for pid in range(len(logs)):
+                    w.i16(0).i32(pid).i32(0)
+                    w.array([0], w.i32)  # replicas
+                    w.array([0], w.i32)  # isr
+            return w.build()
+        if api_key == API_LIST_OFFSETS:
+            r.i32()  # replica_id
+            w = Writer()
+            n_topics = r.i32()
+            w.i32(n_topics)
+            for _ in range(n_topics):
+                topic = r.string()
+                logs = st.logs(topic)
+                n_parts = r.i32()
+                w.string(topic)
+                w.i32(n_parts)
+                for _ in range(n_parts):
+                    pid, ts = r.i32(), r.i64()
+                    log = logs[pid] if pid < len(logs) else []
+                    off = 0 if ts == -2 else len(log)
+                    w.i32(pid).i16(0).i64(-1).i64(off)
+            return w.build()
+        if api_key == API_PRODUCE:
+            r.string()  # transactional_id
+            r.i16()     # acks
+            r.i32()     # timeout
+            w = Writer()
+            n_topics = r.i32()
+            w.i32(n_topics)
+            for _ in range(n_topics):
+                topic = r.string()
+                logs = st.logs(topic)
+                n_parts = r.i32()
+                w.string(topic)
+                w.i32(n_parts)
+                for _ in range(n_parts):
+                    pid = r.i32()
+                    blob = r.bytes_() or b""
+                    log = logs[pid]
+                    base = len(log)
+                    try:
+                        batch = rec.decode_batches(blob)
+                        for j, record in enumerate(batch):
+                            log.append(rec.Record(
+                                base + j, record.timestamp_ms,
+                                record.key, record.value, record.headers))
+                        w.i32(pid).i16(0).i64(base).i64(-1)
+                    except ValueError:
+                        w.i32(pid).i16(87).i64(-1).i64(-1)  # INVALID_RECORD
+            return w.build()
+        if api_key == API_FETCH:
+            r.i32()  # replica_id
+            r.i32()  # max_wait
+            r.i32()  # min_bytes
+            max_bytes = r.i32()
+            r.i8()   # isolation
+            w = Writer()
+            w.i32(0)  # throttle
+            n_topics = r.i32()
+            w.i32(n_topics)
+            for _ in range(n_topics):
+                topic = r.string()
+                logs = st.logs(topic)
+                n_parts = r.i32()
+                w.string(topic)
+                w.i32(n_parts)
+                for _ in range(n_parts):
+                    pid, offset = r.i32(), r.i64()
+                    r.i32()  # partition max bytes
+                    log = logs[pid] if pid < len(logs) else []
+                    hw = len(log)
+                    if offset > hw:
+                        w.i32(pid).i16(1).i64(hw).i64(hw)  # OFFSET_OUT_OF_RANGE
+                        w.i32(0)         # aborted txns: empty array
+                        w.bytes_(None)
+                        continue
+                    chunk = log[offset:]
+                    blob = b""
+                    size = 0
+                    # batch per 500 records, stop at max_bytes
+                    for s in range(0, len(chunk), 500):
+                        part = chunk[s:s + 500]
+                        enc = rec.encode_batch(
+                            [rec.Record(i, p.timestamp_ms, p.key, p.value,
+                                        p.headers)
+                             for i, p in enumerate(part)],
+                            base_offset=offset + s)
+                        blob += enc
+                        size += len(enc)
+                        if size >= max_bytes:
+                            break
+                    w.i32(pid).i16(0).i64(hw).i64(hw)
+                    w.i32(0)             # aborted txns
+                    w.bytes_(blob if blob else None)
+            return w.build()
+        return Writer().i16(35).build()  # UNSUPPORTED_VERSION fallback
+
+
+class MockKafkaBroker:
+    """``with MockKafkaBroker() as bootstrap: KafkaClient(bootstrap)``"""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 num_partitions: int = 3):
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+        self._server.state = _State(num_partitions)  # type: ignore
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def state(self) -> _State:
+        return self._server.state  # type: ignore[attr-defined]
+
+    @property
+    def bootstrap(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"{host}:{port}"
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def __enter__(self) -> str:
+        return self.bootstrap
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -158,14 +158,9 @@ class _State:
 
 class _Handler(socketserver.BaseRequestHandler):
     def _recv_exact(self, n: int) -> bytes | None:
-        chunks = []
-        while n:
-            b = self.request.recv(n)
-            if not b:
-                return None
-            chunks.append(b)
-            n -= len(b)
-        return b"".join(chunks)
+        from heatmap_tpu.utils.netio import recv_exact_or_none
+
+        return recv_exact_or_none(self.request, n)
 
     def handle(self):
         while True:
@@ -267,6 +262,12 @@ class _Handler(socketserver.BaseRequestHandler):
 
     def _get_more(self, st: _State, cmd: dict) -> dict:
         cid = cmd["getMore"]
+        if not isinstance(cid, bson.Int64):
+            # match the real server's type check so clients that encode the
+            # cursor id as int32 fail here too
+            return {"ok": 0.0, "errmsg":
+                    "BSON field 'getMore.getMore' is the wrong type 'int', "
+                    "expected type 'long'"}
         pending = st.cursors.get(cid, [])
         batch_n = cmd.get("batchSize") or 101
         batch, rest = pending[:batch_n], pending[batch_n:]

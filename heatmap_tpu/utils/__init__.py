@@ -1,0 +1,1 @@
+"""utils — small shared host-side helpers."""

@@ -1,0 +1,30 @@
+"""Shared socket I/O helpers for the wire-protocol clients and mocks.
+
+One definition of the exact-read loop (EINTR-safe via Python's default
+retry semantics; raises ConnectionError on EOF) serves the Mongo client,
+the Kafka client, and both protocol mocks.
+"""
+
+from __future__ import annotations
+
+import socket
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly n bytes or raise ConnectionError on EOF."""
+    chunks = []
+    while n:
+        b = sock.recv(n)
+        if not b:
+            raise ConnectionError("connection closed by peer")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def recv_exact_or_none(sock: socket.socket, n: int) -> bytes | None:
+    """Server-side variant: None on clean EOF (client went away)."""
+    try:
+        return recv_exact(sock, n)
+    except ConnectionError:
+        return None
