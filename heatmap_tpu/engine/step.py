@@ -87,9 +87,24 @@ class StepStats(NamedTuple):
     batch_max_ts: jnp.ndarray  # int32 — max valid event ts (watermark input)
 
 
+def _snap_impl(res: int):
+    """H3 snap implementation: pure-XLA by default; the fused Pallas
+    geometry kernel (hexgrid.pallas_kernel) via HEATMAP_H3_IMPL=pallas.
+    Falls back to XLA when the kernel doesn't apply (res > 10) or doesn't
+    lower on the current backend."""
+    import os
+
+    if os.environ.get("HEATMAP_H3_IMPL", "xla") == "pallas" and res <= 10:
+        from heatmap_tpu.hexgrid import pallas_kernel
+
+        if pallas_kernel.pallas_available():
+            return pallas_kernel.latlng_to_cell_pallas
+    return hexdev.latlng_to_cell_vec
+
+
 def snap_and_window(lat_rad, lng_rad, ts_s, valid, params: AggParams):
     """Compute (key_hi, key_lo, window_start) per event; invalid → EMPTY."""
-    hi, lo = hexdev.latlng_to_cell_vec(lat_rad, lng_rad, params.res)
+    hi, lo = _snap_impl(params.res)(lat_rad, lng_rad, params.res)
     ws = (ts_s // params.window_s) * params.window_s
     hi = jnp.where(valid, hi, EMPTY_KEY_HI)
     lo = jnp.where(valid, lo, EMPTY_KEY_LO)

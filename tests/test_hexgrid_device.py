@@ -111,3 +111,62 @@ def test_res0_and_pentagon_bases(rng):
     got = device.cells_to_uint64(hi, lo)
     bcs = ((got >> np.uint64(45)) & np.uint64(0x7F)).astype(int)
     assert (bcs == np.arange(122)).all()
+
+
+class TestPallasKernel:
+    """Pallas geometry-stage kernel vs the pure-XLA path (interpret mode
+    runs the kernel on CPU; on real TPU the same kernel lowers via Mosaic).
+
+    Equality is near-total rather than bitwise: the two float32 expression
+    trees round differently in the last ulp, so points within ~1e-3 grid
+    units of a cell edge may snap to the adjacent cell (same tolerance
+    class as the documented f32-vs-f64 boundary error)."""
+
+    @staticmethod
+    def _agreement(lat, lng, res):
+        from heatmap_tpu.hexgrid.pallas_kernel import latlng_to_cell_pallas
+
+        hi_p, lo_p = latlng_to_cell_pallas(lat, lng, res, interpret=True)
+        hi_x, lo_x = device.latlng_to_cell_vec(lat, lng, res)
+        same = (np.asarray(hi_p) == np.asarray(hi_x)) & (
+            np.asarray(lo_p) == np.asarray(lo_x))
+        return same.mean()
+
+    def test_matches_xla_path_city(self, rng):
+        n = 5000
+        lat = np.radians(rng.uniform(42.2, 42.5, n)).astype(np.float32)
+        lng = np.radians(rng.uniform(-71.3, -70.8, n)).astype(np.float32)
+        for res in (7, 8, 9):
+            assert self._agreement(lat, lng, res) >= 0.998
+
+    def test_matches_xla_path_global_and_padding(self, rng):
+        # odd size forces internal padding; global points cross faces
+        n = 8192 + 137
+        lat = np.radians(rng.uniform(-89.9, 89.9, n)).astype(np.float32)
+        lng = np.radians(rng.uniform(-180, 180, n)).astype(np.float32)
+        assert self._agreement(lat, lng, 8) >= 0.995
+
+    def test_mismatches_are_edge_neighbors(self, rng):
+        """Disagreeing points must still be within one cell of the f64
+        oracle's answer (i.e. plain boundary jitter, not wrong math)."""
+        from heatmap_tpu.hexgrid.pallas_kernel import latlng_to_cell_pallas
+
+        n = 20_000
+        lat_d = rng.uniform(42.2, 42.5, n)
+        lng_d = rng.uniform(-71.3, -70.8, n)
+        lat = np.radians(lat_d).astype(np.float32)
+        lng = np.radians(lng_d).astype(np.float32)
+        hi_p, lo_p = latlng_to_cell_pallas(lat, lng, 8, interpret=True)
+        cells = device.cells_to_uint64(hi_p, lo_p)
+        for idx in range(0, n, 997):  # sample
+            want = host.latlng_to_cell_int(float(lat[idx]), float(lng[idx]), 8)
+            got = int(cells[idx])
+            if got != want:
+                # must be an adjacent cell: same parent or neighboring
+                # centers within ~2 cell radii (res-8 hex edge ~ 530 m)
+                glat, glng = host.cell_to_latlng(got)
+                wlat, wlng = host.cell_to_latlng(want)
+                dist_m = 111_000 * float(np.hypot(glat - wlat,
+                                                  (glng - wlng) *
+                                                  np.cos(np.radians(glat))))
+                assert dist_m < 1200, (idx, hex(got), hex(want), dist_m)
