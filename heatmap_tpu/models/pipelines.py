@@ -46,7 +46,9 @@ PIPELINES: dict[str, Pipeline] = {}
 
 
 def _register(name, description, make_source, **cfg_overrides):
-    cfg = load_config({}, **cfg_overrides)
+    # env (MONGO_URI, KAFKA_BOOTSTRAP, ...) applies like the reference's
+    # import-time reads; the preset's own axes (res/windows/...) win on top
+    cfg = load_config(None, **cfg_overrides)
     PIPELINES[name] = Pipeline(name, description, cfg, make_source)
 
 
@@ -55,7 +57,10 @@ _register(
     "mbta_default",
     "MBTA Boston feed, H3_RES=8, TILE_MINUTES=5 (reference defaults)",
     _kafka_or_synthetic,
-    city="bos", h3_res=8, resolutions=(8,), windows_minutes=(5,),
+    # nothing pinned but the city: this is the "reference defaults"
+    # preset, so H3_RES / TILE_MINUTES / etc. flow from env exactly as
+    # they do in the reference (load_config derives the tuple axes)
+    city="bos",
 )
 
 # 2. OpenSky global aircraft (BASELINE config #2)
@@ -64,6 +69,7 @@ _register(
     "OpenSky global aircraft, H3_RES=7, 5-min window",
     _kafka_or_synthetic,
     city="global", h3_res=7, resolutions=(7,), windows_minutes=(5,),
+    tile_minutes=5,
     state_capacity_log2=19,   # global cardinality
 )
 
@@ -73,6 +79,7 @@ _register(
     "Synthetic replay: 10M-event single-city backfill, H3_RES=9",
     _synthetic_backfill,
     city="bos", h3_res=9, resolutions=(9,), windows_minutes=(5,),
+    tile_minutes=5,
     batch_size=1 << 19, state_capacity_log2=20,
 )
 
@@ -82,6 +89,7 @@ _register(
     "Merged MBTA+OpenSky, multi-resolution 7/8/9 hex pyramid",
     _kafka_or_synthetic,
     city="bos", h3_res=8, resolutions=(7, 8, 9), windows_minutes=(5,),
+    tile_minutes=5,
 )
 
 # 5. sliding multi-window with extended stats (BASELINE config #5)
@@ -90,6 +98,7 @@ _register(
     "Sliding multi-window (1/5/15-min), count + avgSpeed + p95-speed stats",
     _kafka_or_synthetic,
     city="bos", h3_res=8, resolutions=(8,), windows_minutes=(1, 5, 15),
+    tile_minutes=5,  # the 5-min window keeps the reference grid/_id naming
     speed_hist_bins=64,
 )
 

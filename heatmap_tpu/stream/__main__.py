@@ -1,0 +1,43 @@
+"""Standalone streaming job: ``python -m heatmap_tpu.stream [pipeline]``.
+
+The counterpart of the reference's ``spark-submit heatmap_stream.py``
+(reference: heatmap_stream.py:241-249): consume the configured source,
+aggregate on device, upsert the store, checkpoint, repeat until
+interrupted.  ``pipeline`` is one of heatmap_tpu.models.pipelines (default
+``mbta_default``); env config is the same flat set the reference reads.
+"""
+
+import argparse
+import logging
+
+from heatmap_tpu.models.pipelines import PIPELINES, get_pipeline
+from heatmap_tpu.sink import make_store
+from heatmap_tpu.stream import MicroBatchRuntime
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("pipeline", nargs="?", default="mbta_default",
+                    choices=sorted(PIPELINES))
+    ap.add_argument("--max-batches", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    p = get_pipeline(args.pipeline)
+    store = make_store(p.config)
+    src = p.make_source(p.config)
+    rt = MicroBatchRuntime(p.config, src, store)
+    log = logging.getLogger("stream")
+    log.info("pipeline %s: %s", p.name, p.description)
+    try:
+        # run() checkpoints and closes the runtime in its own finally
+        rt.run(max_batches=args.max_batches)
+    except KeyboardInterrupt:
+        log.info("interrupted; shutting down")
+    finally:
+        store.close()
+
+
+if __name__ == "__main__":
+    main()
