@@ -5,6 +5,11 @@ The reference's foreachBatch writes block the driver between micro-batches
 while batch N's docs are upserted; the runtime's checkpoint commit waits on
 ``drain()`` so offsets only advance past durably-written batches
 (SURVEY.md §7 hard part #5).
+
+Transient sink failures are retried with backoff before the writer poisons
+(the reference's producer survives API hiccups the same way,
+mbta_to_kafka.py:86-97); every store write is an idempotent upsert, so a
+retry after a half-applied bulk is safe.
 """
 
 from __future__ import annotations
@@ -12,6 +17,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from typing import Sequence
 
 from heatmap_tpu.sink.base import Store
@@ -20,15 +26,38 @@ log = logging.getLogger(__name__)
 
 
 class AsyncWriter:
-    def __init__(self, store: Store, max_queue: int = 64):
+    def __init__(self, store: Store, max_queue: int = 64,
+                 retries: int = 3, backoff_s: float = 0.2):
         self.store = store
+        self.retries = retries
+        self.backoff_s = backoff_s
         self._q: queue.Queue = queue.Queue(maxsize=max_queue)
         self._exc: BaseException | None = None
         self._written_tiles = 0
         self._written_positions = 0
+        self._retried = 0
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="sink-writer")
         self._thread.start()
+
+    def _apply(self, kind: str, docs) -> int:
+        """One write with bounded retry (idempotent upserts → safe)."""
+        delay = self.backoff_s
+        for attempt in range(self.retries + 1):
+            try:
+                if kind == "tiles":
+                    return self.store.upsert_tiles(docs)
+                return self.store.upsert_positions(docs)
+            except Exception:
+                if attempt == self.retries:
+                    raise
+                self._retried += 1
+                log.warning("sink write failed (attempt %d/%d); retrying "
+                            "in %.1fs", attempt + 1, self.retries, delay,
+                            exc_info=True)
+                time.sleep(delay)
+                delay *= 4
+        raise AssertionError("unreachable")
 
     def _run(self) -> None:
         while True:
@@ -37,12 +66,15 @@ class AsyncWriter:
                 if item is None:
                     return
                 kind, docs = item
-                if kind == "tiles":
-                    self._written_tiles += self.store.upsert_tiles(docs)
-                else:
-                    self._written_positions += self.store.upsert_positions(docs)
+                if self._exc is None:
+                    n = self._apply(kind, docs)
+                    if kind == "tiles":
+                        self._written_tiles += n
+                    else:
+                        self._written_positions += n
             except BaseException as e:  # poisons the writer permanently
-                log.exception("sink write failed")
+                log.exception("sink write failed after %d retries",
+                              self.retries)
                 self._exc = e
             finally:
                 self._q.task_done()
@@ -83,4 +115,5 @@ class AsyncWriter:
     @property
     def counters(self) -> dict:
         return {"tiles_written": self._written_tiles,
-                "positions_written": self._written_positions}
+                "positions_written": self._written_positions,
+                "sink_retries": self._retried}

@@ -35,6 +35,7 @@ from heatmap_tpu.stream.checkpoint import CheckpointManager
 from heatmap_tpu.stream.events import EventColumns, parse_events
 from heatmap_tpu.stream.metrics import Metrics
 from heatmap_tpu.stream.source import Source
+from heatmap_tpu.stream.trace import Tracer
 
 log = logging.getLogger(__name__)
 
@@ -73,6 +74,7 @@ class MicroBatchRuntime:
         self.store = store
         self.writer = AsyncWriter(store)
         self.metrics = Metrics()
+        self.tracer = Tracer()
         self.positions_enabled = positions_enabled
         self.checkpoint_every = checkpoint_every
         self.ckpt = CheckpointManager(cfg.checkpoint_dir)
@@ -273,6 +275,10 @@ class MicroBatchRuntime:
     # ------------------------------------------------------------------
     def step_once(self) -> bool:
         """Run one micro-batch; returns False when the source yielded nothing."""
+        with self.tracer.batch(self.epoch):
+            return self._step_once_inner()
+
+    def _step_once_inner(self) -> bool:
         t0 = time.monotonic()
         polled = self.source.poll(self.cfg.batch_size)
         t_poll = time.monotonic()
@@ -385,6 +391,7 @@ class MicroBatchRuntime:
             self.close()
 
     def close(self) -> None:
+        self.tracer.stop()  # flush a partial profiler capture, if any
         try:
             if not self.writer.poisoned:
                 self._checkpoint()

@@ -1,0 +1,96 @@
+"""Profiling hooks (SURVEY.md §5.1: the reference has none; the BASELINE
+metric is p50 micro-batch latency, so the hot loop must be traceable).
+
+Two layers:
+
+- wall-clock spans per batch (poll / build / device / sink_submit) feed
+  ``stream.metrics`` and surface at /metrics — always on, nanosecond-cheap.
+- a ``jax.profiler`` device trace, enabled by env: set
+  ``HEATMAP_PROFILE_DIR=/tmp/trace`` to capture
+  ``HEATMAP_PROFILE_BATCHES`` (default 16) batches starting at
+  ``HEATMAP_PROFILE_SKIP`` (default 2, skipping compile batches).  The
+  capture is viewable in TensorBoard / Perfetto; each batch is wrapped in
+  a ``StepTraceAnnotation`` so device ops group by micro-batch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+
+class Tracer:
+    """Env-gated jax.profiler trace over a window of micro-batches."""
+
+    def __init__(self, env=None):
+        e = os.environ if env is None else env
+        self.dir = e.get("HEATMAP_PROFILE_DIR", "")
+        self.skip, self.batches = 2, 16
+        if self.dir:  # only parse knobs when profiling is requested
+            try:
+                self.skip = int(e.get("HEATMAP_PROFILE_SKIP", self.skip))
+                self.batches = int(e.get("HEATMAP_PROFILE_BATCHES",
+                                         self.batches))
+            except ValueError as err:
+                log.warning("bad profiler env value (%s); using skip=%d "
+                            "batches=%d", err, self.skip, self.batches)
+        self._active = False
+        self._done = bool(not self.dir)
+
+    def batch(self, epoch: int):
+        """Context manager wrapping one micro-batch."""
+        if self._done and not self._active:
+            return contextlib.nullcontext()
+        return self._batch_ctx(epoch)
+
+    @contextlib.contextmanager
+    def _batch_ctx(self, epoch: int):
+        import jax
+
+        if not self._active and not self._done and epoch >= self.skip:
+            try:
+                jax.profiler.start_trace(self.dir)
+                self._active = True
+                self._stop_at = epoch + self.batches
+                log.info("profiler: tracing %d batches -> %s",
+                         self.batches, self.dir)
+            except Exception as e:  # profiler races / unsupported backend
+                log.warning("profiler start failed: %s", e)
+                self._done = True
+        if self._active:
+            try:
+                with jax.profiler.StepTraceAnnotation("microbatch",
+                                                      step_num=epoch):
+                    yield
+            finally:
+                # stop at window end, and on an exception escaping the
+                # batch — a dangling trace would be lost and would block
+                # any later capture in this process
+                if epoch + 1 >= self._stop_at or self._exception_pending():
+                    self.stop()
+        else:
+            yield
+
+    @staticmethod
+    def _exception_pending() -> bool:
+        import sys
+
+        return sys.exc_info()[0] is not None
+
+    def stop(self) -> None:
+        """Flush an in-flight trace (runtime.close() calls this so a short
+        stream still writes its partial capture)."""
+        if not self._active:
+            return
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+            log.info("profiler: trace written to %s", self.dir)
+        except Exception as e:
+            log.warning("profiler stop failed: %s", e)
+        self._active = False
+        self._done = True

@@ -193,7 +193,7 @@ def test_writer_failure_blocks_checkpoint(tmp_path):
         def upsert_tiles(self, docs):
             raise IOError("sink down")
 
-    w = AsyncWriter(FailingStore())
+    w = AsyncWriter(FailingStore(), retries=0)
     w.submit_tiles([{"_id": "x"}])
     with pytest.raises(RuntimeError):
         w.drain()
