@@ -52,6 +52,7 @@ class Config:
     speed_hist_bins: int = 32          # per-cell speed histogram (p95 stats)
     speed_hist_max_kmh: float = 256.0
     num_shards: int = 0                # 0 = use all local devices
+    bucket_factor: float = 2.0         # all_to_all lane skew tolerance
     trigger_ms: int = 0                # 0 = as fast as possible (ref default)
     serve_host: str = "127.0.0.1"
     serve_port: int = 5000
@@ -91,6 +92,7 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         speed_hist_bins=_int(e, "SPEED_HIST_BINS", Config.speed_hist_bins),
         speed_hist_max_kmh=_float(e, "SPEED_HIST_MAX_KMH", Config.speed_hist_max_kmh),
         num_shards=_int(e, "NUM_SHARDS", Config.num_shards),
+        bucket_factor=_float(e, "EXCHANGE_BUCKET_FACTOR", Config.bucket_factor),
         trigger_ms=_int(e, "TRIGGER_MS", Config.trigger_ms),
         serve_host=e.get("SERVE_HOST", Config.serve_host),
         serve_port=_int(e, "SERVE_PORT", Config.serve_port),

@@ -64,3 +64,35 @@ class SingleAggregator:
             jnp.int32(watermark_cutoff),
         )
         return packed, stats
+
+    def emit_to_host(self, emit) -> dict:
+        """Emit leaves as host numpy (API parity with ShardedAggregator)."""
+        import numpy as np
+
+        e = jax.device_get(emit)
+        return {
+            "key_hi": e.key_hi, "key_lo": e.key_lo, "key_ws": e.key_ws,
+            "count": e.count, "sum_speed": e.sum_speed,
+            "sum_speed2": e.sum_speed2, "sum_lat": e.sum_lat,
+            "sum_lon": e.sum_lon, "valid": e.valid,
+            "hist": np.asarray(e.hist) if e.hist.shape[1] else None,
+        }
+
+    # --- checkpoint interface (runtime._checkpoint / _maybe_resume) --------
+
+    def snapshot(self) -> TileState:
+        """Host-side copy of the state slab."""
+        import numpy as np
+
+        return TileState(*[np.asarray(leaf) for leaf in self.state])
+
+    def restore(self, st: TileState) -> None:
+        """Install a snapshot (shape-checked; raises on config mismatch)."""
+        self._check_restore_shapes(st)
+        self.state = TileState(*st)
+
+    def _check_restore_shapes(self, st: TileState) -> None:
+        want = (self.state.key_hi.shape, self.state.hist.shape)
+        got = (st.key_hi.shape, st.hist.shape)
+        if want != got:
+            raise ValueError(f"state shape {got} != configured {want}")

@@ -11,6 +11,7 @@ collective, and folds the received events into its local sorted state slab
 need no cross-shard dedup, and scalar stats ride a ``psum``/``pmax``.
 """
 
+from heatmap_tpu.parallel import multihost  # noqa: F401
 from heatmap_tpu.parallel.sharded import (  # noqa: F401
     ShardedAggregator,
     ShardStats,

@@ -1,0 +1,87 @@
+"""Multi-host execution: DCN-coordinated processes, ICI-sharded compute.
+
+The reference scales by adding Kafka partitions and Spark executors over
+host networking (SURVEY.md §2c); the TPU-native equivalent is SPMD over a
+global device mesh: every host runs this same program, JAX's distributed
+runtime (DCN) coordinates compilation, and the aggregation's all_to_all
+rides ICI between chips.  Host networking carries only Kafka-in and
+Mongo-out, exactly as §5.8 prescribes.
+
+Usage (same program on every host):
+
+    from heatmap_tpu.parallel import make_mesh, multihost
+    multihost.init_from_env()          # no-op single-host
+    mesh = make_mesh()                 # process-major over global devices
+    agg = ShardedAggregator(mesh, ...) # put_global feeds local slices
+
+Each host polls its own Kafka partitions and contributes
+``batch_size / process_count`` events per step via
+``put_global``; emitted tile rows come back through
+``addressable_rows`` so each host upserts only the shards it owns —
+the sink work parallelizes across hosts with no extra communication.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+
+log = logging.getLogger(__name__)
+
+
+def init_from_env(env=None) -> bool:
+    """Initialize jax.distributed from env; returns True when multi-host.
+
+    Reads ``HEATMAP_COORDINATOR`` (host:port), ``HEATMAP_NUM_PROCESSES``
+    and ``HEATMAP_PROCESS_ID``; falls back to JAX's own auto-detection
+    (TPU pod metadata, SLURM, ...) when only the coordinator is set.  With
+    none of them set this is a single-host run and a no-op.
+    """
+    e = os.environ if env is None else env
+    coord = e.get("HEATMAP_COORDINATOR", "")
+    nproc = e.get("HEATMAP_NUM_PROCESSES", "")
+    pid = e.get("HEATMAP_PROCESS_ID", "")
+    if not coord:
+        return jax.process_count() > 1
+    kwargs: dict = {"coordinator_address": coord}
+    if nproc:
+        kwargs["num_processes"] = int(nproc)
+    if pid:
+        kwargs["process_id"] = int(pid)
+    jax.distributed.initialize(**kwargs)
+    log.info("distributed: process %d/%d, %d global devices",
+             jax.process_index(), jax.process_count(),
+             len(jax.devices()))
+    return True
+
+
+def put_global(sharding: NamedSharding, local: np.ndarray):
+    """Build the global sharded array for this step from this process's
+    local slice (single-host: a plain device_put of the whole batch)."""
+    if jax.process_count() == 1:
+        return jax.device_put(local, sharding)
+    return jax.make_array_from_process_local_data(sharding, local)
+
+
+def global_batch_to_local(batch_size: int) -> int:
+    """Events each process must supply per step (global batch / hosts)."""
+    n = jax.process_count()
+    if batch_size % n:
+        raise ValueError(f"batch_size {batch_size} not divisible by "
+                         f"{n} processes")
+    return batch_size // n
+
+
+def addressable_rows(arr) -> np.ndarray:
+    """Concatenate the shards of a 1-D-sharded global array that live on
+    THIS process (row order follows local shard order).  device_get on a
+    multi-host global array is an error; each host reads — and sinks —
+    only what it owns."""
+    if jax.process_count() == 1:
+        return np.asarray(arr)
+    shards = sorted(arr.addressable_shards, key=lambda s: s.index)
+    return np.concatenate([np.asarray(s.data) for s in shards], axis=0)

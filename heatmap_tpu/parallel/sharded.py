@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from heatmap_tpu.parallel import multihost
 from heatmap_tpu.engine.state import (
     EMPTY_KEY_HI,
     EMPTY_KEY_LO,
@@ -47,8 +48,13 @@ class ShardStats(NamedTuple):
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D shards mesh.  Devices are ordered **process-major** (a no-op on
+    one host): consecutive shard indices stay on the same host first, so
+    the packed all_to_all's heaviest lanes ride intra-host ICI before
+    crossing DCN (multi-host deployment: parallel.multihost)."""
     if devices is None:
         devices = jax.devices()
+    devices = sorted(devices, key=lambda d: (d.process_index, d.id))
     if n_devices:
         devices = devices[:n_devices]
     return Mesh(np.array(devices), (AXIS,))
@@ -207,6 +213,7 @@ class ShardedAggregator:
 
         shard1 = NamedSharding(mesh, P(AXIS))
         shard2 = NamedSharding(mesh, P(AXIS, None))
+        self._state_shardings = (shard1, shard2)
         self.state: TileState = TileState(*[
             jax.device_put(leaf, shard2 if leaf.ndim == 2 else shard1)
             for leaf in init_state(self.n_shards * capacity_per_shard, hist_bins)
@@ -243,12 +250,55 @@ class ShardedAggregator:
         """Fold one global batch; returns (BatchEmit, ShardStats) on device.
 
         Per-shard scalar emit fields (n_emitted/overflowed) come back with a
-        leading (n_shards,) axis.
+        leading (n_shards,) axis.  Multi-host: each process passes its LOCAL
+        slice (batch_size / process_count events, see parallel.multihost)
+        and reads back only its addressable emit shards (emit_to_host).
         """
-        put = lambda x: jax.device_put(jnp.asarray(x), self._in_sharding)
+        put = lambda x: multihost.put_global(self._in_sharding, np.asarray(x))
         self.state, emit, stats = self._step(
             self.state,
             put(lat_rad), put(lng_rad), put(speed), put(ts), put(valid),
             jnp.int32(watermark_cutoff),
         )
         return emit, stats
+
+    @property
+    def local_batch_size(self) -> int:
+        """Events THIS process feeds per step (= batch_size on one host)."""
+        return multihost.global_batch_to_local(self.batch_size)
+
+    def emit_to_host(self, emit: BatchEmit) -> dict:
+        """Emit leaves as host numpy, restricted to this process's shards
+        (each host sinks only the keys it owns; cross-host device_get on a
+        sharded global array is an error)."""
+        rows = {name: multihost.addressable_rows(getattr(emit, name))
+                for name in ("key_hi", "key_lo", "key_ws", "count",
+                             "sum_speed", "sum_speed2", "sum_lat", "sum_lon",
+                             "valid")}
+        hist = multihost.addressable_rows(emit.hist)
+        rows["hist"] = hist if hist.shape[1] else None
+        return rows
+
+    # --- checkpoint interface (runtime._checkpoint / _maybe_resume) --------
+
+    def snapshot(self) -> TileState:
+        """THIS process's rows of the sharded state (per-host checkpoint —
+        hosts restore their own shards; see stream.checkpoint docstring)."""
+        return TileState(*[multihost.addressable_rows(leaf)
+                           for leaf in self.state])
+
+    def restore(self, st: TileState) -> None:
+        shard1, shard2 = self._state_shardings
+        n_local = self.state.key_hi.sharding.addressable_devices
+        want_rows = (self.capacity_per_shard * len(n_local)
+                     if jax.process_count() > 1
+                     else self.n_shards * self.capacity_per_shard)
+        got = (st.key_hi.shape, st.hist.shape)
+        want = ((want_rows,), (want_rows, self.state.hist.shape[1]))
+        if got != want:
+            raise ValueError(f"state shape {got} != configured {want}")
+        self.state = TileState(*[
+            multihost.put_global(shard2 if leaf.ndim == 2 else shard1,
+                                 np.asarray(leaf))
+            for leaf in st
+        ])

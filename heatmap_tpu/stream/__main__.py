@@ -25,9 +25,23 @@ def main(argv=None) -> None:
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(levelname)s %(name)s %(message)s")
     p = get_pipeline(args.pipeline)
+
+    # distributed + multi-device setup: HEATMAP_COORDINATOR et al. start
+    # the cross-host runtime (parallel.multihost); any multi-device
+    # topology gets a sharded mesh
+    import jax
+
+    from heatmap_tpu.parallel import make_mesh, multihost
+
+    multihost.init_from_env()
+    mesh = None
+    n_shards = p.config.num_shards or len(jax.devices())
+    if n_shards > 1 or jax.process_count() > 1:
+        mesh = make_mesh(p.config.num_shards or None)
+
     store = make_store(p.config)
     src = p.make_source(p.config)
-    rt = MicroBatchRuntime(p.config, src, store)
+    rt = MicroBatchRuntime(p.config, src, store, mesh=mesh)
     log = logging.getLogger("stream")
     log.info("pipeline %s: %s", p.name, p.description)
     try:

@@ -39,7 +39,10 @@ class CheckpointManager:
         os.makedirs(directory, exist_ok=True)
         self.latest_path = os.path.join(directory, "LATEST")
 
-    def _commit_dir(self) -> str | None:
+    def _commit_dir(self, epoch: int | None = None) -> str | None:
+        if epoch is not None:
+            path = os.path.join(self.dir, f"commit-{epoch:012d}")
+            return path if os.path.isdir(path) else None
         if not os.path.exists(self.latest_path):
             return None
         with open(self.latest_path, encoding="utf-8") as fh:
@@ -47,16 +50,28 @@ class CheckpointManager:
         path = os.path.join(self.dir, name)
         return path if os.path.isdir(path) else None
 
+    def available_epochs(self) -> list[int]:
+        """Epochs with a complete retained commit dir (ascending)."""
+        out = []
+        for n in sorted(os.listdir(self.dir)) if os.path.isdir(self.dir) else []:
+            if n.startswith("commit-") and not n.endswith(".tmp"):
+                if os.path.exists(os.path.join(self.dir, n, "meta.json")):
+                    out.append(int(n[len("commit-"):]))
+        return out
+
     # --- read -----------------------------------------------------------
-    def load_meta(self) -> dict | None:
-        d = self._commit_dir()
+    def load_meta(self, epoch: int | None = None) -> dict | None:
+        """Latest commit's meta, or a specific retained epoch's (multi-host
+        resume agreement loads the common min epoch — stream.runtime)."""
+        d = self._commit_dir(epoch)
         if d is None:
             return None
         with open(os.path.join(d, "meta.json"), encoding="utf-8") as fh:
             return json.load(fh)
 
-    def load_state(self, res: int, win: int) -> TileState | None:
-        d = self._commit_dir()
+    def load_state(self, res: int, win: int,
+                   epoch: int | None = None) -> TileState | None:
+        d = self._commit_dir(epoch)
         if d is None:
             return None
         path = os.path.join(d, f"state-{res}-{win}.npz")

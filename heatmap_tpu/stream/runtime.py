@@ -59,6 +59,28 @@ def _p95_from_hist(hist_row: np.ndarray, count: int, hist_max: float) -> float:
     return (i + frac) * bin_w
 
 
+def _make_global_pair(mesh):
+    """Cross-host agreement channel: every host contributes a pair of
+    flags, everyone reads the global sums.  This is a collective — hosts
+    must call it at the same point of every step (stream lockstep)."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from heatmap_tpu.parallel.multihost import put_global
+    from heatmap_tpu.parallel.sharded import AXIS
+
+    sharding = NamedSharding(mesh, P(AXIS, None))
+    n_local = sum(1 for d in mesh.devices.ravel()
+                  if d.process_index == jax.process_index())
+    f = jax.jit(lambda x: jnp.sum(x, axis=0))
+
+    def gpair(a: float, b: float) -> np.ndarray:
+        local = np.tile(np.array([[a, b]], np.float32), (n_local, 1))
+        return np.asarray(jax.device_get(f(put_global(sharding, local))))
+
+    return gpair
+
+
 class MicroBatchRuntime:
     def __init__(
         self,
@@ -103,6 +125,7 @@ class MicroBatchRuntime:
                     agg = ShardedAggregator(
                         mesh, params, capacity_per_shard=cap,
                         batch_size=cfg.batch_size, hist_bins=bins,
+                        bucket_factor=cfg.bucket_factor,
                     )
                 else:
                     agg = SingleAggregator(
@@ -110,6 +133,28 @@ class MicroBatchRuntime:
                         hist_bins=bins,
                     )
                 self.aggs[(res, wmin)] = agg
+        # multi-host: each process feeds its share of the global batch and
+        # checkpoints its own shards under a per-process subdirectory
+        # (per-host Kafka partitions → per-host offsets; parallel.multihost)
+        self._feed_batch = cfg.batch_size
+        self._multiproc = jax.process_count() > 1
+        if self._multiproc:
+            from heatmap_tpu.parallel.multihost import global_batch_to_local
+
+            if mesh is None or len(
+                    {d.process_index for d in mesh.devices.ravel()}) < 2:
+                # independent per-host SingleAggregators would upsert
+                # partial counts for the SAME tile _ids (silent clobbering)
+                raise ValueError(
+                    "multi-process run requires a global sharded mesh "
+                    "spanning all processes (parallel.make_mesh after "
+                    "multihost.init_from_env)")
+            self._feed_batch = global_batch_to_local(cfg.batch_size)
+            self.ckpt = CheckpointManager(
+                f"{cfg.checkpoint_dir}/p{jax.process_index()}")
+            self._gpair = _make_global_pair(mesh)
+            self._global_live = 1.0
+
         # the pair whose stats define the batch-level counters
         self._primary = (
             (cfg.h3_res, cfg.tile_minutes)
@@ -121,7 +166,34 @@ class MicroBatchRuntime:
 
     # ------------------------------------------------------------------
     def _maybe_resume(self) -> None:
-        meta = self.ckpt.load_meta()
+        at_epoch: int | None = None
+        if self._multiproc:
+            # hosts may have crashed between each other's commits; agree on
+            # the newest epoch EVERY host retains, or start fresh together.
+            # KEEP_COMMITS=2 covers the <=1-commit divergence the commit
+            # barrier allows.
+            from jax.experimental import multihost_utils
+
+            local = self.ckpt.available_epochs()
+            latest = local[-1] if local else -1
+            common = int(np.min(multihost_utils.process_allgather(
+                np.int64(latest))))
+            if common < 0:
+                if latest >= 0:
+                    log.warning(
+                        "peer host has no checkpoint; discarding local "
+                        "commits (epochs %s) and starting fresh", local)
+                return
+            if common not in local:
+                raise RuntimeError(
+                    f"hosts diverged beyond checkpoint retention: common "
+                    f"epoch {common} not in local commits {local}; clear "
+                    f"{self.cfg.checkpoint_dir} on every host")
+            if common != latest:
+                log.warning("resuming at common epoch %d (local latest %d)",
+                            common, latest)
+            at_epoch = common
+        meta = self.ckpt.load_meta(epoch=at_epoch)
         if not meta:
             return
         log.info("resuming from checkpoint: %s", meta)
@@ -129,29 +201,33 @@ class MicroBatchRuntime:
         self.max_event_ts = meta.get("max_event_ts", I32_MIN)
         self.source.seek(meta.get("offset"))
         for (res, wmin), agg in self.aggs.items():
-            st = self.ckpt.load_state(res, wmin * 60)
+            st = self.ckpt.load_state(res, wmin * 60, epoch=at_epoch)
             if st is None:
                 continue
-            if (st.key_hi.shape != agg.state.key_hi.shape
-                    or st.hist.shape != agg.state.hist.shape):
+            try:
+                agg.restore(TileState(*st))
+            except ValueError as e:
                 # seeking past processed offsets with an unloadable state
                 # would silently lose aggregates — refuse instead
                 raise RuntimeError(
-                    f"checkpoint state for (res={res}, window={wmin}m) has "
-                    f"shape {st.key_hi.shape}/{st.hist.shape} but the config "
-                    f"expects {agg.state.key_hi.shape}/{agg.state.hist.shape}; "
-                    f"restore STATE_CAPACITY_LOG2/SPEED_HIST_BINS or clear "
+                    f"checkpoint state for (res={res}, window={wmin}m) does "
+                    f"not match the config ({e}); restore "
+                    f"STATE_CAPACITY_LOG2/SPEED_HIST_BINS or clear "
                     f"{self.cfg.checkpoint_dir}"
-                )
-            agg.state = TileState(*st)
+                ) from e
 
     def _checkpoint(self) -> None:
+        if self._multiproc:
+            # all hosts reach the commit point (same epoch — epochs advance
+            # in lockstep) before any commits, so retained commits can
+            # never diverge by more than one epoch across hosts
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"heatmap-ckpt-{self.epoch}")
         # commit AFTER the sink writes are durable (idempotent replay window)
         self.writer.drain()
         states = {
-            (res, wmin * 60): TileState(
-                *[np.asarray(leaf) for leaf in agg.state]
-            )
+            (res, wmin * 60): agg.snapshot()
             for (res, wmin), agg in self.aggs.items()
         }
         self.ckpt.commit(self.source.offset(), self.max_event_ts, self.epoch,
@@ -171,7 +247,7 @@ class MicroBatchRuntime:
         return cols if len(cols) else None
 
     def _pad(self, arr: np.ndarray, fill=0):
-        n = self.cfg.batch_size
+        n = self._feed_batch
         if len(arr) == n:
             return arr
         out = np.full((n,), fill, dtype=arr.dtype)
@@ -280,18 +356,28 @@ class MicroBatchRuntime:
 
     def _step_once_inner(self) -> bool:
         t0 = time.monotonic()
-        polled = self.source.poll(self.cfg.batch_size)
+        polled = self.source.poll(self._feed_batch)
         t_poll = time.monotonic()
         cols = self._build_batch(polled)
-        if cols is None:
+        if cols is None and not self._multiproc:
             return False
-        n = len(cols)
-        valid = np.zeros(self.cfg.batch_size, bool)
-        valid[:n] = True
-        lat = self._pad(cols.lat_rad)
-        lng = self._pad(cols.lng_rad)
-        speed = self._pad(cols.speed_kmh)
-        ts = self._pad(cols.ts_s)
+        if cols is None:
+            # multi-host lockstep: peers may have events and are entering
+            # the global collectives this step — participate with an
+            # all-invalid batch (also keeps watermark eviction ticking)
+            n = 0
+            zf = np.zeros(self._feed_batch, np.float32)
+            lat, lng, speed = zf, zf, zf
+            ts = np.zeros(self._feed_batch, np.int32)
+            valid = np.zeros(self._feed_batch, bool)
+        else:
+            n = len(cols)
+            valid = np.zeros(self._feed_batch, bool)
+            valid[:n] = True
+            lat = self._pad(cols.lat_rad)
+            lng = self._pad(cols.lng_rad)
+            speed = self._pad(cols.speed_kmh)
+            ts = self._pad(cols.ts_s)
         t_build = time.monotonic()
 
         cutoff = (
@@ -310,15 +396,10 @@ class MicroBatchRuntime:
                 e = unpack_emit(packed)
             else:
                 emit, stats = agg.step(lat, lng, speed, ts, valid, cutoff)
-                emit, stats = jax.device_get((emit, stats))
-                e = {
-                    "key_hi": emit.key_hi, "key_lo": emit.key_lo,
-                    "key_ws": emit.key_ws, "count": emit.count,
-                    "sum_speed": emit.sum_speed, "sum_speed2": emit.sum_speed2,
-                    "sum_lat": emit.sum_lat, "sum_lon": emit.sum_lon,
-                    "valid": emit.valid,
-                    "hist": emit.hist if emit.hist.shape[1] else None,
-                }
+                # replicated scalars are readable on every host; the emit
+                # leaves are sharded — read only this host's shards
+                stats = jax.device_get(stats)
+                e = agg.emit_to_host(emit)
             docs = self._emit_docs(res, wmin, e)
             self.writer.submit_tiles(docs)
             self.metrics.count("tiles_emitted", len(docs))
@@ -346,7 +427,7 @@ class MicroBatchRuntime:
                                    int(stats.n_late))
         t_device = time.monotonic()
 
-        if self.positions_enabled:
+        if self.positions_enabled and cols is not None:
             pdocs = self._fold_positions(cols)
             self.writer.submit_positions(pdocs)
             self.metrics.count("positions_emitted", len(pdocs))
@@ -364,9 +445,18 @@ class MicroBatchRuntime:
                 "sink_submit": t_end - t_device,
             },
         )
+        progressed = cols is not None
+        if self._multiproc:
+            # fixed-position collective: every host contributes
+            # (had-events, still-live); the summed pair is identical
+            # everywhere, so all hosts take the same run()-loop branch
+            had, live = self._gpair(float(progressed),
+                                    0.0 if self.source.exhausted else 1.0)
+            self._global_live = live
+            progressed = had > 0
         if self.checkpoint_every and self.epoch % self.checkpoint_every == 0:
             self._checkpoint()
-        return True
+        return progressed
 
     def run(self, max_batches: int | None = None) -> None:
         """Drive the loop until the source is exhausted (or forever)."""
@@ -376,9 +466,11 @@ class MicroBatchRuntime:
             while max_batches is None or n < max_batches:
                 t0 = time.monotonic()
                 progressed = self.step_once()
+                done = (self._global_live == 0 if self._multiproc
+                        else self.source.exhausted)
                 if progressed:
                     n += 1
-                elif self.source.exhausted:
+                elif done:
                     break
                 else:
                     time.sleep(0.05)
