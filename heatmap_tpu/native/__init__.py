@@ -83,6 +83,33 @@ def _load():
         return _LIB
 
 
+def maybe_decoder(logger=None) -> "NativeDecoder | None":
+    """A NativeDecoder when the toolchain allows, else None (callers fall
+    back to json.loads).  One place for the probe so sources don't drift."""
+    try:
+        if NativeDecoder.available():
+            return NativeDecoder()
+    except Exception as e:  # pragma: no cover - toolchain-dependent
+        if logger is not None:
+            logger.info("native decoder unavailable (%s)", e)
+    return None
+
+
+def decode_lines(dec: "NativeDecoder", values) -> "object":
+    """Decode an iterable of raw JSON document byte-strings to columns.
+
+    Values are joined with newlines for the line-oriented scanner; raw
+    newline bytes inside a value are JSON-insignificant whitespace outside
+    strings (and invalid JSON inside them), so flattening them to spaces
+    preserves every valid document — a pretty-printed record must not
+    split into dropped fragments."""
+    cleaned = [v.replace(b"\n", b" ").replace(b"\r", b" ")
+               if b"\n" in v or b"\r" in v else v
+               for v in values]
+    cols, _ = dec.decode(b"\n".join(cleaned) + b"\n", final=True)
+    return cols
+
+
 class NativeDecoder:
     """Streaming JSON-lines event decoder with persistent string interning.
 

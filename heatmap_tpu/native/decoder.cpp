@@ -222,6 +222,20 @@ bool parse_iso8601(const char* s, size_t n, double* out) {
     return true;
 }
 
+// Full-string number parse with Python float() semantics: surrounding
+// whitespace allowed, entire remainder must be consumed.
+bool parse_number_string(const char* s, size_t n, double* out) {
+    std::string buf(s, n);  // NUL-terminate for strtod
+    const char* p = buf.c_str();
+    char* end = nullptr;
+    double v = strtod(p, &end);
+    if (end == p) return false;
+    while (*end == ' ' || *end == '\t' || *end == '\r' || *end == '\n') ++end;
+    if (*end != '\0') return false;
+    *out = v;
+    return true;
+}
+
 struct Fields {
     double lat = NAN, lon = NAN, speed = NAN, ts = NAN;
     const char* provider = nullptr; size_t provider_n = 0;
@@ -311,6 +325,17 @@ int64_t dec_decode(void* dv, const char* buf, int64_t len, int64_t cap,
                 } else if (key_is(k, kn, "ts")) {
                     double t;
                     if (parse_iso8601(s, sn, &t)) f.ts = t;
+                } else if (key_is(k, kn, "lat") || key_is(k, kn, "lon") ||
+                           key_is(k, kn, "speedKmh")) {
+                    // string-encoded numerics: the Python path coerces via
+                    // float() (stream/events.py), so "42.36" must parse the
+                    // same here or acceptance becomes toolchain-dependent
+                    double v;
+                    if (parse_number_string(s, sn, &v)) {
+                        if (k[0] == 'l' && k[1] == 'a') f.lat = v;
+                        else if (k[0] == 'l') f.lon = v;
+                        else f.speed = v;
+                    }
                 }
             } else if ((*q >= '0' && *q <= '9') || *q == '-' || *q == '+') {
                 char* numend = nullptr;

@@ -42,6 +42,25 @@ class Source(abc.ABC):
         pass
 
 
+def _decode_raw_values(dec, values: list[bytes]):
+    """Raw JSON document byte-strings -> events: columnar via the C++
+    decoder when available, else per-document json.loads dicts (same
+    drop-on-malformed semantics either way)."""
+    if not values:
+        return []
+    if dec is not None:
+        from heatmap_tpu.native import decode_lines
+
+        return decode_lines(dec, values)
+    out = []
+    for v in values:
+        try:
+            out.append(json.loads(v))
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            pass  # malformed -> dropped (ref: filters)
+    return out
+
+
 class MemorySource(Source):
     """Deque-fed source for hermetic tests (SURVEY.md §4(c))."""
 
@@ -72,19 +91,26 @@ class MemorySource(Source):
 
 
 class JsonlReplaySource(Source):
-    """Replay a JSON-lines event capture; offset = line number."""
+    """Replay a JSON-lines event capture; offset = line number.
+
+    Parsing batches through the C++ decoder (heatmap_tpu.native) when a
+    toolchain exists — the capture-replay path feeds the bench, so the
+    per-line Python parse matters; falls back to json.loads otherwise."""
 
     def __init__(self, path: str, loop: bool = False):
         self.path = path
         self.loop = loop
-        self._fh = open(path, encoding="utf-8")
+        from heatmap_tpu.native import maybe_decoder
+
+        self._fh = open(path, "rb")
         self._line = 0
         self._eof = False
+        self._dec = maybe_decoder()
 
     def poll(self, max_events: int):
-        out = []
+        raw: list[bytes] = []
         wrapped = False
-        while len(out) < max_events:
+        while len(raw) < max_events:
             line = self._fh.readline()
             if not line:
                 if self.loop and not wrapped:
@@ -100,11 +126,8 @@ class JsonlReplaySource(Source):
             line = line.strip()
             if not line:
                 continue
-            try:
-                out.append(json.loads(line))
-            except json.JSONDecodeError:
-                continue  # malformed line -> dropped (ref: filters)
-        return out
+            raw.append(line)
+        return _decode_raw_values(self._dec, raw)
 
     def offset(self):
         return self._line
@@ -347,6 +370,13 @@ class _WireImpl:
         self._offsets: dict[int, int] = {}
         self._discover()
         self._rr = 0  # round-robin cursor
+        # hot path: decode fetched record values to columnar arrays in C++
+        # (heatmap_tpu.native) instead of per-record json.loads — the
+        # per-row-Python cost is the reference's bottleneck #1
+        # (SURVEY.md §3.3); falls back to Python when no toolchain
+        from heatmap_tpu.native import maybe_decoder
+
+        self._dec = maybe_decoder(self.log)
 
     def _discover(self) -> None:
         """(Re)initialize offsets for newly visible partitions at LATEST.
@@ -407,16 +437,13 @@ class _WireImpl:
                 self._offsets[p] = r.offset + 1  # tombstones advance too
                 if r.value is None:
                     continue
-                try:
-                    out.append(json.loads(r.value))
-                except (json.JSONDecodeError, UnicodeDecodeError):
-                    pass  # malformed record: count as dropped downstream
+                out.append(r.value)
             if taken == len(fr.records):
                 # consumed everything fetched: also jump past skipped
                 # batches / trailing tombstones
                 self._offsets[p] = max(self._offsets[p], fr.next_offset)
         self._rr = (self._rr + 1) % max(len(parts), 1)
-        return out
+        return _decode_raw_values(self._dec, out)
 
     def offset(self):
         return dict(self._offsets)

@@ -132,6 +132,25 @@ def _events(n, start=0):
              "ts": 1_700_000_000 + start + i} for i in range(n)]
 
 
+def _drain(src, want: int, polls: int = 10) -> tuple[int, set]:
+    """Poll until `want` events arrive; returns (count, ts set).  Sources
+    may return dict lists or columnar EventColumns (native decode path)."""
+    from heatmap_tpu.stream.events import EventColumns
+
+    n, ts = 0, set()
+    for _ in range(polls):
+        polled = src.poll(64)
+        if isinstance(polled, EventColumns):
+            n += len(polled)
+            ts.update(int(t) for t in polled.ts_s)
+        else:
+            n += len(polled)
+            ts.update(e["ts"] for e in polled)
+        if n >= want:
+            break
+    return n, ts
+
+
 def test_publisher_source_roundtrip(broker):
     from heatmap_tpu.producers.base import KafkaPublisher
     from heatmap_tpu.stream.source import KafkaSource
@@ -141,14 +160,10 @@ def test_publisher_source_roundtrip(broker):
     sent = _events(50)
     pub.publish(sent)
     pub.flush()
-    got = []
-    for _ in range(10):
-        got.extend(src.poll(64))
-        if len(got) >= 50:
-            break
-    assert len(got) == 50
+    n, ts = _drain(src, 50)
+    assert n == 50
     # same canonical events; keying spread them across partitions
-    assert {e["ts"] for e in got} == {e["ts"] for e in sent}
+    assert ts == {e["ts"] for e in sent}
     offs = src.offset()
     assert sum(offs.values()) == 50 and len(offs) == 3
 
@@ -158,12 +173,8 @@ def test_publisher_source_roundtrip(broker):
     pub.flush()
     src2 = KafkaSource(broker.bootstrap, "mobility.positions.v1")
     src2.seek(offs)
-    got2 = []
-    for _ in range(10):
-        got2.extend(src2.poll(64))
-        if len(got2) >= 5:
-            break
-    assert {e["ts"] for e in got2} == {e["ts"] for e in _events(5, start=1000)}
+    n2, ts2 = _drain(src2, 5)
+    assert ts2 == {e["ts"] for e in _events(5, start=1000)}
     pub.close()
     src.close()
     src2.close()

@@ -151,6 +151,34 @@ def test_nul_and_lone_surrogate_names_match_oracle():
     assert names == ["a\x00x", "a\x00y", "\ud800"]
 
 
+def test_string_encoded_numerics_match_oracle():
+    """The Python path coerces "42.36" via float(); the C++ path must
+    accept the same events or acceptance becomes toolchain-dependent."""
+    events = [
+        {"provider": "p", "vehicleId": "s1", "lat": "42.36", "lon": "-71.06",
+         "speedKmh": " 30.5 ", "ts": 1_700_000_000},
+        {"provider": "p", "vehicleId": "s2", "lat": "91.5", "lon": "0",
+         "ts": 1_700_000_000},      # out of range even as a string
+        {"provider": "p", "vehicleId": "s3", "lat": "not-a-number",
+         "lon": "1.0", "ts": 1_700_000_000},   # -> dropped both paths
+    ]
+    assert_matches_oracle(events)
+
+
+def test_decode_lines_tolerates_embedded_newlines():
+    """A pretty-printed (multi-line) JSON value must decode whole, not
+    split into dropped fragments."""
+    from heatmap_tpu.native import decode_lines
+
+    pretty = (b'{\n  "provider": "mbta",\n  "vehicleId": "v1",\n'
+              b'  "lat": 42.3,\n  "lon": -71.05,\n  "ts": 1700000000\n}')
+    compact = (b'{"provider": "mbta", "vehicleId": "v2", "lat": 42.4, '
+               b'"lon": -71.0, "ts": 1700000001}')
+    cols = decode_lines(NativeDecoder(), [pretty, compact])
+    assert len(cols) == 2
+    assert [cols.vehicles[i] for i in cols.vehicle_id] == ["v1", "v2"]
+
+
 def test_cap_limits_output():
     dec = NativeDecoder()
     data = events_bytes(mk(10))
