@@ -98,15 +98,33 @@ def maybe_decoder(logger=None) -> "NativeDecoder | None":
 def decode_lines(dec: "NativeDecoder", values) -> "object":
     """Decode an iterable of raw JSON document byte-strings to columns.
 
-    Values are joined with newlines for the line-oriented scanner; raw
-    newline bytes inside a value are JSON-insignificant whitespace outside
-    strings (and invalid JSON inside them), so flattening them to spaces
-    preserves every valid document — a pretty-printed record must not
-    split into dropped fragments."""
-    cleaned = [v.replace(b"\n", b" ").replace(b"\r", b" ")
-               if b"\n" in v or b"\r" in v else v
-               for v in values]
+    Values are joined with newlines for the line-oriented scanner.  A value
+    containing raw newline bytes (pretty-printed JSON) takes the slow path:
+    json.loads validates it with the exact semantics of the no-toolchain
+    fallback — valid documents are re-serialized compact and batched,
+    invalid ones are dropped and counted (blind newline-flattening would
+    instead ACCEPT documents with a raw 0x0A inside a string, mutating
+    their data, where json.loads rejects them)."""
+    import json
+
+    cleaned = []
+    pre_dropped = 0
+    for v in values:
+        if b"\n" in v or b"\r" in v:
+            try:
+                cleaned.append(json.dumps(json.loads(v)).encode())
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                pre_dropped += 1
+        else:
+            cleaned.append(v)
+    if not cleaned:
+        from heatmap_tpu.stream.events import columns_from_arrays
+
+        cols = columns_from_arrays([], [], [], [])
+        cols.n_dropped = pre_dropped
+        return cols
     cols, _ = dec.decode(b"\n".join(cleaned) + b"\n", final=True)
+    cols.n_dropped += pre_dropped
     return cols
 
 

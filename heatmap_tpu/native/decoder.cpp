@@ -18,10 +18,12 @@
 //
 // Build: g++ -O3 -shared -fPIC decoder.cpp -o _native.so   (no deps)
 
+#include <cctype>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
 #include <cmath>
+#include <locale.h>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -223,15 +225,73 @@ bool parse_iso8601(const char* s, size_t n, double* out) {
 }
 
 // Full-string number parse with Python float() semantics: surrounding
-// whitespace allowed, entire remainder must be consumed.
+// whitespace allowed, optional sign, decimal digits with '_' group
+// separators (between digits only), optional fraction/exponent, and the
+// inf/infinity/nan words.  The grammar is validated BEFORE strtod so C99
+// extensions float() rejects (hex floats) never slip through, and the
+// sanitized buffer is parsed under the C locale (strtod_l) so a host
+// LC_NUMERIC cannot change which events are accepted.
 bool parse_number_string(const char* s, size_t n, double* out) {
-    std::string buf(s, n);  // NUL-terminate for strtod
-    const char* p = buf.c_str();
+    static locale_t c_loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+    size_t i = 0, j = n;
+    auto is_ws = [](char c) {
+        return c == ' ' || c == '\t' || c == '\r' || c == '\n' ||
+               c == '\f' || c == '\v';
+    };
+    while (i < j && is_ws(s[i])) ++i;
+    while (j > i && is_ws(s[j - 1])) --j;
+    if (i >= j) return false;
+    std::string buf;
+    buf.reserve(j - i);
+    size_t k = i;
+    if (s[k] == '+' || s[k] == '-') buf += s[k++];
+    // word forms float() accepts (any case): inf, infinity, nan
+    {
+        std::string w;
+        for (size_t t = k; t < j; ++t)
+            w += (char)tolower((unsigned char)s[t]);
+        if (w == "inf" || w == "infinity") { buf += "inf"; }
+        else if (w == "nan") { buf += "nan"; }
+        else w.clear();
+        if (!buf.empty() && (buf.back() == 'f' || buf.back() == 'n')) {
+            char* end = nullptr;
+            *out = strtod_l(buf.c_str(), &end, c_loc);
+            return end && *end == '\0';
+        }
+    }
+    // digits[_digits]* [. digits[_digits]*] [eE[+-]digits[_digits]*]
+    auto copy_digits = [&](size_t& t) -> bool {
+        bool any = false, prev_digit = false;
+        while (t < j) {
+            char c = s[t];
+            if (c >= '0' && c <= '9') {
+                buf += c; any = prev_digit = true; ++t;
+            } else if (c == '_') {
+                // Python: '_' only BETWEEN digits
+                if (!prev_digit || t + 1 >= j || s[t + 1] < '0' ||
+                    s[t + 1] > '9')
+                    return false;
+                prev_digit = false; ++t;
+            } else break;
+        }
+        return any;
+    };
+    bool int_part = copy_digits(k);
+    bool frac_part = false;
+    if (k < j && s[k] == '.') {
+        buf += '.'; ++k;
+        frac_part = copy_digits(k);
+    }
+    if (!int_part && !frac_part) return false;
+    if (k < j && (s[k] == 'e' || s[k] == 'E')) {
+        buf += 'e'; ++k;
+        if (k < j && (s[k] == '+' || s[k] == '-')) buf += s[k++];
+        if (!copy_digits(k)) return false;
+    }
+    if (k != j) return false;
     char* end = nullptr;
-    double v = strtod(p, &end);
-    if (end == p) return false;
-    while (*end == ' ' || *end == '\t' || *end == '\r' || *end == '\n') ++end;
-    if (*end != '\0') return false;
+    double v = strtod_l(buf.c_str(), &end, c_loc);
+    if (!end || *end != '\0') return false;
     *out = v;
     return true;
 }

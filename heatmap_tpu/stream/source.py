@@ -18,7 +18,9 @@ from typing import Any, Iterable, Sequence
 
 import numpy as np
 
-from heatmap_tpu.stream.events import EventColumns, columns_from_arrays
+from heatmap_tpu.stream.events import (
+    EventColumns, columns_from_arrays, parse_events,
+)
 
 
 class Source(abc.ABC):
@@ -42,10 +44,12 @@ class Source(abc.ABC):
         pass
 
 
-def _decode_raw_values(dec, values: list[bytes]):
-    """Raw JSON document byte-strings -> events: columnar via the C++
-    decoder when available, else per-document json.loads dicts (same
-    drop-on-malformed semantics either way)."""
+def _decode_raw_values(dec, values: list[bytes], intern_p: dict,
+                       intern_v: dict):
+    """Raw JSON document byte-strings -> EventColumns, via the C++ decoder
+    when available, else json.loads + parse_events.  Both paths drop the
+    same documents AND count them in n_dropped, so the events_invalid
+    metric does not depend on whether a toolchain exists."""
     if not values:
         return []
     if dec is not None:
@@ -53,12 +57,15 @@ def _decode_raw_values(dec, values: list[bytes]):
 
         return decode_lines(dec, values)
     out = []
+    malformed = 0
     for v in values:
         try:
             out.append(json.loads(v))
         except (json.JSONDecodeError, UnicodeDecodeError):
-            pass  # malformed -> dropped (ref: filters)
-    return out
+            malformed += 1  # -> dropped (ref: filters)
+    cols = parse_events(out, intern_p, intern_v)
+    cols.n_dropped += malformed
+    return cols
 
 
 class MemorySource(Source):
@@ -106,6 +113,8 @@ class JsonlReplaySource(Source):
         self._line = 0
         self._eof = False
         self._dec = maybe_decoder()
+        self._intern_p: dict = {}
+        self._intern_v: dict = {}
 
     def poll(self, max_events: int):
         raw: list[bytes] = []
@@ -127,7 +136,8 @@ class JsonlReplaySource(Source):
             if not line:
                 continue
             raw.append(line)
-        return _decode_raw_values(self._dec, raw)
+        return _decode_raw_values(self._dec, raw,
+                                  self._intern_p, self._intern_v)
 
     def offset(self):
         return self._line
@@ -377,6 +387,8 @@ class _WireImpl:
         from heatmap_tpu.native import maybe_decoder
 
         self._dec = maybe_decoder(self.log)
+        self._intern_p: dict = {}
+        self._intern_v: dict = {}
 
     def _discover(self) -> None:
         """(Re)initialize offsets for newly visible partitions at LATEST.
@@ -443,7 +455,8 @@ class _WireImpl:
                 # batches / trailing tombstones
                 self._offsets[p] = max(self._offsets[p], fr.next_offset)
         self._rr = (self._rr + 1) % max(len(parts), 1)
-        return _decode_raw_values(self._dec, out)
+        return _decode_raw_values(self._dec, out,
+                                  self._intern_p, self._intern_v)
 
     def offset(self):
         return dict(self._offsets)

@@ -188,6 +188,49 @@ def test_store_grid_filter_and_indexes(mongod):
     store.close()
 
 
+def test_concurrent_monotonic_upserts_race_free(mongod):
+    """The reference's conditional upsert races under concurrency
+    (DuplicateKeyError on the unique index, SURVEY.md §2a).  Hammer the
+    same vehicles from many threads with shuffled timestamps: no errors,
+    and every vehicle converges to its newest position."""
+    import random
+    import threading
+
+    n_threads, n_vehicles, per_thread = 8, 16, 120
+    t_base = 1_700_000_000
+    docs = [PositionDoc("race", f"veh-{v}", epoch_to_dt(t_base + s),
+                        40.0 + s * 1e-4, -70.0)
+            for v in range(n_vehicles) for s in range(n_threads * per_thread)]
+    rng = random.Random(0)
+    rng.shuffle(docs)
+    chunks = [docs[i::n_threads] for i in range(n_threads)]
+    errors = []
+
+    def worker(chunk):
+        store = _mk_store(mongod)  # own connection per thread
+        try:
+            for i in range(0, len(chunk), 50):
+                store.upsert_positions(chunk[i:i + 50])
+        except Exception as e:  # noqa: BLE001 - recorded for the assert
+            errors.append(e)
+        finally:
+            store.close()
+
+    threads = [threading.Thread(target=worker, args=(c,)) for c in chunks]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:2]
+
+    reader = _mk_store(mongod)
+    got = {d["vehicleId"]: d["ts"] for d in reader.all_positions()}
+    newest = epoch_to_dt(t_base + n_threads * per_thread - 1)
+    assert len(got) == n_vehicles
+    assert all(ts == newest for ts in got.values()), got
+    reader.close()
+
+
 def test_runtime_end_to_end_through_wire(mongod, tmp_path):
     """Full pipeline: synthetic events → device aggregation → MongoStore over
     OP_MSG → serve-layer reads (SURVEY.md §4(c) seam at the wire level)."""

@@ -161,6 +161,16 @@ def test_string_encoded_numerics_match_oracle():
          "ts": 1_700_000_000},      # out of range even as a string
         {"provider": "p", "vehicleId": "s3", "lat": "not-a-number",
          "lon": "1.0", "ts": 1_700_000_000},   # -> dropped both paths
+        {"provider": "p", "vehicleId": "s4", "lat": "0x20", "lon": "1.0",
+         "ts": 1_700_000_000},   # C99 hex float: float() rejects -> drop
+        {"provider": "p", "vehicleId": "s5", "lat": "4_2.0", "lon": "1.0",
+         "ts": 1_700_000_000},   # Python underscore literal: accepted, 42.0
+        {"provider": "p", "vehicleId": "s6", "lat": "inf", "lon": "1.0",
+         "ts": 1_700_000_000},   # parses but non-finite -> drop
+        {"provider": "p", "vehicleId": "s7", "lat": "1.0", "lon": "1.0",
+         "speedKmh": "0x20", "ts": 1_700_000_000},  # bad speed -> 0.0, kept
+        {"provider": "p", "vehicleId": "s8", "lat": "-1e1", "lon": "+.5",
+         "ts": 1_700_000_000},   # sign/exponent/bare-fraction forms
     ]
     assert_matches_oracle(events)
 
