@@ -1,0 +1,174 @@
+"""MultiAggregator — every (resolution, window) pair fused into ONE program.
+
+The hex-pyramid and multi-window configs (BASELINE configs #4/#5) need
+3+ concurrent aggregations of the *same* micro-batch.  Driving one
+SingleAggregator per pair costs, per batch, P separate dispatches and P
+separate device->host emit pulls — and re-snaps the batch once per window
+length even though the snap only depends on the resolution.
+
+This class fuses all pairs into a single jitted step:
+
+  * the H3 snap runs once per **unique resolution** (a 3-window config
+    snaps once, not three times);
+  * each pair's ``merge_batch`` fold runs inside the same XLA program, so
+    the per-step dispatch overhead (ruinous on remote-attached chips) is
+    paid once;
+  * the per-pair packed emits are stacked into one (P, E+1, 10) matrix —
+    the whole batch's output crosses the device->host link in ONE pull.
+
+Host API mirrors SingleAggregator per pair via :class:`PairView` (the
+stream runtime checkpoints each (res, window) state independently;
+reference parity: heatmap_stream.py:112-133 run once per configuration).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from heatmap_tpu.engine.state import TileState, init_state
+from heatmap_tpu.engine.step import (
+    AggParams,
+    merge_batch,
+    pack_emit,
+    snap_and_window,
+    window_start,
+)
+
+
+class MultiAggregator:
+    """Fused aggregation over P (resolution, window_s) pairs, one device.
+
+    All pairs share capacity / hist_bins / emit capacity so states and
+    emits stack along a leading pair axis.
+    """
+
+    n_shards = 1
+
+    def __init__(
+        self,
+        pairs: Sequence[tuple[int, int]],   # (res, window_s), unique
+        capacity: int,
+        batch_size: int,
+        emit_capacity: int,
+        hist_bins: int = 0,
+        speed_hist_max: float = 256.0,
+    ):
+        if len(set(pairs)) != len(pairs):
+            raise ValueError(f"duplicate (res, window) pairs: {pairs}")
+        self.pairs = list(pairs)
+        self.capacity_per_shard = capacity
+        self.batch_size = batch_size
+        self.params = [
+            AggParams(res=r, window_s=w, emit_capacity=emit_capacity,
+                      speed_hist_max=speed_hist_max)
+            for r, w in self.pairs
+        ]
+        self.states: list[TileState] = [
+            init_state(capacity, hist_bins) for _ in self.pairs
+        ]
+
+        param_list = self.params
+
+        def _step(states, lat, lng, speed, ts, valid, cutoff):
+            lat_deg = lat * jnp.float32(180.0 / np.pi)
+            lon_deg = lng * jnp.float32(180.0 / np.pi)
+            # one snap per unique resolution, shared across its windows
+            by_res: dict[int, tuple] = {}
+            for p in param_list:
+                if p.res not in by_res:
+                    hi, lo, _ = snap_and_window(lat, lng, ts, valid, p)
+                    by_res[p.res] = (hi, lo)
+            new_states, packs = [], []
+            for p, st in zip(param_list, states):
+                hi, lo = by_res[p.res]
+                ws = window_start(ts, valid, p.window_s)
+                st2, emit, stats = merge_batch(
+                    st, hi, lo, ws, speed, lat_deg, lon_deg, ts, valid,
+                    cutoff, p,
+                )
+                new_states.append(st2)
+                # ride the step stats in the otherwise-unused head-row slots
+                # 2..7 of the packed emit, so the host needs NO second
+                # transfer for them (see stats_from_packed)
+                pk = pack_emit(emit, p.speed_hist_max)
+                svec = jax.lax.bitcast_convert_type(
+                    jnp.stack([stats.n_valid, stats.n_late, stats.n_evicted,
+                               stats.n_active, stats.state_overflow,
+                               stats.batch_max_ts]).astype(jnp.int32),
+                    jnp.uint32,
+                )
+                packs.append(pk.at[0, 2:8].set(svec))
+            return tuple(new_states), jnp.stack(packs)
+
+        self._step = jax.jit(_step, donate_argnums=(0,))
+
+    def step_packed_all(self, lat_rad, lng_rad, speed, ts, valid,
+                        watermark_cutoff):
+        """Fold one batch into every pair's state.
+
+        Returns the packed emits on device: (P, E+1, 10) uint32 — one
+        ``unpack_emit`` row block per pair in ``self.pairs`` order, with
+        that pair's step stats ridden in head-row slots 2..7
+        (``stats_from_packed``).
+        """
+        states, packed = self._step(
+            tuple(self.states),
+            jnp.asarray(lat_rad), jnp.asarray(lng_rad), jnp.asarray(speed),
+            jnp.asarray(ts), jnp.asarray(valid), jnp.int32(watermark_cutoff),
+        )
+        self.states = list(states)
+        return packed
+
+    def view(self, res: int, window_s: int) -> "PairView":
+        return PairView(self, self.pairs.index((res, window_s)))
+
+
+class PairView:
+    """Checkpoint adapter for one pair of a MultiAggregator (SingleAggregator
+    snapshot/restore API)."""
+
+    n_shards = 1
+
+    def __init__(self, multi: MultiAggregator, idx: int):
+        self._multi = multi
+        self._idx = idx
+        self.capacity_per_shard = multi.capacity_per_shard
+
+    @property
+    def state(self) -> TileState:
+        return self._multi.states[self._idx]
+
+    def snapshot(self) -> TileState:
+        return TileState(*[np.asarray(leaf)
+                           for leaf in self._multi.states[self._idx]])
+
+    def restore(self, st: TileState) -> None:
+        cur = self._multi.states[self._idx]
+        want = (cur.key_hi.shape, cur.hist.shape)
+        got = (st.key_hi.shape, st.hist.shape)
+        if want != got:
+            raise ValueError(f"state shape {got} != configured {want}")
+        self._multi.states[self._idx] = TileState(*[jnp.asarray(leaf)
+                                                    for leaf in st])
+
+
+class MultiStats(NamedTuple):
+    """Host-side per-pair stats row (unpacked from the stacked StepStats)."""
+
+    n_valid: int
+    n_late: int
+    n_evicted: int
+    n_active: int
+    state_overflow: int
+    batch_max_ts: int
+
+
+def stats_from_packed(packed_pair: np.ndarray) -> MultiStats:
+    """Decode the StepStats scalars ridden in a pair's packed head row
+    (slots 2..7, written by MultiAggregator's step; avoids a separate
+    stats transfer)."""
+    return MultiStats(*[int(v) for v in packed_pair[0, 2:8].view(np.int32)])

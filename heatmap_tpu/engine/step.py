@@ -102,14 +102,19 @@ def _snap_impl(res: int):
     return hexdev.latlng_to_cell_vec
 
 
+def window_start(ts_s, valid, window_s: int):
+    """Tumbling window start per event; invalid → EMPTY_WS.  The single
+    definition of window assignment (engine.multi shares it)."""
+    ws = (ts_s // window_s) * window_s
+    return jnp.where(valid, ws, EMPTY_WS)
+
+
 def snap_and_window(lat_rad, lng_rad, ts_s, valid, params: AggParams):
     """Compute (key_hi, key_lo, window_start) per event; invalid → EMPTY."""
     hi, lo = _snap_impl(params.res)(lat_rad, lng_rad, params.res)
-    ws = (ts_s // params.window_s) * params.window_s
     hi = jnp.where(valid, hi, EMPTY_KEY_HI)
     lo = jnp.where(valid, lo, EMPTY_KEY_LO)
-    ws = jnp.where(valid, ws, EMPTY_WS)
-    return hi, lo, ws
+    return hi, lo, window_start(ts_s, valid, params.window_s)
 
 
 @functools.partial(jax.jit, static_argnames=("params",))

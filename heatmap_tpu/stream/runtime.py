@@ -25,7 +25,6 @@ import numpy as np
 
 from heatmap_tpu.config import Config
 from heatmap_tpu.engine import AggParams
-from heatmap_tpu.engine.single import SingleAggregator
 from heatmap_tpu.engine.state import TileState
 from heatmap_tpu.engine.step import unpack_emit
 from heatmap_tpu.hexgrid.device import cells_to_uint64
@@ -111,28 +110,41 @@ class MicroBatchRuntime:
         self.aggs: dict[tuple[int, int], object] = {}
         cap = 1 << cfg.state_capacity_log2
         bins = cfg.speed_hist_bins
-        for res in cfg.resolutions:
-            for wmin in cfg.windows_minutes:
-                params = AggParams(
-                    res=res,
-                    window_s=wmin * 60,
-                    emit_capacity=min(cfg.batch_size, cap),
-                    speed_hist_max=cfg.speed_hist_max_kmh,
-                )
-                if mesh is not None and mesh.devices.size > 1:
+        self._multi = None
+        if mesh is not None and mesh.devices.size > 1:
+            for res in cfg.resolutions:
+                for wmin in cfg.windows_minutes:
+                    params = AggParams(
+                        res=res,
+                        window_s=wmin * 60,
+                        emit_capacity=min(cfg.batch_size, cap),
+                        speed_hist_max=cfg.speed_hist_max_kmh,
+                    )
                     from heatmap_tpu.parallel import ShardedAggregator
 
-                    agg = ShardedAggregator(
+                    self.aggs[(res, wmin)] = ShardedAggregator(
                         mesh, params, capacity_per_shard=cap,
                         batch_size=cfg.batch_size, hist_bins=bins,
                         bucket_factor=cfg.bucket_factor,
                     )
-                else:
-                    agg = SingleAggregator(
-                        params, capacity=cap, batch_size=cfg.batch_size,
-                        hist_bins=bins,
-                    )
-                self.aggs[(res, wmin)] = agg
+        else:
+            # single device: ALL pairs fused into one program — one
+            # dispatch and one device->host pull per batch regardless of
+            # how many (res, window) pairs are configured (engine.multi)
+            from heatmap_tpu.engine.multi import MultiAggregator
+
+            # dict-dedupe mirrors the sharded branch's aggs-dict overwrite,
+            # so a config with repeated axes behaves the same on both paths
+            pairs = list(dict.fromkeys(
+                (res, wmin * 60) for res in cfg.resolutions
+                for wmin in cfg.windows_minutes))
+            self._multi = MultiAggregator(
+                pairs, capacity=cap, batch_size=cfg.batch_size,
+                emit_capacity=min(cfg.batch_size, cap), hist_bins=bins,
+                speed_hist_max=cfg.speed_hist_max_kmh,
+            )
+            for res, win_s in pairs:
+                self.aggs[(res, win_s // 60)] = self._multi.view(res, win_s)
         # multi-host: each process feeds its share of the global batch and
         # checkpoints its own shards under a per-process subdirectory
         # (per-host Kafka partitions → per-host offsets; parallel.multihost)
@@ -348,6 +360,37 @@ class MicroBatchRuntime:
             docs.append(PositionDoc(provider, vehicle, epoch_to_dt(ts), la, lo))
         return docs
 
+    def _account_pair(self, res: int, wmin: int, e: dict, stats) -> int:
+        """Sink one pair's emit + book its stats; returns its batch_max_ts.
+
+        ``stats`` is any object with StepStats-named int attributes
+        (device_get'd StepStats/ShardStats or engine.multi.MultiStats)."""
+        docs = self._emit_docs(res, wmin, e)
+        self.writer.submit_tiles(docs)
+        self.metrics.count("tiles_emitted", len(docs))
+        if int(stats.state_overflow) > 0 and not self._overflow_warned:
+            self._overflow_warned = True
+            log.error(
+                "STATE OVERFLOW: %d distinct (cell,window) groups dropped; "
+                "raise STATE_CAPACITY_LOG2 (currently 2^%d per shard)",
+                int(stats.state_overflow), self.cfg.state_capacity_log2,
+            )
+        dropped = int(getattr(stats, "bucket_dropped", 0))
+        if dropped:
+            self.metrics.count("events_bucket_dropped", dropped)
+            log.error(
+                "EXCHANGE OVERFLOW: %d events dropped by all_to_all lane "
+                "skew for (res=%d, window=%dm); raise bucket_factor",
+                dropped, res, wmin,
+            )
+        if (res, wmin) == self._primary:
+            self.metrics.count("events_valid", int(stats.n_valid))
+            self.metrics.count("events_late", int(stats.n_late))
+        else:
+            self.metrics.count(f"events_late_r{res}m{wmin}",
+                               int(stats.n_late))
+        return int(stats.batch_max_ts)
+
     # ------------------------------------------------------------------
     def step_once(self) -> bool:
         """Run one micro-batch; returns False when the source yielded nothing."""
@@ -385,46 +428,32 @@ class MicroBatchRuntime:
             if self.max_event_ts > I32_MIN else I32_MIN
         )
         batch_max = I32_MIN
-        for (res, wmin), agg in self.aggs.items():
-            # packed path: ONE device->host transfer for the whole emit
-            # (per-leaf pulls are ruinous over remote-attached TPUs);
-            # aggregators without step_packed fall back to a pytree get
-            if hasattr(agg, "step_packed"):
-                packed, stats = agg.step_packed(lat, lng, speed, ts, valid,
-                                                cutoff)
-                stats = jax.device_get(stats)
-                e = unpack_emit(packed)
-            else:
+        if self._multi is not None:
+            # fused path: one dispatch for every (res, window) pair, and
+            # ONE device->host pull for all their emits + stats (packed
+            # head rows; engine.multi)
+            from heatmap_tpu.engine.multi import stats_from_packed
+
+            packed_all = self._multi.step_packed_all(
+                lat, lng, speed, ts, valid, cutoff)
+            bufs = np.asarray(packed_all)
+            for idx, (res, win_s) in enumerate(self._multi.pairs):
+                e = unpack_emit(bufs[idx])
+                stats = stats_from_packed(bufs[idx])
+                batch_max = max(
+                    batch_max,
+                    self._account_pair(res, win_s // 60, e, stats),
+                )
+        else:
+            # sharded path (every agg here is a ShardedAggregator)
+            for (res, wmin), agg in self.aggs.items():
                 emit, stats = agg.step(lat, lng, speed, ts, valid, cutoff)
                 # replicated scalars are readable on every host; the emit
                 # leaves are sharded — read only this host's shards
                 stats = jax.device_get(stats)
                 e = agg.emit_to_host(emit)
-            docs = self._emit_docs(res, wmin, e)
-            self.writer.submit_tiles(docs)
-            self.metrics.count("tiles_emitted", len(docs))
-            batch_max = max(batch_max, int(stats.batch_max_ts))
-            if int(stats.state_overflow) > 0 and not self._overflow_warned:
-                self._overflow_warned = True
-                log.error(
-                    "STATE OVERFLOW: %d distinct (cell,window) groups dropped; "
-                    "raise STATE_CAPACITY_LOG2 (currently 2^%d per shard)",
-                    int(stats.state_overflow), self.cfg.state_capacity_log2,
-                )
-            dropped = int(getattr(stats, "bucket_dropped", 0))
-            if dropped:
-                self.metrics.count("events_bucket_dropped", dropped)
-                log.error(
-                    "EXCHANGE OVERFLOW: %d events dropped by all_to_all lane "
-                    "skew for (res=%d, window=%dm); raise bucket_factor",
-                    dropped, res, wmin,
-                )
-            if (res, wmin) == self._primary:
-                self.metrics.count("events_valid", int(stats.n_valid))
-                self.metrics.count("events_late", int(stats.n_late))
-            else:
-                self.metrics.count(f"events_late_r{res}m{wmin}",
-                                   int(stats.n_late))
+                batch_max = max(batch_max,
+                                self._account_pair(res, wmin, e, stats))
         t_device = time.monotonic()
 
         if self.positions_enabled and cols is not None:
