@@ -34,6 +34,8 @@ from heatmap_tpu.engine.step import (
     AggParams,
     merge_batch,
     pack_emit,
+    read_stats_rider,
+    ride_stats,
     snap_and_window,
     window_start,
 )
@@ -91,17 +93,10 @@ class MultiAggregator:
                     cutoff, p,
                 )
                 new_states.append(st2)
-                # ride the step stats in the otherwise-unused head-row slots
-                # 2..7 of the packed emit, so the host needs NO second
-                # transfer for them (see stats_from_packed)
-                pk = pack_emit(emit, p.speed_hist_max)
-                svec = jax.lax.bitcast_convert_type(
-                    jnp.stack([stats.n_valid, stats.n_late, stats.n_evicted,
-                               stats.n_active, stats.state_overflow,
-                               stats.batch_max_ts]).astype(jnp.int32),
-                    jnp.uint32,
-                )
-                packs.append(pk.at[0, 2:8].set(svec))
+                # ride the step stats in the packed head row, so the host
+                # needs NO second transfer for them (see stats_from_packed)
+                packs.append(
+                    ride_stats(pack_emit(emit, p.speed_hist_max), stats))
             return tuple(new_states), jnp.stack(packs)
 
         self._step = jax.jit(_step, donate_argnums=(0,))
@@ -157,7 +152,8 @@ class PairView:
 
 
 class MultiStats(NamedTuple):
-    """Host-side per-pair stats row (unpacked from the stacked StepStats)."""
+    """Host-side StepStats (field order MUST match engine.step.StepStats —
+    the rider is decoded positionally, see step.ride_stats)."""
 
     n_valid: int
     n_late: int
@@ -168,7 +164,6 @@ class MultiStats(NamedTuple):
 
 
 def stats_from_packed(packed_pair: np.ndarray) -> MultiStats:
-    """Decode the StepStats scalars ridden in a pair's packed head row
-    (slots 2..7, written by MultiAggregator's step; avoids a separate
-    stats transfer)."""
-    return MultiStats(*[int(v) for v in packed_pair[0, 2:8].view(np.int32)])
+    """Decode the StepStats ridden in a pair's packed head row (written by
+    MultiAggregator's step; avoids a separate stats transfer)."""
+    return read_stats_rider(packed_pair, MultiStats)

@@ -329,11 +329,13 @@ def pack_emit(emit: BatchEmit, speed_hist_max: float = 256.0) -> jnp.ndarray:
 
     Remote-attached TPUs pay a full round trip per transferred leaf; one
     packed matrix makes the per-batch device->host pull a single transfer.
-    Row 0 carries [n_emitted, overflowed, 0...]; rows 1.. are
-    [key_hi, key_lo, ws, count, sum_speed, sum_speed2, sum_lat, sum_lon,
-    valid, p95] with float lanes bitcast.  The histogram itself stays on
-    device — its p95 summary is computed here.  ``unpack_emit`` reverses
-    it host-side.
+    Row 0 carries [n_emitted, overflowed] in slots 0..1; slots 2.. are
+    reserved for a stats rider (``ride_stats`` — engine.multi and
+    parallel.sharded embed their step stats there so the host needs no
+    second transfer).  Rows 1.. are [key_hi, key_lo, ws, count, sum_speed,
+    sum_speed2, sum_lat, sum_lon, valid, p95] with float lanes bitcast.
+    The histogram itself stays on device — its p95 summary is computed
+    here.  ``unpack_emit`` reverses it host-side.
     """
     bc = lambda a: jax.lax.bitcast_convert_type(a, jnp.uint32)
     E = emit.key_hi.shape[0]
@@ -357,6 +359,37 @@ def pack_emit(emit: BatchEmit, speed_hist_max: float = 256.0) -> jnp.ndarray:
     head = head.at[0, 0].set(emit.n_emitted.reshape(()).astype(jnp.uint32))
     head = head.at[0, 1].set(emit.overflowed.reshape(()).astype(jnp.uint32))
     return jnp.concatenate([head, body], axis=0)
+
+
+_STATS_RIDER_SLOT0 = 2  # first head-row slot available to ride_stats
+
+
+def ride_stats(packed: jnp.ndarray, stats) -> jnp.ndarray:
+    """Embed a NamedTuple of int32 scalars into the packed head row.
+
+    The single definition of the stats-rider layout: fields land in head
+    slots 2..2+len(stats), in field order, bitcast to uint32.  Decode with
+    ``read_stats_rider`` using a host NamedTuple with the SAME fields in
+    the same order.
+    """
+    n = len(stats)
+    if _STATS_RIDER_SLOT0 + n > packed.shape[1]:
+        raise ValueError(f"stats rider of {n} fields does not fit the "
+                         f"{packed.shape[1]}-slot head row")
+    svec = jax.lax.bitcast_convert_type(
+        jnp.stack(list(stats)).astype(jnp.int32), jnp.uint32)
+    return packed.at[0, _STATS_RIDER_SLOT0:_STATS_RIDER_SLOT0 + n].set(svec)
+
+
+def read_stats_rider(packed_np, cls):
+    """Host-side inverse of ``ride_stats``: decode ``cls`` (a NamedTuple
+    type of ints, fields ordered as the device-side stats tuple) from a
+    packed matrix's head row."""
+    import numpy as np
+
+    n = len(cls._fields)
+    raw = np.asarray(packed_np)[0, _STATS_RIDER_SLOT0:_STATS_RIDER_SLOT0 + n]
+    return cls(*[int(v) for v in raw.view(np.int32)])
 
 
 def unpack_emit(packed) -> dict:

@@ -32,7 +32,16 @@ from heatmap_tpu.engine.state import (
     TileState,
     init_state,
 )
-from heatmap_tpu.engine.step import AggParams, BatchEmit, merge_batch, snap_and_window
+from heatmap_tpu.engine.step import (
+    AggParams,
+    BatchEmit,
+    merge_batch,
+    pack_emit,
+    read_stats_rider,
+    ride_stats,
+    snap_and_window,
+    unpack_emit,
+)
 
 AXIS = "shards"
 
@@ -45,6 +54,39 @@ class ShardStats(NamedTuple):
     state_overflow: jnp.ndarray
     batch_max_ts: jnp.ndarray
     bucket_dropped: jnp.ndarray
+
+
+class ShardStatsHost(NamedTuple):
+    """ShardStats decoded from a packed head row (host ints; field order
+    MUST match ShardStats — the rider is decoded positionally, see
+    engine.step.ride_stats)."""
+
+    n_valid: int
+    n_late: int
+    n_evicted: int
+    n_active: int
+    state_overflow: int
+    batch_max_ts: int
+    bucket_dropped: int
+
+
+def unpack_emit_shards(rows: np.ndarray, emit_capacity: int):
+    """Decode one host's packed emit rows (S*(E+1), 10) from
+    ShardedAggregator.step_packed into (emit dict, ShardStatsHost).
+
+    Keys are owned exclusively per shard, so concatenating the blocks'
+    rows never duplicates a group; the stats head fields are psum'd
+    (identical in every block), so block 0's copy is authoritative."""
+    blk = emit_capacity + 1
+    n_blocks = rows.shape[0] // blk
+    blocks = rows.reshape(n_blocks, blk, rows.shape[1])
+    es = [unpack_emit(b) for b in blocks]
+    e = {k: np.concatenate([x[k] for x in es]) for k in
+         ("key_hi", "key_lo", "key_ws", "count", "sum_speed", "sum_speed2",
+          "sum_lat", "sum_lon", "valid", "p95")}
+    e["n_emitted"] = sum(x["n_emitted"] for x in es)
+    e["overflowed"] = any(x["overflowed"] for x in es)
+    return e, read_stats_rider(blocks[0], ShardStatsHost)
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
@@ -165,10 +207,6 @@ def _sharded_step_body(params: AggParams, n_shards: int, cap: int,
         recv["lat_deg"], recv["lon_deg"], recv["ts"], recv["valid"],
         cutoff, params,
     )
-    # per-shard scalars need a rank-1 axis to ride a sharded out_spec
-    emit = emit._replace(
-        n_emitted=emit.n_emitted[None], overflowed=emit.overflowed[None]
-    )
     stats = ShardStats(
         n_valid=jax.lax.psum(st.n_valid, AXIS),
         n_late=jax.lax.psum(n_late_local + st.n_late, AXIS),
@@ -178,7 +216,15 @@ def _sharded_step_body(params: AggParams, n_shards: int, cap: int,
         batch_max_ts=jax.lax.pmax(st.batch_max_ts, AXIS),
         bucket_dropped=jax.lax.psum(n_dropped, AXIS),
     )
-    return new_state, emit, stats
+    # this shard's packed (E+1, 10) emit block with the (replicated,
+    # psum'd) stats ridden in its head row — the host reads the WHOLE
+    # step's output in one addressable pull (engine.step.ride_stats)
+    packed = ride_stats(pack_emit(emit, params.speed_hist_max), stats)
+    # per-shard scalars need a rank-1 axis to ride a sharded out_spec
+    emit = emit._replace(
+        n_emitted=emit.n_emitted[None], overflowed=emit.overflowed[None]
+    )
+    return new_state, emit, packed, stats
 
 
 class ShardedAggregator:
@@ -235,14 +281,28 @@ class ShardedAggregator:
             hist=spec2, valid=spec1, n_emitted=P(AXIS), overflowed=P(AXIS),
         )
         stats_specs = ShardStats(*([P()] * 7))
+        in_specs = (state_specs, spec1, spec1, spec1, spec1, spec1, P())
+        # two lazily-compiled variants of the SAME body, each returning
+        # only what its caller consumes (jit cannot DCE returned outputs;
+        # the streaming hot path must not materialize the emit pytree)
+
+        def body_full(*a):
+            state, emit, packed, stats = body(*a)
+            return state, emit, stats
+
+        def body_packed(*a):
+            state, emit, packed, stats = body(*a)
+            return state, packed
+
         self._step = jax.jit(
-            jax.shard_map(
-                body,
-                mesh=mesh,
-                in_specs=(state_specs, spec1, spec1, spec1, spec1, spec1, P()),
-                out_specs=(state_specs, emit_specs, stats_specs),
-            ),
+            jax.shard_map(body_full, mesh=mesh, in_specs=in_specs,
+                          out_specs=(state_specs, emit_specs, stats_specs)),
             donate_argnums=(0,),  # fold the state slab in place
+        )
+        self._step_packed = jax.jit(
+            jax.shard_map(body_packed, mesh=mesh, in_specs=in_specs,
+                          out_specs=(state_specs, spec2)),
+            donate_argnums=(0,),
         )
         self._in_sharding = shard1
 
@@ -254,13 +314,29 @@ class ShardedAggregator:
         slice (batch_size / process_count events, see parallel.multihost)
         and reads back only its addressable emit shards (emit_to_host).
         """
-        put = lambda x: multihost.put_global(self._in_sharding, np.asarray(x))
         self.state, emit, stats = self._step(
-            self.state,
-            put(lat_rad), put(lng_rad), put(speed), put(ts), put(valid),
+            self.state, *self._puts(lat_rad, lng_rad, speed, ts, valid),
             jnp.int32(watermark_cutoff),
         )
         return emit, stats
+
+    def step_packed(self, lat_rad, lng_rad, speed, ts, valid,
+                    watermark_cutoff):
+        """Single-transfer variant: folds the batch and returns the global
+        packed emit array, (n_shards * (E+1), 10) uint32 sharded over the
+        mesh — one (E+1, 10) block per shard with the replicated stats in
+        its head row.  Pull this host's rows with
+        ``multihost.addressable_rows`` and decode with
+        ``unpack_emit_shards`` (the streaming runtime's hot path)."""
+        self.state, packed = self._step_packed(
+            self.state, *self._puts(lat_rad, lng_rad, speed, ts, valid),
+            jnp.int32(watermark_cutoff),
+        )
+        return packed
+
+    def _puts(self, *arrays):
+        return tuple(multihost.put_global(self._in_sharding, np.asarray(a))
+                     for a in arrays)
 
     @property
     def local_batch_size(self) -> int:

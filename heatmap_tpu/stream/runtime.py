@@ -445,13 +445,16 @@ class MicroBatchRuntime:
                     self._account_pair(res, win_s // 60, e, stats),
                 )
         else:
-            # sharded path (every agg here is a ShardedAggregator)
+            # sharded path (every agg here is a ShardedAggregator): one
+            # addressable pull per pair covers this host's emit shards AND
+            # the replicated stats (packed head rows; parallel.sharded)
+            from heatmap_tpu.parallel import multihost
+            from heatmap_tpu.parallel.sharded import unpack_emit_shards
+
             for (res, wmin), agg in self.aggs.items():
-                emit, stats = agg.step(lat, lng, speed, ts, valid, cutoff)
-                # replicated scalars are readable on every host; the emit
-                # leaves are sharded — read only this host's shards
-                stats = jax.device_get(stats)
-                e = agg.emit_to_host(emit)
+                packed = agg.step_packed(lat, lng, speed, ts, valid, cutoff)
+                rows = multihost.addressable_rows(packed)
+                e, stats = unpack_emit_shards(rows, agg.params.emit_capacity)
                 batch_max = max(batch_max,
                                 self._account_pair(res, wmin, e, stats))
         t_device = time.monotonic()

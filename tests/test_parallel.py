@@ -131,3 +131,37 @@ def test_watermark_eviction_sharded(mesh, rng):
                         np.zeros_like(valid), t0 + 10_000)
     assert int(stats.n_active) == 0
     assert int(stats.n_evicted) > 0
+
+
+def test_step_packed_matches_step(mesh, rng):
+    """The packed single-pull pathway must decode to exactly what the
+    pytree path reports: same emitted groups, same stats."""
+    from heatmap_tpu.parallel import multihost
+    from heatmap_tpu.parallel.sharded import unpack_emit_shards
+
+    agg_a = ShardedAggregator(mesh, PARAMS, capacity_per_shard=1024,
+                              batch_size=1024)
+    agg_b = ShardedAggregator(mesh, PARAMS, capacity_per_shard=1024,
+                              batch_size=1024)
+    for b in range(2):
+        lat, lng, speed, ts, valid = make_batch(
+            rng, 1024, t0=1_700_000_000 + b * 120, nan_frac=0.2)
+        emit, stats = agg_a.step(lat, lng, speed, ts, valid, -2**31)
+        packed = agg_b.step_packed(lat, lng, speed, ts, valid, -2**31)
+        rows = multihost.addressable_rows(packed)
+        e, pstats = unpack_emit_shards(rows, PARAMS.emit_capacity)
+
+        want = agg_a.emit_to_host(emit)
+        def as_dict(d):
+            idx = np.nonzero(d["valid"])[0]
+            return {
+                (int(d["key_hi"][i]), int(d["key_lo"][i]),
+                 int(d["key_ws"][i])):
+                (int(d["count"][i]), round(float(d["sum_speed"][i]), 3))
+                for i in idx
+            }
+        assert as_dict(e) == as_dict(want)
+        assert e["n_emitted"] == int(np.asarray(emit.n_emitted).sum())
+        for f in ("n_valid", "n_late", "n_evicted", "n_active",
+                  "state_overflow", "batch_max_ts", "bucket_dropped"):
+            assert getattr(pstats, f) == int(np.asarray(getattr(stats, f))), f
