@@ -23,25 +23,30 @@ import numpy as np
 log = logging.getLogger(__name__)
 
 _HERE = os.path.dirname(__file__)
-_SRC = os.path.join(_HERE, "decoder.cpp")
+_SRCS = [os.path.join(_HERE, "decoder.cpp"),
+         os.path.join(_HERE, "tile_ops.cpp")]
 _LOCK = threading.Lock()
 _LIB = None
 _LIB_ERR: str | None = None
 
 
 def _build_lib() -> str:
-    with open(_SRC, "rb") as fh:
-        digest = hashlib.sha256(fh.read()).hexdigest()[:16]
+    h = hashlib.sha256()
+    for src in _SRCS:
+        with open(src, "rb") as fh:
+            h.update(fh.read())
+    digest = h.hexdigest()[:16]
     cache_dir = os.environ.get(
         "HEATMAP_NATIVE_CACHE",
         os.path.join(tempfile.gettempdir(), "heatmap-tpu-native"),
     )
     os.makedirs(cache_dir, exist_ok=True)
-    so_path = os.path.join(cache_dir, f"_decoder-{digest}.so")
+    so_path = os.path.join(cache_dir, f"_native-{digest}.so")
     if os.path.exists(so_path):
         return so_path
     tmp = so_path + f".tmp{os.getpid()}"
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", *_SRCS,
+           "-o", tmp]
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(tmp, so_path)
     return so_path
@@ -78,6 +83,18 @@ def _load():
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
             f32p, f32p, f32p, i32p, i32p, i32p,
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        lib.enc_tile_ops.restype = ctypes.c_int64
+        lib.enc_tile_ops.argtypes = [
+            u32p, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32,
+            u8p, ctypes.c_int64,
+            i64p, ctypes.POINTER(ctypes.c_int64),
         ]
         _LIB = lib
         return _LIB
@@ -207,3 +224,72 @@ class NativeDecoder:
         )
         cols.n_dropped = int(dropped.value)
         return cols, min(int(consumed.value), orig_len)
+
+
+class NativeTileOps:
+    """Packed-emit rows -> wire-ready BSON update ops (tile_ops.cpp).
+
+    ``encode(body, ...)`` takes the packed emit matrix's BODY rows
+    ((E, 10) uint32, i.e. ``packed[1:]``) and returns
+    ``(ops_bytes, end_offsets, n_docs)`` where ``ops_bytes`` is the
+    concatenated update-op documents for an OP_MSG "updates" document
+    sequence and ``end_offsets[i]`` is the byte end of op i (for 1000-op
+    chunking).  Rows with valid==0 or count<=0 are skipped, mirroring
+    stream.runtime's doc builder.
+    """
+
+    # conservative per-doc bound: fixed fields ~430B + _id/cellId strings
+    _DOC_BOUND = 640
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native tile encoder unavailable: {_LIB_ERR}")
+        self._lib = lib
+
+    @staticmethod
+    def available() -> bool:
+        return _load() is not None
+
+    def encode(self, body: np.ndarray, city: str, grid: str,
+               window_s: int, ttl_minutes: int,
+               window_minutes_tag: int = 0, with_p95: bool = True):
+        body = np.ascontiguousarray(body, np.uint32)
+        if body.ndim != 2 or body.shape[1] != 10:
+            raise ValueError(f"body must be (E, 10) uint32, got {body.shape}")
+        n_rows = body.shape[0]
+        cap = n_rows * self._DOC_BOUND + 1024
+        out = np.empty(cap, np.uint8)
+        offsets = np.empty(max(n_rows, 1), np.int64)
+        nbytes = ctypes.c_int64(0)
+        n = self._lib.enc_tile_ops(
+            body, n_rows, city.encode(), grid.encode(),
+            window_s * 1000, ttl_minutes * 60_000,
+            window_minutes_tag, int(bool(with_p95)),
+            out, cap, offsets, ctypes.byref(nbytes),
+        )
+        if n < 0:  # undersized buffer (oversized city/grid strings)
+            cap = int(-n) + 1024
+            out = np.empty(cap, np.uint8)
+            n = self._lib.enc_tile_ops(
+                body, n_rows, city.encode(), grid.encode(),
+                window_s * 1000, ttl_minutes * 60_000,
+                window_minutes_tag, int(bool(with_p95)),
+                out, cap, offsets, ctypes.byref(nbytes),
+            )
+            if n < 0:
+                raise RuntimeError("native tile encode overflow after resize")
+        n = int(n)
+        return out[:int(nbytes.value)].tobytes(), offsets[:n].copy(), n
+
+
+def maybe_tile_ops(logger=None) -> "NativeTileOps | None":
+    """A NativeTileOps when the toolchain allows, else None (callers fall
+    back to the Python doc builder)."""
+    try:
+        if NativeTileOps.available():
+            return NativeTileOps()
+    except Exception as e:  # pragma: no cover - toolchain-dependent
+        if logger is not None:
+            logger.info("native tile encoder unavailable (%s)", e)
+    return None

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import abc
 import datetime as dt
-from typing import Any, Iterable, Sequence
+from typing import Any, Iterable, NamedTuple, Sequence
 
 UTC = dt.timezone.utc
 
@@ -36,13 +36,16 @@ def TileDoc(
     avg_lon: float,
     ttl_minutes: int,
     extra: dict[str, Any] | None = None,
+    grid: str | None = None,
 ) -> dict:
     """Build a tiles doc (reference: heatmap_stream.py:173-187).
 
     ``extra`` carries TPU-native extensions (p95SpeedKmh, stddev, window
     length tags for the multi-window configs) without disturbing the base
-    contract."""
-    grid = f"h3r{res}"
+    contract.  ``grid`` overrides the default ``h3r{res}`` label (and the
+    matching _id segment) for non-default window lengths."""
+    if grid is None:
+        grid = f"h3r{res}"
     _id = f"{city}|{grid}|{cell_id}|{iso_z(window_start)}"
     doc = {
         "_id": _id,
@@ -73,6 +76,68 @@ def PositionDoc(provider: str, vehicle_id: str, ts: dt.datetime,
     }
 
 
+class TilePackMeta(NamedTuple):
+    """Static per-(res, window) context for sinking packed emit rows.
+
+    ``grid`` is the full label ("h3r8", or "h3r8m1" for non-default
+    windows); ``window_minutes_tag`` is 0 for the default window, else the
+    window length to record as the doc's windowMinutes field (mirrors
+    stream.runtime's multi-window doc contract)."""
+
+    city: str
+    grid: str
+    window_s: int
+    ttl_minutes: int
+    window_minutes_tag: int
+    with_p95: bool
+
+
+def packed_tile_docs(body, meta: TilePackMeta) -> list[dict]:
+    """Portable tile-doc builder from packed emit BODY rows ((E, 10)
+    uint32, engine.step.pack_emit layout).  The correctness oracle for —
+    and fallback to — the C++ encoder (native/tile_ops.cpp), which
+    produces equivalent BSON for the same rows.  The doc schema itself is
+    TileDoc's — this function only decodes the columnar lanes."""
+    import numpy as np
+
+    body = np.asarray(body)
+    valid = body[:, 8] != 0
+    count = body[:, 3].view(np.int32)
+    idx = np.nonzero(valid & (count > 0))[0]
+    f32 = lambda col: body[:, col].view(np.float32)
+    cells = (body[:, 0].astype(np.uint64) << np.uint64(32)) | \
+        body[:, 1].astype(np.uint64)
+    ws = body[:, 2].view(np.int32)
+    docs = []
+    for j in idx:
+        c = int(count[j])
+        ssp = float(f32(4)[j])
+        extra = {
+            "stddevSpeedKmh": float(
+                max(float(f32(5)[j]) / c - (ssp / c) ** 2, 0.0) ** 0.5),
+        }
+        if meta.with_p95:
+            extra["p95SpeedKmh"] = float(f32(9)[j])
+        if meta.window_minutes_tag:
+            extra["windowMinutes"] = meta.window_minutes_tag
+        start = epoch_to_dt(int(ws[j]))
+        docs.append(TileDoc(
+            city=meta.city,
+            res=0,  # unused: grid label is explicit
+            cell_id=format(int(cells[j]), "x"),
+            window_start=start,
+            window_end=epoch_to_dt(int(ws[j]) + meta.window_s),
+            count=c,
+            avg_speed_kmh=ssp / c,
+            avg_lat=float(f32(6)[j]) / c,
+            avg_lon=float(f32(7)[j]) / c,
+            ttl_minutes=meta.ttl_minutes,
+            extra=extra,
+            grid=meta.grid,
+        ))
+    return docs
+
+
 class Store(abc.ABC):
     """Write + read interface over the two collections.
 
@@ -82,6 +147,12 @@ class Store(abc.ABC):
     @abc.abstractmethod
     def upsert_tiles(self, docs: Sequence[dict]) -> int:
         """Upsert tile docs by _id; returns number written."""
+
+    def upsert_tiles_packed(self, body, meta: TilePackMeta) -> int:
+        """Upsert tiles straight from packed emit body rows.  Default:
+        build docs in Python; MongoStore overrides with the C++
+        columnar->BSON encoder when the toolchain allows."""
+        return self.upsert_tiles(packed_tile_docs(body, meta))
 
     @abc.abstractmethod
     def upsert_positions(self, docs: Sequence[dict]) -> int:

@@ -128,6 +128,20 @@ class _WireBackend:
             n += int(r.get("nModified", 0)) + len(r.get("upserted", []))
         return n
 
+    def bulk_update_raw(self, coll: str, ops: bytes, end_offsets) -> int:
+        """Pre-encoded op docs (native/tile_ops.cpp) as OP_MSG document
+        sequences, chunked at the reference's 1000-op bulk size using the
+        encoder's per-op end offsets — no per-op Python work."""
+        n = 0
+        start = 0
+        for i in range(CHUNK, len(end_offsets) + CHUNK, CHUNK):
+            end = int(end_offsets[min(i, len(end_offsets)) - 1])
+            r = self.client.update_docseq(self.db_name, coll,
+                                          ops[start:end], ordered=False)
+            n += int(r.get("nModified", 0)) + len(r.get("upserted", []))
+            start = end
+        return n
+
     def find(self, coll: str, filter: dict, sort: dict | None = None,
              limit: int = 0) -> Iterable[dict]:
         return self.client.find(self.db_name, coll, filter, sort, limit)
@@ -147,6 +161,8 @@ class MongoStore(Store):
     def __init__(self, uri: str, db_name: str, ensure_indexes: bool = True,
                  backend=None):
         self._b = backend if backend is not None else _make_backend(uri, db_name)
+        self._tile_ops = None
+        self._tile_ops_probed = False
         if ensure_indexes:
             self.ensure_indexes()
 
@@ -159,6 +175,25 @@ class MongoStore(Store):
         if updates:
             self._b.bulk_update("tiles", updates)
         return len(updates)
+
+    def upsert_tiles_packed(self, body, meta) -> int:
+        """Fast path: C++ columnar->BSON encode + OP_MSG document-sequence
+        writes (wire backend only); falls back to the Python doc builder
+        when the toolchain or backend doesn't allow."""
+        if not self._tile_ops_probed:
+            self._tile_ops_probed = True
+            if isinstance(self._b, _WireBackend):
+                from heatmap_tpu.native import maybe_tile_ops
+
+                self._tile_ops = maybe_tile_ops()
+        if self._tile_ops is None:
+            return super().upsert_tiles_packed(body, meta)
+        ops, end_offsets, n = self._tile_ops.encode(
+            body, meta.city, meta.grid, meta.window_s, meta.ttl_minutes,
+            meta.window_minutes_tag, meta.with_p95)
+        if n:
+            self._b.bulk_update_raw("tiles", ops, end_offsets)
+        return n
 
     def upsert_positions(self, docs: Sequence[dict]) -> int:
         # race-free monotonic upsert: match on _id alone (upsert can only

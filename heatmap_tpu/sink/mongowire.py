@@ -89,15 +89,19 @@ class WireClient:
         a late reply left in the kernel buffer would otherwise be consumed
         as the answer to the NEXT command.  Callers reconnect by building a
         new client."""
+        body = dict(doc)
+        body["$db"] = db
+        return self._roundtrip(b"\x00" + bson.encode(body),
+                               next(iter(doc), "?"))
+
+    def _roundtrip(self, sections: bytes, label: str) -> dict:
+        """Send pre-framed OP_MSG sections; return the kind-0 reply doc."""
         if self._dead:
             raise WireError("connection poisoned by a previous I/O error; "
                             "reconnect with a new WireClient")
-        body = dict(doc)
-        body["$db"] = db
-        payload = bson.encode(body)
         req_id = next(_request_ids)
-        msg = struct.pack("<iiii", 16 + 4 + 1 + len(payload), req_id, 0,
-                          OP_MSG) + struct.pack("<i", 0) + b"\x00" + payload
+        msg = struct.pack("<iiii", 16 + 4 + len(sections), req_id, 0,
+                          OP_MSG) + struct.pack("<i", 0) + sections
         with self._lock:
             try:
                 self._sock.sendall(msg)
@@ -120,8 +124,7 @@ class WireClient:
             raise WireError(f"unexpected section kind {rest[4]}")
         reply = bson.decode(rest[5:])
         if not reply.get("ok"):
-            raise WireError(f"{doc and next(iter(doc))}: "
-                            f"{reply.get('errmsg', reply)}")
+            raise WireError(f"{label}: {reply.get('errmsg', reply)}")
         return reply
 
     # ---- commands the sink/serve layers use -------------------------------
@@ -135,6 +138,21 @@ class WireClient:
         "multi": bool}], chunked by the caller."""
         reply = self.command(db, {"update": coll, "updates": updates,
                                   "ordered": ordered})
+        if reply.get("writeErrors"):
+            raise WriteErrors(reply["writeErrors"])
+        return reply
+
+    def update_docseq(self, db: str, coll: str, ops: bytes,
+                      ordered: bool = False) -> dict:
+        """update with pre-encoded op documents as an OP_MSG document
+        sequence (section kind 1) — the zero-copy path for the C++ tile
+        encoder's output: the op bytes go from the native buffer to the
+        socket without Python ever materializing the documents."""
+        body = bson.encode({"update": coll, "ordered": ordered, "$db": db})
+        ident = b"updates\x00"
+        sec1 = (b"\x01" + struct.pack("<i", 4 + len(ident) + len(ops))
+                + ident + ops)
+        reply = self._roundtrip(b"\x00" + body + sec1, "update")
         if reply.get("writeErrors"):
             raise WriteErrors(reply["writeErrors"])
         return reply

@@ -47,6 +47,9 @@ class AsyncWriter:
             try:
                 if kind == "tiles":
                     return self.store.upsert_tiles(docs)
+                if kind == "tiles_packed":
+                    body, meta = docs
+                    return self.store.upsert_tiles_packed(body, meta)
                 return self.store.upsert_positions(docs)
             except Exception:
                 if attempt == self.retries:
@@ -68,7 +71,7 @@ class AsyncWriter:
                 kind, docs = item
                 if self._exc is None:
                     n = self._apply(kind, docs)
-                    if kind == "tiles":
+                    if kind.startswith("tiles"):
                         self._written_tiles += n
                     else:
                         self._written_positions += n
@@ -93,6 +96,13 @@ class AsyncWriter:
         self._check()
         if docs:
             self._q.put(("tiles", docs))
+
+    def submit_tiles_packed(self, body, meta) -> None:
+        """Packed emit body rows + TilePackMeta; the store-side encode
+        (C++ when available) runs on this writer thread, overlapping the
+        next batch's device step."""
+        self._check()
+        self._q.put(("tiles_packed", (body, meta)))
 
     def submit_positions(self, docs: Sequence[dict]) -> None:
         self._check()

@@ -26,7 +26,6 @@ import numpy as np
 from heatmap_tpu.config import Config
 from heatmap_tpu.engine import AggParams
 from heatmap_tpu.engine.state import TileState
-from heatmap_tpu.engine.step import unpack_emit
 from heatmap_tpu.hexgrid.device import cells_to_uint64
 from heatmap_tpu.sink import AsyncWriter, Store, TileDoc, PositionDoc
 from heatmap_tpu.sink.base import epoch_to_dt
@@ -145,6 +144,21 @@ class MicroBatchRuntime:
             )
             for res, win_s in pairs:
                 self.aggs[(res, win_s // 60)] = self._multi.view(res, win_s)
+        # static sink context per pair (packed fast path, sink.base)
+        from heatmap_tpu.sink.base import TilePackMeta
+
+        self._pack_meta = {}
+        for res in cfg.resolutions:
+            for wmin in cfg.windows_minutes:
+                default = wmin == cfg.tile_minutes
+                self._pack_meta[(res, wmin)] = TilePackMeta(
+                    city=cfg.city,
+                    grid=f"h3r{res}" if default else f"h3r{res}m{wmin}",
+                    window_s=wmin * 60,
+                    ttl_minutes=cfg.ttl_minutes,
+                    window_minutes_tag=0 if default else wmin,
+                    with_p95=bins > 0,
+                )
         # multi-host: each process feeds its share of the global batch and
         # checkpoints its own shards under a per-process subdirectory
         # (per-host Kafka partitions → per-host offsets; parallel.multihost)
@@ -280,7 +294,10 @@ class MicroBatchRuntime:
         ssp2 = e["sum_speed2"][idx]
         sla = e["sum_lat"][idx]
         slo = e["sum_lon"][idx]
-        p95 = e["p95"][idx] if "p95" in e else None
+        # p95 lanes exist in every packed emit; only surface them when the
+        # config actually collects histograms (bins=0 → lanes are all 0.0)
+        p95 = (e["p95"][idx]
+               if "p95" in e and self.cfg.speed_hist_bins > 0 else None)
         hist = e["hist"][idx] if e.get("hist") is not None else None
         cells = cells_to_uint64(hi, lo)
         cfg = self.cfg
@@ -304,8 +321,9 @@ class MicroBatchRuntime:
                     hist[j], c, cfg.speed_hist_max_kmh
                 )
             if wmin != cfg.tile_minutes:
+                # distinct grid label → distinct _id space (multi-window)
                 extra["windowMinutes"] = wmin
-            doc = TileDoc(
+            docs.append(TileDoc(
                 city=cfg.city,
                 res=res,
                 cell_id=format(int(cells[j]), "x"),
@@ -317,16 +335,9 @@ class MicroBatchRuntime:
                 avg_lon=float(slo[j]) / c,
                 ttl_minutes=cfg.ttl_minutes,
                 extra=extra,
-            )
-            if wmin != cfg.tile_minutes:
-                # distinct grid label → distinct _id space (multi-window)
-                grid = f"h3r{res}m{wmin}"
-                doc["grid"] = grid
-                doc["_id"] = "|".join(
-                    [cfg.city, grid, doc["cellId"],
-                     doc["_id"].rsplit("|", 1)[-1]]
-                )
-            docs.append(doc)
+                grid=(None if wmin == cfg.tile_minutes
+                      else f"h3r{res}m{wmin}"),
+            ))
         return docs
 
     def _fold_positions(self, cols: EventColumns) -> list[dict]:
@@ -368,6 +379,20 @@ class MicroBatchRuntime:
         docs = self._emit_docs(res, wmin, e)
         self.writer.submit_tiles(docs)
         self.metrics.count("tiles_emitted", len(docs))
+        return self._account_stats(res, wmin, stats)
+
+    def _account_pair_packed(self, res: int, wmin: int, body, stats) -> int:
+        """Packed fast path: hand the raw emit body rows to the writer
+        thread (columnar->BSON encode happens there, in C++ when the store
+        supports it) and book the stats."""
+        n_docs = int(np.count_nonzero(
+            (body[:, 8] != 0) & (body[:, 3].view(np.int32) > 0)))
+        if n_docs:
+            self.writer.submit_tiles_packed(body, self._pack_meta[(res, wmin)])
+        self.metrics.count("tiles_emitted", n_docs)
+        return self._account_stats(res, wmin, stats)
+
+    def _account_stats(self, res: int, wmin: int, stats) -> int:
         if int(stats.state_overflow) > 0 and not self._overflow_warned:
             self._overflow_warned = True
             log.error(
@@ -438,11 +463,11 @@ class MicroBatchRuntime:
                 lat, lng, speed, ts, valid, cutoff)
             bufs = np.asarray(packed_all)
             for idx, (res, win_s) in enumerate(self._multi.pairs):
-                e = unpack_emit(bufs[idx])
                 stats = stats_from_packed(bufs[idx])
                 batch_max = max(
                     batch_max,
-                    self._account_pair(res, win_s // 60, e, stats),
+                    self._account_pair_packed(res, win_s // 60,
+                                              bufs[idx][1:], stats),
                 )
         else:
             # sharded path (every agg here is a ShardedAggregator): one

@@ -169,15 +169,55 @@ class _Handler(socketserver.BaseRequestHandler):
                 return
             length, req_id, _rto, opcode = struct.unpack("<iiii", hdr)
             body = self._recv_exact(length - 16)
-            if body is None or opcode != 2013 or body[4] != 0:
+            if body is None or opcode != 2013:
                 return
-            cmd = bson.decode(body[5:])
+            cmd = self._parse_sections(body)
+            if cmd is None:
+                return
             with self.server.state.lock:  # type: ignore[attr-defined]
                 reply = self._dispatch(cmd)
             payload = bson.encode(reply)
             out = struct.pack("<iiii", 16 + 4 + 1 + len(payload), 0, req_id,
                               2013) + struct.pack("<i", 0) + b"\x00" + payload
             self.request.sendall(out)
+
+    @staticmethod
+    def _parse_sections(body: bytes) -> dict | None:
+        """OP_MSG sections -> one command dict.  Kind-1 document sequences
+        are folded in as array fields, which is exactly how the server
+        treats them (a sequence is an alternative encoding of a command
+        array argument)."""
+        cmd: dict | None = None
+        seqs: dict[str, list[dict]] = {}
+        i = 4  # skip flagBits (always sent 0 by the framework's client)
+        while i < len(body):
+            kind = body[i]
+            i += 1
+            (sz,) = struct.unpack_from("<i", body, i)
+            if kind == 0:
+                doc = bson.decode(body[i:i + sz])
+                if cmd is None:
+                    cmd = doc
+                i += sz
+            elif kind == 1:
+                end = i + sz
+                j = i + 4
+                nul = body.index(b"\x00", j)
+                ident = body[j:nul].decode("utf-8")
+                j = nul + 1
+                docs = []
+                while j < end:
+                    (dsz,) = struct.unpack_from("<i", body, j)
+                    docs.append(bson.decode(body[j:j + dsz]))
+                    j += dsz
+                seqs[ident] = docs
+                i = end
+            else:
+                return None
+        if cmd is None:
+            return None
+        cmd.update(seqs)
+        return cmd
 
     # ---- command dispatch -------------------------------------------------
 

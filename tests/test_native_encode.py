@@ -1,0 +1,148 @@
+"""Differential tests for the C++ columnar->BSON tile encoder
+(native/tile_ops.cpp) against the portable Python doc builder
+(sink.base.packed_tile_docs), plus the OP_MSG document-sequence write path
+end-to-end against the wire-level mock mongod."""
+
+import numpy as np
+import pytest
+
+from heatmap_tpu.native import NativeTileOps
+from heatmap_tpu.sink import bson
+from heatmap_tpu.sink.base import TilePackMeta, packed_tile_docs
+
+pytestmark = pytest.mark.skipif(
+    not NativeTileOps.available(), reason="no C++ toolchain")
+
+META = TilePackMeta(city="bos", grid="h3r8", window_s=300, ttl_minutes=45,
+                    window_minutes_tag=0, with_p95=True)
+
+
+def make_body(rng, n, invalid_frac=0.15):
+    body = np.zeros((n, 10), np.uint32)
+    body[:, 0] = rng.integers(0, 2**31, n)          # key_hi (bit 31 clear)
+    body[:, 1] = rng.integers(0, 2**32, n)          # key_lo
+    ws = (1_700_000_000 + rng.integers(0, 864, n) * 100).astype(np.int32)
+    body[:, 2] = ws.view(np.uint32)
+    body[:, 3] = rng.integers(0, 50, n)             # count (some zeros)
+    for col, lo, hi in ((4, 0, 5000.0), (5, 0, 1e6),
+                        (6, -90 * 40, 90 * 40), (7, -180 * 40, 180 * 40),
+                        (9, 0, 250.0)):
+        body[:, col] = rng.uniform(lo, hi, n).astype(np.float32).view(np.uint32)
+    body[:, 8] = (rng.random(n) > invalid_frac).astype(np.uint32)
+    return body
+
+
+def doc_from_op(op: dict) -> dict:
+    assert op["upsert"] is True
+    assert set(op) == {"q", "u", "upsert"}
+    doc = op["u"]["$set"]
+    assert op["q"] == {"_id": doc["_id"]}
+    return doc
+
+
+def decode_ops(ops: bytes, end_offsets) -> list[dict]:
+    out, start = [], 0
+    for end in end_offsets:
+        out.append(doc_from_op(bson.decode(ops[start:int(end)])))
+        start = int(end)
+    assert start == len(ops)
+    return out
+
+
+@pytest.mark.parametrize("meta", [
+    META,
+    META._replace(grid="h3r9m1", window_s=60, window_minutes_tag=1),
+    META._replace(with_p95=False, city="global-city"),
+])
+def test_native_matches_python(rng, meta):
+    enc = NativeTileOps()
+    body = make_body(rng, 257)
+    ops, offsets, n = enc.encode(body, meta.city, meta.grid, meta.window_s,
+                                 meta.ttl_minutes, meta.window_minutes_tag,
+                                 meta.with_p95)
+    got = decode_ops(ops, offsets)
+    want = packed_tile_docs(body, meta)
+    assert n == len(want) > 50
+    assert len(got) == n
+    for g, w in zip(got, want):
+        assert list(g) == list(w), "field order must match"
+        for k in w:
+            if isinstance(w[k], float):
+                assert g[k] == pytest.approx(w[k], rel=1e-15, abs=1e-300), k
+            else:
+                assert g[k] == w[k], k
+
+
+def test_empty_and_all_invalid(rng):
+    enc = NativeTileOps()
+    body = make_body(rng, 16)
+    body[:, 8] = 0
+    ops, offsets, n = enc.encode(body, "bos", "h3r8", 300, 45, 0, True)
+    assert n == 0 and len(ops) == 0 and len(offsets) == 0
+    ops, offsets, n = enc.encode(np.zeros((0, 10), np.uint32),
+                                 "bos", "h3r8", 300, 45, 0, True)
+    assert n == 0
+
+
+def test_docseq_write_path_matches_python_path(rng):
+    """MongoStore.upsert_tiles_packed (C++ encode + kind-1 doc sequence)
+    must leave the mock server in exactly the state the Python
+    upsert_tiles path produces — across multiple 1000-op chunks."""
+    from heatmap_tpu.sink.mongo import MongoStore
+    from heatmap_tpu.testing.mock_mongod import MockMongod
+
+    body = make_body(rng, 2500, invalid_frac=0.05)
+    # make keys unique so doc counts are deterministic
+    body[:, 1] = np.arange(2500, dtype=np.uint32)
+    with MockMongod() as uri_a, MockMongod() as uri_b:
+        store_a = MongoStore(uri_a, "mobility", ensure_indexes=False)
+        store_b = MongoStore(uri_b, "mobility", ensure_indexes=False)
+        n_a = store_a.upsert_tiles_packed(body, META)
+        assert store_a._tile_ops is not None, "native path must engage"
+        n_b = store_b.upsert_tiles(packed_tile_docs(body, META))
+        assert n_a == n_b > 1000
+
+        a = {d["_id"]: d for d in store_a._b.find("tiles", {})}
+        b = {d["_id"]: d for d in store_b._b.find("tiles", {})}
+        assert set(a) == set(b)
+        for k in a:
+            ga, gb = a[k], b[k]
+            assert list(ga) == list(gb)
+            for f in ga:
+                if isinstance(ga[f], float):
+                    assert ga[f] == pytest.approx(gb[f], rel=1e-15), (k, f)
+                else:
+                    assert ga[f] == gb[f], (k, f)
+        store_a.close()
+        store_b.close()
+
+
+def test_default_store_packed_path(rng):
+    """Stores without a native path (MemoryStore) take the portable
+    packed->docs fallback and agree with explicit doc upserts."""
+    from heatmap_tpu.sink.memory import MemoryStore
+
+    body = make_body(rng, 64)
+    s1, s2 = MemoryStore(), MemoryStore()
+    n1 = s1.upsert_tiles_packed(body, META)
+    n2 = s2.upsert_tiles(packed_tile_docs(body, META))
+    assert n1 == n2
+    ws = s1.latest_window_start()
+    a = sorted(s1.tiles_in_window(ws), key=lambda d: d["_id"])
+    b = sorted(s2.tiles_in_window(ws), key=lambda d: d["_id"])
+    assert a == b
+
+
+def test_oversized_city_never_drops_rows(rng):
+    """Review regression: a long city/grid must not silently skip rows —
+    the native path resizes its buffers and emits every doc."""
+    enc = NativeTileOps()
+    meta = META._replace(city="c" * 200, grid="g" * 64)
+    body = make_body(rng, 64, invalid_frac=0.0)
+    body[:, 3] = np.maximum(body[:, 3], 1)  # all counts positive
+    ops, offsets, n = enc.encode(body, meta.city, meta.grid, meta.window_s,
+                                 meta.ttl_minutes, 0, True)
+    want = packed_tile_docs(body, meta)
+    assert n == len(want) == 64
+    got = decode_ops(ops, offsets)
+    assert [g["_id"] for g in got] == [w["_id"] for w in want]
