@@ -34,8 +34,57 @@ import time
 import numpy as np
 
 
+def _ensure_device(probe_timeout_s: float = 90.0) -> None:
+    """Re-exec onto the CPU backend when the accelerator is unreachable.
+
+    The TPU here is remote-attached (axon tunnel); when the tunnel is down
+    the FIRST device operation hangs forever, which would leave the whole
+    round without a benchmark artifact.  Probe device init + one tiny jit
+    on a watchdog thread; on timeout or error, restart this process with
+    JAX_PLATFORMS=cpu and (unless explicitly set) a smaller event count so
+    the bench still completes and prints its JSON line.
+    """
+    if os.environ.get("BENCH_DEVICE_FALLBACK"):
+        return  # already fell back once; never loop
+    import threading
+
+    ok: list[bool] = []
+
+    def probe():
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            jax.block_until_ready(jax.jit(lambda v: v + 1)(jnp.zeros(8)))
+            ok.append(True)
+        except Exception as e:  # noqa: BLE001 - any init failure → fallback
+            print(f"# device probe failed: {e}", file=sys.stderr)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(probe_timeout_s)
+    if ok:
+        return
+    print(f"# accelerator unreachable after {probe_timeout_s:.0f}s; "
+          "falling back to CPU", file=sys.stderr)
+    env = dict(os.environ)
+    env["BENCH_DEVICE_FALLBACK"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("BENCH_EVENTS", str(2 * (1 << 20)))
+    env.setdefault("BENCH_BATCH", str(1 << 18))
+    env.setdefault("BENCH_CHUNK", "4")
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)],
+              env)
+
+
 def main() -> dict:
     import jax
+
+    if os.environ.get("BENCH_DEVICE_FALLBACK"):
+        # the environment pins JAX_PLATFORMS=axon via sitecustomize (env
+        # vars are read before ours land); the config API is the reliable
+        # override, as long as it runs before the first device op
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from heatmap_tpu.engine import AggParams, init_state
@@ -145,4 +194,5 @@ def main() -> dict:
 
 
 if __name__ == "__main__":
+    _ensure_device()
     main()

@@ -104,20 +104,24 @@ def packed_tile_docs(body, meta: TilePackMeta) -> list[dict]:
     valid = body[:, 8] != 0
     count = body[:, 3].view(np.int32)
     idx = np.nonzero(valid & (count > 0))[0]
-    f32 = lambda col: body[:, col].view(np.float32)
     cells = (body[:, 0].astype(np.uint64) << np.uint64(32)) | \
         body[:, 1].astype(np.uint64)
     ws = body[:, 2].view(np.int32)
+    sum_speed = body[:, 4].view(np.float32)
+    sum_speed2 = body[:, 5].view(np.float32)
+    sum_lat = body[:, 6].view(np.float32)
+    sum_lon = body[:, 7].view(np.float32)
+    p95 = body[:, 9].view(np.float32)
     docs = []
     for j in idx:
         c = int(count[j])
-        ssp = float(f32(4)[j])
+        ssp = float(sum_speed[j])
         extra = {
             "stddevSpeedKmh": float(
-                max(float(f32(5)[j]) / c - (ssp / c) ** 2, 0.0) ** 0.5),
+                max(float(sum_speed2[j]) / c - (ssp / c) ** 2, 0.0) ** 0.5),
         }
         if meta.with_p95:
-            extra["p95SpeedKmh"] = float(f32(9)[j])
+            extra["p95SpeedKmh"] = float(p95[j])
         if meta.window_minutes_tag:
             extra["windowMinutes"] = meta.window_minutes_tag
         start = epoch_to_dt(int(ws[j]))
@@ -129,8 +133,8 @@ def packed_tile_docs(body, meta: TilePackMeta) -> list[dict]:
             window_end=epoch_to_dt(int(ws[j]) + meta.window_s),
             count=c,
             avg_speed_kmh=ssp / c,
-            avg_lat=float(f32(6)[j]) / c,
-            avg_lon=float(f32(7)[j]) / c,
+            avg_lat=float(sum_lat[j]) / c,
+            avg_lon=float(sum_lon[j]) / c,
             ttl_minutes=meta.ttl_minutes,
             extra=extra,
             grid=meta.grid,

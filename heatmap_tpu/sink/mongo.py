@@ -15,9 +15,12 @@ Index DDL the reference documents as a manual mongosh step
 
 from __future__ import annotations
 
+import logging
 from typing import Iterable, Sequence
 
 from heatmap_tpu.sink.base import Store
+
+log = logging.getLogger(__name__)
 
 CHUNK = 1000  # reference flush size (heatmap_stream.py:191)
 
@@ -185,7 +188,10 @@ class MongoStore(Store):
             if isinstance(self._b, _WireBackend):
                 from heatmap_tpu.native import maybe_tile_ops
 
-                self._tile_ops = maybe_tile_ops()
+                self._tile_ops = maybe_tile_ops(log)
+                if self._tile_ops is None:
+                    log.warning("C++ tile encoder unavailable; tiles take "
+                                "the per-row Python doc-builder path")
         if self._tile_ops is None:
             return super().upsert_tiles_packed(body, meta)
         ops, end_offsets, n = self._tile_ops.encode(

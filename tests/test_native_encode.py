@@ -88,15 +88,19 @@ def test_docseq_write_path_matches_python_path(rng):
     """MongoStore.upsert_tiles_packed (C++ encode + kind-1 doc sequence)
     must leave the mock server in exactly the state the Python
     upsert_tiles path produces — across multiple 1000-op chunks."""
-    from heatmap_tpu.sink.mongo import MongoStore
+    from heatmap_tpu.sink.mongo import MongoStore, _WireBackend
     from heatmap_tpu.testing.mock_mongod import MockMongod
 
     body = make_body(rng, 2500, invalid_frac=0.05)
     # make keys unique so doc counts are deterministic
     body[:, 1] = np.arange(2500, dtype=np.uint32)
     with MockMongod() as uri_a, MockMongod() as uri_b:
-        store_a = MongoStore(uri_a, "mobility", ensure_indexes=False)
-        store_b = MongoStore(uri_b, "mobility", ensure_indexes=False)
+        # explicit wire backend: the native docseq path must engage even on
+        # machines where pymongo is installed (it would win the autoprobe)
+        store_a = MongoStore(uri_a, "mobility", ensure_indexes=False,
+                             backend=_WireBackend(uri_a, "mobility"))
+        store_b = MongoStore(uri_b, "mobility", ensure_indexes=False,
+                             backend=_WireBackend(uri_b, "mobility"))
         n_a = store_a.upsert_tiles_packed(body, META)
         assert store_a._tile_ops is not None, "native path must engage"
         n_b = store_b.upsert_tiles(packed_tile_docs(body, META))
