@@ -304,7 +304,7 @@ def merge_batch(
 def p95_from_hist_device(hist, count, hist_max: float):
     """Vectorized 95th percentile from per-row speed histograms (device).
 
-    Same interpolation as the host version (stream.runtime._p95_from_hist);
+    Same interpolation as the host oracle (tests/test_emit_pack.py);
     computing it on device means the (E, B) histogram never has to cross
     the device->host link."""
     E, B = hist.shape

@@ -3,11 +3,18 @@
 Dataflow per device (= one shard of the mesh axis "shards"):
 
     local batch shard (N/D events)
-      → snap_and_window (hexgrid.device)
-      → owner = mix32(key) % D            # key-space partitioning
-      → bucket into (D, cap) padded lanes # stable-sort by owner + rank
-      → lax.all_to_all over "shards"      # the ICI exchange (≈ Spark shuffle)
-      → engine.merge_batch into the local state slab (keys owned exclusively)
+      → H3 snap once per unique resolution (hexgrid.device)
+      → per (res, window) pair: owner = mix32(key) % D   # key partitioning
+      → bucket into (D, cap) padded lanes  # stable-sort by owner + rank
+      → ONE lax.all_to_all over "shards" carrying EVERY pair's lanes
+        (the ICI exchange ≈ Spark shuffle; fewer, larger messages)
+      → engine.merge_batch per pair into its local state slab
+        (keys owned exclusively)
+
+All configured (resolution, window) pairs run inside one jitted program —
+one dispatch per batch — and the per-pair packed emits come back stacked,
+so a host reads its entire step output (emits + psum'd stats ridden in
+head rows) in ONE addressable transfer.
 
 Bucket lanes are fixed-capacity (static shapes); events beyond a lane's
 capacity are dropped and counted in ``ShardStats.bucket_dropped`` — size
@@ -17,7 +24,7 @@ capacity are dropped and counted in ``ShardStats.bucket_dropped`` — size
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -35,12 +42,14 @@ from heatmap_tpu.engine.state import (
 from heatmap_tpu.engine.step import (
     AggParams,
     BatchEmit,
+    FUTURE_WINDOWS,
     merge_batch,
     pack_emit,
     read_stats_rider,
     ride_stats,
     snap_and_window,
     unpack_emit,
+    window_start,
 )
 
 AXIS = "shards"
@@ -70,23 +79,54 @@ class ShardStatsHost(NamedTuple):
     bucket_dropped: int
 
 
-def unpack_emit_shards(rows: np.ndarray, emit_capacity: int):
-    """Decode one host's packed emit rows (S*(E+1), 10) from
-    ShardedAggregator.step_packed into (emit dict, ShardStatsHost).
+def unpack_emit_shards(rows: np.ndarray, emit_capacity: int,
+                       n_pairs: int | None = None):
+    """Decode one host's packed emit rows from ShardedAggregator.step_packed.
 
-    Keys are owned exclusively per shard, so concatenating the blocks'
-    rows never duplicates a group; the stats head fields are psum'd
-    (identical in every block), so block 0's copy is authoritative."""
+    ``rows`` is (S * n_pairs * (E+1), 10) — per local shard, the P pairs'
+    blocks in pair order.  With ``n_pairs`` given (any value, even 1),
+    returns a list of (emit dict, ShardStatsHost), one per pair; with it
+    omitted, the historical single-pair signature: one bare
+    (emit dict, ShardStatsHost) tuple.
+
+    Keys are owned exclusively per shard, so concatenating blocks' rows
+    never duplicates a group; the stats head fields are psum'd (identical
+    in every shard's block for a given pair), so the first shard's copy is
+    authoritative.
+    """
+    single = n_pairs is None
+    if single:
+        n_pairs = 1
     blk = emit_capacity + 1
-    n_blocks = rows.shape[0] // blk
-    blocks = rows.reshape(n_blocks, blk, rows.shape[1])
-    es = [unpack_emit(b) for b in blocks]
-    e = {k: np.concatenate([x[k] for x in es]) for k in
-         ("key_hi", "key_lo", "key_ws", "count", "sum_speed", "sum_speed2",
-          "sum_lat", "sum_lon", "valid", "p95")}
-    e["n_emitted"] = sum(x["n_emitted"] for x in es)
-    e["overflowed"] = any(x["overflowed"] for x in es)
-    return e, read_stats_rider(blocks[0], ShardStatsHost)
+    n_shards = rows.shape[0] // (blk * n_pairs)
+    blocks = rows.reshape(n_shards, n_pairs, blk, rows.shape[1])
+    out = []
+    for p in range(n_pairs):
+        es = [unpack_emit(blocks[s, p]) for s in range(n_shards)]
+        e = {k: np.concatenate([x[k] for x in es]) for k in
+             ("key_hi", "key_lo", "key_ws", "count", "sum_speed",
+              "sum_speed2", "sum_lat", "sum_lon", "valid", "p95")}
+        e["n_emitted"] = sum(x["n_emitted"] for x in es)
+        e["overflowed"] = any(x["overflowed"] for x in es)
+        out.append((e, read_stats_rider(blocks[0, p], ShardStatsHost)))
+    return out[0] if single else out
+
+
+def packed_pair_bodies(rows: np.ndarray, emit_capacity: int, n_pairs: int):
+    """Split one host's packed emit rows into per-pair BODY matrices for
+    the packed sink fast path (sink.Store.upsert_tiles_packed): returns
+    [(body (S*E, 10) uint32, ShardStatsHost)] in pair order.  The head
+    rows are dropped after their stats are read; keys are shard-disjoint
+    so concatenating shard blocks never duplicates a group."""
+    blk = emit_capacity + 1
+    n_shards = rows.shape[0] // (blk * n_pairs)
+    blocks = rows.reshape(n_shards, n_pairs, blk, rows.shape[1])
+    out = []
+    for p in range(n_pairs):
+        body = np.ascontiguousarray(
+            blocks[:, p, 1:, :].reshape(-1, rows.shape[1]))
+        out.append((body, read_stats_rider(blocks[0, p], ShardStatsHost)))
+    return out
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
@@ -112,14 +152,27 @@ def _mix32(hi, lo, ws):
     return h
 
 
-def _bucket_and_exchange(fields, dest, valid, n_shards: int, cap: int):
-    """Route per-event field arrays to their owner shard.
+_LANE_NAMES = ("hi", "lat_deg", "lo", "lon_deg", "speed", "ts", "ws",
+               "valid")
 
-    fields: dict name -> (N,) array.  Returns (dict name -> (D*cap,) array
-    plus a "valid" mask, n_dropped scalar).  All fields are bitcast to
-    uint32 and packed into ONE all_to_all so the exchange is a single ICI
-    collective per step.
-    """
+
+def _lane_init(name: str, n: int):
+    if name in ("hi", "lo"):
+        return jnp.full((n,), EMPTY_KEY_HI, jnp.uint32)
+    if name == "ws":
+        return jnp.full((n,), EMPTY_WS, jnp.int32)
+    if name == "valid":
+        return jnp.zeros((n,), bool)
+    if name == "ts":
+        return jnp.zeros((n,), jnp.int32)
+    return jnp.zeros((n,), jnp.float32)
+
+
+def _bucket_lanes(fields, dest, valid, n_shards: int, cap: int):
+    """Route per-event field arrays into (n_shards*cap,) owner-ordered
+    lanes (stable-sort by owner, rank within owner).  Returns the lanes
+    stacked as one (n_shards, cap, L) uint32 block ready for the exchange,
+    plus the dropped-events count.  Lane order is ``_LANE_NAMES``."""
     n = dest.shape[0]
     # invalid events must not consume lane capacity: sink them to a
     # nonexistent destination group before ranking
@@ -137,116 +190,142 @@ def _bucket_and_exchange(fields, dest, valid, n_shards: int, cap: int):
     ok = valid[order] & (rank < cap) & (dest_s < n_shards)
     slot = jnp.where(ok, slot, n_shards * cap)  # OOB → dropped
 
-    names = sorted(fields)
     out = []
-    for name in names:
-        arr = fields[name]
-        if arr.dtype == jnp.uint32:
-            init = jnp.full((n_shards * cap,), EMPTY_KEY_HI, jnp.uint32)
-        elif name == "ws":
-            init = jnp.full((n_shards * cap,), EMPTY_WS, jnp.int32)
+    for name in _LANE_NAMES:
+        if name == "valid":
+            out.append(jnp.zeros((n_shards * cap,), bool)
+                       .at[slot].set(ok, mode="drop"))
         else:
-            init = jnp.zeros((n_shards * cap,), arr.dtype)
-        out.append(init.at[slot].set(arr[order], mode="drop"))
-    sent_valid = (
-        jnp.zeros((n_shards * cap,), bool).at[slot].set(ok, mode="drop")
-    )
-    names.append("valid")
-    out.append(sent_valid)
+            out.append(_lane_init(name, n_shards * cap)
+                       .at[slot].set(fields[name][order], mode="drop"))
     n_dropped = jnp.sum((valid[order] & (rank >= cap)).astype(jnp.int32))
 
-    # pack every lane as uint32 → one ICI collective; block b goes to peer b
     packed = jnp.stack(
         [a.astype(jnp.uint32) if a.dtype == jnp.bool_
          else jax.lax.bitcast_convert_type(a, jnp.uint32)
          for a in out],
         axis=-1,
     ).reshape(n_shards, cap, len(out))
-    packed = jax.lax.all_to_all(packed, AXIS, split_axis=0, concat_axis=0)
-    packed = packed.reshape(n_shards * cap, len(out))
+    return packed, n_dropped
 
-    exchanged = {}
-    for i, name in enumerate(names):
+
+def _decode_lanes(packed):
+    """(n_shards*cap, L) uint32 → dict of typed lanes (_LANE_NAMES)."""
+    n = packed.shape[0]
+    recv = {}
+    for i, name in enumerate(_LANE_NAMES):
         lane = packed[:, i]
-        want = out[i].dtype
+        want = _lane_init(name, n).dtype
         if want == jnp.bool_:
-            exchanged[name] = lane != 0
+            recv[name] = lane != 0
         else:
-            exchanged[name] = jax.lax.bitcast_convert_type(lane, want)
-    return exchanged, n_dropped
+            recv[name] = jax.lax.bitcast_convert_type(lane, want)
+    return recv
 
 
-def _sharded_step_body(params: AggParams, n_shards: int, cap: int,
-                       state: TileState, lat, lng, speed, ts, valid, cutoff):
-    """Per-device body run under shard_map."""
-    hi, lo, ws = snap_and_window(lat, lng, ts, valid, params)
-    # drop late/future events BEFORE the exchange so a replay backlog
-    # neither wastes ICI bandwidth nor steals bucket-lane capacity
-    # (future drop mirrors engine.step — see FUTURE_WINDOWS there)
-    from heatmap_tpu.engine.step import FUTURE_WINDOWS
-
-    late = valid & (ws != EMPTY_WS) & (ws + params.window_s <= cutoff)
-    has_wm = cutoff > jnp.int32(-(2**31))
-    late = late | (
-        valid & has_wm & (ws != EMPTY_WS)
-        & ((ws - cutoff) >= FUTURE_WINDOWS * params.window_s)
-    )
-    valid = valid & ~late
-    n_late_local = jnp.sum(late.astype(jnp.int32))
-    dest = (_mix32(hi, lo, ws) % jnp.uint32(n_shards)).astype(jnp.int32)
+def _sharded_step_body(params_list: tuple[AggParams, ...], n_shards: int,
+                       cap: int, states, lat, lng, speed, ts, valid, cutoff):
+    """Per-device body run under shard_map: every pair in one program,
+    every pair's exchange in ONE all_to_all."""
     lat_deg = lat * jnp.float32(180.0 / np.pi)
     lon_deg = lng * jnp.float32(180.0 / np.pi)
-    fields = {
-        "hi": hi, "lo": lo, "ws": ws, "speed": speed,
-        "lat_deg": lat_deg, "lon_deg": lon_deg, "ts": ts,
-    }
-    recv, n_dropped = _bucket_and_exchange(fields, dest, valid, n_shards, cap)
+    # one snap per unique resolution, shared across its windows
+    snapped = {}
+    for p in params_list:
+        if p.res not in snapped:
+            hi, lo, _ = snap_and_window(lat, lng, ts, valid, p)
+            snapped[p.res] = (hi, lo)
 
-    new_state, emit, st = merge_batch(
-        state, recv["hi"], recv["lo"], recv["ws"], recv["speed"],
-        recv["lat_deg"], recv["lon_deg"], recv["ts"], recv["valid"],
-        cutoff, params,
-    )
-    stats = ShardStats(
-        n_valid=jax.lax.psum(st.n_valid, AXIS),
-        n_late=jax.lax.psum(n_late_local + st.n_late, AXIS),
-        n_evicted=jax.lax.psum(st.n_evicted, AXIS),
-        n_active=jax.lax.psum(st.n_active, AXIS),
-        state_overflow=jax.lax.psum(st.state_overflow, AXIS),
-        batch_max_ts=jax.lax.pmax(st.batch_max_ts, AXIS),
-        bucket_dropped=jax.lax.psum(n_dropped, AXIS),
-    )
-    # this shard's packed (E+1, 10) emit block with the (replicated,
-    # psum'd) stats ridden in its head row — the host reads the WHOLE
-    # step's output in one addressable pull (engine.step.ride_stats)
-    packed = ride_stats(pack_emit(emit, params.speed_hist_max), stats)
-    # per-shard scalars need a rank-1 axis to ride a sharded out_spec
-    emit = emit._replace(
-        n_emitted=emit.n_emitted[None], overflowed=emit.overflowed[None]
-    )
-    return new_state, emit, packed, stats
+    blocks, n_lates, n_drops = [], [], []
+    for p in params_list:
+        hi, lo = snapped[p.res]
+        ws = window_start(ts, valid, p.window_s)
+        # drop late/future events BEFORE the exchange so a replay backlog
+        # neither wastes ICI bandwidth nor steals bucket-lane capacity
+        # (future drop mirrors engine.step — see FUTURE_WINDOWS there)
+        late = valid & (ws != EMPTY_WS) & (ws + p.window_s <= cutoff)
+        has_wm = cutoff > jnp.int32(-(2**31))
+        late = late | (
+            valid & has_wm & (ws != EMPTY_WS)
+            & ((ws - cutoff) >= FUTURE_WINDOWS * p.window_s)
+        )
+        valid_p = valid & ~late
+        n_lates.append(jnp.sum(late.astype(jnp.int32)))
+        dest = (_mix32(hi, lo, ws) % jnp.uint32(n_shards)).astype(jnp.int32)
+        fields = {
+            "hi": hi, "lo": lo, "ws": ws, "speed": speed,
+            "lat_deg": lat_deg, "lon_deg": lon_deg, "ts": ts,
+        }
+        block, n_dropped = _bucket_lanes(fields, dest, valid_p, n_shards, cap)
+        blocks.append(block)
+        n_drops.append(n_dropped)
+
+    # ONE ICI collective for all pairs: (P, D, cap, L), peer dim = axis 1
+    packed = jnp.stack(blocks)
+    packed = jax.lax.all_to_all(packed, AXIS, split_axis=1, concat_axis=1)
+
+    new_states, emits, packs, stats_list = [], [], [], []
+    for i, (p, st) in enumerate(zip(params_list, states)):
+        recv = _decode_lanes(packed[i].reshape(n_shards * cap, -1))
+        new_state, emit, s = merge_batch(
+            st, recv["hi"], recv["lo"], recv["ws"], recv["speed"],
+            recv["lat_deg"], recv["lon_deg"], recv["ts"], recv["valid"],
+            cutoff, p,
+        )
+        stats = ShardStats(
+            n_valid=jax.lax.psum(s.n_valid, AXIS),
+            n_late=jax.lax.psum(n_lates[i] + s.n_late, AXIS),
+            n_evicted=jax.lax.psum(s.n_evicted, AXIS),
+            n_active=jax.lax.psum(s.n_active, AXIS),
+            state_overflow=jax.lax.psum(s.state_overflow, AXIS),
+            batch_max_ts=jax.lax.pmax(s.batch_max_ts, AXIS),
+            bucket_dropped=jax.lax.psum(n_drops[i], AXIS),
+        )
+        # this pair's packed (E+1, 10) emit block with the (replicated,
+        # psum'd) stats ridden in its head row — the host reads the WHOLE
+        # step's output in one addressable pull (engine.step.ride_stats)
+        packs.append(ride_stats(pack_emit(emit, p.speed_hist_max), stats))
+        # per-shard scalars need a rank-1 axis to ride a sharded out_spec
+        emits.append(emit._replace(
+            n_emitted=emit.n_emitted[None], overflowed=emit.overflowed[None]
+        ))
+        new_states.append(new_state)
+        stats_list.append(stats)
+    packed_out = jnp.concatenate(packs, axis=0)  # (P*(E+1), 10) per shard
+    return tuple(new_states), tuple(emits), packed_out, tuple(stats_list)
 
 
 class ShardedAggregator:
     """Host-facing wrapper owning the sharded device state.
 
-    One instance per (resolution, window) pair; batches are fed as global
-    (batch_size,) arrays, sharded over the mesh's ``shards`` axis.
-    ``bucket_factor`` oversizes the exchange lanes relative to the uniform
-    share (2.0 = tolerate 2x skew toward one shard).
+    ``params`` is one AggParams or a sequence of them — every configured
+    (resolution, window) pair folds inside the same program.  Batches are
+    fed as global (batch_size,) arrays, sharded over the mesh's
+    ``shards`` axis.  ``bucket_factor`` oversizes the exchange lanes
+    relative to the uniform share (2.0 = tolerate 2x skew toward one
+    shard).
     """
 
     def __init__(
         self,
         mesh: Mesh,
-        params: AggParams,
+        params: AggParams | Sequence[AggParams],
         capacity_per_shard: int,
         batch_size: int,
         hist_bins: int = 0,
         bucket_factor: float = 2.0,
     ):
         self.mesh = mesh
-        self.params = params
+        plist = ([params] if isinstance(params, AggParams) else list(params))
+        if len({(p.res, p.window_s) for p in plist}) != len(plist):
+            raise ValueError(f"duplicate (res, window) pairs: "
+                             f"{[(p.res, p.window_s) for p in plist]}")
+        if len({p.emit_capacity for p in plist}) != 1:
+            raise ValueError("all pairs must share emit_capacity "
+                             "(packed blocks stack uniformly)")
+        self.params_list = tuple(plist)
+        self.params = self.params_list[0]
+        self.pairs = [(p.res, p.window_s) for p in self.params_list]
         self.n_shards = mesh.devices.size
         if batch_size % self.n_shards:
             raise ValueError(
@@ -260,13 +339,18 @@ class ShardedAggregator:
         shard1 = NamedSharding(mesh, P(AXIS))
         shard2 = NamedSharding(mesh, P(AXIS, None))
         self._state_shardings = (shard1, shard2)
-        self.state: TileState = TileState(*[
-            jax.device_put(leaf, shard2 if leaf.ndim == 2 else shard1)
-            for leaf in init_state(self.n_shards * capacity_per_shard, hist_bins)
-        ])
+        self.states: list[TileState] = [
+            TileState(*[
+                jax.device_put(leaf, shard2 if leaf.ndim == 2 else shard1)
+                for leaf in init_state(self.n_shards * capacity_per_shard,
+                                       hist_bins)
+            ])
+            for _ in self.params_list
+        ]
 
         body = functools.partial(
-            _sharded_step_body, params, self.n_shards, self.bucket_cap
+            _sharded_step_body, self.params_list, self.n_shards,
+            self.bucket_cap,
         )
         spec1 = P(AXIS)
         spec2 = P(AXIS, None)
@@ -281,57 +365,75 @@ class ShardedAggregator:
             hist=spec2, valid=spec1, n_emitted=P(AXIS), overflowed=P(AXIS),
         )
         stats_specs = ShardStats(*([P()] * 7))
-        in_specs = (state_specs, spec1, spec1, spec1, spec1, spec1, P())
+        n_pairs = len(self.params_list)
+        states_specs = tuple([state_specs] * n_pairs)
+        in_specs = (states_specs, spec1, spec1, spec1, spec1, spec1, P())
         # two lazily-compiled variants of the SAME body, each returning
         # only what its caller consumes (jit cannot DCE returned outputs;
-        # the streaming hot path must not materialize the emit pytree)
+        # the streaming hot path must not materialize the emit pytrees)
 
         def body_full(*a):
-            state, emit, packed, stats = body(*a)
-            return state, emit, stats
+            states, emits, packed, stats = body(*a)
+            return states, emits, stats
 
         def body_packed(*a):
-            state, emit, packed, stats = body(*a)
-            return state, packed
+            states, emits, packed, stats = body(*a)
+            return states, packed
 
         self._step = jax.jit(
-            jax.shard_map(body_full, mesh=mesh, in_specs=in_specs,
-                          out_specs=(state_specs, emit_specs, stats_specs)),
-            donate_argnums=(0,),  # fold the state slab in place
+            jax.shard_map(
+                body_full, mesh=mesh, in_specs=in_specs,
+                out_specs=(states_specs, tuple([emit_specs] * n_pairs),
+                           tuple([stats_specs] * n_pairs)),
+            ),
+            donate_argnums=(0,),  # fold the state slabs in place
         )
         self._step_packed = jax.jit(
             jax.shard_map(body_packed, mesh=mesh, in_specs=in_specs,
-                          out_specs=(state_specs, spec2)),
+                          out_specs=(states_specs, spec2)),
             donate_argnums=(0,),
         )
         self._in_sharding = shard1
 
+    # --- compat aliases (single-pair callers: tests, dryrun) ---------------
+
+    @property
+    def state(self) -> TileState:
+        return self.states[0]
+
     def step(self, lat_rad, lng_rad, speed, ts, valid, watermark_cutoff):
-        """Fold one global batch; returns (BatchEmit, ShardStats) on device.
+        """Fold one global batch; returns (BatchEmit, ShardStats) on device
+        — pair 0's view (use step_packed for multi-pair configurations).
 
         Per-shard scalar emit fields (n_emitted/overflowed) come back with a
         leading (n_shards,) axis.  Multi-host: each process passes its LOCAL
         slice (batch_size / process_count events, see parallel.multihost)
         and reads back only its addressable emit shards (emit_to_host).
         """
-        self.state, emit, stats = self._step(
-            self.state, *self._puts(lat_rad, lng_rad, speed, ts, valid),
+        states, emits, stats = self._step(
+            tuple(self.states), *self._puts(lat_rad, lng_rad, speed, ts,
+                                            valid),
             jnp.int32(watermark_cutoff),
         )
-        return emit, stats
+        self.states = list(states)
+        return emits[0], stats[0]
 
     def step_packed(self, lat_rad, lng_rad, speed, ts, valid,
                     watermark_cutoff):
-        """Single-transfer variant: folds the batch and returns the global
-        packed emit array, (n_shards * (E+1), 10) uint32 sharded over the
-        mesh — one (E+1, 10) block per shard with the replicated stats in
-        its head row.  Pull this host's rows with
+        """Single-transfer variant: folds the batch into every pair's
+        state and returns the global packed emit array,
+        (n_shards * n_pairs * (E+1), 10) uint32 sharded over the mesh —
+        per shard, one (E+1, 10) block per pair with the replicated stats
+        in its head row.  Pull this host's rows with
         ``multihost.addressable_rows`` and decode with
-        ``unpack_emit_shards`` (the streaming runtime's hot path)."""
-        self.state, packed = self._step_packed(
-            self.state, *self._puts(lat_rad, lng_rad, speed, ts, valid),
+        ``unpack_emit_shards(rows, E, n_pairs)`` (the streaming runtime's
+        hot path)."""
+        states, packed = self._step_packed(
+            tuple(self.states), *self._puts(lat_rad, lng_rad, speed, ts,
+                                            valid),
             jnp.int32(watermark_cutoff),
         )
+        self.states = list(states)
         return packed
 
     def _puts(self, *arrays):
@@ -357,24 +459,49 @@ class ShardedAggregator:
 
     # --- checkpoint interface (runtime._checkpoint / _maybe_resume) --------
 
-    def snapshot(self) -> TileState:
-        """THIS process's rows of the sharded state (per-host checkpoint —
-        hosts restore their own shards; see stream.checkpoint docstring)."""
-        return TileState(*[multihost.addressable_rows(leaf)
-                           for leaf in self.state])
+    def view(self, res: int, window_s: int) -> "ShardedPairView":
+        return ShardedPairView(self, self.pairs.index((res, window_s)))
 
-    def restore(self, st: TileState) -> None:
+    def snapshot(self, idx: int = 0) -> TileState:
+        """THIS process's rows of one pair's sharded state (per-host
+        checkpoint — hosts restore their own shards; see stream.checkpoint
+        docstring)."""
+        return TileState(*[multihost.addressable_rows(leaf)
+                           for leaf in self.states[idx]])
+
+    def restore(self, st: TileState, idx: int = 0) -> None:
         shard1, shard2 = self._state_shardings
-        n_local = self.state.key_hi.sharding.addressable_devices
+        cur = self.states[idx]
+        n_local = cur.key_hi.sharding.addressable_devices
         want_rows = (self.capacity_per_shard * len(n_local)
                      if jax.process_count() > 1
                      else self.n_shards * self.capacity_per_shard)
         got = (st.key_hi.shape, st.hist.shape)
-        want = ((want_rows,), (want_rows, self.state.hist.shape[1]))
+        want = ((want_rows,), (want_rows, cur.hist.shape[1]))
         if got != want:
             raise ValueError(f"state shape {got} != configured {want}")
-        self.state = TileState(*[
+        self.states[idx] = TileState(*[
             multihost.put_global(shard2 if leaf.ndim == 2 else shard1,
                                  np.asarray(leaf))
             for leaf in st
         ])
+
+
+class ShardedPairView:
+    """Checkpoint adapter for one pair of a multi-pair ShardedAggregator
+    (same snapshot/restore surface as engine.multi.PairView)."""
+
+    def __init__(self, agg: ShardedAggregator, idx: int):
+        self._agg = agg
+        self._idx = idx
+        self.capacity_per_shard = agg.capacity_per_shard
+
+    @property
+    def state(self) -> TileState:
+        return self._agg.states[self._idx]
+
+    def snapshot(self) -> TileState:
+        return self._agg.snapshot(self._idx)
+
+    def restore(self, st: TileState) -> None:
+        self._agg.restore(st, self._idx)

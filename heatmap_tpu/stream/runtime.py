@@ -26,8 +26,7 @@ import numpy as np
 from heatmap_tpu.config import Config
 from heatmap_tpu.engine import AggParams
 from heatmap_tpu.engine.state import TileState
-from heatmap_tpu.hexgrid.device import cells_to_uint64
-from heatmap_tpu.sink import AsyncWriter, Store, TileDoc, PositionDoc
+from heatmap_tpu.sink import AsyncWriter, Store, PositionDoc
 from heatmap_tpu.sink.base import epoch_to_dt
 from heatmap_tpu.stream.checkpoint import CheckpointManager
 from heatmap_tpu.stream.events import EventColumns, parse_events
@@ -38,23 +37,6 @@ from heatmap_tpu.stream.trace import Tracer
 log = logging.getLogger(__name__)
 
 I32_MIN = -(2**31)
-
-
-def _p95_from_hist(hist_row: np.ndarray, count: int, hist_max: float) -> float:
-    """95th-percentile speed by linear interpolation inside the hit bin."""
-    if count <= 0 or hist_row.size == 0:
-        return 0.0
-    b = hist_row.size
-    bin_w = hist_max / b
-    target = 0.95 * count
-    cum = np.cumsum(hist_row)
-    i = int(np.searchsorted(cum, target))
-    if i >= b:
-        return float(hist_max)
-    prev = float(cum[i - 1]) if i > 0 else 0.0
-    in_bin = float(hist_row[i])
-    frac = (target - prev) / in_bin if in_bin > 0 else 0.0
-    return (i + frac) * bin_w
 
 
 def _make_global_pair(mesh):
@@ -110,33 +92,32 @@ class MicroBatchRuntime:
         cap = 1 << cfg.state_capacity_log2
         bins = cfg.speed_hist_bins
         self._multi = None
+        self._sharded = None
+        pairs = list(dict.fromkeys(
+            (res, wmin * 60) for res in cfg.resolutions
+            for wmin in cfg.windows_minutes))
         if mesh is not None and mesh.devices.size > 1:
-            for res in cfg.resolutions:
-                for wmin in cfg.windows_minutes:
-                    params = AggParams(
-                        res=res,
-                        window_s=wmin * 60,
-                        emit_capacity=min(cfg.batch_size, cap),
-                        speed_hist_max=cfg.speed_hist_max_kmh,
-                    )
-                    from heatmap_tpu.parallel import ShardedAggregator
+            from heatmap_tpu.parallel import ShardedAggregator
 
-                    self.aggs[(res, wmin)] = ShardedAggregator(
-                        mesh, params, capacity_per_shard=cap,
-                        batch_size=cfg.batch_size, hist_bins=bins,
-                        bucket_factor=cfg.bucket_factor,
-                    )
+            # ALL pairs fused into one sharded program: one dispatch, one
+            # all_to_all, one addressable pull per batch (parallel.sharded)
+            self._sharded = ShardedAggregator(
+                mesh,
+                [AggParams(res=res, window_s=win_s,
+                           emit_capacity=min(cfg.batch_size, cap),
+                           speed_hist_max=cfg.speed_hist_max_kmh)
+                 for res, win_s in pairs],
+                capacity_per_shard=cap, batch_size=cfg.batch_size,
+                hist_bins=bins, bucket_factor=cfg.bucket_factor,
+            )
+            for res, win_s in pairs:
+                self.aggs[(res, win_s // 60)] = self._sharded.view(res, win_s)
         else:
             # single device: ALL pairs fused into one program — one
             # dispatch and one device->host pull per batch regardless of
             # how many (res, window) pairs are configured (engine.multi)
             from heatmap_tpu.engine.multi import MultiAggregator
 
-            # dict-dedupe mirrors the sharded branch's aggs-dict overwrite,
-            # so a config with repeated axes behaves the same on both paths
-            pairs = list(dict.fromkeys(
-                (res, wmin * 60) for res in cfg.resolutions
-                for wmin in cfg.windows_minutes))
             self._multi = MultiAggregator(
                 pairs, capacity=cap, batch_size=cfg.batch_size,
                 emit_capacity=min(cfg.batch_size, cap), hist_bins=bins,
@@ -280,66 +261,6 @@ class MicroBatchRuntime:
         out[: len(arr)] = arr
         return out
 
-    def _emit_docs(self, res: int, wmin: int, e: dict) -> list[dict]:
-        """Build tile docs from an unpacked emit dict (engine.unpack_emit
-        shape: key/count/sum arrays + 'p95' or 'hist')."""
-        idx = np.nonzero(e["valid"])[0]
-        if idx.size == 0:
-            return []
-        hi = e["key_hi"][idx]
-        lo = e["key_lo"][idx]
-        ws = e["key_ws"][idx]
-        count = e["count"][idx]
-        ssp = e["sum_speed"][idx]
-        ssp2 = e["sum_speed2"][idx]
-        sla = e["sum_lat"][idx]
-        slo = e["sum_lon"][idx]
-        # p95 lanes exist in every packed emit; only surface them when the
-        # config actually collects histograms (bins=0 → lanes are all 0.0)
-        p95 = (e["p95"][idx]
-               if "p95" in e and self.cfg.speed_hist_bins > 0 else None)
-        hist = e["hist"][idx] if e.get("hist") is not None else None
-        cells = cells_to_uint64(hi, lo)
-        cfg = self.cfg
-        # the reference's _id grid label for its single configured window;
-        # extra window lengths get a distinct label so ids never collide
-        docs = []
-        win_s = wmin * 60
-        for j in range(idx.size):
-            c = int(count[j])
-            if c <= 0:
-                continue
-            extra = {
-                "stddevSpeedKmh": float(
-                    max(ssp2[j] / c - (ssp[j] / c) ** 2, 0.0) ** 0.5
-                ),
-            }
-            if p95 is not None:
-                extra["p95SpeedKmh"] = float(p95[j])
-            elif hist is not None:
-                extra["p95SpeedKmh"] = _p95_from_hist(
-                    hist[j], c, cfg.speed_hist_max_kmh
-                )
-            if wmin != cfg.tile_minutes:
-                # distinct grid label → distinct _id space (multi-window)
-                extra["windowMinutes"] = wmin
-            docs.append(TileDoc(
-                city=cfg.city,
-                res=res,
-                cell_id=format(int(cells[j]), "x"),
-                window_start=epoch_to_dt(int(ws[j])),
-                window_end=epoch_to_dt(int(ws[j]) + win_s),
-                count=c,
-                avg_speed_kmh=float(ssp[j]) / c,
-                avg_lat=float(sla[j]) / c,
-                avg_lon=float(slo[j]) / c,
-                ttl_minutes=cfg.ttl_minutes,
-                extra=extra,
-                grid=(None if wmin == cfg.tile_minutes
-                      else f"h3r{res}m{wmin}"),
-            ))
-        return docs
-
     def _fold_positions(self, cols: EventColumns) -> list[dict]:
         """Latest position per vehicle, monotonic in ts (the *intent* of the
         reference's conditional upsert, heatmap_stream.py:198-228, without
@@ -371,20 +292,11 @@ class MicroBatchRuntime:
             docs.append(PositionDoc(provider, vehicle, epoch_to_dt(ts), la, lo))
         return docs
 
-    def _account_pair(self, res: int, wmin: int, e: dict, stats) -> int:
-        """Sink one pair's emit + book its stats; returns its batch_max_ts.
-
-        ``stats`` is any object with StepStats-named int attributes
-        (device_get'd StepStats/ShardStats or engine.multi.MultiStats)."""
-        docs = self._emit_docs(res, wmin, e)
-        self.writer.submit_tiles(docs)
-        self.metrics.count("tiles_emitted", len(docs))
-        return self._account_stats(res, wmin, stats)
-
     def _account_pair_packed(self, res: int, wmin: int, body, stats) -> int:
-        """Packed fast path: hand the raw emit body rows to the writer
-        thread (columnar->BSON encode happens there, in C++ when the store
-        supports it) and book the stats."""
+        """Sink one pair's packed emit body rows + book its stats; returns
+        its batch_max_ts.  The writer thread turns the rows into store
+        writes (columnar->BSON in C++ when the store supports it);
+        ``stats`` is any object with StepStats-named int attributes."""
         n_docs = int(np.count_nonzero(
             (body[:, 8] != 0) & (body[:, 3].view(np.int32) > 0)))
         if n_docs:
@@ -470,18 +382,26 @@ class MicroBatchRuntime:
                                               bufs[idx][1:], stats),
                 )
         else:
-            # sharded path (every agg here is a ShardedAggregator): one
-            # addressable pull per pair covers this host's emit shards AND
-            # the replicated stats (packed head rows; parallel.sharded)
+            # sharded path: ONE dispatch folds every pair (single fused
+            # all_to_all), and one addressable pull covers this host's
+            # emit shards AND the replicated stats for all pairs (packed
+            # head rows; parallel.sharded).  Tile rows ride the same
+            # packed fast path as the single-device branch.
             from heatmap_tpu.parallel import multihost
-            from heatmap_tpu.parallel.sharded import unpack_emit_shards
+            from heatmap_tpu.parallel.sharded import packed_pair_bodies
 
-            for (res, wmin), agg in self.aggs.items():
-                packed = agg.step_packed(lat, lng, speed, ts, valid, cutoff)
-                rows = multihost.addressable_rows(packed)
-                e, stats = unpack_emit_shards(rows, agg.params.emit_capacity)
-                batch_max = max(batch_max,
-                                self._account_pair(res, wmin, e, stats))
+            packed = self._sharded.step_packed(lat, lng, speed, ts, valid,
+                                               cutoff)
+            rows = multihost.addressable_rows(packed)
+            bodies = packed_pair_bodies(
+                rows, self._sharded.params.emit_capacity,
+                len(self._sharded.pairs))
+            for (res, win_s), (body, stats) in zip(self._sharded.pairs,
+                                                   bodies):
+                batch_max = max(
+                    batch_max,
+                    self._account_pair_packed(res, win_s // 60, body, stats),
+                )
         t_device = time.monotonic()
 
         if self.positions_enabled and cols is not None:

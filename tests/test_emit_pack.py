@@ -13,8 +13,25 @@ from heatmap_tpu.engine.step import (
     snap_and_window,
     unpack_emit,
 )
-from heatmap_tpu.stream.runtime import _p95_from_hist
 from tests.test_engine import make_batch
+
+
+def _p95_from_hist(hist_row: np.ndarray, count: int, hist_max: float) -> float:
+    """Host reference for the device p95: 95th percentile by linear
+    interpolation inside the hit bin (oracle for p95_from_hist_device)."""
+    if count <= 0 or hist_row.size == 0:
+        return 0.0
+    b = hist_row.size
+    bin_w = hist_max / b
+    target = 0.95 * count
+    cum = np.cumsum(hist_row)
+    i = int(np.searchsorted(cum, target))
+    if i >= b:
+        return float(hist_max)
+    prev = float(cum[i - 1]) if i > 0 else 0.0
+    in_bin = float(hist_row[i])
+    frac = (target - prev) / in_bin if in_bin > 0 else 0.0
+    return (i + frac) * bin_w
 
 PARAMS = AggParams(res=8, window_s=300, emit_capacity=512,
                    speed_hist_max=256.0)

@@ -1,0 +1,79 @@
+"""Multi-pair fused sharded aggregation: one program folding every
+(res, window) pair with a single all_to_all must agree pair-by-pair with
+independent single-pair ShardedAggregators."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from heatmap_tpu.engine import AggParams
+from heatmap_tpu.parallel import ShardedAggregator, make_mesh, multihost
+from heatmap_tpu.parallel.sharded import (
+    packed_pair_bodies,
+    unpack_emit_shards,
+)
+from tests.test_engine import make_batch
+
+PAIRS = [(8, 300), (8, 60), (7, 300)]
+PARAMS = [AggParams(res=r, window_s=w, emit_capacity=1024)
+          for r, w in PAIRS]
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8
+    return make_mesh(8)
+
+
+def _emit_as_dict(e):
+    idx = np.nonzero(e["valid"])[0]
+    return {
+        (int(e["key_hi"][i]), int(e["key_lo"][i]), int(e["key_ws"][i])):
+        (int(e["count"][i]), round(float(e["sum_speed"][i]), 3))
+        for i in idx
+    }
+
+
+def test_fused_sharded_matches_single_pair(mesh, rng):
+    fused = ShardedAggregator(mesh, PARAMS, capacity_per_shard=1024,
+                              batch_size=1024)
+    singles = {
+        (p.res, p.window_s): ShardedAggregator(
+            mesh, p, capacity_per_shard=1024, batch_size=1024)
+        for p in PARAMS
+    }
+    for b in range(3):
+        lat, lng, speed, ts, valid = make_batch(
+            rng, 1024, t0=1_700_000_000 + b * 150, nan_frac=0.1)
+        packed = fused.step_packed(lat, lng, speed, ts, valid, -2**31)
+        rows = multihost.addressable_rows(packed)
+        results = unpack_emit_shards(rows, 1024, len(PAIRS))
+        bodies = packed_pair_bodies(rows, 1024, len(PAIRS))
+        for (r, w), (e, stats), (body, bstats) in zip(PAIRS, results,
+                                                      bodies):
+            sp = singles[(r, w)].step_packed(lat, lng, speed, ts, valid,
+                                             -2**31)
+            se, sstats = unpack_emit_shards(
+                multihost.addressable_rows(sp), 1024)
+            assert _emit_as_dict(e) == _emit_as_dict(se), (r, w, b)
+            assert stats == sstats, (r, w, b)
+            assert bstats == sstats
+            # body rows decode to the same groups as the emit dict
+            bvalid = body[:, 8] != 0
+            assert int(np.count_nonzero(bvalid)) == e["n_emitted"]
+
+    # per-pair states match too
+    for idx, (r, w) in enumerate(PAIRS):
+        got = fused.view(r, w).snapshot()
+        want = singles[(r, w)].snapshot(0)
+        # fused and single slabs may order identical key sets identically
+        # (same merge fold) — compare exactly
+        for g, s in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(s))
+
+
+def test_sharded_duplicate_pairs_rejected(mesh):
+    with pytest.raises(ValueError):
+        ShardedAggregator(mesh, [PARAMS[0], PARAMS[0]],
+                          capacity_per_shard=64, batch_size=64)
