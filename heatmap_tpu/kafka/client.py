@@ -349,6 +349,28 @@ class KafkaClient:
         records = [r for r in records if r.offset >= offset]
         return FetchResult(hw, records, max(next_off, offset), skipped)
 
+    def fetch_values(self, topic: str, partition: int, offset: int,
+                     max_bytes: int = 1 << 20, max_wait_ms: int = 100):
+        """Fetch + decode straight to a newline-joined values blob via the
+        C++ batch decoder (native.kafka_decode_values) — the consumer hot
+        path, skipping per-record Python entirely.  Returns
+        (high_watermark, KafkaValues) or, when the native path can't take
+        this blob (no toolchain, malformed varints, newline-bearing
+        values), (high_watermark, FetchResult) from the Python decoder."""
+        from heatmap_tpu.native import kafka_decode_values
+
+        hw, blob = self._with_retry(
+            topic, partition,
+            lambda c: c.fetch(topic, partition, offset, max_bytes,
+                              max_wait_ms))
+        kv = kafka_decode_values(blob, offset)
+        if kv is not None:
+            kv.next_offset = max(kv.next_offset, offset)
+            return hw, kv
+        records, next_off, skipped = rec.decode_batches_tolerant(blob, offset)
+        records = [r for r in records if r.offset >= offset]
+        return hw, FetchResult(hw, records, max(next_off, offset), skipped)
+
     def list_offsets(self, topic: str, timestamp: int = LATEST) -> dict[int, int]:
         parts = self.partitions(topic)
         out: dict[int, int] = {}

@@ -33,9 +33,26 @@ def _make_table():
 
 
 _TABLE = _make_table()
+_NATIVE_CRC = None
+_NATIVE_PROBED = False
 
 
 def crc32c(data: bytes, crc: int = 0) -> int:
+    """CRC32C; dispatches to the C++ implementation (hardware SSE4.2 on
+    x86) when the toolchain allows — the Python table walk is ~10 MB/s,
+    three orders below the ingest target."""
+    global _NATIVE_CRC, _NATIVE_PROBED
+    if not _NATIVE_PROBED:
+        _NATIVE_PROBED = True
+        try:
+            from heatmap_tpu.native import crc32c_native
+
+            if crc32c_native(b"123456789") == 0xE3069283:  # spec check value
+                _NATIVE_CRC = crc32c_native
+        except Exception:
+            _NATIVE_CRC = None
+    if _NATIVE_CRC is not None:
+        return _NATIVE_CRC(bytes(data), crc)
     crc ^= 0xFFFFFFFF
     tbl = _TABLE
     for b in data:

@@ -24,7 +24,8 @@ log = logging.getLogger(__name__)
 
 _HERE = os.path.dirname(__file__)
 _SRCS = [os.path.join(_HERE, "decoder.cpp"),
-         os.path.join(_HERE, "tile_ops.cpp")]
+         os.path.join(_HERE, "tile_ops.cpp"),
+         os.path.join(_HERE, "kafka_codec.cpp")]
 _LOCK = threading.Lock()
 _LIB = None
 _LIB_ERR: str | None = None
@@ -45,8 +46,12 @@ def _build_lib() -> str:
     if os.path.exists(so_path):
         return so_path
     tmp = so_path + f".tmp{os.getpid()}"
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", *_SRCS,
-           "-o", tmp]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17"]
+    import platform
+
+    if platform.machine().lower() in ("x86_64", "amd64"):
+        cmd.append("-msse4.2")  # hardware CRC32C (kafka_codec.cpp)
+    cmd += [*_SRCS, "-o", tmp]
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(tmp, so_path)
     return so_path
@@ -96,8 +101,76 @@ def _load():
             u8p, ctypes.c_int64,
             i64p, ctypes.POINTER(ctypes.c_int64),
         ]
+        lib.kc_crc32c.restype = ctypes.c_uint32
+        lib.kc_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                  ctypes.c_uint32]
+        lib.kc_decode_values.restype = ctypes.c_int64
+        lib.kc_decode_values.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int32,
+            u8p, ctypes.c_int64,
+            i64p, i64p, ctypes.c_int64,
+            i64p,
+        ]
         _LIB = lib
         return _LIB
+
+
+def crc32c_native(data: bytes, crc: int = 0) -> "int | None":
+    """Hardware/sliced CRC32C (kafka_codec.cpp); None without a toolchain."""
+    lib = _load()
+    if lib is None:
+        return None
+    return int(lib.kc_crc32c(data, len(data), crc))
+
+
+class KafkaValues:
+    """Result of kafka_decode_values: newline-joined record values plus
+    the bookkeeping the consumer's partial-take logic needs.  (Blobs with
+    newline-bearing values never produce a KafkaValues at all — the
+    decoder returns None and callers take the Python record path.)"""
+
+    __slots__ = ("blob", "val_off", "val_pos", "next_offset",
+                 "skipped_batches", "n_null")
+
+    def __init__(self, blob, val_off, val_pos, next_offset, skipped,
+                 n_null):
+        self.blob = blob
+        self.val_off = val_off
+        self.val_pos = val_pos
+        self.next_offset = next_offset
+        self.skipped_batches = skipped
+        self.n_null = n_null
+
+    def __len__(self):
+        return len(self.val_off)
+
+
+def kafka_decode_values(blob: bytes, start_offset: int,
+                        verify_crc: bool = True) -> "KafkaValues | None":
+    """Decode a Fetch records blob straight to newline-joined values
+    (kafka_codec.cpp).  None when no toolchain exists, the blob's varints
+    are malformed, or any value contains raw newlines — callers fall back
+    to the Python record path (kafka.records.decode_batches_tolerant)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(blob)
+    out = np.empty(n + n // 6 + 16, np.uint8)
+    cap_vals = n // 6 + 8
+    val_off = np.empty(cap_vals, np.int64)
+    val_pos = np.empty(cap_vals, np.int64)
+    state = np.zeros(5, np.int64)
+    nv = lib.kc_decode_values(blob, n, start_offset, int(verify_crc),
+                              out, len(out), val_off, val_pos, cap_vals,
+                              state)
+    if nv < 0 or state[3] > 0:  # malformed varints / newline-bearing values
+        return None
+    nv = int(nv)
+    return KafkaValues(
+        out[:int(state[0])].tobytes(), val_off[:nv].copy(),
+        val_pos[:nv].copy(), int(state[1]), int(state[2]), int(state[4]),
+    )
 
 
 def maybe_decoder(logger=None) -> "NativeDecoder | None":

@@ -403,10 +403,39 @@ class _WireImpl:
         except (KafkaError, ConnectionError, OSError) as e:
             self.log.warning("kafka partition discovery failed: %s", e)
 
-    def poll(self, max_events):
+    def _guarded_fetch(self, p: int, fn):
+        """One fetch with the consumer's retriable-error policy; None on a
+        handled error (the partition is retried next poll)."""
         from heatmap_tpu.kafka import KafkaError
         from heatmap_tpu.kafka.client import EARLIEST
 
+        try:
+            return fn()
+        except KafkaError as e:
+            if e.code == 1:  # OFFSET_OUT_OF_RANGE: retention truncated
+                # past our checkpoint — resume from the log start
+                try:
+                    earliest = self.c.list_offsets(self.topic, EARLIEST)
+                    self.log.warning(
+                        "offset %d for %s[%d] out of range; resetting "
+                        "to earliest %d", self._offsets[p], self.topic,
+                        p, earliest.get(p, 0))
+                    self._offsets[p] = earliest.get(p, 0)
+                except (KafkaError, ConnectionError, OSError) as e2:
+                    self.log.warning("offset reset failed: %s", e2)
+            else:
+                self.log.warning("fetch %s[%d]: %s", self.topic, p, e)
+        except (ConnectionError, OSError) as e:
+            self.log.warning("fetch %s[%d]: %s", self.topic, p, e)
+        return None
+
+    def poll(self, max_events):
+        if self._dec is not None:
+            return self._poll_columnar(max_events)
+        return self._poll_records(max_events)
+
+    def _poll_records(self, max_events):
+        """Portable path (no C++ toolchain): per-record Python decode."""
         out = []
         if not self._offsets:
             self._discover()
@@ -417,26 +446,10 @@ class _WireImpl:
             if len(out) >= max_events:
                 break
             p = parts[(self._rr + k) % len(parts)]
-            try:
-                fr = self.c.fetch(self.topic, p, self._offsets[p],
-                                  max_wait_ms=50)
-            except KafkaError as e:
-                if e.code == 1:  # OFFSET_OUT_OF_RANGE: retention truncated
-                    # past our checkpoint — resume from the log start
-                    try:
-                        earliest = self.c.list_offsets(self.topic, EARLIEST)
-                        self.log.warning(
-                            "offset %d for %s[%d] out of range; resetting "
-                            "to earliest %d", self._offsets[p], self.topic,
-                            p, earliest.get(p, 0))
-                        self._offsets[p] = earliest.get(p, 0)
-                    except (KafkaError, ConnectionError, OSError) as e2:
-                        self.log.warning("offset reset failed: %s", e2)
-                else:
-                    self.log.warning("fetch %s[%d]: %s", self.topic, p, e)
-                continue
-            except (ConnectionError, OSError) as e:
-                self.log.warning("fetch %s[%d]: %s", self.topic, p, e)
+            fr = self._guarded_fetch(
+                p, lambda p=p: self.c.fetch(self.topic, p, self._offsets[p],
+                                            max_wait_ms=50))
+            if fr is None:
                 continue
             if fr.skipped_batches:
                 self.log.warning("skipped %d undecodable batches on %s[%d]",
@@ -457,6 +470,75 @@ class _WireImpl:
         self._rr = (self._rr + 1) % max(len(parts), 1)
         return _decode_raw_values(self._dec, out,
                                   self._intern_p, self._intern_v)
+
+    def _poll_columnar(self, max_events):
+        """Hot path: Fetch blobs decode to newline-joined value buffers in
+        C++ (native.kafka_decode_values) and feed the columnar JSON decoder
+        directly — per-record Python only on the rare fallback (corrupt
+        varints / newline-bearing values), where values are re-serialized
+        compact and joined into the same stream."""
+        if not self._offsets:
+            self._discover()
+        parts = sorted(self._offsets)
+        if not parts:
+            return []
+        blobs: list[bytes] = []
+        n_out = 0
+        pre_dropped = 0
+        for k in range(len(parts)):
+            if n_out >= max_events:
+                break
+            p = parts[(self._rr + k) % len(parts)]
+            res = self._guarded_fetch(
+                p, lambda p=p: self.c.fetch_values(
+                    self.topic, p, self._offsets[p], max_wait_ms=50))
+            if res is None:
+                continue
+            _hw, fv = res
+            skipped = getattr(fv, "skipped_batches", 0)
+            if skipped:
+                self.log.warning("skipped %d undecodable batches on %s[%d]",
+                                 skipped, self.topic, p)
+            if hasattr(fv, "blob"):  # native KafkaValues
+                room = max_events - n_out
+                nv = len(fv)
+                if nv <= room:
+                    if nv:
+                        blobs.append(fv.blob)
+                        n_out += nv
+                    # next_offset covers every value, null, and skipped batch
+                    self._offsets[p] = max(self._offsets[p], fv.next_offset)
+                else:
+                    blobs.append(fv.blob[:int(fv.val_pos[room])])
+                    self._offsets[p] = int(fv.val_off[room - 1]) + 1
+                    n_out += room
+            else:  # FetchResult fallback for this blob
+                taken = 0
+                for r in fv.records:
+                    if n_out >= max_events:
+                        break
+                    taken += 1
+                    self._offsets[p] = r.offset + 1
+                    if r.value is None:
+                        continue
+                    try:
+                        blobs.append(
+                            json.dumps(json.loads(r.value)).encode() + b"\n")
+                        n_out += 1
+                    except (ValueError, UnicodeDecodeError):
+                        pre_dropped += 1  # malformed → dropped (ref filters)
+                if taken == len(fv.records):
+                    self._offsets[p] = max(self._offsets[p], fv.next_offset)
+        self._rr = (self._rr + 1) % max(len(parts), 1)
+        if not blobs:
+            if pre_dropped:
+                cols = columns_from_arrays([], [], [], [])
+                cols.n_dropped = pre_dropped
+                return cols
+            return []
+        cols, _ = self._dec.decode(b"".join(blobs), final=True)
+        cols.n_dropped += pre_dropped
+        return cols
 
     def offset(self):
         return dict(self._offsets)
