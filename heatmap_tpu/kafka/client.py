@@ -350,20 +350,23 @@ class KafkaClient:
         return FetchResult(hw, records, max(next_off, offset), skipped)
 
     def fetch_values(self, topic: str, partition: int, offset: int,
-                     max_bytes: int = 1 << 20, max_wait_ms: int = 100):
-        """Fetch + decode straight to a newline-joined values blob via the
-        C++ batch decoder (native.kafka_decode_values) — the consumer hot
-        path, skipping per-record Python entirely.  Returns
-        (high_watermark, KafkaValues) or, when the native path can't take
-        this blob (no toolchain, malformed varints, newline-bearing
-        values), (high_watermark, FetchResult) from the Python decoder."""
+                     max_bytes: int = 1 << 20, max_wait_ms: int = 100,
+                     framing: str = "newline"):
+        """Fetch + decode straight to a joined values blob via the C++
+        batch decoder (native.kafka_decode_values) — the consumer hot
+        path, skipping per-record Python entirely.  ``framing``:
+        "newline" for JSON values, "lp" (u32 length prefixes) for binary
+        event values.  Returns (high_watermark, KafkaValues) or, when the
+        native path can't take this blob (no toolchain, malformed varints,
+        newline-bearing values under newline framing), (high_watermark,
+        FetchResult) from the Python decoder."""
         from heatmap_tpu.native import kafka_decode_values
 
         hw, blob = self._with_retry(
             topic, partition,
             lambda c: c.fetch(topic, partition, offset, max_bytes,
                               max_wait_ms))
-        kv = kafka_decode_values(blob, offset)
+        kv = kafka_decode_values(blob, offset, framing=framing)
         if kv is not None:
             kv.next_offset = max(kv.next_offset, offset)
             return hw, kv

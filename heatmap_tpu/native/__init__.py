@@ -107,10 +107,16 @@ def _load():
         lib.kc_decode_values.restype = ctypes.c_int64
         lib.kc_decode_values.argtypes = [
             ctypes.c_char_p, ctypes.c_int64,
-            ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
             u8p, ctypes.c_int64,
             i64p, i64p, ctypes.c_int64,
             i64p,
+        ]
+        lib.dec_decode_binary.restype = ctypes.c_int64
+        lib.dec_decode_binary.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            f32p, f32p, f32p, i32p, i32p, i32p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
         ]
         _LIB = lib
         return _LIB
@@ -125,10 +131,12 @@ def crc32c_native(data: bytes, crc: int = 0) -> "int | None":
 
 
 class KafkaValues:
-    """Result of kafka_decode_values: newline-joined record values plus
-    the bookkeeping the consumer's partial-take logic needs.  (Blobs with
-    newline-bearing values never produce a KafkaValues at all — the
-    decoder returns None and callers take the Python record path.)"""
+    """Result of kafka_decode_values: record values joined under the
+    requested framing — newline-terminated lines ("newline", JSON values;
+    a blob containing newline-bearing values returns None instead and
+    callers take the Python record path) or u32-length-prefixed frames
+    ("lp", binary event values, stream/binfmt.py) — plus the bookkeeping
+    the consumer's partial-take logic needs."""
 
     __slots__ = ("blob", "val_off", "val_pos", "next_offset",
                  "skipped_batches", "n_null")
@@ -147,23 +155,27 @@ class KafkaValues:
 
 
 def kafka_decode_values(blob: bytes, start_offset: int,
-                        verify_crc: bool = True) -> "KafkaValues | None":
-    """Decode a Fetch records blob straight to newline-joined values
-    (kafka_codec.cpp).  None when no toolchain exists, the blob's varints
-    are malformed, or any value contains raw newlines — callers fall back
-    to the Python record path (kafka.records.decode_batches_tolerant)."""
+                        verify_crc: bool = True,
+                        framing: str = "newline") -> "KafkaValues | None":
+    """Decode a Fetch records blob straight to a joined values buffer
+    (kafka_codec.cpp): framing="newline" for JSON values, "lp" for
+    u32-length-prefixed binary event values (stream/binfmt.py).  None when
+    no toolchain exists, the blob's varints are malformed, or (newline
+    framing only) a value contains raw newlines — callers fall back to the
+    Python record path (kafka.records.decode_batches_tolerant)."""
     lib = _load()
     if lib is None:
         return None
+    lp = framing == "lp"
     n = len(blob)
-    out = np.empty(n + n // 6 + 16, np.uint8)
     cap_vals = n // 6 + 8
+    out = np.empty(n + cap_vals * (4 if lp else 1) + 16, np.uint8)
     val_off = np.empty(cap_vals, np.int64)
     val_pos = np.empty(cap_vals, np.int64)
     state = np.zeros(5, np.int64)
     nv = lib.kc_decode_values(blob, n, start_offset, int(verify_crc),
-                              out, len(out), val_off, val_pos, cap_vals,
-                              state)
+                              int(lp), out, len(out), val_off, val_pos,
+                              cap_vals, state)
     if nv < 0 or state[3] > 0:  # malformed varints / newline-bearing values
         return None
     nv = int(nv)
@@ -297,6 +309,36 @@ class NativeDecoder:
         )
         cols.n_dropped = int(dropped.value)
         return cols, min(int(consumed.value), orig_len)
+
+    def decode_binary(self, data: bytes, max_events: int | None = None):
+        """Like ``decode`` but for a u32-length-prefixed stream of binary
+        event records (stream/binfmt.py layout); shares the same intern
+        tables, so mixed JSON/binary sessions keep stable ids."""
+        from heatmap_tpu.stream.events import columns_from_arrays
+
+        cap = (max_events if max_events is not None
+               else len(data) // 36 + 1)  # min frame = 4 + 32-byte header
+        lat = np.empty(cap, np.float32)
+        lon = np.empty(cap, np.float32)
+        speed = np.empty(cap, np.float32)
+        ts = np.empty(cap, np.int32)
+        pid = np.empty(cap, np.int32)
+        vid = np.empty(cap, np.int32)
+        dropped = ctypes.c_int64(0)
+        consumed = ctypes.c_int64(0)
+        n = self._lib.dec_decode_binary(
+            self._h, data, len(data), cap,
+            lat, lon, speed, ts, pid, vid,
+            ctypes.byref(dropped), ctypes.byref(consumed),
+        )
+        self._refresh_interns()
+        cols = columns_from_arrays(
+            lat[:n], lon[:n], speed[:n], ts[:n],
+            provider_id=pid[:n], vehicle_id=vid[:n],
+            providers=self._providers, vehicles=self._vehicles,
+        )
+        cols.n_dropped = int(dropped.value)
+        return cols, int(consumed.value)
 
 
 class NativeTileOps:

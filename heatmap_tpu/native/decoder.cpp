@@ -454,3 +454,94 @@ int64_t dec_decode(void* dv, const char* buf, int64_t len, int64_t cap,
 }
 
 }  // extern "C"
+
+namespace {
+
+// Strict UTF-8 validity (rejects overlongs, surrogates, >U+10FFFF) — the
+// binary path must drop exactly what Python's bytes.decode("utf-8") rejects
+// (stream/binfmt.py decode_event), so acceptance is toolchain-independent.
+bool utf8_valid(const unsigned char* s, size_t n) {
+    size_t i = 0;
+    while (i < n) {
+        unsigned char c = s[i];
+        if (c < 0x80) { ++i; continue; }
+        int extra;
+        uint32_t cp;
+        if ((c & 0xE0) == 0xC0) { extra = 1; cp = c & 0x1F; }
+        else if ((c & 0xF0) == 0xE0) { extra = 2; cp = c & 0x0F; }
+        else if ((c & 0xF8) == 0xF0) { extra = 3; cp = c & 0x07; }
+        else return false;
+        if (i + extra >= n) return false;
+        for (int k = 1; k <= extra; ++k) {
+            unsigned char cc = s[i + k];
+            if ((cc & 0xC0) != 0x80) return false;
+            cp = (cp << 6) | (cc & 0x3F);
+        }
+        if (extra == 1 && cp < 0x80) return false;          // overlong
+        if (extra == 2 && cp < 0x800) return false;
+        if (extra == 3 && cp < 0x10000) return false;
+        if (cp >= 0xD800 && cp <= 0xDFFF) return false;     // surrogate
+        if (cp > 0x10FFFF) return false;
+        i += 1 + extra;
+    }
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode up to `cap` events from a u32-length-prefixed stream of binary
+// event records (layout: stream/binfmt.py — magic 0xB1, version 1).  Same
+// output contract as dec_decode; a partial trailing record is left
+// unconsumed for streaming.  Invalid envelopes/fields are dropped with
+// the same rules as the JSON/Python paths.
+int64_t dec_decode_binary(void* dv, const char* buf, int64_t len,
+                          int64_t cap,
+                          float* lat, float* lon, float* speed, int32_t* ts,
+                          int32_t* provider_id, int32_t* vehicle_id,
+                          int64_t* n_dropped, int64_t* consumed) {
+    Decoder* d = (Decoder*)dv;
+    int64_t out = 0, dropped = 0;
+    int64_t i = 0;
+    *consumed = 0;
+    while (i + 4 <= len && out < cap) {
+        uint32_t n;
+        memcpy(&n, buf + i, 4);
+        if (i + 4 + (int64_t)n > len) break;  // partial trailing record
+        const unsigned char* r = (const unsigned char*)buf + i + 4;
+        i += 4 + n;
+        *consumed = i;
+        if (n < 32 || r[0] != 0xB1 || r[1] != 1) { ++dropped; continue; }
+        uint32_t pn = r[2], vn = r[3];
+        if (32 + pn + vn != n) { ++dropped; continue; }
+        float f[5];
+        memcpy(f, r + 4, 20);
+        int64_t tsv;
+        memcpy(&tsv, r + 24, 8);
+        double la = f[0], lo = f[1];
+        if (!std::isfinite(la) || !std::isfinite(lo) ||
+            la < -90.0 || la > 90.0 || lo < -180.0 || lo > 180.0 ||
+            tsv < 0 || tsv >= 2147483648LL) {
+            ++dropped;
+            continue;
+        }
+        if (!utf8_valid(r + 32, pn) || !utf8_valid(r + 32 + pn, vn)) {
+            ++dropped;
+            continue;
+        }
+        float sp = f[2];
+        if (!std::isfinite(sp)) sp = 0.0f;
+        lat[out] = (float)la;
+        lon[out] = (float)lo;
+        speed[out] = sp;
+        ts[out] = (int32_t)tsv;
+        provider_id[out] = d->providers.get((const char*)r + 32, pn);
+        vehicle_id[out] = d->vehicles.get((const char*)r + 32 + pn, vn);
+        ++out;
+    }
+    *n_dropped = dropped;
+    return out;
+}
+
+}  // extern "C"

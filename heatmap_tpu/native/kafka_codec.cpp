@@ -118,23 +118,26 @@ uint32_t kc_crc32c(const uint8_t* p, int64_t n, uint32_t crc) {
     return crc32c_impl(p, n, crc);
 }
 
-// Decode a Fetch records blob into a newline-joined values buffer.
+// Decode a Fetch records blob into a joined values buffer.
 //
-//   blob      : out, >= len bytes + one newline per value
+//   framing   : 0 = newline-joined (JSON values; records whose value
+//               contains a raw \n/\r are counted as oddballs and the
+//               caller falls back); 1 = u32-length-prefixed (arbitrary
+//               bytes — the binary event format, stream/binfmt.py)
+//   blob      : out, >= len + cap_vals * (framing ? 4 : 1) bytes
 //   val_off   : out, kafka offset of emitted value v
-//   val_pos   : out, start of value v in blob
+//   val_pos   : out, start of value v's frame in blob
 //   out_state : [blob_len, next_offset, n_skipped_batches, n_oddballs,
 //               n_null]
 //
-// Emits only records with offset >= start_offset and non-null values
-// without raw \n/\r bytes.  Returns the number of emitted values, or -1
-// when an output capacity is exceeded (caller sizes blob_cap >= len +
-// cap_vals and cap_vals >= len/6 + 8, which cannot overflow for wellformed
+// Emits only records with offset >= start_offset and non-null values.
+// Returns the number of emitted values, or -1 when an output capacity is
+// exceeded (caller sizes capacities so this cannot happen for well-formed
 // input; -1 therefore means malformed varints, and the caller falls back
 // to the Python path).
 int64_t kc_decode_values(
     const uint8_t* buf, int64_t len,
-    int64_t start_offset, int32_t verify_crc,
+    int64_t start_offset, int32_t verify_crc, int32_t framing,
     uint8_t* blob, int64_t blob_cap,
     int64_t* val_off, int64_t* val_pos, int64_t cap_vals,
     int64_t* out_state) {
@@ -194,21 +197,29 @@ int64_t kc_decode_values(
                 } else {
                     if (k + vn > rec_end) return -1;
                     bool odd = false;
-                    for (int64_t t = 0; t < vn; t++) {
-                        uint8_t c = buf[k + t];
-                        if (c == '\n' || c == '\r') { odd = true; break; }
+                    if (framing == 0) {
+                        for (int64_t t = 0; t < vn; t++) {
+                            uint8_t c = buf[k + t];
+                            if (c == '\n' || c == '\r') { odd = true; break; }
+                        }
                     }
                     if (odd) {
                         n_odd++;
                     } else {
+                        int64_t frame = framing ? vn + 4 : vn + 1;
                         if (n_vals >= cap_vals ||
-                            blob_len + vn + 1 > blob_cap)
+                            blob_len + frame > blob_cap)
                             return -1;
                         val_off[n_vals] = voff;
                         val_pos[n_vals] = blob_len;
+                        if (framing) {
+                            uint32_t vlen = (uint32_t)vn;
+                            std::memcpy(blob + blob_len, &vlen, 4);
+                            blob_len += 4;
+                        }
                         std::memcpy(blob + blob_len, buf + k, vn);
                         blob_len += vn;
-                        blob[blob_len++] = '\n';
+                        if (!framing) blob[blob_len++] = '\n';
                         n_vals++;
                     }
                 }

@@ -65,10 +65,22 @@ class KafkaPublisher(Publisher):
     by murmur2(key) exactly like stock clients.  Set HEATMAP_KAFKA_IMPL to
     wire | confluent to pin one."""
 
-    def __init__(self, bootstrap: str, topic: str, impl: str | None = None):
+    def __init__(self, bootstrap: str, topic: str, impl: str | None = None,
+                 event_format: str | None = None):
         import os
 
         self.topic = topic
+        # "json" (the reference's documented schema, README.md:191-204) or
+        # "binary" (stream/binfmt.py fixed layout — the high-rate option;
+        # consumers pick the matching HEATMAP_EVENT_FORMAT)
+        self.event_format = event_format or os.environ.get(
+            "HEATMAP_EVENT_FORMAT", "json")
+        if self.event_format == "binary":
+            from heatmap_tpu.stream.binfmt import encode_event
+
+            self._encode_value = encode_event
+        else:
+            self._encode_value = lambda e: json.dumps(e).encode("utf-8")
         impl = impl or os.environ.get("HEATMAP_KAFKA_IMPL", "auto")
         self._mode = "wire"
         if impl in ("auto", "confluent"):
@@ -106,7 +118,7 @@ class KafkaPublisher(Publisher):
         if self._mode == "confluent":
             for e in events:
                 self._p.produce(self.topic, key=str(e.get("vehicleId", "")),
-                                value=json.dumps(e).encode("utf-8"))
+                                value=self._encode_value(e))
             return
         from heatmap_tpu.kafka import Record
         from heatmap_tpu.kafka.client import partition_for_key
@@ -117,7 +129,7 @@ class KafkaPublisher(Publisher):
             key = str(e.get("vehicleId", "")).encode("utf-8")
             p = partition_for_key(key, len(parts))
             self._pending.setdefault(p, []).append(
-                Record(0, now_ms, key, json.dumps(e).encode("utf-8")))
+                Record(0, now_ms, key, self._encode_value(e)))
 
     def flush(self) -> None:
         if self._mode == "confluent":

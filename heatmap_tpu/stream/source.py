@@ -45,13 +45,23 @@ class Source(abc.ABC):
 
 
 def _decode_raw_values(dec, values: list[bytes], intern_p: dict,
-                       intern_v: dict):
-    """Raw JSON document byte-strings -> EventColumns, via the C++ decoder
-    when available, else json.loads + parse_events.  Both paths drop the
-    same documents AND count them in n_dropped, so the events_invalid
-    metric does not depend on whether a toolchain exists."""
+                       intern_v: dict, fmt: str = "json"):
+    """Raw event value byte-strings -> EventColumns, via the C++ decoder
+    when available, else the Python codecs.  Both paths drop the same
+    documents AND count them in n_dropped, so the events_invalid metric
+    does not depend on whether a toolchain exists."""
     if not values:
         return []
+    if fmt == "binary":
+        from heatmap_tpu.stream import binfmt
+
+        if dec is not None:
+            cols, _ = dec.decode_binary(binfmt.frame_lp(values))
+            return cols
+        dicts, dropped = binfmt.decode_events(values)
+        cols = parse_events(dicts, intern_p, intern_v)
+        cols.n_dropped += dropped
+        return cols
     if dec is not None:
         from heatmap_tpu.native import decode_lines
 
@@ -282,6 +292,26 @@ class KafkaSource(Source):
         self._impl.close()
 
 
+def _value_decoder():
+    """Per-message value -> event dict (or None = drop), honoring
+    HEATMAP_EVENT_FORMAT so every consumer impl speaks the same format as
+    the publisher (stream/binfmt.py for "binary", JSON otherwise)."""
+    import os
+
+    if os.environ.get("HEATMAP_EVENT_FORMAT", "json") == "binary":
+        from heatmap_tpu.stream.binfmt import decode_event
+
+        return decode_event
+
+    def _json(value):
+        try:
+            return json.loads(value)
+        except (json.JSONDecodeError, TypeError, UnicodeDecodeError):
+            return None
+
+    return _json
+
+
 class _ConfluentImpl:
     def __init__(self, bootstrap, topic, group):
         from confluent_kafka import Consumer
@@ -295,6 +325,7 @@ class _ConfluentImpl:
         self.c.subscribe([topic])
         self.topic = topic
         self._offsets: dict[int, int] = {}
+        self._decode_value = _value_decoder()
 
     def poll(self, max_events):
         out = []
@@ -302,11 +333,10 @@ class _ConfluentImpl:
         for m in msgs:
             if m.error():
                 continue
-            try:
-                out.append(json.loads(m.value()))
-            except (json.JSONDecodeError, TypeError):
-                continue
+            d = self._decode_value(m.value())
             self._offsets[m.partition()] = m.offset() + 1
+            if d is not None:
+                out.append(d)
         return out
 
     def offset(self):
@@ -333,17 +363,21 @@ class _KafkaPythonImpl:
             bootstrap_servers=bootstrap,
             enable_auto_commit=False,
             auto_offset_reset="latest",
-            value_deserializer=lambda b: json.loads(b.decode("utf-8")),
+            # decode (json or binary) happens in poll so a malformed value
+            # is dropped rather than crashing the iterator
             consumer_timeout_ms=50,
         )
         self._offsets: dict[int, int] = {}
+        self._decode_value = _value_decoder()
 
     def poll(self, max_events):
         out = []
         try:
             for m in self.c:
-                out.append(m.value)
+                d = self._decode_value(m.value)
                 self._offsets[m.partition] = m.offset + 1
+                if d is not None:
+                    out.append(d)
                 if len(out) >= max_events:
                     break
         except StopIteration:
@@ -371,12 +405,16 @@ class _WireImpl:
 
     def __init__(self, bootstrap, topic):
         import logging
+        import os
 
         from heatmap_tpu.kafka import KafkaClient
 
         self.log = logging.getLogger(__name__)
         self.c = KafkaClient(bootstrap)
         self.topic = topic
+        # event value encoding on this topic: "json" (reference contract)
+        # or "binary" (stream/binfmt.py — the high-rate option)
+        self._fmt = os.environ.get("HEATMAP_EVENT_FORMAT", "json")
         self._offsets: dict[int, int] = {}
         self._discover()
         self._rr = 0  # round-robin cursor
@@ -469,14 +507,17 @@ class _WireImpl:
                 self._offsets[p] = max(self._offsets[p], fr.next_offset)
         self._rr = (self._rr + 1) % max(len(parts), 1)
         return _decode_raw_values(self._dec, out,
-                                  self._intern_p, self._intern_v)
+                                  self._intern_p, self._intern_v, self._fmt)
 
     def _poll_columnar(self, max_events):
-        """Hot path: Fetch blobs decode to newline-joined value buffers in
-        C++ (native.kafka_decode_values) and feed the columnar JSON decoder
+        """Hot path: Fetch blobs decode to joined value buffers in C++
+        (native.kafka_decode_values — newline framing for JSON,
+        length-prefixed for binary events) and feed the columnar decoder
         directly — per-record Python only on the rare fallback (corrupt
-        varints / newline-bearing values), where values are re-serialized
-        compact and joined into the same stream."""
+        varints / newline-bearing JSON values), where values are re-framed
+        into the same stream."""
+        binary = self._fmt == "binary"
+        framing = "lp" if binary else "newline"
         if not self._offsets:
             self._discover()
         parts = sorted(self._offsets)
@@ -491,7 +532,8 @@ class _WireImpl:
             p = parts[(self._rr + k) % len(parts)]
             res = self._guarded_fetch(
                 p, lambda p=p: self.c.fetch_values(
-                    self.topic, p, self._offsets[p], max_wait_ms=50))
+                    self.topic, p, self._offsets[p], max_wait_ms=50,
+                    framing=framing))
             if res is None:
                 continue
             _hw, fv = res
@@ -521,6 +563,12 @@ class _WireImpl:
                     self._offsets[p] = r.offset + 1
                     if r.value is None:
                         continue
+                    if binary:
+                        from heatmap_tpu.stream.binfmt import frame_lp
+
+                        blobs.append(frame_lp([r.value]))
+                        n_out += 1
+                        continue
                     try:
                         blobs.append(
                             json.dumps(json.loads(r.value)).encode() + b"\n")
@@ -536,7 +584,11 @@ class _WireImpl:
                 cols.n_dropped = pre_dropped
                 return cols
             return []
-        cols, _ = self._dec.decode(b"".join(blobs), final=True)
+        joined = b"".join(blobs)
+        if binary:
+            cols, _ = self._dec.decode_binary(joined)
+        else:
+            cols, _ = self._dec.decode(joined, final=True)
         cols.n_dropped += pre_dropped
         return cols
 

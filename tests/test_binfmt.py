@@ -1,0 +1,151 @@
+"""Binary event format (stream/binfmt.py + native dec_decode_binary +
+kafka length-prefixed framing): Python/C++ differential and the full
+publisher → broker → source → columns round trip in both formats."""
+
+import math
+
+import numpy as np
+import pytest
+
+from heatmap_tpu.stream import binfmt
+from heatmap_tpu.stream.events import parse_events
+
+
+def _events(n, start=0):
+    return [{"provider": "mbta", "vehicleId": f"veh-{i % 7}",
+             "lat": 42.3 + i * 1e-4, "lon": -71.05, "speedKmh": 30.0 + i,
+             "bearing": 12.5, "accuracyM": 5.0,
+             "ts": 1_700_000_000 + start + i} for i in range(n)]
+
+
+def test_roundtrip_python():
+    evs = _events(20)
+    vals = [binfmt.encode_event(e) for e in evs]
+    back, dropped = binfmt.decode_events(vals)
+    assert dropped == 0
+    for e, b in zip(evs, back):
+        assert b["provider"] == e["provider"]
+        assert b["vehicleId"] == e["vehicleId"]
+        assert b["lat"] == pytest.approx(e["lat"], rel=1e-6)  # f32
+        assert b["speedKmh"] == pytest.approx(e["speedKmh"], rel=1e-6)
+        assert b["ts"] == e["ts"]
+
+
+def test_encode_validates():
+    with pytest.raises(ValueError):
+        binfmt.encode_event({"provider": "p" * 300, "vehicleId": "v",
+                             "lat": 0, "lon": 0, "ts": 1})
+    with pytest.raises(ValueError):
+        binfmt.encode_event({"provider": "p", "vehicleId": "v",
+                             "lat": 0, "lon": 0, "ts": "not-a-ts"})
+    # non-finite optional floats coerce to 0 like the JSON path
+    b = binfmt.encode_event({"provider": "p", "vehicleId": "v", "lat": 1.0,
+                             "lon": 2.0, "speedKmh": math.inf, "ts": 5})
+    assert binfmt.decode_event(b)["speedKmh"] == 0.0
+
+
+def test_decode_rejects_bad_envelopes():
+    good = binfmt.encode_event(_events(1)[0])
+    assert binfmt.decode_event(good) is not None
+    assert binfmt.decode_event(b"") is None
+    assert binfmt.decode_event(good[:-1]) is None          # truncated
+    assert binfmt.decode_event(b"\x00" + good[1:]) is None  # bad magic
+    assert binfmt.decode_event(good + b"x") is None         # trailing junk
+    bad_utf8 = bytearray(good)
+    bad_utf8[binfmt.HEADER_SIZE] = 0xFF  # invalid UTF-8 in provider
+    assert binfmt.decode_event(bytes(bad_utf8)) is None
+
+
+def _native_dec():
+    from heatmap_tpu.native import NativeDecoder
+
+    if not NativeDecoder.available():
+        pytest.skip("no C++ toolchain")
+    return NativeDecoder()
+
+
+def test_native_binary_matches_python():
+    dec = _native_dec()
+    evs = _events(100)
+    # inject drops: out-of-range lat, bad ts, bad magic, invalid utf-8
+    vals = [binfmt.encode_event(e) for e in evs]
+    bad_lat = binfmt.encode_event(dict(evs[0], lat=50))
+    bad_lat = bytearray(bad_lat)
+    import struct as st
+    st.pack_into("<f", bad_lat, 4, 99.0)  # lat out of range
+    vals.insert(5, bytes(bad_lat))
+    vals.insert(9, b"\x00garbage")
+    utf = bytearray(binfmt.encode_event(evs[1]))
+    utf[binfmt.HEADER_SIZE] = 0xED  # surrogate-ish start byte
+    vals.insert(15, bytes(utf))
+
+    cols, consumed = dec.decode_binary(binfmt.frame_lp(vals))
+    dicts, env_dropped = binfmt.decode_events(vals)
+    want = parse_events(dicts, {}, {})
+    assert len(cols) == len(want) == 100
+    assert cols.n_dropped == want.n_dropped + env_dropped == 3
+    np.testing.assert_allclose(cols.lat_deg, want.lat_deg, rtol=1e-6)
+    np.testing.assert_array_equal(cols.ts_s, want.ts_s)
+    got_v = [cols.vehicles[i] for i in cols.vehicle_id]
+    want_v = [want.vehicles[i] for i in want.vehicle_id]
+    assert got_v == want_v
+    assert [cols.providers[i] for i in cols.provider_id] == \
+        [want.providers[i] for i in want.provider_id]
+
+
+def test_native_binary_partial_trailing_record():
+    dec = _native_dec()
+    vals = [binfmt.encode_event(e) for e in _events(3)]
+    blob = binfmt.frame_lp(vals)
+    cut = blob[:-5]
+    cols, consumed = dec.decode_binary(cut)
+    assert len(cols) == 2
+    assert consumed == len(binfmt.frame_lp(vals[:2]))
+
+
+def test_kafka_binary_end_to_end():
+    """publisher(binary) → wire broker → KafkaSource → EventColumns equals
+    the JSON path over the same events (store-level equivalence)."""
+    import os
+    from unittest import mock
+
+    from heatmap_tpu.producers.base import KafkaPublisher
+    from heatmap_tpu.stream.events import EventColumns
+    from heatmap_tpu.stream.source import KafkaSource
+    from heatmap_tpu.testing.mock_kafka import MockKafkaBroker
+
+    evs = _events(50)
+
+    def run(fmt):
+        with mock.patch.dict(os.environ,
+                             {"HEATMAP_EVENT_FORMAT": fmt,
+                              "HEATMAP_KAFKA_IMPL": "wire"}):
+            b = MockKafkaBroker()
+            src = KafkaSource(b.bootstrap, "tbin")
+            pub = KafkaPublisher(b.bootstrap, "tbin")
+            pub.publish(evs)
+            pub.flush()
+            rows = {}
+            for _ in range(10):
+                polled = src.poll(64)
+                assert isinstance(polled, (list, EventColumns))
+                if isinstance(polled, EventColumns):
+                    for i in range(len(polled)):
+                        rows[int(polled.ts_s[i])] = (
+                            round(float(polled.lat_deg[i]), 5),
+                            round(float(polled.speed_kmh[i]), 3),
+                            polled.vehicles[int(polled.vehicle_id[i])],
+                        )
+                else:
+                    for e in polled:
+                        rows[int(e["ts"])] = (round(float(e["lat"]), 5),
+                                              round(float(e["speedKmh"]), 3),
+                                              e["vehicleId"])
+                if len(rows) >= 50:
+                    break
+            pub.close()
+            src.close()
+            b.close()
+            return rows
+
+    assert run("binary") == run("json")
