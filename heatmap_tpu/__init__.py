@@ -25,8 +25,9 @@ Layout
 - ``producers`` — MBTA / OpenSky / synthetic producers
                   (reference: mbta_to_kafka.py; README.md:111-117).
 - ``models``    — the five benchmark pipeline configurations (BASELINE.json).
-- ``ops``       — low-level device ops incl. the Pallas H3 kernel.
-- ``native``    — C++ host components (fast decode, host H3) via ctypes.
+- ``kafka``     — the Kafka wire protocol, in-framework (no client library).
+- ``native``    — C++ host components via ctypes: JSON/binary event decode,
+                  Kafka RecordBatch decode + CRC32C, columnar→BSON tile ops.
 """
 
 __version__ = "0.1.0"
