@@ -84,7 +84,9 @@ class MicroBatchRuntime:
         self.max_event_ts = I32_MIN
         self._intern_p: dict[str, int] = {}
         self._intern_v: dict[str, int] = {}
-        self._positions: dict[int, tuple] = {}  # vid -> (ts, lat, lon, pid)
+        # per-vehicle-intern-id last emitted ts (monotonic guard), grown on
+        # demand; -2^62 = "never seen" sentinel below any valid epoch
+        self._pos_ts = np.full(1024, -(2**62), np.int64)
         self._overflow_warned = False
 
         # one aggregator per (resolution, window) pair (BASELINE configs 4/5)
@@ -264,7 +266,9 @@ class MicroBatchRuntime:
     def _fold_positions(self, cols: EventColumns) -> list[dict]:
         """Latest position per vehicle, monotonic in ts (the *intent* of the
         reference's conditional upsert, heatmap_stream.py:198-228, without
-        its duplicate-key race)."""
+        its duplicate-key race).  The per-vehicle newest-event selection
+        and the newer-than-stored comparison are fully vectorized; Python
+        touches only the vehicles that actually changed."""
         if not len(cols):
             return []
         vid = cols.vehicle_id
@@ -272,24 +276,32 @@ class MicroBatchRuntime:
         last = np.nonzero(
             np.concatenate([vid[order][1:] != vid[order][:-1], [True]])
         )[0]
-        rows = order[last]
-        changed = []
-        for r in rows:
-            v = int(vid[r])
-            ts = int(cols.ts_s[r])
-            cur = self._positions.get(v)
-            if cur is None or cur[0] < ts:
-                self._positions[v] = (
-                    ts, float(cols.lat_deg[r]), float(cols.lng_deg[r]),
-                    int(cols.provider_id[r]),
-                )
-                changed.append(v)
+        rows = order[last]                       # one row per vehicle in batch
+        v_ids = vid[rows]
+        ts_new = cols.ts_s[rows].astype(np.int64)
+        # grow the persistent per-vehicle last-ts table to cover new ids
+        need = int(v_ids.max()) + 1
+        if need > len(self._pos_ts):
+            grown = np.full(max(need, 2 * len(self._pos_ts)), -(2**62),
+                            np.int64)
+            grown[:len(self._pos_ts)] = self._pos_ts
+            self._pos_ts = grown
+        newer = ts_new > self._pos_ts[v_ids]
+        rows = rows[newer]
+        if rows.size == 0:
+            return []
+        self._pos_ts[vid[rows]] = cols.ts_s[rows]
         docs = []
-        for v in changed:
-            ts, la, lo, p = self._positions[v]
-            provider = cols.providers[p] if p < len(cols.providers) else "?"
-            vehicle = cols.vehicles[v] if v < len(cols.vehicles) else str(v)
-            docs.append(PositionDoc(provider, vehicle, epoch_to_dt(ts), la, lo))
+        providers, vehicles = cols.providers, cols.vehicles
+        lat, lng, pid = cols.lat_deg, cols.lng_deg, cols.provider_id
+        for r in rows:
+            p = int(pid[r])
+            v = int(vid[r])
+            docs.append(PositionDoc(
+                providers[p] if p < len(providers) else "?",
+                vehicles[v] if v < len(vehicles) else str(v),
+                epoch_to_dt(int(cols.ts_s[r])),
+                float(lat[r]), float(lng[r])))
         return docs
 
     def _account_pair_packed(self, res: int, wmin: int, body, stats) -> int:
