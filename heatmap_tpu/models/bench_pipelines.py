@@ -1,0 +1,84 @@
+"""Per-config benchmark sweep: every BASELINE.json pipeline through the
+full streaming runtime (synthetic source → device aggregation → memory
+store), one JSON line per config.
+
+``python -m heatmap_tpu.models.bench_pipelines [--events N] [--batch B]``
+
+This complements the repo-root ``bench.py`` (the headline single-metric
+backfill harness the driver runs): here every (res, window) topology —
+single pair, multi-res pyramid, sliding multi-window — exercises the same
+fused per-pair step the production runtime uses, including emit packing,
+sink submission, and watermarking.  Sources are forced synthetic so the
+sweep is hermetic; Kafka-facing behavior is benchmarked by bench.py's
+ingest path and the kafka microbenches.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def bench_one(name: str, n_events: int, batch: int) -> dict:
+    from heatmap_tpu.config import load_config
+    from heatmap_tpu.models.pipelines import get_pipeline
+    from heatmap_tpu.sink import MemoryStore
+    from heatmap_tpu.stream import MicroBatchRuntime, SyntheticSource
+
+    p = get_pipeline(name)
+    cfg = load_config(
+        {},
+        resolutions=p.config.resolutions,
+        windows_minutes=p.config.windows_minutes,
+        h3_res=p.config.h3_res,
+        tile_minutes=p.config.tile_minutes,
+        speed_hist_bins=p.config.speed_hist_bins,
+        state_capacity_log2=max(p.config.state_capacity_log2, 16),
+        batch_size=batch,
+        store="memory",
+        checkpoint_dir=f"/tmp/bench-pipelines-{name}-{int(time.time())}",
+    )
+    src = SyntheticSource(n_events=n_events, n_vehicles=20_000,
+                         t0=int(time.time()) - 300, events_per_second=batch)
+    rt = MicroBatchRuntime(cfg, src, MemoryStore(), checkpoint_every=0)
+    # warmup/compile outside the timed region: one batch (its events are
+    # excluded from the throughput numerator below)
+    rt.step_once()
+    warm = rt.metrics.snapshot().get("events_valid", 0)
+    t0 = time.monotonic()
+    rt.run()
+    wall = time.monotonic() - t0
+    snap = rt.metrics.snapshot()
+    n_total = snap.get("events_valid", 0)
+    n_timed = n_total - warm
+    return {
+        "pipeline": name,
+        "pairs": len(cfg.resolutions) * len(cfg.windows_minutes),
+        "events": n_total,
+        "events_per_sec": (round(n_timed / wall, 1)
+                           if wall > 0 and n_timed else None),
+        "batch_p50_ms": snap.get("batch_latency_p50_ms"),
+        "tiles_emitted": snap.get("tiles_emitted"),
+    }
+
+
+def main(argv=None) -> list[dict]:
+    from heatmap_tpu.models.pipelines import PIPELINES
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--events", type=int, default=1 << 18)
+    ap.add_argument("--batch", type=int, default=1 << 14)
+    ap.add_argument("--pipelines", nargs="*", default=sorted(PIPELINES))
+    args = ap.parse_args(argv)
+
+    out = []
+    for name in args.pipelines:
+        r = bench_one(name, args.events, args.batch)
+        print(json.dumps(r), flush=True)
+        out.append(r)
+    return out
+
+
+if __name__ == "__main__":
+    main()

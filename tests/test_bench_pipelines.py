@@ -1,0 +1,18 @@
+"""The per-config benchmark sweep must drive each named pipeline through
+the real runtime and report sane numbers (smoke: one single-pair and one
+multi-pair config, tiny sizes)."""
+
+import pytest
+
+from heatmap_tpu.models.bench_pipelines import bench_one
+
+
+@pytest.mark.parametrize("name,pairs", [("mbta_default", 1),
+                                        ("multi_window", 3)])
+def test_bench_one(name, pairs):
+    r = bench_one(name, n_events=2048, batch=512)
+    assert r["pipeline"] == name
+    assert r["pairs"] == pairs
+    assert r["events"] == 2048
+    assert r["events_per_sec"] and r["events_per_sec"] > 0
+    assert r["tiles_emitted"] > 0

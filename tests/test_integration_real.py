@@ -47,7 +47,6 @@ def test_pipeline_against_real_services():
 
     topic = f"heatmap-it-{uuid.uuid4().hex[:8]}"
     db = f"heatmap_it_{uuid.uuid4().hex[:8]}"
-    src = KafkaSource(BOOTSTRAP, topic)
     pub = KafkaPublisher(BOOTSTRAP, topic)
     t0 = int(time.time()) - 100
     evs = [{"provider": "it", "vehicleId": f"veh-{i % 11}",
@@ -69,6 +68,16 @@ def test_pipeline_against_real_services():
             time.sleep(0.5)
     else:
         pytest.fail("could not publish to real broker")
+
+    # construct the consumer AFTER the publish: it starts at LATEST (the
+    # reference's startingOffsets semantics), so rewind to the log start
+    # explicitly — on a fresh topic LATEST now points past our 600 events
+    src = KafkaSource(BOOTSTRAP, topic)
+    try:
+        parts = src._impl.c.partitions(topic)
+    except Exception:
+        parts = [0, 1, 2]
+    src.seek({p: 0 for p in parts})
 
     store = MongoStore(MONGO_URI, db)
     cfg = load_config({}, batch_size=256,
