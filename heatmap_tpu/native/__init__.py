@@ -25,7 +25,8 @@ log = logging.getLogger(__name__)
 _HERE = os.path.dirname(__file__)
 _SRCS = [os.path.join(_HERE, "decoder.cpp"),
          os.path.join(_HERE, "tile_ops.cpp"),
-         os.path.join(_HERE, "kafka_codec.cpp")]
+         os.path.join(_HERE, "kafka_codec.cpp"),
+         os.path.join(_HERE, "positions_ops.cpp")]
 _LOCK = threading.Lock()
 _LIB = None
 _LIB_ERR: str | None = None
@@ -117,6 +118,13 @@ def _load():
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
             f32p, f32p, f32p, i32p, i32p, i32p,
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.enc_position_ops.restype = ctypes.c_int64
+        lib.enc_position_ops.argtypes = [
+            f32p, f32p, i64p, ctypes.c_int64,
+            u8p, i64p, u8p, i64p,
+            u8p, ctypes.c_int64,
+            i64p, ctypes.POINTER(ctypes.c_int64),
         ]
         _LIB = lib
         return _LIB
@@ -407,4 +415,60 @@ def maybe_tile_ops(logger=None) -> "NativeTileOps | None":
     except Exception as e:  # pragma: no cover - toolchain-dependent
         if logger is not None:
             logger.info("native tile encoder unavailable (%s)", e)
+    return None
+
+
+class NativePositionOps:
+    """Columnar changed-vehicle rows -> wire-ready monotonic pipeline-update
+    ops (positions_ops.cpp).  ``encode(rows)`` takes a
+    sink.base.PositionRows and returns (ops_bytes, end_offsets, n)."""
+
+    # fixed pipeline skeleton ~330B + strings (id appears twice)
+    _DOC_BOUND = 420
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native position encoder unavailable: "
+                               f"{_LIB_ERR}")
+        self._lib = lib
+
+    @staticmethod
+    def available() -> bool:
+        return _load() is not None
+
+    def encode(self, rows):
+        n = len(rows.ts_ms)
+        prov = [p.encode("utf-8") for p in rows.providers]
+        veh = [v.encode("utf-8") for v in rows.vehicles]
+        prov_off = np.zeros(n + 1, np.int64)
+        veh_off = np.zeros(n + 1, np.int64)
+        np.cumsum([len(p) for p in prov], out=prov_off[1:])
+        np.cumsum([len(v) for v in veh], out=veh_off[1:])
+        prov_buf = np.frombuffer(b"".join(prov) or b"\0", np.uint8)
+        veh_buf = np.frombuffer(b"".join(veh) or b"\0", np.uint8)
+        str_bytes = int(prov_off[-1] + veh_off[-1])
+        cap = n * self._DOC_BOUND + 3 * str_bytes + 1024
+        out = np.empty(cap, np.uint8)
+        offsets = np.empty(max(n, 1), np.int64)
+        nbytes = ctypes.c_int64(0)
+        got = self._lib.enc_position_ops(
+            np.ascontiguousarray(rows.lat, np.float32),
+            np.ascontiguousarray(rows.lon, np.float32),
+            np.ascontiguousarray(rows.ts_ms, np.int64), n,
+            prov_buf, prov_off, veh_buf, veh_off,
+            out, cap, offsets, ctypes.byref(nbytes),
+        )
+        if got < 0:
+            raise RuntimeError("native position encode overflow")
+        return out[:int(nbytes.value)].tobytes(), offsets[:n].copy(), n
+
+
+def maybe_position_ops(logger=None) -> "NativePositionOps | None":
+    try:
+        if NativePositionOps.available():
+            return NativePositionOps()
+    except Exception as e:  # pragma: no cover - toolchain-dependent
+        if logger is not None:
+            logger.info("native position encoder unavailable (%s)", e)
     return None

@@ -142,6 +142,22 @@ def packed_tile_docs(body, meta: TilePackMeta) -> list[dict]:
     return docs
 
 
+class PositionRows(NamedTuple):
+    """Columnar changed-vehicle positions for the packed sink path."""
+
+    lat: Any        # (n,) float32 degrees
+    lon: Any        # (n,) float32 degrees
+    ts_ms: Any      # (n,) int64 epoch milliseconds
+    providers: list  # n provider strings
+    vehicles: list   # n vehicleId strings
+
+    def to_docs(self) -> list[dict]:
+        return [PositionDoc(self.providers[i], self.vehicles[i],
+                            epoch_to_dt(int(self.ts_ms[i]) / 1000.0),
+                            float(self.lat[i]), float(self.lon[i]))
+                for i in range(len(self.ts_ms))]
+
+
 class Store(abc.ABC):
     """Write + read interface over the two collections.
 
@@ -161,6 +177,12 @@ class Store(abc.ABC):
     @abc.abstractmethod
     def upsert_positions(self, docs: Sequence[dict]) -> int:
         """Monotonic upsert position docs by _id; returns number applied."""
+
+    def upsert_positions_packed(self, rows: "PositionRows") -> int:
+        """Monotonic upsert straight from columnar changed-vehicle rows.
+        Default: build docs in Python; MongoStore overrides with the C++
+        pipeline-op encoder when the toolchain allows."""
+        return self.upsert_positions(rows.to_docs())
 
     @abc.abstractmethod
     def latest_window_start(self, grid: str | None = None) -> dt.datetime | None:

@@ -165,9 +165,26 @@ class MongoStore(Store):
                  backend=None):
         self._b = backend if backend is not None else _make_backend(uri, db_name)
         self._tile_ops = None
-        self._tile_ops_probed = False
+        self._pos_ops = None
+        self._native_probed = False
         if ensure_indexes:
             self.ensure_indexes()
+
+    def _probe_native(self) -> None:
+        """One-shot probe of the C++ encoders (wire backend only — the
+        doc-sequence write path is the framework's own client)."""
+        if self._native_probed:
+            return
+        self._native_probed = True
+        if not isinstance(self._b, _WireBackend):
+            return
+        from heatmap_tpu.native import maybe_position_ops, maybe_tile_ops
+
+        self._tile_ops = maybe_tile_ops(log)
+        self._pos_ops = maybe_position_ops(log)
+        if self._tile_ops is None:
+            log.warning("C++ tile encoder unavailable; tiles take the "
+                        "per-row Python doc-builder path")
 
     def ensure_indexes(self) -> None:
         self._b.ensure_indexes()
@@ -183,15 +200,7 @@ class MongoStore(Store):
         """Fast path: C++ columnar->BSON encode + OP_MSG document-sequence
         writes (wire backend only); falls back to the Python doc builder
         when the toolchain or backend doesn't allow."""
-        if not self._tile_ops_probed:
-            self._tile_ops_probed = True
-            if isinstance(self._b, _WireBackend):
-                from heatmap_tpu.native import maybe_tile_ops
-
-                self._tile_ops = maybe_tile_ops(log)
-                if self._tile_ops is None:
-                    log.warning("C++ tile encoder unavailable; tiles take "
-                                "the per-row Python doc-builder path")
+        self._probe_native()
         if self._tile_ops is None:
             return super().upsert_tiles_packed(body, meta)
         ops, end_offsets, n = self._tile_ops.encode(
@@ -200,6 +209,17 @@ class MongoStore(Store):
         if n:
             self._b.bulk_update_raw("tiles", ops, end_offsets)
         return n
+
+    def upsert_positions_packed(self, rows) -> int:
+        """Fast path: C++ pipeline-op encode (positions_ops.cpp) + OP_MSG
+        document sequences (wire backend only); same monotonic semantics
+        as upsert_positions, whose Python builder remains the fallback and
+        the differential oracle."""
+        self._probe_native()
+        if self._pos_ops is None or not len(rows.ts_ms):
+            return super().upsert_positions_packed(rows)
+        ops, end_offsets, _ = self._pos_ops.encode(rows)
+        return self._b.bulk_update_raw("positions_latest", ops, end_offsets)
 
     def upsert_positions(self, docs: Sequence[dict]) -> int:
         # race-free monotonic upsert: match on _id alone (upsert can only

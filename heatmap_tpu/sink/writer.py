@@ -50,6 +50,8 @@ class AsyncWriter:
                 if kind == "tiles_packed":
                     body, meta = docs
                     return self.store.upsert_tiles_packed(body, meta)
+                if kind == "positions_packed":
+                    return self.store.upsert_positions_packed(docs)
                 return self.store.upsert_positions(docs)
             except Exception:
                 if attempt == self.retries:
@@ -103,6 +105,12 @@ class AsyncWriter:
         next batch's device step."""
         self._check()
         self._q.put(("tiles_packed", (body, meta)))
+
+    def submit_positions_packed(self, rows) -> None:
+        """Columnar changed-vehicle rows (sink.base.PositionRows)."""
+        self._check()
+        if len(rows.ts_ms):
+            self._q.put(("positions_packed", rows))
 
     def submit_positions(self, docs: Sequence[dict]) -> None:
         self._check()

@@ -26,8 +26,8 @@ import numpy as np
 from heatmap_tpu.config import Config
 from heatmap_tpu.engine import AggParams
 from heatmap_tpu.engine.state import TileState
-from heatmap_tpu.sink import AsyncWriter, Store, PositionDoc
-from heatmap_tpu.sink.base import epoch_to_dt
+from heatmap_tpu.sink import AsyncWriter, Store
+from heatmap_tpu.sink.base import PositionRows
 from heatmap_tpu.stream.checkpoint import CheckpointManager
 from heatmap_tpu.stream.events import EventColumns, parse_events
 from heatmap_tpu.stream.metrics import Metrics
@@ -263,14 +263,16 @@ class MicroBatchRuntime:
         out[: len(arr)] = arr
         return out
 
-    def _fold_positions(self, cols: EventColumns) -> list[dict]:
+    def _fold_positions(self, cols: EventColumns):
         """Latest position per vehicle, monotonic in ts (the *intent* of the
         reference's conditional upsert, heatmap_stream.py:198-228, without
         its duplicate-key race).  The per-vehicle newest-event selection
-        and the newer-than-stored comparison are fully vectorized; Python
-        touches only the vehicles that actually changed."""
+        and the newer-than-stored comparison are fully vectorized; returns
+        columnar PositionRows for the changed vehicles (None when none) —
+        the sink encodes them to pipeline-update ops, in C++ on the wire
+        backend (native/positions_ops.cpp)."""
         if not len(cols):
-            return []
+            return None
         vid = cols.vehicle_id
         order = np.lexsort((cols.ts_s, vid))
         last = np.nonzero(
@@ -289,20 +291,19 @@ class MicroBatchRuntime:
         newer = ts_new > self._pos_ts[v_ids]
         rows = rows[newer]
         if rows.size == 0:
-            return []
+            return None
         self._pos_ts[vid[rows]] = cols.ts_s[rows]
-        docs = []
         providers, vehicles = cols.providers, cols.vehicles
-        lat, lng, pid = cols.lat_deg, cols.lng_deg, cols.provider_id
-        for r in rows:
-            p = int(pid[r])
-            v = int(vid[r])
-            docs.append(PositionDoc(
-                providers[p] if p < len(providers) else "?",
-                vehicles[v] if v < len(vehicles) else str(v),
-                epoch_to_dt(int(cols.ts_s[r])),
-                float(lat[r]), float(lng[r])))
-        return docs
+        pid = cols.provider_id
+        return PositionRows(
+            lat=cols.lat_deg[rows],
+            lon=cols.lng_deg[rows],
+            ts_ms=cols.ts_s[rows].astype(np.int64) * 1000,
+            providers=[providers[int(p)] if int(p) < len(providers) else "?"
+                       for p in pid[rows]],
+            vehicles=[vehicles[int(v)] if int(v) < len(vehicles) else str(v)
+                      for v in vid[rows]],
+        )
 
     def _account_pair_packed(self, res: int, wmin: int, body, stats) -> int:
         """Sink one pair's packed emit body rows + book its stats; returns
@@ -417,9 +418,10 @@ class MicroBatchRuntime:
         t_device = time.monotonic()
 
         if self.positions_enabled and cols is not None:
-            pdocs = self._fold_positions(cols)
-            self.writer.submit_positions(pdocs)
-            self.metrics.count("positions_emitted", len(pdocs))
+            prows = self._fold_positions(cols)
+            if prows is not None:
+                self.writer.submit_positions_packed(prows)
+                self.metrics.count("positions_emitted", len(prows.ts_ms))
 
         if batch_max > I32_MIN:
             self.max_event_ts = max(self.max_event_ts, batch_max)
