@@ -115,3 +115,52 @@ def test_cell_ring_consistency():
     lngs = [c[0] for c in ring]
     assert min(lats) < lat < max(lats)
     assert min(lngs) < lng < max(lngs)
+
+
+def test_serving_reads_over_wire_store():
+    """The full read path (find + getMore cursors, datetime round-trips,
+    grid filter) over the framework's own Mongo wire client — the serving
+    deployment the reference runs with pymongo (app.py:16,45-88)."""
+    from heatmap_tpu.sink.mongo import MongoStore, _WireBackend
+    from heatmap_tpu.testing.mock_mongod import MockMongod
+
+    now = dt.datetime.now(UTC).replace(microsecond=0)
+    ws = now - dt.timedelta(minutes=2)
+    import functools
+
+    with MockMongod() as uri:
+        s = MongoStore(uri, "mobility", ensure_indexes=True,
+                       backend=_WireBackend(uri, "mobility"))
+        # force multi-page cursors so the getMore leg genuinely runs
+        # (the client default batchSize of 1000 would fit everything in
+        # firstBatch and silently skip it)
+        s._b.client.find = functools.partial(s._b.client.find, batch_size=40)
+        cells = [hexgrid.latlng_to_cell(42.3 + i * 1e-2, -71.05, 8)
+                 for i in range(150)]  # 4 cursor pages at batch_size=40
+        s.upsert_tiles([
+            TileDoc("bos", 8, c, ws, ws + dt.timedelta(minutes=5),
+                    count=i + 1, avg_speed_kmh=30.0, avg_lat=42.3,
+                    avg_lon=-71.05, ttl_minutes=45)
+            for i, c in enumerate(cells)
+        ])
+        s.upsert_positions([
+            PositionDoc("mbta", f"veh-{i}", now, 42.36, -71.05)
+            for i in range(5)
+        ])
+
+        cfg = load_config({}, serve_port=0)
+        httpd, t, port = start_background(s, cfg)
+        try:
+            base = f"http://127.0.0.1:{port}"
+            fc = get_json(base + "/api/tiles/latest")
+            assert len(fc["features"]) == len(set(cells))
+            counts = {f["properties"]["cellId"]: f["properties"]["count"]
+                      for f in fc["features"]}
+            assert counts[cells[0]] >= 1
+            pc = get_json(base + "/api/positions/latest")
+            assert len(pc["features"]) == 5
+            assert {f["properties"]["vehicleId"] for f in pc["features"]} == \
+                {f"veh-{i}" for i in range(5)}
+        finally:
+            httpd.shutdown()
+            s.close()
