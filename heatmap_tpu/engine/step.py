@@ -117,32 +117,17 @@ def snap_and_window(lat_rad, lng_rad, ts_s, valid, params: AggParams):
     return hi, lo, window_start(ts_s, valid, params.window_s)
 
 
-@functools.partial(jax.jit, static_argnames=("params",))
-def merge_batch(
-    state: TileState,
-    ev_hi,
-    ev_lo,
-    ev_ws,
-    ev_speed,
-    ev_lat_deg,
-    ev_lon_deg,
-    ev_ts,
-    ev_valid,
-    watermark_cutoff,          # int32 scalar: evict windows ending before this
-    params: AggParams,
-):
-    """Fold one batch into the state. Returns (state, BatchEmit, StepStats)."""
-    C = state.capacity
-    N = ev_hi.shape[0]
-    B = state.hist_bins
+def _drop_and_evict(state, ev_hi, ev_lo, ev_ws, ev_valid, watermark_cutoff,
+                    params: AggParams):
+    """Shared prologue: late/future-event drop + window eviction masks.
 
-    # --- late/future-event drop + window eviction (watermark semantics) --
-    # late: the window already closed (ws + window <= cutoff).  future:
-    # more than FUTURE_WINDOWS ahead of the watermark — a clock-skewed
-    # producer poison pill; dropping it also guarantees the live window
-    # span stays < 4096 windows, which the 12-bit window-index sort-key
-    # compression below relies on.  (With the watermark disabled the span
-    # bound is the caller's responsibility — bounded replays only.)
+    late: the window already closed (ws + window <= cutoff).  future:
+    more than FUTURE_WINDOWS ahead of the watermark — a clock-skewed
+    producer poison pill; dropping it also guarantees the live window
+    span stays < 4096 windows, which the 12-bit window-index key
+    compression relies on.  (With the watermark disabled the span bound
+    is the caller's responsibility — bounded replays only.)
+    """
     late = ev_valid & (ev_ws + params.window_s <= watermark_cutoff)
     if FUTURE_WINDOWS:
         has_wm = watermark_cutoff > jnp.int32(-(2**31))
@@ -161,27 +146,82 @@ def merge_batch(
     st_hi = jnp.where(keep, state.key_hi, EMPTY_KEY_HI)
     st_lo = jnp.where(keep, state.key_lo, EMPTY_KEY_LO)
     st_ws = jnp.where(keep, state.key_ws, EMPTY_WS)
+    return (late, ev_valid, ev_hi, ev_lo, ev_ws,
+            evict, keep, st_hi, st_lo, st_ws)
+
+
+def _compress_key(hi, ws, empty, params: AggParams):
+    """96-bit composite key → u32 upper sort key (the low word is `lo`).
+
+    With `res` static, hi's upper bits (mode/res) are constant and its
+    variable part (base cell + coarse digits) fits 20 bits; the window
+    start folds to a 12-bit window index (mod 4096).  Distinct live keys
+    stay distinct while the active window span is < 4096 windows —
+    guaranteed by any sane watermark (4096 x 5 min ≈ 14 days);
+    k1 = 0xFFFFFFFF is unreachable for live rows (base cell <= 121) and
+    marks empties."""
+    wix = (ws // params.window_s).astype(jnp.uint32) & jnp.uint32(0xFFF)
+    return jnp.where(
+        empty,
+        jnp.uint32(0xFFFFFFFF),
+        (wix << 20) | (hi & jnp.uint32(0xFFFFF)),
+    )
+
+
+def merge_batch(
+    state: TileState,
+    ev_hi,
+    ev_lo,
+    ev_ws,
+    ev_speed,
+    ev_lat_deg,
+    ev_lon_deg,
+    ev_ts,
+    ev_valid,
+    watermark_cutoff,          # int32 scalar: evict windows ending before this
+    params: AggParams,
+):
+    """Fold one batch into the state. Returns (state, BatchEmit, StepStats).
+
+    Two equivalent routing implementations (differential-tested against
+    each other): the default full merge-sort over (state ∥ batch), or —
+    with ``HEATMAP_MERGE_IMPL=rank`` — a batch-only sort merged into the
+    already-sorted slab by rank (searchsorted), which does ~sort(N)
+    instead of ~sort(C+N) work and wins when the slab dwarfs the batch
+    (latency-oriented streaming configs).  The env var is read at trace
+    time (like HEATMAP_H3_IMPL)."""
+    import os
+
+    if os.environ.get("HEATMAP_MERGE_IMPL", "sort") == "rank":
+        return _merge_rank(state, ev_hi, ev_lo, ev_ws, ev_speed, ev_lat_deg,
+                           ev_lon_deg, ev_ts, ev_valid, watermark_cutoff,
+                           params)
+    return _merge_sort(state, ev_hi, ev_lo, ev_ws, ev_speed, ev_lat_deg,
+                       ev_lon_deg, ev_ts, ev_valid, watermark_cutoff, params)
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def _merge_sort(
+    state: TileState,
+    ev_hi, ev_lo, ev_ws, ev_speed, ev_lat_deg, ev_lon_deg, ev_ts, ev_valid,
+    watermark_cutoff,
+    params: AggParams,
+):
+    """Routing via one merge-sort of (state ∥ batch) compressed keys."""
+    C = state.capacity
+    N = ev_hi.shape[0]
+
+    (late, ev_valid, ev_hi, ev_lo, ev_ws, evict, keep, st_hi, st_lo,
+     st_ws) = _drop_and_evict(state, ev_hi, ev_lo, ev_ws, ev_valid,
+                              watermark_cutoff, params)
 
     # --- merge-sort state ∥ batch; carry origin row -----------------------
-    # The 96-bit composite key (hi, lo, ws) is compressed EXACTLY into two
-    # u32 sort keys: with `res` static, hi's upper bits (mode/res) are
-    # constant and its variable part (base cell + coarse digits) fits 20
-    # bits; the window start is folded to a 12-bit window index (mod 4096).
-    # Distinct live keys stay distinct as long as the active window span is
-    # < 4096 windows — guaranteed by any sane watermark (4096 x 5 min ≈ 14
-    # days); k1 = 0xFFFFFFFF is unreachable for live rows (base cell <= 121)
-    # and marks empties.  Halving the sort operands nearly halves the cost
-    # of the dominant op in this fold.
+    # Halving the sort operands (2 u32 keys instead of the 96-bit
+    # composite) nearly halves the cost of the dominant op in this fold.
     all_hi = jnp.concatenate([st_hi, ev_hi])
     all_lo = jnp.concatenate([st_lo, ev_lo])
     all_ws = jnp.concatenate([st_ws, ev_ws])
-    empty = all_hi == EMPTY_KEY_HI
-    wix = (all_ws // params.window_s).astype(jnp.uint32) & jnp.uint32(0xFFF)
-    k1 = jnp.where(
-        empty,
-        jnp.uint32(0xFFFFFFFF),
-        (wix << 20) | (all_hi & jnp.uint32(0xFFFFF)),
-    )
+    k1 = _compress_key(all_hi, all_ws, all_hi == EMPTY_KEY_HI, params)
     orig = jnp.arange(C + N, dtype=jnp.int32)  # <C: state row, >=C: batch row
     s_k1, s_k2, s_orig = jax.lax.sort((k1, all_lo, orig), num_keys=2)
 
@@ -199,6 +239,120 @@ def merge_batch(
     # route empties/evictions/lates to the drop bin
     state_seg = jnp.where(keep, state_seg, C)
     batch_seg = jnp.where(ev_valid, batch_seg, C)
+
+    n_seg_total = seg[-1] + 1  # includes the single EMPTY segment if present
+    has_empty = ~nonempty[-1]  # empties (if any) sort last
+    n_distinct = n_seg_total - has_empty.astype(jnp.int32)
+    return _apply_routing(state, ev_hi, ev_lo, ev_ws, ev_speed, ev_lat_deg,
+                          ev_lon_deg, ev_ts, ev_valid, late, evict, keep,
+                          state_seg, batch_seg, n_distinct, params)
+
+
+def _searchsorted_pair(a1, a2, q1, q2):
+    """Leftmost insertion index of each (q1, q2) query into the array
+    sorted lexicographically by (a1, a2) — u32 pairs, since the default
+    no-x64 JAX config has no u64 (a static unrolled binary search; each
+    step is two gathers over the query vector)."""
+    n = a1.shape[0]
+    lo = jnp.zeros(q1.shape, jnp.int32)
+    hi = jnp.full(q1.shape, n, jnp.int32)
+    for _ in range(max(n, 1).bit_length()):
+        mid = (lo + hi) >> 1
+        i = jnp.clip(mid, 0, n - 1)
+        m1 = a1[i]
+        m2 = a2[i]
+        a_lt_q = (m1 < q1) | ((m1 == q1) & (m2 < q2))
+        lo = jnp.where(a_lt_q, mid + 1, lo)
+        hi = jnp.where(a_lt_q, hi, mid)
+    return lo
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def _merge_rank(
+    state: TileState,
+    ev_hi, ev_lo, ev_ws, ev_speed, ev_lat_deg, ev_lon_deg, ev_ts, ev_valid,
+    watermark_cutoff,
+    params: AggParams,
+):
+    """Routing via a batch-only sort merged into the sorted slab by rank.
+
+    The slab's sortedness invariant means the state side never needs
+    re-sorting: evicted rows compact out with a cumsum, the batch's
+    unique keys binary-search their insertion points, and every row's
+    final position is (state rank) + (count of smaller new keys).  Work
+    is ~sort(N) + O((C+N) log) instead of ~sort(C+N)."""
+    C = state.capacity
+    N = ev_hi.shape[0]
+    U32MAX = jnp.uint32(0xFFFFFFFF)
+
+    (late, ev_valid, ev_hi, ev_lo, ev_ws, evict, keep, st_hi, st_lo,
+     st_ws) = _drop_and_evict(state, ev_hi, ev_lo, ev_ws, ev_valid,
+                              watermark_cutoff, params)
+
+    # compressed key pair: (k1, lo); k1 == U32MAX marks empty/invalid and
+    # is unreachable for live rows (see _compress_key)
+    st_k1 = _compress_key(st_hi, st_ws, ~keep, params)
+    ev_k1 = _compress_key(ev_hi, ev_ws, ~ev_valid, params)
+
+    # --- compact the kept state rows (stays sorted: subsequence) ---------
+    keep_i = keep.astype(jnp.int32)
+    pos_k = jnp.cumsum(keep_i) - 1                    # target rank per kept row
+    n_keep = jnp.sum(keep_i)
+    st_dst = jnp.where(keep, pos_k, C)
+    c1 = jnp.full((C,), U32MAX, jnp.uint32).at[st_dst].set(st_k1, mode="drop")
+    c2 = jnp.full((C,), U32MAX, jnp.uint32).at[st_dst].set(st_lo, mode="drop")
+
+    # --- sort the batch only ---------------------------------------------
+    orig = jnp.arange(N, dtype=jnp.int32)
+    s_k1, s_k2, s_orig = jax.lax.sort((ev_k1, ev_lo, orig), num_keys=2)
+    is_start = ((s_k1 != jnp.roll(s_k1, 1))
+                | (s_k2 != jnp.roll(s_k2, 1))).at[0].set(True)
+    seg_b = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+
+    # unique batch keys, ascending at the prefix (padding = (MAX, MAX))
+    u1 = jnp.full((N,), U32MAX, jnp.uint32).at[seg_b].set(s_k1)
+    u2 = jnp.full((N,), U32MAX, jnp.uint32).at[seg_b].set(s_k2)
+    u_valid = u1 != U32MAX
+
+    # --- rank the uniques against the compacted slab ---------------------
+    p_state = _searchsorted_pair(c1, c2, u1, u2)
+    i = jnp.clip(p_state, 0, C - 1)
+    matched = u_valid & (p_state < C) & (c1[i] == u1) & (c2[i] == u2)
+    is_new = u_valid & ~matched
+    new_i = is_new.astype(jnp.int32)
+    before = jnp.cumsum(new_i) - new_i        # new keys strictly smaller
+    out_u = jnp.where(u_valid, p_state + before, C)
+
+    # state-side shift without a second search: slab row j moves right by
+    # #{new keys < c[j]} = #{new: p_state <= j} (a new key inserting at j
+    # is strictly smaller than c[j] — never equal, else it would have
+    # matched), i.e. an inclusive cumsum of insertion-point counts
+    cnt_new = (jnp.zeros((C,), jnp.int32)
+               .at[jnp.where(is_new, p_state, C)].add(1, mode="drop"))
+    out_state_pos = jnp.arange(C, dtype=jnp.int32) + jnp.cumsum(cnt_new)
+
+    # --- routing tables ---------------------------------------------------
+    state_seg = jnp.where(
+        keep, out_state_pos[jnp.clip(pos_k, 0, C - 1)], C)
+    seg_of_orig = jnp.zeros((N,), jnp.int32).at[s_orig].set(seg_b)
+    batch_seg = jnp.where(ev_valid, out_u[seg_of_orig], C)
+    n_distinct = n_keep + jnp.sum(new_i)
+    return _apply_routing(state, ev_hi, ev_lo, ev_ws, ev_speed, ev_lat_deg,
+                          ev_lon_deg, ev_ts, ev_valid, late, evict, keep,
+                          state_seg, batch_seg, n_distinct, params)
+
+
+def _apply_routing(
+    state: TileState,
+    ev_hi, ev_lo, ev_ws, ev_speed, ev_lat_deg, ev_lon_deg, ev_ts, ev_valid,
+    late, evict, keep,
+    state_seg, batch_seg, n_distinct,
+    params: AggParams,
+):
+    """Shared epilogue: rebuild the slab from the routing tables, build the
+    update-mode emit, and assemble StepStats."""
+    C = state.capacity
+    B = state.hist_bins
 
     # --- rebuild the slab ------------------------------------------------
     # keys scatter from the ORIGINAL arrays via the routing maps (the sort
@@ -287,9 +441,6 @@ def merge_batch(
     )
 
     # --- stats ------------------------------------------------------------
-    n_seg_total = seg[-1] + 1  # includes the single EMPTY segment if present
-    has_empty = ~nonempty[-1]  # empties (if any) sort last
-    n_distinct = n_seg_total - has_empty.astype(jnp.int32)
     stats = StepStats(
         n_valid=jnp.sum(one),
         n_late=jnp.sum(late.astype(jnp.int32)),
