@@ -188,11 +188,16 @@ def merge_batch(
     with ``HEATMAP_MERGE_IMPL=rank`` — a batch-only sort merged into the
     already-sorted slab by rank (searchsorted), which does ~sort(N)
     instead of ~sort(C+N) work and wins when the slab dwarfs the batch
-    (latency-oriented streaming configs).  The env var is read at trace
-    time (like HEATMAP_H3_IMPL)."""
+    (latency-oriented streaming configs).  ``auto`` picks by the measured
+    crossover: rank when capacity >= 4x batch (both shapes benched on
+    CPU, see ROADMAP.md — to be re-confirmed on chip).  The env var is
+    read at trace time (like HEATMAP_H3_IMPL)."""
     import os
 
-    if os.environ.get("HEATMAP_MERGE_IMPL", "sort") == "rank":
+    impl = os.environ.get("HEATMAP_MERGE_IMPL", "sort")
+    if impl == "auto":
+        impl = "rank" if state.capacity >= 4 * ev_hi.shape[0] else "sort"
+    if impl == "rank":
         return _merge_rank(state, ev_hi, ev_lo, ev_ws, ev_speed, ev_lat_deg,
                            ev_lon_deg, ev_ts, ev_valid, watermark_cutoff,
                            params)

@@ -100,3 +100,21 @@ def test_env_dispatch(rng):
         n = int(live.sum())
         pairs = list(zip(k1[:n].tolist(), k2[:n].tolist()))
         assert pairs == sorted(pairs) and len(set(pairs)) == n
+
+
+@pytest.mark.parametrize("cap,n,picks_rank", [(2048, 128, True),
+                                              (256, 128, False)])
+def test_env_auto_dispatch(rng, cap, n, picks_rank):
+    """auto picks rank only when the slab dwarfs the batch (>= 4x)."""
+    with mock.patch.dict(os.environ, {"HEATMAP_MERGE_IMPL": "auto"}):
+        st = init_state(cap, 0)
+        lat, lng, speed, ts, valid = make_batch(rng, n)
+        hi, lo, ws = snap_and_window(lat, lng, ts, valid, P)
+        with mock.patch("heatmap_tpu.engine.step._merge_rank",
+                        wraps=_merge_rank) as mr, \
+             mock.patch("heatmap_tpu.engine.step._merge_sort",
+                        wraps=_merge_sort) as ms:
+            merge_batch(st, hi, lo, ws, speed, np.degrees(lat),
+                        np.degrees(lng), ts, valid, np.int32(-2**31), P)
+            assert mr.called == picks_rank
+            assert ms.called == (not picks_rank)
