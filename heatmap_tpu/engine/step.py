@@ -29,6 +29,7 @@ cardinality, SURVEY.md §5.7).
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple
 
 import jax
@@ -48,6 +49,12 @@ I32_MIN = jnp.int32(-(2**31))
 # clock-skew poison (and keep the live span well inside the 4096-window
 # sort-key compression, see merge_batch).
 FUTURE_WINDOWS = 2048
+
+# Merge-fold routing (sort|rank|auto), resolved ONCE at import so every
+# program in the process — fused aggregator traces and direct merge_batch
+# calls alike — uses the same implementation regardless of later env
+# changes.  Override per call with merge_batch(..., impl=...).
+MERGE_IMPL = os.environ.get("HEATMAP_MERGE_IMPL", "sort")
 
 
 class AggParams(NamedTuple):
@@ -180,6 +187,7 @@ def merge_batch(
     ev_valid,
     watermark_cutoff,          # int32 scalar: evict windows ending before this
     params: AggParams,
+    impl: str | None = None,
 ):
     """Fold one batch into the state. Returns (state, BatchEmit, StepStats).
 
@@ -191,10 +199,11 @@ def merge_batch(
     (latency-oriented streaming configs).  ``auto`` picks by the measured
     crossover: rank when capacity >= 4x batch (both shapes benched on
     CPU, see ROADMAP.md — to be re-confirmed on chip).  The env var is
-    read at trace time (like HEATMAP_H3_IMPL)."""
-    import os
-
-    impl = os.environ.get("HEATMAP_MERGE_IMPL", "sort")
+    resolved once at import (module constant ``MERGE_IMPL``) so fused
+    aggregator programs and direct calls can never mix implementations;
+    pass ``impl`` explicitly to override."""
+    if impl is None:
+        impl = MERGE_IMPL
     if impl == "auto":
         impl = "rank" if state.capacity >= 4 * ev_hi.shape[0] else "sort"
     if impl == "rank":

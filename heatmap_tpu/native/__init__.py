@@ -349,6 +349,21 @@ class NativeDecoder:
         return cols, int(consumed.value)
 
 
+def _encode_with_resize(call, cap, what):
+    """Run a native encoder (``call(out, cap) -> n_docs | -needed_bytes``)
+    once; on overflow reallocate to the exact reported size and retry."""
+    out = np.empty(cap, np.uint8)
+    got = call(out, cap)
+    if got < 0:
+        cap = int(-got) + 1024
+        out = np.empty(cap, np.uint8)
+        got = call(out, cap)
+        if got < 0:
+            raise RuntimeError(
+                f"native {what} encode overflow after resize")
+    return out, int(got)
+
+
 class NativeTileOps:
     """Packed-emit rows -> wire-ready BSON update ops (tile_ops.cpp).
 
@@ -381,28 +396,19 @@ class NativeTileOps:
         if body.ndim != 2 or body.shape[1] != 10:
             raise ValueError(f"body must be (E, 10) uint32, got {body.shape}")
         n_rows = body.shape[0]
-        cap = n_rows * self._DOC_BOUND + 1024
-        out = np.empty(cap, np.uint8)
         offsets = np.empty(max(n_rows, 1), np.int64)
         nbytes = ctypes.c_int64(0)
-        n = self._lib.enc_tile_ops(
-            body, n_rows, city.encode(), grid.encode(),
-            window_s * 1000, ttl_minutes * 60_000,
-            window_minutes_tag, int(bool(with_p95)),
-            out, cap, offsets, ctypes.byref(nbytes),
-        )
-        if n < 0:  # undersized buffer (oversized city/grid strings)
-            cap = int(-n) + 1024
-            out = np.empty(cap, np.uint8)
-            n = self._lib.enc_tile_ops(
+
+        def call(out, cap):
+            return self._lib.enc_tile_ops(
                 body, n_rows, city.encode(), grid.encode(),
                 window_s * 1000, ttl_minutes * 60_000,
                 window_minutes_tag, int(bool(with_p95)),
                 out, cap, offsets, ctypes.byref(nbytes),
             )
-            if n < 0:
-                raise RuntimeError("native tile encode overflow after resize")
-        n = int(n)
+
+        out, n = _encode_with_resize(
+            call, n_rows * self._DOC_BOUND + 1024, "tile")
         return out[:int(nbytes.value)].tobytes(), offsets[:n].copy(), n
 
 
@@ -448,19 +454,20 @@ class NativePositionOps:
         prov_buf = np.frombuffer(b"".join(prov) or b"\0", np.uint8)
         veh_buf = np.frombuffer(b"".join(veh) or b"\0", np.uint8)
         str_bytes = int(prov_off[-1] + veh_off[-1])
-        cap = n * self._DOC_BOUND + 3 * str_bytes + 1024
-        out = np.empty(cap, np.uint8)
         offsets = np.empty(max(n, 1), np.int64)
         nbytes = ctypes.c_int64(0)
-        got = self._lib.enc_position_ops(
-            np.ascontiguousarray(rows.lat, np.float32),
-            np.ascontiguousarray(rows.lon, np.float32),
-            np.ascontiguousarray(rows.ts_ms, np.int64), n,
-            prov_buf, prov_off, veh_buf, veh_off,
-            out, cap, offsets, ctypes.byref(nbytes),
-        )
-        if got < 0:
-            raise RuntimeError("native position encode overflow")
+        lat = np.ascontiguousarray(rows.lat, np.float32)
+        lon = np.ascontiguousarray(rows.lon, np.float32)
+        ts_ms = np.ascontiguousarray(rows.ts_ms, np.int64)
+
+        def call(out, cap):
+            return self._lib.enc_position_ops(
+                lat, lon, ts_ms, n, prov_buf, prov_off, veh_buf, veh_off,
+                out, cap, offsets, ctypes.byref(nbytes),
+            )
+
+        out, _ = _encode_with_resize(
+            call, n * self._DOC_BOUND + 3 * str_bytes + 1024, "position")
         return out[:int(nbytes.value)].tobytes(), offsets[:n].copy(), n
 
 

@@ -552,7 +552,10 @@ class _WireImpl:
                     self._offsets[p] = max(self._offsets[p], fv.next_offset)
                 else:
                     blobs.append(fv.blob[:int(fv.val_pos[room])])
-                    self._offsets[p] = int(fv.val_off[room - 1]) + 1
+                    # resume at the first untaken value, so nulls/skipped
+                    # batches between the last taken and first untaken
+                    # value aren't re-fetched (and re-warned) next poll
+                    self._offsets[p] = int(fv.val_off[room])
                     n_out += room
             else:  # FetchResult fallback for this blob
                 taken = 0

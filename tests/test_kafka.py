@@ -244,3 +244,33 @@ def test_partial_take_exactly_once(broker):
     pub.close()
     src.close()
     src2.close()
+
+
+def test_partial_take_resumes_at_first_untaken(broker):
+    """A partial take must resume at the FIRST untaken value's offset, so
+    tombstones (and skipped batches) between the last taken and the first
+    untaken value are not re-fetched on every subsequent poll."""
+    from heatmap_tpu.native import maybe_decoder
+    from heatmap_tpu.stream.events import EventColumns
+    from heatmap_tpu.stream.source import KafkaSource
+
+    if maybe_decoder() is None:
+        pytest.skip("columnar path needs the C++ decoder")
+    c = KafkaClient(broker.bootstrap)
+    vals = [json.dumps(e).encode() for e in _events(3)]
+    c.produce("t6", 0, [Record(0, 0, b"k", vals[0]),
+                        Record(0, 0, b"k", None),  # tombstone in the gap
+                        Record(0, 0, b"k", vals[1]),
+                        Record(0, 0, b"k", vals[2])])
+    src = KafkaSource(broker.bootstrap, "t6")
+    src.seek({0: 0, 1: 0, 2: 0})
+    polled = src.poll(1)
+    assert isinstance(polled, EventColumns) and len(polled) == 1
+    # first untaken value sits at kafka offset 2, past the tombstone at 1
+    assert src.offset()[0] == 2
+    rest = src.poll(16)
+    assert len(rest) == 2
+    assert sorted([int(t) for t in polled.ts_s] +
+                  [int(t) for t in rest.ts_s]) == [e["ts"] for e in _events(3)]
+    src.close()
+    c.close()

@@ -3,7 +3,6 @@ bit-identical to the default full merge-sort across every behavior the
 fold has — watermark eviction, duplicates, invalid rows, capacity
 overflow, emits, and stats."""
 
-import os
 from unittest import mock
 
 import numpy as np
@@ -78,8 +77,8 @@ def test_rank_matches_sort_all_invalid(rng):
 
 
 def test_env_dispatch(rng):
-    """merge_batch honors HEATMAP_MERGE_IMPL at trace time."""
-    with mock.patch.dict(os.environ, {"HEATMAP_MERGE_IMPL": "rank"}):
+    """merge_batch honors the import-time MERGE_IMPL resolution."""
+    with mock.patch("heatmap_tpu.engine.step.MERGE_IMPL", "rank"):
         st = init_state(512, 0)
         lat, lng, speed, ts, valid = make_batch(rng, 128)
         hi, lo, ws = snap_and_window(lat, lng, ts, valid, P)
@@ -106,7 +105,7 @@ def test_env_dispatch(rng):
                                               (256, 128, False)])
 def test_env_auto_dispatch(rng, cap, n, picks_rank):
     """auto picks rank only when the slab dwarfs the batch (>= 4x)."""
-    with mock.patch.dict(os.environ, {"HEATMAP_MERGE_IMPL": "auto"}):
+    with mock.patch("heatmap_tpu.engine.step.MERGE_IMPL", "auto"):
         st = init_state(cap, 0)
         lat, lng, speed, ts, valid = make_batch(rng, n)
         hi, lo, ws = snap_and_window(lat, lng, ts, valid, P)

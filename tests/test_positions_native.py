@@ -101,3 +101,16 @@ def test_monotonic_semantics_over_wire(rng):
         assert all(d["ts"] == want_ts[d["_id"]] for d in a)
         sa.close()
         sb.close()
+
+
+def test_undersized_buffer_resizes_and_retries(rng, monkeypatch):
+    """When the conservative _DOC_BOUND estimate is exceeded, encode must
+    reallocate to the exact size the C side reports and retry, not raise
+    (mirrors NativeTileOps.encode)."""
+    rows = make_rows(rng, 23)
+    ops, offsets, n = NativePositionOps().encode(rows)
+    monkeypatch.setattr(NativePositionOps, "_DOC_BOUND", 0)
+    ops2, offsets2, n2 = NativePositionOps().encode(rows)
+    assert n2 == n == 23
+    assert ops2 == ops
+    np.testing.assert_array_equal(offsets2, offsets)
