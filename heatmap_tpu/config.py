@@ -54,6 +54,9 @@ class Config:
     num_shards: int = 0                # 0 = use all local devices
     bucket_factor: float = 2.0         # all_to_all lane skew tolerance
     trigger_ms: int = 0                # 0 = as fast as possible (ref default)
+    on_overflow: str = "error"         # "error": metric + rate-limited log;
+                                       # "fail": stop the run (data loss is
+                                       # never silent either way)
     serve_host: str = "127.0.0.1"
     serve_port: int = 5000
     store: str = "auto"                # "auto" | "memory" | "mongo" | "jsonl"
@@ -94,10 +97,16 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         num_shards=_int(e, "NUM_SHARDS", Config.num_shards),
         bucket_factor=_float(e, "EXCHANGE_BUCKET_FACTOR", Config.bucket_factor),
         trigger_ms=_int(e, "TRIGGER_MS", Config.trigger_ms),
+        on_overflow=e.get("HEATMAP_ON_OVERFLOW", Config.on_overflow),
         serve_host=e.get("SERVE_HOST", Config.serve_host),
         serve_port=_int(e, "SERVE_PORT", Config.serve_port),
         store=e.get("HEATMAP_STORE", Config.store),
     )
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
+    if cfg.on_overflow not in ("error", "fail"):
+        # a typo here would silently downgrade a stop-on-data-loss knob
+        raise ValueError(
+            f"HEATMAP_ON_OVERFLOW must be 'error' or 'fail', "
+            f"got {cfg.on_overflow!r}")
     return cfg

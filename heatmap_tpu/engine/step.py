@@ -21,9 +21,11 @@ Degradation semantics: if the number of distinct live groups ever exceeds the
 slab capacity, the groups with the highest composite keys are dropped —
 including, possibly, pre-existing rows whose aggregates are then lost (their
 next re-emit restarts the count).  ``StepStats.state_overflow`` counts the
-dropped segments; the stream runtime treats any nonzero value as a loud
-misconfiguration error (capacity must be sized for the active-cell
-cardinality, SURVEY.md §5.7).
+dropped segments; the stream runtime surfaces any nonzero value as
+per-batch ``state_overflow_groups`` / ``state_overflow_last_epoch``
+counters at /metrics plus a rate-limited ERROR log, and with
+``HEATMAP_ON_OVERFLOW=fail`` stops the run (capacity must be sized for
+the active-cell cardinality, SURVEY.md §5.7).
 """
 
 from __future__ import annotations

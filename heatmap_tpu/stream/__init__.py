@@ -27,4 +27,7 @@ from heatmap_tpu.stream.source import (  # noqa: F401
     Source,
     SyntheticSource,
 )
-from heatmap_tpu.stream.runtime import MicroBatchRuntime  # noqa: F401
+from heatmap_tpu.stream.runtime import (  # noqa: F401
+    MicroBatchRuntime,
+    StateOverflowError,
+)

@@ -36,6 +36,11 @@ from heatmap_tpu.stream.trace import Tracer
 
 log = logging.getLogger(__name__)
 
+
+class StateOverflowError(RuntimeError):
+    """Raised (HEATMAP_ON_OVERFLOW=fail) when distinct (cell,window) groups
+    exceed the state slab capacity and aggregates would be dropped."""
+
 I32_MIN = -(2**31)
 
 
@@ -87,7 +92,8 @@ class MicroBatchRuntime:
         # per-vehicle-intern-id last emitted ts (monotonic guard), grown on
         # demand; -2^62 = "never seen" sentinel below any valid epoch
         self._pos_ts = np.full(1024, -(2**62), np.int64)
-        self._overflow_warned = False
+        self._overflow_logged_at = -float("inf")
+        self._fatal = False  # suppresses the exit checkpoint (close())
 
         # one aggregator per (resolution, window) pair (BASELINE configs 4/5)
         self.aggs: dict[tuple[int, int], object] = {}
@@ -318,13 +324,34 @@ class MicroBatchRuntime:
         return self._account_stats(res, wmin, stats)
 
     def _account_stats(self, res: int, wmin: int, stats) -> int:
-        if int(stats.state_overflow) > 0 and not self._overflow_warned:
-            self._overflow_warned = True
-            log.error(
-                "STATE OVERFLOW: %d distinct (cell,window) groups dropped; "
-                "raise STATE_CAPACITY_LOG2 (currently 2^%d per shard)",
-                int(stats.state_overflow), self.cfg.state_capacity_log2,
-            )
+        ovf = int(stats.state_overflow)
+        if ovf > 0:
+            # Data loss is never silent: every overflowing batch bumps the
+            # /metrics counters; the ERROR log is rate-limited to once a
+            # minute so a sustained overflow can't drown the log.
+            self.metrics.count("state_overflow_groups", ovf)
+            self.metrics.counters["state_overflow_last_epoch"] = self.epoch
+            now = time.monotonic()
+            if now - self._overflow_logged_at >= 60.0:
+                self._overflow_logged_at = now
+                log.error(
+                    "STATE OVERFLOW: %d distinct (cell,window) groups "
+                    "dropped this batch (%d total); raise "
+                    "STATE_CAPACITY_LOG2 (currently 2^%d per shard)",
+                    ovf,
+                    self.metrics.counters["state_overflow_groups"],
+                    self.cfg.state_capacity_log2,
+                )
+            if self.cfg.on_overflow == "fail":
+                # the exit checkpoint must NOT commit: offsets/state stay at
+                # the last good checkpoint so the lost batch replays after
+                # the operator raises the capacity
+                self._fatal = True
+                raise StateOverflowError(
+                    f"{ovf} aggregate groups dropped at state capacity "
+                    f"2^{self.cfg.state_capacity_log2} per shard; raise "
+                    f"STATE_CAPACITY_LOG2, or set HEATMAP_ON_OVERFLOW=error "
+                    f"to keep running with the loss surfaced at /metrics")
         dropped = int(getattr(stats, "bucket_dropped", 0))
         if dropped:
             self.metrics.count("events_bucket_dropped", dropped)
@@ -476,7 +503,7 @@ class MicroBatchRuntime:
     def close(self) -> None:
         self.tracer.stop()  # flush a partial profiler capture, if any
         try:
-            if not self.writer.poisoned:
+            if not self.writer.poisoned and not self._fatal:
                 self._checkpoint()
         finally:
             # a poisoned writer raises here, after source/store cleanup ran,

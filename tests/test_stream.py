@@ -230,3 +230,46 @@ def test_jsonl_store_roundtrip(tmp_path):
     assert store2.n_positions == 20
     ws = store2.latest_window_start()
     assert list(store2.tiles_in_window(ws))
+
+
+def test_state_overflow_is_loud(tmp_path, caplog):
+    """Overflow must surface on EVERY overflowing batch: per-batch /metrics
+    counters plus a (rate-limited) ERROR log — never a one-shot warning
+    (engine/step.py degradation contract)."""
+    import logging
+
+    cfg = mk_cfg(tmp_path, state_capacity_log2=6)  # 64 slots << ~150 cells
+    store = MemoryStore()
+    src = MemorySource(mk_events(1000))
+    src.finish()
+    rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=0)
+    with caplog.at_level(logging.ERROR, logger="heatmap_tpu.stream.runtime"):
+        rt.run()
+    snap = rt.metrics.snapshot()
+    assert snap.get("state_overflow_groups", 0) > 0
+    assert snap.get("state_overflow_last_epoch", -1) >= 1
+    assert any("STATE OVERFLOW" in r.message for r in caplog.records)
+
+
+def test_state_overflow_fail_mode(tmp_path):
+    """HEATMAP_ON_OVERFLOW=fail stops the run instead of dropping data —
+    including the exit checkpoint: offsets/state must stay at the last
+    good commit so the lost batch replays after a capacity raise."""
+    import os
+
+    from heatmap_tpu.stream import StateOverflowError
+
+    cfg = mk_cfg(tmp_path, state_capacity_log2=6, on_overflow="fail")
+    store = MemoryStore()
+    src = MemorySource(mk_events(1000))
+    src.finish()
+    rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=0)
+    with pytest.raises(StateOverflowError):
+        rt.run()
+    assert not os.path.exists(rt.ckpt.latest_path)  # loss not made durable
+
+
+def test_on_overflow_validated():
+    with pytest.raises(ValueError, match="HEATMAP_ON_OVERFLOW"):
+        load_config({"HEATMAP_ON_OVERFLOW": "FAIL"})
+    assert load_config({"HEATMAP_ON_OVERFLOW": "fail"}).on_overflow == "fail"
