@@ -4,8 +4,10 @@ Behavioral parity:
 - GET https://api-v3.mbta.com/vehicles with a fields filter and
   page[limit]=200 (mbta_to_kafka.py:41-48), optional x-api-key header (:19-21).
 - requests.Session with Retry(total=3, backoff 0.5, on 429/5xx) (:23-27).
-- speed m/s → km/h via ×3.6 (:70); wall-clock ts fallback when updated_at
-  is absent (:64,73); malformed vehicles skipped with a warning (:75-77).
+- speed m/s → km/h via ×3.6, only for numeric speeds (:70); wall-clock ts
+  fallback when updated_at is absent OR not Z-suffixed (:64,73); malformed
+  vehicles skipped with a warning (:75-77).
+- vehicleId prefers the vehicle label, then the id, then "unknown" (:69).
 - canonical 8-field event, key = vehicleId.
 """
 
@@ -67,14 +69,18 @@ class MbtaProducer:
                     continue
                 speed_ms = attrs.get("speed")
                 ts = attrs.get("updated_at")
-                if not ts or not isinstance(ts, str):
+                if not ts or not isinstance(ts, str) or not ts.endswith("Z"):
+                    # ref replaces non-Z-suffixed timestamps with wall clock
                     ts = utcnow_iso()
                 out.append({
                     "provider": self.provider,
-                    "vehicleId": str(item.get("id")),
+                    "vehicleId": str(attrs.get("label") or item.get("id")
+                                     or "unknown"),
                     "lat": float(lat),
                     "lon": float(lon),
-                    "speedKmh": float(speed_ms) * 3.6 if speed_ms is not None else None,
+                    "speedKmh": (float(speed_ms) * 3.6
+                                 if isinstance(speed_ms, (int, float))
+                                 else None),
                     "bearing": attrs.get("bearing"),
                     "accuracyM": None,
                     "ts": ts,

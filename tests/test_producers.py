@@ -35,13 +35,23 @@ MBTA_PAYLOAD = {
             "id": "bad",
             "attributes": {"latitude": "not-a-number", "longitude": -71.0},
         },
+        {  # label beats id (ref :69); non-Z ts replaced by wall clock
+           # (ref :73); string speed -> None, vehicle kept (ref :70)
+            "id": "y777",
+            "attributes": {"latitude": 42.37, "longitude": -71.08,
+                           "label": "1711", "speed": "fast",
+                           "updated_at": "2026-07-29T12:00:00+00:00"},
+        },
+        {  # neither label nor id -> "unknown" (ref :69)
+            "attributes": {"latitude": 42.38, "longitude": -71.09},
+        },
     ]
 }
 
 
 def test_mbta_normalization():
     evs = MbtaProducer().to_events(MBTA_PAYLOAD)
-    assert len(evs) == 2
+    assert len(evs) == 4
     e = evs[0]
     assert e["provider"] == "mbta"
     assert e["vehicleId"] == "y1234"
@@ -50,9 +60,15 @@ def test_mbta_normalization():
     e2 = evs[1]
     assert e2["speedKmh"] is None
     assert e2["ts"].endswith("Z")  # wall-clock fallback (ref :64,73)
+    e3 = evs[2]
+    assert e3["vehicleId"] == "1711"        # label-first (ref :69)
+    assert e3["ts"] != "2026-07-29T12:00:00+00:00"  # non-Z replaced (:73)
+    assert e3["ts"].endswith("Z")
+    assert e3["speedKmh"] is None           # non-numeric speed (ref :70)
+    assert evs[3]["vehicleId"] == "unknown"  # no label, no id (ref :69)
     # events pass the stream validator
     cols = parse_events(evs)
-    assert len(cols) == 2
+    assert len(cols) == 4
 
 
 OPENSKY_PAYLOAD = {
@@ -97,8 +113,8 @@ def test_poll_loop_and_publishers(tmp_path):
     mem = MemoryPublisher()
     n = run_poll_loop(lambda: prod.to_events(next(payloads)), mem,
                       period_s=0, max_polls=2)
-    assert n == 4
-    assert len(mem.queue) == 4
+    assert n == 8
+    assert len(mem.queue) == 8
 
     path = str(tmp_path / "cap.jsonl")
     pub = JsonlPublisher(path)
@@ -106,7 +122,7 @@ def test_poll_loop_and_publishers(tmp_path):
     pub.flush()
     pub.close()
     lines = [json.loads(x) for x in open(path)]
-    assert len(lines) == 2
+    assert len(lines) == 4
     assert lines[0]["vehicleId"] == "y1234"
 
     # captured file replays through the stream source
@@ -114,7 +130,7 @@ def test_poll_loop_and_publishers(tmp_path):
 
     src = JsonlReplaySource(path)
     evs = src.poll(10)
-    assert len(evs) == 2
+    assert len(evs) == 4
 
 
 def test_poll_loop_error_tiers():
