@@ -62,14 +62,18 @@ class MbtaProducer:
         out = []
         for item in payload.get("data", []):
             try:
-                attrs = item.get("attributes", {})
+                attrs = item.get("attributes") or {}  # null attrs -> skip
                 lat = attrs.get("latitude")
                 lon = attrs.get("longitude")
                 if lat is None or lon is None:
                     continue
                 speed_ms = attrs.get("speed")
-                ts = attrs.get("updated_at")
-                if not ts or not isinstance(ts, str) or not ts.endswith("Z"):
+                ts = attrs.get("updated_at") or utcnow_iso()
+                if not isinstance(ts, str):
+                    # ref hits AttributeError at ts.endswith and skips the
+                    # vehicle as malformed (:73)
+                    raise TypeError(f"updated_at: {ts!r}")
+                if not ts.endswith("Z"):
                     # ref replaces non-Z-suffixed timestamps with wall clock
                     ts = utcnow_iso()
                 out.append({

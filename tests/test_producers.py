@@ -45,6 +45,15 @@ MBTA_PAYLOAD = {
         {  # neither label nor id -> "unknown" (ref :69)
             "attributes": {"latitude": 42.38, "longitude": -71.09},
         },
+        {  # null attributes -> skipped, not a crash (ref :60 `or {}`)
+            "id": "y-null",
+            "attributes": None,
+        },
+        {  # non-string updated_at -> malformed, vehicle skipped (ref :73)
+            "id": "y-numts",
+            "attributes": {"latitude": 42.39, "longitude": -71.04,
+                           "updated_at": 1753795200},
+        },
     ]
 }
 
