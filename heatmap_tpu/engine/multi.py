@@ -138,8 +138,21 @@ class PairView:
         return self._multi.states[self._idx]
 
     def snapshot(self) -> TileState:
-        return TileState(*[np.asarray(leaf)
-                           for leaf in self._multi.states[self._idx]])
+        from heatmap_tpu.engine.state import to_host
+
+        return to_host(self._multi.states[self._idx])
+
+    def device_snapshot(self) -> TileState:
+        """Fresh-buffer on-device copy (see SingleAggregator)."""
+        from heatmap_tpu.engine.state import device_copy
+
+        return device_copy(self._multi.states[self._idx])
+
+    @staticmethod
+    def to_host(snap: TileState) -> TileState:
+        from heatmap_tpu.engine.state import to_host
+
+        return to_host(snap)
 
     def restore(self, st: TileState) -> None:
         cur = self._multi.states[self._idx]

@@ -81,10 +81,23 @@ class SingleAggregator:
     # --- checkpoint interface (runtime._checkpoint / _maybe_resume) --------
 
     def snapshot(self) -> TileState:
-        """Host-side copy of the state slab."""
-        import numpy as np
+        """Host-side copy of the state slab (synchronous; no device copy)."""
+        from heatmap_tpu.engine.state import to_host
 
-        return TileState(*[np.asarray(leaf) for leaf in self.state])
+        return to_host(self.state)
+
+    def device_snapshot(self) -> TileState:
+        """On-device copy with fresh buffers (async dispatch) — safe to
+        hold across later (buffer-donating) steps and pull off-thread."""
+        from heatmap_tpu.engine.state import device_copy
+
+        return device_copy(self.state)
+
+    @staticmethod
+    def to_host(snap: TileState) -> TileState:
+        from heatmap_tpu.engine.state import to_host
+
+        return to_host(snap)
 
     def restore(self, st: TileState) -> None:
         """Install a snapshot (shape-checked; raises on config mismatch)."""

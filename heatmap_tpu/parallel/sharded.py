@@ -465,9 +465,20 @@ class ShardedAggregator:
     def snapshot(self, idx: int = 0) -> TileState:
         """THIS process's rows of one pair's sharded state (per-host
         checkpoint — hosts restore their own shards; see stream.checkpoint
-        docstring)."""
+        docstring).  Synchronous: pulls the live slabs, no device copy."""
+        return self.snapshot_to_host(self.states[idx])
+
+    def device_snapshot(self, idx: int = 0) -> TileState:
+        """Fresh-buffer on-device copy, sharding preserved (the step
+        programs donate the state slabs, so references don't survive)."""
+        from heatmap_tpu.engine.state import device_copy
+
+        return device_copy(self.states[idx])
+
+    @staticmethod
+    def snapshot_to_host(snap: TileState) -> TileState:
         return TileState(*[multihost.addressable_rows(leaf)
-                           for leaf in self.states[idx]])
+                           for leaf in snap])
 
     def restore(self, st: TileState, idx: int = 0) -> None:
         shard1, shard2 = self._state_shardings
@@ -502,6 +513,13 @@ class ShardedPairView:
 
     def snapshot(self) -> TileState:
         return self._agg.snapshot(self._idx)
+
+    def device_snapshot(self) -> TileState:
+        return self._agg.device_snapshot(self._idx)
+
+    @staticmethod
+    def to_host(snap: TileState) -> TileState:
+        return ShardedAggregator.snapshot_to_host(snap)
 
     def restore(self, st: TileState) -> None:
         self._agg.restore(st, self._idx)

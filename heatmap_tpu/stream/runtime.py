@@ -18,6 +18,7 @@ group (heatmap_stream.py:243), as-fast-as-possible triggering unless
 from __future__ import annotations
 
 import logging
+import threading
 import time
 
 import jax
@@ -94,6 +95,8 @@ class MicroBatchRuntime:
         self._pos_ts = np.full(1024, -(2**62), np.int64)
         self._overflow_logged_at = -float("inf")
         self._fatal = False  # suppresses the exit checkpoint (close())
+        self._ckpt_thread: threading.Thread | None = None
+        self._ckpt_err: BaseException | None = None
 
         # one aggregator per (resolution, window) pair (BASELINE configs 4/5)
         self.aggs: dict[tuple[int, int], object] = {}
@@ -235,19 +238,59 @@ class MicroBatchRuntime:
         if self._multiproc:
             # all hosts reach the commit point (same epoch — epochs advance
             # in lockstep) before any commits, so retained commits can
-            # never diverge by more than one epoch across hosts
+            # never diverge by more than one epoch across hosts.  Stays
+            # synchronous: collectives must not run off the step thread.
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices(f"heatmap-ckpt-{self.epoch}")
-        # commit AFTER the sink writes are durable (idempotent replay window)
-        self.writer.drain()
-        states = {
-            (res, wmin * 60): agg.snapshot()
+            # commit AFTER sink writes are durable (idempotent replay window)
+            self.writer.drain()
+            states = {
+                (res, wmin * 60): agg.snapshot()
+                for (res, wmin), agg in self.aggs.items()
+            }
+            self.ckpt.commit(self.source.offset(), self.max_event_ts,
+                             self.epoch, states)
+            self.metrics.count("checkpoints")
+            return
+        # Single host: capture fresh-buffer device copies + offsets now
+        # (device copies dispatch asynchronously), then drain/transfer/
+        # write on a background thread so checkpoint batches don't stall
+        # the step loop.
+        self._ckpt_join()  # serialize with the previous in-flight commit
+        snaps = {
+            (res, wmin * 60): (agg.device_snapshot(), agg.to_host)
             for (res, wmin), agg in self.aggs.items()
         }
-        self.ckpt.commit(self.source.offset(), self.max_event_ts, self.epoch,
-                         states)
-        self.metrics.count("checkpoints")
+        offset = self.source.offset()
+        epoch, max_ts = self.epoch, self.max_event_ts
+
+        def commit():
+            try:
+                # writes queued before the snapshot must be durable before
+                # offsets move; later writes draining too is harmless
+                # (idempotent upserts)
+                self.writer.drain()
+                states = {k: to_host(s) for k, (s, to_host) in snaps.items()}
+                self.ckpt.commit(offset, max_ts, epoch, states)
+                self.metrics.count("checkpoints")
+            except BaseException as e:  # surfaced on the step thread
+                self._ckpt_err = e
+
+        self._ckpt_thread = threading.Thread(target=commit,
+                                             name="ckpt-commit", daemon=True)
+        self._ckpt_thread.start()
+
+    def _ckpt_join(self, raise_errors: bool = True) -> None:
+        t = self._ckpt_thread
+        if t is not None:
+            t.join()
+            self._ckpt_thread = None
+        if self._ckpt_err is not None:
+            err, self._ckpt_err = self._ckpt_err, None
+            if raise_errors:
+                raise RuntimeError("async checkpoint commit failed") from err
+            log.error("async checkpoint commit failed", exc_info=err)
 
     # ------------------------------------------------------------------
     def _build_batch(self, polled) -> EventColumns | None:
@@ -505,6 +548,9 @@ class MicroBatchRuntime:
         try:
             if not self.writer.poisoned and not self._fatal:
                 self._checkpoint()
+            # wait out the in-flight async commit either way; on the fatal
+            # path only log its error so the original exception survives
+            self._ckpt_join(raise_errors=not self._fatal)
         finally:
             # a poisoned writer raises here, after source/store cleanup ran,
             # and the uncommitted offsets make the lost batch replayable

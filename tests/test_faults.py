@@ -79,6 +79,7 @@ def test_crash_during_sink_flush_replays_idempotently(tmp_path):
         while rt.step_once():
             pass
     rt.writer.drain()  # the in-flight writes had landed before the death
+    rt._ckpt_join()    # ...and so had the (async) epoch-4 commit
 
     rt2 = MicroBatchRuntime(cfg, mk_src(), store, checkpoint_every=4)
     assert rt2.epoch == 4  # resumed at the last committed checkpoint

@@ -142,6 +142,7 @@ def test_checkpoint_resume(tmp_path):
     for _ in range(2):
         rt.step_once()
     rt._checkpoint()
+    rt._ckpt_join()  # commit is async; wait for it to land
     off = src.offset()
     assert off == 1024
 
@@ -273,3 +274,57 @@ def test_on_overflow_validated():
     with pytest.raises(ValueError, match="HEATMAP_ON_OVERFLOW"):
         load_config({"HEATMAP_ON_OVERFLOW": "FAIL"})
     assert load_config({"HEATMAP_ON_OVERFLOW": "fail"}).on_overflow == "fail"
+
+
+def test_checkpoint_commit_is_async(tmp_path, monkeypatch):
+    """The step loop must not wait for drain/transfer/disk at checkpoint
+    batches: the commit runs on a background thread off device-side state
+    copies (VERDICT round-1 item 6), and lands with the captured epoch."""
+    import threading
+
+    cfg = mk_cfg(tmp_path)
+    store = MemoryStore()
+    src = MemorySource(mk_events(1500))  # 3 batches of 512
+    src.finish()
+    rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=2)
+    gate = threading.Event()
+    orig_drain = rt.writer.drain
+
+    def gated_drain():
+        assert gate.wait(10.0)
+        orig_drain()
+
+    monkeypatch.setattr(rt.writer, "drain", gated_drain)
+    assert rt.step_once()          # epoch 1: no checkpoint
+    t0 = time.monotonic()
+    assert rt.step_once()          # epoch 2: checkpoint fires
+    dt_step = time.monotonic() - t0
+    assert dt_step < 3.0           # not blocked behind the 10s gate
+    assert rt.ckpt.load_meta() is None  # commit not landed yet
+    gate.set()
+    rt._ckpt_join()
+    meta = rt.ckpt.load_meta()
+    assert meta is not None and meta["epoch"] == 2
+    rt.step_once()                 # final batch
+    rt.close()                     # exit commit (epoch 3) lands
+    assert rt.ckpt.load_meta()["epoch"] == 3
+
+
+def test_async_checkpoint_errors_surface(tmp_path, monkeypatch):
+    """A failed background commit must fail the run at the next join."""
+    cfg = mk_cfg(tmp_path)
+    store = MemoryStore()
+    src = MemorySource(mk_events(1500))
+    src.finish()
+    rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=2)
+
+    def bad_commit(*a, **k):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(rt.ckpt, "commit", bad_commit)
+    rt.step_once()
+    rt.step_once()                 # epoch 2: async commit fails
+    with pytest.raises(RuntimeError, match="async checkpoint commit"):
+        rt._ckpt_join()
+    rt._fatal = True               # let close() skip the exit commit
+    rt.close()
