@@ -45,6 +45,7 @@ def bench_one(name: str, n_events: int, batch: int) -> dict:
     # warmup/compile outside the timed region: one batch (its events are
     # excluded from the throughput numerator below)
     rt.step_once()
+    rt.flush_pending()  # stats are pulled one batch behind the dispatch
     warm = rt.metrics.snapshot().get("events_valid", 0)
     t0 = time.monotonic()
     rt.run()

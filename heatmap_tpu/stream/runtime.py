@@ -97,6 +97,7 @@ class MicroBatchRuntime:
         self._fatal = False  # suppresses the exit checkpoint (close())
         self._ckpt_thread: threading.Thread | None = None
         self._ckpt_err: BaseException | None = None
+        self._pending = None  # last batch's emits, still on device
 
         # one aggregator per (resolution, window) pair (BASELINE configs 4/5)
         self.aggs: dict[tuple[int, int], object] = {}
@@ -181,6 +182,10 @@ class MicroBatchRuntime:
         )
 
         self._maybe_resume()
+        # offsets as of the last DISPATCHED batch: checkpoints commit these,
+        # never the live source offsets, so a batch polled but not yet
+        # dispatched (exception between poll and dispatch) always replays
+        self._offsets_dispatched = self.source.offset()
 
     # ------------------------------------------------------------------
     def _maybe_resume(self) -> None:
@@ -235,6 +240,8 @@ class MicroBatchRuntime:
                 ) from e
 
     def _checkpoint(self) -> None:
+        # the commit must cover every batch whose offsets it advances past
+        self.flush_pending()
         if self._multiproc:
             # all hosts reach the commit point (same epoch — epochs advance
             # in lockstep) before any commits, so retained commits can
@@ -249,7 +256,7 @@ class MicroBatchRuntime:
                 (res, wmin * 60): agg.snapshot()
                 for (res, wmin), agg in self.aggs.items()
             }
-            self.ckpt.commit(self.source.offset(), self.max_event_ts,
+            self.ckpt.commit(self._offsets_dispatched, self.max_event_ts,
                              self.epoch, states)
             self.metrics.count("checkpoints")
             return
@@ -262,7 +269,7 @@ class MicroBatchRuntime:
             (res, wmin * 60): (agg.device_snapshot(), agg.to_host)
             for (res, wmin), agg in self.aggs.items()
         }
-        offset = self.source.offset()
+        offset = self._offsets_dispatched
         epoch, max_ts = self.epoch, self.max_event_ts
 
         def commit():
@@ -354,26 +361,71 @@ class MicroBatchRuntime:
                       for v in vid[rows]],
         )
 
-    def _account_pair_packed(self, res: int, wmin: int, body, stats) -> int:
+    def _account_pair_packed(self, res: int, wmin: int, body, stats,
+                             epoch: int | None = None) -> int:
         """Sink one pair's packed emit body rows + book its stats; returns
         its batch_max_ts.  The writer thread turns the rows into store
         writes (columnar->BSON in C++ when the store supports it);
-        ``stats`` is any object with StepStats-named int attributes."""
+        ``stats`` is any object with StepStats-named int attributes;
+        ``epoch`` is the batch's dispatching epoch (accounting runs one
+        batch behind)."""
         n_docs = int(np.count_nonzero(
             (body[:, 8] != 0) & (body[:, 3].view(np.int32) > 0)))
         if n_docs:
             self.writer.submit_tiles_packed(body, self._pack_meta[(res, wmin)])
         self.metrics.count("tiles_emitted", n_docs)
-        return self._account_stats(res, wmin, stats)
+        return self._account_stats(res, wmin, stats, epoch)
 
-    def _account_stats(self, res: int, wmin: int, stats) -> int:
+    def flush_pending(self) -> None:
+        """Pull + account the deferred previous batch's emits, if any.
+
+        Runs on the step thread.  Called by the step loop (one batch
+        behind the dispatch), before every checkpoint capture (so commits
+        cover every accounted batch), on idle polls, and from close()."""
+        pending, self._pending = self._pending, None
+        if pending is None:
+            return
+        packed, epoch = pending
+        batch_max = I32_MIN
+        if self._multi is not None:
+            from heatmap_tpu.engine.multi import stats_from_packed
+
+            bufs = np.asarray(packed)
+            for idx, (res, win_s) in enumerate(self._multi.pairs):
+                stats = stats_from_packed(bufs[idx])
+                batch_max = max(
+                    batch_max,
+                    self._account_pair_packed(res, win_s // 60,
+                                              bufs[idx][1:], stats, epoch),
+                )
+        else:
+            from heatmap_tpu.parallel import multihost
+            from heatmap_tpu.parallel.sharded import packed_pair_bodies
+
+            rows = multihost.addressable_rows(packed)
+            bodies = packed_pair_bodies(
+                rows, self._sharded.params.emit_capacity,
+                len(self._sharded.pairs))
+            for (res, win_s), (body, stats) in zip(self._sharded.pairs,
+                                                   bodies):
+                batch_max = max(
+                    batch_max,
+                    self._account_pair_packed(res, win_s // 60, body,
+                                              stats, epoch),
+                )
+        if batch_max > I32_MIN:
+            self.max_event_ts = max(self.max_event_ts, batch_max)
+
+    def _account_stats(self, res: int, wmin: int, stats,
+                       epoch: int | None = None) -> int:
         ovf = int(stats.state_overflow)
         if ovf > 0:
             # Data loss is never silent: every overflowing batch bumps the
             # /metrics counters; the ERROR log is rate-limited to once a
             # minute so a sustained overflow can't drown the log.
             self.metrics.count("state_overflow_groups", ovf)
-            self.metrics.counters["state_overflow_last_epoch"] = self.epoch
+            self.metrics.counters["state_overflow_last_epoch"] = (
+                self.epoch if epoch is None else epoch)
             now = time.monotonic()
             if now - self._overflow_logged_at >= 60.0:
                 self._overflow_logged_at = now
@@ -423,6 +475,8 @@ class MicroBatchRuntime:
         t_poll = time.monotonic()
         cols = self._build_batch(polled)
         if cols is None and not self._multiproc:
+            # idle poll: settle the deferred batch so stats/sink catch up
+            self.flush_pending()
             return False
         if cols is None:
             # multi-host lockstep: peers may have events and are entering
@@ -443,48 +497,33 @@ class MicroBatchRuntime:
             ts = self._pad(cols.ts_s)
         t_build = time.monotonic()
 
+        # Pipelined pull: batch k-1's emits stay on device while the host
+        # polls/builds batch k — the device folds k-1 during that host
+        # work.  Account k-1 now (this waits for its fold, then one D2H),
+        # so the cutoff below sees every prior batch's max event ts, then
+        # dispatch k.  flush_pending() is also the barrier (checkpoint,
+        # close, idle polls) that keeps commit ordering and end-of-stream
+        # semantics exact.
+        self.flush_pending()
         cutoff = (
             self.max_event_ts - self.cfg.watermark_minutes * 60
             if self.max_event_ts > I32_MIN else I32_MIN
         )
-        batch_max = I32_MIN
         if self._multi is not None:
             # fused path: one dispatch for every (res, window) pair, and
             # ONE device->host pull for all their emits + stats (packed
             # head rows; engine.multi)
-            from heatmap_tpu.engine.multi import stats_from_packed
-
-            packed_all = self._multi.step_packed_all(
+            packed = self._multi.step_packed_all(
                 lat, lng, speed, ts, valid, cutoff)
-            bufs = np.asarray(packed_all)
-            for idx, (res, win_s) in enumerate(self._multi.pairs):
-                stats = stats_from_packed(bufs[idx])
-                batch_max = max(
-                    batch_max,
-                    self._account_pair_packed(res, win_s // 60,
-                                              bufs[idx][1:], stats),
-                )
         else:
             # sharded path: ONE dispatch folds every pair (single fused
-            # all_to_all), and one addressable pull covers this host's
-            # emit shards AND the replicated stats for all pairs (packed
-            # head rows; parallel.sharded).  Tile rows ride the same
-            # packed fast path as the single-device branch.
-            from heatmap_tpu.parallel import multihost
-            from heatmap_tpu.parallel.sharded import packed_pair_bodies
-
+            # all_to_all); the deferred pull covers this host's emit
+            # shards AND the replicated stats for all pairs (packed head
+            # rows; parallel.sharded)
             packed = self._sharded.step_packed(lat, lng, speed, ts, valid,
                                                cutoff)
-            rows = multihost.addressable_rows(packed)
-            bodies = packed_pair_bodies(
-                rows, self._sharded.params.emit_capacity,
-                len(self._sharded.pairs))
-            for (res, win_s), (body, stats) in zip(self._sharded.pairs,
-                                                   bodies):
-                batch_max = max(
-                    batch_max,
-                    self._account_pair_packed(res, win_s // 60, body, stats),
-                )
+        self._pending = (packed, self.epoch)
+        self._offsets_dispatched = self.source.offset()
         t_device = time.monotonic()
 
         if self.positions_enabled and cols is not None:
@@ -493,8 +532,6 @@ class MicroBatchRuntime:
                 self.writer.submit_positions_packed(prows)
                 self.metrics.count("positions_emitted", len(prows.ts_ms))
 
-        if batch_max > I32_MIN:
-            self.max_event_ts = max(self.max_event_ts, batch_max)
         self.epoch += 1
         t_end = time.monotonic()
         self.metrics.observe_batch(
@@ -546,11 +583,17 @@ class MicroBatchRuntime:
     def close(self) -> None:
         self.tracer.stop()  # flush a partial profiler capture, if any
         try:
-            if not self.writer.poisoned and not self._fatal:
-                self._checkpoint()
-            # wait out the in-flight async commit either way; on the fatal
-            # path only log its error so the original exception survives
-            self._ckpt_join(raise_errors=not self._fatal)
+            try:
+                self.flush_pending()
+            finally:
+                # a fatal flush (e.g. deferred overflow in fail mode) sets
+                # _fatal, so the exit commit below is skipped correctly
+                if not self.writer.poisoned and not self._fatal:
+                    self._checkpoint()
+                # wait out the in-flight async commit either way; on the
+                # fatal path only log its error so the original exception
+                # survives
+                self._ckpt_join(raise_errors=not self._fatal)
         finally:
             # a poisoned writer raises here, after source/store cleanup ran,
             # and the uncommitted offsets make the lost batch replayable

@@ -179,6 +179,7 @@ def test_watermark_drops_late_events(tmp_path):
     # events a full hour earlier: behind watermark -> dropped
     src.push(mk_events(50, t0=t0 - 3600))
     rt.step_once()
+    rt.flush_pending()  # stats are pulled one batch behind the dispatch
     assert rt.metrics.counters["events_late"] == 50
     rt.writer.drain()
     total = sum(d["count"] for d in store._tiles.values())
@@ -328,3 +329,36 @@ def test_async_checkpoint_errors_surface(tmp_path, monkeypatch):
         rt._ckpt_join()
     rt._fatal = True               # let close() skip the exit commit
     rt.close()
+
+
+def test_crash_between_poll_and_dispatch_replays_polled_batch(
+        tmp_path, monkeypatch):
+    """Checkpoints commit offsets of DISPATCHED batches only: a batch
+    polled right before a mid-step failure (the deferred-pull window)
+    must not be covered by the exit commit, so it replays on resume."""
+    cfg = mk_cfg(tmp_path)
+    store = MemoryStore()
+    src = SyntheticSource(n_events=1024, n_vehicles=50,
+                          events_per_second=512)
+    rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=0)
+    rt.step_once()                      # batch 1 dispatched; emits pending
+    orig = rt.flush_pending
+    armed = {"on": True}
+
+    def flaky():
+        if armed["on"] and rt._pending is not None:
+            armed["on"] = False         # fail once, mid-step, post-poll
+            raise RuntimeError("transient pull failure")
+        orig()
+
+    monkeypatch.setattr(rt, "flush_pending", flaky)
+    with pytest.raises(RuntimeError, match="transient pull"):
+        rt.step_once()                  # polled batch 2, then died
+    rt.close()                          # exit commit: dispatched offsets only
+
+    src2 = SyntheticSource(n_events=1024, n_vehicles=50,
+                           events_per_second=512)
+    rt2 = MicroBatchRuntime(cfg, src2, store, checkpoint_every=0)
+    assert src2.offset() == 512         # batch 2 replays
+    rt2.run()
+    assert sum(d["count"] for d in store._tiles.values()) == 1024
