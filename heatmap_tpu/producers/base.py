@@ -70,15 +70,21 @@ class KafkaPublisher(Publisher):
         import os
 
         self.topic = topic
-        # "json" (the reference's documented schema, README.md:191-204) or
-        # "binary" (stream/binfmt.py fixed layout — the high-rate option;
-        # consumers pick the matching HEATMAP_EVENT_FORMAT)
+        # "json" (the reference's documented schema, README.md:191-204),
+        # "binary" (stream/binfmt.py fixed layout — high-rate per-event),
+        # or "columnar" (stream/colfmt.py — one record per poll, arrays
+        # per field, memcpy-speed decode; consumers pick the matching
+        # HEATMAP_EVENT_FORMAT)
         self.event_format = event_format or os.environ.get(
             "HEATMAP_EVENT_FORMAT", "json")
+        self._colbuf: list[dict] = []
+        self._rr = 0
         if self.event_format == "binary":
             from heatmap_tpu.stream.binfmt import encode_event
 
             self._encode_value = encode_event
+        elif self.event_format == "columnar":
+            self._encode_value = None  # batched: see publish()/flush()
         else:
             self._encode_value = lambda e: json.dumps(e).encode("utf-8")
         impl = impl or os.environ.get("HEATMAP_KAFKA_IMPL", "auto")
@@ -115,6 +121,11 @@ class KafkaPublisher(Publisher):
         return self._parts
 
     def publish(self, events: Sequence[dict]) -> None:
+        if self.event_format == "columnar":
+            # batches can't be keyed per vehicle; buffered until flush(),
+            # then one columnar value round-robins across partitions
+            self._colbuf.extend(events)
+            return
         if self._mode == "confluent":
             for e in events:
                 self._p.produce(self.topic, key=str(e.get("vehicleId", "")),
@@ -131,7 +142,37 @@ class KafkaPublisher(Publisher):
             self._pending.setdefault(p, []).append(
                 Record(0, now_ms, key, self._encode_value(e)))
 
+    # events per columnar record: ~36 B/event + strings keeps a chunk
+    # well inside the broker's default 1 MB message.max.bytes, and bounds
+    # how much a failed produce re-encodes on retry
+    _COL_CHUNK = 16384
+
+    def _flush_columnar(self) -> None:
+        from heatmap_tpu.stream.colfmt import encode_batch
+
+        while self._colbuf:
+            chunk = self._colbuf[:self._COL_CHUNK]
+            value = encode_batch(chunk)
+            if self._mode == "confluent":
+                self._p.produce(self.topic, value=value)
+                self._p.flush()
+            else:
+                from heatmap_tpu.kafka import Record
+
+                parts = self._ensure_parts()
+                p = parts[self._rr % len(parts)]
+                self._p.produce(
+                    self.topic, p,
+                    [Record(0, int(time.time() * 1000), None, value)])
+                self._rr += 1
+            # dropped only after a successful produce; a failure keeps the
+            # unpublished remainder for the poll loop's retry
+            del self._colbuf[:len(chunk)]
+
     def flush(self) -> None:
+        if self.event_format == "columnar":
+            self._flush_columnar()
+            return
         if self._mode == "confluent":
             self._p.flush()
             return

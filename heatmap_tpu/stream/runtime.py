@@ -46,7 +46,7 @@ I32_MIN = -(2**31)
 
 
 def _make_global_pair(mesh):
-    """Cross-host agreement channel: every host contributes a pair of
+    """Cross-host agreement channel: every host contributes a triple of
     flags, everyone reads the global sums.  This is a collective — hosts
     must call it at the same point of every step (stream lockstep)."""
     import jax.numpy as jnp
@@ -60,8 +60,8 @@ def _make_global_pair(mesh):
                   if d.process_index == jax.process_index())
     f = jax.jit(lambda x: jnp.sum(x, axis=0))
 
-    def gpair(a: float, b: float) -> np.ndarray:
-        local = np.tile(np.array([[a, b]], np.float32), (n_local, 1))
+    def gpair(a: float, b: float, c: float = 0.0) -> np.ndarray:
+        local = np.tile(np.array([[a, b, c]], np.float32), (n_local, 1))
         return np.asarray(jax.device_get(f(put_global(sharding, local))))
 
     return gpair
@@ -98,6 +98,8 @@ class MicroBatchRuntime:
         self._ckpt_thread: threading.Thread | None = None
         self._ckpt_err: BaseException | None = None
         self._pending = None  # last batch's emits, still on device
+        self._carry_cols = None  # overshoot remainder of a batch-granular poll
+        self._ckpt_due = False  # cadence hit while mid-carry; commit ASAP
 
         # one aggregator per (resolution, window) pair (BASELINE configs 4/5)
         self.aggs: dict[tuple[int, int], object] = {}
@@ -240,6 +242,11 @@ class MicroBatchRuntime:
                 ) from e
 
     def _checkpoint(self) -> None:
+        if self._carry_cols is not None:
+            # mid-record: state would double-fold the already-dispatched
+            # slices on replay — wait for the carry to drain (a step or
+            # two); the next eligible epoch commits instead
+            return
         # the commit must cover every batch whose offsets it advances past
         self.flush_pending()
         if self._multiproc:
@@ -471,9 +478,21 @@ class MicroBatchRuntime:
 
     def _step_once_inner(self) -> bool:
         t0 = time.monotonic()
-        polled = self.source.poll(self._feed_batch)
+        if self._carry_cols is not None:
+            # a batch-granular source (columnar values) overshot the feed
+            # shape: drain the remainder before polling again
+            cols, polled = self._carry_cols, None
+            self._carry_cols = None
+        else:
+            polled = self.source.poll(self._feed_batch)
+            cols = self._build_batch(polled)
+        if cols is not None and len(cols) > self._feed_batch:
+            from heatmap_tpu.stream.events import slice_columns
+
+            self._carry_cols = slice_columns(cols, self._feed_batch,
+                                             len(cols))
+            cols = slice_columns(cols, 0, self._feed_batch)
         t_poll = time.monotonic()
-        cols = self._build_batch(polled)
         if cols is None and not self._multiproc:
             # idle poll: settle the deferred batch so stats/sink catch up
             self.flush_pending()
@@ -523,7 +542,11 @@ class MicroBatchRuntime:
             packed = self._sharded.step_packed(lat, lng, speed, ts, valid,
                                                cutoff)
         self._pending = (packed, self.epoch)
-        self._offsets_dispatched = self.source.offset()
+        if self._carry_cols is None:
+            # offsets only advance once EVERY row of the polled records has
+            # been dispatched — a checkpoint mid-carry would otherwise
+            # cover rows that exist nowhere but in this process's memory
+            self._offsets_dispatched = self.source.offset()
         t_device = time.monotonic()
 
         if self.positions_enabled and cols is not None:
@@ -544,15 +567,28 @@ class MicroBatchRuntime:
             },
         )
         progressed = cols is not None
+        carrying = self._carry_cols is not None
         if self._multiproc:
             # fixed-position collective: every host contributes
-            # (had-events, still-live); the summed pair is identical
-            # everywhere, so all hosts take the same run()-loop branch
-            had, live = self._gpair(float(progressed),
-                                    0.0 if self.source.exhausted else 1.0)
+            # (had-events, still-live, mid-carry); the summed triple is
+            # identical everywhere, so all hosts take the same run()-loop
+            # branch AND the same checkpoint-skip decision (a one-sided
+            # skip would deadlock the checkpoint barrier)
+            had, live, carry_any = self._gpair(
+                float(progressed),
+                0.0 if self.source.exhausted else 1.0,
+                float(carrying))
             self._global_live = live
             progressed = had > 0
+            carrying = carry_any > 0
         if self.checkpoint_every and self.epoch % self.checkpoint_every == 0:
+            # cadence hit; if mid-carry, the flag holds the commit until
+            # the FIRST carry-free step (a fixed record:feed size ratio can
+            # make "cadence epoch AND carry-free" never align, so waiting
+            # for the next cadence hit could starve checkpoints forever)
+            self._ckpt_due = True
+        if self._ckpt_due and not carrying:
+            self._ckpt_due = False
             self._checkpoint()
         return progressed
 
@@ -584,6 +620,15 @@ class MicroBatchRuntime:
         self.tracer.stop()  # flush a partial profiler capture, if any
         try:
             try:
+                # drain any carry so the exit commit is record-aligned
+                # (multiproc can't exit run() mid-carry: carrying hosts
+                # keep the global had-events flag up, so peers keep
+                # stepping with them).  On a fatal/poisoned exit the
+                # commit is skipped anyway and the uncommitted carry
+                # replays on resume — don't dispatch into a failed run.
+                while (self._carry_cols is not None and not self._multiproc
+                       and not self._fatal and not self.writer.poisoned):
+                    self._step_once_inner()
                 self.flush_pending()
             finally:
                 # a fatal flush (e.g. deferred overflow in fail mode) sets

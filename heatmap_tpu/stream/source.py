@@ -293,27 +293,39 @@ class KafkaSource(Source):
 
 
 def _value_decoder():
-    """Per-message value -> event dict (or None = drop), honoring
+    """Per-message value -> list of event dicts (empty = drop), honoring
     HEATMAP_EVENT_FORMAT so every consumer impl speaks the same format as
-    the publisher (stream/binfmt.py for "binary", JSON otherwise)."""
+    the publisher: stream/binfmt.py for "binary", stream/colfmt.py batch
+    expansion for "columnar", JSON otherwise."""
     import os
 
-    if os.environ.get("HEATMAP_EVENT_FORMAT", "json") == "binary":
+    fmt = os.environ.get("HEATMAP_EVENT_FORMAT", "json")
+    if fmt == "binary":
         from heatmap_tpu.stream.binfmt import decode_event
 
-        return decode_event
+        def _bin(value):
+            d = decode_event(value)
+            return [] if d is None else [d]
+
+        return _bin
+    if fmt == "columnar":
+        from heatmap_tpu.stream.colfmt import decode_batch_dicts
+
+        return decode_batch_dicts
 
     def _json(value):
         try:
-            return json.loads(value)
+            return [json.loads(value)]
         except (json.JSONDecodeError, TypeError, UnicodeDecodeError):
-            return None
+            return []
 
     return _json
 
 
 class _ConfluentImpl:
     def __init__(self, bootstrap, topic, group):
+        import os
+
         from confluent_kafka import Consumer
 
         self.c = Consumer({
@@ -325,18 +337,22 @@ class _ConfluentImpl:
         self.c.subscribe([topic])
         self.topic = topic
         self._offsets: dict[int, int] = {}
+        self._fmt = os.environ.get("HEATMAP_EVENT_FORMAT", "json")
         self._decode_value = _value_decoder()
 
     def poll(self, max_events):
         out = []
-        msgs = self.c.consume(num_messages=max_events, timeout=0.05)
+        # columnar: every message is a whole batch, and messages handed
+        # out by consume() are consumed (no redelivery without a seek) —
+        # so bound the expansion at the fetch, not with a mid-loop break
+        n_msgs = 1 if self._fmt == "columnar" else max_events
+        msgs = self.c.consume(num_messages=n_msgs, timeout=0.05)
         for m in msgs:
             if m.error():
                 continue
-            d = self._decode_value(m.value())
+            ds = self._decode_value(m.value())
             self._offsets[m.partition()] = m.offset() + 1
-            if d is not None:
-                out.append(d)
+            out.extend(ds)
         return out
 
     def offset(self):
@@ -374,10 +390,9 @@ class _KafkaPythonImpl:
         out = []
         try:
             for m in self.c:
-                d = self._decode_value(m.value)
+                ds = self._decode_value(m.value)
                 self._offsets[m.partition] = m.offset + 1
-                if d is not None:
-                    out.append(d)
+                out.extend(ds)
                 if len(out) >= max_events:
                     break
         except StopIteration:
@@ -412,8 +427,9 @@ class _WireImpl:
         self.log = logging.getLogger(__name__)
         self.c = KafkaClient(bootstrap)
         self.topic = topic
-        # event value encoding on this topic: "json" (reference contract)
-        # or "binary" (stream/binfmt.py — the high-rate option)
+        # event value encoding on this topic: "json" (reference contract),
+        # "binary" (stream/binfmt.py — high-rate per-event), or "columnar"
+        # (stream/colfmt.py — whole batches per value, memcpy decode)
         self._fmt = os.environ.get("HEATMAP_EVENT_FORMAT", "json")
         self._offsets: dict[int, int] = {}
         self._discover()
@@ -468,20 +484,26 @@ class _WireImpl:
         return None
 
     def poll(self, max_events):
+        if self._fmt == "columnar":
+            return self._poll_colfmt(max_events)
         if self._dec is not None:
             return self._poll_columnar(max_events)
         return self._poll_records(max_events)
 
-    def _poll_records(self, max_events):
-        """Portable path (no C++ toolchain): per-record Python decode."""
-        out = []
+    def _poll_record_loop(self, max_events, handle):
+        """Shared per-record fetch skeleton: round-robin the partitions,
+        guarded fetch, advance the offset past every record (tombstones
+        too) and past skipped batches when a fetch is fully consumed.
+        ``handle(p, r) -> n`` consumes one non-null record and returns how
+        many events it contributed toward ``max_events``."""
         if not self._offsets:
             self._discover()
         parts = sorted(self._offsets)
         if not parts:
-            return out
+            return
+        n_out = 0
         for k in range(len(parts)):
-            if len(out) >= max_events:
+            if n_out >= max_events:
                 break
             p = parts[(self._rr + k) % len(parts)]
             fr = self._guarded_fetch(
@@ -494,18 +516,52 @@ class _WireImpl:
                                  fr.skipped_batches, self.topic, p)
             taken = 0
             for r in fr.records:
-                if len(out) >= max_events:
+                if n_out >= max_events:
                     break
                 taken += 1
-                self._offsets[p] = r.offset + 1  # tombstones advance too
+                self._offsets[p] = r.offset + 1
                 if r.value is None:
                     continue
-                out.append(r.value)
+                n_out += handle(p, r)
             if taken == len(fr.records):
                 # consumed everything fetched: also jump past skipped
                 # batches / trailing tombstones
                 self._offsets[p] = max(self._offsets[p], fr.next_offset)
         self._rr = (self._rr + 1) % max(len(parts), 1)
+
+    def _poll_colfmt(self, max_events):
+        """HEATMAP_EVENT_FORMAT=columnar: each record value is a whole
+        struct-of-arrays batch (stream/colfmt.py) — decode is numpy views,
+        no per-event work.  Values are consumed at batch granularity (a
+        poll may overshoot max_events by up to one batch)."""
+        from heatmap_tpu.stream.colfmt import concat_columns, decode_batch
+
+        out = []
+
+        def handle(p, r):
+            cols = decode_batch(r.value, self._intern_p, self._intern_v)
+            if cols is None:
+                self.log.warning("dropping malformed columnar value at "
+                                 "%s[%d]@%d", self.topic, p, r.offset)
+                return 0
+            if len(cols) or cols.n_dropped:
+                out.append(cols)
+            return len(cols)
+
+        self._poll_record_loop(max_events, handle)
+        if not out:
+            return []
+        return concat_columns(out, self._intern_p, self._intern_v)
+
+    def _poll_records(self, max_events):
+        """Portable path (no C++ toolchain): per-record Python decode."""
+        out = []
+
+        def handle(p, r):
+            out.append(r.value)
+            return 1
+
+        self._poll_record_loop(max_events, handle)
         return _decode_raw_values(self._dec, out,
                                   self._intern_p, self._intern_v, self._fmt)
 
