@@ -3,10 +3,12 @@
 One Kafka record value carries N events in struct-of-arrays form plus a
 batch-local string table.  Decoding is numpy views over the value bytes
 plus one intern pass over the (small) string table: measured ~18M
-ev/s/core at 100k-event batches with 5k vehicles — vs ~10M ev/s/core
-for the per-event binary layout (stream/binfmt.py, C++) and ~0.2M for
-JSON (SURVEY.md §7 hard part #3's end state).  At the 5M ev/s north
-star, ingest decode costs ~0.3 cores.
+ev/s/core cold and ~44M ev/s/core steady-state (the LUT cache skips the
+intern pass when producers resend the same vehicle set) at 100k-event
+batches with 5k vehicles — vs ~10M ev/s/core for the per-event binary
+layout (stream/binfmt.py, C++) and ~0.2M for JSON (SURVEY.md §7 hard
+part #3's end state).  At the 5M ev/s north star, ingest decode costs
+~0.1 cores.
 
 Layout (little-endian), after the 16-byte header:
 
@@ -133,13 +135,17 @@ def _parse_strtab(blob: bytes, n_strings: int) -> list[str] | None:
     return out
 
 
-def decode_batch(value: bytes, intern_p: dict, intern_v: dict
-                 ) -> EventColumns | None:
+def decode_batch(value: bytes, intern_p: dict, intern_v: dict,
+                 lut_cache: dict | None = None) -> EventColumns | None:
     """One columnar value -> EventColumns (session-interned ids).
 
     Returns None when the envelope (magic/version/lengths) is invalid;
     row-level validation drops rows into ``n_dropped`` exactly like
-    parse_events."""
+    parse_events.  ``lut_cache`` (owned by the caller, same lifetime as
+    the intern maps) memoizes the string-table parse and the
+    batch-id->session-id LUTs keyed by the table blob: producers resend
+    the same vehicle set batch after batch, so the steady state does no
+    per-string Python work at all."""
     if len(value) < HEADER_SIZE:
         return None
     magic, ver, _flags, n, n_strings, tab_bytes = _HEAD.unpack_from(value)
@@ -164,9 +170,24 @@ def decode_batch(value: bytes, intern_p: dict, intern_v: dict
     ts = arr("<i8", n)
     pid = arr("<u4", n)
     vid = arr("<u4", n)
-    strings = _parse_strtab(value[off:off + tab_bytes], n_strings)
-    if strings is None:
-        return None
+    blob = value[off:off + tab_bytes]
+    # key includes n_strings: the same blob under a different claimed count
+    # parses (or fails) differently, and a hit must never skip the
+    # envelope rejection the uncached path guarantees
+    key = (blob, n_strings)
+    cached = lut_cache.get(key) if lut_cache is not None else None
+    if cached is None:
+        strings = _parse_strtab(blob, n_strings)
+        if strings is None:
+            return None
+        # role-split LUTs, filled lazily as ids are seen in each role
+        cached = (strings, np.full(max(n_strings, 1), -1, np.int32),
+                  np.full(max(n_strings, 1), -1, np.int32))
+        if lut_cache is not None:
+            if len(lut_cache) >= 128:  # bounded: vehicle churn makes new blobs
+                lut_cache.clear()
+            lut_cache[key] = cached
+    strings, lut_p, lut_v = cached
 
     # vectorized validation, parse_events semantics
     ok = (
@@ -184,13 +205,15 @@ def decode_batch(value: bytes, intern_p: dict, intern_v: dict
 
     # batch-local string ids -> session intern ids, split by ROLE: only
     # strings actually referenced as providers enter the provider intern
-    # map (and likewise vehicles), so the session tables stay clean
-    lut_p = np.full(max(n_strings, 1), -1, np.int32)
-    lut_v = np.full(max(n_strings, 1), -1, np.int32)
-    for i in np.unique(pid) if len(pid) else []:
-        lut_p[i] = intern_p.setdefault(strings[i], len(intern_p))
-    for i in np.unique(vid) if len(vid) else []:
-        lut_v[i] = intern_v.setdefault(strings[i], len(intern_v))
+    # map (and likewise vehicles), so the session tables stay clean.
+    # Cached LUTs skip already-mapped ids (intern maps are grow-only, so
+    # existing entries never invalidate).
+    if len(pid):
+        for i in np.unique(pid[lut_p[pid] < 0]):
+            lut_p[i] = intern_p.setdefault(strings[i], len(intern_p))
+    if len(vid):
+        for i in np.unique(vid[lut_v[vid] < 0]):
+            lut_v[i] = intern_v.setdefault(strings[i], len(intern_v))
 
     lat32 = lat.astype(np.float32, copy=False)
     lon32 = lon.astype(np.float32, copy=False)

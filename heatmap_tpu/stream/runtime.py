@@ -100,6 +100,7 @@ class MicroBatchRuntime:
         self._pending = None  # last batch's emits, still on device
         self._carry_cols = None  # overshoot remainder of a batch-granular poll
         self._ckpt_due = False  # cadence hit while mid-carry; commit ASAP
+        self._last_pull_s = 0.0  # wall of the most recent deferred pull
 
         # one aggregator per (resolution, window) pair (BASELINE configs 4/5)
         self.aggs: dict[tuple[int, int], object] = {}
@@ -389,6 +390,7 @@ class MicroBatchRuntime:
         Runs on the step thread.  Called by the step loop (one batch
         behind the dispatch), before every checkpoint capture (so commits
         cover every accounted batch), on idle polls, and from close()."""
+        t_flush = time.monotonic()
         pending, self._pending = self._pending, None
         if pending is None:
             return
@@ -422,6 +424,7 @@ class MicroBatchRuntime:
                 )
         if batch_max > I32_MIN:
             self.max_event_ts = max(self.max_event_ts, batch_max)
+        self._last_pull_s = time.monotonic() - t_flush
 
     def _account_stats(self, res: int, wmin: int, stats,
                        epoch: int | None = None) -> int:
@@ -523,6 +526,7 @@ class MicroBatchRuntime:
         # dispatch k.  flush_pending() is also the barrier (checkpoint,
         # close, idle polls) that keeps commit ordering and end-of-stream
         # semantics exact.
+        self._last_pull_s = 0.0  # only THIS window's pull is attributed
         self.flush_pending()
         cutoff = (
             self.max_event_ts - self.cfg.watermark_minutes * 60
@@ -557,12 +561,17 @@ class MicroBatchRuntime:
 
         self.epoch += 1
         t_end = time.monotonic()
+        pull_s, self._last_pull_s = self._last_pull_s, 0.0
         self.metrics.observe_batch(
             t_end - t0,
             {
                 "poll": t_poll - t0,
                 "build": t_build - t_poll,
-                "device": t_device - t_build,
+                # the deferred pull of batch k-1 (waits out its fold) vs
+                # this batch's own dispatch — the split that shows whether
+                # checkpoint/pull work ever gaps the step loop
+                "pull": pull_s,
+                "device": (t_device - t_build) - pull_s,
                 "sink_submit": t_end - t_device,
             },
         )

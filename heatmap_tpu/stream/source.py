@@ -443,6 +443,7 @@ class _WireImpl:
         self._dec = maybe_decoder(self.log)
         self._intern_p: dict = {}
         self._intern_v: dict = {}
+        self._col_cache: dict = {}  # colfmt LUT memo (same lifetime)
 
     def _discover(self) -> None:
         """(Re)initialize offsets for newly visible partitions at LATEST.
@@ -539,7 +540,8 @@ class _WireImpl:
         out = []
 
         def handle(p, r):
-            cols = decode_batch(r.value, self._intern_p, self._intern_v)
+            cols = decode_batch(r.value, self._intern_p, self._intern_v,
+                                self._col_cache)
             if cols is None:
                 self.log.warning("dropping malformed columnar value at "
                                  "%s[%d]@%d", self.topic, p, r.offset)

@@ -247,3 +247,40 @@ def test_columnar_publisher_chunks_large_batches():
             sum(c.list_offsets("tbig", EARLIEST).values())
         assert n_records == -(-n // KafkaPublisher._COL_CHUNK)
         c.close()
+
+
+def test_lut_cache_correct_across_batches():
+    """The LUT cache must return identical results to uncached decode,
+    including when a repeated string table is later used with NEW ids in
+    a role (lazy fill), and across interleaved distinct tables."""
+    p1, v1, cache = {}, {}, {}
+    p2, v2 = {}, {}
+    a = _events(20)                    # vehicles veh-0..6
+    b = _events(20, start=50)          # same vehicle set, same table
+    c = [{**e, "vehicleId": f"x-{i}"} for i, e in enumerate(_events(8))]
+    for evs in (a, b, c, a, c):
+        got = decode_batch(encode_batch(evs), p1, v1, cache)
+        uncached = decode_batch(encode_batch(evs), p2, v2)
+        assert len(got) == len(uncached)
+        for i in range(len(got)):
+            assert (got.providers[got.provider_id[i]]
+                    == uncached.providers[uncached.provider_id[i]])
+            assert (got.vehicles[got.vehicle_id[i]]
+                    == uncached.vehicles[uncached.vehicle_id[i]])
+    assert p1 == p2 and v1 == v2
+    assert len(cache) == 2  # a/b share one table; c is the other
+
+
+def test_lut_cache_hit_rejects_inflated_n_strings():
+    """A cache hit must not skip envelope rejection: the same string-table
+    blob under an inflated n_strings claim must be dropped (None), not
+    crash on out-of-bounds LUT indexing."""
+    import struct
+
+    p, v, cache = {}, {}, {}
+    good = encode_batch(_events(6))
+    assert decode_batch(good, p, v, cache) is not None  # warms the cache
+    bad = bytearray(good)
+    n_strings = struct.unpack_from("<I", good, 8)[0]
+    struct.pack_into("<I", bad, 8, n_strings + 5)
+    assert decode_batch(bytes(bad), p, v, cache) is None
