@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Ingest-stack bench: publisher -> in-process wire broker -> KafkaSource.
+
+Measures end-to-end Kafka ingest throughput (produce + fetch + decode to
+EventColumns) per HEATMAP_EVENT_FORMAT on this host, isolating the
+stream-side ingest ceiling from the device fold (SURVEY.md §7 hard part
+3).  The mock broker speaks the real wire protocol over real sockets, so
+this exercises exactly the consumer path production uses.
+
+Usage: python tools/bench_ingest.py [n_events]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def bench_format(fmt: str, n: int) -> tuple[float, float]:
+    """(publish ev/s, consume ev/s) for one format."""
+    os.environ["HEATMAP_EVENT_FORMAT"] = fmt
+    # pin the framework's wire client: the mock broker doesn't speak the
+    # consumer-group APIs an installed confluent/kafka-python would use
+    os.environ["HEATMAP_KAFKA_IMPL"] = "wire"
+    from heatmap_tpu.producers.base import KafkaPublisher
+    from heatmap_tpu.stream.events import EventColumns
+    from heatmap_tpu.stream.source import KafkaSource
+    from heatmap_tpu.testing.mock_kafka import MockKafkaBroker
+
+    evs = [{"provider": "mbta", "vehicleId": f"veh-{i % 5000}",
+            "lat": 42.3 + (i % 100) * 1e-4, "lon": -71.05,
+            "speedKmh": 30.0, "bearing": 0.0, "accuracyM": 5.0,
+            "ts": 1_700_000_000 + (i % 600)} for i in range(n)]
+    with MockKafkaBroker() as bootstrap:
+        src = KafkaSource(bootstrap, "bench")
+        pub = KafkaPublisher(bootstrap, "bench", event_format=fmt)
+        t0 = time.perf_counter()
+        for k in range(0, n, 20_000):
+            pub.publish(evs[k:k + 20_000])
+            pub.flush()
+        t_pub = time.perf_counter() - t0
+
+        got = 0
+        t0 = time.perf_counter()
+        while got < n:
+            polled = src.poll(1 << 17)
+            if isinstance(polled, EventColumns):
+                got += len(polled)
+            else:
+                got += len(polled or [])
+            if not polled:
+                break
+        t_con = time.perf_counter() - t0
+        pub.close()
+        src.close()
+    assert got == n, (fmt, got, n)
+    return n / t_pub, n / t_con
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 500_000
+    print(f"# {n:,} events per format, single core, wire broker loopback")
+    for fmt in ("json", "binary", "columnar"):
+        pub_eps, con_eps = bench_format(fmt, n)
+        print(f"{fmt:9s} publish {pub_eps / 1e6:6.2f}M ev/s   "
+              f"consume {con_eps / 1e6:6.2f}M ev/s")
+
+
+if __name__ == "__main__":
+    import jax
+
+    # ingest only — keep the accelerator (and a dead tunnel) out of it
+    jax.config.update("jax_platforms", "cpu")
+    main()
