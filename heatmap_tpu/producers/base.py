@@ -147,27 +147,63 @@ class KafkaPublisher(Publisher):
     # how much a failed produce re-encodes on retry
     _COL_CHUNK = 16384
 
+    def _produce_columnar_value(self, value: bytes,
+                                flush_now: bool = True) -> None:
+        if self._mode == "confluent":
+            self._p.produce(self.topic, value=value)
+            if flush_now:
+                self._p.flush()
+            return
+        from heatmap_tpu.kafka import Record
+
+        parts = self._ensure_parts()
+        p = parts[self._rr % len(parts)]
+        self._p.produce(self.topic, p,
+                        [Record(0, int(time.time() * 1000), None, value)])
+        self._rr += 1
+
     def _flush_columnar(self) -> None:
         from heatmap_tpu.stream.colfmt import encode_batch
 
         while self._colbuf:
             chunk = self._colbuf[:self._COL_CHUNK]
-            value = encode_batch(chunk)
-            if self._mode == "confluent":
-                self._p.produce(self.topic, value=value)
-                self._p.flush()
-            else:
-                from heatmap_tpu.kafka import Record
-
-                parts = self._ensure_parts()
-                p = parts[self._rr % len(parts)]
-                self._p.produce(
-                    self.topic, p,
-                    [Record(0, int(time.time() * 1000), None, value)])
-                self._rr += 1
+            self._produce_columnar_value(encode_batch(chunk))
             # dropped only after a successful produce; a failure keeps the
             # unpublished remainder for the poll loop's retry
             del self._colbuf[:len(chunk)]
+
+    def publish_columns(self, cols) -> int:
+        """High-rate columnar path: publish an EventColumns batch directly
+        (array-native encode, no per-event Python) in bounded chunks;
+        returns the number of events produced.  Requires
+        event_format=columnar.
+
+        At-least-once: a failure mid-batch raises with
+        ``e.events_published`` set to the count already on the wire, so a
+        caller can resume from that row instead of re-sending (a blind
+        retry duplicates the delivered prefix, like any Kafka producer
+        retry)."""
+        if self.event_format != "columnar":
+            raise ValueError("publish_columns requires event_format="
+                             f"'columnar', not {self.event_format!r}")
+        from heatmap_tpu.stream.colfmt import encode_batch_columns
+        from heatmap_tpu.stream.events import slice_columns
+
+        published = 0
+        try:
+            for k in range(0, len(cols), self._COL_CHUNK):
+                end = min(k + self._COL_CHUNK, len(cols))
+                self._produce_columnar_value(
+                    encode_batch_columns(slice_columns(cols, k, end)),
+                    flush_now=False)
+                published = end
+            if self._mode == "confluent":
+                self._p.flush()  # one ack round for the whole batch
+        except Exception as e:
+            e.events_published = (0 if self._mode == "confluent"
+                                  else published)  # unacked => unknown
+            raise
+        return published
 
     def flush(self) -> None:
         if self.event_format == "columnar":

@@ -120,6 +120,54 @@ def encode_batch(events) -> bytes:
     ])
 
 
+def encode_batch_columns(cols: EventColumns) -> bytes:
+    """EventColumns -> one columnar batch value, array-native.
+
+    The high-rate path for replay/backfill producers: no per-event
+    Python.  Assumes the rows are already validated (they came from
+    parse_events / a decoder).  Only the strings this batch actually
+    references go on the wire (ids are remapped compactly) — session
+    intern tables are cumulative, and embedding them whole would grow
+    every record with vehicle churn until the broker rejects it."""
+    n = len(cols)
+    pid_in = np.asarray(cols.provider_id, np.int64)
+    vid_in = np.asarray(cols.vehicle_id, np.int64)
+    if n and (pid_in.min() < 0 or pid_in.max() >= len(cols.providers)
+              or vid_in.min() < 0 or vid_in.max() >= len(cols.vehicles)):
+        # silent whole-batch drops at decode are worse than failing here
+        raise ValueError("provider_id/vehicle_id out of string-table range")
+    up = np.unique(pid_in) if n else np.zeros(0, np.int64)
+    uv = np.unique(vid_in) if n else np.zeros(0, np.int64)
+    strings = ([str(cols.providers[i]) for i in up]
+               + [str(cols.vehicles[i]) for i in uv])
+    remap_p = np.zeros(int(up[-1]) + 1 if len(up) else 1, "<u4")
+    remap_p[up] = np.arange(len(up), dtype="<u4")
+    remap_v = np.zeros(int(uv[-1]) + 1 if len(uv) else 1, "<u4")
+    remap_v[uv] = np.arange(len(uv), dtype="<u4") + np.uint32(len(up))
+    pid = remap_p[pid_in]
+    vid = remap_v[vid_in]
+    tab_parts = []
+    for s in strings:
+        b = s.encode("utf-8")[:0xFFFF]
+        tab_parts.append(struct.pack("<H", len(b)))
+        tab_parts.append(b)
+    tab = b"".join(tab_parts)
+    zeros = np.zeros(n, "<f4")
+    head = _HEAD.pack(MAGIC, VERSION, 0, n, len(strings), len(tab))
+    return b"".join([
+        head,
+        cols.lat_deg.astype("<f4", copy=False).tobytes(),
+        cols.lng_deg.astype("<f4", copy=False).tobytes(),
+        cols.speed_kmh.astype("<f4", copy=False).tobytes(),
+        zeros.tobytes(),   # bearing (not carried in EventColumns)
+        zeros.tobytes(),   # accuracy
+        cols.ts_s.astype("<i8").tobytes(),
+        pid.tobytes(),
+        vid.tobytes(),
+        tab,
+    ])
+
+
 def _parse_strtab(blob: bytes, n_strings: int) -> list[str] | None:
     out = []
     off = 0

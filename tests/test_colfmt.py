@@ -284,3 +284,75 @@ def test_lut_cache_hit_rejects_inflated_n_strings():
     n_strings = struct.unpack_from("<I", good, 8)[0]
     struct.pack_into("<I", bad, 8, n_strings + 5)
     assert decode_batch(bytes(bad), p, v, cache) is None
+
+
+def test_encode_batch_columns_differential():
+    """The array-native encoder must decode to the same rows as the
+    per-event encoder (string-table layout may differ)."""
+    from heatmap_tpu.stream.colfmt import encode_batch_columns
+
+    evs = _events(50)
+    cols_in = parse_events(evs)
+    a = decode_batch(encode_batch_columns(cols_in), {}, {})
+    b = decode_batch(encode_batch(evs), {}, {})
+    assert len(a) == len(b) == 50
+    np.testing.assert_array_equal(a.ts_s, b.ts_s)
+    np.testing.assert_array_equal(a.lat_deg, b.lat_deg)
+    np.testing.assert_array_equal(a.speed_kmh, b.speed_kmh)
+    for i in range(50):
+        assert a.providers[a.provider_id[i]] == b.providers[b.provider_id[i]]
+        assert a.vehicles[a.vehicle_id[i]] == b.vehicles[b.vehicle_id[i]]
+
+
+def test_publish_columns_wire_roundtrip(monkeypatch):
+    """publish_columns -> broker -> KafkaSource delivers every row, in
+    bounded chunks."""
+    from heatmap_tpu.producers.base import KafkaPublisher
+    from heatmap_tpu.stream.events import EventColumns
+    from heatmap_tpu.stream.source import KafkaSource
+    from heatmap_tpu.testing.mock_kafka import MockKafkaBroker
+
+    monkeypatch.setenv("HEATMAP_EVENT_FORMAT", "columnar")
+    monkeypatch.setattr(KafkaPublisher, "_COL_CHUNK", 64)
+    sent = _events(200)  # 200 rows / 64-chunk -> 4 records
+    cols = parse_events(sent)
+    with MockKafkaBroker() as bootstrap:
+        src = KafkaSource(bootstrap, "tpc")
+        pub = KafkaPublisher(bootstrap, "tpc", event_format="columnar")
+        pub.publish_columns(cols)
+        pub.close()
+        seen = []
+        for _ in range(12):
+            polled = src.poll(512)
+            if isinstance(polled, EventColumns):
+                seen.extend(int(t) for t in polled.ts_s)
+            if len(seen) >= 200:
+                break
+        assert sorted(seen) == [e["ts"] for e in sent]
+        src.close()
+
+
+def test_encode_batch_columns_compact_tables_and_bounds():
+    """Only referenced strings go on the wire (session tables are
+    cumulative), and out-of-range ids fail at encode, not as silent
+    whole-batch drops at decode."""
+    from heatmap_tpu.stream.colfmt import HEADER_SIZE, encode_batch_columns
+    from heatmap_tpu.stream.events import slice_columns
+    import struct as _struct
+
+    cols = parse_events(_events(100))            # vehicles veh-0..6
+    head = slice_columns(cols, 0, 10)
+    v = encode_batch_columns(head)
+    n_strings = _struct.unpack_from("<I", v, 8)[0]
+    used = {cols.vehicles[i] for i in head.vehicle_id} | \
+        {cols.providers[i] for i in head.provider_id}
+    assert n_strings == len(used)                # not the cumulative table
+    got = decode_batch(v, {}, {})
+    for i in range(len(got)):
+        assert (got.vehicles[got.vehicle_id[i]]
+                == cols.vehicles[head.vehicle_id[i]])
+
+    bad = parse_events(_events(4))
+    bad.vehicle_id[2] = 99                       # past the table
+    with pytest.raises(ValueError, match="string-table range"):
+        encode_batch_columns(bad)

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Replay a capture (JSONL) or a synthetic stream into Kafka at full rate.
+
+The benchmark-grade producer for BASELINE config #3 through REAL Kafka:
+uses the columnar batch format's array-native encoder
+(``colfmt.encode_batch_columns``) so publishing is bounded by the wire,
+not per-event Python.  Consumers must run HEATMAP_EVENT_FORMAT=columnar.
+
+Usage:
+    python tools/replay_to_kafka.py --synthetic 1000000
+    python tools/replay_to_kafka.py --jsonl capture.jsonl
+Env: KAFKA_BOOTSTRAP, KAFKA_TOPIC (reference names).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--synthetic", type=int, default=0,
+                    help="generate N synthetic events instead of a capture")
+    ap.add_argument("--jsonl", type=str, default=None,
+                    help="JSONL capture to replay")
+    ap.add_argument("--chunk", type=int, default=1 << 16,
+                    help="events pulled from the source per publish round")
+    args = ap.parse_args()
+
+    from heatmap_tpu.config import load_config
+    from heatmap_tpu.producers.base import KafkaPublisher
+    from heatmap_tpu.stream.events import EventColumns, parse_events
+    from heatmap_tpu.stream.source import JsonlReplaySource, SyntheticSource
+
+    cfg = load_config()
+    if args.jsonl:
+        src = JsonlReplaySource(args.jsonl)
+    elif args.synthetic:
+        src = SyntheticSource(n_events=args.synthetic,
+                              events_per_second=args.chunk)
+    else:
+        ap.error("pass --synthetic N or --jsonl PATH")
+        return
+
+    pub = KafkaPublisher(cfg.kafka_bootstrap, cfg.kafka_topic,
+                         event_format="columnar")
+    total = 0
+    t0 = time.perf_counter()
+    while True:
+        polled = src.poll(args.chunk)
+        cols = (polled if isinstance(polled, EventColumns)
+                else parse_events(polled) if polled else None)
+        if cols is None or not len(cols):
+            if src.exhausted:
+                break
+            continue
+        pub.publish_columns(cols)
+        total += len(cols)
+    pub.close()
+    dt = time.perf_counter() - t0
+    print(f"published {total:,} events in {dt:.2f}s "
+          f"({total / max(dt, 1e-9) / 1e6:.2f}M ev/s)")
+
+
+if __name__ == "__main__":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # no accelerator needed
+    main()
