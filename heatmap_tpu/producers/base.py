@@ -148,9 +148,14 @@ class KafkaPublisher(Publisher):
     _COL_CHUNK = 16384
 
     def _produce_columnar_value(self, value: bytes,
-                                flush_now: bool = True) -> None:
+                                flush_now: bool = True,
+                                on_delivery=None) -> None:
         if self._mode == "confluent":
-            self._p.produce(self.topic, value=value)
+            if on_delivery is not None:
+                self._p.produce(self.topic, value=value,
+                                on_delivery=on_delivery)
+            else:
+                self._p.produce(self.topic, value=value)
             if flush_now:
                 self._p.flush()
             return
@@ -190,15 +195,25 @@ class KafkaPublisher(Publisher):
         from heatmap_tpu.stream.events import slice_columns
 
         published = 0
+        delivery_errs: list = []
+
+        def on_delivery(err, _msg):  # confluent async delivery reports
+            if err is not None:
+                delivery_errs.append(err)
+
         try:
             for k in range(0, len(cols), self._COL_CHUNK):
                 end = min(k + self._COL_CHUNK, len(cols))
                 self._produce_columnar_value(
                     encode_batch_columns(slice_columns(cols, k, end)),
-                    flush_now=False)
+                    flush_now=False, on_delivery=on_delivery)
                 published = end
             if self._mode == "confluent":
                 self._p.flush()  # one ack round for the whole batch
+                if delivery_errs:
+                    raise RuntimeError(
+                        f"{len(delivery_errs)} columnar record(s) failed "
+                        f"delivery: {delivery_errs[0]}")
         except Exception as e:
             e.events_published = (0 if self._mode == "confluent"
                                   else published)  # unacked => unknown

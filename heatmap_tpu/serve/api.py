@@ -93,6 +93,17 @@ def positions_feature_collection(store: Store) -> dict:
 
 def make_wsgi_app(store: Store, cfg=None, runtime=None):
     refresh_ms = getattr(cfg, "refresh_ms", 5000) if cfg else 5000
+    resolutions = getattr(cfg, "resolutions", None) if cfg else None
+    # default grid for bare /api/tiles/latest: one grid per response (the
+    # reference contract) that actually EXISTS in the configured pyramid
+    default_grid = None
+    if cfg is not None:
+        res_list = tuple(resolutions or ())
+        h3res = getattr(cfg, "h3_res", None)
+        if h3res is not None and (not res_list or h3res in res_list):
+            default_grid = f"h3r{h3res}"
+        elif res_list:
+            default_grid = f"h3r{res_list[0]}"
 
     def app(environ, start_response):
         path = environ.get("PATH_INFO", "/")
@@ -103,6 +114,10 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 for part in qs.split("&"):
                     if part.startswith("grid="):
                         grid = part[5:]
+                if grid is None:
+                    # a multi-res pyramid would otherwise mix overlapping
+                    # hexes in a single FeatureCollection
+                    grid = default_grid
                 body = json.dumps(tiles_feature_collection(store, grid))
                 ctype = "application/json"
             elif path == "/api/positions/latest":
@@ -118,7 +133,7 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 body = json.dumps({"ok": True})
                 ctype = "application/json"
             elif path == "/":
-                body = render_index(refresh_ms)
+                body = render_index(refresh_ms, resolutions)
                 ctype = "text/html; charset=utf-8"
             else:
                 start_response("404 Not Found", [("Content-Type", "text/plain")])

@@ -41,6 +41,9 @@ _PAGE = """<!doctype html>
 <script>
 "use strict";
 const REFRESH_MS = __REFRESH_MS__;
+// [resolution, grid] pairs of the multi-res pyramid (default window),
+// lowest res first; empty/single => fixed default grid, as the reference
+const GRIDS = __GRIDS__;
 const RAMP = [[0,'#ffffcc'],[3,'#ffeda0'],[6,'#fed976'],[11,'#feb24c'],
               [21,'#fd8d3c'],[51,'#f03b20'],[101,'#bd0026']];
 
@@ -82,14 +85,35 @@ function status(msg) {
   status._t = setTimeout(() => el.style.visibility = 'hidden', 2000);
 }
 
+// zoom-adaptive pyramid: the finest resolution whose detail the current
+// zoom can show (threshold ~1.5*res - 1: res 7 from z10, 8 from z11, 9
+// from z12.5); coarser cells when zoomed out keep tile counts sane
+function gridForZoom(z) {
+  if (GRIDS.length < 2) return GRIDS.length ? GRIDS[0][1] : null;
+  let g = GRIDS[0][1];
+  for (const [res, grid] of GRIDS) if (z >= 1.5 * res - 1) g = grid;
+  return g;
+}
+let activeGrid = null;
+map.on('zoomend', () => {
+  const g = gridForZoom(map.getZoom());
+  if (g !== activeGrid) tick();
+});
+
 let fitted = false;
+let tickSeq = 0;
 async function tick() {
+  const seq = ++tickSeq;  // a newer tick invalidates slower in-flight ones
   try {
+    activeGrid = gridForZoom(map.getZoom());
+    const tilesUrl = '/api/tiles/latest' +
+      (activeGrid ? `?grid=${encodeURIComponent(activeGrid)}` : '');
     const [tiles, pts, metrics] = await Promise.all([
-      fetch('/api/tiles/latest').then(r => r.json()),
+      fetch(tilesUrl).then(r => r.json()),
       fetch('/api/positions/latest').then(r => r.json()),
       fetch('/metrics').then(r => r.json()).catch(() => ({})),
     ]);
+    if (seq !== tickSeq) return;  // stale response; a fresher one renders
     hexes.clearLayers();
     if (tiles.features && tiles.features.length) {
       hexes.addData(tiles);
@@ -120,6 +144,7 @@ function renderHud(nt, np, m) {
   const sw = RAMP.map(([min, c]) =>
     `<span class="swatch" style="background:${c}"></span>&ge;${min}`).join(' ');
   let line = `${nt} tiles · ${np} vehicles`;
+  if (activeGrid && GRIDS.length > 1) line += ` · ${activeGrid}`;
   if (m && m.events_per_sec !== undefined)
     line += ` · ${Number(m.events_per_sec).toLocaleString()} ev/s` +
             ` · p50 ${m.batch_latency_p50_ms} ms`;
@@ -133,5 +158,13 @@ setInterval(tick, REFRESH_MS);
 </html>"""
 
 
-def render_index(refresh_ms: int = 5000) -> str:
-    return _PAGE.replace("__REFRESH_MS__", str(int(refresh_ms)))
+def render_index(refresh_ms: int = 5000,
+                 resolutions=None) -> str:
+    """``resolutions``: the multi-res pyramid (cfg.resolutions); with more
+    than one the UI switches grid by zoom level."""
+    import json
+
+    grids = [[int(r), f"h3r{int(r)}"] for r in sorted(resolutions or [])]
+    return (_PAGE
+            .replace("__REFRESH_MS__", str(int(refresh_ms)))
+            .replace("__GRIDS__", json.dumps(grids)))

@@ -97,14 +97,7 @@ def encode_batch(events) -> bytes:
         vid.append(strings.setdefault(vehicle, len(strings)))
 
     n = len(lat)
-    tab_parts = []
-    for s in strings:
-        b = s.encode("utf-8")
-        if len(b) > 0xFFFF:
-            b = b[:0xFFFF]
-        tab_parts.append(struct.pack("<H", len(b)))
-        tab_parts.append(b)
-    tab = b"".join(tab_parts)
+    tab = _encode_strtab(strings)
     head = _HEAD.pack(MAGIC, VERSION, 0, n, len(strings), len(tab))
     return b"".join([
         head,
@@ -146,12 +139,7 @@ def encode_batch_columns(cols: EventColumns) -> bytes:
     remap_v[uv] = np.arange(len(uv), dtype="<u4") + np.uint32(len(up))
     pid = remap_p[pid_in]
     vid = remap_v[vid_in]
-    tab_parts = []
-    for s in strings:
-        b = s.encode("utf-8")[:0xFFFF]
-        tab_parts.append(struct.pack("<H", len(b)))
-        tab_parts.append(b)
-    tab = b"".join(tab_parts)
+    tab = _encode_strtab(strings)
     zeros = np.zeros(n, "<f4")
     head = _HEAD.pack(MAGIC, VERSION, 0, n, len(strings), len(tab))
     return b"".join([
@@ -166,6 +154,16 @@ def encode_batch_columns(cols: EventColumns) -> bytes:
         vid.tobytes(),
         tab,
     ])
+
+
+def _encode_strtab(strings) -> bytes:
+    """String table blob: per entry u16 byte length + UTF-8 bytes."""
+    parts = []
+    for s in strings:
+        b = s.encode("utf-8")[:0xFFFF]
+        parts.append(struct.pack("<H", len(b)))
+        parts.append(b)
+    return b"".join(parts)
 
 
 def _parse_strtab(blob: bytes, n_strings: int) -> list[str] | None:

@@ -164,3 +164,16 @@ def test_serving_reads_over_wire_store():
         finally:
             httpd.shutdown()
             s.close()
+
+
+def test_index_embeds_multi_res_grids(tmp_path):
+    """With a multi-res pyramid configured, the UI gets the [res, grid]
+    pairs for zoom-adaptive selection; single-res stays fixed."""
+    from heatmap_tpu.serve.ui import render_index
+
+    multi = render_index(5000, (9, 7, 8))
+    assert 'const GRIDS = [[7, "h3r7"], [8, "h3r8"], [9, "h3r9"]];' in multi
+    single = render_index(5000, (8,))
+    assert 'const GRIDS = [[8, "h3r8"]];' in single
+    none = render_index(5000)
+    assert "const GRIDS = [];" in none
