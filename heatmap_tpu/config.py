@@ -49,6 +49,8 @@ class Config:
     windows_minutes: tuple[int, ...] = (5,)  # sliding multi-window, e.g. 1,5,15
     batch_size: int = 1 << 17          # events per fixed-shape micro-batch
     state_capacity_log2: int = 17      # open-addressing table slots per shard
+    state_max_log2: int = 0            # growth ceiling; 0 = capacity+4 (16x);
+                                       # == state_capacity_log2 disables growth
     speed_hist_bins: int = 32          # per-cell speed histogram (p95 stats)
     speed_hist_max_kmh: float = 256.0
     num_shards: int = 0                # 0 = use all local devices
@@ -92,6 +94,7 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         windows_minutes=_ints(e, "WINDOW_MINUTES", e.get("TILE_MINUTES", "5")),
         batch_size=_int(e, "BATCH_SIZE", Config.batch_size),
         state_capacity_log2=_int(e, "STATE_CAPACITY_LOG2", Config.state_capacity_log2),
+        state_max_log2=_int(e, "HEATMAP_STATE_MAX_LOG2", Config.state_max_log2),
         speed_hist_bins=_int(e, "SPEED_HIST_BINS", Config.speed_hist_bins),
         speed_hist_max_kmh=_float(e, "SPEED_HIST_MAX_KMH", Config.speed_hist_max_kmh),
         num_shards=_int(e, "NUM_SHARDS", Config.num_shards),
@@ -109,4 +112,8 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         raise ValueError(
             f"HEATMAP_ON_OVERFLOW must be 'error' or 'fail', "
             f"got {cfg.on_overflow!r}")
+    if cfg.state_max_log2 and cfg.state_max_log2 < cfg.state_capacity_log2:
+        raise ValueError(
+            f"HEATMAP_STATE_MAX_LOG2 ({cfg.state_max_log2}) below "
+            f"STATE_CAPACITY_LOG2 ({cfg.state_capacity_log2})")
     return cfg

@@ -121,6 +121,27 @@ class MultiAggregator:
     def view(self, res: int, window_s: int) -> "PairView":
         return PairView(self, self.pairs.index((res, window_s)))
 
+    def grow(self, new_capacity: int) -> None:
+        """Resize EVERY pair's slab (pairs share one capacity so the fused
+        step keeps uniform shapes).  The next step retraces on the new
+        shape; sortedness is preserved (EMPTY pads the tail).  Emit
+        capacity grows with the slab (a larger slab means a batch can
+        touch more groups than the old min(batch, cap) bound) — the
+        in-place params update is read at that retrace."""
+        from heatmap_tpu.engine.state import resize_state
+
+        self.states = [
+            TileState(*[jnp.asarray(leaf)
+                        for leaf in resize_state(st, new_capacity)])
+            for st in self.states
+        ]
+        self.capacity_per_shard = new_capacity
+        new_emit = min(self.batch_size, new_capacity)
+        self.params[:] = [
+            p._replace(emit_capacity=max(p.emit_capacity, new_emit))
+            for p in self.params
+        ]
+
 
 class PairView:
     """Checkpoint adapter for one pair of a MultiAggregator (SingleAggregator
@@ -131,7 +152,10 @@ class PairView:
     def __init__(self, multi: MultiAggregator, idx: int):
         self._multi = multi
         self._idx = idx
-        self.capacity_per_shard = multi.capacity_per_shard
+
+    @property
+    def capacity_per_shard(self) -> int:  # tracks growth
+        return self._multi.capacity_per_shard
 
     @property
     def state(self) -> TileState:

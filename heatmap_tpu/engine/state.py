@@ -89,3 +89,46 @@ def to_host(snap: TileState) -> TileState:
     import numpy as np
 
     return TileState(*[np.asarray(leaf) for leaf in snap])
+
+
+def resize_state(st: TileState, new_capacity: int,
+                 n_shards: int = 1) -> TileState:
+    """Host-side resize of a snapshot to a new per-shard capacity.
+
+    Growth pads each shard block's tail with EMPTY rows — EMPTY sorts
+    last under the fold's compressed key, so per-shard sortedness (the
+    slab invariant) is preserved.  Shrinking is allowed only when every
+    shard's live rows fit (live rows are a sorted prefix); otherwise
+    raises, because dropping aggregates silently is never acceptable.
+    """
+    import numpy as np
+
+    rows = st.key_hi.shape[0]
+    if rows % n_shards:
+        raise ValueError(f"{rows} rows not divisible by {n_shards} shards")
+    old_cap = rows // n_shards
+    if new_capacity == old_cap:
+        return st
+    key_hi = np.asarray(st.key_hi).reshape(n_shards, old_cap)
+    if new_capacity < old_cap:
+        live = (key_hi != np.uint32(EMPTY_KEY_HI)).sum(axis=1)
+        if int(live.max(initial=0)) > new_capacity:
+            raise ValueError(
+                f"cannot shrink to {new_capacity}: a shard holds "
+                f"{int(live.max())} live groups")
+    fills = {
+        "key_hi": np.uint32(EMPTY_KEY_HI),
+        "key_lo": np.uint32(EMPTY_KEY_LO),
+        "key_ws": np.int32(EMPTY_WS),
+    }
+    out = []
+    for name, leaf in zip(TileState._fields, st):
+        a = np.asarray(leaf)
+        shard_shape = (n_shards, old_cap) + a.shape[1:]
+        a = a.reshape(shard_shape)
+        new = np.full((n_shards, new_capacity) + a.shape[2:],
+                      fills.get(name, a.dtype.type(0)), a.dtype)
+        keep = min(old_cap, new_capacity)
+        new[:, :keep] = a[:, :keep]
+        out.append(new.reshape((n_shards * new_capacity,) + a.shape[2:]))
+    return TileState(*out)

@@ -323,7 +323,9 @@ class ShardedAggregator:
         if len({p.emit_capacity for p in plist}) != 1:
             raise ValueError("all pairs must share emit_capacity "
                              "(packed blocks stack uniformly)")
-        self.params_list = tuple(plist)
+        # a LIST on purpose: grow() mutates it in place and the jitted
+        # bodies re-read it when the new state shapes force a retrace
+        self.params_list = list(plist)
         self.params = self.params_list[0]
         self.pairs = [(p.res, p.window_s) for p in self.params_list]
         self.n_shards = mesh.devices.size
@@ -462,6 +464,38 @@ class ShardedAggregator:
     def view(self, res: int, window_s: int) -> "ShardedPairView":
         return ShardedPairView(self, self.pairs.index((res, window_s)))
 
+    @property
+    def local_shards(self) -> int:
+        """Shard blocks held by THIS process (== addressable devices in a
+        multi-host mesh; all shards on a single host)."""
+        n_local = len(self.states[0].key_hi.sharding.addressable_devices)
+        return n_local if jax.process_count() > 1 else self.n_shards
+
+    def grow(self, new_capacity: int) -> None:
+        """Resize every pair's sharded slab to ``new_capacity`` rows per
+        shard (host roundtrip + retrace on the next step; growth is rare
+        and geometric).  EMPTY pads each shard block's tail, preserving
+        per-shard sortedness.  In a multi-host mesh every process must
+        call this at the same step (the runtime's growth decision is
+        derived from replicated stats, so it is)."""
+        from heatmap_tpu.engine.state import resize_state
+
+        shards = self.local_shards
+        snaps = [self.snapshot(i) for i in range(len(self.states))]
+        self.capacity_per_shard = new_capacity
+        for i, snap in enumerate(snaps):
+            self.restore(resize_state(snap, new_capacity, shards), i)
+        # emit capacity grows with the slab: a batch can now touch more
+        # groups per shard than the old min(batch, cap) bound.  In-place
+        # so the partial-bound list the jitted bodies read stays the same
+        # object; the changed state shapes force the retrace that reads it.
+        new_emit = min(self.batch_size, new_capacity)
+        self.params_list[:] = [
+            p._replace(emit_capacity=max(p.emit_capacity, new_emit))
+            for p in self.params_list
+        ]
+        self.params = self.params_list[0]
+
     def snapshot(self, idx: int = 0) -> TileState:
         """THIS process's rows of one pair's sharded state (per-host
         checkpoint — hosts restore their own shards; see stream.checkpoint
@@ -505,7 +539,10 @@ class ShardedPairView:
     def __init__(self, agg: ShardedAggregator, idx: int):
         self._agg = agg
         self._idx = idx
-        self.capacity_per_shard = agg.capacity_per_shard
+
+    @property
+    def capacity_per_shard(self) -> int:  # tracks growth
+        return self._agg.capacity_per_shard
 
     @property
     def state(self) -> TileState:
@@ -520,6 +557,10 @@ class ShardedPairView:
     @staticmethod
     def to_host(snap: TileState) -> TileState:
         return ShardedAggregator.snapshot_to_host(snap)
+
+    @property
+    def n_shards(self) -> int:
+        return self._agg.local_shards
 
     def restore(self, st: TileState) -> None:
         self._agg.restore(st, self._idx)

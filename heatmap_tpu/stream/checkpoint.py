@@ -82,7 +82,12 @@ class CheckpointManager:
 
     # --- write ----------------------------------------------------------
     def commit(self, offset: Any, max_event_ts: int, epoch: int,
-               states: dict[tuple[int, int], TileState] | None = None) -> None:
+               states: dict[tuple[int, int], TileState] | None = None,
+               shards: int | None = None) -> None:
+        """``shards``: the writer's local shard-block count.  Recorded so
+        a restart can tell a capacity change (absorbable: pad/grow) from a
+        shard-count change (NOT absorbable: rows would be reinterpreted as
+        the wrong shard blocks and keys would land off their owner)."""
         name = f"commit-{epoch:012d}"
         cdir = os.path.join(self.dir, name)
         tmp = cdir + ".tmp"
@@ -91,9 +96,12 @@ class CheckpointManager:
         for (res, win), st in (states or {}).items():
             np.savez(os.path.join(tmp, f"state-{res}-{win}.npz"),
                      **{k: np.asarray(v) for k, v in st._asdict().items()})
+        meta = {"offset": offset, "max_event_ts": int(max_event_ts),
+                "epoch": int(epoch)}
+        if shards is not None:
+            meta["shards"] = int(shards)
         with open(os.path.join(tmp, "meta.json"), "w", encoding="utf-8") as fh:
-            json.dump({"offset": offset, "max_event_ts": int(max_event_ts),
-                       "epoch": int(epoch)}, fh)
+            json.dump(meta, fh)
         shutil.rmtree(cdir, ignore_errors=True)
         os.replace(tmp, cdir)
 

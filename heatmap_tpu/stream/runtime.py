@@ -101,10 +101,32 @@ class MicroBatchRuntime:
         self._carry_cols = None  # overshoot remainder of a batch-granular poll
         self._ckpt_due = False  # cadence hit while mid-carry; commit ASAP
         self._last_pull_s = 0.0  # wall of the most recent deferred pull
+        self._n_active_peak = 0  # max live groups (any pair) since startup
+        self._cap_max = 1 << (cfg.state_max_log2
+                              or cfg.state_capacity_log2 + 4)
 
         # one aggregator per (resolution, window) pair (BASELINE configs 4/5)
         self.aggs: dict[tuple[int, int], object] = {}
         cap = 1 << cfg.state_capacity_log2
+        n_shards_planned = (mesh.devices.size
+                            if mesh is not None and mesh.devices.size > 1
+                            else 1)
+        if self._cap_max > cap and cap * n_shards_planned < 2 * cfg.batch_size:
+            # one batch can mint up to batch_size new groups: below this
+            # floor the first batches could overflow before stats-driven
+            # growth sees them.  Start at the floor (loudly) — cheap here,
+            # before any state exists.
+            grown = cap
+            while (grown * n_shards_planned < 2 * cfg.batch_size
+                   and grown < self._cap_max):
+                grown *= 2
+            log.warning(
+                "STATE_CAPACITY_LOG2=%d holds less than one batch of new "
+                "groups; starting at 2^%d rows/shard (set "
+                "HEATMAP_STATE_MAX_LOG2=%d to pin the configured size)",
+                cfg.state_capacity_log2, grown.bit_length() - 1,
+                cfg.state_capacity_log2)
+            cap = grown
         bins = cfg.speed_hist_bins
         self._multi = None
         self._sharded = None
@@ -223,6 +245,15 @@ class MicroBatchRuntime:
         if not meta:
             return
         log.info("resuming from checkpoint: %s", meta)
+        snap_shards = meta.get("shards")
+        if snap_shards is not None and snap_shards != self._local_shards:
+            # even an exact-shape restore would be wrong: rows would be
+            # reinterpreted as different shard blocks (per-shard sorted
+            # runs, key ownership) — silently corrupting aggregates
+            raise RuntimeError(
+                f"checkpoint written with {snap_shards} local shard(s), "
+                f"this run has {self._local_shards}; restore the original "
+                f"device topology or clear {self.cfg.checkpoint_dir}")
         self.epoch = meta.get("epoch", 0)
         self.max_event_ts = meta.get("max_event_ts", I32_MIN)
         self.source.seek(meta.get("offset"))
@@ -230,17 +261,55 @@ class MicroBatchRuntime:
             st = self.ckpt.load_state(res, wmin * 60, epoch=at_epoch)
             if st is None:
                 continue
+            st = TileState(*st)
             try:
-                agg.restore(TileState(*st))
+                agg.restore(st)
             except ValueError as e:
-                # seeking past processed offsets with an unloadable state
-                # would silently lose aggregates — refuse instead
-                raise RuntimeError(
-                    f"checkpoint state for (res={res}, window={wmin}m) does "
-                    f"not match the config ({e}); restore "
-                    f"STATE_CAPACITY_LOG2/SPEED_HIST_BINS or clear "
-                    f"{self.cfg.checkpoint_dir}"
-                ) from e
+                # capacity changes across restarts are absorbed: pad the
+                # snapshot up to the configured capacity, or grow the
+                # aggregators to a LARGER snapshot (a grown run).  Anything
+                # else — hist bins, a shard-count change (rows would be
+                # reinterpreted as the wrong shard blocks), legacy metas
+                # without a recorded shard count, shrink below live rows —
+                # still refuses: seeking past processed offsets with an
+                # unloadable state would silently lose aggregates.
+                try:
+                    self._restore_resized(agg, st, meta.get("shards"))
+                except (ValueError, RuntimeError) as e2:
+                    raise RuntimeError(
+                        f"checkpoint state for (res={res}, window={wmin}m) "
+                        f"does not match the config ({e}; resize: {e2}); "
+                        f"restore STATE_CAPACITY_LOG2/SPEED_HIST_BINS or "
+                        f"clear {self.cfg.checkpoint_dir}"
+                    ) from e2
+
+    @property
+    def _local_shards(self) -> int:
+        """Shard blocks in THIS process's snapshots (1 on the fused
+        single-device path)."""
+        return (self._sharded.local_shards if self._sharded is not None
+                else 1)
+
+    def _restore_resized(self, agg, st: TileState,
+                         snap_shards: int | None) -> None:
+        from heatmap_tpu.engine.state import resize_state
+
+        shards = self._local_shards
+        if snap_shards is None:
+            raise ValueError(
+                "checkpoint does not record its shard count; only an "
+                "exact-shape restore is safe")
+        if snap_shards != shards:
+            raise ValueError(
+                f"checkpoint written with {snap_shards} local shard(s), "
+                f"this run has {shards}")
+        snap_cap = st.key_hi.shape[0] // shards
+        if snap_cap > agg.capacity_per_shard:
+            grower = self._multi if self._multi is not None else self._sharded
+            grower.grow(snap_cap)  # capacity is shared across pairs
+            agg.restore(st)
+        else:
+            agg.restore(resize_state(st, agg.capacity_per_shard, shards))
 
     def _checkpoint(self) -> None:
         if self._carry_cols is not None:
@@ -265,7 +334,7 @@ class MicroBatchRuntime:
                 for (res, wmin), agg in self.aggs.items()
             }
             self.ckpt.commit(self._offsets_dispatched, self.max_event_ts,
-                             self.epoch, states)
+                             self.epoch, states, shards=self._local_shards)
             self.metrics.count("checkpoints")
             return
         # Single host: capture fresh-buffer device copies + offsets now
@@ -287,7 +356,8 @@ class MicroBatchRuntime:
                 # (idempotent upserts)
                 self.writer.drain()
                 states = {k: to_host(s) for k, (s, to_host) in snaps.items()}
-                self.ckpt.commit(offset, max_ts, epoch, states)
+                self.ckpt.commit(offset, max_ts, epoch, states,
+                                 shards=self._local_shards)
                 self.metrics.count("checkpoints")
             except BaseException as e:  # surfaced on the step thread
                 self._ckpt_err = e
@@ -471,7 +541,45 @@ class MicroBatchRuntime:
         else:
             self.metrics.count(f"events_late_r{res}m{wmin}",
                                int(stats.n_late))
+        self._n_active_peak = max(self._n_active_peak, int(stats.n_active))
         return int(stats.batch_max_ts)
+
+    def _maybe_grow(self) -> None:
+        """Grow the state slabs BEFORE they can overflow.
+
+        A batch adds at most one new group per event per pair, so keeping
+        free slots above 2x the global batch (the 2 covers the one-batch
+        stats lag) makes single-slab overflow structurally impossible
+        below the growth ceiling.  Sharded slabs overflow per shard; the
+        extra 2x on the occupancy term tolerates up to 2x accumulated
+        key-ownership skew (far above what mix32 produces at real group
+        counts), with the overflow accounting as the loud backstop.
+        Runs on the step thread between the flush and the next dispatch —
+        no batch is in flight, so the resize is a plain state swap plus a
+        retrace on the next step.  In multi-host mode every host derives
+        the same decision from the replicated stats."""
+        agg = self._multi if self._multi is not None else self._sharded
+        shards = agg.n_shards
+        margin = 2 * self.cfg.batch_size
+        skew = 2 if shards > 1 else 1
+        cap = agg.capacity_per_shard
+        if self._n_active_peak * skew + margin <= cap * shards:
+            return
+        new_cap = cap
+        while (self._n_active_peak * skew + margin > new_cap * shards
+               and new_cap < self._cap_max):
+            new_cap *= 2
+        if new_cap == cap:
+            return  # at the ceiling; the overflow accounting stands guard
+        t0 = time.monotonic()
+        agg.grow(new_cap)
+        self.metrics.count("state_grown")
+        self.metrics.counters["state_capacity_per_shard"] = new_cap
+        log.warning(
+            "state slabs grown 2^%d -> 2^%d rows/shard (%d live groups; "
+            "%.2fs; next step retraces)", cap.bit_length() - 1,
+            new_cap.bit_length() - 1, self._n_active_peak,
+            time.monotonic() - t0)
 
     # ------------------------------------------------------------------
     def step_once(self) -> bool:
@@ -528,6 +636,7 @@ class MicroBatchRuntime:
         # semantics exact.
         self._last_pull_s = 0.0  # only THIS window's pull is attributed
         self.flush_pending()
+        self._maybe_grow()
         cutoff = (
             self.max_event_ts - self.cfg.watermark_minutes * 60
             if self.max_event_ts > I32_MIN else I32_MIN

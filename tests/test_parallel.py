@@ -165,3 +165,37 @@ def test_step_packed_matches_step(mesh, rng):
         for f in ("n_valid", "n_late", "n_evicted", "n_active",
                   "state_overflow", "batch_max_ts", "bucket_dropped"):
             assert getattr(pstats, f) == int(np.asarray(getattr(stats, f))), f
+
+
+def test_sharded_grow_preserves_state(mesh, rng):
+    """grow() must preserve every live group (per-shard sorted prefix,
+    EMPTY-padded tails) and keep subsequent folds identical to an oracle
+    that never grew."""
+    agg = ShardedAggregator(mesh, PARAMS, capacity_per_shard=64,
+                            batch_size=1024)
+    oracle = DictAgg(PARAMS)
+
+    def feed(b, n):
+        lat, lng, speed, ts, valid = make_batch(rng, 1024,
+                                                t0=1_700_000_000 + b * 120)
+        valid[n:] = False  # small first fill, full batches after the grow
+        emit, stats = agg.step(lat, lng, speed, ts, valid, -2**31)
+        hi, lo, ws = snap_and_window(lat, lng, ts, valid, PARAMS)
+        oracle.feed(np.asarray(hi), np.asarray(lo), np.asarray(ws), speed,
+                    np.degrees(lat.astype(np.float64)),
+                    np.degrees(lng.astype(np.float64)), valid, -2**31)
+        assert int(stats.state_overflow) == 0
+        return stats
+
+    feed(0, 200)  # <= 200 groups over 8x64 slots: no overflow
+    before, _ = shard_states_as_dict(agg)
+    agg.grow(256)
+    assert agg.capacity_per_shard == 256
+    after, per_shard = shard_states_as_dict(agg)
+    assert after == before  # nothing lost or moved across shards
+    feed(1, 1024)  # retraced step on the grown shapes, full batch
+    got, _ = shard_states_as_dict(agg)
+    assert set(got) == set(oracle.groups)
+    for k, g in got.items():
+        w = oracle.groups[k]
+        assert g[0] == w[0], (k, g, w)

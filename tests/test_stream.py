@@ -240,7 +240,8 @@ def test_state_overflow_is_loud(tmp_path, caplog):
     (engine/step.py degradation contract)."""
     import logging
 
-    cfg = mk_cfg(tmp_path, state_capacity_log2=6)  # 64 slots << ~150 cells
+    # 64 slots << ~150 cells, growth disabled so overflow actually happens
+    cfg = mk_cfg(tmp_path, state_capacity_log2=6, state_max_log2=6)
     store = MemoryStore()
     src = MemorySource(mk_events(1000))
     src.finish()
@@ -261,7 +262,8 @@ def test_state_overflow_fail_mode(tmp_path):
 
     from heatmap_tpu.stream import StateOverflowError
 
-    cfg = mk_cfg(tmp_path, state_capacity_log2=6, on_overflow="fail")
+    cfg = mk_cfg(tmp_path, state_capacity_log2=6, state_max_log2=6,
+                 on_overflow="fail")
     store = MemoryStore()
     src = MemorySource(mk_events(1000))
     src.finish()
@@ -362,3 +364,88 @@ def test_crash_between_poll_and_dispatch_replays_polled_batch(
     assert src2.offset() == 512         # batch 2 replays
     rt2.run()
     assert sum(d["count"] for d in store._tiles.values()) == 1024
+
+
+def test_state_grows_before_overflow(tmp_path):
+    """With growth headroom, a tiny initial capacity self-heals: the slab
+    doubles before it can overflow, nothing is dropped, and the total
+    mass is conserved."""
+    cfg = mk_cfg(tmp_path, state_capacity_log2=6, state_max_log2=12,
+                 batch_size=128)
+    store = MemoryStore()
+    src = MemorySource(mk_events(1000))
+    src.finish()
+    rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=0)
+    rt.run()
+    snap = rt.metrics.snapshot()
+    assert snap.get("state_grown", 0) >= 1
+    assert snap.get("state_overflow_groups", 0) == 0  # nothing dropped
+    assert snap["events_valid"] == 1000
+    assert sum(d["count"] for d in store._tiles.values()) == 1000
+    assert rt._multi.capacity_per_shard > 64
+
+
+def test_resume_across_capacity_change(tmp_path):
+    """Checkpoints survive capacity changes in BOTH directions: a grown
+    run's snapshot restores into a smaller-configured restart (aggregators
+    grow to match), and a small snapshot restores into a raised capacity
+    (padded up)."""
+    cfg = mk_cfg(tmp_path, state_capacity_log2=6, state_max_log2=12,
+                 batch_size=128)
+    store = MemoryStore()
+    src = SyntheticSource(n_events=1024, n_vehicles=400,
+                          events_per_second=128)
+    rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=1)
+    for _ in range(4):
+        rt.step_once()
+    rt._checkpoint()
+    rt._ckpt_join()
+    grown_cap = rt._multi.capacity_per_shard
+    assert grown_cap > 64  # the snapshot on disk is from a grown run
+    rt.close()
+
+    # restart with the ORIGINAL small capacity: aggregators grow to match
+    src2 = SyntheticSource(n_events=1024, n_vehicles=400,
+                           events_per_second=128)
+    store2 = MemoryStore()
+    rt2 = MicroBatchRuntime(cfg, src2, store2, checkpoint_every=0)
+    assert rt2._multi.capacity_per_shard == grown_cap
+    rt2.run()
+    assert src2.exhausted
+
+    # restart with capacity RAISED past the snapshot: padded up
+    cfg3 = mk_cfg(tmp_path, state_capacity_log2=11, state_max_log2=12,
+                  batch_size=128)
+    src3 = SyntheticSource(n_events=1024, n_vehicles=400,
+                           events_per_second=128)
+    rt3 = MicroBatchRuntime(cfg3, src3, MemoryStore(), checkpoint_every=0)
+    assert rt3._multi.capacity_per_shard == 2048
+    rt3.run()
+
+
+def test_resume_refuses_shard_count_change(tmp_path):
+    """A checkpoint written under a different shard topology must refuse
+    loudly — rows would be reinterpreted as the wrong shard blocks."""
+    import json as _json
+    import os
+
+    cfg = mk_cfg(tmp_path)
+    src = SyntheticSource(n_events=1024, n_vehicles=50,
+                          events_per_second=512)
+    rt = MicroBatchRuntime(cfg, src, MemoryStore(), checkpoint_every=1)
+    rt.step_once()
+    rt._checkpoint()
+    rt._ckpt_join()
+    rt.close()
+    # tamper: claim the snapshot came from an 8-shard topology
+    with open(rt.ckpt.latest_path) as fh:
+        cdir = os.path.join(cfg.checkpoint_dir, fh.read().strip())
+    mp = os.path.join(cdir, "meta.json")
+    meta = _json.load(open(mp))
+    assert meta["shards"] == 1  # recorded by the commit
+    meta["shards"] = 8
+    _json.dump(meta, open(mp, "w"))
+    src2 = SyntheticSource(n_events=1024, n_vehicles=50,
+                           events_per_second=512)
+    with pytest.raises(RuntimeError, match="shard"):
+        MicroBatchRuntime(cfg, src2, MemoryStore(), checkpoint_every=0)
