@@ -15,10 +15,14 @@ the full update-mode emit (packed, count/avg/p95 per touched group); emit
 pulls are issued async and overlap the next chunk's compute.
 
 On an accelerator the harness first AUTOTUNES (BENCH_AUTOTUNE=0 disables):
-short timed runs over a small (batch, chunk, merge-impl) grid pick the
-best configuration, which then runs the full-length headline measurement.
-Explicit BENCH_BATCH/BENCH_CHUNK/HEATMAP_MERGE_IMPL env values pin that
-dimension instead of sweeping it.
+short timed runs over a small (merge-impl x batch, then chunk, then
+state capacity) grid pick the best configuration, which then runs the
+full-length headline measurement.  Explicit BENCH_BATCH / BENCH_CHUNK /
+HEATMAP_MERGE_IMPL / BENCH_CAP_LOG2 env values pin their dimension
+instead of sweeping it.  Configs that drop groups at capacity are
+rejected (the engine's exact overflow counter rides the scan carry),
+and a headline run that drops groups re-runs at a doubled slab so the
+published number is never overflow-inflated.
 
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
 ratio is against the BASELINE.json north-star target of 5M events/sec.
@@ -168,29 +172,34 @@ def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
 
     try:
         @functools.partial(jax.jit, donate_argnums=(0,))
-        def run_chunk(state, ev):
+        def run_chunk(carry, ev):
             valid = jnp.ones((batch,), bool)
 
-            def body(st, e):
+            def body(c, e):
+                st, ovf = c
                 st, emit, stats = aggregate_batch(
                     st, e["lat"], e["lng"], e["speed"], e["ts"], valid,
                     jnp.int32(-(2**31)), params,
                 )
-                return st, pack_emit(emit, params.speed_hist_max)
+                # ride the overflow counter in the carry: dropped groups
+                # must disqualify a config (occupancy at the end is a bad
+                # proxy — window eviction frees slots mid-run)
+                return ((st, ovf + stats.state_overflow),
+                        pack_emit(emit, params.speed_hist_max))
 
-            state, packed = jax.lax.scan(body, state, ev)
-            return state, packed  # packed: (chunk, E+1, 10) uint32
+            carry, packed = jax.lax.scan(body, carry, ev)
+            return carry, packed  # packed: (chunk, E+1, 10) uint32
 
         state = init_state(cap, bins)
 
         # --- warmup / compile ---------------------------------------------
         t0 = time.monotonic()
         ev0 = {k: jax.device_put(v[0]) for k, v in host_events.items()}
-        state, packed = run_chunk(state, ev0)
+        carry, packed = run_chunk((state, jnp.int32(0)), ev0)
         np.asarray(packed[0, 0, 0])
         print(f"# [{merge_impl} b={batch} c={chunk}] compile+warmup: "
               f"{time.monotonic() - t0:.1f}s", file=sys.stderr)
-        state = init_state(cap, bins)  # reset after warmup
+        carry = (init_state(cap, bins), jnp.int32(0))  # reset after warmup
 
         # --- timed run ----------------------------------------------------
         emitted_rows = 0
@@ -200,7 +209,7 @@ def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
         last = t_start
         for c in range(n_chunks):
             ev = {k: jax.device_put(v[c]) for k, v in host_events.items()}
-            state, packed = run_chunk(state, ev)
+            carry, packed = run_chunk(carry, ev)
             if pending is not None:
                 # ONE D2H for the whole chunk's emits (per-pull dominates)
                 bufs = np.asarray(pending)
@@ -213,7 +222,9 @@ def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
         bufs = np.asarray(pending)
         for b in range(chunk):
             emitted_rows += unpack_emit(bufs[b])["n_emitted"]
+        state, ovf = carry
         n_active = int(np.asarray(jnp.sum(state.count > 0)))
+        state_overflow = int(np.asarray(ovf))
         wall = time.monotonic() - t_start
     finally:
         step_mod.MERGE_IMPL = prev_impl
@@ -226,6 +237,7 @@ def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
         "total": total, "wall": wall, "n_chunks": n_chunks,
         "n_batches": n_batches, "p50_batch_ms": p50_batch,
         "n_active": n_active, "emitted_rows": emitted_rows,
+        "state_overflow": state_overflow,
     }
     return eps, info
 
@@ -257,10 +269,10 @@ def main() -> dict:
     print(f"# device: {dev.platform} {dev.device_kind}", file=sys.stderr)
     on_accel = dev.platform != "cpu"
 
-    fixed = dict(res=res, cap=cap, bins=bins, emit_cap=emit_cap)
     batch_env = os.environ.get("BENCH_BATCH")
     chunk_env = os.environ.get("BENCH_CHUNK")
     impl_env = os.environ.get("HEATMAP_MERGE_IMPL")
+    cap_env = os.environ.get("BENCH_CAP_LOG2")
     batch = int(batch_env) if batch_env else 1 << 20
     chunk = int(chunk_env) if chunk_env else 8
     impl = impl_env if impl_env else "sort"
@@ -279,38 +291,61 @@ def main() -> dict:
                         batch)
 
     if autotune:
-        # two short-run stages keep the compile count ~8 (each compile on a
-        # remote-attached chip costs 20-40s): (impl x batch) at the default
-        # chunk, then chunk alternatives on the stage-1 winner.  Explicit
-        # env values pin their dimension.
-        def _try(b, c, im, best):
+        # three short-run stages keep the compile count ~10 (each compile
+        # on a remote-attached chip costs 20-40s): (impl x batch) at the
+        # default chunk, chunk alternatives on that winner, then state
+        # capacity.  Explicit env values pin their dimension.  Capacity
+        # candidates whose slab ends up nearly full are rejected — a full
+        # slab means overflow drops would buy throughput dishonestly.
+        def _try(b, c, im, cp, best):
             short = min(n_events, 4 * b * c)
             try:
-                eps, _ = _run_config(flat, **fixed, batch=b, chunk=c,
-                                     merge_impl=im, n_events=short)
+                eps, inf = _run_config(flat, res=res, cap=cp, bins=bins,
+                                       emit_cap=emit_cap, batch=b, chunk=c,
+                                       merge_impl=im, n_events=short)
             except Exception as e:  # noqa: BLE001 - skip bad configs
-                print(f"# autotune [{im} b={b} c={c}] failed: {e}",
+                print(f"# autotune [{im} b={b} c={c} cap={cp}] failed: {e}",
                       file=sys.stderr)
                 return best
-            print(f"# autotune [{im} b={b} c={c}]: {eps / 1e6:.2f}M ev/s",
-                  file=sys.stderr)
-            return max(best, (eps, b, c, im))
+            if inf["state_overflow"]:
+                print(f"# autotune [{im} b={b} c={c} cap={cp}] rejected: "
+                      f"{inf['state_overflow']} groups dropped at capacity",
+                      file=sys.stderr)
+                return best
+            print(f"# autotune [{im} b={b} c={c} cap={cp}]: "
+                  f"{eps / 1e6:.2f}M ev/s", file=sys.stderr)
+            return max(best, (eps, b, c, im, cp))
 
         impls = [impl_env] if impl_env else ["sort", "rank"]
-        best = (0.0, batch, chunk, impl)
+        # a pinned BENCH_CAP_LOG2 disables the capacity stage (stages 1-2
+        # already ran at it)
+        cand_caps = [] if cap_env else [cap >> 1, cap << 1]
+        best = (0.0, batch, chunk, impl, cap)
         for b in cand_batches:
             for im in impls:
-                best = _try(b, chunk, im, best)
+                best = _try(b, chunk, im, cap, best)
         c0 = chunk  # the chunk every stage-1 candidate already ran at
         for c in cand_chunks:
             if c != c0:
-                best = _try(best[1], c, best[3], best)
-        _, batch, chunk, impl = best
-        print(f"# autotune winner: impl={impl} batch={batch} chunk={chunk}",
-              file=sys.stderr)
+                best = _try(best[1], c, best[3], cap, best)
+        for cp in cand_caps:
+            best = _try(best[1], best[2], best[3], cp, best)
+        _, batch, chunk, impl, cap = best
+        print(f"# autotune winner: impl={impl} batch={batch} chunk={chunk} "
+              f"cap={cap}", file=sys.stderr)
 
-    eps, info = _run_config(flat, **fixed, batch=batch, chunk=chunk,
-                            merge_impl=impl, n_events=n_events)
+    # the short autotune runs can under-predict the full run's group
+    # count; if the headline run dropped groups, double the slab and
+    # re-run so the published number is never overflow-inflated
+    for _attempt in range(3):
+        eps, info = _run_config(flat, res=res, cap=cap, bins=bins,
+                                emit_cap=emit_cap, batch=batch, chunk=chunk,
+                                merge_impl=impl, n_events=n_events)
+        if not info["state_overflow"]:
+            break
+        print(f"# headline run dropped {info['state_overflow']} groups at "
+              f"cap={cap}; re-running at {cap * 2}", file=sys.stderr)
+        cap *= 2
     print(
         f"# {info['total']:,} events in {info['wall']:.2f}s "
         f"({info['n_chunks']} chunks x {chunk} batches of {batch:,}, "
