@@ -16,7 +16,8 @@ pulls are issued async and overlap the next chunk's compute.
 
 On an accelerator the harness first AUTOTUNES (BENCH_AUTOTUNE=0 disables):
 short timed runs over a small (merge-impl x batch, then chunk, then
-state capacity) grid pick the best configuration, which then runs the
+state capacity, then H3 snap impl — the fused Pallas kernel is tried on
+accelerators) grid pick the best configuration, which then runs the
 full-length headline measurement.  Explicit BENCH_BATCH / BENCH_CHUNK /
 HEATMAP_MERGE_IMPL / BENCH_CAP_LOG2 env values pin their dimension
 instead of sweeping it.  Configs that drop groups at capacity are
@@ -145,7 +146,7 @@ def _required_events(n_events: int, batch: int, chunk: int) -> int:
 
 
 def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
-                merge_impl, n_events):
+                merge_impl, n_events, h3_impl="xla"):
     """One timed run at a configuration; returns (events_per_sec, info)."""
     import jax
     import jax.numpy as jnp
@@ -166,9 +167,23 @@ def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
     }
 
     # merge impl is a trace-time choice (resolved once at import); the
-    # sweep overrides the module constant around each fresh trace
+    # sweep overrides the module constant around each fresh trace.  The
+    # H3 snap impl is likewise read from the env at trace time — pallas
+    # only lowers on real hardware (Mosaic), so a failed lowering simply
+    # fails this candidate.
     prev_impl = step_mod.MERGE_IMPL
     step_mod.MERGE_IMPL = merge_impl
+    if h3_impl == "pallas":
+        from heatmap_tpu.hexgrid import pallas_kernel
+
+        # _snap_impl silently falls back to XLA when the kernel doesn't
+        # apply — a 'pallas' measurement must never secretly time XLA
+        if not (pallas_kernel.pallas_available() and res <= 10):
+            raise RuntimeError(
+                "pallas snap not usable on this backend/res; candidate "
+                "skipped rather than silently measuring XLA")
+    prev_h3 = os.environ.get("HEATMAP_H3_IMPL")
+    os.environ["HEATMAP_H3_IMPL"] = h3_impl
 
     try:
         @functools.partial(jax.jit, donate_argnums=(0,))
@@ -228,6 +243,10 @@ def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
         wall = time.monotonic() - t_start
     finally:
         step_mod.MERGE_IMPL = prev_impl
+        if prev_h3 is None:
+            os.environ.pop("HEATMAP_H3_IMPL", None)
+        else:
+            os.environ["HEATMAP_H3_IMPL"] = prev_h3
 
     total = n_batches * batch
     eps = total / wall
@@ -297,42 +316,54 @@ def main() -> dict:
         # capacity.  Explicit env values pin their dimension.  Capacity
         # candidates whose slab ends up nearly full are rejected — a full
         # slab means overflow drops would buy throughput dishonestly.
-        def _try(b, c, im, cp, best):
+        def _try(b, c, im, cp, h3, best):
             short = min(n_events, 4 * b * c)
+            tag = f"{im} b={b} c={c} cap={cp} h3={h3}"
             try:
                 eps, inf = _run_config(flat, res=res, cap=cp, bins=bins,
                                        emit_cap=emit_cap, batch=b, chunk=c,
-                                       merge_impl=im, n_events=short)
+                                       merge_impl=im, n_events=short,
+                                       h3_impl=h3)
             except Exception as e:  # noqa: BLE001 - skip bad configs
-                print(f"# autotune [{im} b={b} c={c} cap={cp}] failed: {e}",
-                      file=sys.stderr)
+                print(f"# autotune [{tag}] failed: {e}", file=sys.stderr)
                 return best
             if inf["state_overflow"]:
-                print(f"# autotune [{im} b={b} c={c} cap={cp}] rejected: "
+                print(f"# autotune [{tag}] rejected: "
                       f"{inf['state_overflow']} groups dropped at capacity",
                       file=sys.stderr)
                 return best
-            print(f"# autotune [{im} b={b} c={c} cap={cp}]: "
-                  f"{eps / 1e6:.2f}M ev/s", file=sys.stderr)
-            return max(best, (eps, b, c, im, cp))
+            print(f"# autotune [{tag}]: {eps / 1e6:.2f}M ev/s",
+                  file=sys.stderr)
+            return max(best, (eps, b, c, im, cp, h3))
 
         impls = [impl_env] if impl_env else ["sort", "rank"]
         # a pinned BENCH_CAP_LOG2 disables the capacity stage (stages 1-2
-        # already ran at it)
+        # already ran at it); a pinned HEATMAP_H3_IMPL likewise pins the
+        # snap stage
         cand_caps = [] if cap_env else [cap >> 1, cap << 1]
-        best = (0.0, batch, chunk, impl, cap)
+        h3_env = os.environ.get("HEATMAP_H3_IMPL")
+        h3 = h3_env or "xla"
+        # the fused Pallas snap has never been measured on hardware — let
+        # the accelerator run try it (a failed Mosaic lowering just fails
+        # the candidate)
+        cand_h3 = [] if (h3_env or not on_accel) else ["pallas"]
+        best = (0.0, batch, chunk, impl, cap, h3)
         for b in cand_batches:
             for im in impls:
-                best = _try(b, chunk, im, cap, best)
+                best = _try(b, chunk, im, cap, h3, best)
         c0 = chunk  # the chunk every stage-1 candidate already ran at
         for c in cand_chunks:
             if c != c0:
-                best = _try(best[1], c, best[3], cap, best)
+                best = _try(best[1], c, best[3], cap, h3, best)
         for cp in cand_caps:
-            best = _try(best[1], best[2], best[3], cp, best)
-        _, batch, chunk, impl, cap = best
+            best = _try(best[1], best[2], best[3], cp, h3, best)
+        for h3i in cand_h3:
+            best = _try(best[1], best[2], best[3], best[4], h3i, best)
+        _, batch, chunk, impl, cap, h3 = best
         print(f"# autotune winner: impl={impl} batch={batch} chunk={chunk} "
-              f"cap={cap}", file=sys.stderr)
+              f"cap={cap} h3={h3}", file=sys.stderr)
+    else:
+        h3 = os.environ.get("HEATMAP_H3_IMPL", "xla")
 
     # the short autotune runs can under-predict the full run's group
     # count; if the headline run dropped groups, double the slab and
@@ -340,7 +371,8 @@ def main() -> dict:
     for attempt in range(3):
         eps, info = _run_config(flat, res=res, cap=cap, bins=bins,
                                 emit_cap=emit_cap, batch=batch, chunk=chunk,
-                                merge_impl=impl, n_events=n_events)
+                                merge_impl=impl, n_events=n_events,
+                                h3_impl=h3)
         if not info["state_overflow"]:
             break
         if attempt == 2:
@@ -354,7 +386,7 @@ def main() -> dict:
     print(
         f"# {info['total']:,} events in {info['wall']:.2f}s "
         f"({info['n_chunks']} chunks x {chunk} batches of {batch:,}, "
-        f"merge={impl}) | per-batch mean "
+        f"merge={impl}, h3={h3}) | per-batch mean "
         f"{info['wall'] / info['n_batches'] * 1e3:.0f}ms "
         f"(p50 chunk/batch {info['p50_batch_ms']:.0f}ms) | active groups "
         f"{info['n_active']:,} | emit rows {info['emitted_rows']:,}",

@@ -460,9 +460,8 @@ def test_end_to_end_per_cell_differential(tmp_path):
     import collections
     import math
 
-    from heatmap_tpu.hexgrid import h3_to_string
     from heatmap_tpu.hexgrid.device import (
-        cells_to_uint64,
+        cells_to_strings,
         latlng_deg_to_cell_vec,
     )
 
@@ -484,8 +483,7 @@ def test_end_to_end_per_cell_differential(tmp_path):
     cells_by_res = {}
     for res in (7, 8):
         hi, lo = latlng_deg_to_cell_vec(lat, lon, res)
-        cells_by_res[res] = [h3_to_string(int(c)) for c in
-                             cells_to_uint64(np.asarray(hi), np.asarray(lo))]
+        cells_by_res[res] = cells_to_strings(np.asarray(hi), np.asarray(lo))
     oracle: dict = collections.defaultdict(lambda: [0, 0.0])
     for i, e in enumerate(evs):
         ts = int(dt.datetime.strptime(e["ts"], "%Y-%m-%dT%H:%M:%S%z")
