@@ -171,17 +171,28 @@ def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
     # H3 snap impl is likewise read from the env at trace time — pallas
     # only lowers on real hardware (Mosaic), so a failed lowering simply
     # fails this candidate.
-    prev_impl = step_mod.MERGE_IMPL
-    step_mod.MERGE_IMPL = merge_impl
     if h3_impl == "pallas":
+        # _snap_impl silently falls back to XLA when the kernel doesn't
+        # apply — a 'pallas' measurement must never secretly time XLA.
+        # Ask the REAL dispatcher (no re-derived condition to drift).
         from heatmap_tpu.hexgrid import pallas_kernel
 
-        # _snap_impl silently falls back to XLA when the kernel doesn't
-        # apply — a 'pallas' measurement must never secretly time XLA
-        if not (pallas_kernel.pallas_available() and res <= 10):
+        probe_prev = os.environ.get("HEATMAP_H3_IMPL")
+        os.environ["HEATMAP_H3_IMPL"] = "pallas"
+        try:
+            engaged = (step_mod._snap_impl(res)
+                       is pallas_kernel.latlng_to_cell_pallas)
+        finally:
+            if probe_prev is None:
+                os.environ.pop("HEATMAP_H3_IMPL", None)
+            else:
+                os.environ["HEATMAP_H3_IMPL"] = probe_prev
+        if not engaged:
             raise RuntimeError(
                 "pallas snap not usable on this backend/res; candidate "
                 "skipped rather than silently measuring XLA")
+    prev_impl = step_mod.MERGE_IMPL
+    step_mod.MERGE_IMPL = merge_impl
     prev_h3 = os.environ.get("HEATMAP_H3_IMPL")
     os.environ["HEATMAP_H3_IMPL"] = h3_impl
 

@@ -83,10 +83,12 @@ WORKER = textwrap.dedent("""
 
     ckpt_dir = os.path.join(os.path.dirname(out_path), "ckpt")
     # bucket_factor 16: the synthetic grid concentrates keys on few cells,
-    # so the default 2x skew headroom would drop events at the exchange
+    # so the default 2x skew headroom would drop events at the exchange.
+    # state capacity starts SMALL (2^8/shard after the init floor) so the
+    # mid-run growth path must fire — in lockstep on both hosts.
     cfg = load_config({}, batch_size=GLOBAL_BATCH, store="memory",
-                      checkpoint_dir=ckpt_dir, state_capacity_log2=12,
-                      bucket_factor=16.0)
+                      checkpoint_dir=ckpt_dir, state_capacity_log2=8,
+                      state_max_log2=13, bucket_factor=16.0)
     store = MemoryStore()
     # ASYMMETRIC feeds: host 0 has one batch, host 1 has two — host 0 must
     # keep participating in the collectives with empty batches until the
@@ -94,7 +96,7 @@ WORKER = textwrap.dedent("""
     n_local_events = 512 * (pid + 1)
     events = [
         {"provider": "mh", "vehicleId": f"veh-{pid}-{i % 40}",
-         "lat": 42.3 + ((pid * 512 + i) % 100) * 1e-3, "lon": -71.05,
+         "lat": 42.0 + ((pid * 512 + i) * 7 % 1500) * 1e-3, "lon": -71.05,
          "speedKmh": 30.0, "ts": 1_700_000_000 + i % 300}
         for i in range(n_local_events)
     ]
@@ -117,7 +119,11 @@ WORKER = textwrap.dedent("""
         json.dump({"pid": pid, "n_valid": n_valid, "n_active": n_active,
                    "rows": local, "rt_tile_count": tile_count,
                    "rt_n_tiles": n_tiles,
-                   "rt_events_valid": int(events_valid_global)}, fh)
+                   "rt_events_valid": int(events_valid_global),
+                   "rt_cap": int(rt._sharded.capacity_per_shard),
+                   "rt_grown": int(rt.metrics.counters.get("state_grown", 0)),
+                   "rt_overflow": int(rt.metrics.counters.get(
+                       "state_overflow_groups", 0))}, fh)
 """)
 
 
@@ -174,3 +180,9 @@ def test_two_process_sharded_aggregation(tmp_path):
     assert sum(r["rt_tile_count"] for r in results) == 1536
     assert all(r["rt_n_tiles"] > 0 for r in results)
     assert [r["rt_events_valid"] for r in results] == [1536, 1536]
+    # state growth fired mid-run, in LOCKSTEP: both hosts grew the same
+    # number of times to the same capacity (a one-sided grow would wedge
+    # the collectives), and nothing was dropped along the way
+    assert results[0]["rt_grown"] == results[1]["rt_grown"] >= 1
+    assert results[0]["rt_cap"] == results[1]["rt_cap"] > 256
+    assert [r["rt_overflow"] for r in results] == [0, 0]
