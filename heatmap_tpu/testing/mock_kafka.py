@@ -13,6 +13,7 @@ Topics auto-create on first metadata request with ``num_partitions``
 
 from __future__ import annotations
 
+import socket
 import socketserver
 import struct
 import threading
@@ -41,6 +42,21 @@ class _Handler(socketserver.BaseRequestHandler):
         from heatmap_tpu.utils.netio import recv_exact_or_none
 
         return recv_exact_or_none(self.request, n)
+
+    def setup(self):
+        # track live connections so close() can sever them — a broker
+        # shutdown must look like an outage to already-connected clients,
+        # not a zombie socket still serving the old in-memory state
+        self.server._conns.add(self.request)  # type: ignore[attr-defined]
+        if getattr(self.server, "_closing", False):
+            # accepted in the races of shutdown: sever immediately
+            try:
+                self.request.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    def finish(self):
+        self.server._conns.discard(self.request)  # type: ignore[attr-defined]
 
     def handle(self):
         while True:
@@ -194,6 +210,7 @@ class MockKafkaBroker:
 
         self._server = _Server((host, port), _Handler)
         self._server.state = _State(num_partitions)  # type: ignore
+        self._server._conns = set()  # type: ignore[attr-defined]
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -208,8 +225,14 @@ class MockKafkaBroker:
         return f"{host}:{port}"
 
     def close(self) -> None:
+        self._server._closing = True  # type: ignore[attr-defined]
         self._server.shutdown()
         self._server.server_close()
+        for conn in list(self._server._conns):  # type: ignore[attr-defined]
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
     def __enter__(self) -> str:
         return self.bootstrap
