@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import datetime as dt
 import functools
+import gzip
 import json
 import logging
 import threading
@@ -144,11 +145,38 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                            [("Content-Type", "application/json")])
             return [b'{"error": "internal"}']
         data = body.encode("utf-8")
-        start_response("200 OK", [("Content-Type", ctype),
-                                  ("Content-Length", str(len(data)))])
+        headers = [("Content-Type", ctype)]
+        # tile FeatureCollections run to hundreds of KB and the UI polls
+        # every few seconds; GeoJSON gzips ~5-10x
+        if len(data) >= 1024 and _accepts_gzip(
+                environ.get("HTTP_ACCEPT_ENCODING", "")):
+            data = gzip.compress(data, compresslevel=1)
+            headers.append(("Content-Encoding", "gzip"))
+        headers.append(("Vary", "Accept-Encoding"))
+        headers.append(("Content-Length", str(len(data))))
+        start_response("200 OK", headers)
         return [data]
 
     return app
+
+
+def _accepts_gzip(accept_encoding: str) -> bool:
+    """True when the client lists gzip with a nonzero qvalue (a bare
+    substring match would gzip at 'gzip;q=0')."""
+    for part in accept_encoding.split(","):
+        token, _, params = part.strip().partition(";")
+        if token.strip().lower() != "gzip":
+            continue
+        q = 1.0
+        for p in params.split(";"):
+            k, _, v = p.strip().partition("=")
+            if k.strip().lower() == "q":
+                try:
+                    q = float(v)
+                except ValueError:
+                    q = 0.0
+        return q > 0.0
+    return False
 
 
 class _ThreadingWSGIServer(ThreadingMixIn, WSGIServer):

@@ -177,3 +177,64 @@ def test_index_embeds_multi_res_grids(tmp_path):
     assert 'const GRIDS = [[8, "h3r8"]];' in single
     none = render_index(5000)
     assert "const GRIDS = [];" in none
+
+
+def test_gzip_negotiation():
+    """Large JSON bodies gzip when the client accepts it; small bodies
+    and non-accepting clients get identity, and content round-trips."""
+    import gzip
+    import json as _json
+
+    from heatmap_tpu.serve.api import make_wsgi_app
+
+    store = MemoryStore()
+    now = dt.datetime.now(UTC).replace(microsecond=0)
+    ws = now - dt.timedelta(minutes=2)
+    cells = {hexgrid.latlng_to_cell(42.2 + i * 7e-3, -71.05, 8)
+             for i in range(200)}
+    store.upsert_tiles([
+        TileDoc("bos", 8, c, ws, ws + dt.timedelta(minutes=5),
+                count=i + 1, avg_speed_kmh=30.0, avg_lat=42.3,
+                avg_lon=-71.05, ttl_minutes=45)
+        for i, c in enumerate(sorted(cells))
+    ])
+    n_docs = len(cells)
+    app = make_wsgi_app(store)
+
+    def req(path, accept_gzip):
+        captured = {}
+
+        def sr(status, headers):
+            captured["status"] = status
+            captured["headers"] = dict(headers)
+
+        env = {"PATH_INFO": path, "QUERY_STRING": ""}
+        if accept_gzip:
+            env["HTTP_ACCEPT_ENCODING"] = "gzip, deflate"
+        body = b"".join(app(env, sr))
+        return captured, body
+
+    cap, body = req("/api/tiles/latest", accept_gzip=True)
+    assert cap["headers"].get("Content-Encoding") == "gzip"
+    fc = _json.loads(gzip.decompress(body))
+    assert len(fc["features"]) == n_docs
+
+    cap2, body2 = req("/api/tiles/latest", accept_gzip=False)
+    assert "Content-Encoding" not in cap2["headers"]
+    assert len(_json.loads(body2)["features"]) == n_docs
+
+    cap3, body3 = req("/healthz", accept_gzip=True)  # tiny: identity
+    assert "Content-Encoding" not in cap3["headers"]
+    assert _json.loads(body3) == {"ok": True}
+
+
+def test_gzip_qvalue_refusal():
+    from heatmap_tpu.serve.api import _accepts_gzip
+
+    assert _accepts_gzip("gzip")
+    assert _accepts_gzip("gzip, deflate")
+    assert _accepts_gzip("deflate, gzip;q=0.5")
+    assert not _accepts_gzip("gzip;q=0, identity")
+    assert not _accepts_gzip("gzip;q=0.0")
+    assert not _accepts_gzip("identity")
+    assert not _accepts_gzip("")
