@@ -72,6 +72,27 @@ class Config:
         """Grid label used in tile _ids, e.g. "h3r8" (heatmap_stream.py:179)."""
         return f"h3r{self.h3_res}"
 
+    def pair_grid(self, res: int, wmin: int) -> str:
+        """Sink grid label for a (res, window) pair — the single source of
+        truth for the tagging rule: the reference's bare "h3r{res}" when
+        the window IS the reference tile window (tile _ids stay drop-in
+        compatible, heatmap_stream.py:173), tagged "h3r{res}m{wmin}"
+        otherwise.  The runtime writes under these labels and the API
+        derives its bare-endpoint default from them."""
+        return (f"h3r{res}" if wmin == self.tile_minutes
+                else f"h3r{res}m{wmin}")
+
+    def default_grid(self) -> str:
+        """The grid bare /api/tiles/latest serves: the configured h3_res
+        (or the first resolution), under the reference tile window when
+        it is configured, else the first window — always a grid the
+        runtime actually writes."""
+        res_list = self.resolutions or (self.h3_res,)
+        res = self.h3_res if self.h3_res in res_list else res_list[0]
+        wins = self.windows_minutes or (self.tile_minutes,)
+        wmin = self.tile_minutes if self.tile_minutes in wins else wins[0]
+        return self.pair_grid(res, wmin)
+
 
 def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
     """Build a Config from env vars (same names as the reference) + overrides."""

@@ -406,6 +406,22 @@ int64_t dec_decode(void* dv, const char* buf, int64_t len, int64_t cap,
                     else if (key_is(k, kn, "lon")) f.lon = v;
                     else if (key_is(k, kn, "speedKmh")) f.speed = v;
                     else if (key_is(k, kn, "ts")) f.ts = v;
+                    else if (key_is(k, kn, "vehicleId")) {
+                        // numeric identity: the Python path str()-coerces
+                        // (stream/events.py:106) and the reference's Spark
+                        // StringType schema casts — capture the literal
+                        // token so an unwrapped numeric MBTA label
+                        // (producers/mbta.py, ref :68) is accepted here
+                        // too, not dropped as null.  Identities are opaque
+                        // keys: the token spelling ("17.50") is kept as-is
+                        // rather than re-canonicalized like Python's
+                        // str(17.5).
+                        f.vehicle = q; f.vehicle_n = (size_t)(numend - q);
+                        f.vehicle_null = false;
+                    } else if (key_is(k, kn, "provider")) {
+                        f.provider = q; f.provider_n = (size_t)(numend - q);
+                        f.provider_null = false;
+                    }
                     q = numend;
                 }
             } else {

@@ -78,8 +78,11 @@ class MbtaProducer:
                     ts = utcnow_iso()
                 out.append({
                     "provider": self.provider,
-                    "vehicleId": str(attrs.get("label") or item.get("id")
-                                     or "unknown"),
+                    # unwrapped like the ref (:68): a numeric label goes
+                    # into the JSON as a number; only the Kafka KEY is
+                    # str()'d (producers/base.py, ref :79)
+                    "vehicleId": (attrs.get("label") or item.get("id")
+                                  or "unknown"),
                     "lat": float(lat),
                     "lon": float(lon),
                     "speedKmh": (float(speed_ms) * 3.6

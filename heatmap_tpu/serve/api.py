@@ -97,14 +97,13 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
     resolutions = getattr(cfg, "resolutions", None) if cfg else None
     # default grid for bare /api/tiles/latest: one grid per response (the
     # reference contract) that actually EXISTS in the configured pyramid
-    default_grid = None
-    if cfg is not None:
-        res_list = tuple(resolutions or ())
-        h3res = getattr(cfg, "h3_res", None)
-        if h3res is not None and (not res_list or h3res in res_list):
-            default_grid = f"h3r{h3res}"
-        elif res_list:
-            default_grid = f"h3r{res_list[0]}"
+    # Config.default_grid matches the runtime's tagging rule (pair_grid):
+    # with e.g. WINDOW_MINUTES=1,15 TILE_MINUTES=5 the untagged h3r{res}
+    # grid is never written, so the bare endpoint must point at a tagged
+    # grid that exists instead of a permanently empty FeatureCollection.
+    default_grid = (cfg.default_grid()
+                    if cfg is not None and hasattr(cfg, "default_grid")
+                    else None)
 
     def app(environ, start_response):
         path = environ.get("PATH_INFO", "/")

@@ -182,7 +182,8 @@ def _parse_strtab(blob: bytes, n_strings: int) -> list[str] | None:
 
 
 def decode_batch(value: bytes, intern_p: dict, intern_v: dict,
-                 lut_cache: dict | None = None) -> EventColumns | None:
+                 lut_cache: dict | None = None,
+                 extras: dict | None = None) -> EventColumns | None:
     """One columnar value -> EventColumns (session-interned ids).
 
     Returns None when the envelope (magic/version/lengths) is invalid;
@@ -191,7 +192,10 @@ def decode_batch(value: bytes, intern_p: dict, intern_v: dict,
     the intern maps) memoizes the string-table parse and the
     batch-id->session-id LUTs keyed by the table blob: producers resend
     the same vehicle set batch after batch, so the steady state does no
-    per-string Python work at all."""
+    per-string Python work at all.  ``extras``, when given, receives the
+    wire columns EventColumns does not carry (``bearing``, ``accuracy``
+    f32 arrays, row-filtered like the rest) — the dict-expansion
+    fallback uses this to report the encoded values instead of zeros."""
     if len(value) < HEADER_SIZE:
         return None
     magic, ver, _flags, n, n_strings, tab_bytes = _HEAD.unpack_from(value)
@@ -211,8 +215,8 @@ def decode_batch(value: bytes, intern_p: dict, intern_v: dict,
     lat = arr("<f4", n)
     lon = arr("<f4", n)
     speed = arr("<f4", n)
-    arr("<f4", n)  # bearing: carried on the wire, unused downstream
-    arr("<f4", n)  # accuracy
+    bearing = arr("<f4", n)   # unused by the device path (EventColumns
+    accuracy = arr("<f4", n)  # drops them); surfaced via ``extras``
     ts = arr("<i8", n)
     pid = arr("<u4", n)
     vid = arr("<u4", n)
@@ -247,7 +251,12 @@ def decode_batch(value: bytes, intern_p: dict, intern_v: dict,
     if n_dropped:
         lat, lon, speed = lat[ok], lon[ok], speed[ok]
         ts, pid, vid = ts[ok], pid[ok], vid[ok]
+        if extras is not None:
+            bearing, accuracy = bearing[ok], accuracy[ok]
     speed = np.where(np.isfinite(speed), speed, np.float32(0.0))
+    if extras is not None:
+        extras["bearing"] = bearing
+        extras["accuracy"] = accuracy
 
     # batch-local string ids -> session intern ids, split by ROLE: only
     # strings actually referenced as providers enter the provider intern
@@ -304,7 +313,8 @@ def decode_batch_dicts(value: bytes) -> list[dict]:
     EventColumns directly and never pays this expansion)."""
     p_map: dict = {}
     v_map: dict = {}
-    cols = decode_batch(value, p_map, v_map)
+    extras: dict = {}
+    cols = decode_batch(value, p_map, v_map, extras=extras)
     if cols is None:
         return []
     providers = list(p_map)
@@ -315,7 +325,7 @@ def decode_batch_dicts(value: bytes) -> list[dict]:
         "lat": float(cols.lat_deg[i]),
         "lon": float(cols.lng_deg[i]),
         "speedKmh": float(cols.speed_kmh[i]),
-        "bearing": 0.0,
-        "accuracyM": 0.0,
+        "bearing": float(extras["bearing"][i]),
+        "accuracyM": float(extras["accuracy"][i]),
         "ts": int(cols.ts_s[i]),
     } for i in range(len(cols))]

@@ -171,7 +171,7 @@ class MicroBatchRuntime:
                 default = wmin == cfg.tile_minutes
                 self._pack_meta[(res, wmin)] = TilePackMeta(
                     city=cfg.city,
-                    grid=f"h3r{res}" if default else f"h3r{res}m{wmin}",
+                    grid=cfg.pair_grid(res, wmin),
                     window_s=wmin * 60,
                     ttl_minutes=cfg.ttl_minutes,
                     window_minutes_tag=0 if default else wmin,
@@ -312,7 +312,24 @@ class MicroBatchRuntime:
             agg.restore(resize_state(st, agg.capacity_per_shard, shards))
 
     def _checkpoint(self) -> None:
-        if self._carry_cols is not None:
+        if self._multiproc:
+            # The mid-carry skip must be decided COLLECTIVELY.  close()
+            # reaches this point on every host (lockstep exits: the
+            # max_batches counter advances on the global had-events flag,
+            # and _fatal derives from replicated stats), but the carry is
+            # per-host — run(max_batches=N) can end with one host
+            # mid-carry while its peers are carry-free.  A local early
+            # return here would strand those peers in the commit barrier
+            # below forever.  All hosts agree first: if ANY carries, ALL
+            # skip (the uncommitted tail just replays on resume — every
+            # sink write is an idempotent upsert).  The step-loop call
+            # site gates on the same global flag, so this collective is
+            # reached on all hosts there too (it reads carry_any == 0).
+            _, _, carry_any = self._gpair(
+                0.0, 0.0, float(self._carry_cols is not None))
+            if carry_any > 0:
+                return
+        elif self._carry_cols is not None:
             # mid-record: state would double-fold the already-dispatched
             # slices on replay — wait for the carry to drain (a step or
             # two); the next eligible epoch commits instead
@@ -738,11 +755,14 @@ class MicroBatchRuntime:
         self.tracer.stop()  # flush a partial profiler capture, if any
         try:
             try:
-                # drain any carry so the exit commit is record-aligned
-                # (multiproc can't exit run() mid-carry: carrying hosts
-                # keep the global had-events flag up, so peers keep
-                # stepping with them).  On a fatal/poisoned exit the
-                # commit is skipped anyway and the uncommitted carry
+                # drain any carry so the exit commit is record-aligned.
+                # Multiproc does NOT drain here (extra local steps would
+                # desync the lockstep collectives; run(max_batches=N) CAN
+                # exit mid-carry) — instead _checkpoint() decides the
+                # mid-carry skip collectively, so a carrying host and its
+                # carry-free peers all skip the exit commit together and
+                # the tail replays on resume.  On a fatal/poisoned exit
+                # the commit is skipped anyway and the uncommitted carry
                 # replays on resume — don't dispatch into a failed run.
                 while (self._carry_cols is not None and not self._multiproc
                        and not self._fatal and not self.writer.poisoned):

@@ -356,3 +356,22 @@ def test_encode_batch_columns_compact_tables_and_bounds():
     bad.vehicle_id[2] = 99                       # past the table
     with pytest.raises(ValueError, match="string-table range"):
         encode_batch_columns(bad)
+
+def test_dict_fallback_preserves_bearing_accuracy():
+    """encode_batch puts real bearing/accuracy on the wire; the portable
+    dict-expansion fallback must report them, not fabricate 0.0
+    (regression) — row filtering included."""
+    evs = mixed_events()
+    for i, e in enumerate(evs):
+        e["bearing"] = float(i * 10 % 360)
+        e["accuracyM"] = float(i) / 2
+    out = decode_batch_dicts(encode_batch(evs))
+    kept = parse_events(evs)
+    assert len(out) == len(kept)
+    by_key = {(d["vehicleId"], d["ts"]): d for d in out}
+    for i, e in enumerate(evs):
+        if i in (3, 7, 13):   # dropped rows (range/finite/ts validation)
+            continue
+        d = by_key[(e["vehicleId"], int(e["ts"]))]
+        assert d["bearing"] == pytest.approx(e["bearing"])
+        assert d["accuracyM"] == pytest.approx(e["accuracyM"])

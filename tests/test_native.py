@@ -198,3 +198,14 @@ def test_cap_limits_output():
     # the rest decodes from the consumed offset
     got2, consumed2 = dec.decode(data[consumed:])
     assert len(got2) == 6
+
+def test_numeric_identity_matches_oracle():
+    """Unquoted numeric provider/vehicleId (an unwrapped MBTA label,
+    producers/mbta.py, ref :68) is str()-coerced by parse_events — the
+    C++ decoder must accept it identically, not drop the event as a null
+    identity (regression)."""
+    evs = mk(3)
+    evs[0]["vehicleId"] = 1711
+    evs[1]["provider"] = 42
+    evs[2]["vehicleId"] = 0
+    assert_matches_oracle(evs)

@@ -177,3 +177,15 @@ def test_pipelines_registry():
     assert len(cols) == 1000
     with pytest.raises(KeyError):
         get_pipeline("nope")
+
+def test_mbta_numeric_label_unwrapped():
+    """A numeric label is published unwrapped, exactly like the ref
+    (mbta_to_kafka.py:68: `attributes.label or id or "unknown"` with no
+    str()): the JSON value is 1711, not "1711".  Only the Kafka KEY is
+    str()'d (ref :79; producers/base.py does the same)."""
+    payload = {"data": [{"id": "y1", "attributes": {
+        "latitude": 42.3, "longitude": -71.0, "label": 1711,
+        "updated_at": "2026-07-29T12:00:00Z"}}]}
+    (e,) = MbtaProducer().to_events(payload)
+    assert e["vehicleId"] == 1711
+    assert not isinstance(e["vehicleId"], str)

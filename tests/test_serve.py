@@ -238,3 +238,34 @@ def test_gzip_qvalue_refusal():
     assert not _accepts_gzip("gzip;q=0.0")
     assert not _accepts_gzip("identity")
     assert not _accepts_gzip("")
+
+def test_bare_tiles_default_grid_without_default_window():
+    """With WINDOW_MINUTES not containing TILE_MINUTES (e.g. 1,15 vs 5)
+    the untagged h3r{res} grid is NEVER written — the runtime tags every
+    window h3r{res}m{w}.  The bare /api/tiles/latest must then default to
+    the first configured window's tagged grid instead of returning a
+    permanently empty FeatureCollection (regression)."""
+    cfg = load_config({"WINDOW_MINUTES": "1,15", "TILE_MINUTES": "5"},
+                      serve_port=0)
+    assert 5 not in cfg.windows_minutes
+    s = MemoryStore()
+    now = dt.datetime.now(UTC).replace(microsecond=0)
+    ws = now - dt.timedelta(minutes=2)
+    cell = hexgrid.latlng_to_cell(42.3601, -71.0589, 8)
+    for wmin in (1, 15):
+        s.upsert_tiles([
+            TileDoc("bos", 8, cell, ws, ws + dt.timedelta(minutes=wmin),
+                    count=wmin, avg_speed_kmh=30.0, avg_lat=42.36,
+                    avg_lon=-71.05, ttl_minutes=45, grid=f"h3r8m{wmin}"),
+        ])
+    httpd, t, port = start_background(s, cfg)
+    try:
+        fc = get_json(f"http://127.0.0.1:{port}/api/tiles/latest")
+        assert len(fc["features"]) == 1
+        assert fc["features"][0]["properties"]["count"] == 1  # the m1 grid
+        # explicit grid param still selects the other window
+        fc15 = get_json(
+            f"http://127.0.0.1:{port}/api/tiles/latest?grid=h3r8m15")
+        assert fc15["features"][0]["properties"]["count"] == 15
+    finally:
+        httpd.shutdown()
