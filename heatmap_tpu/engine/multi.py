@@ -13,7 +13,7 @@ This class fuses all pairs into a single jitted step:
   * each pair's ``merge_batch`` fold runs inside the same XLA program, so
     the per-step dispatch overhead (ruinous on remote-attached chips) is
     paid once;
-  * the per-pair packed emits are stacked into one (P, E+1, 10) matrix —
+  * the per-pair packed emits are stacked into one (P, E+1, 13) matrix —
     the whole batch's output crosses the device->host link in ONE pull.
 
 Host API mirrors SingleAggregator per pair via :class:`PairView` (the
@@ -105,7 +105,7 @@ class MultiAggregator:
                         watermark_cutoff):
         """Fold one batch into every pair's state.
 
-        Returns the packed emits on device: (P, E+1, 10) uint32 — one
+        Returns the packed emits on device: (P, E+1, 13) uint32 — one
         ``unpack_emit`` row block per pair in ``self.pairs`` order, with
         that pair's step stats ridden in head-row slots 2..7
         (``stats_from_packed``).

@@ -74,7 +74,9 @@ class SingleAggregator:
             "key_hi": e.key_hi, "key_lo": e.key_lo, "key_ws": e.key_ws,
             "count": e.count, "sum_speed": e.sum_speed,
             "sum_speed2": e.sum_speed2, "sum_lat": e.sum_lat,
-            "sum_lon": e.sum_lon, "valid": e.valid,
+            "sum_lon": e.sum_lon, "anchor_speed": e.anchor_speed,
+            "anchor_lat": e.anchor_lat, "anchor_lon": e.anchor_lon,
+            "valid": e.valid,
             "hist": np.asarray(e.hist) if e.hist.shape[1] else None,
         }
 

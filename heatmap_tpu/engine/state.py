@@ -27,17 +27,32 @@ EMPTY_WS = jnp.int32(2**31 - 1)
 
 
 class TileState(NamedTuple):
-    """All arrays share leading dim = capacity C; hist is (C, B) (B may be 0)."""
+    """All arrays share leading dim = capacity C; hist is (C, B) (B may be 0).
+
+    The four float accumulators hold RESIDUAL sums about fixed per-group
+    anchors (``anchor_*``), not absolute sums: TPUs have no f64, and an
+    absolute f32 Σlat over a million-event hot cell reaches ~4e7 where the
+    f32 ulp is 4 — the representable sum itself is then microdegrees off.
+    Residuals within one hex cell are tiny (and exact to compute, see
+    step._apply_routing), so f32 holds them losslessly; consumers
+    recombine ``anchor + resid/count`` in f64 host-side.  ``comp`` carries
+    Kahan compensation for the residual sums so cross-batch folding error
+    stays at per-batch rounding level instead of growing with count."""
 
     key_hi: jnp.ndarray    # uint32 — cell index bits 32..63
     key_lo: jnp.ndarray    # uint32 — cell index bits 0..31
     key_ws: jnp.ndarray    # int32  — window start, epoch seconds
     count: jnp.ndarray     # int32
-    sum_speed: jnp.ndarray   # float32 — Σ speedKmh
-    sum_speed2: jnp.ndarray  # float32 — Σ speedKmh²
-    sum_lat: jnp.ndarray     # float32 — Σ lat (degrees)
-    sum_lon: jnp.ndarray     # float32 — Σ lon (degrees)
+    sum_speed: jnp.ndarray   # float32 — Σ (speedKmh - anchor_speed)
+    sum_speed2: jnp.ndarray  # float32 — Σ (speedKmh - anchor_speed)²
+    sum_lat: jnp.ndarray     # float32 — Σ (lat - anchor_lat) (degrees)
+    sum_lon: jnp.ndarray     # float32 — Σ (lon - anchor_lon) (degrees)
     hist: jnp.ndarray        # int32 (C, B) — speed histogram for p95
+    anchor_speed: jnp.ndarray  # float32 — fixed per-group speed anchor
+    anchor_lat: jnp.ndarray    # float32 — fixed per-group lat anchor
+    anchor_lon: jnp.ndarray    # float32 — fixed per-group lon anchor
+    comp: jnp.ndarray          # float32 (C, 4) — Kahan compensation for
+                               # (sum_speed, sum_speed2, sum_lat, sum_lon)
 
     @property
     def capacity(self) -> int:
@@ -60,6 +75,10 @@ def init_state(capacity: int, hist_bins: int = 0) -> TileState:
         sum_lat=jnp.zeros((c,), jnp.float32),
         sum_lon=jnp.zeros((c,), jnp.float32),
         hist=jnp.zeros((c, hist_bins), jnp.int32),
+        anchor_speed=jnp.zeros((c,), jnp.float32),
+        anchor_lat=jnp.zeros((c,), jnp.float32),
+        anchor_lon=jnp.zeros((c,), jnp.float32),
+        comp=jnp.zeros((c, 4), jnp.float32),
     )
 
 

@@ -77,10 +77,13 @@ class BatchEmit(NamedTuple):
     key_lo: jnp.ndarray
     key_ws: jnp.ndarray
     count: jnp.ndarray
-    sum_speed: jnp.ndarray
-    sum_speed2: jnp.ndarray
+    sum_speed: jnp.ndarray   # residual sums about the anchor_* lanes
+    sum_speed2: jnp.ndarray  # (engine.state.TileState docstring)
     sum_lat: jnp.ndarray
     sum_lon: jnp.ndarray
+    anchor_speed: jnp.ndarray  # per-group anchors: consumers recombine
+    anchor_lat: jnp.ndarray    # anchor + resid/count in f64 host-side
+    anchor_lon: jnp.ndarray
     hist: jnp.ndarray
     valid: jnp.ndarray       # bool
     n_emitted: jnp.ndarray   # int32 scalar — true touched-group count
@@ -396,25 +399,75 @@ def _apply_routing(
         zc.at[state_seg].add(jnp.where(keep, state.count, 0), mode="drop")
         .at[batch_seg].add(one, mode="drop")
     )
+
+    # --- residual-anchor accumulation (the f64-free precision story) ----
+    # TPUs have no f64, and absolute f32 sums cannot hold the needed
+    # precision: Σlat over a 1M-event hot cell reaches ~4e7 where the f32
+    # ulp is 4, so even a correctly-rounded absolute sum puts the centroid
+    # ~2e-6 deg off.  Each group instead carries FIXED anchors (min over
+    # the events of the batch that created it — a segment-min, so both
+    # merge impls derive the identical value) and accumulates residuals
+    # about them.  Values within one hex cell lie within a fraction of
+    # each other, so `ev - anchor` is exact (Sterbenz) and the residual
+    # sums stay small enough for f32 to hold to ~1e-8 deg.  Consumers
+    # recombine anchor + resid/count in f64 host-side (sink/base.py,
+    # native/tile_ops.cpp); speed variance is anchor-invariant:
+    # Var(v) = E[r²] − E[r]².
+    inf = jnp.float32(jnp.inf)
+
+    def group_anchor(ev, stored):
+        a = (jnp.full((C,), inf, jnp.float32)
+             .at[batch_seg].min(jnp.where(ev_valid, ev, inf), mode="drop"))
+        # existing groups keep their stored anchor: accumulated residuals
+        # are relative to it, so it must never move while the group lives
+        return a.at[state_seg].set(jnp.where(keep, stored, inf), mode="drop")
+
+    anc_speed = group_anchor(ev_speed, state.anchor_speed)
+    anc_lat = group_anchor(ev_lat_deg, state.anchor_lat)
+    anc_lon = group_anchor(ev_lon_deg, state.anchor_lon)
+
+    gi_ev = jnp.clip(batch_seg, 0, C - 1)
+    resid = lambda ev, anc: jnp.where(ev_valid, ev - anc[gi_ev], 0.0)
+    r_speed = resid(ev_speed, anc_speed)
+    r_lat = resid(ev_lat_deg, anc_lat)
+    r_lon = resid(ev_lon_deg, anc_lon)
+    # overflow-dropped events may read an empty row's inf anchor → non-
+    # finite residuals; their scatter writes are dropped (mode="drop"),
+    # so the values never land — only anchors stored/emitted must be
+    # sanitized (below).
+
     # the four float accumulators ride one (C, 4) scatter instead of four
-    fmask = ev_valid.astype(jnp.float32)
     kf = keep.astype(jnp.float32)
     st_vals = jnp.stack([
         state.sum_speed * kf, state.sum_speed2 * kf,
         state.sum_lat * kf, state.sum_lon * kf,
     ], axis=1)
     ev_vals = jnp.stack([
-        ev_speed * fmask, ev_speed * ev_speed * fmask,
-        ev_lat_deg * fmask, ev_lon_deg * fmask,
+        r_speed, r_speed * r_speed, r_lat, r_lon,
     ], axis=1)
-    sums = (
-        jnp.zeros((C, 4), jnp.float32)
-        .at[state_seg].add(st_vals, mode="drop")
-        .at[batch_seg].add(ev_vals, mode="drop")
-    )
+    base = jnp.zeros((C, 4), jnp.float32).at[state_seg].add(
+        st_vals, mode="drop")
+    delta = jnp.zeros((C, 4), jnp.float32).at[batch_seg].add(
+        ev_vals, mode="drop")
+    comp_r = jnp.zeros((C, 4), jnp.float32).at[state_seg].add(
+        state.comp * kf[:, None], mode="drop")
+    # Kahan fold of the batch delta into the carried sums: the error of
+    # each fold is captured in `comp` and fed back, so the accumulated
+    # error stays at per-batch scatter rounding instead of growing with
+    # the group's total count.  (XLA does not reassociate float adds by
+    # default, so the compensation term survives compilation.)
+    y = delta - comp_r
+    t = base + y
+    comp = (t - base) - y
+    sums = t
     sum_speed, sum_speed2, sum_lat, sum_lon = (
         sums[:, 0], sums[:, 1], sums[:, 2], sums[:, 3]
     )
+    # empty/recycled rows: finite zeros (inf anchors would poison a later
+    # emit pack; empties have no batch events and no kept state row)
+    anc_speed = jnp.where(jnp.isfinite(anc_speed), anc_speed, 0.0)
+    anc_lat = jnp.where(jnp.isfinite(anc_lat), anc_lat, 0.0)
+    anc_lon = jnp.where(jnp.isfinite(anc_lon), anc_lon, 0.0)
 
     if B > 0:
         bin_w = params.speed_hist_max / B
@@ -431,6 +484,8 @@ def _apply_routing(
         key_hi=key_hi, key_lo=key_lo, key_ws=key_ws, count=count,
         sum_speed=sum_speed, sum_speed2=sum_speed2,
         sum_lat=sum_lat, sum_lon=sum_lon, hist=hist,
+        anchor_speed=anc_speed, anchor_lat=anc_lat, anchor_lon=anc_lon,
+        comp=comp,
     )
 
     # --- update-mode emit: groups touched by this batch -------------------
@@ -449,6 +504,9 @@ def _apply_routing(
         sum_speed2=jnp.where(emit_ok, sum_speed2[gi], 0.0),
         sum_lat=jnp.where(emit_ok, sum_lat[gi], 0.0),
         sum_lon=jnp.where(emit_ok, sum_lon[gi], 0.0),
+        anchor_speed=jnp.where(emit_ok, anc_speed[gi], 0.0),
+        anchor_lat=jnp.where(emit_ok, anc_lat[gi], 0.0),
+        anchor_lon=jnp.where(emit_ok, anc_lon[gi], 0.0),
         hist=hist[gi] * emit_ok[:, None].astype(jnp.int32) if B > 0
         else jnp.zeros((E, 0), jnp.int32),
         valid=emit_ok,
@@ -492,7 +550,7 @@ def p95_from_hist_device(hist, count, hist_max: float):
 
 
 def pack_emit(emit: BatchEmit, speed_hist_max: float = 256.0) -> jnp.ndarray:
-    """Pack a BatchEmit into one (E+1, 10) uint32 matrix.
+    """Pack a BatchEmit into one (E+1, 13) uint32 matrix.
 
     Remote-attached TPUs pay a full round trip per transferred leaf; one
     packed matrix makes the per-batch device->host pull a single transfer.
@@ -500,9 +558,12 @@ def pack_emit(emit: BatchEmit, speed_hist_max: float = 256.0) -> jnp.ndarray:
     reserved for a stats rider (``ride_stats`` — engine.multi and
     parallel.sharded embed their step stats there so the host needs no
     second transfer).  Rows 1.. are [key_hi, key_lo, ws, count, sum_speed,
-    sum_speed2, sum_lat, sum_lon, valid, p95] with float lanes bitcast.
-    The histogram itself stays on device — its p95 summary is computed
-    here.  ``unpack_emit`` reverses it host-side.
+    sum_speed2, sum_lat, sum_lon, valid, p95, anchor_speed, anchor_lat,
+    anchor_lon] with float lanes bitcast — the sum lanes are per-group
+    RESIDUAL sums about the anchor lanes (engine.state.TileState); the
+    consumer recombines anchor + resid/count in f64.  The histogram
+    itself stays on device — its p95 summary is computed here.
+    ``unpack_emit`` reverses it host-side.
     """
     bc = lambda a: jax.lax.bitcast_convert_type(a, jnp.uint32)
     E = emit.key_hi.shape[0]
@@ -521,6 +582,9 @@ def pack_emit(emit: BatchEmit, speed_hist_max: float = 256.0) -> jnp.ndarray:
         bc(emit.sum_lon),
         emit.valid.astype(jnp.uint32),
         bc(p95),
+        bc(emit.anchor_speed),
+        bc(emit.anchor_lat),
+        bc(emit.anchor_lon),
     ], axis=1)
     head = jnp.zeros((1, body.shape[1]), jnp.uint32)
     head = head.at[0, 0].set(emit.n_emitted.reshape(()).astype(jnp.uint32))
@@ -577,6 +641,9 @@ def unpack_emit(packed) -> dict:
         "sum_lon": f32(7),
         "valid": body[:, 8] != 0,
         "p95": f32(9),
+        "anchor_speed": f32(10),
+        "anchor_lat": f32(11),
+        "anchor_lon": f32(12),
         "n_emitted": int(p[0, 0]),
         "overflowed": bool(p[0, 1]),
     }

@@ -368,7 +368,7 @@ class NativeTileOps:
     """Packed-emit rows -> wire-ready BSON update ops (tile_ops.cpp).
 
     ``encode(body, ...)`` takes the packed emit matrix's BODY rows
-    ((E, 10) uint32, i.e. ``packed[1:]``) and returns
+    ((E, 13) uint32, i.e. ``packed[1:]``) and returns
     ``(ops_bytes, end_offsets, n_docs)`` where ``ops_bytes`` is the
     concatenated update-op documents for an OP_MSG "updates" document
     sequence and ``end_offsets[i]`` is the byte end of op i (for 1000-op
@@ -393,8 +393,8 @@ class NativeTileOps:
                window_s: int, ttl_minutes: int,
                window_minutes_tag: int = 0, with_p95: bool = True):
         body = np.ascontiguousarray(body, np.uint32)
-        if body.ndim != 2 or body.shape[1] != 10:
-            raise ValueError(f"body must be (E, 10) uint32, got {body.shape}")
+        if body.ndim != 2 or body.shape[1] != 13:
+            raise ValueError(f"body must be (E, 13) uint32, got {body.shape}")
         n_rows = body.shape[0]
         offsets = np.empty(max(n_rows, 1), np.int64)
         nbytes = ctypes.c_int64(0)

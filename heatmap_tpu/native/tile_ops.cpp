@@ -1,7 +1,7 @@
 // tile_ops.cpp — packed-emit rows -> BSON update-op documents, in C++.
 //
 // The sink hot path of the streaming runtime: each micro-batch's device
-// emit arrives on the host as the packed (E+1, 10) uint32 matrix
+// emit arrives on the host as the packed (E+1, 13) uint32 matrix
 // (heatmap_tpu/engine/step.py pack_emit).  The reference built one Python
 // dict per tile row on the Spark driver and let pymongo's C extension
 // encode it (reference: heatmap_stream.py:163-196); here the whole
@@ -113,9 +113,12 @@ inline float as_f32(uint32_t bits) {
 
 extern "C" {
 
-// body: (n_rows, 10) uint32 row-major — the packed emit matrix WITHOUT its
+// body: (n_rows, 13) uint32 row-major — the packed emit matrix WITHOUT its
 // head row (lanes: key_hi, key_lo, ws, count, sum_speed, sum_speed2,
-// sum_lat, sum_lon, valid, p95; float lanes bitcast, see engine/step.py).
+// sum_lat, sum_lon, valid, p95, anchor_speed, anchor_lat, anchor_lon;
+// float lanes bitcast, see engine/step.py).  The sum lanes are residual
+// sums about the anchor lanes; averages recombine anchor + resid/count
+// here in double precision (the device has no f64 — engine/state.py).
 // Writes concatenated BSON update-op docs into out (skipping rows with
 // valid==0 or count<=0), records each op's END offset in offsets[i]
 // (i = 0..n_docs-1), sets *bytes_out to the total length, and returns the
@@ -138,7 +141,7 @@ int64_t enc_tile_ops(
                             + 16 + 23 + 3 + 1);
 
     for (int64_t r = 0; r < n_rows; r++) {
-        const uint32_t* row = body + r * 10;
+        const uint32_t* row = body + r * 13;
         if (row[8] == 0) continue;                 // valid lane
         int32_t count = (int32_t)row[3];
         if (count <= 0) continue;
@@ -150,15 +153,20 @@ int64_t enc_tile_ops(
         double sum_lat = as_f32(row[6]);
         double sum_lon = as_f32(row[7]);
         double p95 = as_f32(row[9]);
+        double anchor_speed = as_f32(row[10]);
+        double anchor_lat = as_f32(row[11]);
+        double anchor_lon = as_f32(row[12]);
 
         hex_u64(cell, cell_hex);
         iso_z_from_epoch(ws, iso);
         int idn = std::snprintf(idbuf.data(), idbuf.size(), "%s|%s|%s|%s",
                                 city, grid, cell_hex, iso);
 
-        double avg_speed = sum_speed / count;
-        double mean_sq = sum_speed2 / count;
-        double var = mean_sq - avg_speed * avg_speed;
+        // residual moments: mean_r recombines with the anchor for the
+        // average; variance is anchor-invariant (Var(v) = E[r^2]-E[r]^2)
+        double mean_r = sum_speed / count;
+        double avg_speed = anchor_speed + mean_r;
+        double var = sum_speed2 / count - mean_r * mean_r;
         if (var < 0.0) var = 0.0;
         double stddev = std::sqrt(var);
         int64_t ws_ms = ws * 1000;
@@ -188,8 +196,8 @@ int64_t enc_tile_ops(
                     // BSON array = doc with "0","1" keys
                     b.u8(0x04); b.cstr("coordinates");
                     int64_t arr = b.mark();
-                    el_f64(b, "0", sum_lon / count);
-                    el_f64(b, "1", sum_lat / count);
+                    el_f64(b, "0", anchor_lon + sum_lon / count);
+                    el_f64(b, "1", anchor_lat + sum_lat / count);
                     b.u8(0); b.patch(arr);
                     doc_close(b, c);
                 }

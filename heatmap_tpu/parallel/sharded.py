@@ -83,7 +83,7 @@ def unpack_emit_shards(rows: np.ndarray, emit_capacity: int,
                        n_pairs: int | None = None):
     """Decode one host's packed emit rows from ShardedAggregator.step_packed.
 
-    ``rows`` is (S * n_pairs * (E+1), 10) — per local shard, the P pairs'
+    ``rows`` is (S * n_pairs * (E+1), 13) — per local shard, the P pairs'
     blocks in pair order.  With ``n_pairs`` given (any value, even 1),
     returns a list of (emit dict, ShardStatsHost), one per pair; with it
     omitted, the historical single-pair signature: one bare
@@ -105,7 +105,8 @@ def unpack_emit_shards(rows: np.ndarray, emit_capacity: int,
         es = [unpack_emit(blocks[s, p]) for s in range(n_shards)]
         e = {k: np.concatenate([x[k] for x in es]) for k in
              ("key_hi", "key_lo", "key_ws", "count", "sum_speed",
-              "sum_speed2", "sum_lat", "sum_lon", "valid", "p95")}
+              "sum_speed2", "sum_lat", "sum_lon", "valid", "p95",
+              "anchor_speed", "anchor_lat", "anchor_lon")}
         e["n_emitted"] = sum(x["n_emitted"] for x in es)
         e["overflowed"] = any(x["overflowed"] for x in es)
         out.append((e, read_stats_rider(blocks[0, p], ShardStatsHost)))
@@ -115,7 +116,7 @@ def unpack_emit_shards(rows: np.ndarray, emit_capacity: int,
 def packed_pair_bodies(rows: np.ndarray, emit_capacity: int, n_pairs: int):
     """Split one host's packed emit rows into per-pair BODY matrices for
     the packed sink fast path (sink.Store.upsert_tiles_packed): returns
-    [(body (S*E, 10) uint32, ShardStatsHost)] in pair order.  The head
+    [(body (S*E, 13) uint32, ShardStatsHost)] in pair order.  The head
     rows are dropped after their stats are read; keys are shard-disjoint
     so concatenating shard blocks never duplicates a group."""
     blk = emit_capacity + 1
@@ -281,7 +282,7 @@ def _sharded_step_body(params_list: tuple[AggParams, ...], n_shards: int,
             batch_max_ts=jax.lax.pmax(s.batch_max_ts, AXIS),
             bucket_dropped=jax.lax.psum(n_drops[i], AXIS),
         )
-        # this pair's packed (E+1, 10) emit block with the (replicated,
+        # this pair's packed (E+1, 13) emit block with the (replicated,
         # psum'd) stats ridden in its head row — the host reads the WHOLE
         # step's output in one addressable pull (engine.step.ride_stats)
         packs.append(ride_stats(pack_emit(emit, p.speed_hist_max), stats))
@@ -291,7 +292,7 @@ def _sharded_step_body(params_list: tuple[AggParams, ...], n_shards: int,
         ))
         new_states.append(new_state)
         stats_list.append(stats)
-    packed_out = jnp.concatenate(packs, axis=0)  # (P*(E+1), 10) per shard
+    packed_out = jnp.concatenate(packs, axis=0)  # (P*(E+1), 13) per shard
     return tuple(new_states), tuple(emits), packed_out, tuple(stats_list)
 
 
@@ -359,11 +360,13 @@ class ShardedAggregator:
         state_specs = TileState(
             key_hi=spec1, key_lo=spec1, key_ws=spec1, count=spec1,
             sum_speed=spec1, sum_speed2=spec1, sum_lat=spec1, sum_lon=spec1,
-            hist=spec2,
+            hist=spec2, anchor_speed=spec1, anchor_lat=spec1,
+            anchor_lon=spec1, comp=spec2,
         )
         emit_specs = BatchEmit(
             key_hi=spec1, key_lo=spec1, key_ws=spec1, count=spec1,
             sum_speed=spec1, sum_speed2=spec1, sum_lat=spec1, sum_lon=spec1,
+            anchor_speed=spec1, anchor_lat=spec1, anchor_lon=spec1,
             hist=spec2, valid=spec1, n_emitted=P(AXIS), overflowed=P(AXIS),
         )
         stats_specs = ShardStats(*([P()] * 7))
@@ -424,8 +427,8 @@ class ShardedAggregator:
                     watermark_cutoff):
         """Single-transfer variant: folds the batch into every pair's
         state and returns the global packed emit array,
-        (n_shards * n_pairs * (E+1), 10) uint32 sharded over the mesh —
-        per shard, one (E+1, 10) block per pair with the replicated stats
+        (n_shards * n_pairs * (E+1), 13) uint32 sharded over the mesh —
+        per shard, one (E+1, 13) block per pair with the replicated stats
         in its head row.  Pull this host's rows with
         ``multihost.addressable_rows`` and decode with
         ``unpack_emit_shards(rows, E, n_pairs)`` (the streaming runtime's
@@ -454,6 +457,7 @@ class ShardedAggregator:
         rows = {name: multihost.addressable_rows(getattr(emit, name))
                 for name in ("key_hi", "key_lo", "key_ws", "count",
                              "sum_speed", "sum_speed2", "sum_lat", "sum_lon",
+                             "anchor_speed", "anchor_lat", "anchor_lon",
                              "valid")}
         hist = multihost.addressable_rows(emit.hist)
         rows["hist"] = hist if hist.shape[1] else None

@@ -93,11 +93,17 @@ class TilePackMeta(NamedTuple):
 
 
 def packed_tile_docs(body, meta: TilePackMeta) -> list[dict]:
-    """Portable tile-doc builder from packed emit BODY rows ((E, 10)
+    """Portable tile-doc builder from packed emit BODY rows ((E, 13)
     uint32, engine.step.pack_emit layout).  The correctness oracle for —
     and fallback to — the C++ encoder (native/tile_ops.cpp), which
     produces equivalent BSON for the same rows.  The doc schema itself is
-    TileDoc's — this function only decodes the columnar lanes."""
+    TileDoc's — this function only decodes the columnar lanes.
+
+    The sum lanes are per-group residual sums about the anchor lanes
+    (engine.state.TileState): averages recombine ``anchor + resid/count``
+    here in f64, which is what preserves microdegree centroid precision
+    on an f64-free device.  Speed variance is anchor-invariant
+    (Var(v) = E[r²] − E[r]²), so it uses the residual moments directly."""
     import numpy as np
 
     body = np.asarray(body)
@@ -112,13 +118,16 @@ def packed_tile_docs(body, meta: TilePackMeta) -> list[dict]:
     sum_lat = body[:, 6].view(np.float32)
     sum_lon = body[:, 7].view(np.float32)
     p95 = body[:, 9].view(np.float32)
+    anchor_speed = body[:, 10].view(np.float32)
+    anchor_lat = body[:, 11].view(np.float32)
+    anchor_lon = body[:, 12].view(np.float32)
     docs = []
     for j in idx:
         c = int(count[j])
-        ssp = float(sum_speed[j])
+        mean_r = float(sum_speed[j]) / c
         extra = {
             "stddevSpeedKmh": float(
-                max(float(sum_speed2[j]) / c - (ssp / c) ** 2, 0.0) ** 0.5),
+                max(float(sum_speed2[j]) / c - mean_r ** 2, 0.0) ** 0.5),
         }
         if meta.with_p95:
             extra["p95SpeedKmh"] = float(p95[j])
@@ -132,9 +141,9 @@ def packed_tile_docs(body, meta: TilePackMeta) -> list[dict]:
             window_start=start,
             window_end=epoch_to_dt(int(ws[j]) + meta.window_s),
             count=c,
-            avg_speed_kmh=ssp / c,
-            avg_lat=float(sum_lat[j]) / c,
-            avg_lon=float(sum_lon[j]) / c,
+            avg_speed_kmh=float(anchor_speed[j]) + mean_r,
+            avg_lat=float(anchor_lat[j]) + float(sum_lat[j]) / c,
+            avg_lon=float(anchor_lon[j]) + float(sum_lon[j]) / c,
             ttl_minutes=meta.ttl_minutes,
             extra=extra,
             grid=meta.grid,

@@ -78,6 +78,17 @@ class CheckpointManager:
         if not os.path.exists(path):
             return None
         with np.load(path) as z:
+            missing = [k for k in TileState._fields if k not in z.files]
+            if missing:
+                # pre-anchor checkpoints hold ABSOLUTE sums; the current
+                # state holds residual sums about per-group anchors that
+                # an old snapshot simply doesn't have — synthesizing them
+                # would corrupt every resumed average, so refuse loudly
+                raise ValueError(
+                    f"checkpoint {path} was written by an older state "
+                    f"layout (missing {missing}); it cannot be resumed by "
+                    f"this version — restart from empty state (the sink "
+                    f"is idempotent) or replay with the writing version")
             return TileState(**{k: z[k] for k in TileState._fields})
 
     # --- write ----------------------------------------------------------
