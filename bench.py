@@ -408,7 +408,17 @@ def main() -> dict:
                   f"count+avg+p95 update-mode emits)",
         "value": round(eps, 1),
         "unit": "events/sec",
+        # vs_baseline is the harness contract key; the reference publishes
+        # no measured numbers (BASELINE.md §methodology), so the
+        # denominator is the DESIGN TARGET — 5M ev/s on v5e-4
+        # (BASELINE.json north star), not a measured Spark baseline.
+        # vs_target says so explicitly; baseline_note disambiguates for
+        # any consumer of the raw JSON.
         "vs_baseline": round(eps / 5_000_000.0, 4),
+        "vs_target": round(eps / 5_000_000.0, 4),
+        "baseline_note": "denominator = 5M ev/s design target "
+                         "(BASELINE.json north star); reference publishes "
+                         "no measured baseline",
     }
     print(json.dumps(result))
     return result

@@ -5,8 +5,12 @@ adds cluster awareness: it bootstraps metadata, routes produce/fetch to
 each partition's leader, and refreshes + retries once on leadership
 errors (NOT_LEADER_OR_FOLLOWER / LEADER_NOT_AVAILABLE / UNKNOWN_TOPIC).
 
-API versions are pinned to non-flexible encodings (kafka/protocol.py);
-``BrokerClient`` verifies the broker still serves them via ApiVersions.
+API versions are NEGOTIATED per connection: ``BrokerClient`` reads the
+broker's ApiVersions response and uses the highest version inside both
+the broker's range and this client's implemented range (``_SUPPORTED``,
+all non-flexible encodings), failing at connect with an actionable
+message when there is no overlap (e.g. a post-4.x broker that finally
+drops them).
 Offsets are the caller's responsibility (framework checkpoint ownership,
 see package docstring).
 """
@@ -27,9 +31,16 @@ from heatmap_tpu.kafka.protocol import (
 
 _corr = itertools.count(1)
 
-# version pins (non-flexible encodings)
-_VERSIONS = {API_PRODUCE: 3, API_FETCH: 4, API_LIST_OFFSETS: 1,
-             API_METADATA: 1, API_VERSIONS: 0}
+# Implemented per-API version RANGES (all non-flexible encodings; flexible
+# starts at Produce v9 / Fetch v12 / Metadata v9 / ListOffsets v6).  Each
+# connection negotiates the highest version inside both this range and the
+# broker's advertised range (ApiVersions), so the client works against any
+# broker era with an overlap: the floors are what kafka-python-era clients
+# use (kept by every broker through at least 4.x), the ceilings cover the
+# KIP-896 (Kafka 4.0) removals of early versions.
+_SUPPORTED = {API_PRODUCE: (3, 7), API_FETCH: (4, 11),
+              API_LIST_OFFSETS: (1, 3), API_METADATA: (1, 7),
+              API_VERSIONS: (0, 0)}
 
 EARLIEST = -2
 LATEST = -1
@@ -99,6 +110,9 @@ class BrokerClient:
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
         self._dead = False
+        # per-API versions in use on THIS connection; ApiVersions itself
+        # must go out before negotiation completes, hence the seed entry
+        self._use: dict[int, int] = {API_VERSIONS: 0}
         self._check_versions()
 
     def _recv_exact(self, n: int) -> bytes:
@@ -110,7 +124,7 @@ class BrokerClient:
         if self._dead:
             raise ConnectionError("connection poisoned; reconnect")
         cid = next(_corr)
-        msg = frame_request(api_key, _VERSIONS[api_key], cid,
+        msg = frame_request(api_key, self._use[api_key], cid,
                             self.client_id, body)
         with self._lock:
             try:
@@ -136,27 +150,47 @@ class BrokerClient:
         for _ in range(r.i32()):
             k, lo, hi = r.i16(), r.i16(), r.i16()
             supported[k] = (lo, hi)
-        for k, v in _VERSIONS.items():
+        names = {API_PRODUCE: "Produce", API_FETCH: "Fetch",
+                 API_LIST_OFFSETS: "ListOffsets", API_METADATA: "Metadata"}
+        for k, (lo_i, hi_i) in _SUPPORTED.items():
             if k == API_VERSIONS:
                 continue
-            lo, hi = supported.get(k, (0, -1))
-            if not lo <= v <= hi:
-                raise KafkaError(35, f"api {k} v{v} (broker serves {lo}..{hi})")
+            lo_b, hi_b = supported.get(k, (0, -1))
+            use = min(hi_i, hi_b)
+            if use < max(lo_i, lo_b):
+                # no overlap between what we implement and what the broker
+                # serves — fail AT CONNECT with the ranges and a remedy
+                raise KafkaError(
+                    35,
+                    f"broker serves {names.get(k, f'api {k}')} "
+                    f"v{lo_b}..v{hi_b}; this client implements "
+                    f"v{lo_i}..v{hi_i} (non-flexible encodings) with no "
+                    f"overlap — use a broker within Kafka 2.1..4.x-era "
+                    f"protocol support, or HEATMAP_KAFKA_IMPL="
+                    f"confluent/kafka-python for a library client")
+            self._use[k] = use
 
     # ---- requests ---------------------------------------------------------
 
     def metadata(self, topics: list[str] | None = None) -> dict:
+        v = self._use[API_METADATA]
         w = Writer()
         if topics is None:
             w.i32(-1)
         else:
             w.array(topics, w.string)
+        if v >= 4:
+            w.i8(1)  # allow_auto_topic_creation (v1-v3 behavior)
         r = self.request(API_METADATA, w.build())
+        if v >= 3:
+            r.i32()  # throttle_time_ms
         brokers = {}
         for _ in range(r.i32()):
             node, host, port = r.i32(), r.string(), r.i32()
             r.string()  # rack
             brokers[node] = (host, port)
+        if v >= 2:
+            r.string()  # cluster_id
         r.i32()  # controller id
         topics_out = {}
         for _ in range(r.i32()):
@@ -165,8 +199,12 @@ class BrokerClient:
             parts = {}
             for _ in range(r.i32()):
                 perr, pid, leader = r.i16(), r.i32(), r.i32()
+                if v >= 7:
+                    r.i32()  # leader_epoch
                 r.array(r.i32)  # replicas
                 r.array(r.i32)  # isr
+                if v >= 5:
+                    r.array(r.i32)  # offline_replicas
                 parts[pid] = {"leader": leader, "error": perr}
             topics_out[name] = {"error": terr, "partitions": parts}
         return {"brokers": brokers, "topics": topics_out}
@@ -174,14 +212,19 @@ class BrokerClient:
     def list_offsets(self, topic: str, partitions: dict[int, int]) -> dict[int, int]:
         """partitions: {partition: timestamp(-1 latest / -2 earliest)} →
         {partition: offset}."""
+        v = self._use[API_LIST_OFFSETS]
         w = Writer()
         w.i32(-1)  # replica_id
+        if v >= 2:
+            w.i8(0)  # isolation_level: read_uncommitted
         w.i32(1)   # one topic
         w.string(topic)
         w.i32(len(partitions))
         for p, ts in partitions.items():
             w.i32(p).i64(ts)
         r = self.request(API_LIST_OFFSETS, w.build())
+        if v >= 2:
+            r.i32()  # throttle_time_ms
         out = {}
         for _ in range(r.i32()):
             r.string()
@@ -197,6 +240,7 @@ class BrokerClient:
     def produce(self, topic: str, partition: int, batch: bytes,
                 acks: int = 1, timeout_ms: int = 10_000) -> int:
         """Returns the base offset assigned to the batch."""
+        v = self._use[API_PRODUCE]
         w = Writer()
         w.string(None)  # transactional_id
         w.i16(acks).i32(timeout_ms)
@@ -204,14 +248,16 @@ class BrokerClient:
         w.string(topic)
         w.i32(1)
         w.i32(partition)
-        w.bytes_(batch)
+        w.bytes_(batch)  # request encoding is identical across v3-v7
         r = self.request(API_PRODUCE, w.build())
         base = -1
         for _ in range(r.i32()):
             r.string()
             for _ in range(r.i32()):
                 pid, err, base = r.i32(), r.i16(), r.i64()
-                r.i64()  # log_append_time
+                r.i64()  # log_append_time (v2+)
+                if v >= 5:
+                    r.i64()  # log_start_offset
                 if err:
                     raise KafkaError(err, f"Produce {topic}[{pid}]")
         return base
@@ -220,16 +266,36 @@ class BrokerClient:
               max_bytes: int = 1 << 20, max_wait_ms: int = 100,
               min_bytes: int = 1) -> tuple[int, bytes]:
         """(high_watermark, raw records blob)."""
+        v = self._use[API_FETCH]
         w = Writer()
         w.i32(-1)                       # replica_id
         w.i32(max_wait_ms).i32(min_bytes).i32(max_bytes)
         w.i8(0)                         # isolation: read_uncommitted
+        if v >= 7:
+            # sessionless full fetch: no incremental-session state to
+            # carry for a single-partition request
+            w.i32(0).i32(-1)            # session_id, session_epoch
         w.i32(1)
         w.string(topic)
         w.i32(1)
-        w.i32(partition).i64(offset).i32(max_bytes)
+        w.i32(partition)
+        if v >= 9:
+            w.i32(-1)                   # current_leader_epoch: unknown
+        w.i64(offset)
+        if v >= 5:
+            w.i64(-1)                   # log_start_offset (consumer: -1)
+        w.i32(max_bytes)
+        if v >= 7:
+            w.i32(0)                    # forgotten_topics_data: none
+        if v >= 11:
+            w.string("")                # rack_id
         r = self.request(API_FETCH, w.build())
         r.i32()  # throttle
+        if v >= 7:
+            err = r.i16()               # session-level error
+            r.i32()                     # session_id
+            if err:
+                raise KafkaError(err, f"Fetch {topic} (session)")
         hw, blob = 0, b""
         for _ in range(r.i32()):
             r.string()
@@ -237,7 +303,11 @@ class BrokerClient:
                 pid, err = r.i32(), r.i16()
                 hw = r.i64()
                 r.i64()       # last_stable_offset
+                if v >= 5:
+                    r.i64()   # log_start_offset
                 r.array(lambda: (r.i64(), r.i64()))  # aborted txns
+                if v >= 11:
+                    r.i32()   # preferred_read_replica (KIP-392)
                 blob = r.bytes_() or b""
                 if err:
                     raise KafkaError(err, f"Fetch {topic}[{pid}]")

@@ -1,11 +1,13 @@
 """In-process mock Kafka broker speaking the binary protocol over TCP.
 
-Serves exactly the API versions the framework's client pins
-(kafka/protocol.py): ApiVersions v0, Metadata v1, ListOffsets v1,
-Produce v3, Fetch v4.  Partition logs are decoded Records in memory;
-Produce decodes the inbound batch (verifying CRC32C) and Fetch re-encodes
-from the requested offset, so both directions of the record codec are
-exercised against each other.
+Serves the version RANGES the framework's client implements
+(kafka/client.py ``_SUPPORTED``): Metadata v1-v7, ListOffsets v1-v3,
+Produce v3-v7, Fetch v4-v11 — encoding each response per the requested
+version, so the client's per-connection negotiation is exercised for
+real.  Partition logs are decoded Records in memory; Produce decodes the
+inbound batch (verifying CRC32C) and Fetch re-encodes from the requested
+offset, so both directions of the record codec are exercised against
+each other.
 
 Topics auto-create on first metadata request with ``num_partitions``
 (default 3, the reference topic's layout, README.md:100-101).
@@ -80,21 +82,26 @@ class _Handler(socketserver.BaseRequestHandler):
                   r: Reader) -> bytes:
         if api_key == API_VERSIONS:
             w = Writer().i16(0)
-            apis = [(API_PRODUCE, 0, 8), (API_FETCH, 0, 11),
-                    (API_LIST_OFFSETS, 0, 5), (API_METADATA, 0, 8),
-                    (API_VERSIONS, 0, 0)]
+            apis = self.server.api_versions  # type: ignore[attr-defined]
             w.i32(len(apis))
             for k, lo, hi in apis:
                 w.i16(k).i16(lo).i16(hi)
             return w.build()
+        v = api_version
         if api_key == API_METADATA:
             topics = r.array(r.string)
+            if v >= 4:
+                r.i8()  # allow_auto_topic_creation
             if topics is None:
                 topics = list(st.topics)
             host, port = self.server.server_address[:2]  # type: ignore
             w = Writer()
+            if v >= 3:
+                w.i32(0)                # throttle_time_ms
             w.i32(1)                    # one broker
             w.i32(0).string(host).i32(port).string(None)
+            if v >= 2:
+                w.string("mock-cluster")
             w.i32(0)                    # controller id
             w.i32(len(topics))
             for t in topics:
@@ -103,12 +110,20 @@ class _Handler(socketserver.BaseRequestHandler):
                 w.i32(len(logs))
                 for pid in range(len(logs)):
                     w.i16(0).i32(pid).i32(0)
+                    if v >= 7:
+                        w.i32(0)         # leader_epoch
                     w.array([0], w.i32)  # replicas
                     w.array([0], w.i32)  # isr
+                    if v >= 5:
+                        w.array([], w.i32)  # offline_replicas
             return w.build()
         if api_key == API_LIST_OFFSETS:
             r.i32()  # replica_id
+            if v >= 2:
+                r.i8()  # isolation_level
             w = Writer()
+            if v >= 2:
+                w.i32(0)  # throttle_time_ms
             n_topics = r.i32()
             w.i32(n_topics)
             for _ in range(n_topics):
@@ -150,6 +165,10 @@ class _Handler(socketserver.BaseRequestHandler):
                         w.i32(pid).i16(0).i64(base).i64(-1)
                     except ValueError:
                         w.i32(pid).i16(87).i64(-1).i64(-1)  # INVALID_RECORD
+                    if v >= 5:
+                        w.i64(0)  # log_start_offset
+            if v >= 1:
+                w.i32(0)  # throttle_time_ms (trails the topics array)
             return w.build()
         if api_key == API_FETCH:
             r.i32()  # replica_id
@@ -157,8 +176,13 @@ class _Handler(socketserver.BaseRequestHandler):
             r.i32()  # min_bytes
             max_bytes = r.i32()
             r.i8()   # isolation
+            if v >= 7:
+                r.i32()  # session_id
+                r.i32()  # session_epoch
             w = Writer()
             w.i32(0)  # throttle
+            if v >= 7:
+                w.i16(0).i32(0)  # session error + session_id
             n_topics = r.i32()
             w.i32(n_topics)
             for _ in range(n_topics):
@@ -168,13 +192,22 @@ class _Handler(socketserver.BaseRequestHandler):
                 w.string(topic)
                 w.i32(n_parts)
                 for _ in range(n_parts):
-                    pid, offset = r.i32(), r.i64()
+                    pid = r.i32()
+                    if v >= 9:
+                        r.i32()  # current_leader_epoch
+                    offset = r.i64()
+                    if v >= 5:
+                        r.i64()  # log_start_offset
                     r.i32()  # partition max bytes
                     log = logs[pid] if pid < len(logs) else []
                     hw = len(log)
                     if offset > hw:
                         w.i32(pid).i16(1).i64(hw).i64(hw)  # OFFSET_OUT_OF_RANGE
+                        if v >= 5:
+                            w.i64(0)     # log_start_offset
                         w.i32(0)         # aborted txns: empty array
+                        if v >= 11:
+                            w.i32(-1)    # preferred_read_replica (KIP-392)
                         w.bytes_(None)
                         continue
                     chunk = log[offset:]
@@ -193,23 +226,54 @@ class _Handler(socketserver.BaseRequestHandler):
                         if size >= max_bytes:
                             break
                     w.i32(pid).i16(0).i64(hw).i64(hw)
+                    if v >= 5:
+                        w.i64(0)         # log_start_offset
                     w.i32(0)             # aborted txns
+                    if v >= 11:
+                        w.i32(-1)        # preferred_read_replica (KIP-392)
                     w.bytes_(blob if blob else None)
+            # v7+ forgotten_topics_data and v11+ rack_id trail the request;
+            # nothing further is read from it, so they need no handling
             return w.build()
         return Writer().i16(35).build()  # UNSUPPORTED_VERSION fallback
 
 
+# Advertised ApiVersions tables.  LEGACY mirrors a 2.x/3.x broker (every
+# historical version still served).  KIP896 mirrors a Kafka 4.x broker
+# after the KIP-896 removals of pre-2.1 protocol versions, with
+# DELIBERATELY aggressive minima (Metadata>=4, ListOffsets>=2 — above the
+# client's old floor pins): a client that hard-pinned the floors would be
+# rejected here, so passing against this table proves the per-connection
+# version NEGOTIATION (kafka/client.py _SUPPORTED) actually engages the
+# higher encodings end to end.
+API_VERSIONS_LEGACY = (
+    (API_PRODUCE, 0, 8), (API_FETCH, 0, 11), (API_LIST_OFFSETS, 0, 5),
+    (API_METADATA, 0, 8), (API_VERSIONS, 0, 0),
+)
+API_VERSIONS_KIP896 = (
+    (API_PRODUCE, 3, 11), (API_FETCH, 4, 16), (API_LIST_OFFSETS, 2, 9),
+    (API_METADATA, 4, 12), (API_VERSIONS, 0, 4),
+)
+
+
 class MockKafkaBroker:
-    """``with MockKafkaBroker() as bootstrap: KafkaClient(bootstrap)``"""
+    """``with MockKafkaBroker() as bootstrap: KafkaClient(bootstrap)``
+
+    ``api_versions`` overrides the advertised ApiVersions table (e.g.
+    ``API_VERSIONS_KIP896`` to emulate a Kafka 4.x broker, or a custom
+    table whose minima exceed the client pins to emulate a future broker
+    that dropped them)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 num_partitions: int = 3):
+                 num_partitions: int = 3,
+                 api_versions: tuple = API_VERSIONS_LEGACY):
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
 
         self._server = _Server((host, port), _Handler)
         self._server.state = _State(num_partitions)  # type: ignore
+        self._server.api_versions = tuple(api_versions)  # type: ignore
         self._server._conns = set()  # type: ignore[attr-defined]
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)

@@ -98,6 +98,31 @@ def test_pipeline_against_real_services():
     assert tiles and all(t["count"] > 0 for t in tiles)
     positions = list(store.all_positions())
     assert len(positions) == 11
+
+    # serve leg: the full reference loop is produce → aggregate → upsert
+    # → SERVE (app.py:45-88) — read the same Mongo back through the live
+    # HTTP API so the wire client's cursor path is covered against a real
+    # mongod too
+    import json
+    import urllib.request
+
+    from heatmap_tpu.serve import start_background
+
+    httpd, _t, port = start_background(store, load_config({}, serve_port=0))
+    try:
+        base = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(base + "/api/tiles/latest",
+                                    timeout=10) as r:
+            fc = json.loads(r.read())
+        assert fc["type"] == "FeatureCollection"
+        assert len(fc["features"]) == len(tiles)
+        with urllib.request.urlopen(base + "/api/positions/latest",
+                                    timeout=10) as r:
+            pc = json.loads(r.read())
+        assert len(pc["features"]) == 11
+    finally:
+        httpd.shutdown()
+
     # cleanup (wire backend exposes drop; pymongo path drops via its client)
     try:
         if hasattr(store._b.client, "drop_collection"):

@@ -327,3 +327,71 @@ def test_consumer_survives_broker_outage_and_truncation():
         src.close()
     finally:
         b2.close()
+
+def test_kip896_broker_accepted():
+    """Kafka 4.x (KIP-896) removed early protocol versions; the mock's
+    4.x table raises the minima ABOVE the historical floor pins
+    (Metadata>=4, ListOffsets>=2).  The client must NEGOTIATE the higher
+    versions per connection and round-trip end to end — this is the
+    README supported-broker-range claim (a hard-pinned client would be
+    rejected at connect here)."""
+    from heatmap_tpu.kafka.protocol import (
+        API_FETCH, API_LIST_OFFSETS, API_METADATA, API_PRODUCE,
+    )
+    from heatmap_tpu.testing.mock_kafka import API_VERSIONS_KIP896
+
+    with MockKafkaBroker(api_versions=API_VERSIONS_KIP896) as bootstrap:
+        c = KafkaClient(bootstrap)
+        assert c.partitions("t896") == [0, 1, 2]
+        base = c.produce("t896", 0, [Record(0, 1000, b"k", b"v"),
+                                     Record(0, 1001, b"k2", b"w")])
+        assert base == 0
+        fr = c.fetch("t896", 0, 0)
+        assert [r.value for r in fr.records] == [b"v", b"w"]
+        assert c.list_offsets("t896")[0] == 2
+        # the negotiated versions are the intersection maxima, not pins
+        conn = next(iter(c._conns.values()))
+        assert conn._use[API_PRODUCE] == 7
+        assert conn._use[API_FETCH] == 11
+        assert conn._use[API_LIST_OFFSETS] == 3
+        assert conn._use[API_METADATA] == 7
+        c.close()
+
+
+def test_legacy_broker_negotiates_implemented_maxima():
+    """Against a 2.x-era table the client picks min(impl_max, broker_max)
+    per API — e.g. Metadata 7 (impl) vs broker 8 -> 7; Fetch 11 vs 11."""
+    from heatmap_tpu.kafka.protocol import (
+        API_FETCH, API_LIST_OFFSETS, API_METADATA, API_PRODUCE,
+    )
+
+    with MockKafkaBroker() as bootstrap:
+        c = KafkaClient(bootstrap)
+        c.produce("tleg", 0, [Record(0, 1000, b"k", b"v")])
+        assert [r.value for r in c.fetch("tleg", 0, 0).records] == [b"v"]
+        conn = next(iter(c._conns.values()))
+        assert conn._use[API_PRODUCE] == 7      # min(7, 8)
+        assert conn._use[API_FETCH] == 11       # min(11, 11)
+        assert conn._use[API_LIST_OFFSETS] == 3  # min(3, 5)
+        assert conn._use[API_METADATA] == 7     # min(7, 8)
+        c.close()
+
+
+def test_dropped_pin_fails_actionably():
+    """A future broker that drops the pinned versions must fail AT
+    CONNECT with the API name, the broker's served range, and a remedy —
+    not deep in a produce call with a raw protocol error."""
+    from heatmap_tpu.kafka.protocol import (
+        API_FETCH, API_LIST_OFFSETS, API_METADATA, API_PRODUCE,
+        API_VERSIONS,
+    )
+
+    future = ((API_PRODUCE, 12, 15), (API_FETCH, 17, 20),
+              (API_LIST_OFFSETS, 10, 12), (API_METADATA, 13, 15),
+              (API_VERSIONS, 0, 5))
+    with MockKafkaBroker(api_versions=future) as bootstrap:
+        with pytest.raises(KafkaError) as ei:
+            KafkaClient(bootstrap)
+        msg = str(ei.value)
+        assert "Produce" in msg and "v12..v15" in msg and "v3..v7" in msg
+        assert "HEATMAP_KAFKA_IMPL" in msg
