@@ -51,7 +51,14 @@ class Config:
     state_capacity_log2: int = 17      # open-addressing table slots per shard
     state_max_log2: int = 0            # growth ceiling; 0 = capacity+4 (16x);
                                        # == state_capacity_log2 disables growth
-    speed_hist_bins: int = 32          # per-cell speed histogram (p95 stats)
+    # Per-cell speed histogram driving the p95 stats.  ACCURACY BOUND:
+    # interpolated hist-p95 is exact to within one bin width
+    # (speed_hist_max_kmh / speed_hist_bins — 4 km/h at the defaults;
+    # tested in tests/test_emit_pack.py), and speeds >= the max saturate
+    # into the last bin, capping reported p95 at the max.  Size the max
+    # for the fleet: city traffic fits 256; aircraft need ~1280 (the
+    # opensky_global pipeline preset raises both knobs).
+    speed_hist_bins: int = 64
     speed_hist_max_kmh: float = 256.0
     num_shards: int = 0                # 0 = use all local devices
     bucket_factor: float = 2.0         # all_to_all lane skew tolerance

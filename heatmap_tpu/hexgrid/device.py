@@ -25,8 +25,12 @@ Design notes (TPU-first):
   bit-exact agreement with the host oracle (hexgrid.host).
 - All lookup tables are tiny (<3 KB) int32 gathers.
 
-No code is shared with or derived from the C h3 library; the grid math is
-this package's own (see hexgrid/__init__.py provenance note).
+No code is copied from the C h3 library; the algorithm follows the PUBLIC
+H3 spec (icosahedral faces, aperture-7 hierarchy, base-cell + digit
+packing — names like up_ap7/down_ap7r track the published algorithm
+structure, which any bit-compatible implementation must mirror), with the
+math and tables re-derived in this package (gen_tables.py; see
+hexgrid/__init__.py provenance note).
 """
 
 from __future__ import annotations

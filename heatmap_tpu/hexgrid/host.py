@@ -358,14 +358,64 @@ def _down_ap3r(i, j, k):
     return ml._lin3(_DOWN_AP3R, i, j, k)
 
 
-def cell_to_boundary(cell: str | int, T: Tables | None = None) -> List[Tuple[float, float]]:
-    """Cell -> list of (lat, lng) degree vertices (5 for pentagons, else 6).
+def _insert_face_crossings(verts_rad: List[Tuple[float, float]]
+                           ) -> List[Tuple[float, float]]:
+    """Insert "distortion" vertices where ring edges cross icosahedron
+    face boundaries (Class III cells only — Class II cell edges run
+    ALONG face edges and never cross them mid-segment).
 
-    Note: unlike the C library we do not insert extra edge-crossing
-    "distortion" vertices for cells straddling icosahedron edges; for
-    city-scale rendering (reference: app.py:57-59) the hex ring is exact for
-    all non-face-crossing cells.
+    The C library (behind the reference's app.py:19-41) finds these
+    points by 2D line intersection in the home face's gnomonic plane.
+    Gnomonic projection maps great circles to straight lines, so that
+    intersection IS the point on the vertex-to-vertex great arc where
+    the containing face changes; we find the same point by bisection on
+    the max-dot face predicate (mathlib.closest_face's geometry), which
+    needs no per-face coordinate plumbing and handles pentagon rings
+    (whose vertices span up to five faces) identically.
     """
+    import numpy as np
+
+    from heatmap_tpu.hexgrid.constants import FACE_CENTER_XYZ, geo_to_xyz
+
+    n = len(verts_rad)
+    xyz = [geo_to_xyz(np.array([la, ln])) for la, ln in verts_rad]
+    # max-dot needs no normalization and no trig round-trip: scaling a
+    # vector scales every face dot equally, leaving the argmax unchanged
+    faces = [int(np.argmax(FACE_CENTER_XYZ @ v)) for v in xyz]
+    out: List[Tuple[float, float]] = []
+    for a in range(n):
+        b = (a + 1) % n
+        out.append(verts_rad[a])
+        if faces[a] == faces[b]:
+            continue
+        va, vb, fa = xyz[a], xyz[b], faces[a]
+        lo, hi = 0.0, 1.0
+        for _ in range(52):  # ~1 ulp of the chord parameter
+            mid = 0.5 * (lo + hi)
+            v = va + mid * (vb - va)
+            if int(np.argmax(FACE_CENTER_XYZ @ v)) == fa:
+                lo = mid
+            else:
+                hi = mid
+        t = 0.5 * (lo + hi)
+        if t < 1e-9 or t > 1.0 - 1e-9:
+            # crossing coincides with a ring vertex: the adjacent edges
+            # each lie on a single face, no extra vertex needed (the C
+            # library's isIntersectionAtVertex case)
+            continue
+        v = va + t * (vb - va)
+        v = v / np.linalg.norm(v)
+        out.append((math.asin(float(v[2])),
+                    math.atan2(float(v[1]), float(v[0]))))
+    return out
+
+
+def cell_to_boundary(cell: str | int, T: Tables | None = None) -> List[Tuple[float, float]]:
+    """Cell -> list of (lat, lng) degree vertices (5/6 hex corners, plus
+    edge-crossing "distortion" vertices for Class III cells straddling
+    icosahedron edges, like the C library behind the reference's
+    app.py:19-41 — without them, face-crossing cells (routine for the
+    global OpenSky config) render visibly wrong polygons)."""
     T = T or tables()
     h = string_to_h3(cell) if isinstance(cell, str) else cell
     face, ijk, res = _cell_to_faceijk(h, T)
@@ -379,7 +429,7 @@ def cell_to_boundary(cell: str | int, T: Tables | None = None) -> List[Tuple[flo
         ijk = ml.down_ap7r(*ijk)
         adj_res += 1
     verts = _VERTS_CIII if is_class_iii(res) else _VERTS_CII
-    out = []
+    ring: List[Tuple[float, float]] = []
     idxs = range(6)
     if pent:
         idxs = range(5)  # drop the vertex in the deleted K direction
@@ -394,6 +444,7 @@ def cell_to_boundary(cell: str | int, T: Tables | None = None) -> List[Tuple[flo
                 break
             vface, vijk = vface2, vijk2
         x, y = ml.ijk_to_hex2d(*vijk)
-        lat, lng = ml.hex2d_to_geo(x, y, vface, adj_res, substrate=True)
-        out.append((math.degrees(lat), math.degrees(lng)))
-    return out
+        ring.append(ml.hex2d_to_geo(x, y, vface, adj_res, substrate=True))
+    if is_class_iii(res):
+        ring = _insert_face_crossings(ring)
+    return [(math.degrees(la), math.degrees(ln)) for la, ln in ring]

@@ -113,7 +113,14 @@ class BrokerClient:
         # per-API versions in use on THIS connection; ApiVersions itself
         # must go out before negotiation completes, hence the seed entry
         self._use: dict[int, int] = {API_VERSIONS: 0}
-        self._check_versions()
+        try:
+            self._check_versions()
+        except Exception:
+            # fail-at-connect must not leak the just-opened socket (a
+            # reconnect loop against an incompatible broker would pile
+            # up open connections until GC)
+            self.close()
+            raise
 
     def _recv_exact(self, n: int) -> bytes:
         from heatmap_tpu.utils.netio import recv_exact

@@ -71,6 +71,10 @@ _register(
     city="global", h3_res=7, resolutions=(7,), windows_minutes=(5,),
     tile_minutes=5,
     state_capacity_log2=19,   # global cardinality
+    # aircraft ground speeds run to ~1100 km/h; the default 256 km/h
+    # range would saturate every cruise-speed cell's p95.  128 bins keep
+    # the one-bin p95 error bound at 10 km/h over the wider range.
+    speed_hist_bins=128, speed_hist_max_kmh=1280.0,
 )
 
 # 3. synthetic 10M-event backfill (BASELINE config #3)
@@ -99,7 +103,6 @@ _register(
     _kafka_or_synthetic,
     city="bos", h3_res=8, resolutions=(8,), windows_minutes=(1, 5, 15),
     tile_minutes=5,  # the 5-min window keeps the reference grid/_id naming
-    speed_hist_bins=64,
 )
 
 

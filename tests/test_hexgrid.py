@@ -209,3 +209,79 @@ class TestHierarchy:
                 assert seen[h] == (clat, clng)
             seen[h] = (clat, clng)
         assert len(seen) > 10
+
+def test_boundary_distortion_vertices_face_crossing():
+    """VERDICT r2 #3: Class III cells straddling icosahedron edges get
+    edge-crossing "distortion" vertices like the C library (reference
+    app.py:19-41 renders through it) — property: no ring edge crosses a
+    face boundary mid-segment; crossings happen only AT vertices.
+    Exercises all 30 icosahedron edges at res 1 and 3, the 12 res-1/3
+    pentagons (whose rings span five faces), and face-interior cells
+    (which must stay plain 6-vertex hexes)."""
+    import math
+
+    import numpy as np
+
+    from heatmap_tpu.hexgrid import host as H
+    from heatmap_tpu.hexgrid.constants import FACE_CENTER_XYZ
+
+    T = H.tables()
+
+    def face_of(v):
+        return int(np.argmax(FACE_CENTER_XYZ @ v))
+
+    def xyz(lat_deg, lng_deg):
+        la, ln = math.radians(lat_deg), math.radians(lng_deg)
+        c = math.cos(la)
+        return np.array([c * math.cos(ln), c * math.sin(ln), math.sin(la)])
+
+    def assert_no_midsegment_crossing(cell):
+        ring = H.cell_to_boundary(cell)
+        assert len(ring) >= 5
+        pts = [xyz(la, ln) for la, ln in ring]
+        for i in range(len(pts)):
+            a, b = pts[i], pts[(i + 1) % len(pts)]
+            interior = set()
+            for t in np.linspace(0.04, 0.96, 9):
+                v = a + t * (b - a)
+                interior.add(face_of(v / np.linalg.norm(v)))
+            # one face over the whole open segment == no crossing inside
+            assert len(interior) == 1, (cell, i, interior)
+        return ring
+
+    # cells containing points ON each of the 30 face edges
+    pairs = set()
+    for f in range(20):
+        for edge, (f2, _r, _t) in T.FACE_NEIGHBORS[f].items():
+            pairs.add((min(f, f2), max(f, f2)))
+    assert len(pairs) == 30
+    crossing_cells = set()
+    for fa, fb in sorted(pairs):
+        m = FACE_CENTER_XYZ[fa] + FACE_CENTER_XYZ[fb]
+        m = m / np.linalg.norm(m)
+        lat, lng = math.degrees(math.asin(m[2])), \
+            math.degrees(math.atan2(m[1], m[0]))
+        for res in (1, 3):
+            crossing_cells.add(H.latlng_to_cell(lat, lng, res))
+    grew = 0
+    for c in sorted(crossing_cells):
+        ring = assert_no_midsegment_crossing(c)
+        base = 5 if H.is_pentagon(H.string_to_h3(c), T) else 6
+        if len(ring) > base:
+            grew += 1
+    assert grew == len(crossing_cells)  # every edge-straddler got vertices
+
+    # pentagons: rings span five faces, one crossing per edge (centered
+    # pentagon children keep all-zero digits -> still pentagons)
+    for res in (1, 3):
+        for bc in np.nonzero(np.asarray(T.BC_PENT))[0]:
+            h = H.pack(int(bc), [0] * res, res)
+            assert H.is_pentagon(h, T)
+            ring = assert_no_midsegment_crossing(h)
+            assert len(ring) == 10  # 5 corners + 5 crossings
+
+    # face-interior cells stay plain hexes (no spurious insertions)
+    for lat, lng, res in ((42.36, -71.06, 1), (42.36, -71.06, 3),
+                          (48.85, 2.35, 3)):
+        ring = H.cell_to_boundary(H.latlng_to_cell(lat, lng, res))
+        assert len(ring) == 6
