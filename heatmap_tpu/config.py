@@ -69,6 +69,13 @@ class Config:
     serve_host: str = "127.0.0.1"
     serve_port: int = 5000
     store: str = "auto"                # "auto" | "memory" | "mongo" | "jsonl"
+    emit_pull: str = "auto"            # "auto" | "full" | "prefix": prefix
+                                       # pulls head row + live-rows bucket
+                                       # (2 transfers, far fewer bytes) —
+                                       # wins on remote-attached chips;
+                                       # auto = prefix off-CPU (single-
+                                       # device paths; sharded pulls stay
+                                       # full)
 
     @property
     def tile_seconds(self) -> int:
@@ -132,6 +139,7 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         serve_host=e.get("SERVE_HOST", Config.serve_host),
         serve_port=_int(e, "SERVE_PORT", Config.serve_port),
         store=e.get("HEATMAP_STORE", Config.store),
+        emit_pull=e.get("HEATMAP_EMIT_PULL", Config.emit_pull),
     )
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
@@ -144,4 +152,8 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         raise ValueError(
             f"HEATMAP_STATE_MAX_LOG2 ({cfg.state_max_log2}) below "
             f"STATE_CAPACITY_LOG2 ({cfg.state_capacity_log2})")
+    if cfg.emit_pull not in ("auto", "full", "prefix"):
+        raise ValueError(
+            f"HEATMAP_EMIT_PULL must be auto|full|prefix, "
+            f"got {cfg.emit_pull!r}")
     return cfg

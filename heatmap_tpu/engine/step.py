@@ -667,3 +667,45 @@ def aggregate_batch(
         state, hi, lo, ws, speed_kmh, lat_deg, lon_deg, ts_s, valid,
         watermark_cutoff, params,
     )
+
+def pull_packed_stack(packed, prefix: bool) -> list:
+    """Device->host pull of a stacked packed-emit array ((P, E+1, L)
+    uint32 — one (E+1, L) block per pair/batch) as a list of P host
+    matrices.  THE single implementation of the transfer discipline
+    (stream.runtime and bench.py both route here).
+
+    ``prefix=False``: one full transfer.  ``prefix=True``: the P head
+    rows first (they carry n_emitted + the stats rider), then one shared
+    live-prefix bucket — max n_emitted across blocks rounded up to a
+    power of two, so at most log2(E) slice shapes ever compile.  Live
+    emit rows are a prefix by construction (pack_emit's nonzero() yields
+    ascending indices with the fill at the tail) and rows inside the
+    bucket past a block's own n_emitted carry valid=0, so every consumer
+    (unpack_emit, packed_tile_docs, the C++ encoder) works unchanged.
+
+    On remote-attached accelerators the D2H payload dominates the extra
+    round trip as soon as emit capacity dwarfs the touched-group count —
+    the streaming steady state.  On CPU the full pull is cheaper (an
+    extra round trip with nothing to save).
+    """
+    import numpy as np
+
+    if not prefix:
+        b = np.asarray(packed)
+        return [b[i] for i in range(b.shape[0])]
+    heads = np.asarray(packed[:, 0, :])             # (P, L) tiny
+    E = packed.shape[1] - 1
+    n_max = int(heads[:, 0].astype(np.int64).max())
+    bucket = 1
+    while bucket < n_max and bucket < E:
+        bucket <<= 1
+    bucket = min(bucket, E)                          # overflow: n > E
+    body = np.asarray(packed[:, 1:1 + bucket, :])
+    return [np.concatenate([heads[i:i + 1], body[i]])
+            for i in range(body.shape[0])]
+
+
+def pull_emit_prefix(packed):
+    """Live-prefix pull of ONE packed emit matrix ((E+1, L) uint32) —
+    the single-block view of ``pull_packed_stack``."""
+    return pull_packed_stack(packed[None], prefix=True)[0]

@@ -98,6 +98,12 @@ class MicroBatchRuntime:
         self._ckpt_thread: threading.Thread | None = None
         self._ckpt_err: BaseException | None = None
         self._pending = None  # last batch's emits, still on device
+        # live-prefix emit pulls (flush_pending): explicit knob wins;
+        # auto = on for accelerators (where D2H bytes cost), off for CPU
+        # (an extra round trip with nothing to save)
+        self._prefix_pull = (
+            cfg.emit_pull == "prefix"
+            or (cfg.emit_pull == "auto" and jax.default_backend() != "cpu"))
         self._carry_cols = None  # overshoot remainder of a batch-granular poll
         self._ckpt_due = False  # cadence hit while mid-carry; commit ASAP
         self._last_pull_s = 0.0  # wall of the most recent deferred pull
@@ -485,8 +491,13 @@ class MicroBatchRuntime:
         batch_max = I32_MIN
         if self._multi is not None:
             from heatmap_tpu.engine.multi import stats_from_packed
+            from heatmap_tpu.engine.step import pull_packed_stack
 
-            bufs = np.asarray(packed)
+            # emit_pull=prefix (the off-CPU auto choice): head rows +
+            # one shared live-prefix bucket instead of the full (P,
+            # E+1, L) matrix — KB instead of MB per batch on remote-
+            # attached chips (engine.step.pull_packed_stack)
+            bufs = pull_packed_stack(packed, self._prefix_pull)
             for idx, (res, win_s) in enumerate(self._multi.pairs):
                 stats = stats_from_packed(bufs[idx])
                 batch_max = max(

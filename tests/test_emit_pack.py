@@ -128,3 +128,27 @@ def test_p95_error_bound_one_bin(rng):
     got = float(np.asarray(p95_from_hist_device(
         hist, np.array([len(speeds)], np.int32), 256.0))[0])
     assert 256.0 - 4.0 <= got <= 256.0
+
+def test_pull_emit_prefix_equivalent(rng):
+    """Live-prefix pulls (engine.step.pull_emit_prefix, the runtime's
+    emit_pull=prefix discipline) must surface exactly the same live rows
+    and head stats as a full transfer — rows are truncated to the
+    power-of-two bucket, never reordered or lost (live rows are a prefix
+    by construction)."""
+    from heatmap_tpu.engine.step import pull_emit_prefix
+
+    emit = _run_one(rng, bins=8)
+    packed = pack_emit(emit, 256.0)
+    full = unpack_emit(np.asarray(packed))
+    pref = unpack_emit(pull_emit_prefix(packed))
+    assert pref["n_emitted"] == full["n_emitted"] > 0
+    assert pref["overflowed"] == full["overflowed"]
+    n = full["n_emitted"]
+    assert pref["valid"][:n].all() and not pref["valid"][n:].any()
+    # bucket is the next power of two (bounded retrace count)
+    b = len(pref["valid"])
+    assert b >= n and (b & (b - 1)) == 0 or b == len(full["valid"])
+    for k in ("key_hi", "key_lo", "key_ws", "count", "sum_speed",
+              "sum_lat", "sum_lon", "anchor_speed", "anchor_lat",
+              "anchor_lon", "p95"):
+        np.testing.assert_array_equal(pref[k][:n], full[k][:n])

@@ -557,3 +557,26 @@ def test_exit_commit_mid_carry_skip_is_collective(tmp_path, monkeypatch):
     assert rt.metrics.counters["checkpoints"] == 1
     rt._multiproc = False
     rt.close()
+
+def test_emit_pull_prefix_equals_full(tmp_path):
+    """emit_pull=prefix (the off-CPU auto choice: head rows + live-prefix
+    bucket, two transfers) must sink exactly what emit_pull=full sinks —
+    same tiles, same counts, same metrics."""
+    stores = {}
+    for mode in ("full", "prefix"):
+        src = SyntheticSource(n_events=6000, n_vehicles=120, seed=5,
+                              t0=1_700_000_000)
+        cfg = load_config({}, batch_size=512, state_capacity_log2=12,
+                          store="memory", emit_pull=mode,
+                          checkpoint_dir=str(tmp_path / f"ck-{mode}"))
+        store = MemoryStore(now_fn=lambda: dt.datetime(2023, 11, 14,
+                                                       tzinfo=UTC))
+        rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=0)
+        assert rt._prefix_pull == (mode == "prefix")
+        rt.run()
+        assert rt.metrics.counters["events_valid"] == 6000
+        stores[mode] = store
+    full, pref = stores["full"]._tiles, stores["prefix"]._tiles
+    assert full.keys() == pref.keys() and len(full) > 0
+    for k in full:
+        assert full[k] == pref[k], k
