@@ -50,7 +50,7 @@ def test_multi_matches_singles(rng):
         cutoff = max_ts - 600 if max_ts > -(2**31) else -(2**31)
         packed = multi.step_packed_all(lat, lng, speed, ts, valid, cutoff)
         bufs = np.asarray(packed)
-        assert bufs.shape == (len(PAIRS), N + 1, 10)
+        assert bufs.shape == (len(PAIRS), N + 1, 13)
         for idx, (r, w) in enumerate(PAIRS):
             sp, s_stats = singles[(r, w)].step_packed(
                 lat, lng, speed, ts, valid, cutoff)

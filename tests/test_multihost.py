@@ -115,6 +115,52 @@ WORKER = textwrap.dedent("""
     assert rt2.epoch == rt.epoch
     rt2.writer.close()
 
+    # ---- exit-commit mid-carry (real collectives): host 1's source
+    # overshoots the feed shape (batch-granular records), so
+    # run(max_batches=1) ends with host 1 mid-carry and host 0 carry-free.
+    # The exit commit's skip decision must be COLLECTIVE — a one-sided
+    # local skip would strand host 0 in the commit barrier forever (this
+    # hang was the round-2 advisor finding; both processes exiting rc 0
+    # IS the assertion).
+    from heatmap_tpu.stream.events import parse_events, slice_columns
+
+    class CarrySource:
+        def __init__(self, events, overshoot):
+            self._cols = parse_events(events)
+            self._off = 0
+            self._over = overshoot
+        def poll(self, max_events):
+            n = len(self._cols)
+            if self._off >= n:
+                return None
+            take = min(n - self._off, max_events + self._over)
+            out = slice_columns(self._cols, self._off, self._off + take)
+            self._off += take
+            return out
+        def offset(self):
+            return self._off
+        def seek(self, offset):
+            self._off = int(offset)
+        @property
+        def exhausted(self):
+            return self._off >= len(self._cols)
+        def close(self):
+            pass
+
+    evs3 = [{"provider": "mh", "vehicleId": f"c{i % 7}",
+             "lat": 42.0 + (i % 50) * 1e-3, "lon": -71.0, "speedKmh": 10.0,
+             "ts": 1_700_000_000 + i % 60} for i in range(2048)]
+    cfg3 = load_config({}, batch_size=GLOBAL_BATCH, store="memory",
+                       checkpoint_dir=os.path.join(
+                           os.path.dirname(out_path), "ckpt3"),
+                       state_capacity_log2=10, bucket_factor=16.0)
+    src3 = CarrySource(evs3, overshoot=256 if pid == 1 else 0)
+    rt3 = MicroBatchRuntime(cfg3, src3, MemoryStore(), mesh=mesh,
+                            checkpoint_every=0)
+    rt3.run(max_batches=1)
+    rt3_carrying = rt3._carry_cols is not None
+    carry_commit_skipped = rt3.ckpt.load_meta() is None
+
     with open(out_path, "w") as fh:
         json.dump({"pid": pid, "n_valid": n_valid, "n_active": n_active,
                    "rows": local, "rt_tile_count": tile_count,
@@ -123,7 +169,9 @@ WORKER = textwrap.dedent("""
                    "rt_cap": int(rt._sharded.capacity_per_shard),
                    "rt_grown": int(rt.metrics.counters.get("state_grown", 0)),
                    "rt_overflow": int(rt.metrics.counters.get(
-                       "state_overflow_groups", 0))}, fh)
+                       "state_overflow_groups", 0)),
+                   "rt3_carrying": bool(rt3_carrying),
+                   "rt3_commit_skipped": bool(carry_commit_skipped)}, fh)
 """)
 
 
@@ -186,3 +234,9 @@ def test_two_process_sharded_aggregation(tmp_path):
     assert results[0]["rt_grown"] == results[1]["rt_grown"] >= 1
     assert results[0]["rt_cap"] == results[1]["rt_cap"] > 256
     assert [r["rt_overflow"] for r in results] == [0, 0]
+    # mid-carry exit: host 1 ended run() carrying, host 0 didn't; BOTH
+    # skipped the exit commit via the collective agreement and exited
+    # cleanly (a one-sided skip would have hung a host in the barrier
+    # and failed the whole test on timeout)
+    assert [r["rt3_carrying"] for r in results] == [False, True]
+    assert [r["rt3_commit_skipped"] for r in results] == [True, True]

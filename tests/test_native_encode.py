@@ -18,15 +18,19 @@ META = TilePackMeta(city="bos", grid="h3r8", window_s=300, ttl_minutes=45,
 
 
 def make_body(rng, n, invalid_frac=0.15):
-    body = np.zeros((n, 10), np.uint32)
+    body = np.zeros((n, 13), np.uint32)
     body[:, 0] = rng.integers(0, 2**31, n)          # key_hi (bit 31 clear)
     body[:, 1] = rng.integers(0, 2**32, n)          # key_lo
     ws = (1_700_000_000 + rng.integers(0, 864, n) * 100).astype(np.int32)
     body[:, 2] = ws.view(np.uint32)
     body[:, 3] = rng.integers(0, 50, n)             # count (some zeros)
-    for col, lo, hi in ((4, 0, 5000.0), (5, 0, 1e6),
-                        (6, -90 * 40, 90 * 40), (7, -180 * 40, 180 * 40),
-                        (9, 0, 250.0)):
+    # residual sums (4-7) about the anchor lanes (10-12) — small
+    # residual magnitudes, realistic anchors (engine.state.TileState)
+    for col, lo, hi in ((4, -50.0, 5000.0), (5, 0, 1e5),
+                        (6, -0.01 * 40, 0.01 * 40),
+                        (7, -0.01 * 40, 0.01 * 40),
+                        (9, 0, 250.0), (10, 0, 200.0),
+                        (11, -90.0, 90.0), (12, -180.0, 180.0)):
         body[:, col] = rng.uniform(lo, hi, n).astype(np.float32).view(np.uint32)
     body[:, 8] = (rng.random(n) > invalid_frac).astype(np.uint32)
     return body
@@ -79,7 +83,7 @@ def test_empty_and_all_invalid(rng):
     body[:, 8] = 0
     ops, offsets, n = enc.encode(body, "bos", "h3r8", 300, 45, 0, True)
     assert n == 0 and len(ops) == 0 and len(offsets) == 0
-    ops, offsets, n = enc.encode(np.zeros((0, 10), np.uint32),
+    ops, offsets, n = enc.encode(np.zeros((0, 13), np.uint32),
                                  "bos", "h3r8", 300, 45, 0, True)
     assert n == 0
 

@@ -24,16 +24,21 @@ def mesh():
 
 
 def shard_states_as_dict(agg: ShardedAggregator):
-    """Pull global state to host; return {key: [count, sums...]}, plus the
-    per-shard key sets for disjointness checks."""
+    """Pull global state to host; return {key: [count, ABSOLUTE sums...]}
+    (reconstructed in f64 from the residual sums + per-group anchors,
+    engine.state.TileState), plus the per-shard key sets for
+    disjointness checks."""
     hi = np.asarray(agg.state.key_hi)
     lo = np.asarray(agg.state.key_lo)
     ws = np.asarray(agg.state.key_ws)
     cnt = np.asarray(agg.state.count)
-    ssp = np.asarray(agg.state.sum_speed)
-    ssp2 = np.asarray(agg.state.sum_speed2)
-    sla = np.asarray(agg.state.sum_lat)
-    slo = np.asarray(agg.state.sum_lon)
+    rsp = np.asarray(agg.state.sum_speed, dtype=np.float64)
+    rsp2 = np.asarray(agg.state.sum_speed2, dtype=np.float64)
+    rla = np.asarray(agg.state.sum_lat, dtype=np.float64)
+    rlo = np.asarray(agg.state.sum_lon, dtype=np.float64)
+    a_s = np.asarray(agg.state.anchor_speed, dtype=np.float64)
+    a_la = np.asarray(agg.state.anchor_lat, dtype=np.float64)
+    a_lo = np.asarray(agg.state.anchor_lon, dtype=np.float64)
     live = hi != np.uint32(0xFFFFFFFF)
     out, per_shard = {}, []
     C = agg.capacity_per_shard
@@ -42,8 +47,10 @@ def shard_states_as_dict(agg: ShardedAggregator):
         for i in np.nonzero(live[s * C:(s + 1) * C])[0] + s * C:
             k = (int(hi[i]), int(lo[i]), int(ws[i]))
             keys.add(k)
-            out[k] = [int(cnt[i]), float(ssp[i]), float(ssp2[i]),
-                      float(sla[i]), float(slo[i])]
+            c = int(cnt[i])
+            out[k] = [c, a_s[i] * c + rsp[i],
+                      rsp2[i] + 2.0 * a_s[i] * rsp[i] + c * a_s[i] ** 2,
+                      a_la[i] * c + rla[i], a_lo[i] * c + rlo[i]]
         per_shard.append(keys)
     return out, per_shard
 

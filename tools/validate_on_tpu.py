@@ -10,7 +10,9 @@ shows a real TPU; it writes `HARDWARE.md` at the repo root with:
 2. Merge-fold impl crossover (sort vs rank) at the streaming shape
    (slab >> batch) and the backfill shape (batch >= slab) — decides
    whether HEATMAP_MERGE_IMPL=auto should become the process default.
-3. A jax.profiler trace of a short sustained streaming run
+3. Emit-pull discipline (full vs live-prefix transfers) on this link —
+   validates emit_pull=auto's off-CPU prefix default.
+4. A jax.profiler trace of a short sustained streaming run
    (HEATMAP_PROFILE_DIR) for step-gap / sort-share analysis.
 
 Usage: python tools/validate_on_tpu.py [--quick]
@@ -132,6 +134,52 @@ def merge_bench(lines: list, quick: bool) -> None:
                  "HEATMAP_MERGE_IMPL=auto the process default.\n")
 
 
+def pull_bench(lines: list, quick: bool) -> None:
+    """Emit-pull discipline on THIS host<->device link: full vs
+    live-prefix transfer of a packed emit matrix at streaming occupancy
+    (decides whether emit_pull=auto's off-CPU prefix default holds up —
+    prefix pays an extra round trip to move far fewer bytes)."""
+    import jax
+    import numpy as np
+
+    from heatmap_tpu.engine.step import pull_packed_stack
+
+    E, L = 1 << 15, 13
+    reps = 5 if quick else 20
+    lines.append("## Emit pull: full vs live-prefix\n")
+    lines.append(f"emit capacity {E:,} rows x {L} lanes "
+                 f"({(E + 1) * L * 4 / 1e6:.1f} MB full)\n")
+    lines.append("| live rows | full ms | prefix ms | winner |")
+    lines.append("|---|---|---|---|")
+    for n_live in (256, 4096, E):
+        host = np.zeros((1, E + 1, L), np.uint32)
+        host[0, 0, 0] = n_live
+        host[0, 1:1 + min(n_live, E), 8] = 1  # valid lane
+        # fresh device arrays per rep: jax Arrays cache their host copy
+        # after the first transfer, which would fake a ~0ms second pull.
+        # +2 sacrificial arrays warm each mode's slice-op compiles (the
+        # prefix path traces per bucket shape) OUTSIDE the timed loop —
+        # a first-rep compile would otherwise swamp the few-ms transfer
+        # and flip the recorded winner
+        arrs = [jax.device_put(host) for _ in range(2 * reps + 2)]
+        jax.block_until_ready(arrs)
+        pull_packed_stack(arrs[2 * reps], False)       # warm full
+        pull_packed_stack(arrs[2 * reps + 1], True)    # warm prefix
+        t0 = time.perf_counter()
+        for r in range(reps):
+            pull_packed_stack(arrs[r], False)
+        t_full = (time.perf_counter() - t0) / reps * 1e3
+        t0 = time.perf_counter()
+        for r in range(reps):
+            pull_packed_stack(arrs[reps + r], True)
+        t_pref = (time.perf_counter() - t0) / reps * 1e3
+        win = "prefix" if t_pref < t_full else "full"
+        lines.append(f"| {n_live:,} | {t_full:.2f} | {t_pref:.2f} | {win} |")
+    lines.append("\nDecision rule: if full wins even at low occupancy on "
+                 "this link, set HEATMAP_EMIT_PULL=full (auto assumes "
+                 "remote-attached D2H costs dominate the round trip).\n")
+
+
 def profile_stream(lines: list, quick: bool) -> None:
     import numpy as np
 
@@ -223,6 +271,7 @@ def main() -> None:
               "and must not be recorded as hardware numbers", file=sys.stderr)
     snap_bench(lines, args.quick)
     merge_bench(lines, args.quick)
+    pull_bench(lines, args.quick)
     profile_stream(lines, args.quick)
     with open(REPORT, "w", encoding="utf-8") as fh:
         fh.write("\n".join(lines) + "\n")
