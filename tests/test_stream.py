@@ -580,3 +580,32 @@ def test_emit_pull_prefix_equals_full(tmp_path):
     assert full.keys() == pref.keys() and len(full) > 0
     for k in full:
         assert full[k] == pref[k], k
+
+def test_emit_pull_validated():
+    with pytest.raises(ValueError, match="HEATMAP_EMIT_PULL"):
+        load_config({"HEATMAP_EMIT_PULL": "partial"})
+    assert load_config({"HEATMAP_EMIT_PULL": "prefix"}).emit_pull == "prefix"
+
+
+def test_old_checkpoint_layout_refused(tmp_path):
+    """A checkpoint from the pre-anchor state layout holds ABSOLUTE sums;
+    the current engine accumulates residuals about per-group anchors, so
+    resuming it would corrupt every average.  The loader must refuse with
+    an actionable message, not synthesize fields."""
+    import os
+
+    from heatmap_tpu.engine.state import TileState, init_state
+    from heatmap_tpu.stream.checkpoint import CheckpointManager
+
+    cm = CheckpointManager(str(tmp_path / "ck"))
+    st = init_state(64, 0)
+    cm.commit(offset=7, max_event_ts=0, epoch=1, states={(8, 300): st})
+    # strip the anchor/comp fields, emulating an old-layout npz
+    path = os.path.join(cm._commit_dir(), "state-8-300.npz")
+    with np.load(path) as z:
+        old = {k: z[k] for k in z.files
+               if k not in ("anchor_speed", "anchor_lat", "anchor_lon",
+                            "comp")}
+    np.savez(path, **old)
+    with pytest.raises(ValueError, match="older state layout"):
+        cm.load_state(8, 300)
