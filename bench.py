@@ -17,9 +17,10 @@ pulls are issued async and overlap the next chunk's compute.
 On an accelerator the harness first AUTOTUNES (BENCH_AUTOTUNE=0 disables):
 short timed runs over a small (merge-impl x batch, then chunk, then
 state capacity, then H3 snap impl — the fused Pallas kernel is tried on
-accelerators) grid pick the best configuration, which then runs the
-full-length headline measurement.  Explicit BENCH_BATCH / BENCH_CHUNK /
-HEATMAP_MERGE_IMPL / BENCH_CAP_LOG2 env values pin their dimension
+accelerators — then an emit-pull full-vs-prefix A/B) grid pick the best
+configuration, which then runs the full-length headline measurement.
+Explicit BENCH_BATCH / BENCH_CHUNK / HEATMAP_MERGE_IMPL /
+BENCH_CAP_LOG2 / BENCH_EMIT_PULL env values pin their dimension
 instead of sweeping it.  Configs that drop groups at capacity are
 rejected (the engine's exact overflow counter rides the scan carry),
 and a headline run that drops groups re-runs at a doubled slab so the
@@ -31,7 +32,8 @@ ratio is against the BASELINE.json north-star target of 5M events/sec.
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Env knobs: BENCH_EVENTS (default 16M), BENCH_BATCH (2^20), BENCH_RES (8),
 BENCH_CAP_LOG2 (17), BENCH_HIST_BINS (32), BENCH_CHUNK (8),
-BENCH_EMIT_CAP (4096), BENCH_AUTOTUNE (1 on accelerators),
+BENCH_EMIT_CAP (4096), BENCH_EMIT_PULL (full|prefix),
+BENCH_AUTOTUNE (1 on accelerators),
 BENCH_PROBE_ATTEMPTS (3), BENCH_PROBE_TIMEOUT_S (95), BENCH_TIMEOUT_S
 (1800), BENCH_TUNNEL_ADDR (127.0.0.1:8093, diagnostics only).
 """
@@ -146,7 +148,7 @@ def _required_events(n_events: int, batch: int, chunk: int) -> int:
 
 
 def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
-                merge_impl, n_events, h3_impl="xla"):
+                merge_impl, n_events, h3_impl="xla", pull=None):
     """One timed run at a configuration; returns (events_per_sec, info)."""
     import jax
     import jax.numpy as jnp
@@ -233,10 +235,10 @@ def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
         # (stream/runtime.py _pull_packed_multi): on accelerators,
         # transfer the head rows then only the live-prefix bucket — the
         # bench must pay the same D2H the pipeline pays, no more.
-        prefix_pull = os.environ.get(
+        prefix_pull = (pull if pull is not None else os.environ.get(
             "BENCH_EMIT_PULL",
             "prefix" if jax.default_backend() != "cpu" else "full",
-        ) == "prefix"
+        )) == "prefix"
 
         def pull_chunk_emits(pend) -> int:
             bufs = pull_packed_stack(pend, prefix_pull)
@@ -308,6 +310,12 @@ def main() -> dict:
     dev = jax.devices()[0]
     print(f"# device: {dev.platform} {dev.device_kind}", file=sys.stderr)
     on_accel = dev.platform != "cpu"
+    # the ONE default + validation for the pull knob (a typo'd value
+    # must not get printed as the measured discipline)
+    default_pull = "prefix" if on_accel else "full"
+    pull_env = os.environ.get("BENCH_EMIT_PULL")
+    if pull_env is not None and pull_env not in ("full", "prefix"):
+        sys.exit(f"BENCH_EMIT_PULL must be full|prefix, got {pull_env!r}")
 
     batch_env = os.environ.get("BENCH_BATCH")
     chunk_env = os.environ.get("BENCH_CHUNK")
@@ -381,10 +389,29 @@ def main() -> dict:
         for h3i in cand_h3:
             best = _try(best[1], best[2], best[3], best[4], h3i, best)
         _, batch, chunk, impl, cap, h3 = best
+        # final A/B: the emit-pull discipline on THIS link (same config,
+        # alternate mode) — prefix trades a round trip for fewer bytes,
+        # and only a measurement says which wins on a given attachment
+        pull = pull_env or default_pull
+        if not pull_env and best[0] > 0:
+            alt = "full" if pull == "prefix" else "prefix"
+            try:
+                eps_alt, inf_alt = _run_config(
+                    flat, res=res, cap=cap, bins=bins, emit_cap=emit_cap,
+                    batch=batch, chunk=chunk, merge_impl=impl,
+                    n_events=min(n_events, 4 * batch * chunk), h3_impl=h3,
+                    pull=alt)
+                print(f"# autotune [pull={alt}]: {eps_alt / 1e6:.2f}M ev/s "
+                      f"(vs {best[0] / 1e6:.2f}M {pull})", file=sys.stderr)
+                if eps_alt > best[0] and not inf_alt["state_overflow"]:
+                    pull = alt
+            except Exception as e:  # noqa: BLE001
+                print(f"# autotune [pull={alt}] failed: {e}", file=sys.stderr)
         print(f"# autotune winner: impl={impl} batch={batch} chunk={chunk} "
-              f"cap={cap} h3={h3}", file=sys.stderr)
+              f"cap={cap} h3={h3} pull={pull}", file=sys.stderr)
     else:
         h3 = os.environ.get("HEATMAP_H3_IMPL", "xla")
+        pull = pull_env or default_pull
 
     # the short autotune runs can under-predict the full run's group
     # count; if the headline run dropped groups, double the slab and
@@ -393,7 +420,7 @@ def main() -> dict:
         eps, info = _run_config(flat, res=res, cap=cap, bins=bins,
                                 emit_cap=emit_cap, batch=batch, chunk=chunk,
                                 merge_impl=impl, n_events=n_events,
-                                h3_impl=h3)
+                                h3_impl=h3, pull=pull)
         if not info["state_overflow"]:
             break
         if attempt == 2:
@@ -407,7 +434,7 @@ def main() -> dict:
     print(
         f"# {info['total']:,} events in {info['wall']:.2f}s "
         f"({info['n_chunks']} chunks x {chunk} batches of {batch:,}, "
-        f"merge={impl}, h3={h3}) | per-batch mean "
+        f"merge={impl}, h3={h3}, pull={pull}) | per-batch mean "
         f"{info['wall'] / info['n_batches'] * 1e3:.0f}ms "
         f"(p50 chunk/batch {info['p50_batch_ms']:.0f}ms) | active groups "
         f"{info['n_active']:,} | emit rows {info['emitted_rows']:,}",

@@ -594,7 +594,7 @@ def test_old_checkpoint_layout_refused(tmp_path):
     an actionable message, not synthesize fields."""
     import os
 
-    from heatmap_tpu.engine.state import TileState, init_state
+    from heatmap_tpu.engine.state import init_state
     from heatmap_tpu.stream.checkpoint import CheckpointManager
 
     cm = CheckpointManager(str(tmp_path / "ck"))
