@@ -235,10 +235,11 @@ def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
         # (stream/runtime.py _pull_packed_multi): on accelerators,
         # transfer the head rows then only the live-prefix bucket — the
         # bench must pay the same D2H the pipeline pays, no more.
-        prefix_pull = (pull if pull is not None else os.environ.get(
-            "BENCH_EMIT_PULL",
-            "prefix" if jax.default_backend() != "cpu" else "full",
-        )) == "prefix"
+        # callers pass the resolved mode; the bare-import default only
+        # serves direct _run_config use outside main()
+        prefix_pull = (pull if pull is not None
+                       else jax.default_backend() != "cpu" and "prefix"
+                       or "full") == "prefix"
 
         def pull_chunk_emits(pend) -> int:
             bufs = pull_packed_stack(pend, prefix_pull)
@@ -345,6 +346,9 @@ def main() -> dict:
         # capacity.  Explicit env values pin their dimension.  Capacity
         # candidates whose slab ends up nearly full are rejected — a full
         # slab means overflow drops would buy throughput dishonestly.
+        pull = pull_env or default_pull  # sweep + headline share it;
+        # the final A/B below may flip it by measurement
+
         def _try(b, c, im, cp, h3, best):
             short = min(n_events, 4 * b * c)
             tag = f"{im} b={b} c={c} cap={cp} h3={h3}"
@@ -352,7 +356,7 @@ def main() -> dict:
                 eps, inf = _run_config(flat, res=res, cap=cp, bins=bins,
                                        emit_cap=emit_cap, batch=b, chunk=c,
                                        merge_impl=im, n_events=short,
-                                       h3_impl=h3)
+                                       h3_impl=h3, pull=pull)
             except Exception as e:  # noqa: BLE001 - skip bad configs
                 print(f"# autotune [{tag}] failed: {e}", file=sys.stderr)
                 return best
@@ -392,7 +396,6 @@ def main() -> dict:
         # final A/B: the emit-pull discipline on THIS link (same config,
         # alternate mode) — prefix trades a round trip for fewer bytes,
         # and only a measurement says which wins on a given attachment
-        pull = pull_env or default_pull
         if not pull_env and best[0] > 0:
             alt = "full" if pull == "prefix" else "prefix"
             try:
