@@ -31,6 +31,8 @@ ratio is against the BASELINE.json north-star target of 5M events/sec.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Env knobs: BENCH_EVENTS (default 16M), BENCH_BATCH (2^20), BENCH_RES (8),
+BENCH_PIPELINE (backfill|hex_pyramid|multi_window — fused BASELINE
+configs #4/#5; backfill/config #3 stays the headline),
 BENCH_CAP_LOG2 (17), BENCH_HIST_BINS (32), BENCH_CHUNK (8),
 BENCH_EMIT_CAP (4096), BENCH_EMIT_PULL (full|prefix),
 BENCH_AUTOTUNE (1 on accelerators),
@@ -148,22 +150,31 @@ def _required_events(n_events: int, batch: int, chunk: int) -> int:
 
 
 def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
-                merge_impl, n_events, h3_impl="xla", pull=None):
-    """One timed run at a configuration; returns (events_per_sec, info)."""
+                merge_impl, n_events, h3_impl="xla", pull=None,
+                pairs=None):
+    """One timed run at a configuration; returns (events_per_sec, info).
+
+    ``pairs``: optional list of (res, window_s) for the fused multi-pair
+    fold (BASELINE configs #4/#5 via BENCH_PIPELINE); default is the
+    single (res, 300s) pair of config #3.  Every pair folds inside the
+    SAME scanned program, one snap per unique resolution —
+    engine/multi.py's fusion, under the bench's chunked dispatch."""
     import jax
     import jax.numpy as jnp
 
     from heatmap_tpu.engine import AggParams, init_state
     from heatmap_tpu.engine import step as step_mod
+    from heatmap_tpu.engine.multi import fused_fold
     from heatmap_tpu.engine.step import (
-        aggregate_batch, pack_emit, pull_packed_stack, unpack_emit)
+        pack_emit, pull_packed_stack, unpack_emit)
 
     n_batches = max(1, n_events // batch)
     n_chunks = max(1, n_batches // chunk)
     n_batches = n_chunks * chunk
     assert len(flat["lat"]) >= n_batches * batch, "capture undersized"
-    params = AggParams(res=res, window_s=300, emit_capacity=emit_cap,
-                       speed_hist_max=256.0)
+    pair_list = pairs or [(res, 300)]
+    params_list = [AggParams(res=r, window_s=w, emit_capacity=emit_cap,
+                             speed_hist_max=256.0) for r, w in pair_list]
     host_events = {
         k: v[: n_batches * batch].reshape(n_chunks, chunk, batch)
         for k, v in flat.items()
@@ -205,30 +216,35 @@ def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
             valid = jnp.ones((batch,), bool)
 
             def body(c, e):
-                st, ovf = c
-                st, emit, stats = aggregate_batch(
-                    st, e["lat"], e["lng"], e["speed"], e["ts"], valid,
-                    jnp.int32(-(2**31)), params,
-                )
-                # ride the overflow counter in the carry: dropped groups
-                # must disqualify a config (occupancy at the end is a bad
-                # proxy — window eviction frees slots mid-run)
-                return ((st, ovf + stats.state_overflow),
-                        pack_emit(emit, params.speed_hist_max))
+                sts, ovf = c
+                # the production fusion itself (engine.multi.fused_fold)
+                sts, folded = fused_fold(
+                    params_list, sts, e["lat"], e["lng"], e["speed"],
+                    e["ts"], valid, jnp.int32(-(2**31)))
+                packs = []
+                for p, (emit, stats) in zip(params_list, folded):
+                    # ride the overflow counter in the carry: dropped
+                    # groups must disqualify a config (occupancy at the
+                    # end is a bad proxy — eviction frees slots mid-run)
+                    ovf = ovf + stats.state_overflow
+                    packs.append(pack_emit(emit, p.speed_hist_max))
+                return ((sts, ovf), jnp.stack(packs))
 
             carry, packed = jax.lax.scan(body, carry, ev)
-            return carry, packed  # packed: (chunk, E+1, 13) uint32
+            return carry, packed  # packed: (chunk, P, E+1, 13) uint32
 
-        state = init_state(cap, bins)
+        def fresh_states():
+            return tuple(init_state(cap, bins) for _ in params_list)
 
         # --- warmup / compile ---------------------------------------------
         t0 = time.monotonic()
         ev0 = {k: jax.device_put(v[0]) for k, v in host_events.items()}
-        carry, packed = run_chunk((state, jnp.int32(0)), ev0)
-        np.asarray(packed[0, 0, 0])
-        print(f"# [{merge_impl} b={batch} c={chunk}] compile+warmup: "
-              f"{time.monotonic() - t0:.1f}s", file=sys.stderr)
-        carry = (init_state(cap, bins), jnp.int32(0))  # reset after warmup
+        carry, packed = run_chunk((fresh_states(), jnp.int32(0)), ev0)
+        np.asarray(packed[0, 0, 0, 0])
+        print(f"# [{merge_impl} b={batch} c={chunk} P={len(params_list)}] "
+              f"compile+warmup: {time.monotonic() - t0:.1f}s",
+              file=sys.stderr)
+        carry = (fresh_states(), jnp.int32(0))  # reset after warmup
 
         # --- timed run ----------------------------------------------------
         # Pull discipline mirrors the streaming runtime's emit_pull=auto
@@ -242,7 +258,8 @@ def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
                        or "full") == "prefix"
 
         def pull_chunk_emits(pend) -> int:
-            bufs = pull_packed_stack(pend, prefix_pull)
+            blocks = pend.reshape(-1, *pend.shape[-2:])  # (chunk*P, E+1, L)
+            bufs = pull_packed_stack(blocks, prefix_pull)
             return int(sum(unpack_emit(b)["n_emitted"] for b in bufs))
 
         emitted_rows = 0
@@ -261,8 +278,9 @@ def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
             chunk_walls.append(now - last)
             last = now
         emitted_rows += pull_chunk_emits(pending)
-        state, ovf = carry
-        n_active = int(np.asarray(jnp.sum(state.count > 0)))
+        states, ovf = carry
+        n_active = int(sum(int(np.asarray(jnp.sum(st.count > 0)))
+                           for st in states))
         state_overflow = int(np.asarray(ovf))
         wall = time.monotonic() - t_start
     finally:
@@ -304,6 +322,20 @@ def main() -> dict:
 
     n_events = int(os.environ.get("BENCH_EVENTS", 16 * (1 << 20)))
     res = int(os.environ.get("BENCH_RES", 8))
+    # BENCH_PIPELINE widens the measured fold beyond config #3:
+    # hex_pyramid = BASELINE #4 (res 7/8/9 fused), multi_window =
+    # BASELINE #5 (1/5/15-min sliding).  The default stays config #3 so
+    # the headline metric is stable round over round.
+    pipeline = os.environ.get("BENCH_PIPELINE", "backfill")
+    pipe_pairs = {
+        "backfill": None,
+        "hex_pyramid": [(7, 300), (8, 300), (9, 300)],
+        "multi_window": [(8, 60), (8, 300), (8, 900)],
+    }
+    if pipeline not in pipe_pairs:
+        sys.exit(f"BENCH_PIPELINE must be one of {sorted(pipe_pairs)}, "
+                 f"got {pipeline!r}")
+    pairs = pipe_pairs[pipeline]
     cap = 1 << int(os.environ.get("BENCH_CAP_LOG2", 17))
     bins = int(os.environ.get("BENCH_HIST_BINS", 32))
     emit_cap = int(os.environ.get("BENCH_EMIT_CAP", 4096))
@@ -356,7 +388,7 @@ def main() -> dict:
                 eps, inf = _run_config(flat, res=res, cap=cp, bins=bins,
                                        emit_cap=emit_cap, batch=b, chunk=c,
                                        merge_impl=im, n_events=short,
-                                       h3_impl=h3, pull=pull)
+                                       h3_impl=h3, pull=pull, pairs=pairs)
             except Exception as e:  # noqa: BLE001 - skip bad configs
                 print(f"# autotune [{tag}] failed: {e}", file=sys.stderr)
                 return best
@@ -403,7 +435,7 @@ def main() -> dict:
                     flat, res=res, cap=cap, bins=bins, emit_cap=emit_cap,
                     batch=batch, chunk=chunk, merge_impl=impl,
                     n_events=min(n_events, 4 * batch * chunk), h3_impl=h3,
-                    pull=alt)
+                    pull=alt, pairs=pairs)
                 print(f"# autotune [pull={alt}]: {eps_alt / 1e6:.2f}M ev/s "
                       f"(vs {best[0] / 1e6:.2f}M {pull})", file=sys.stderr)
                 if eps_alt > best[0] and not inf_alt["state_overflow"]:
@@ -423,7 +455,7 @@ def main() -> dict:
         eps, info = _run_config(flat, res=res, cap=cap, bins=bins,
                                 emit_cap=emit_cap, batch=batch, chunk=chunk,
                                 merge_impl=impl, n_events=n_events,
-                                h3_impl=h3, pull=pull)
+                                h3_impl=h3, pull=pull, pairs=pairs)
         if not info["state_overflow"]:
             break
         if attempt == 2:
@@ -443,8 +475,15 @@ def main() -> dict:
         f"{info['n_active']:,} | emit rows {info['emitted_rows']:,}",
         file=sys.stderr,
     )
+    desc = {
+        "backfill": f"H3 res {res}, 5-min windows",
+        "hex_pyramid": "fused res 7/8/9 pyramid, 5-min windows "
+                       "(BASELINE config #4)",
+        "multi_window": "H3 res 8, fused 1/5/15-min sliding windows "
+                        "(BASELINE config #5)",
+    }[pipeline]
     result = {
-        "metric": f"GPS events/sec aggregated (H3 res {res}, 5-min windows, "
+        "metric": f"GPS events/sec aggregated ({desc}, "
                   f"count+avg+p95 update-mode emits)",
         "value": round(eps, 1),
         "unit": "events/sec",

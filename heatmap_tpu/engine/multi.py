@@ -41,6 +41,32 @@ from heatmap_tpu.engine.step import (
 )
 
 
+def fused_fold(params_list, states, lat_rad, lng_rad, speed, ts, valid,
+               cutoff):
+    """THE per-batch multi-pair fold (trace-time): one H3 snap per unique
+    resolution shared across its windows, then each pair's merge_batch on
+    its own state slab.  Shared by MultiAggregator's jitted step and by
+    bench.py's scanned chunks, so the benchmark always measures exactly
+    the production fusion.  Returns (new_states, [(emit, stats)] in pair
+    order)."""
+    lat_deg = lat_rad * jnp.float32(180.0 / np.pi)
+    lon_deg = lng_rad * jnp.float32(180.0 / np.pi)
+    by_res: dict[int, tuple] = {}
+    for p in params_list:
+        if p.res not in by_res:
+            hi, lo, _ = snap_and_window(lat_rad, lng_rad, ts, valid, p)
+            by_res[p.res] = (hi, lo)
+    new_states, folded = [], []
+    for p, st in zip(params_list, states):
+        hi, lo = by_res[p.res]
+        ws = window_start(ts, valid, p.window_s)
+        st2, emit, stats = merge_batch(
+            st, hi, lo, ws, speed, lat_deg, lon_deg, ts, valid, cutoff, p)
+        new_states.append(st2)
+        folded.append((emit, stats))
+    return tuple(new_states), folded
+
+
 class MultiAggregator:
     """Fused aggregation over P (resolution, window_s) pairs, one device.
 
@@ -76,28 +102,13 @@ class MultiAggregator:
         param_list = self.params
 
         def _step(states, lat, lng, speed, ts, valid, cutoff):
-            lat_deg = lat * jnp.float32(180.0 / np.pi)
-            lon_deg = lng * jnp.float32(180.0 / np.pi)
-            # one snap per unique resolution, shared across its windows
-            by_res: dict[int, tuple] = {}
-            for p in param_list:
-                if p.res not in by_res:
-                    hi, lo, _ = snap_and_window(lat, lng, ts, valid, p)
-                    by_res[p.res] = (hi, lo)
-            new_states, packs = [], []
-            for p, st in zip(param_list, states):
-                hi, lo = by_res[p.res]
-                ws = window_start(ts, valid, p.window_s)
-                st2, emit, stats = merge_batch(
-                    st, hi, lo, ws, speed, lat_deg, lon_deg, ts, valid,
-                    cutoff, p,
-                )
-                new_states.append(st2)
-                # ride the step stats in the packed head row, so the host
-                # needs NO second transfer for them (see stats_from_packed)
-                packs.append(
-                    ride_stats(pack_emit(emit, p.speed_hist_max), stats))
-            return tuple(new_states), jnp.stack(packs)
+            new_states, folded = fused_fold(param_list, states, lat, lng,
+                                            speed, ts, valid, cutoff)
+            # ride the step stats in the packed head row, so the host
+            # needs NO second transfer for them (see stats_from_packed)
+            packs = [ride_stats(pack_emit(emit, p.speed_hist_max), stats)
+                     for p, (emit, stats) in zip(param_list, folded)]
+            return new_states, jnp.stack(packs)
 
         self._step = jax.jit(_step, donate_argnums=(0,))
 

@@ -60,6 +60,17 @@ def main() -> int:
         print(line)
         lines.append(line)
 
+    try:
+        return _run(args, log, lines)
+    finally:
+        # the log must survive EVERY exit path — failures and crashes
+        # are exactly the runs worth recording
+        if args.out and lines:
+            with open(args.out, "a", encoding="utf-8") as fh:
+                fh.write("\n".join(lines) + "\n")
+
+
+def _run(args, log, lines) -> int:
     import jax
 
     bootstrap = os.environ.get("KAFKA_BOOTSTRAP", "127.0.0.1:9092")
@@ -106,8 +117,16 @@ def main() -> int:
 
         # 2. aggregate (the reference's spark-submit role)
         src = KafkaSource(bootstrap, topic)
-        try:  # discover the topic's REAL partition list (a real broker's
-            parts = src._impl.c.partitions(topic)  # num.partitions may be !=3)
+        try:
+            # discover the topic's REAL partition list with the wire
+            # client (impl-agnostic: the consumer may be confluent/
+            # kafka-python, whose internals differ) — a real broker's
+            # num.partitions may be anything
+            from heatmap_tpu.kafka import KafkaClient
+
+            kc = KafkaClient(bootstrap)
+            parts = kc.partitions(topic)
+            kc.close()
         except Exception:
             parts = [0, 1, 2]
         src.seek({p: 0 for p in parts})
@@ -130,9 +149,6 @@ def main() -> int:
             f"{snap.get('checkpoints', 0)} checkpoints committed)")
         if got != n:
             log("FAIL: not all events aggregated")
-            if args.out:
-                with open(args.out, "a", encoding="utf-8") as fh:
-                    fh.write("\n".join(lines) + "\n")
             return 1
 
         # 3. upserted state (the reference's mongosh check)
@@ -161,10 +177,6 @@ def main() -> int:
 
         store.close()
         pub.close()
-
-    if args.out:
-        with open(args.out, "a", encoding="utf-8") as fh:
-            fh.write("\n".join(lines) + "\n")
     return 0 if ok else 1
 
 
