@@ -32,4 +32,18 @@ Layout
 
 __version__ = "0.1.0"
 
+import os as _os
+
+if _os.environ.get("HEATMAP_PLATFORM"):
+    # Select the JAX backend before anything touches a device array.
+    # Deployments that pin a platform plugin via sitecustomize (where
+    # JAX_PLATFORMS from the environment is applied too early to
+    # override) can still run the demo/runtime on another backend —
+    # e.g. HEATMAP_PLATFORM=cpu when the accelerator tunnel is down.
+    # Must precede the engine import: its module-level jnp constants
+    # initialize the backend, and a dead remote plugin blocks there.
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["HEATMAP_PLATFORM"])
+
 from heatmap_tpu.config import Config, load_config  # noqa: F401

@@ -1,0 +1,55 @@
+"""Shared helpers for the on-hardware measurement tools.
+
+Both `tools/validate_on_tpu.py` (one-shot, assumes a stable chip) and
+`tools/hw_burst.py` (resumable, survives a flapping relay) time the same
+operations; the timing loop and the synthetic merge-fold inputs live
+here so the two tools can never drift apart on what they measure.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def timed(fn, *args, reps: int = 20) -> float:
+    """Mean seconds per call after a compile+warm run."""
+    import jax
+
+    jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def rand_latlng(n: int, seed: int = 0):
+    """Uniform global-ish radian coordinates for snap benches."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    lat = np.radians(rng.uniform(-60, 60, n)).astype(np.float32)
+    lng = np.radians(rng.uniform(-180, 180, n)).astype(np.float32)
+    return lat, lng
+
+
+def merge_fold_args(batch: int, seed: int = 1):
+    """The canonical merge-fold input tuple at the Boston streaming
+    shape (res 8, 5-min windows, 10-min spread) used by every
+    sort-vs-rank crossover measurement."""
+    import numpy as np
+
+    from heatmap_tpu.engine import AggParams
+    from heatmap_tpu.engine.step import snap_and_window
+
+    rng = np.random.default_rng(seed)
+    p = AggParams(res=8, window_s=300, emit_capacity=min(4096, batch))
+    lat = np.radians(rng.uniform(42.0, 43.0, batch)).astype(np.float32)
+    lng = np.radians(rng.uniform(-72.0, -70.0, batch)).astype(np.float32)
+    speed = rng.uniform(0, 120, batch).astype(np.float32)
+    ts = (1_700_000_000 + rng.integers(0, 600, batch)).astype(np.int32)
+    valid = np.ones(batch, bool)
+    hi, lo, ws = snap_and_window(lat, lng, ts, valid, p)
+    return (hi, lo, ws, speed, np.degrees(lat.astype(np.float64)),
+            np.degrees(lng.astype(np.float64)), ts, valid,
+            np.int32(-(2 ** 31)), p)

@@ -26,20 +26,14 @@ import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# timing loop + canonical bench inputs shared with tools/hw_burst.py so
+# the one-shot and burst-banked numbers measure the same thing
+from _hw_common import merge_fold_args, rand_latlng  # noqa: E402
+from _hw_common import timed as _timed  # noqa: E402
 
 REPORT = os.path.join(os.path.dirname(__file__), os.pardir, "HARDWARE.md")
-
-
-def _timed(fn, *args, reps=20):
-    import jax
-
-    out = fn(*args)
-    jax.block_until_ready(out)  # compile + warm
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / reps
 
 
 def snap_bench(lines: list, quick: bool) -> None:
@@ -51,9 +45,7 @@ def snap_bench(lines: list, quick: bool) -> None:
     from heatmap_tpu.hexgrid import pallas_kernel
 
     n = 1 << (18 if quick else 20)
-    rng = np.random.default_rng(0)
-    lat = np.radians(rng.uniform(-60, 60, n)).astype(np.float32)
-    lng = np.radians(rng.uniform(-180, 180, n)).astype(np.float32)
+    lat, lng = rand_latlng(n)
     lines.append("## H3 snap: Pallas vs XLA\n")
     lines.append(f"{n:,} points, {jax.devices()[0].device_kind}\n")
     lines.append("| res | XLA ms | Pallas ms | speedup | agree |")
@@ -89,16 +81,10 @@ def snap_bench(lines: list, quick: bool) -> None:
 
 def merge_bench(lines: list, quick: bool) -> None:
     import jax
-    import numpy as np
 
-    from heatmap_tpu.engine import AggParams, init_state
-    from heatmap_tpu.engine.step import (
-        _merge_rank,
-        _merge_sort,
-        snap_and_window,
-    )
+    from heatmap_tpu.engine import init_state
+    from heatmap_tpu.engine.step import _merge_rank, _merge_sort
 
-    rng = np.random.default_rng(1)
     lines.append("## Merge fold: sort vs rank crossover\n")
     lines.append("| shape | batch | slab | sort ms | rank ms | winner |")
     lines.append("|---|---|---|---|---|---|")
@@ -106,16 +92,7 @@ def merge_bench(lines: list, quick: bool) -> None:
     if not quick:
         shapes.append(("balanced", 1 << 16, 1 << 16))
     for name, batch, cap in shapes:
-        p = AggParams(res=8, window_s=300, emit_capacity=min(4096, batch))
-        lat = np.radians(rng.uniform(42.0, 43.0, batch)).astype(np.float32)
-        lng = np.radians(rng.uniform(-72.0, -70.0, batch)).astype(np.float32)
-        speed = rng.uniform(0, 120, batch).astype(np.float32)
-        ts = (1_700_000_000 + rng.integers(0, 600, batch)).astype(np.int32)
-        valid = np.ones(batch, bool)
-        hi, lo, ws = snap_and_window(lat, lng, ts, valid, p)
-        args = (hi, lo, ws, speed, np.degrees(lat.astype(np.float64)),
-                np.degrees(lng.astype(np.float64)), ts, valid,
-                np.int32(-2**31), p)
+        args = merge_fold_args(batch)
         st = init_state(cap, 16)
 
         def run_sort(s):
