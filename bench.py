@@ -499,8 +499,47 @@ def main() -> dict:
                          "(BASELINE.json north star); reference publishes "
                          "no measured baseline",
     }
+    if dev.platform == "cpu":
+        # The relay flaps (up for ~minutes at a time); tools/hw_burst.py
+        # banks real-hardware measurements whenever it answers.  If this
+        # run fell back to CPU but a hardware headline was banked, carry
+        # it in the artifact with provenance so the round still records
+        # the measured TPU number.
+        banked = _banked_hw_headline()
+        if banked:
+            result.update(banked)
     print(json.dumps(result))
     return result
+
+
+def _banked_hw_headline() -> dict:
+    """Hardware-stamped headline unit from HW_PROGRESS.json, if any."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "HW_PROGRESS.json")
+    try:
+        with open(path, encoding="utf-8") as fh:
+            units = json.load(fh)["units"]
+        best = None
+        for name in ("headline", "headline_big"):
+            unit = units.get(name)
+            if not unit or unit["data"].get("_platform") == "cpu":
+                continue
+            if (best is None or unit["data"]["events_per_sec"]
+                    > best["data"]["events_per_sec"]):
+                best = unit
+        if best is None:
+            return {}
+        data = best["data"]
+        return {
+            "hw_banked_events_per_sec": data["events_per_sec"],
+            "hw_banked_device": data.get("_device_kind", "?"),
+            "hw_banked_at": best.get("ts", "?"),
+            "hw_banked_note": "measured on hardware by tools/hw_burst.py "
+                              "during a relay uptime window; this run "
+                              "itself fell back to CPU",
+        }
+    except (OSError, KeyError, ValueError):
+        return {}
 
 
 def _fallback_reexec() -> None:

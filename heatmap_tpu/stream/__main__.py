@@ -20,10 +20,24 @@ def main(argv=None) -> None:
     ap.add_argument("pipeline", nargs="?", default="mbta_default",
                     choices=sorted(PIPELINES))
     ap.add_argument("--max-batches", type=int, default=None)
+    ap.add_argument("--supervise", action="store_true",
+                    help="run the job as a supervised child: restart on "
+                         "crash AND on heartbeat stall (wedged device op),"
+                         " resuming from the checkpoint; policy via "
+                         "HEATMAP_SUPERVISE_* (stream/supervisor.py)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    if args.supervise:
+        import sys
+
+        from heatmap_tpu.stream.supervisor import supervise_cli
+
+        child = [sys.executable, "-m", "heatmap_tpu.stream", args.pipeline]
+        if args.max_batches is not None:
+            child += ["--max-batches", str(args.max_batches)]
+        raise SystemExit(supervise_cli(child))
     p = get_pipeline(args.pipeline)
 
     # distributed + multi-device setup: HEATMAP_COORDINATOR et al. start

@@ -18,6 +18,7 @@ group (heatmap_stream.py:243), as-fast-as-possible triggering unless
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 
@@ -738,6 +739,26 @@ class MicroBatchRuntime:
             self._checkpoint()
         return progressed
 
+    def _touch_heartbeat(self) -> None:
+        """Liveness beacon for stream.supervisor: overwrite the file named
+        by HEATMAP_HEARTBEAT_FILE (set by the supervisor in the child's
+        env) with the current wall time, at most once a second.  Written
+        from the step loop, so a wedged device op — the observed failure
+        mode of a remote-attached chip whose tunnel died — stops the
+        beacon and the supervisor can declare a stall."""
+        path = os.environ.get("HEATMAP_HEARTBEAT_FILE")
+        if not path:
+            return
+        now = time.monotonic()
+        if now - getattr(self, "_hb_last", 0.0) < 1.0:
+            return
+        self._hb_last = now
+        try:
+            with open(path, "w", encoding="utf-8") as fh:
+                fh.write(f"{time.time():.3f} epoch={self.epoch}\n")
+        except OSError:  # beacon must never take the pipeline down
+            pass
+
     def run(self, max_batches: int | None = None) -> None:
         """Drive the loop until the source is exhausted (or forever)."""
         trigger_s = self.cfg.trigger_ms / 1e3
@@ -745,6 +766,7 @@ class MicroBatchRuntime:
         try:
             while max_batches is None or n < max_batches:
                 t0 = time.monotonic()
+                self._touch_heartbeat()
                 progressed = self.step_once()
                 done = (self._global_live == 0 if self._multiproc
                         else self.source.exhausted)

@@ -1,0 +1,241 @@
+"""Failure detection + elastic restart for the streaming job.
+
+The reference delegates this entirely to Spark's restart-from-checkpoint
+model (SURVEY.md §5.3; reference: heatmap_stream.py:241-249 relies on
+the cluster manager to resurrect a dead driver).  Here the framework
+owns it: the supervisor runs the streaming job as a child process and
+restarts it from its own checkpoint when it crashes — or when it
+*stalls*, the failure mode clusters can't see from an exit code.
+
+Why a stall detector is first-class: with a remote-attached accelerator
+(TPU over a tunnel), the observed failure mode is not a crash but a
+device op that never returns — the JAX client sleeps in a read against a
+connection that no longer exists.  The runtime's step loop writes a
+heartbeat file (MicroBatchRuntime._touch_heartbeat, at most 1/s); the
+supervisor declares a stall when the beacon goes quiet past
+``stall_timeout_s``, kills the child, and restarts it.  The sink's
+idempotent upserts + the offsets-after-commit checkpoint discipline make
+the replay safe (same contract that makes crash-restart safe,
+stream/checkpoint.py).
+
+Optional platform failover: after ``failover_after`` consecutive
+failures, the child is restarted with ``HEATMAP_PLATFORM=<failover_
+platform>`` (default cpu) so a pipeline whose accelerator link died
+keeps serving — degraded — instead of crash-looping.  Set
+``failover_after=None`` to insist on the accelerator.
+
+Usage: ``python -m heatmap_tpu.stream --supervise [pipeline]`` (the CLI
+builds the child argv from its own), or programmatically::
+
+    Supervisor([sys.executable, "-m", "heatmap_tpu.stream", "mbta"],
+               RestartPolicy(stall_timeout_s=120)).run()
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import NamedTuple
+
+log = logging.getLogger("supervisor")
+
+
+class RestartPolicy(NamedTuple):
+    """Restart budget and failure thresholds.
+
+    ``max_restarts`` within ``window_s`` bounds a crash loop (an old
+    failure ages out of the budget after the window); exponential
+    backoff between restarts keeps a hard-down dependency from being
+    hammered."""
+
+    max_restarts: int = 5
+    window_s: float = 300.0
+    backoff_s: float = 1.0
+    backoff_max_s: float = 30.0
+    stall_timeout_s: float = 120.0
+    # grace before the FIRST beacon: the child's first step traces and
+    # compiles the whole streaming program, which on a remote-attached
+    # chip routinely takes minutes — killing it mid-compile would make
+    # supervised mode unable to ever start.  After the first beacon the
+    # tighter stall_timeout_s applies.
+    startup_grace_s: float = 600.0
+    term_grace_s: float = 10.0     # SIGTERM → SIGKILL escalation
+    failover_after: int | None = None
+    failover_platform: str = "cpu"
+
+    @classmethod
+    def from_env(cls, env=os.environ) -> "RestartPolicy":
+        """Env-var form for the CLI (HEATMAP_SUPERVISE_* namespace)."""
+        def _f(name, cast, default):
+            v = env.get(f"HEATMAP_SUPERVISE_{name}")
+            return cast(v) if v not in (None, "") else default
+
+        failover = _f("FAILOVER_AFTER", int, None)
+        return cls(
+            max_restarts=_f("MAX_RESTARTS", int, cls._field_defaults["max_restarts"]),
+            window_s=_f("WINDOW_S", float, cls._field_defaults["window_s"]),
+            backoff_s=_f("BACKOFF_S", float, cls._field_defaults["backoff_s"]),
+            backoff_max_s=_f("BACKOFF_MAX_S", float,
+                             cls._field_defaults["backoff_max_s"]),
+            stall_timeout_s=_f("STALL_TIMEOUT_S", float,
+                               cls._field_defaults["stall_timeout_s"]),
+            startup_grace_s=_f("STARTUP_GRACE_S", float,
+                               cls._field_defaults["startup_grace_s"]),
+            term_grace_s=_f("TERM_GRACE_S", float,
+                            cls._field_defaults["term_grace_s"]),
+            failover_after=failover,
+            failover_platform=_f("FAILOVER_PLATFORM", str,
+                                 cls._field_defaults["failover_platform"]),
+        )
+
+
+class Supervisor:
+    def __init__(self, argv: list[str], policy: RestartPolicy | None = None,
+                 env: dict | None = None, heartbeat_path: str | None = None,
+                 poll_s: float = 0.2):
+        self.argv = list(argv)
+        self.policy = policy or RestartPolicy()
+        self.env = dict(env if env is not None else os.environ)
+        self.heartbeat_path = heartbeat_path or os.path.join(
+            tempfile.gettempdir(), f"heatmap-hb-{os.getpid()}")
+        self.poll_s = poll_s
+        self.restarts = 0            # total child launches after the first
+        self.failed_over = False
+        self._stop = False
+
+    # -------------------------------------------------------------- child
+
+    def _spawn(self) -> subprocess.Popen:
+        env = dict(self.env)
+        env["HEATMAP_HEARTBEAT_FILE"] = self.heartbeat_path
+        try:
+            os.remove(self.heartbeat_path)  # age from THIS child's start
+        except OSError:
+            pass
+        log.info("starting child: %s", " ".join(self.argv))
+        return subprocess.Popen(self.argv, env=env)
+
+    def _heartbeat_age(self, child_started: float) -> tuple[float, bool]:
+        """(seconds since the child last proved liveness, beacon seen):
+        age of its latest beacon write, or of its start time if it never
+        wrote one (covers a child wedged inside backend init / the first
+        compile — judged against startup_grace_s, not stall_timeout_s)."""
+        try:
+            return (time.monotonic() - max(
+                child_started,
+                self._mono_of(os.stat(self.heartbeat_path).st_mtime)), True)
+        except OSError:
+            return time.monotonic() - child_started, False
+
+    @staticmethod
+    def _mono_of(wall_ts: float) -> float:
+        """Translate a wall-clock mtime onto the monotonic axis."""
+        return time.monotonic() - max(0.0, time.time() - wall_ts)
+
+    def _kill(self, proc: subprocess.Popen) -> None:
+        """SIGTERM, grace period, SIGKILL."""
+        if proc.poll() is not None:
+            return
+        proc.terminate()
+        try:
+            proc.wait(self.policy.term_grace_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+
+    # --------------------------------------------------------------- loop
+
+    def run(self) -> int:
+        """Supervise until the child exits 0 (done), the restart budget
+        is exhausted, or stop() is called.  Returns the final child exit
+        code (0 on clean completion)."""
+        p = self.policy
+        recent: list[float] = []     # monotonic times of recent failures
+        backoff = p.backoff_s
+        failures_in_a_row = 0
+        rc = 1
+        while not self._stop:
+            proc = self._spawn()
+            started = time.monotonic()
+            reason = None
+            while reason is None and not self._stop:
+                code = proc.poll()
+                if code is not None:
+                    if code == 0:
+                        log.info("child exited cleanly; done")
+                        return 0
+                    reason = f"exit code {code}"
+                    rc = code
+                    break
+                age, beacon_seen = self._heartbeat_age(started)
+                limit = (p.stall_timeout_s if beacon_seen
+                         else max(p.stall_timeout_s, p.startup_grace_s))
+                if age > limit:
+                    reason = f"stall: no heartbeat for >{limit:.1f}s"
+                    self._kill(proc)
+                    rc = 1
+                    break
+                time.sleep(self.poll_s)
+            if self._stop:
+                self._kill(proc)
+                log.info("stopped; child terminated")
+                return 0
+            if time.monotonic() - started > p.window_s:
+                # the child ran healthy for a full budget window before
+                # this failure — an isolated blip, not a streak.  Without
+                # the reset, one crash a day would eventually trip
+                # failover_after and permanently degrade to the failover
+                # platform despite a working accelerator.
+                failures_in_a_row = 0
+                backoff = p.backoff_s
+            failures_in_a_row += 1
+            now = time.monotonic()
+            recent = [t for t in recent if now - t <= p.window_s]
+            recent.append(now)
+            if len(recent) > p.max_restarts:
+                log.error("giving up: %d failures within %.0fs (last: %s)",
+                          len(recent), p.window_s, reason)
+                return rc
+            if (p.failover_after is not None and not self.failed_over
+                    and failures_in_a_row >= p.failover_after):
+                log.warning(
+                    "%d consecutive failures — failing over to "
+                    "HEATMAP_PLATFORM=%s (degraded; restart without the "
+                    "override to return to the accelerator)",
+                    failures_in_a_row, p.failover_platform)
+                self.env["HEATMAP_PLATFORM"] = p.failover_platform
+                self.failed_over = True
+            log.warning("child failed (%s); restarting in %.1fs "
+                        "(%d/%d in window)", reason, backoff,
+                        len(recent), p.max_restarts)
+            self.restarts += 1
+            time.sleep(backoff)
+            backoff = min(backoff * 2, p.backoff_max_s)
+        return rc
+
+    def stop(self) -> None:
+        """Ask run() to terminate the child and return (signal-safe)."""
+        self._stop = True
+
+
+def supervise_cli(child_argv: list[str]) -> int:
+    """CLI glue: run ``child_argv`` under a Supervisor configured from
+    HEATMAP_SUPERVISE_* env vars; SIGTERM/SIGINT stop child + parent."""
+    sup = Supervisor(child_argv, RestartPolicy.from_env())
+
+    def _on_signal(signum, frame):  # noqa: ARG001
+        sup.stop()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    return sup.run()
+
+
+if __name__ == "__main__":  # pragma: no cover - tiny manual harness
+    logging.basicConfig(level=logging.INFO)
+    sys.exit(supervise_cli(sys.argv[1:]))
