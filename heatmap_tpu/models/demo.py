@@ -11,10 +11,16 @@ import argparse
 import logging
 import time
 
-from heatmap_tpu.config import load_config
-from heatmap_tpu.serve import start_background
-from heatmap_tpu.sink import MemoryStore
-from heatmap_tpu.stream import MicroBatchRuntime, SyntheticSource
+# pin CPU if the accelerator link is dead — the stream import below
+# touches jax at module level and would otherwise hang forever
+from heatmap_tpu.utils.device_probe import ensure_reachable_backend
+
+ensure_reachable_backend()
+
+from heatmap_tpu.config import load_config  # noqa: E402
+from heatmap_tpu.serve import start_background  # noqa: E402
+from heatmap_tpu.sink import MemoryStore  # noqa: E402
+from heatmap_tpu.stream import MicroBatchRuntime, SyntheticSource  # noqa: E402
 
 log = logging.getLogger("demo")
 

@@ -27,7 +27,22 @@ from heatmap_tpu.stream.source import (  # noqa: F401
     Source,
     SyntheticSource,
 )
-from heatmap_tpu.stream.runtime import (  # noqa: F401
-    MicroBatchRuntime,
-    StateOverflowError,
-)
+
+# The runtime (and engine behind it) touch jax at import; resolving them
+# lazily keeps `import heatmap_tpu.stream` — and crucially the package
+# import that `python -m heatmap_tpu.stream` performs BEFORE __main__'s
+# device probe can run — free of device init, so a dead accelerator
+# relay can't hang the CLI before its CPU-fallback logic exists.
+_LAZY = {"MicroBatchRuntime", "StateOverflowError"}
+
+
+def __getattr__(name):  # PEP 562
+    if name in _LAZY:
+        from heatmap_tpu.stream import runtime
+
+        return getattr(runtime, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

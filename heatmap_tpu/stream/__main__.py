@@ -10,9 +10,10 @@ interrupted.  ``pipeline`` is one of heatmap_tpu.models.pipelines (default
 import argparse
 import logging
 
+# light imports only (pipelines/source/config carry no jax); everything
+# that touches a device is imported inside main() AFTER the probe below
 from heatmap_tpu.models.pipelines import PIPELINES, get_pipeline
 from heatmap_tpu.sink import make_store
-from heatmap_tpu.stream import MicroBatchRuntime
 
 
 def main(argv=None) -> None:
@@ -30,6 +31,9 @@ def main(argv=None) -> None:
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(levelname)s %(name)s %(message)s")
     if args.supervise:
+        # the PARENT never probes (it runs no device op) and must not pin
+        # HEATMAP_PLATFORM: each child probes per launch, so an
+        # accelerator that comes back between restarts gets retried
         import sys
 
         from heatmap_tpu.stream.supervisor import supervise_cli
@@ -38,6 +42,13 @@ def main(argv=None) -> None:
         if args.max_batches is not None:
             child += ["--max-batches", str(args.max_batches)]
         raise SystemExit(supervise_cli(child))
+
+    # with a dead accelerator relay, the first jax touch (module-level
+    # engine constants behind the runtime import) hangs forever — the
+    # probe pins CPU instead (skipped under HEATMAP_PLATFORM / multihost)
+    from heatmap_tpu.utils.device_probe import ensure_reachable_backend
+
+    ensure_reachable_backend()
     p = get_pipeline(args.pipeline)
 
     # distributed + multi-device setup: HEATMAP_COORDINATOR et al. start
@@ -46,6 +57,7 @@ def main(argv=None) -> None:
     import jax
 
     from heatmap_tpu.parallel import make_mesh, multihost
+    from heatmap_tpu.stream import MicroBatchRuntime
 
     multihost.init_from_env()
     mesh = None

@@ -163,6 +163,7 @@ class Supervisor:
             proc = self._spawn()
             started = time.monotonic()
             reason = None
+            healthy_span = 0.0
             while reason is None and not self._stop:
                 code = proc.poll()
                 if code is not None:
@@ -170,6 +171,9 @@ class Supervisor:
                         log.info("child exited cleanly; done")
                         return 0
                     reason = f"exit code {code}"
+                    # exit-code failure: the child ran under its own
+                    # power until it ended — its lifetime was healthy
+                    healthy_span = time.monotonic() - started
                     rc = code
                     break
                 age, beacon_seen = self._heartbeat_age(started)
@@ -177,6 +181,13 @@ class Supervisor:
                          else max(p.stall_timeout_s, p.startup_grace_s))
                 if age > limit:
                     reason = f"stall: no heartbeat for >{limit:.1f}s"
+                    # healthy span ends at the LAST beacon, not at kill
+                    # time: the stall-detection wait is not health, or a
+                    # child that only ever wedged (startup grace > window)
+                    # would reset the streak on every iteration and the
+                    # budget/failover could never trip
+                    healthy_span = max(0.0,
+                                       time.monotonic() - started - age)
                     self._kill(proc)
                     rc = 1
                     break
@@ -185,7 +196,7 @@ class Supervisor:
                 self._kill(proc)
                 log.info("stopped; child terminated")
                 return 0
-            if time.monotonic() - started > p.window_s:
+            if healthy_span > p.window_s:
                 # the child ran healthy for a full budget window before
                 # this failure — an isolated blip, not a streak.  Without
                 # the reset, one crash a day would eventually trip
@@ -216,7 +227,7 @@ class Supervisor:
             self.restarts += 1
             time.sleep(backoff)
             backoff = min(backoff * 2, p.backoff_max_s)
-        return rc
+        return 0 if self._stop else rc  # stop() during backoff = clean stop
 
     def stop(self) -> None:
         """Ask run() to terminate the child and return (signal-safe)."""

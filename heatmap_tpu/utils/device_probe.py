@@ -1,0 +1,83 @@
+"""Startup accelerator probe with CPU fallback for CLI entrypoints.
+
+With a remote-attached accelerator (TPU behind a relay), a dead link
+does not raise — the first device operation (even the module-level
+constants in ``heatmap_tpu.engine``) blocks forever.  ``bench.py``
+solved this for the benchmark harness; this is the same discipline for
+the long-running entrypoints (``python -m heatmap_tpu.stream``, the
+demo): probe device init + one tiny jit in a fresh subprocess (a hung
+in-process init can never be retried — the backend lock stays held),
+and on failure pin this process to the CPU backend, loudly, so the
+pipeline starts degraded instead of hanging silently.
+
+Skipped when the operator already chose a backend (``HEATMAP_PLATFORM``),
+when probing is disabled (``HEATMAP_DEVICE_PROBE=0``), or in multi-host
+mode (``HEATMAP_COORDINATOR`` — a fallback decided per-host would
+desync the mesh; the supervisor's failover handles that case from
+outside the process group).
+
+Call ``ensure_reachable_backend()`` BEFORE importing anything that
+touches jax arrays.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import time
+
+log = logging.getLogger("device_probe")
+
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp;"
+    "jax.block_until_ready(jax.jit(lambda v: v + 1)(jnp.zeros(8)));"
+    "d = jax.devices()[0];"
+    "print(f'PROBE_OK {d.platform} {d.device_kind}')"
+)
+
+
+def ensure_reachable_backend(timeout_s: float | None = None,
+                             attempts: int | None = None) -> str:
+    """Probe the default backend; pin CPU if it never answers.
+
+    Returns ``"ok"`` (accelerator answered), ``"fallback"`` (pinned to
+    CPU), or ``"skipped"`` (probe not applicable)."""
+    if (os.environ.get("HEATMAP_PLATFORM")
+            or os.environ.get("HEATMAP_DEVICE_PROBE") == "0"
+            or os.environ.get("HEATMAP_COORDINATOR")):
+        return "skipped"
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("HEATMAP_PROBE_TIMEOUT_S", "90"))
+    if attempts is None:
+        attempts = int(os.environ.get("HEATMAP_PROBE_ATTEMPTS", "1"))
+    for k in range(attempts):
+        try:
+            r = subprocess.run([sys.executable, "-c", _PROBE_SRC],
+                               capture_output=True, text=True,
+                               timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            log.warning("device probe %d/%d: no response in %.0fs",
+                        k + 1, attempts, timeout_s)
+        else:
+            out = r.stdout or ""
+            if "PROBE_OK" in out:
+                if " cpu " in out or out.rstrip().endswith(" cpu"):
+                    return "ok"  # default backend IS cpu; nothing to pin
+                log.info("device probe: %s", out.strip())
+                return "ok"
+            tail = ((r.stderr or "").strip().splitlines() or ["<no output>"])
+            log.warning("device probe %d/%d: backend error: %s",
+                        k + 1, attempts, tail[-1])
+        if k + 1 < attempts:
+            time.sleep(float(os.environ.get("HEATMAP_PROBE_BACKOFF_S", "5")))
+    log.warning(
+        "accelerator unreachable; pinning this process to the CPU backend "
+        "(set HEATMAP_PLATFORM or HEATMAP_DEVICE_PROBE=0 to override)")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # children (multihost workers, supervised restarts) inherit the choice
+    os.environ["HEATMAP_PLATFORM"] = "cpu"
+    return "fallback"

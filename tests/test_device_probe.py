@@ -1,0 +1,69 @@
+"""Startup device probe: dead-backend fallback to CPU, skip conditions,
+and healthy-path no-op (utils/device_probe.py — the CLI counterpart of
+bench.py's _ensure_device discipline)."""
+
+import pytest
+
+from heatmap_tpu.utils import device_probe
+
+
+@pytest.fixture
+def clean_env(monkeypatch):
+    for var in ("HEATMAP_PLATFORM", "HEATMAP_DEVICE_PROBE",
+                "HEATMAP_COORDINATOR"):
+        monkeypatch.delenv(var, raising=False)
+    yield monkeypatch
+    # the fallback path sets HEATMAP_PLATFORM via os.environ directly
+    # (production code, not monkeypatch) — undo it so later tests in the
+    # session don't inherit a pinned platform.  (The jax_platforms config
+    # it also sets is already "cpu" session-wide per conftest.)
+    import os
+
+    os.environ.pop("HEATMAP_PLATFORM", None)
+
+
+def test_skips_when_platform_pinned(clean_env):
+    clean_env.setenv("HEATMAP_PLATFORM", "cpu")
+    assert device_probe.ensure_reachable_backend() == "skipped"
+
+
+def test_skips_when_disabled(clean_env):
+    clean_env.setenv("HEATMAP_DEVICE_PROBE", "0")
+    assert device_probe.ensure_reachable_backend() == "skipped"
+
+
+def test_skips_in_multihost(clean_env):
+    clean_env.setenv("HEATMAP_COORDINATOR", "127.0.0.1:1234")
+    assert device_probe.ensure_reachable_backend() == "skipped"
+
+
+def test_healthy_backend_is_ok(clean_env):
+    """The probe subprocess answering PROBE_OK means no fallback; env
+    stays unpinned.  (In this test env the default backend is the axon
+    plugin, so the real probe would hang — substitute a probe source
+    that answers like a healthy chip.)"""
+    clean_env.setattr(device_probe, "_PROBE_SRC",
+                      "print('PROBE_OK tpu TPU v5 lite')")
+    assert device_probe.ensure_reachable_backend(timeout_s=30) == "ok"
+    import os
+
+    assert "HEATMAP_PLATFORM" not in os.environ
+
+
+def test_dead_backend_falls_back(clean_env):
+    """A probe that hangs past the timeout pins CPU and exports
+    HEATMAP_PLATFORM so children inherit the choice."""
+    clean_env.setattr(device_probe, "_PROBE_SRC",
+                      "import time; time.sleep(3600)")
+    assert device_probe.ensure_reachable_backend(
+        timeout_s=1.0, attempts=1) == "fallback"
+    import os
+
+    assert os.environ["HEATMAP_PLATFORM"] == "cpu"
+
+
+def test_backend_error_falls_back(clean_env):
+    clean_env.setattr(device_probe, "_PROBE_SRC",
+                      "raise RuntimeError('no plugin')")
+    assert device_probe.ensure_reachable_backend(
+        timeout_s=30, attempts=1) == "fallback"

@@ -184,6 +184,33 @@ sys.exit(0 if n >= 3 else 1)
     assert open(log).read().split() == ["-", "-", "-"]
 
 
+def test_wedged_child_still_trips_failover(tmp_path):
+    """A child that only ever wedges (no beacon, killed by the startup
+    grace) must NOT count as healthy — its streak accumulates and
+    failover trips.  (The stall-detection wait itself is not health.)"""
+    log = tmp_path / "launches"
+    body = """
+import os, sys, time
+with open(os.environ["LAUNCH_LOG"], "a") as fh:
+    fh.write(os.environ.get("HEATMAP_PLATFORM", "-") + "\\n")
+if os.environ.get("HEATMAP_PLATFORM") == "cpu":
+    sys.exit(0)
+time.sleep(3600)   # wedged before any beacon
+"""
+    sup = Supervisor(
+        _child(body),
+        RestartPolicy(max_restarts=10, stall_timeout_s=2.0,
+                      startup_grace_s=2.0, window_s=1.0,
+                      failover_after=2, backoff_s=0.05,
+                      backoff_max_s=0.1, term_grace_s=1.0),
+        env={**{k: v for k, v in os.environ.items()
+                if k != "HEATMAP_PLATFORM"}, "LAUNCH_LOG": str(log)},
+        heartbeat_path=str(tmp_path / "hb"), poll_s=0.02)
+    assert sup.run() == 0
+    assert sup.failed_over
+    assert open(log).read().split()[-1] == "cpu"
+
+
 def test_policy_from_env():
     env = {"HEATMAP_SUPERVISE_MAX_RESTARTS": "9",
            "HEATMAP_SUPERVISE_STALL_TIMEOUT_S": "7.5",
