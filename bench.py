@@ -401,7 +401,7 @@ def main() -> dict:
                   file=sys.stderr)
             return max(best, (eps, b, c, im, cp, h3))
 
-        impls = [impl_env] if impl_env else ["sort", "rank"]
+        impls = [impl_env] if impl_env else ["sort", "rank", "probe"]
         # a pinned BENCH_CAP_LOG2 disables the capacity stage (stages 1-2
         # already ran at it); a pinned HEATMAP_H3_IMPL likewise pins the
         # snap stage
@@ -550,6 +550,10 @@ def _fallback_reexec() -> None:
     env.setdefault("BENCH_EVENTS", str(2 * (1 << 20)))
     env.setdefault("BENCH_BATCH", str(1 << 18))
     env.setdefault("BENCH_CHUNK", "4")
+    # measured on this 1-core host (2026-07-31, 2^21 events, bins=64):
+    # rank 239k ev/s vs sort 227k at the shape above; batch 2^17/2^19
+    # within noise.  Keep the CPU fallback pinned to the winner.
+    env.setdefault("HEATMAP_MERGE_IMPL", "rank")
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)],
               env)
 

@@ -58,6 +58,12 @@ FUTURE_WINDOWS = 2048
 # changes.  Override per call with merge_batch(..., impl=...).
 MERGE_IMPL = os.environ.get("HEATMAP_MERGE_IMPL", "sort")
 
+# _merge_probe tunables (resolved once at import, like MERGE_IMPL):
+# probe rounds before the per-batch sort fallback, and the unique-key
+# budget divisor (budget = batch/PROBE_UNIQ_DIV, floor 256).
+PROBE_ROUNDS = int(os.environ.get("HEATMAP_PROBE_ROUNDS", "16"))
+PROBE_UNIQ_DIV = int(os.environ.get("HEATMAP_PROBE_UNIQ_DIV", "8"))
+
 
 class AggParams(NamedTuple):
     """Static parameters of one (resolution, window) aggregation."""
@@ -215,6 +221,10 @@ def merge_batch(
         return _merge_rank(state, ev_hi, ev_lo, ev_ws, ev_speed, ev_lat_deg,
                            ev_lon_deg, ev_ts, ev_valid, watermark_cutoff,
                            params)
+    if impl == "probe":
+        return _merge_probe(state, ev_hi, ev_lo, ev_ws, ev_speed,
+                            ev_lat_deg, ev_lon_deg, ev_ts, ev_valid,
+                            watermark_cutoff, params)
     return _merge_sort(state, ev_hi, ev_lo, ev_ws, ev_speed, ev_lat_deg,
                        ev_lon_deg, ev_ts, ev_valid, watermark_cutoff, params)
 
@@ -322,15 +332,40 @@ def _merge_rank(
     c2 = jnp.full((C,), U32MAX, jnp.uint32).at[st_dst].set(st_lo, mode="drop")
 
     # --- sort the batch only ---------------------------------------------
+    u1, u2, uid_of_event = _sorted_batch_uniques(ev_k1, ev_lo, N)
+
+    state_seg, batch_seg, n_distinct = _route_via_uniques(
+        c1, c2, pos_k, keep, n_keep, u1, u2, uid_of_event, ev_valid, C)
+    return _apply_routing(state, ev_hi, ev_lo, ev_ws, ev_speed, ev_lat_deg,
+                          ev_lon_deg, ev_ts, ev_valid, late, evict, keep,
+                          state_seg, batch_seg, n_distinct, params)
+
+
+def _sorted_batch_uniques(ev_k1, ev_lo, N: int):
+    """Batch sort + dedup: ascending unique (k1, lo) keys padded with
+    (MAX, MAX), and each event's index into them.  THE definition of the
+    sort route — _merge_rank always takes it, _merge_probe falls back to
+    it, and bit-identity between those paths depends on both calling
+    this one function."""
+    U32MAX = jnp.uint32(0xFFFFFFFF)
     orig = jnp.arange(N, dtype=jnp.int32)
     s_k1, s_k2, s_orig = jax.lax.sort((ev_k1, ev_lo, orig), num_keys=2)
     is_start = ((s_k1 != jnp.roll(s_k1, 1))
                 | (s_k2 != jnp.roll(s_k2, 1))).at[0].set(True)
     seg_b = jnp.cumsum(is_start.astype(jnp.int32)) - 1
-
-    # unique batch keys, ascending at the prefix (padding = (MAX, MAX))
     u1 = jnp.full((N,), U32MAX, jnp.uint32).at[seg_b].set(s_k1)
     u2 = jnp.full((N,), U32MAX, jnp.uint32).at[seg_b].set(s_k2)
+    uid_of_event = jnp.zeros((N,), jnp.int32).at[s_orig].set(seg_b)
+    return u1, u2, uid_of_event
+
+
+def _route_via_uniques(c1, c2, pos_k, keep, n_keep, u1, u2, uid_of_event,
+                       ev_valid, C: int):
+    """Shared rank-merge tail: given the compacted sorted slab (c1, c2),
+    the ascending unique batch keys (u1, u2 — any length, (MAX, MAX)
+    padded) and each event's index into them, produce the scatter
+    routing tables (state_seg, batch_seg, n_distinct)."""
+    U32MAX = jnp.uint32(0xFFFFFFFF)
     u_valid = u1 != U32MAX
 
     # --- rank the uniques against the compacted slab ---------------------
@@ -353,9 +388,127 @@ def _merge_rank(
     # --- routing tables ---------------------------------------------------
     state_seg = jnp.where(
         keep, out_state_pos[jnp.clip(pos_k, 0, C - 1)], C)
-    seg_of_orig = jnp.zeros((N,), jnp.int32).at[s_orig].set(seg_b)
-    batch_seg = jnp.where(ev_valid, out_u[seg_of_orig], C)
+    batch_seg = jnp.where(ev_valid, out_u[uid_of_event], C)
     n_distinct = n_keep + jnp.sum(new_i)
+    return state_seg, batch_seg, n_distinct
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def _merge_probe(
+    state: TileState,
+    ev_hi, ev_lo, ev_ws, ev_speed, ev_lat_deg, ev_lon_deg, ev_ts, ev_valid,
+    watermark_cutoff,
+    params: AggParams,
+):
+    """Routing via hash-probe dedup instead of a batch sort.
+
+    The batch sort is the dominant cost of ``rank`` at streaming shapes,
+    yet a batch of N events typically holds ~N/10 distinct (cell,
+    window) keys.  This impl dedups the batch into a 2N-slot linear-
+    probing table with R rounds of gather/scatter (O(R·N) memory traffic
+    — no log²N sorting network), then sorts only a fixed N/PROBE_UNIQ_DIV
+    unique budget and reuses the rank machinery.  On a sort-hostile
+    backend (TPU: lax.sort is ~log²N serial stages) the probe rounds
+    replace ~98 stages with ~PROBE_ROUNDS passes.
+
+    Correctness never depends on the probe converging: if any event is
+    still unplaced after R rounds, or the distinct count exceeds the
+    unique budget, a ``lax.cond`` falls back to the full batch-sort
+    route for THIS batch (same routing-table contract, bit-identical
+    ``_apply_routing`` epilogue).  Tunables: HEATMAP_PROBE_ROUNDS
+    (default 16), HEATMAP_PROBE_UNIQ_DIV (default 8 → budget N/8,
+    floor 256)."""
+    C = state.capacity
+    N = ev_hi.shape[0]
+    U32MAX = jnp.uint32(0xFFFFFFFF)
+    M = 1 << (2 * N - 1).bit_length()        # pow2 table, load <= 0.5
+    U = min(N, max(256, N // PROBE_UNIQ_DIV))
+
+    (late, ev_valid, ev_hi, ev_lo, ev_ws, evict, keep, st_hi, st_lo,
+     st_ws) = _drop_and_evict(state, ev_hi, ev_lo, ev_ws, ev_valid,
+                              watermark_cutoff, params)
+
+    st_k1 = _compress_key(st_hi, st_ws, ~keep, params)
+    ev_k1 = _compress_key(ev_hi, ev_ws, ~ev_valid, params)
+
+    # --- compact the kept state rows (identical to _merge_rank) ----------
+    keep_i = keep.astype(jnp.int32)
+    pos_k = jnp.cumsum(keep_i) - 1
+    n_keep = jnp.sum(keep_i)
+    st_dst = jnp.where(keep, pos_k, C)
+    c1 = jnp.full((C,), U32MAX, jnp.uint32).at[st_dst].set(st_k1, mode="drop")
+    c2 = jnp.full((C,), U32MAX, jnp.uint32).at[st_dst].set(st_lo, mode="drop")
+
+    # --- probe-dedup the batch -------------------------------------------
+    h = ((ev_k1 * jnp.uint32(0x9E3779B9))
+         ^ (ev_lo * jnp.uint32(0x85EBCA6B)))
+    eidx = jnp.arange(N, dtype=jnp.int32)
+
+    def probe_round(_, carry):
+        tk1, tk2, placed, slot, off = carry
+        idx = ((h + off.astype(jnp.uint32))
+               & jnp.uint32(M - 1)).astype(jnp.int32)
+        want = ~placed
+        cur1 = tk1[idx]
+        cur2 = tk2[idx]
+        empty = cur1 == U32MAX
+        mine = want & ~empty & (cur1 == ev_k1) & (cur2 == ev_lo)
+        claim = want & empty
+        # lowest event index wins a contested empty slot; same-key losers
+        # re-check the SAME slot next round (off unchanged) and match it,
+        # different-key losers advance
+        claim_arr = (jnp.full((M,), N, jnp.int32)
+                     .at[jnp.where(claim, idx, M)].min(eidx, mode="drop"))
+        winner = claim & (claim_arr[idx] == eidx)
+        widx = jnp.where(winner, idx, M)
+        tk1 = tk1.at[widx].set(ev_k1, mode="drop")
+        tk2 = tk2.at[widx].set(ev_lo, mode="drop")
+        advance = want & ~empty & ~mine
+        return (tk1, tk2, placed | mine | winner,
+                jnp.where(mine | winner, idx, slot),
+                off + advance.astype(jnp.int32))
+
+    init = (jnp.full((M,), U32MAX, jnp.uint32),
+            jnp.full((M,), U32MAX, jnp.uint32),
+            ~ev_valid,                            # invalid rows never probe
+            jnp.zeros_like(eidx),
+            jnp.zeros_like(eidx))
+    if PROBE_ROUNDS > 0:
+        # round 0 unrolled: under shard_map the fori_loop carry must have
+        # uniform "varying over shards" types, but the fresh tables above
+        # are replicated constants while the loop's outputs depend on the
+        # (sharded) batch.  One unrolled round makes every carry
+        # component batch-derived before the loop sees it.
+        init = probe_round(0, init)
+    tk1, tk2, placed, slot, _ = jax.lax.fori_loop(
+        1, PROBE_ROUNDS, probe_round, init)
+
+    # --- compact + sort only the unique budget ---------------------------
+    occupied = tk1 != U32MAX
+    comp_pos = jnp.cumsum(occupied.astype(jnp.int32)) - 1     # over M slots
+    n_uniq = jnp.sum(occupied.astype(jnp.int32))
+    dst = jnp.where(occupied & (comp_pos < U), comp_pos, U)
+    cu1 = jnp.full((U,), U32MAX, jnp.uint32).at[dst].set(tk1, mode="drop")
+    cu2 = jnp.full((U,), U32MAX, jnp.uint32).at[dst].set(tk2, mode="drop")
+    cid = jnp.arange(U, dtype=jnp.int32)
+    s_u1, s_u2, s_cid = jax.lax.sort((cu1, cu2, cid), num_keys=2)
+    rank_of_compact = jnp.zeros((U,), jnp.int32).at[s_cid].set(cid)
+    compact_of_slot = jnp.clip(comp_pos, 0, U - 1)
+    uid_of_event = rank_of_compact[compact_of_slot[jnp.clip(slot, 0, M - 1)]]
+
+    fallback = jnp.any(ev_valid & ~placed) | (n_uniq > U)
+
+    def probe_route(_):
+        return _route_via_uniques(c1, c2, pos_k, keep, n_keep, s_u1, s_u2,
+                                  uid_of_event, ev_valid & placed, C)
+
+    def sort_route(_):
+        u1, u2, uid = _sorted_batch_uniques(ev_k1, ev_lo, N)
+        return _route_via_uniques(c1, c2, pos_k, keep, n_keep, u1, u2,
+                                  uid, ev_valid, C)
+
+    state_seg, batch_seg, n_distinct = jax.lax.cond(
+        fallback, sort_route, probe_route, None)
     return _apply_routing(state, ev_hi, ev_lo, ev_ws, ev_speed, ev_lat_deg,
                           ev_lon_deg, ev_ts, ev_valid, late, evict, keep,
                           state_seg, batch_seg, n_distinct, params)

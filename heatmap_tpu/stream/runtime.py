@@ -766,8 +766,13 @@ class MicroBatchRuntime:
         try:
             while max_batches is None or n < max_batches:
                 t0 = time.monotonic()
-                self._touch_heartbeat()
                 progressed = self.step_once()
+                # beacon AFTER the step: the first write then proves a
+                # completed step (incl. the first-step compile), so the
+                # supervisor's startup grace stays in force until real
+                # liveness exists — a pre-step beacon would drop it to
+                # stall_timeout_s and get a slow first compile killed
+                self._touch_heartbeat()
                 done = (self._global_live == 0 if self._multiproc
                         else self.source.exhausted)
                 if progressed:

@@ -10,6 +10,7 @@ import pytest
 
 from heatmap_tpu.engine import AggParams, init_state
 from heatmap_tpu.engine.step import (
+    _merge_probe,
     _merge_rank,
     _merge_sort,
     merge_batch,
@@ -21,7 +22,7 @@ P = AggParams(res=8, window_s=300, emit_capacity=256)
 
 
 def run_pair(rng, n_batches=5, n=256, cap=1024, bins=8, cutoff_fn=None,
-             nan_frac=0.1, params=P):
+             nan_frac=0.1, params=P, impl_b=_merge_rank):
     a = init_state(cap, bins)
     b = init_state(cap, bins)
     max_ts = -(2**31)
@@ -33,7 +34,7 @@ def run_pair(rng, n_batches=5, n=256, cap=1024, bins=8, cutoff_fn=None,
         args = (hi, lo, ws, speed, np.degrees(lat.astype(np.float64)),
                 np.degrees(lng.astype(np.float64)), ts, valid, cutoff, params)
         a, ea, ta = _merge_sort(a, *args)
-        b, eb, tb = _merge_rank(b, *args)
+        b, eb, tb = impl_b(b, *args)
         for fa, fb, name in zip(a, b, a._fields):
             np.testing.assert_array_equal(
                 np.asarray(fa), np.asarray(fb), err_msg=f"{name} step {k}")
@@ -99,6 +100,41 @@ def test_env_dispatch(rng):
         n = int(live.sum())
         pairs = list(zip(k1[:n].tolist(), k2[:n].tolist()))
         assert pairs == sorted(pairs) and len(set(pairs)) == n
+
+
+def test_probe_matches_sort_basic(rng):
+    run_pair(rng, impl_b=_merge_probe)
+
+
+def test_probe_matches_sort_with_watermark(rng):
+    run_pair(rng, impl_b=_merge_probe,
+             cutoff_fn=lambda m: m - 600 if m > -2**31 else -2**31)
+
+
+def test_probe_matches_sort_overflow(rng):
+    run_pair(rng, n=512, cap=64, bins=0, impl_b=_merge_probe)
+
+
+def test_probe_matches_sort_many_uniques(rng):
+    """More distinct keys than the probe's unique budget (floor 256):
+    the in-kernel lax.cond fallback must take the sort route and stay
+    bit-identical.  res 12 over a whole city makes nearly every event
+    its own (cell, window) group."""
+    run_pair(rng, n=512, cap=4096, bins=4, nan_frac=0.0,
+             params=AggParams(res=12, window_s=300, emit_capacity=1024),
+             impl_b=_merge_probe)
+
+
+def test_probe_zero_rounds_falls_back(rng):
+    """PROBE_ROUNDS=0 places nothing — every batch takes the fallback
+    route and must still match sort exactly.  (The module constant is
+    read at trace time, so the un-jitted function is traced fresh.)"""
+    with mock.patch("heatmap_tpu.engine.step.PROBE_ROUNDS", 0):
+        import jax
+
+        fresh = jax.jit(_merge_probe.__wrapped__,
+                        static_argnames=("params",))
+        run_pair(rng, impl_b=fresh)
 
 
 @pytest.mark.parametrize("cap,n,picks_rank", [(2048, 128, True),

@@ -169,19 +169,22 @@ def unit_merge(shape: str) -> dict:
 
     _device_ready()
     from heatmap_tpu.engine import init_state
-    from heatmap_tpu.engine.step import _merge_rank, _merge_sort
+    from heatmap_tpu.engine.step import (
+        _merge_probe, _merge_rank, _merge_sort)
 
     batch, cap = {"streaming": (1 << 14, 1 << 17),
                   "backfill": (1 << 17, 1 << 15),
                   "balanced": (1 << 16, 1 << 16)}[shape]
     args = _merge_args(batch)
-    t_sort = _timed(lambda s: _merge_sort(s, *args)[0],
-                    init_state(cap, 16)) * 1e3
-    t_rank = _timed(lambda s: _merge_rank(s, *args)[0],
-                    init_state(cap, 16)) * 1e3
+    times = {}
+    for name, fn in (("sort", _merge_sort), ("rank", _merge_rank),
+                     ("probe", _merge_probe)):
+        times[name] = round(
+            _timed(lambda s, f=fn: f(s, *args)[0],
+                   init_state(cap, 16)) * 1e3, 2)
     return {"shape": shape, "batch": batch, "slab": cap,
-            "sort_ms": round(t_sort, 2), "rank_ms": round(t_rank, 2),
-            "winner": "rank" if t_rank < t_sort else "sort"}
+            **{f"{k}_ms": v for k, v in times.items()},
+            "winner": min(times, key=times.get)}
 
 
 def unit_pull() -> dict:
@@ -484,13 +487,15 @@ def report() -> None:
     merges = [hw[k] for k in ("merge_stream", "merge_backfill",
                               "merge_balanced") if k in hw]
     if merges:
-        lines += ["## Merge fold: sort vs rank crossover", "",
-                  "| shape | batch | slab | sort ms | rank ms | winner |",
-                  "|---|---|---|---|---|---|"]
+        lines += ["## Merge fold: sort vs rank vs probe crossover", "",
+                  "| shape | batch | slab | sort ms | rank ms | probe ms "
+                  "| winner |",
+                  "|---|---|---|---|---|---|---|"]
         for d in merges:
             lines.append(f"| {d['shape']} | {d['batch']:,} | "
                          f"{d['slab']:,} | {d['sort_ms']} | "
-                         f"{d['rank_ms']} | {d['winner']} |")
+                         f"{d['rank_ms']} | {d.get('probe_ms', '—')} | "
+                         f"{d['winner']} |")
         lines += ["", "Decision rule: if rank wins the streaming shape "
                   "and auto's 4x-ratio pick matches the winners, make "
                   "HEATMAP_MERGE_IMPL=auto the process default.", ""]
