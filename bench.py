@@ -552,8 +552,11 @@ def _fallback_reexec() -> None:
     env.setdefault("BENCH_CHUNK", "4")
     # measured on this 1-core host (2026-07-31, 2^21 events, bins=64):
     # rank 239k ev/s vs sort 227k at the shape above; batch 2^17/2^19
-    # within noise.  Keep the CPU fallback pinned to the winner.
-    env.setdefault("HEATMAP_MERGE_IMPL", "rank")
+    # within noise.  Pin the CPU fallback to the winner — but NOT when
+    # the user explicitly asked for an autotune sweep, where a pin would
+    # collapse the impl candidates to this one value.
+    if os.environ.get("BENCH_AUTOTUNE") != "1":
+        env.setdefault("HEATMAP_MERGE_IMPL", "rank")
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)],
               env)
 

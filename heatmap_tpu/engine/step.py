@@ -324,12 +324,7 @@ def _merge_rank(
     ev_k1 = _compress_key(ev_hi, ev_ws, ~ev_valid, params)
 
     # --- compact the kept state rows (stays sorted: subsequence) ---------
-    keep_i = keep.astype(jnp.int32)
-    pos_k = jnp.cumsum(keep_i) - 1                    # target rank per kept row
-    n_keep = jnp.sum(keep_i)
-    st_dst = jnp.where(keep, pos_k, C)
-    c1 = jnp.full((C,), U32MAX, jnp.uint32).at[st_dst].set(st_k1, mode="drop")
-    c2 = jnp.full((C,), U32MAX, jnp.uint32).at[st_dst].set(st_lo, mode="drop")
+    c1, c2, pos_k, n_keep = _compact_state(keep, st_k1, st_lo, C)
 
     # --- sort the batch only ---------------------------------------------
     u1, u2, uid_of_event = _sorted_batch_uniques(ev_k1, ev_lo, N)
@@ -339,6 +334,20 @@ def _merge_rank(
     return _apply_routing(state, ev_hi, ev_lo, ev_ws, ev_speed, ev_lat_deg,
                           ev_lon_deg, ev_ts, ev_valid, late, evict, keep,
                           state_seg, batch_seg, n_distinct, params)
+
+
+def _compact_state(keep, st_k1, st_lo, C: int):
+    """Compact the kept state rows to the slab prefix (stays sorted: a
+    subsequence of a sorted sequence).  THE definition of the compacted
+    (c1, c2) slab both rank and probe routing search against."""
+    U32MAX = jnp.uint32(0xFFFFFFFF)
+    keep_i = keep.astype(jnp.int32)
+    pos_k = jnp.cumsum(keep_i) - 1                # target rank per kept row
+    n_keep = jnp.sum(keep_i)
+    st_dst = jnp.where(keep, pos_k, C)
+    c1 = jnp.full((C,), U32MAX, jnp.uint32).at[st_dst].set(st_k1, mode="drop")
+    c2 = jnp.full((C,), U32MAX, jnp.uint32).at[st_dst].set(st_lo, mode="drop")
+    return c1, c2, pos_k, n_keep
 
 
 def _sorted_batch_uniques(ev_k1, ev_lo, N: int):
@@ -431,13 +440,7 @@ def _merge_probe(
     st_k1 = _compress_key(st_hi, st_ws, ~keep, params)
     ev_k1 = _compress_key(ev_hi, ev_ws, ~ev_valid, params)
 
-    # --- compact the kept state rows (identical to _merge_rank) ----------
-    keep_i = keep.astype(jnp.int32)
-    pos_k = jnp.cumsum(keep_i) - 1
-    n_keep = jnp.sum(keep_i)
-    st_dst = jnp.where(keep, pos_k, C)
-    c1 = jnp.full((C,), U32MAX, jnp.uint32).at[st_dst].set(st_k1, mode="drop")
-    c2 = jnp.full((C,), U32MAX, jnp.uint32).at[st_dst].set(st_lo, mode="drop")
+    c1, c2, pos_k, n_keep = _compact_state(keep, st_k1, st_lo, C)
 
     # --- probe-dedup the batch -------------------------------------------
     h = ((ev_k1 * jnp.uint32(0x9E3779B9))
@@ -454,9 +457,14 @@ def _merge_probe(
         empty = cur1 == U32MAX
         mine = want & ~empty & (cur1 == ev_k1) & (cur2 == ev_lo)
         claim = want & empty
-        # lowest event index wins a contested empty slot; same-key losers
-        # re-check the SAME slot next round (off unchanged) and match it,
-        # different-key losers advance
+        # lowest event index wins a contested empty slot.  ALL losers of
+        # an empty-slot contest re-check the SAME slot next round (off
+        # unchanged): same-key losers then match the installed key;
+        # different-key losers see a foreign key and advance — i.e. an
+        # empty-slot loss costs one stalled round before advancing, so
+        # worst-case placement needs (probe-chain length + contested
+        # rounds), not just the chain length; size PROBE_ROUNDS (and
+        # trust the fallback) accordingly
         claim_arr = (jnp.full((M,), N, jnp.int32)
                      .at[jnp.where(claim, idx, M)].min(eidx, mode="drop"))
         winner = claim & (claim_arr[idx] == eidx)

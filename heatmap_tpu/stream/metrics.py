@@ -32,6 +32,7 @@ class Metrics:
         self.t_start = time.monotonic()
         self.counters: collections.Counter = collections.Counter()
         self.batch_latency = Percentiles()
+        self.freshness = Percentiles()  # emit wall time − newest event ts
         self.spans: dict[str, Percentiles] = collections.defaultdict(Percentiles)
 
     def count(self, name: str, n: int = 1) -> None:
@@ -49,6 +50,9 @@ class Metrics:
         out["events_per_sec"] = round(self.counters.get("events_valid", 0) / elapsed, 1)
         out["batch_latency_p50_ms"] = round(self.batch_latency.quantile(0.5) * 1e3, 3)
         out["batch_latency_p95_ms"] = round(self.batch_latency.quantile(0.95) * 1e3, 3)
+        if self.freshness.samples:
+            out["freshness_p50_s"] = round(self.freshness.quantile(0.5), 3)
+            out["freshness_p95_s"] = round(self.freshness.quantile(0.95), 3)
         for k, p in self.spans.items():
             out[f"span_{k}_p50_ms"] = round(p.quantile(0.5) * 1e3, 3)
         return out

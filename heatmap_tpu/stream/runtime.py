@@ -523,6 +523,13 @@ class MicroBatchRuntime:
                 )
         if batch_max > I32_MIN:
             self.max_event_ts = max(self.max_event_ts, batch_max)
+            # end-to-end freshness at the emit boundary: wall clock now
+            # minus the batch's newest event time.  The reference's
+            # implied budget is ~10s (3s producer poll + 2s trigger + 5s
+            # UI poll, SURVEY.md §3.5); this makes ours observable.
+            # Meaningful for live feeds; replays of old data show the
+            # replay lag, which is itself the honest answer.
+            self.metrics.freshness.add(time.time() - batch_max)
         self._last_pull_s = time.monotonic() - t_flush
 
     def _account_stats(self, res: int, wmin: int, stats,

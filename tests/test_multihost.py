@@ -205,7 +205,11 @@ def test_two_process_sharded_aggregation(tmp_path):
             stderr=subprocess.PIPE)
         for pid in (0, 1)
     ]
-    outs = [p.communicate(timeout=420) for p in procs]
+    # generous budget: the two workers compile + run collectives on ONE
+    # shared CPU core and finish in ~1-2 min idle, but a concurrently
+    # running suite or bench can starve them several-fold — observed
+    # twice as a 420 s timeout while the rest of the suite was green
+    outs = [p.communicate(timeout=900) for p in procs]
     for p, (so, se) in zip(procs, outs):
         assert p.returncode == 0, se.decode()[-2000:]
 

@@ -95,6 +95,11 @@ def test_end_to_end_memory(tmp_path):
     assert all(p["_id"].startswith("mbta|veh-") for p in pos)
     snap = rt.metrics.snapshot()
     assert snap["events_valid"] == 1000
+    # freshness = emit wall time − newest event ts: the events were
+    # stamped T_NOW (≈ now − 600s), so the observed lag must be about
+    # the replay age — present, positive, and not wildly off
+    assert 0 < snap["freshness_p50_s"] < 3600
+    assert snap["freshness_p95_s"] >= snap["freshness_p50_s"]
 
 
 def test_positions_monotonic(tmp_path):
