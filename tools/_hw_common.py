@@ -33,6 +33,23 @@ def rand_latlng(n: int, seed: int = 0):
     return lat, lng
 
 
+def headline_result(device_kind: str, eps: float, info: dict, *, batch: int,
+                    chunk: int, bins=None, emit_cap=None, cap=None) -> dict:
+    """The one schema for a banked headline measurement (consumed by
+    hw_burst --report and bench.py's hw_banked_* carry).  Config knobs
+    are recorded so same-shaped numbers from different tools stay
+    distinguishable in the artifact."""
+    out = {"device": device_kind, "batch": batch, "chunk": chunk,
+           "events_per_sec": round(eps, 1),
+           "mev_per_s": round(eps / 1e6, 3)}
+    for k, v in (("bins", bins), ("emit_cap", emit_cap), ("cap", cap)):
+        if v is not None:
+            out[k] = v
+    out.update({k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in info.items()})
+    return out
+
+
 def merge_fold_args(batch: int, seed: int = 1):
     """The canonical merge-fold input tuple at the Boston streaming
     shape (res 8, 5-min windows, 10-min spread) used by every

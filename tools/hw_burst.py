@@ -108,6 +108,7 @@ def _device_ready() -> None:
 
 # ---------------------------------------------------------------- units
 
+from _hw_common import headline_result  # noqa: E402
 from _hw_common import merge_fold_args as _merge_args  # noqa: E402
 from _hw_common import rand_latlng as _rand_latlng  # noqa: E402
 from _hw_common import timed as _timed  # noqa: E402
@@ -238,11 +239,9 @@ def unit_headline(total=1 << 21, batch=1 << 18, chunk=4,
         flat, res=8, cap=cap, bins=64, emit_cap=1 << 14, batch=batch,
         chunk=chunk, merge_impl="sort", n_events=total,
         pull="prefix" if jax.default_backend() != "cpu" else "full")
-    return {"device": jax.devices()[0].device_kind, "batch": batch,
-            "chunk": chunk, "events_per_sec": round(eps, 1),
-            "mev_per_s": round(eps / 1e6, 3), **{
-                k: (round(v, 4) if isinstance(v, float) else v)
-                for k, v in info.items()}}
+    return headline_result(jax.devices()[0].device_kind, eps, info,
+                           batch=batch, chunk=chunk, bins=64,
+                           emit_cap=1 << 14, cap=cap)
 
 
 def unit_stream_profile() -> dict:
@@ -448,7 +447,8 @@ def report() -> None:
                      f"(each stamped with its own capture time in "
                      f"HW_PROGRESS.json)")
         lines.append("")
-    heads = [(k, hw[k]) for k in ("headline", "headline_big") if k in hw]
+    heads = [(k, hw[k]) for k in ("headline", "headline_big",
+                                  "headline_bench") if k in hw]
     if heads:
         lines += ["## Headline fold throughput (bench.py `_run_config`)",
                   ""]
