@@ -366,10 +366,21 @@ def main() -> dict:
     cand_chunks = ([int(chunk_env)] if chunk_env
                    else ([4, 8, 16] if autotune else [chunk]))
     # size the capture for every config the sweep (or the pinned headline
-    # run) may consume — a one-chunk minimum can exceed BENCH_EVENTS
-    flat = _gen_capture(max(_required_events(n_events, b, c)
-                            for b in cand_batches for c in cand_chunks),
-                        batch)
+    # run) may consume — a one-chunk minimum can exceed BENCH_EVENTS —
+    # including the fixed-shape insurance run below, which otherwise
+    # silently no-ops exactly when env pins a small config
+    sizes = [_required_events(n_events, b, c)
+             for b in cand_batches for c in cand_chunks]
+    if on_accel and pipeline == "backfill":
+        tools_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools")
+        if tools_dir not in sys.path:
+            sys.path.insert(0, tools_dir)
+        from _hw_common import HEADLINE_SHAPE as _HS
+
+        sizes.append(_required_events(min(n_events, 2 * _HS["total"]),
+                                      _HS["batch"], _HS["chunk"]))
+    flat = _gen_capture(max(sizes), batch)
 
     if on_accel and pipeline == "backfill":
         # Bank an early hardware headline BEFORE the long autotune sweep:
@@ -379,13 +390,16 @@ def main() -> dict:
         # into HW_PROGRESS.json; the fallback path carries it as
         # hw_banked_* even if nothing after this line completes.
         try:
-            short = min(n_events, 2 * (1 << 21))
+            short = min(n_events, 2 * _HS["total"])
+            pull0 = pull_env or default_pull
             eps0, inf0 = _run_config(
                 flat, res=res, cap=cap, bins=bins, emit_cap=emit_cap,
-                batch=1 << 18, chunk=4, merge_impl="sort", n_events=short,
-                pull=pull_env or default_pull)
-            _bank_hw_headline(dev, eps0, inf0, batch=1 << 18, chunk=4,
-                              bins=bins, emit_cap=emit_cap, cap=cap)
+                batch=_HS["batch"], chunk=_HS["chunk"],
+                merge_impl=_HS["merge"], n_events=short, pull=pull0)
+            _bank_hw_headline(dev, eps0, inf0, batch=_HS["batch"],
+                              chunk=_HS["chunk"], bins=bins,
+                              emit_cap=emit_cap, cap=cap, res=res,
+                              pull=pull0)
             print(f"# early hardware headline banked: {eps0 / 1e6:.2f}M "
                   f"ev/s", file=sys.stderr)
         except Exception as e:  # noqa: BLE001 - insurance must not kill the run
@@ -525,7 +539,7 @@ def main() -> dict:
         # run fell back to CPU but a hardware headline was banked, carry
         # it in the artifact with provenance so the round still records
         # the measured TPU number.
-        banked = _banked_hw_headline()
+        banked = _banked_hw_headline(res)
         if banked:
             result.update(banked)
     print(json.dumps(result))
@@ -533,7 +547,8 @@ def main() -> dict:
 
 
 def _bank_hw_headline(dev, eps: float, info: dict, batch: int, chunk: int,
-                      bins=None, emit_cap=None, cap=None) -> None:
+                      bins=None, emit_cap=None, cap=None, res=None,
+                      pull=None) -> None:
     """Merge an on-accelerator headline into HW_PROGRESS.json (the burst
     runner's merge-write), so a relay death later in this run still
     leaves a hardware number.  Banked under its OWN unit name — this
@@ -552,7 +567,7 @@ def _bank_hw_headline(dev, eps: float, info: dict, batch: int, chunk: int,
 
     data = headline_result(dev.device_kind, eps, info, batch=batch,
                            chunk=chunk, bins=bins, emit_cap=emit_cap,
-                           cap=cap)
+                           cap=cap, res=res, pull=pull)
     data["_platform"] = dev.platform
     data["_device_kind"] = dev.device_kind
     state = hw_burst._load()
@@ -563,8 +578,13 @@ def _bank_hw_headline(dev, eps: float, info: dict, batch: int, chunk: int,
     hw_burst._save(state)
 
 
-def _banked_hw_headline() -> dict:
-    """Hardware-stamped headline unit from HW_PROGRESS.json, if any."""
+def _banked_hw_headline(res: int = 8) -> dict:
+    """Hardware-stamped headline unit from HW_PROGRESS.json, if any.
+
+    Only entries measured at THIS run's resolution qualify (entries
+    predating the res field default to 8, the units' fixed config) — a
+    res-7 short run is faster per event and must never be published as
+    the res-8 headline."""
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "HW_PROGRESS.json")
     try:
@@ -574,6 +594,8 @@ def _banked_hw_headline() -> dict:
         for name in ("headline", "headline_big", "headline_bench"):
             unit = units.get(name)
             if not unit or unit["data"].get("_platform") == "cpu":
+                continue
+            if unit["data"].get("res", 8) != res:
                 continue
             if (best is None or unit["data"]["events_per_sec"]
                     > best["data"]["events_per_sec"]):

@@ -1,0 +1,150 @@
+"""Burst-runner orchestration: merge-save semantics, hardware-vs-CPU
+completion accounting, attempt budgets, and report rendering
+(tools/hw_burst.py — the component that banks the hardware measurements;
+a silent bug here costs the whole relay-window harvest)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools"))
+import hw_burst  # noqa: E402
+
+
+def _hw(name, eps=1.0):
+    return {"data": {"events_per_sec": eps, "_platform": "axon",
+                     "_device_kind": "TPU v5 lite"}, "ts": name}
+
+
+def _cpu(name):
+    return {"data": {"events_per_sec": 1.0, "_platform": "cpu",
+                     "_device_kind": "cpu"}, "ts": name}
+
+
+@pytest.fixture
+def progress(tmp_path, monkeypatch):
+    path = tmp_path / "HW_PROGRESS.json"
+    monkeypatch.setattr(hw_burst, "PROGRESS", str(path))
+    monkeypatch.delenv("HW_BURST_CPU", raising=False)
+    monkeypatch.delenv("HEATMAP_PLATFORM", raising=False)
+    return path
+
+
+def test_save_keeps_disk_only_units(progress):
+    json.dump({"units": {"pull": _hw("disk")}, "attempts": {"pull": 2},
+               "log": []}, open(progress, "w"))
+    hw_burst._save({"units": {"headline": _hw("mem")},
+                    "attempts": {"headline": 1}, "log": []})
+    out = json.load(open(progress))
+    assert set(out["units"]) == {"pull", "headline"}
+    assert out["attempts"] == {"pull": 2, "headline": 1}
+
+
+def test_save_hardware_beats_cpu(progress):
+    """A concurrently banked hardware result must never be clobbered by
+    this process's CPU dry-run result for the same unit; a hardware
+    result in memory (fresher) wins over hardware on disk."""
+    json.dump({"units": {"a": _hw("disk-hw"), "b": _cpu("disk-cpu")},
+               "attempts": {}, "log": []}, open(progress, "w"))
+    hw_burst._save({"units": {"a": _cpu("mem-cpu"), "b": _hw("mem-hw")},
+                    "attempts": {}, "log": []})
+    out = json.load(open(progress))
+    assert out["units"]["a"]["ts"] == "disk-hw"
+    assert out["units"]["b"]["ts"] == "mem-hw"
+
+
+def test_done_ignores_cpu_results(progress, monkeypatch):
+    state = {"units": {"pull": _cpu("x")}, "attempts": {}, "log": []}
+    assert not hw_burst._done(state, "pull")       # cpu != banked
+    assert not hw_burst._done(state, "headline")   # absent
+    state["units"]["headline"] = _hw("y")
+    assert hw_burst._done(state, "headline")
+    monkeypatch.setenv("HW_BURST_CPU", "1")        # dry-run mode: cpu counts
+    assert hw_burst._done(state, "pull")
+
+
+def _fake_run(results):
+    """subprocess.run stub: pops per-unit outcomes.  'timeout' raises;
+    a dict is JSON-printed with rc 0; 'fail' returns rc 1."""
+    def run(argv, capture_output, text, timeout, cwd):
+        unit = argv[argv.index("--unit") + 1]
+        r = results[unit].pop(0)
+        if r == "timeout":
+            raise subprocess.TimeoutExpired(argv, timeout)
+        class P:
+            pass
+        p = P()
+        if r == "fail":
+            p.returncode, p.stdout, p.stderr = 1, "", "boom"
+        else:
+            p.returncode, p.stdout, p.stderr = 0, json.dumps(r), ""
+        return p
+    return run
+
+
+def test_run_pending_banks_and_stops_on_timeout(progress, monkeypatch):
+    """Results bank as they land; a unit timeout means the relay window
+    closed, so the burst stops instead of burning every attempt."""
+    order = list(hw_burst.UNITS)
+    results = {order[0]: [{"events_per_sec": 9.9, "_platform": "axon",
+                           "_device_kind": "TPU v5 lite"}],
+               order[1]: ["timeout"]}
+    monkeypatch.setattr(hw_burst.subprocess, "run", _fake_run(results))
+    monkeypatch.setattr(hw_burst, "tcp_up", lambda: True)
+    state = hw_burst._load()
+    assert hw_burst.run_pending(state) is False     # stopped at the timeout
+    out = json.load(open(progress))
+    assert order[0] in out["units"]                 # banked before the stop
+    assert out["units"][order[0]]["data"]["events_per_sec"] == 9.9
+    assert out["attempts"][order[1]] == 1           # the attempt was charged
+    assert order[1] not in out["units"]
+
+
+def test_run_pending_respects_attempt_budget(progress, monkeypatch):
+    """A unit out of attempts is skipped without another subprocess."""
+    name, (_, max_att) = next(iter(hw_burst.UNITS.items()))
+    calls = []
+
+    def no_run(argv, **kw):
+        calls.append(argv)
+        raise AssertionError("should not spawn")
+    monkeypatch.setattr(hw_burst.subprocess, "run", no_run)
+    monkeypatch.setattr(hw_burst, "tcp_up", lambda: False)  # stop after skip
+    state = {"units": {}, "attempts": {n: hw_burst.UNITS[n][1]
+                                      for n in hw_burst.UNITS}, "log": []}
+    assert hw_burst.run_pending(state) is False
+    assert calls == []
+
+
+def test_report_renders_all_unit_schemas(progress, tmp_path, monkeypatch):
+    """Old-schema (no batch key), new-schema, and CPU-stamped entries all
+    render; CPU results are excluded from the hardware tables."""
+    monkeypatch.setattr(hw_burst, "ROOT", str(tmp_path))
+    state = {
+        "units": {
+            "headline": {"data": {"events_per_sec": 5e6, "mev_per_s": 5.0,
+                                  "p50_batch_ms": 10.0, "n_active": 1,
+                                  "emitted_rows": 1, "state_overflow": 0,
+                                  "_platform": "axon",
+                                  "_device_kind": "TPU v5 lite"},
+                         "ts": "t"},          # old schema: no batch/chunk
+            "merge_stream": {"data": {"shape": "streaming", "batch": 16384,
+                                      "slab": 131072, "sort_ms": 9.0,
+                                      "rank_ms": 3.0, "winner": "rank",
+                                      "_platform": "axon",
+                                      "_device_kind": "TPU v5 lite"},
+                             "ts": "t"},      # old schema: no probe_ms
+            "pull": _cpu("cpu-dryrun"),
+        },
+        "attempts": {}, "log": [],
+    }
+    json.dump(state, open(progress, "w"))
+    hw_burst.report()
+    md = open(tmp_path / "HARDWARE.md").read()
+    assert "5.0 M ev/s" in md and "batch ? x chunk ?" in md
+    assert "| streaming | 16,384 |" in md and "| 3.0 | — | rank |" in md
+    assert "banked on CPU, excluded: pull" in md

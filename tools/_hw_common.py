@@ -33,16 +33,28 @@ def rand_latlng(n: int, seed: int = 0):
     return lat, lng
 
 
+# The canonical headline measurement shape: hw_burst.unit_headline runs
+# exactly this, and bench.py's early insurance bank mirrors batch/chunk/
+# merge from it so the two stay directly comparable (bins/emit_cap/cap
+# may differ and are recorded per entry).
+HEADLINE_SHAPE = {"total": 1 << 21, "batch": 1 << 18, "chunk": 4,
+                  "cap": 1 << 17, "bins": 64, "emit_cap": 1 << 14,
+                  "merge": "sort"}
+
+
 def headline_result(device_kind: str, eps: float, info: dict, *, batch: int,
-                    chunk: int, bins=None, emit_cap=None, cap=None) -> dict:
+                    chunk: int, bins=None, emit_cap=None, cap=None,
+                    res=None, pull=None) -> dict:
     """The one schema for a banked headline measurement (consumed by
     hw_burst --report and bench.py's hw_banked_* carry).  Config knobs
-    are recorded so same-shaped numbers from different tools stay
+    — including res and the emit-pull discipline — are recorded so
+    same-shaped numbers from different tools/configs stay
     distinguishable in the artifact."""
     out = {"device": device_kind, "batch": batch, "chunk": chunk,
            "events_per_sec": round(eps, 1),
            "mev_per_s": round(eps / 1e6, 3)}
-    for k, v in (("bins", bins), ("emit_cap", emit_cap), ("cap", cap)):
+    for k, v in (("bins", bins), ("emit_cap", emit_cap), ("cap", cap),
+                 ("res", res), ("pull", pull)):
         if v is not None:
             out[k] = v
     out.update({k: (round(v, 4) if isinstance(v, float) else v)

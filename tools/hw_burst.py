@@ -108,7 +108,7 @@ def _device_ready() -> None:
 
 # ---------------------------------------------------------------- units
 
-from _hw_common import headline_result  # noqa: E402
+from _hw_common import HEADLINE_SHAPE, headline_result  # noqa: E402
 from _hw_common import merge_fold_args as _merge_args  # noqa: E402
 from _hw_common import rand_latlng as _rand_latlng  # noqa: E402
 from _hw_common import timed as _timed  # noqa: E402
@@ -220,8 +220,10 @@ def unit_pull() -> dict:
     return {"emit_capacity": E, "lanes": L, "rows": rows}
 
 
-def unit_headline(total=1 << 21, batch=1 << 18, chunk=4,
-                  cap=1 << 17) -> dict:
+def unit_headline(total=HEADLINE_SHAPE["total"],
+                  batch=HEADLINE_SHAPE["batch"],
+                  chunk=HEADLINE_SHAPE["chunk"],
+                  cap=HEADLINE_SHAPE["cap"]) -> dict:
     """Production-shaped fold throughput: bench.py's own `_run_config`,
     without the autotune sweep (too slow for a flap window).  bench.py
     remains the canonical end-of-round harness; this banks a number
@@ -235,13 +237,17 @@ def unit_headline(total=1 << 21, batch=1 << 18, chunk=4,
 
     flat = bench._gen_capture(bench._required_events(total, batch, chunk),
                               batch)
+    pull = "prefix" if jax.default_backend() != "cpu" else "full"
     eps, info = bench._run_config(
-        flat, res=8, cap=cap, bins=64, emit_cap=1 << 14, batch=batch,
-        chunk=chunk, merge_impl="sort", n_events=total,
-        pull="prefix" if jax.default_backend() != "cpu" else "full")
+        flat, res=8, cap=cap, bins=HEADLINE_SHAPE["bins"],
+        emit_cap=HEADLINE_SHAPE["emit_cap"], batch=batch,
+        chunk=chunk, merge_impl=HEADLINE_SHAPE["merge"], n_events=total,
+        pull=pull)
     return headline_result(jax.devices()[0].device_kind, eps, info,
-                           batch=batch, chunk=chunk, bins=64,
-                           emit_cap=1 << 14, cap=cap)
+                           batch=batch, chunk=chunk,
+                           bins=HEADLINE_SHAPE["bins"],
+                           emit_cap=HEADLINE_SHAPE["emit_cap"], cap=cap,
+                           res=8, pull=pull)
 
 
 def unit_stream_profile() -> dict:
@@ -443,7 +449,8 @@ def report() -> None:
     else:
         kind = next(iter(hw.values())).get("_device_kind", "?")
         lines.append(f"device: {kind}  ")
-        lines.append(f"banked units: {len(hw)}/{len(UNITS)} "
+        n_burst_hw = sum(1 for k in hw if k in UNITS)
+        lines.append(f"banked units: {n_burst_hw}/{len(UNITS)} "
                      f"(each stamped with its own capture time in "
                      f"HW_PROGRESS.json)")
         lines.append("")
