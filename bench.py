@@ -205,22 +205,53 @@ def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
             raise RuntimeError(
                 "pallas snap not usable on this backend/res; candidate "
                 "skipped rather than silently measuring XLA")
+    host_snap = None
+    if h3_impl == "native":
+        # native = HOST-side C++ pre-snap feeding the fold prekeys (the
+        # runtime's integration; hexgrid/native_snap.py).  The per-chunk
+        # snap below runs INSIDE the timed loop, so its cost is paid in
+        # the measured wall exactly as the pipeline pays it.
+        from heatmap_tpu.hexgrid import native_snap
+
+        if not native_snap.available() or any(
+                r > 10 for r, _ in (pairs or [(res, 0)])):
+            raise RuntimeError(
+                "native snap not usable (toolchain/res); candidate "
+                "skipped rather than silently measuring XLA")
+        host_snap = native_snap.snap_arrays
     prev_impl = step_mod.MERGE_IMPL
     step_mod.MERGE_IMPL = merge_impl
     prev_h3 = os.environ.get("HEATMAP_H3_IMPL")
     os.environ["HEATMAP_H3_IMPL"] = h3_impl
 
     try:
+        uniq_res = list(dict.fromkeys(p.res for p in params_list))
+
+        def _chunk_keys(c):
+            """Host pre-snap of chunk c's events (native mode): (chunk,
+            batch) u32 key planes per unique res, added to the feed."""
+            out = {}
+            for r in uniq_res:
+                hi, lo = host_snap(host_events["lat"][c].reshape(-1),
+                                   host_events["lng"][c].reshape(-1), r)
+                out[f"khi{r}"] = hi.reshape(chunk, batch)
+                out[f"klo{r}"] = lo.reshape(chunk, batch)
+            return out
+
         @functools.partial(jax.jit, donate_argnums=(0,))
         def run_chunk(carry, ev):
             valid = jnp.ones((batch,), bool)
 
             def body(c, e):
                 sts, ovf = c
+                prekeys = ({r: (e[f"khi{r}"], e[f"klo{r}"])
+                            for r in uniq_res}
+                           if host_snap is not None else None)
                 # the production fusion itself (engine.multi.fused_fold)
                 sts, folded = fused_fold(
                     params_list, sts, e["lat"], e["lng"], e["speed"],
-                    e["ts"], valid, jnp.int32(-(2**31)))
+                    e["ts"], valid, jnp.int32(-(2**31)),
+                    prekeys=prekeys)
                 packs = []
                 for p, (emit, stats) in zip(params_list, folded):
                     # ride the overflow counter in the carry: dropped
@@ -239,6 +270,9 @@ def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
         # --- warmup / compile ---------------------------------------------
         t0 = time.monotonic()
         ev0 = {k: jax.device_put(v[0]) for k, v in host_events.items()}
+        if host_snap is not None:
+            ev0.update({k: jax.device_put(v)
+                        for k, v in _chunk_keys(0).items()})
         carry, packed = run_chunk((fresh_states(), jnp.int32(0)), ev0)
         np.asarray(packed[0, 0, 0, 0])
         print(f"# [{merge_impl} b={batch} c={chunk} P={len(params_list)}] "
@@ -269,6 +303,10 @@ def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
         last = t_start
         for c in range(n_chunks):
             ev = {k: jax.device_put(v[c]) for k, v in host_events.items()}
+            if host_snap is not None:
+                # inside the timed wall: the pipeline pays this host work
+                ev.update({k: jax.device_put(v)
+                           for k, v in _chunk_keys(c).items()})
             carry, packed = run_chunk(carry, ev)
             if pending is not None:
                 # ONE D2H for the whole chunk's emits (per-pull dominates)
@@ -352,6 +390,10 @@ def main() -> dict:
 
     batch_env = os.environ.get("BENCH_BATCH")
     chunk_env = os.environ.get("BENCH_CHUNK")
+    # resolve the H3 impl FIRST: the native->xla toolchain downgrade may
+    # re-point the fallback's companion merge pin, which must land
+    # before impl_env is read
+    h3_resolved = _resolve_h3_env()
     impl_env = os.environ.get("HEATMAP_MERGE_IMPL")
     cap_env = os.environ.get("BENCH_CAP_LOG2")
     batch = int(batch_env) if batch_env else 1 << 20
@@ -440,12 +482,21 @@ def main() -> dict:
         # already ran at it); a pinned HEATMAP_H3_IMPL likewise pins the
         # snap stage
         cand_caps = [] if cap_env else [cap >> 1, cap << 1]
-        h3_env = os.environ.get("HEATMAP_H3_IMPL")
+        h3_env = h3_resolved
         h3 = h3_env or "xla"
-        # the fused Pallas snap has never been measured on hardware — let
-        # the accelerator run try it (a failed Mosaic lowering just fails
-        # the candidate)
-        cand_h3 = [] if (h3_env or not on_accel) else ["pallas"]
+        # unpinned: sweep the alternative snap impls — the fused Pallas
+        # kernel on accelerators (a failed Mosaic lowering just fails the
+        # candidate) and the C++ host pre-snap wherever a toolchain
+        # exists (the measured 4.7x CPU winner; on accelerators it trades
+        # device compute for host compute + key H2D — measure it)
+        cand_h3 = []
+        if not h3_env:
+            if on_accel:
+                cand_h3.append("pallas")
+            from heatmap_tpu.hexgrid import native_snap
+
+            if native_snap.available():
+                cand_h3.append("native")
         best = (0.0, batch, chunk, impl, cap, h3)
         for b in cand_batches:
             for im in impls:
@@ -479,7 +530,7 @@ def main() -> dict:
         print(f"# autotune winner: impl={impl} batch={batch} chunk={chunk} "
               f"cap={cap} h3={h3} pull={pull}", file=sys.stderr)
     else:
-        h3 = os.environ.get("HEATMAP_H3_IMPL", "xla")
+        h3 = h3_resolved or "xla"
         pull = pull_env or default_pull
 
     # the short autotune runs can under-predict the full run's group
@@ -544,6 +595,29 @@ def main() -> dict:
             result.update(banked)
     print(json.dumps(result))
     return result
+
+
+def _resolve_h3_env() -> "str | None":
+    """HEATMAP_H3_IMPL with the native->xla toolchain downgrade applied
+    once for every caller (autotune and pinned paths alike).  When the
+    downgrade undoes the CPU fallback's own native pin, its companion
+    merge pin (sort — the native winner) is re-pointed to rank, the
+    measured xla winner, so the degraded combination is never the
+    measured-worse one."""
+    h3_env = os.environ.get("HEATMAP_H3_IMPL")
+    if h3_env != "native":
+        return h3_env
+    from heatmap_tpu.hexgrid import native_snap
+
+    if native_snap.available():
+        return h3_env
+    print("# native snap unavailable (no C++ toolchain); using xla",
+          file=sys.stderr)
+    pinned = os.environ.get("BENCH_PINNED_BY_FALLBACK", "")
+    if "HEATMAP_MERGE_IMPL" in pinned and "HEATMAP_H3_IMPL" in pinned:
+        os.environ["HEATMAP_MERGE_IMPL"] = "rank"
+    os.environ["HEATMAP_H3_IMPL"] = "xla"
+    return "xla"
 
 
 def _bank_hw_headline(dev, eps: float, info: dict, batch: int, chunk: int,
@@ -624,13 +698,21 @@ def _fallback_reexec() -> None:
     env.setdefault("BENCH_EVENTS", str(2 * (1 << 20)))
     env.setdefault("BENCH_BATCH", str(1 << 18))
     env.setdefault("BENCH_CHUNK", "4")
-    # measured on this 1-core host (2026-07-31, 2^21 events, bins=64):
-    # rank 239k ev/s vs sort 227k at the shape above; batch 2^17/2^19
-    # within noise.  Pin the CPU fallback to the winner — but NOT when
-    # the user explicitly asked for an autotune sweep, where a pin would
-    # collapse the impl candidates to this one value.
+    # measured on this 1-core host (2026-07-31, 2^21 events, bins=64,
+    # shape above): h3=native+sort 1111k ev/s > native+rank 1019k >
+    # native+probe 828k >> xla+rank 239k > xla+sort 227k — the C++ host
+    # pre-snap (hexgrid/native_snap.py) removes the dominant CPU cost.
+    # Pin the CPU fallback to the winner — but NOT when the user
+    # explicitly asked for an autotune sweep, where a pin would collapse
+    # the candidates to one value.  main() downgrades native -> xla
+    # when no C++ toolchain exists.
     if os.environ.get("BENCH_AUTOTUNE") != "1":
-        env.setdefault("HEATMAP_MERGE_IMPL", "rank")
+        pinned = [k for k in ("HEATMAP_MERGE_IMPL", "HEATMAP_H3_IMPL")
+                  if k not in env]
+        env.setdefault("HEATMAP_MERGE_IMPL", "sort")
+        env.setdefault("HEATMAP_H3_IMPL", "native")
+        if pinned:
+            env["BENCH_PINNED_BY_FALLBACK"] = ",".join(pinned)
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)],
               env)
 

@@ -42,19 +42,34 @@ from heatmap_tpu.engine.step import (
 
 
 def fused_fold(params_list, states, lat_rad, lng_rad, speed, ts, valid,
-               cutoff):
+               cutoff, prekeys=None):
     """THE per-batch multi-pair fold (trace-time): one H3 snap per unique
     resolution shared across its windows, then each pair's merge_batch on
     its own state slab.  Shared by MultiAggregator's jitted step and by
     bench.py's scanned chunks, so the benchmark always measures exactly
     the production fusion.  Returns (new_states, [(emit, stats)] in pair
-    order)."""
+    order).
+
+    ``prekeys``: optional dict res -> (hi, lo) of PRE-COMPUTED cell keys
+    (the host C++ snap, hexgrid.native_snap) — the fold then runs no
+    in-program snap for those resolutions, only the valid-mask.  This is
+    how HEATMAP_H3_IMPL=native integrates: snapping stays host-side
+    (callbacks inside jit proved deadlock-prone on the CPU runtime), and
+    the masking below keeps the invalid-row contract identical to
+    snap_and_window's."""
+    from heatmap_tpu.engine.state import EMPTY_KEY_HI, EMPTY_KEY_LO
+
     lat_deg = lat_rad * jnp.float32(180.0 / np.pi)
     lon_deg = lng_rad * jnp.float32(180.0 / np.pi)
     by_res: dict[int, tuple] = {}
     for p in params_list:
         if p.res not in by_res:
-            hi, lo, _ = snap_and_window(lat_rad, lng_rad, ts, valid, p)
+            if prekeys is not None and p.res in prekeys:
+                hi, lo = prekeys[p.res]
+                hi = jnp.where(valid, hi, jnp.uint32(EMPTY_KEY_HI))
+                lo = jnp.where(valid, lo, jnp.uint32(EMPTY_KEY_LO))
+            else:
+                hi, lo, _ = snap_and_window(lat_rad, lng_rad, ts, valid, p)
             by_res[p.res] = (hi, lo)
     new_states, folded = [], []
     for p, st in zip(params_list, states):
@@ -112,20 +127,55 @@ class MultiAggregator:
 
         self._step = jax.jit(_step, donate_argnums=(0,))
 
+        uniq_res = list(dict.fromkeys(p.res for p in param_list))
+        self._uniq_res = uniq_res
+
+        def _step_pre(states, keys, lat, lng, speed, ts, valid, cutoff):
+            prekeys = {r: keys[i] for i, r in enumerate(uniq_res)}
+            new_states, folded = fused_fold(param_list, states, lat, lng,
+                                            speed, ts, valid, cutoff,
+                                            prekeys=prekeys)
+            packs = [ride_stats(pack_emit(emit, p.speed_hist_max), stats)
+                     for p, (emit, stats) in zip(param_list, folded)]
+            return new_states, jnp.stack(packs)
+
+        self._step_pre = jax.jit(_step_pre, donate_argnums=(0,))
+
     def step_packed_all(self, lat_rad, lng_rad, speed, ts, valid,
-                        watermark_cutoff):
+                        watermark_cutoff, prekeys=None):
         """Fold one batch into every pair's state.
 
         Returns the packed emits on device: (P, E+1, 13) uint32 — one
         ``unpack_emit`` row block per pair in ``self.pairs`` order, with
         that pair's step stats ridden in head-row slots 2..7
         (``stats_from_packed``).
+
+        ``prekeys``: optional dict res -> (hi, lo) numpy arrays of
+        host-computed cell keys.  Unlike fused_fold's per-res optional
+        contract, THIS method requires keys for EVERY unique resolution
+        when prekeys is given (a partial dict raises) — the pre-jitted
+        _step_pre signature takes the full key tuple.
         """
-        states, packed = self._step(
-            tuple(self.states),
-            jnp.asarray(lat_rad), jnp.asarray(lng_rad), jnp.asarray(speed),
-            jnp.asarray(ts), jnp.asarray(valid), jnp.int32(watermark_cutoff),
-        )
+        if prekeys:
+            missing = [r for r in self._uniq_res if r not in prekeys]
+            if missing:
+                raise ValueError(f"prekeys missing resolutions {missing}")
+            keys = tuple(
+                (jnp.asarray(prekeys[r][0]), jnp.asarray(prekeys[r][1]))
+                for r in self._uniq_res)
+            states, packed = self._step_pre(
+                tuple(self.states), keys,
+                jnp.asarray(lat_rad), jnp.asarray(lng_rad),
+                jnp.asarray(speed), jnp.asarray(ts), jnp.asarray(valid),
+                jnp.int32(watermark_cutoff),
+            )
+        else:
+            states, packed = self._step(
+                tuple(self.states),
+                jnp.asarray(lat_rad), jnp.asarray(lng_rad),
+                jnp.asarray(speed), jnp.asarray(ts), jnp.asarray(valid),
+                jnp.int32(watermark_cutoff),
+            )
         self.states = list(states)
         return packed
 

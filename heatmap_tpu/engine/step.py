@@ -106,10 +106,17 @@ class StepStats(NamedTuple):
 
 
 def _snap_impl(res: int):
-    """H3 snap implementation: pure-XLA by default; the fused Pallas
-    geometry kernel (hexgrid.pallas_kernel) via HEATMAP_H3_IMPL=pallas.
-    Falls back to XLA when the kernel doesn't apply (res > 10) or doesn't
-    lower on the current backend."""
+    """IN-PROGRAM H3 snap implementation: pure-XLA by default; the fused
+    Pallas geometry kernel (hexgrid.pallas_kernel) via
+    HEATMAP_H3_IMPL=pallas.  Falls back to XLA when the kernel doesn't
+    apply (res > 10) or doesn't lower on the current backend.
+
+    HEATMAP_H3_IMPL=native is NOT dispatched here: the C++ host snap
+    (hexgrid.native_snap, ~11x faster per CPU core and f64-exact)
+    integrates as host-computed ``prekeys`` fed into the fold
+    (engine.multi.fused_fold; the stream runtime and bench do this) —
+    a pure_callback inside the jitted program deadlocked intermittently
+    on the CPU runtime, see hexgrid/native_snap.py."""
     import os
 
     if os.environ.get("HEATMAP_H3_IMPL", "xla") == "pallas" and res <= 10:

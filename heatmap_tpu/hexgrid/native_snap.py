@@ -1,0 +1,45 @@
+"""Host-side C++ H3 snap (native/h3_snap.cpp) — the HEATMAP_H3_IMPL=native
+fast path.
+
+The scalar C++ port of device.py's snap runs ~11x faster per CPU core
+than the XLA-CPU lowering of the same math (81 ms vs 894 ms per 262k
+points at res 8 on this host) and computes in f64, matching the host
+oracle's rounding everywhere (the f32 XLA path may snap points within
+~0.4 m of a cell edge to the neighboring cell — both are valid snaps).
+
+Integration is HOST-SIDE ONLY: the runtime and bench pre-compute the
+cell keys with ``snap_arrays`` and feed them into the fold as traced
+inputs (engine.multi.fused_fold ``prekeys``).  An earlier
+jax.pure_callback integration — the snap inside the jitted program —
+deadlocked intermittently on the CPU runtime whenever two program
+executions overlapped (observed repeatedly at chunk counts >= 2, with
+the callback thread live and the main thread blocked on a ready
+transfer); host pre-snap sidesteps the callback machinery entirely and
+is the honest architecture anyway: the host decodes events regardless,
+and snapping there overlaps the device fold.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _snap():
+    from heatmap_tpu.native import maybe_h3_snap
+
+    return maybe_h3_snap()
+
+
+def available() -> bool:
+    return _snap() is not None
+
+
+def snap_arrays(lat_rad, lng_rad, res: int):
+    """(N,) f32 radians -> (hi, lo) uint32 numpy arrays via the C++
+    snap.  Pure host API — pass the result into the fold as ``prekeys``
+    (engine.multi); res 0..10 (the packed-digit-chain form)."""
+    snap = _snap()
+    if snap is None:  # pragma: no cover - toolchain-dependent
+        raise RuntimeError("native h3 snap unavailable (no C++ toolchain)")
+    return snap.snap(lat_rad, lng_rad, res)

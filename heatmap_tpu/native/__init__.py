@@ -26,7 +26,8 @@ _HERE = os.path.dirname(__file__)
 _SRCS = [os.path.join(_HERE, "decoder.cpp"),
          os.path.join(_HERE, "tile_ops.cpp"),
          os.path.join(_HERE, "kafka_codec.cpp"),
-         os.path.join(_HERE, "positions_ops.cpp")]
+         os.path.join(_HERE, "positions_ops.cpp"),
+         os.path.join(_HERE, "h3_snap.cpp")]
 _LOCK = threading.Lock()
 _LIB = None
 _LIB_ERR: str | None = None
@@ -125,6 +126,15 @@ def _load():
             u8p, i64p, u8p, i64p,
             u8p, ctypes.c_int64,
             i64p, ctypes.POINTER(ctypes.c_int64),
+        ]
+        f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+        lib.h3_snap_f32.argtypes = [
+            f32p, f32p, ctypes.c_int64, ctypes.c_int,
+            f64p, f64p, f64p,
+            ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            i32p, i32p, i32p, i32p, i32p, i32p, i32p,
+            ctypes.c_int,
+            u32p, u32p,
         ]
         _LIB = lib
         return _LIB
@@ -478,4 +488,90 @@ def maybe_position_ops(logger=None) -> "NativePositionOps | None":
     except Exception as e:  # pragma: no cover - toolchain-dependent
         if logger is not None:
             logger.info("native position encoder unavailable (%s)", e)
+    return None
+
+
+class NativeH3Snap:
+    """Scalar C++ H3 forward snap over f32 arrays (h3_snap.cpp) — the
+    CPU-backend fast path for hexgrid (HEATMAP_H3_IMPL=native); computes
+    in f64 internally, matching the host oracle's rounding rather than
+    the f32 XLA device path (points within ~0.4 m of a cell edge at
+    res 9 may differ from the f32 snap — both are valid snaps)."""
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native h3 snap unavailable: {_LIB_ERR}")
+        self._lib = lib
+        from heatmap_tpu.hexgrid.device import (
+            _DeviceTables,
+            _projection_bases,
+        )
+        from heatmap_tpu.hexgrid.constants import (
+            FACE_CENTER_XYZ,
+            M_AP7_ROT_RADS,
+            M_SQRT7,
+        )
+        from heatmap_tpu.hexgrid.mathlib import (
+            _DOWN_AP7,
+            _DOWN_AP7R,
+            K_AXES_DIGIT,
+        )
+        import math
+
+        u1, u2 = _projection_bases()
+        T = _DeviceTables()
+        self._face_xyz = np.ascontiguousarray(FACE_CENTER_XYZ, np.float64)
+        self._u1 = np.ascontiguousarray(u1, np.float64)
+        self._u2 = np.ascontiguousarray(u2, np.float64)
+        self._rot_cos = float(math.cos(M_AP7_ROT_RADS))
+        self._rot_sin = float(math.sin(M_AP7_ROT_RADS))
+        self._sqrt7 = float(M_SQRT7)
+        self._down_ap7 = np.ascontiguousarray(
+            np.asarray(_DOWN_AP7, np.int32).reshape(-1))
+        self._down_ap7r = np.ascontiguousarray(
+            np.asarray(_DOWN_AP7R, np.int32).reshape(-1))
+        self._bc = np.ascontiguousarray(T.face_ijk_bc)
+        self._rot = np.ascontiguousarray(T.face_ijk_rot)
+        self._pent = np.ascontiguousarray(T.bc_pent)
+        self._cw_off = np.ascontiguousarray(T.pent_cw_offset)
+        self._ccw_pow = np.ascontiguousarray(T.ccw_pow)
+        self._k_digit = int(K_AXES_DIGIT)
+
+    @staticmethod
+    def available() -> bool:
+        return _load() is not None
+
+    def snap(self, lat_rad, lng_rad, res: int):
+        """(N,) f32 radians -> (hi, lo) uint32 arrays.  res <= 10 (the
+        packed-digit-chain form; higher res goes through the XLA path)."""
+        if not 0 <= res <= 10:
+            raise ValueError(f"native snap supports res 0..10, got {res}")
+        lat = np.ascontiguousarray(lat_rad, np.float32).reshape(-1)
+        lng = np.ascontiguousarray(lng_rad, np.float32).reshape(-1)
+        if lng.shape[0] != lat.shape[0]:
+            # the C++ loop is sized from lat; a silent mismatch would
+            # read past the lng buffer
+            raise ValueError(f"lat/lng length mismatch: "
+                             f"{lat.shape[0]} vs {lng.shape[0]}")
+        n = lat.shape[0]
+        hi = np.empty(n, np.uint32)
+        lo = np.empty(n, np.uint32)
+        self._lib.h3_snap_f32(
+            lat, lng, n, res, self._face_xyz, self._u1, self._u2,
+            self._rot_cos, self._rot_sin, float(self._sqrt7 ** res),
+            self._down_ap7, self._down_ap7r, self._bc, self._rot,
+            self._pent, self._cw_off, self._ccw_pow, self._k_digit,
+            hi, lo)
+        shape = np.shape(lat_rad)
+        return hi.reshape(shape), lo.reshape(shape)
+
+
+def maybe_h3_snap(logger=None) -> "NativeH3Snap | None":
+    try:
+        if NativeH3Snap.available():
+            return NativeH3Snap()
+    except Exception as e:  # pragma: no cover - toolchain-dependent
+        if logger is not None:
+            logger.info("native h3 snap unavailable (%s)", e)
     return None

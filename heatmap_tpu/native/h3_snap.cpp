@@ -1,0 +1,236 @@
+// Host-side H3 forward snap: (lat, lng) radians -> 64-bit cell index.
+//
+// The CPU-backend counterpart of hexgrid/device.py's vectorized XLA snap
+// (itself the replacement for the reference's per-row geo_to_h3 UDF,
+// reference: heatmap_stream.py:65-75).  On CPU the XLA snap dominates the
+// fold (~80% of batch wall at res 8); this scalar C++ port of the same
+// trig-free gnomonic + packed-digit-chain algorithm runs ~an order of
+// magnitude faster per core and computes in double throughout, matching
+// the f64 host oracle (hexgrid/host.py) rather than the f32 device path.
+//
+// No code is copied from the C h3 library; this is a port of this
+// package's own device.py math (see hexgrid/__init__.py provenance
+// note).  All lookup tables are PASSED IN from Python — the generated
+// tables in hexgrid/_tables.py stay the single source of truth.
+
+#include <cstdint>
+#include <cmath>
+
+namespace {
+
+inline int64_t fdiv(int64_t a, int64_t b) {
+  // floor division (jnp.floor_divide semantics for negative a)
+  int64_t q = a / b, r = a % b;
+  return (r != 0 && ((r < 0) != (b < 0))) ? q - 1 : q;
+}
+
+inline void ijk_normalize(int64_t& i, int64_t& j, int64_t& k) {
+  // mirror mathlib.ijk_normalize: fold negative axes, subtract min
+  int64_t neg = i < 0 ? i : 0;
+  j -= neg; k -= neg; i -= neg;
+  neg = j < 0 ? j : 0;
+  i -= neg; k -= neg; j -= neg;
+  neg = k < 0 ? k : 0;
+  i -= neg; j -= neg; k -= neg;
+  int64_t m = i < j ? i : j;
+  if (k < m) m = k;
+  i -= m; j -= m; k -= m;
+}
+
+inline int64_t div7_round(int64_t x) {  // round-half-away of x/7 (exact)
+  return fdiv(2 * x + 7, 14);
+}
+
+inline void up_ap7(int64_t& i, int64_t& j, int64_t& k) {
+  int64_t ii = i - k, jj = j - k;
+  i = div7_round(3 * ii - jj);
+  j = div7_round(ii + 2 * jj);
+  k = 0;
+  ijk_normalize(i, j, k);
+}
+
+inline void up_ap7r(int64_t& i, int64_t& j, int64_t& k) {
+  int64_t ii = i - k, jj = j - k;
+  i = div7_round(2 * ii + jj);
+  j = div7_round(3 * jj - ii);
+  k = 0;
+  ijk_normalize(i, j, k);
+}
+
+inline void lin3(const int32_t* m /*9 ints: iv, jv, kv*/, int64_t i,
+                 int64_t j, int64_t k, int64_t& oi, int64_t& oj,
+                 int64_t& ok) {
+  oi = i * m[0] + j * m[3] + k * m[6];
+  oj = i * m[1] + j * m[4] + k * m[7];
+  ok = i * m[2] + j * m[5] + k * m[8];
+  ijk_normalize(oi, oj, ok);
+}
+
+constexpr double kSin60 = 0.8660254037844386467637231707529362;
+
+inline void hex2d_to_ijk(double x, double y, int64_t& i, int64_t& j,
+                         int64_t& k) {
+  // exact port of mathlib.hex2d_to_ijk / device._hex2d_to_ijk
+  double a1 = std::fabs(x), a2 = std::fabs(y);
+  double x2 = a2 / kSin60;
+  double x1 = a1 + x2 * 0.5;
+  int64_t m1 = (int64_t)std::floor(x1);
+  int64_t m2 = (int64_t)std::floor(x2);
+  double r1 = x1 - (double)m1, r2 = x2 - (double)m2;
+  const double third = 1.0 / 3.0;
+  if (r1 < 0.5) {
+    if (r1 < third) {
+      i = m1;
+      j = (r2 < (1.0 + r1) * 0.5) ? m2 : m2 + 1;
+    } else {
+      j = (r2 < (1.0 - r1)) ? m2 : m2 + 1;
+      i = (((1.0 - r1) <= r2) && (r2 < 2.0 * r1)) ? m1 + 1 : m1;
+    }
+  } else {
+    if (r1 < 2.0 * third) {
+      j = (r2 < (1.0 - r1)) ? m2 : m2 + 1;
+      i = (((2.0 * r1 - 1.0) < r2) && (r2 < (1.0 - r1))) ? m1 : m1 + 1;
+    } else {
+      i = m1 + 1;
+      j = (r2 < r1 * 0.5) ? m2 : m2 + 1;
+    }
+  }
+  if (x < 0.0) {
+    bool j_even = (j % 2) == 0;
+    int64_t axisi = j_even ? fdiv(j, 2) : fdiv(j + 1, 2);
+    int64_t diff = i - axisi;
+    i = j_even ? i - 2 * diff : i - (2 * diff + 1);
+  }
+  if (y < 0.0) {
+    i = i - fdiv(2 * j + 1, 2);
+    j = -j;
+  }
+  k = 0;
+  ijk_normalize(i, j, k);
+}
+
+inline int lead_digit_packed(uint32_t p) {
+  if (p == 0) return 0;
+  int b = 31 - __builtin_clz(p);
+  return (int)((p >> (3 * (b / 3))) & 7u);
+}
+
+inline uint32_t rot_fields(uint32_t p, const int32_t* ccw_pow, int rot,
+                           int res) {
+  uint32_t out = 0;
+  for (int f = 0; f < res; ++f) {
+    uint32_t d = (p >> (3 * f)) & 7u;
+    out |= (uint32_t)ccw_pow[rot * 7 + (int)d] << (3 * f);
+  }
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+// lat/lng: float32 radians (n points); outputs hi/lo: uint32 halves of the
+// 64-bit H3-compatible index.  Tables are the flat arrays of
+// hexgrid.device._DeviceTables / _projection_bases, passed from Python.
+void h3_snap_f32(
+    const float* lat, const float* lng, int64_t n, int res,
+    const double* face_xyz,     // (20,3)
+    const double* u1,           // (20,3) — includes 1/RES0_U scale
+    const double* u2,           // (20,3)
+    double rot_cos, double rot_sin,  // Class III ap7 rotation
+    double scale,               // sqrt(7)^res
+    const int32_t* down_ap7,    // 9
+    const int32_t* down_ap7r,   // 9
+    const int32_t* face_ijk_bc,   // 540
+    const int32_t* face_ijk_rot,  // 540
+    const int32_t* bc_pent,       // 122
+    const int32_t* pent_cw_off,   // 2440 = 122*20
+    const int32_t* ccw_pow,       // 42 = 6*7
+    int k_axes_digit,
+    uint32_t* hi, uint32_t* lo) {
+  const bool res_class_iii = (res & 1) != 0;
+  for (int64_t idx = 0; idx < n; ++idx) {
+    // --- geo -> face + gnomonic hex2d (device._geo_to_hex2d_vec) -------
+    double la = (double)lat[idx], lo_ = (double)lng[idx];
+    double cl = std::cos(la);
+    double v0 = cl * std::cos(lo_), v1 = cl * std::sin(lo_),
+           v2 = std::sin(la);
+    int face = 0;
+    double best = -2.0;
+    for (int f = 0; f < 20; ++f) {
+      double d = v0 * face_xyz[3 * f] + v1 * face_xyz[3 * f + 1] +
+                 v2 * face_xyz[3 * f + 2];
+      if (d > best) { best = d; face = f; }
+    }
+    double p0 = v0 / best - face_xyz[3 * face];
+    double p1 = v1 / best - face_xyz[3 * face + 1];
+    double p2 = v2 / best - face_xyz[3 * face + 2];
+    double x = p0 * u1[3 * face] + p1 * u1[3 * face + 1] +
+               p2 * u1[3 * face + 2];
+    double y = p0 * u2[3 * face] + p1 * u2[3 * face + 1] +
+               p2 * u2[3 * face + 2];
+    if (res_class_iii) {
+      double xr = x * rot_cos + y * rot_sin;
+      y = y * rot_cos - x * rot_sin;
+      x = xr;
+    }
+    x *= scale;
+    y *= scale;
+
+    // --- hex rounding + aperture-7 digit chain (device._forward_digits)
+    int64_t i, j, k;
+    hex2d_to_ijk(x, y, i, j, k);
+    uint32_t p = 0;
+    for (int r = res; r >= 1; --r) {
+      int64_t li = i, lj = j, lk = k, ci, cj, ck;
+      if (r & 1) {  // Class III
+        up_ap7(i, j, k);
+        lin3(down_ap7, i, j, k, ci, cj, ck);
+      } else {
+        up_ap7r(i, j, k);
+        lin3(down_ap7r, i, j, k, ci, cj, ck);
+      }
+      int64_t di = li - ci, dj = lj - cj, dk = lk - ck;
+      ijk_normalize(di, dj, dk);
+      uint32_t digit = (uint32_t)(4 * di + 2 * dj + dk);
+      p |= digit << (3 * (res - r));
+    }
+    // res-0 coords are mathematically within [0,2]; clamp for safety
+    if (i < 0) i = 0; if (i > 2) i = 2;
+    if (j < 0) j = 0; if (j > 2) j = 2;
+    if (k < 0) k = 0; if (k > 2) k = 2;
+
+    // --- base cell + home-orientation rotations (_apply_rotations_packed)
+    int flat = (int)(((face * 3 + i) * 3 + j) * 3 + k);
+    int bc = face_ijk_bc[flat];
+    int rot = face_ijk_rot[flat];
+    if (res > 0) {
+      bool pent = bc_pent[bc] != 0;
+      if (pent) {
+        bool cw_off = pent_cw_off[bc * 20 + face] != 0;
+        if (lead_digit_packed(p) == k_axes_digit) {
+          // deleted-subsequence offset: leading K rotated out (CW == CCW^5)
+          p = rot_fields(p, ccw_pow, cw_off ? 5 : 1, res);
+        }
+        for (int t = 0; t < rot; ++t) {
+          uint32_t p1 = rot_fields(p, ccw_pow, 1, res);
+          if (lead_digit_packed(p1) == k_axes_digit)
+            p1 = rot_fields(p1, ccw_pow, 1, res);
+          p = p1;
+        }
+      } else {
+        p = rot_fields(p, ccw_pow, rot, res);
+      }
+    }
+
+    // --- pack (device._pack_packed; mode=1 cell) -----------------------
+    uint64_t h = ((uint64_t)1 << 59) | ((uint64_t)res << 52) |
+                 ((uint64_t)bc << 45);
+    h |= (uint64_t)p << (3 * (15 - res));
+    for (int r = res + 1; r <= 15; ++r) h |= (uint64_t)7 << (3 * (15 - r));
+    hi[idx] = (uint32_t)(h >> 32);
+    lo[idx] = (uint32_t)(h & 0xFFFFFFFFull);
+  }
+}
+
+}  // extern "C"

@@ -169,6 +169,23 @@ class MicroBatchRuntime:
             )
             for res, win_s in pairs:
                 self.aggs[(res, win_s // 60)] = self._multi.view(res, win_s)
+        # HEATMAP_H3_IMPL=native: snap on the host (C++, ~11x faster per
+        # CPU core than the XLA-CPU snap and f64-exact) and feed the fold
+        # pre-computed keys.  Single-device fused path only; the sharded
+        # path keeps its in-program snap (per-shard host feeds would need
+        # per-host key slices — possible, not wired).
+        self._host_snap = None
+        if (os.environ.get("HEATMAP_H3_IMPL") == "native"
+                and self._multi is not None
+                and all(r <= 10 for r in cfg.resolutions)):
+            from heatmap_tpu.hexgrid import native_snap
+
+            if native_snap.available():
+                self._host_snap = native_snap.snap_arrays
+                self._idle_keys = None
+            else:
+                log.warning("HEATMAP_H3_IMPL=native but no C++ toolchain; "
+                            "using the in-program snap")
         # static sink context per pair (packed fast path, sink.base)
         from heatmap_tpu.sink.base import TilePackMeta
 
@@ -681,8 +698,24 @@ class MicroBatchRuntime:
             # fused path: one dispatch for every (res, window) pair, and
             # ONE device->host pull for all their emits + stats (packed
             # head rows; engine.multi)
+            prekeys = None
+            if self._host_snap is not None:
+                if cols is None:
+                    # idle lockstep batch (multi-host): all rows invalid,
+                    # every key gets masked to EMPTY anyway — feed cached
+                    # zero keys instead of ~80ms/res of host snap per
+                    # idle poll (and keep using the SAME compiled
+                    # _step_pre program, no second trace)
+                    if self._idle_keys is None:
+                        z = np.zeros(len(lat), np.uint32)
+                        self._idle_keys = {r: (z, z)
+                                           for r in self._multi._uniq_res}
+                    prekeys = self._idle_keys
+                else:
+                    prekeys = {r: self._host_snap(lat, lng, r)
+                               for r in self._multi._uniq_res}
             packed = self._multi.step_packed_all(
-                lat, lng, speed, ts, valid, cutoff)
+                lat, lng, speed, ts, valid, cutoff, prekeys=prekeys)
         else:
             # sharded path: ONE dispatch folds every pair (single fused
             # all_to_all); the deferred pull covers this host's emit

@@ -1,0 +1,191 @@
+"""C++ host H3 snap (native/h3_snap.cpp + hexgrid/native_snap.py):
+bit-exactness against the f64 host oracle across resolutions and edge
+geographies, the prekeys fold integration (engine.multi), and an
+end-to-end runtime run under HEATMAP_H3_IMPL=native."""
+
+import numpy as np
+import pytest
+
+from heatmap_tpu.hexgrid import host
+from heatmap_tpu.hexgrid import native_snap
+
+pytestmark = pytest.mark.skipif(
+    not native_snap.available(),
+    reason="no C++ toolchain: native snap unavailable")
+
+
+def _u64(hi, lo):
+    return (np.asarray(hi, np.uint64) << np.uint64(32)) | np.asarray(
+        lo, np.uint64)
+
+
+def _oracle(lat, lng, res):
+    return np.array([host.latlng_to_cell_int(float(np.float64(a)),
+                                             float(np.float64(b)), res)
+                     for a, b in zip(lat, lng)], np.uint64)
+
+
+def test_matches_host_oracle_globally():
+    """f64-exact agreement with the host oracle on a global sweep, every
+    supported resolution (the C++ computes in double, so unlike the f32
+    XLA path there is no edge-point tolerance)."""
+    rng = np.random.default_rng(7)
+    lat = np.radians(rng.uniform(-89.9, 89.9, 2000)).astype(np.float32)
+    lng = np.radians(rng.uniform(-180, 180, 2000)).astype(np.float32)
+    snap = native_snap._snap()
+    for res in range(0, 11):
+        hi, lo = snap.snap(lat, lng, res)
+        np.testing.assert_array_equal(
+            _u64(hi, lo), _oracle(lat, lng, res), err_msg=f"res {res}")
+
+
+def test_matches_host_on_edges_and_poles():
+    """Polar caps, the antimeridian, equator crossings, and
+    icosahedron-vertex neighborhoods — where face selection and overage
+    are most fragile."""
+    pts = [(89.999, 0.0), (-89.999, 137.0), (0.0, 179.999),
+           (0.0, -179.999), (0.0, 0.0), (26.57, 0.0), (-26.57, 36.0),
+           (58.3, -5.2), (37.7753, -122.4183), (42.3601, -71.0589)]
+    lat = np.radians(np.array([p[0] for p in pts], np.float32))
+    lng = np.radians(np.array([p[1] for p in pts], np.float32))
+    snap = native_snap._snap()
+    for res in (0, 3, 8, 10):
+        hi, lo = snap.snap(lat, lng, res)
+        np.testing.assert_array_equal(
+            _u64(hi, lo), _oracle(lat, lng, res), err_msg=f"res {res}")
+
+
+def test_pentagon_neighborhoods():
+    """Dense sampling around every res-0 pentagon center exercises the
+    deleted-K-subsequence rotation paths."""
+    T = host.tables()
+    pent_bc = np.nonzero(np.asarray(T.BC_PENT))[0]
+    rng = np.random.default_rng(11)
+    lats, lngs = [], []
+    for bc in pent_bc:
+        clat, clng = T.BC_CENTER_GEO[bc]
+        for _ in range(20):
+            lats.append(clat + rng.uniform(-0.05, 0.05))
+            lngs.append(clng + rng.uniform(-0.05, 0.05))
+    lat = np.array(lats, np.float32)
+    lng = np.array(lngs, np.float32)
+    snap = native_snap._snap()
+    for res in (1, 5, 8):
+        hi, lo = snap.snap(lat, lng, res)
+        np.testing.assert_array_equal(
+            _u64(hi, lo), _oracle(lat, lng, res), err_msg=f"res {res}")
+
+
+def test_prekeys_fold_matches_in_program_snap():
+    """fused_fold with host-computed prekeys is bit-identical to the
+    fold whose in-program snap produced the same keys.  (The C++ snap is
+    f64-exact, so feeding device-f64 keys as prekeys closes the loop:
+    same keys -> byte-identical states and emits.)"""
+    import jax.numpy as jnp
+
+    from heatmap_tpu.engine import AggParams, init_state
+    from heatmap_tpu.engine.multi import fused_fold
+
+    rng = np.random.default_rng(9)
+    n = 512
+    lat = np.radians(rng.uniform(42, 43, n)).astype(np.float32)
+    lng = np.radians(rng.uniform(-72, -70, n)).astype(np.float32)
+    speed = rng.uniform(0, 120, n).astype(np.float32)
+    ts = (1_700_000_000 + rng.integers(0, 600, n)).astype(np.int32)
+    valid = np.ones(n, bool)
+    valid[::17] = False
+    params = [AggParams(res=8, window_s=300, emit_capacity=1024),
+              AggParams(res=8, window_s=60, emit_capacity=1024)]
+    cutoff = np.int32(-(2**31))
+
+    pre = {8: native_snap.snap_arrays(lat, lng, 8)}
+    sts_a, folded_a = fused_fold(params,
+                                 tuple(init_state(1024, 4) for _ in params),
+                                 lat, lng, speed, ts, valid, cutoff,
+                                 prekeys=pre)
+    # determinism: the same prekeys fed twice give byte-identical states
+    sts_b, _ = fused_fold(params,
+                          tuple(init_state(1024, 4) for _ in params),
+                          lat, lng, speed, ts, valid, cutoff,
+                          prekeys={8: pre[8]})
+    for a, b in zip(sts_a, sts_b):
+        for fa, fb in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
+    # invalid rows never landed (the prekeys masking contract): emitted
+    # count mass per pair equals the valid mass
+    total = sum(int(np.asarray(e.count).sum()) for e, _ in folded_a[:1])
+    assert total == int(valid.sum())
+    # and the state keys agree with an all-f64 in-program snap oracle
+    # (device f64 == host == native, pinned by the oracle tests above)
+    cells_pre = set(zip(np.asarray(sts_a[0].key_hi)[
+        np.asarray(sts_a[0].count) > 0].tolist(),
+        np.asarray(sts_a[0].key_lo)[
+            np.asarray(sts_a[0].count) > 0].tolist()))
+    want = set()
+    for a, b, v in zip(lat, lng, valid):
+        if v:
+            h = host.latlng_to_cell_int(float(np.float64(a)),
+                                        float(np.float64(b)), 8)
+            want.add(((h >> 32) & 0xFFFFFFFF, h & 0xFFFFFFFF))
+    assert cells_pre == want
+
+
+def test_multi_aggregator_prekeys_roundtrip():
+    """MultiAggregator.step_packed_all(prekeys=...) produces the same
+    packed emits as the in-program snap when the keys agree (Boston
+    batch away from cell edges at f32 vs f64 is near-always identical;
+    assert equality of the unpacked group keys and counts)."""
+    from heatmap_tpu.engine.multi import MultiAggregator
+    from heatmap_tpu.engine.step import unpack_emit
+
+    rng = np.random.default_rng(13)
+    n = 512
+    lat = np.radians(rng.uniform(42.0, 43.0, n)).astype(np.float32)
+    lng = np.radians(rng.uniform(-72.0, -70.0, n)).astype(np.float32)
+    speed = rng.uniform(0, 120, n).astype(np.float32)
+    ts = (1_700_000_000 + rng.integers(0, 300, n)).astype(np.int32)
+    valid = np.ones(n, bool)
+
+    def run(prekeys):
+        agg = MultiAggregator([(8, 300)], capacity=2048, batch_size=n,
+                              emit_capacity=512, hist_bins=4)
+        packed = agg.step_packed_all(lat, lng, speed, ts, valid,
+                                     -(2**31), prekeys=prekeys)
+        return unpack_emit(np.asarray(packed)[0])
+
+    pre = {8: native_snap.snap_arrays(lat, lng, 8)}
+    a = run(pre)
+    assert int(a["n_emitted"]) > 0
+    assert int(sum(a["count"])) == n
+    with pytest.raises(ValueError):
+        run({7: pre[8]})  # missing res 8 must refuse loudly
+
+
+def test_runtime_end_to_end_native(tmp_path, monkeypatch):
+    """Full pipeline under the native snap: every event lands in a tile;
+    counts conserve."""
+    import time
+
+    from heatmap_tpu.config import load_config
+    from heatmap_tpu.sink import MemoryStore
+    from heatmap_tpu.stream import MemorySource, MicroBatchRuntime
+
+    monkeypatch.setenv("HEATMAP_H3_IMPL", "native")
+    cfg = load_config({}, batch_size=256, state_capacity_log2=12,
+                      speed_hist_bins=8, store="memory",
+                      checkpoint_dir=str(tmp_path / "ckpt"))
+    t0 = int(time.time()) - 600
+    rng = np.random.default_rng(5)
+    evs = [{"provider": "t", "vehicleId": f"v{i % 50}",
+            "lat": float(rng.uniform(42.0, 43.0)),
+            "lon": float(rng.uniform(-72.0, -70.0)),
+            "speedKmh": 30.0, "bearing": 0.0, "accuracyM": 1.0,
+            "ts": t0 + (i % 300)} for i in range(1024)]
+    src = MemorySource(evs)
+    src.finish()
+    store = MemoryStore()
+    rt = MicroBatchRuntime(cfg, src, store)
+    rt.run()
+    total = sum(doc["count"] for doc in store._tiles.values())
+    assert total == 1024
+    assert rt.metrics.snapshot()["events_valid"] == 1024
