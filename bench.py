@@ -509,6 +509,15 @@ def main() -> dict:
             best = _try(best[1], best[2], best[3], cp, h3, best)
         for h3i in cand_h3:
             best = _try(best[1], best[2], best[3], best[4], h3i, best)
+        if best[5] != h3:
+            # a different snap impl won: the merge winner was chosen
+            # under the OLD snap, and the best (merge, snap) pairing can
+            # differ (measured: rank wins under xla, sort under native) —
+            # re-try the other merge impls at the winning snap
+            for im in impls:
+                if im != best[3]:
+                    best = _try(best[1], best[2], im, best[4], best[5],
+                                best)
         _, batch, chunk, impl, cap, h3 = best
         # final A/B: the emit-pull discipline on THIS link (same config,
         # alternate mode) — prefix trades a round trip for fewer bytes,
@@ -613,8 +622,10 @@ def _resolve_h3_env() -> "str | None":
         return h3_env
     print("# native snap unavailable (no C++ toolchain); using xla",
           file=sys.stderr)
-    pinned = os.environ.get("BENCH_PINNED_BY_FALLBACK", "")
-    if "HEATMAP_MERGE_IMPL" in pinned and "HEATMAP_H3_IMPL" in pinned:
+    # re-point the companion merge pin whenever the FALLBACK owned it
+    # (sort only wins under native; a user-pinned merge stays untouched)
+    if "HEATMAP_MERGE_IMPL" in os.environ.get("BENCH_PINNED_BY_FALLBACK",
+                                              ""):
         os.environ["HEATMAP_MERGE_IMPL"] = "rank"
     os.environ["HEATMAP_H3_IMPL"] = "xla"
     return "xla"

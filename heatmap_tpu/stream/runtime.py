@@ -694,11 +694,13 @@ class MicroBatchRuntime:
             self.max_event_ts - self.cfg.watermark_minutes * 60
             if self.max_event_ts > I32_MIN else I32_MIN
         )
+        snap_s = 0.0  # host pre-snap wall (native impl, fused path only)
         if self._multi is not None:
             # fused path: one dispatch for every (res, window) pair, and
             # ONE device->host pull for all their emits + stats (packed
             # head rows; engine.multi)
             prekeys = None
+            t_snap0 = time.monotonic()
             if self._host_snap is not None:
                 if cols is None:
                     # idle lockstep batch (multi-host): all rows invalid,
@@ -712,8 +714,22 @@ class MicroBatchRuntime:
                                            for r in self._multi._uniq_res}
                     prekeys = self._idle_keys
                 else:
-                    prekeys = {r: self._host_snap(lat, lng, r)
-                               for r in self._multi._uniq_res}
+                    # snap only the live prefix: the build pads the feed
+                    # shape with invalid suffix rows whose keys are
+                    # masked to EMPTY anyway — an underfilled poll (100
+                    # events in a 2^17 feed) must not pay the full-batch
+                    # snap per resolution
+                    nz = np.flatnonzero(valid)
+                    n_live = int(nz[-1]) + 1 if nz.size else 0
+                    prekeys = {}
+                    for r in self._multi._uniq_res:
+                        hi = np.zeros(len(lat), np.uint32)
+                        lo = np.zeros(len(lat), np.uint32)
+                        if n_live:
+                            hi[:n_live], lo[:n_live] = self._host_snap(
+                                lat[:n_live], lng[:n_live], r)
+                        prekeys[r] = (hi, lo)
+            snap_s = time.monotonic() - t_snap0
             packed = self._multi.step_packed_all(
                 lat, lng, speed, ts, valid, cutoff, prekeys=prekeys)
         else:
@@ -749,7 +765,10 @@ class MicroBatchRuntime:
                 # this batch's own dispatch — the split that shows whether
                 # checkpoint/pull work ever gaps the step loop
                 "pull": pull_s,
-                "device": (t_device - t_build) - pull_s,
+                # host pre-snap (HEATMAP_H3_IMPL=native) is host work
+                # billed separately from the device dispatch it precedes
+                "snap": snap_s,
+                "device": (t_device - t_build) - pull_s - snap_s,
                 "sink_submit": t_end - t_device,
             },
         )

@@ -69,6 +69,7 @@ UNITS: dict[str, tuple[int, int]] = {
     "merge_backfill": (300, 4),
     "merge_balanced": (300, 4),
     "headline_big": (600, 4),
+    "headline_native": (600, 4),
     "stream_profile": (600, 4),
 }
 
@@ -223,7 +224,7 @@ def unit_pull() -> dict:
 def unit_headline(total=HEADLINE_SHAPE["total"],
                   batch=HEADLINE_SHAPE["batch"],
                   chunk=HEADLINE_SHAPE["chunk"],
-                  cap=HEADLINE_SHAPE["cap"]) -> dict:
+                  cap=HEADLINE_SHAPE["cap"], h3="xla") -> dict:
     """Production-shaped fold throughput: bench.py's own `_run_config`,
     without the autotune sweep (too slow for a flap window).  bench.py
     remains the canonical end-of-round harness; this banks a number
@@ -242,12 +243,14 @@ def unit_headline(total=HEADLINE_SHAPE["total"],
         flat, res=8, cap=cap, bins=HEADLINE_SHAPE["bins"],
         emit_cap=HEADLINE_SHAPE["emit_cap"], batch=batch,
         chunk=chunk, merge_impl=HEADLINE_SHAPE["merge"], n_events=total,
-        pull=pull)
-    return headline_result(jax.devices()[0].device_kind, eps, info,
-                           batch=batch, chunk=chunk,
-                           bins=HEADLINE_SHAPE["bins"],
-                           emit_cap=HEADLINE_SHAPE["emit_cap"], cap=cap,
-                           res=8, pull=pull)
+        h3_impl=h3, pull=pull)
+    out = headline_result(jax.devices()[0].device_kind, eps, info,
+                          batch=batch, chunk=chunk,
+                          bins=HEADLINE_SHAPE["bins"],
+                          emit_cap=HEADLINE_SHAPE["emit_cap"], cap=cap,
+                          res=8, pull=pull)
+    out["h3"] = h3
+    return out
 
 
 def unit_stream_profile() -> dict:
@@ -296,6 +299,10 @@ UNIT_FNS = {
     "headline": unit_headline,
     "headline_big": lambda: unit_headline(total=1 << 23, batch=1 << 20,
                                           chunk=4, cap=1 << 18),
+    # host C++ pre-snap + key H2D instead of the on-chip snap: on an
+    # accelerator this trades device compute for host work + transfer;
+    # only a measurement says which wins on this attachment
+    "headline_native": lambda: unit_headline(h3="native"),
     "snap_xla_r7": lambda: unit_snap_xla(7),
     "snap_xla_r8": lambda: unit_snap_xla(8),
     "snap_xla_r9": lambda: unit_snap_xla(9),
