@@ -225,16 +225,28 @@ def _decode_lanes(packed):
 
 
 def _sharded_step_body(params_list: tuple[AggParams, ...], n_shards: int,
-                       cap: int, states, lat, lng, speed, ts, valid, cutoff):
+                       cap: int, states, lat, lng, speed, ts, valid, cutoff,
+                       prekeys=None):
     """Per-device body run under shard_map: every pair in one program,
-    every pair's exchange in ONE all_to_all."""
+    every pair's exchange in ONE all_to_all.
+
+    ``prekeys``: optional dict res -> (hi, lo) of host-precomputed cell
+    keys for this shard's rows (HEATMAP_H3_IMPL=native — see
+    engine.multi.fused_fold); masking keeps the invalid-row contract
+    identical to snap_and_window's."""
     lat_deg = lat * jnp.float32(180.0 / np.pi)
     lon_deg = lng * jnp.float32(180.0 / np.pi)
     # one snap per unique resolution, shared across its windows
     snapped = {}
     for p in params_list:
         if p.res not in snapped:
-            hi, lo, _ = snap_and_window(lat, lng, ts, valid, p)
+            if prekeys is not None and p.res in prekeys:
+                hi = jnp.where(valid, prekeys[p.res][0],
+                               jnp.uint32(EMPTY_KEY_HI))
+                lo = jnp.where(valid, prekeys[p.res][1],
+                               jnp.uint32(EMPTY_KEY_LO))
+            else:
+                hi, lo, _ = snap_and_window(lat, lng, ts, valid, p)
             snapped[p.res] = (hi, lo)
 
     blocks, n_lates, n_drops = [], [], []
@@ -398,6 +410,27 @@ class ShardedAggregator:
                           out_specs=(states_specs, spec2)),
             donate_argnums=(0,),
         )
+
+        # prekeys variant: host-precomputed (hi, lo) planes per unique
+        # resolution ride as extra sharded args (HEATMAP_H3_IMPL=native)
+        uniq_res = list(dict.fromkeys(p.res for p in self.params_list))
+        self._uniq_res = uniq_res
+
+        def body_packed_pre(states, lat, lng, speed, ts, valid, cutoff,
+                            *keys):
+            prekeys = {r: (keys[2 * i], keys[2 * i + 1])
+                       for i, r in enumerate(uniq_res)}
+            states, emits, packed, stats = body(
+                states, lat, lng, speed, ts, valid, cutoff,
+                prekeys=prekeys)
+            return states, packed
+
+        in_specs_pre = in_specs + tuple([spec1] * (2 * len(uniq_res)))
+        self._step_packed_pre = jax.jit(
+            jax.shard_map(body_packed_pre, mesh=mesh, in_specs=in_specs_pre,
+                          out_specs=(states_specs, spec2)),
+            donate_argnums=(0,),
+        )
         self._in_sharding = shard1
 
     # --- compat aliases (single-pair callers: tests, dryrun) ---------------
@@ -424,7 +457,7 @@ class ShardedAggregator:
         return emits[0], stats[0]
 
     def step_packed(self, lat_rad, lng_rad, speed, ts, valid,
-                    watermark_cutoff):
+                    watermark_cutoff, prekeys=None):
         """Single-transfer variant: folds the batch into every pair's
         state and returns the global packed emit array,
         (n_shards * n_pairs * (E+1), 13) uint32 sharded over the mesh —
@@ -432,12 +465,29 @@ class ShardedAggregator:
         in its head row.  Pull this host's rows with
         ``multihost.addressable_rows`` and decode with
         ``unpack_emit_shards(rows, E, n_pairs)`` (the streaming runtime's
-        hot path)."""
-        states, packed = self._step_packed(
-            tuple(self.states), *self._puts(lat_rad, lng_rad, speed, ts,
-                                            valid),
-            jnp.int32(watermark_cutoff),
-        )
+        hot path).
+
+        ``prekeys``: optional dict res -> (hi, lo) numpy arrays of
+        host-precomputed cell keys for THIS host's local rows (same
+        local-slice convention as lat_rad); required for EVERY unique
+        resolution when given (a partial dict raises)."""
+        if prekeys:
+            missing = [r for r in self._uniq_res if r not in prekeys]
+            if missing:
+                raise ValueError(f"prekeys missing resolutions {missing}")
+            key_arrays = [a for r in self._uniq_res for a in prekeys[r]]
+            states, packed = self._step_packed_pre(
+                tuple(self.states), *self._puts(lat_rad, lng_rad, speed,
+                                                ts, valid),
+                jnp.int32(watermark_cutoff),
+                *self._puts(*key_arrays),
+            )
+        else:
+            states, packed = self._step_packed(
+                tuple(self.states), *self._puts(lat_rad, lng_rad, speed,
+                                                ts, valid),
+                jnp.int32(watermark_cutoff),
+            )
         self.states = list(states)
         return packed
 

@@ -171,18 +171,17 @@ class MicroBatchRuntime:
                 self.aggs[(res, win_s // 60)] = self._multi.view(res, win_s)
         # HEATMAP_H3_IMPL=native: snap on the host (C++, ~11x faster per
         # CPU core than the XLA-CPU snap and f64-exact) and feed the fold
-        # pre-computed keys.  Single-device fused path only; the sharded
-        # path keeps its in-program snap (per-shard host feeds would need
-        # per-host key slices — possible, not wired).
+        # pre-computed keys — both paths: the fused single-device step
+        # (engine.multi prekeys) and the sharded step (each host snaps
+        # its LOCAL slice; parallel.sharded prekeys).
         self._host_snap = None
+        self._idle_keys = None
         if (os.environ.get("HEATMAP_H3_IMPL") == "native"
-                and self._multi is not None
                 and all(r <= 10 for r in cfg.resolutions)):
             from heatmap_tpu.hexgrid import native_snap
 
             if native_snap.available():
                 self._host_snap = native_snap.snap_arrays
-                self._idle_keys = None
             else:
                 log.warning("HEATMAP_H3_IMPL=native but no C++ toolchain; "
                             "using the in-program snap")
@@ -222,6 +221,27 @@ class MicroBatchRuntime:
                 f"{cfg.checkpoint_dir}/p{jax.process_index()}")
             self._gpair = _make_global_pair(mesh)
             self._global_live = 1.0
+            # cross-host agreement on the native host snap: hosts with
+            # and without the C++ toolchain would dispatch DIFFERENT
+            # jitted programs (_step_packed_pre vs _step_packed) into the
+            # same lockstep collectives — and even benignly, f64-exact
+            # C++ keys on one host vs f32 XLA keys on another would make
+            # tile membership depend on which host ingested the event.
+            # One startup collective (run unconditionally: an env var
+            # skewed across hosts must not desync the barrier itself)
+            # keeps the choice all-or-nothing.
+            have, total, _ = self._gpair(
+                1.0 if self._host_snap is not None else 0.0, 1.0)
+            if self._host_snap is not None and have != total:
+                log.warning(
+                    "HEATMAP_H3_IMPL=native disabled: only %d/%d shards "
+                    "have the C++ toolchain — a split would desync the "
+                    "lockstep programs", int(have), int(total))
+                self._host_snap = None
+            elif self._host_snap is None and have > 0:
+                log.warning(
+                    "peer hosts requested the native snap but this host "
+                    "can't provide it; all hosts fall back to in-program")
 
         # the pair whose stats define the batch-level counters
         self._primary = (
@@ -694,42 +714,15 @@ class MicroBatchRuntime:
             self.max_event_ts - self.cfg.watermark_minutes * 60
             if self.max_event_ts > I32_MIN else I32_MIN
         )
-        snap_s = 0.0  # host pre-snap wall (native impl, fused path only)
+        # host pre-snap (HEATMAP_H3_IMPL=native), shared by both paths
+        agg_ = self._multi if self._multi is not None else self._sharded
+        t_snap0 = time.monotonic()
+        prekeys = self._presnap(lat, lng, valid, cols, agg_._uniq_res)
+        snap_s = time.monotonic() - t_snap0
         if self._multi is not None:
             # fused path: one dispatch for every (res, window) pair, and
             # ONE device->host pull for all their emits + stats (packed
             # head rows; engine.multi)
-            prekeys = None
-            t_snap0 = time.monotonic()
-            if self._host_snap is not None:
-                if cols is None:
-                    # idle lockstep batch (multi-host): all rows invalid,
-                    # every key gets masked to EMPTY anyway — feed cached
-                    # zero keys instead of ~80ms/res of host snap per
-                    # idle poll (and keep using the SAME compiled
-                    # _step_pre program, no second trace)
-                    if self._idle_keys is None:
-                        z = np.zeros(len(lat), np.uint32)
-                        self._idle_keys = {r: (z, z)
-                                           for r in self._multi._uniq_res}
-                    prekeys = self._idle_keys
-                else:
-                    # snap only the live prefix: the build pads the feed
-                    # shape with invalid suffix rows whose keys are
-                    # masked to EMPTY anyway — an underfilled poll (100
-                    # events in a 2^17 feed) must not pay the full-batch
-                    # snap per resolution
-                    nz = np.flatnonzero(valid)
-                    n_live = int(nz[-1]) + 1 if nz.size else 0
-                    prekeys = {}
-                    for r in self._multi._uniq_res:
-                        hi = np.zeros(len(lat), np.uint32)
-                        lo = np.zeros(len(lat), np.uint32)
-                        if n_live:
-                            hi[:n_live], lo[:n_live] = self._host_snap(
-                                lat[:n_live], lng[:n_live], r)
-                        prekeys[r] = (hi, lo)
-            snap_s = time.monotonic() - t_snap0
             packed = self._multi.step_packed_all(
                 lat, lng, speed, ts, valid, cutoff, prekeys=prekeys)
         else:
@@ -738,7 +731,7 @@ class MicroBatchRuntime:
             # shards AND the replicated stats for all pairs (packed head
             # rows; parallel.sharded)
             packed = self._sharded.step_packed(lat, lng, speed, ts, valid,
-                                               cutoff)
+                                               cutoff, prekeys=prekeys)
         self._pending = (packed, self.epoch)
         if self._carry_cols is None:
             # offsets only advance once EVERY row of the polled records has
@@ -797,6 +790,32 @@ class MicroBatchRuntime:
             self._ckpt_due = False
             self._checkpoint()
         return progressed
+
+    def _presnap(self, lat, lng, valid, cols, uniq_res):
+        """Host C++ cell keys for this batch (HEATMAP_H3_IMPL=native), or
+        None for the in-program snap.  Idle lockstep batches (cols is
+        None, all rows invalid — the keys get masked to EMPTY anyway)
+        feed cached zero keys so multi-host idle polls pay no snap, and
+        only the LIVE PREFIX of a padded feed is snapped (an underfilled
+        poll must not pay the full-batch cost per resolution)."""
+        if self._host_snap is None:
+            return None
+        if cols is None:
+            if self._idle_keys is None:
+                z = np.zeros(len(lat), np.uint32)
+                self._idle_keys = {r: (z, z) for r in uniq_res}
+            return self._idle_keys
+        nz = np.flatnonzero(valid)
+        n_live = int(nz[-1]) + 1 if nz.size else 0
+        prekeys = {}
+        for r in uniq_res:
+            hi = np.zeros(len(lat), np.uint32)
+            lo = np.zeros(len(lat), np.uint32)
+            if n_live:
+                hi[:n_live], lo[:n_live] = self._host_snap(
+                    lat[:n_live], lng[:n_live], r)
+            prekeys[r] = (hi, lo)
+        return prekeys
 
     def _touch_heartbeat(self) -> None:
         """Liveness beacon for stream.supervisor: overwrite the file named

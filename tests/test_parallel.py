@@ -206,3 +206,32 @@ def test_sharded_grow_preserves_state(mesh, rng):
     for k, g in got.items():
         w = oracle.groups[k]
         assert g[0] == w[0], (k, g, w)
+
+
+def test_step_packed_prekeys_matches_in_program_snap(mesh, rng):
+    """Host-precomputed cell keys (HEATMAP_H3_IMPL=native's sharded
+    integration) fed through step_packed(prekeys=...) must produce
+    byte-identical packed emits to the in-program snap.  Feeding the
+    XLA snap's own keys as prekeys isolates the plumbing: same keys in,
+    so any difference is a routing/masking bug."""
+    from heatmap_tpu.hexgrid.device import latlng_to_cell_vec
+    from heatmap_tpu.parallel import multihost
+
+    agg_a = ShardedAggregator(mesh, PARAMS, capacity_per_shard=1024,
+                              batch_size=1024)
+    agg_b = ShardedAggregator(mesh, PARAMS, capacity_per_shard=1024,
+                              batch_size=1024)
+    for b in range(2):
+        lat, lng, speed, ts, valid = make_batch(
+            rng, 1024, t0=1_700_000_000 + b * 120, nan_frac=0.2)
+        hi, lo = latlng_to_cell_vec(lat, lng, PARAMS.res)
+        pre = {PARAMS.res: (np.asarray(hi), np.asarray(lo))}
+        p_a = agg_a.step_packed(lat, lng, speed, ts, valid, -2**31)
+        p_b = agg_b.step_packed(lat, lng, speed, ts, valid, -2**31,
+                                prekeys=pre)
+        np.testing.assert_array_equal(
+            multihost.addressable_rows(p_a),
+            multihost.addressable_rows(p_b), err_msg=f"batch {b}")
+    with pytest.raises(ValueError):
+        agg_b.step_packed(lat, lng, speed, ts, valid, -2**31,
+                          prekeys={7: pre[PARAMS.res]})
