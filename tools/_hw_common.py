@@ -62,6 +62,27 @@ def headline_result(device_kind: str, eps: float, info: dict, *, batch: int,
     return out
 
 
+def merge_impl_times(batch: int, cap: int, hist_bins: int = 16) -> dict:
+    """Time every merge-fold impl at one (batch, slab) shape — THE
+    shared measurement both hw_burst's merge units and validate_on_tpu's
+    merge bench report, so the tools cannot drift on what they compare.
+    Returns {impl_name: ms}."""
+    from heatmap_tpu.engine import init_state
+    from heatmap_tpu.engine.step import (
+        _merge_probe,
+        _merge_rank,
+        _merge_sort,
+    )
+
+    args = merge_fold_args(batch)
+    out = {}
+    for name, fn in (("sort", _merge_sort), ("rank", _merge_rank),
+                     ("probe", _merge_probe)):
+        out[name] = timed(lambda s, f=fn: f(s, *args)[0],
+                          init_state(cap, hist_bins)) * 1e3
+    return out
+
+
 def merge_fold_args(batch: int, seed: int = 1):
     """The canonical merge-fold input tuple at the Boston streaming
     shape (res 8, 5-min windows, 10-min spread) used by every

@@ -167,23 +167,14 @@ def unit_snap_pallas(res: int) -> dict:
 
 
 def unit_merge(shape: str) -> dict:
-    import jax
-
     _device_ready()
-    from heatmap_tpu.engine import init_state
-    from heatmap_tpu.engine.step import (
-        _merge_probe, _merge_rank, _merge_sort)
+    from _hw_common import merge_impl_times
 
     batch, cap = {"streaming": (1 << 14, 1 << 17),
                   "backfill": (1 << 17, 1 << 15),
                   "balanced": (1 << 16, 1 << 16)}[shape]
-    args = _merge_args(batch)
-    times = {}
-    for name, fn in (("sort", _merge_sort), ("rank", _merge_rank),
-                     ("probe", _merge_probe)):
-        times[name] = round(
-            _timed(lambda s, f=fn: f(s, *args)[0],
-                   init_state(cap, 16)) * 1e3, 2)
+    times = {k: round(v, 2) for k, v in
+             merge_impl_times(batch, cap).items()}
     return {"shape": shape, "batch": batch, "slab": cap,
             **{f"{k}_ms": v for k, v in times.items()},
             "winner": min(times, key=times.get)}

@@ -7,7 +7,7 @@ shows a real TPU; it writes `HARDWARE.md` at the repo root with:
 
 1. Pallas vs XLA H3 snap micro-bench (and whether Mosaic lowers at all),
    per resolution 7/8/9.
-2. Merge-fold impl crossover (sort vs rank) at the streaming shape
+2. Merge-fold impl crossover (sort vs rank vs probe) at the streaming shape
    (slab >> batch) and the backfill shape (batch >= slab) — decides
    whether HEATMAP_MERGE_IMPL=auto should become the process default.
 3. Emit-pull discipline (full vs live-prefix transfers) on this link —
@@ -30,7 +30,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 # timing loop + canonical bench inputs shared with tools/hw_burst.py so
 # the one-shot and burst-banked numbers measure the same thing
-from _hw_common import merge_fold_args, rand_latlng  # noqa: E402
+from _hw_common import rand_latlng  # noqa: E402
 from _hw_common import timed as _timed  # noqa: E402
 
 REPORT = os.path.join(os.path.dirname(__file__), os.pardir, "HARDWARE.md")
@@ -80,35 +80,26 @@ def snap_bench(lines: list, quick: bool) -> None:
 
 
 def merge_bench(lines: list, quick: bool) -> None:
-    import jax
+    from _hw_common import merge_impl_times
 
-    from heatmap_tpu.engine import init_state
-    from heatmap_tpu.engine.step import _merge_rank, _merge_sort
-
-    lines.append("## Merge fold: sort vs rank crossover\n")
-    lines.append("| shape | batch | slab | sort ms | rank ms | winner |")
-    lines.append("|---|---|---|---|---|---|")
+    lines.append("## Merge fold: sort vs rank vs probe crossover\n")
+    lines.append("| shape | batch | slab | sort ms | rank ms | probe ms "
+                 "| winner |")
+    lines.append("|---|---|---|---|---|---|---|")
     shapes = [("streaming", 1 << 14, 1 << 17), ("backfill", 1 << 17, 1 << 15)]
     if not quick:
         shapes.append(("balanced", 1 << 16, 1 << 16))
     for name, batch, cap in shapes:
-        args = merge_fold_args(batch)
-        st = init_state(cap, 16)
-
-        def run_sort(s):
-            return _merge_sort(s, *args)[0]
-
-        def run_rank(s):
-            return _merge_rank(s, *args)[0]
-
-        t_sort = _timed(run_sort, st) * 1e3
-        t_rank = _timed(run_rank, init_state(cap, 16)) * 1e3
-        lines.append(f"| {name} | {batch:,} | {cap:,} | {t_sort:.2f} | "
-                     f"{t_rank:.2f} | "
-                     f"{'rank' if t_rank < t_sort else 'sort'} |")
-    lines.append("\nDecision rule: if rank wins the streaming shape and "
-                 "auto's 4x-ratio pick matches the winners, make "
-                 "HEATMAP_MERGE_IMPL=auto the process default.\n")
+        t = merge_impl_times(batch, cap)
+        winner = min(t, key=t.get)
+        lines.append(f"| {name} | {batch:,} | {cap:,} | {t['sort']:.2f} | "
+                     f"{t['rank']:.2f} | {t['probe']:.2f} | {winner} |")
+    lines.append("\nDecision rule: make the streaming-shape winner the "
+                 "process default — if rank wins and auto's 4x-ratio "
+                 "pick matches, prefer HEATMAP_MERGE_IMPL=auto; if probe "
+                 "wins (the expected TPU outcome — it removes the batch "
+                 "sort, rank's dominant cost there), set "
+                 "HEATMAP_MERGE_IMPL=probe.\n")
 
 
 def pull_bench(lines: list, quick: bool) -> None:
