@@ -663,6 +663,12 @@ def _bank_hw_headline(dev, eps: float, info: dict, batch: int, chunk: int,
     hw_burst._save(state)
 
 
+def _progress_path() -> str:
+    """HW_PROGRESS.json next to this file (patchable seam for tests)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "HW_PROGRESS.json")
+
+
 def _banked_hw_headline(res: int = 8) -> dict:
     """Hardware-stamped headline unit from HW_PROGRESS.json, if any.
 
@@ -670,10 +676,8 @@ def _banked_hw_headline(res: int = 8) -> dict:
     predating the res field default to 8, the units' fixed config) — a
     res-7 short run is faster per event and must never be published as
     the res-8 headline."""
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "HW_PROGRESS.json")
     try:
-        with open(path, encoding="utf-8") as fh:
+        with open(_progress_path(), encoding="utf-8") as fh:
             units = json.load(fh)["units"]
         best = None
         for name in ("headline", "headline_big", "headline_bench"):

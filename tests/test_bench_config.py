@@ -1,0 +1,67 @@
+"""bench.py's `_run_config` — the function every headline/autotune/
+insurance measurement runs through — must work at tiny shapes for each
+snap impl and for the fused multi-pair pipelines (smoke: the round-end
+artifact depends on this path)."""
+
+import importlib.util
+import os
+
+import pytest
+
+_spec = importlib.util.spec_from_file_location(
+    "bench_under_test",
+    os.path.join(os.path.dirname(__file__), os.pardir, "bench.py"))
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+def _native_available():
+    from heatmap_tpu.hexgrid import native_snap
+
+    return native_snap.available()
+
+
+@pytest.mark.parametrize("h3", [
+    "xla",
+    pytest.param("native", marks=pytest.mark.skipif(
+        not _native_available(), reason="no C++ toolchain")),
+])
+def test_run_config_small(h3):
+    flat = bench._gen_capture(bench._required_events(4096, 1024, 2), 1024)
+    eps, info = bench._run_config(
+        flat, res=8, cap=1 << 12, bins=8, emit_cap=1024, batch=1024,
+        chunk=2, merge_impl="rank", n_events=4096, h3_impl=h3, pull="full")
+    assert eps > 0
+    assert info["state_overflow"] == 0
+    assert info["emitted_rows"] > 0
+    assert info["n_active"] > 0
+
+
+@pytest.mark.skipif(not _native_available(), reason="no C++ toolchain")
+def test_run_config_multi_pair_native():
+    """The fused hex-pyramid shape (BASELINE #4) through the prekeys
+    path: every unique res pre-snapped on the host."""
+    pairs = [(7, 300), (8, 300), (9, 300)]
+    flat = bench._gen_capture(bench._required_events(4096, 1024, 2), 1024)
+    eps, info = bench._run_config(
+        flat, res=8, cap=1 << 12, bins=8, emit_cap=1024, batch=1024,
+        chunk=2, merge_impl="sort", n_events=4096, h3_impl="native",
+        pull="full", pairs=pairs)
+    assert eps > 0
+    assert info["state_overflow"] == 0
+
+
+def test_banked_headline_res_filter(tmp_path, monkeypatch):
+    """_banked_hw_headline only carries entries measured at the current
+    resolution (a res-7 short run must never be published as the res-8
+    headline)."""
+    import json
+
+    path = tmp_path / "HW_PROGRESS.json"
+    monkeypatch.setattr(bench, "_progress_path", lambda: str(path))
+    path.write_text(json.dumps({"units": {"headline_bench": {
+        "data": {"events_per_sec": 9e6, "res": 7, "_platform": "axon",
+                 "_device_kind": "TPU v5 lite"}, "ts": "t"}}}))
+    assert bench._banked_hw_headline(8) == {}
+    got = bench._banked_hw_headline(7)
+    assert got["hw_banked_events_per_sec"] == 9e6

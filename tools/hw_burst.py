@@ -110,7 +110,6 @@ def _device_ready() -> None:
 # ---------------------------------------------------------------- units
 
 from _hw_common import HEADLINE_SHAPE, headline_result  # noqa: E402
-from _hw_common import merge_fold_args as _merge_args  # noqa: E402
 from _hw_common import rand_latlng as _rand_latlng  # noqa: E402
 from _hw_common import timed as _timed  # noqa: E402
 
