@@ -710,7 +710,10 @@ def _fallback_reexec() -> None:
     env = dict(os.environ)
     env["BENCH_DEVICE_FALLBACK"] = "1"
     env["JAX_PLATFORMS"] = "cpu"
-    env.setdefault("BENCH_EVENTS", str(2 * (1 << 20)))
+    # 8 chunks at the fallback shape: enough chunk-wall samples for a
+    # meaningful p50 (2M events gave only two), still ~15 s total on
+    # this 1-core host at the measured ~1M ev/s
+    env.setdefault("BENCH_EVENTS", str(8 * (1 << 20)))
     env.setdefault("BENCH_BATCH", str(1 << 18))
     env.setdefault("BENCH_CHUNK", "4")
     # measured on this 1-core host (2026-07-31, 2^21 events, bins=64,
