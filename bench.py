@@ -332,11 +332,25 @@ def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
     eps = total / wall
     chunk_walls.sort()
     p50_batch = chunk_walls[len(chunk_walls) // 2] / chunk * 1e3
+    # --- roofline statement (VERDICT r3 item 7) -----------------------
+    # The fold is sort/HBM-bound, so achieved memory bandwidth — not MFU
+    # — is the honest utilization metric.  The model is a FLOOR: per
+    # batch, every impl must at minimum read the batch inputs (4 f32/i32
+    # lanes, + 2 u32 key lanes per unique res when host-pre-snapped) and
+    # read+write each pair's live slab once (12 scalar lanes + Kahan
+    # comp 4 + hist bins, 4 B each).  Sorts and emit packing move more;
+    # achieved/peak therefore UNDERSTATES true traffic.
+    row_bytes = (12 + 4 + bins) * 4
+    feed_bytes = batch * (16 + (8 * len({p.res for p in params_list})
+                                if host_snap is not None else 0))
+    per_batch_bytes = len(params_list) * 2 * cap * row_bytes + feed_bytes
     info = {
         "total": total, "wall": wall, "n_chunks": n_chunks,
         "n_batches": n_batches, "p50_batch_ms": p50_batch,
         "n_active": n_active, "emitted_rows": emitted_rows,
         "state_overflow": state_overflow,
+        "modeled_bytes_per_event": per_batch_bytes / batch,
+        "hbm_gbps_achieved": per_batch_bytes * n_batches / wall / 1e9,
     }
     return eps, info
 
@@ -592,6 +606,14 @@ def main() -> dict:
         "baseline_note": "denominator = 5M ev/s design target "
                          "(BASELINE.json north star); reference publishes "
                          "no measured baseline",
+        # roofline statement: the fold is HBM-bound, so judge the device
+        # number against memory bandwidth (v5e ~819 GB/s, this CPU ~10s
+        # of GB/s), not MFU.  Floor model — see _run_config.
+        "modeled_bytes_per_event": round(info["modeled_bytes_per_event"], 1),
+        "hbm_gbps_achieved": round(info["hbm_gbps_achieved"], 2),
+        "roofline_note": "floor model: batch feed + 2x slab row traffic "
+                         "per pair per batch; sorts/emits move more, so "
+                         "this understates true bytes",
     }
     if dev.platform == "cpu":
         # The relay flaps (up for ~minutes at a time); tools/hw_burst.py
@@ -680,7 +702,9 @@ def _banked_hw_headline(res: int = 8) -> dict:
         with open(_progress_path(), encoding="utf-8") as fh:
             units = json.load(fh)["units"]
         best = None
-        for name in ("headline", "headline_big", "headline_bench"):
+        best_name = None
+        for name in ("micro", "headline", "headline_big",
+                     "headline_bench"):
             unit = units.get(name)
             if not unit or unit["data"].get("_platform") == "cpu":
                 continue
@@ -688,7 +712,7 @@ def _banked_hw_headline(res: int = 8) -> dict:
                 continue
             if (best is None or unit["data"]["events_per_sec"]
                     > best["data"]["events_per_sec"]):
-                best = unit
+                best, best_name = unit, name
         if best is None:
             return {}
         data = best["data"]
@@ -696,6 +720,12 @@ def _banked_hw_headline(res: int = 8) -> dict:
             "hw_banked_events_per_sec": data["events_per_sec"],
             "hw_banked_device": data.get("_device_kind", "?"),
             "hw_banked_at": best.get("ts", "?"),
+            # units differ in batch/chunk shape — publish the winner's
+            # config with its number so a big-batch result can't
+            # masquerade as the round-comparable headline
+            "hw_banked_unit": best_name,
+            "hw_banked_batch": data.get("batch"),
+            "hw_banked_chunk": data.get("chunk"),
             "hw_banked_note": "measured on hardware during a relay uptime "
                               "window (by tools/hw_burst.py or an earlier "
                               "bench attempt); this run itself fell back "

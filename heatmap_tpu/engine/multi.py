@@ -156,7 +156,7 @@ class MultiAggregator:
         when prekeys is given (a partial dict raises) — the pre-jitted
         _step_pre signature takes the full key tuple.
         """
-        if prekeys:
+        if prekeys is not None:
             missing = [r for r in self._uniq_res if r not in prekeys]
             if missing:
                 raise ValueError(f"prekeys missing resolutions {missing}")

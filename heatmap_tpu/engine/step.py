@@ -52,15 +52,26 @@ I32_MIN = jnp.int32(-(2**31))
 # sort-key compression, see merge_batch).
 FUTURE_WINDOWS = 2048
 
-# Merge-fold routing (sort|rank|auto), resolved ONCE at import so every
-# program in the process — fused aggregator traces and direct merge_batch
-# calls alike — uses the same implementation regardless of later env
-# changes.  Override per call with merge_batch(..., impl=...).
-MERGE_IMPL = os.environ.get("HEATMAP_MERGE_IMPL", "sort")
+# Merge-fold routing (sort|rank|probe|auto).  ``MERGE_IMPL`` is the
+# process-wide OVERRIDE slot (bench sweeps and tests assign it); when it
+# is None — the normal state — HEATMAP_MERGE_IMPL is read at TRACE time
+# by _resolve_merge_impl(), so a library user who sets the env var after
+# importing this module is honored rather than silently served the
+# import-time snapshot (round-3 advisor footgun).  All impls are
+# bit-identical by construction and differential test, so programs
+# traced before and after an env change still agree on results.
+MERGE_IMPL: "str | None" = None
 
-# _merge_probe tunables (resolved once at import, like MERGE_IMPL):
-# probe rounds before the per-batch sort fallback, and the unique-key
-# budget divisor (budget = batch/PROBE_UNIQ_DIV, floor 256).
+
+def _resolve_merge_impl() -> str:
+    return (MERGE_IMPL if MERGE_IMPL is not None
+            else os.environ.get("HEATMAP_MERGE_IMPL", "sort"))
+
+# _merge_probe tunables (resolved once at import — they only shape the
+# probe impl's internal loop, not results, and tests patch the module
+# constants directly): probe rounds before the per-batch sort fallback,
+# and the unique-key budget divisor (budget = batch/PROBE_UNIQ_DIV,
+# floor 256).
 PROBE_ROUNDS = int(os.environ.get("HEATMAP_PROBE_ROUNDS", "16"))
 PROBE_UNIQ_DIV = int(os.environ.get("HEATMAP_PROBE_UNIQ_DIV", "8"))
 
@@ -217,11 +228,11 @@ def merge_batch(
     (latency-oriented streaming configs).  ``auto`` picks by the measured
     crossover: rank when capacity >= 4x batch (both shapes benched on
     CPU, see ROADMAP.md — to be re-confirmed on chip).  The env var is
-    resolved once at import (module constant ``MERGE_IMPL``) so fused
-    aggregator programs and direct calls can never mix implementations;
-    pass ``impl`` explicitly to override."""
+    read at trace time (module override slot ``MERGE_IMPL`` wins when
+    set — bench sweeps and tests use it); pass ``impl`` explicitly to
+    override per call."""
     if impl is None:
-        impl = MERGE_IMPL
+        impl = _resolve_merge_impl()
     if impl == "auto":
         impl = "rank" if state.capacity >= 4 * ev_hi.shape[0] else "sort"
     if impl == "rank":

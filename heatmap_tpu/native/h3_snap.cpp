@@ -152,6 +152,11 @@ void h3_snap_f32(
   for (int64_t idx = 0; idx < n; ++idx) {
     // --- geo -> face + gnomonic hex2d (device._geo_to_hex2d_vec) -------
     double la = (double)lat[idx], lo_ = (double)lng[idx];
+    // Non-finite coords (NaN-filled invalid rows inside the live prefix)
+    // would reach UB double->int64 casts in the digit chain and could
+    // pack digit 7, driving rot_fields past the 42-entry ccw_pow table.
+    // Their outputs are masked downstream, so pin them to (0,0) here.
+    if (!std::isfinite(la) || !std::isfinite(lo_)) { la = 0.0; lo_ = 0.0; }
     double cl = std::cos(la);
     double v0 = cl * std::cos(lo_), v1 = cl * std::sin(lo_),
            v2 = std::sin(la);

@@ -471,7 +471,7 @@ class ShardedAggregator:
         host-precomputed cell keys for THIS host's local rows (same
         local-slice convention as lat_rad); required for EVERY unique
         resolution when given (a partial dict raises)."""
-        if prekeys:
+        if prekeys is not None:
             missing = [r for r in self._uniq_res if r not in prekeys]
             if missing:
                 raise ValueError(f"prekeys missing resolutions {missing}")

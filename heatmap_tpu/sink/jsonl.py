@@ -12,7 +12,7 @@ import json
 import os
 from typing import Sequence
 
-from heatmap_tpu.sink.base import UTC
+from heatmap_tpu.sink.base import Store, UTC
 from heatmap_tpu.sink.memory import MemoryStore
 
 _DT_FIELDS = ("windowStart", "windowEnd", "staleAt", "ts")
@@ -72,17 +72,29 @@ class JsonlStore(MemoryStore):
         self._append("positions", docs)
         return n
 
+    def upsert_tiles_packed(self, body, meta) -> int:
+        # NOT MemoryStore's lazy packed banking: this store's durability
+        # contract is the append-only op log, so packed rows must decode
+        # to docs NOW and hit the log via upsert_tiles (Store's portable
+        # default does exactly that).  Positions need no override:
+        # MemoryStore doesn't intercept them, so Store's default already
+        # routes through this class's logging upsert_positions.
+        return Store.upsert_tiles_packed(self, body, meta)
+
     def flush(self) -> None:
         self._fh.flush()
 
     def close(self) -> None:
         self._fh.close()
-        # compact: rewrite the live view only
+        # compact: rewrite the live view only.  Iterate the underlying
+        # doc dicts, NOT the ._tiles/._positions properties — those
+        # re-acquire self._lock (non-reentrant) and would deadlock here.
         tmp = self.path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as fh:
             with self._lock:
-                for d in self._tiles.values():
+                self._compact_tiles()
+                for d in self._tile_docs.values():
                     fh.write(json.dumps({"c": "tiles", "doc": _enc(d)}) + "\n")
-                for d in self._positions.values():
+                for d in self._pos_docs.values():
                     fh.write(json.dumps({"c": "positions", "doc": _enc(d)}) + "\n")
         os.replace(tmp, self.path)

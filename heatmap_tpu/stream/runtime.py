@@ -109,6 +109,8 @@ class MicroBatchRuntime:
         self._ckpt_due = False  # cadence hit while mid-carry; commit ASAP
         self._last_pull_s = 0.0  # wall of the most recent deferred pull
         self._n_active_peak = 0  # max live groups (any pair) since startup
+        self._step_began = None  # monotonic start of the in-flight step
+        self._hb_watchdog = None  # in-flight beacon thread (lazy, daemon)
         self._cap_max = 1 << (cfg.state_max_log2
                               or cfg.state_capacity_log2 + 4)
 
@@ -176,13 +178,22 @@ class MicroBatchRuntime:
         # its LOCAL slice; parallel.sharded prekeys).
         self._host_snap = None
         self._idle_keys = None
-        if (os.environ.get("HEATMAP_H3_IMPL") == "native"
-                and all(r <= 10 for r in cfg.resolutions)):
+        h3_impl = os.environ.get("HEATMAP_H3_IMPL", "auto")
+        # auto: on the CPU backend the C++ host pre-snap is the measured
+        # winner (round-3 autotune on this host: native+sort 1.11M ev/s
+        # vs xla+sort 0.23M — the in-program snap dominates the batch);
+        # on accelerators stay with the in-program snap until a hardware
+        # measurement (tools/hw_burst.py headline_native unit) says
+        # otherwise.
+        want_native = (h3_impl == "native" or
+                       (h3_impl == "auto"
+                        and jax.default_backend() == "cpu"))
+        if want_native and all(r <= 10 for r in cfg.resolutions):
             from heatmap_tpu.hexgrid import native_snap
 
             if native_snap.available():
                 self._host_snap = native_snap.snap_arrays
-            else:
+            elif h3_impl == "native":
                 log.warning("HEATMAP_H3_IMPL=native but no C++ toolchain; "
                             "using the in-program snap")
         # static sink context per pair (packed fast path, sink.base)
@@ -674,8 +685,12 @@ class MicroBatchRuntime:
     # ------------------------------------------------------------------
     def step_once(self) -> bool:
         """Run one micro-batch; returns False when the source yielded nothing."""
-        with self.tracer.batch(self.epoch):
-            return self._step_once_inner()
+        self._step_began = time.monotonic()
+        try:
+            with self.tracer.batch(self.epoch):
+                return self._step_once_inner()
+        finally:
+            self._step_began = None
 
     def _step_once_inner(self) -> bool:
         t0 = time.monotonic()
@@ -848,11 +863,37 @@ class MicroBatchRuntime:
         if now - getattr(self, "_hb_last", 0.0) < 1.0:
             return
         self._hb_last = now
+        self._hb_write(path)
+        if getattr(self, "_hb_watchdog", None) is None:
+            # First beacon == first completed step: only now start the
+            # in-flight watchdog, so the supervisor's startup grace stays
+            # in force through the first compile (an earlier watchdog
+            # tick would count as the first beacon and drop the limit to
+            # stall_timeout_s).  The watchdog keeps the beacon alive
+            # while a step is IN FLIGHT, but only up to
+            # HEATMAP_DISPATCH_GRACE_S (default 300 s): a legitimate
+            # mid-run recompile (slab growth retrace, post-failover
+            # retrace) outlives stall_timeout_s without being killed,
+            # while a truly wedged device RPC goes quiet once the grace
+            # lapses and still trips the supervisor.
+            self._hb_stop = threading.Event()
+            self._hb_watchdog = threading.Thread(
+                target=self._hb_watchdog_loop, args=(path,), daemon=True)
+            self._hb_watchdog.start()
+
+    def _hb_write(self, path: str) -> None:
         try:
             with open(path, "w", encoding="utf-8") as fh:
                 fh.write(f"{time.time():.3f} epoch={self.epoch}\n")
         except OSError:  # beacon must never take the pipeline down
             pass
+
+    def _hb_watchdog_loop(self, path: str) -> None:
+        grace = float(os.environ.get("HEATMAP_DISPATCH_GRACE_S", "300"))
+        while not self._hb_stop.wait(1.0):
+            began = getattr(self, "_step_began", None)
+            if began is not None and time.monotonic() - began < grace:
+                self._hb_write(path)
 
     def run(self, max_batches: int | None = None) -> None:
         """Drive the loop until the source is exhausted (or forever)."""
@@ -886,6 +927,8 @@ class MicroBatchRuntime:
 
     def close(self) -> None:
         self.tracer.stop()  # flush a partial profiler capture, if any
+        if getattr(self, "_hb_stop", None) is not None:
+            self._hb_stop.set()
         try:
             try:
                 # drain any carry so the exit commit is record-aligned.

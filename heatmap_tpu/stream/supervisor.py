@@ -57,6 +57,14 @@ class RestartPolicy(NamedTuple):
     window_s: float = 300.0
     backoff_s: float = 1.0
     backoff_max_s: float = 30.0
+    # After the first beacon, a beacon gap past this declares a stall.
+    # Legitimate LONG device ops mid-run (slab-growth retrace, post-
+    # failover recompile) are covered by the runtime's in-flight beacon
+    # watchdog (runtime._hb_watchdog_loop), which keeps the beacon alive
+    # while a step is dispatching for up to HEATMAP_DISPATCH_GRACE_S
+    # (default 300 s) — so only an op that outlives BOTH that grace and
+    # this timeout is killed.  Raise HEATMAP_DISPATCH_GRACE_S (child
+    # env) rather than this if recompiles are routinely slower.
     stall_timeout_s: float = 120.0
     # grace before the FIRST beacon: the child's first step traces and
     # compiles the whole streaming program, which on a remote-attached
@@ -106,7 +114,16 @@ class Supervisor:
         self.poll_s = poll_s
         self.restarts = 0            # total child launches after the first
         self.failed_over = False
-        self._stop = False
+        # A plain bool, NOT a threading.Event: stop() runs inside signal
+        # handlers (supervise_cli), and Event.set() acquires the Event's
+        # non-reentrant Condition lock — which the interrupted main
+        # thread holds in the prologue/epilogue of every wait(), so a
+        # badly-timed signal would self-deadlock the supervisor.  A bool
+        # store is async-signal-safe; responsiveness comes from _wait()
+        # sleeping in poll_s slices (a signal interrupts time.sleep, the
+        # handler sets the flag, PEP 475 resumes the <=poll_s remainder,
+        # and the slice loop exits — worst-case stop latency poll_s).
+        self._stop_flag = False
 
     # -------------------------------------------------------------- child
 
@@ -137,6 +154,16 @@ class Supervisor:
         """Translate a wall-clock mtime onto the monotonic axis."""
         return time.monotonic() - max(0.0, time.time() - wall_ts)
 
+    def _wait(self, seconds: float) -> None:
+        """Sleep up to ``seconds``, returning within ``poll_s`` of
+        stop() — including stop() from a signal handler."""
+        deadline = time.monotonic() + seconds
+        while not self._stop_flag:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return
+            time.sleep(min(self.poll_s, left))
+
     def _kill(self, proc: subprocess.Popen) -> None:
         """SIGTERM, grace period, SIGKILL."""
         if proc.poll() is not None:
@@ -159,12 +186,12 @@ class Supervisor:
         backoff = p.backoff_s
         failures_in_a_row = 0
         rc = 1
-        while not self._stop:
+        while not self._stop_flag:
             proc = self._spawn()
             started = time.monotonic()
             reason = None
             healthy_span = 0.0
-            while reason is None and not self._stop:
+            while reason is None and not self._stop_flag:
                 code = proc.poll()
                 if code is not None:
                     if code == 0:
@@ -191,8 +218,8 @@ class Supervisor:
                     self._kill(proc)
                     rc = 1
                     break
-                time.sleep(self.poll_s)
-            if self._stop:
+                self._wait(self.poll_s)
+            if self._stop_flag:
                 self._kill(proc)
                 log.info("stopped; child terminated")
                 return 0
@@ -225,13 +252,13 @@ class Supervisor:
                         "(%d/%d in window)", reason, backoff,
                         len(recent), p.max_restarts)
             self.restarts += 1
-            time.sleep(backoff)
+            self._wait(backoff)
             backoff = min(backoff * 2, p.backoff_max_s)
-        return 0 if self._stop else rc  # stop() during backoff = clean stop
+        return 0 if self._stop_flag else rc  # stop() during backoff = clean stop
 
     def stop(self) -> None:
         """Ask run() to terminate the child and return (signal-safe)."""
-        self._stop = True
+        self._stop_flag = True
 
 
 def supervise_cli(child_argv: list[str]) -> int:

@@ -256,7 +256,16 @@ class _Handler(socketserver.BaseRequestHandler):
         n, n_modified, upserted = 0, 0, []
         for i, op in enumerate(cmd["updates"]):
             q, u = op["q"], op["u"]
-            matches = [d for d in coll.values() if _match(d, q)]
+            if set(q) == {"_id"} and not isinstance(q["_id"], dict):
+                # point query on the primary key: the collection dict IS
+                # the _id index — a real server never scans for these,
+                # and the framework's bulk upserts (1000 statements per
+                # command) made the O(n_docs) scan per statement the
+                # dominant cost of every at-rate test run
+                hit = coll.get(q["_id"])
+                matches = [hit] if hit is not None else []
+            else:
+                matches = [d for d in coll.values() if _match(d, q)]
             if matches:
                 targets = matches if op.get("multi") else matches[:1]
                 for old in targets:

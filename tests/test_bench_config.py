@@ -35,6 +35,13 @@ def test_run_config_small(h3):
     assert info["state_overflow"] == 0
     assert info["emitted_rows"] > 0
     assert info["n_active"] > 0
+    # roofline floor model: slab dominates at this shape — 2 slabs of
+    # (16 + 8 bins)*4 B rows per batch of 1024 events, plus the 16 B
+    # feed (native adds 8 B/event of prekeys)
+    exp = (2 * (1 << 12) * (12 + 4 + 8) * 4
+           + 1024 * (16 + (8 if h3 == "native" else 0))) / 1024
+    assert info["modeled_bytes_per_event"] == pytest.approx(exp)
+    assert info["hbm_gbps_achieved"] > 0
 
 
 @pytest.mark.skipif(not _native_available(), reason="no C++ toolchain")

@@ -120,6 +120,60 @@ def test_run_pending_respects_attempt_budget(progress, monkeypatch):
     assert calls == []
 
 
+_FAKE_UNIT_SCRIPT = """\
+import json, os, sys, time
+name = sys.argv[sys.argv.index("--unit") + 1]
+marker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      name + ".attempted")
+if name == "alpha" and not os.path.exists(marker):
+    open(marker, "w").write("x")
+    time.sleep(60)  # wedged device RPC: parent's unit timeout kills us
+print(json.dumps({"events_per_sec": 123.0, "mev_per_s": 0.000123,
+                  "unit": name, "_platform": "axon",
+                  "_device_kind": "TPU v5 lite"}))
+"""
+
+
+def test_mid_unit_flap_survives_and_loop_resumes(progress, tmp_path,
+                                                 monkeypatch):
+    """Full rehearsal of the relay-window failure mode, with REAL
+    subprocesses: unit `alpha` wedges mid-measurement on its first
+    attempt (the observed behavior when the window closes under a device
+    RPC), the runner's hard timeout kills it, the progress file survives
+    with the attempt charged, and a later loop() iteration — the
+    reopened window — banks both units and exits.  This is the
+    insurance run for the round's one hardware window."""
+    script = tmp_path / "fake_units.py"
+    script.write_text(_FAKE_UNIT_SCRIPT)
+    monkeypatch.setattr(hw_burst, "__file__", str(script))
+    monkeypatch.setattr(hw_burst, "UNITS",
+                        {"alpha": (5, 3), "beta": (5, 3)})
+    monkeypatch.setattr(hw_burst, "POLL_S", 0.01)
+    # the axon sitecustomize (PYTHONPATH) costs ~7 s of interpreter
+    # startup per child — irrelevant to the orchestration under test
+    monkeypatch.setenv("PYTHONPATH", "")
+
+    # --- window 1: opens, alpha wedges, timeout fires, window closes
+    monkeypatch.setattr(hw_burst, "tcp_up", lambda: True)
+    assert hw_burst.run_pending(hw_burst._load()) is False
+    out = json.load(open(progress))          # banked JSON survived the kill
+    assert out["attempts"]["alpha"] == 1
+    assert out["units"] == {}
+    assert any("TIMEOUT" in line for line in out["log"])
+
+    # --- relay flaps down, then a second window opens: loop() resumes
+    # from the on-disk state and banks everything
+    ups = iter([False, False, True])
+    monkeypatch.setattr(hw_burst, "tcp_up", lambda: next(ups, True))
+    hw_burst.loop()                          # returns only when all banked
+    out = json.load(open(progress))
+    assert set(out["units"]) == {"alpha", "beta"}
+    assert out["attempts"]["alpha"] == 2
+    for u in out["units"].values():
+        assert u["data"]["_platform"] == "axon"
+        assert u["data"]["events_per_sec"] == 123.0
+
+
 def test_report_renders_all_unit_schemas(progress, tmp_path, monkeypatch):
     """Old-schema (no batch key), new-schema, and CPU-stamped entries all
     render; CPU results are excluded from the hardware tables."""

@@ -77,8 +77,22 @@ def test_rank_matches_sort_all_invalid(rng):
         np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
 
 
+def test_env_var_read_at_trace_time(rng, monkeypatch):
+    """HEATMAP_MERGE_IMPL set AFTER import is honored (round-3 advisor
+    footgun: the old import-time snapshot silently ignored it) — and the
+    MERGE_IMPL override slot still wins over the env var."""
+    from heatmap_tpu.engine import step as step_mod
+
+    monkeypatch.setenv("HEATMAP_MERGE_IMPL", "rank")
+    assert step_mod._resolve_merge_impl() == "rank"
+    monkeypatch.setenv("HEATMAP_MERGE_IMPL", "probe")
+    assert step_mod._resolve_merge_impl() == "probe"
+    with mock.patch("heatmap_tpu.engine.step.MERGE_IMPL", "sort"):
+        assert step_mod._resolve_merge_impl() == "sort"
+
+
 def test_env_dispatch(rng):
-    """merge_batch honors the import-time MERGE_IMPL resolution."""
+    """merge_batch honors the MERGE_IMPL override slot."""
     with mock.patch("heatmap_tpu.engine.step.MERGE_IMPL", "rank"):
         st = init_state(512, 0)
         lat, lng, speed, ts, valid = make_batch(rng, 128)

@@ -480,14 +480,19 @@ def test_end_to_end_per_cell_differential(tmp_path):
     rt.run()
     assert rt.metrics.snapshot().get("state_overflow_groups", 0) == 0
 
-    # oracle cells via the device snap (f32, what production runs) — the
-    # snap itself is pinned against the f64 host oracle in the hexgrid
-    # suites; THIS test pins windowing/merge/emit/doc-building/sink
+    # oracle cells via the SAME snap the runtime engaged (the C++ native
+    # host pre-snap is the measured CPU default since round 4; f32 XLA
+    # otherwise) — the snap itself is pinned against the f64 host oracle
+    # in the hexgrid suites; THIS test pins windowing/merge/emit/
+    # doc-building/sink
     lat = np.array([e["lat"] for e in evs], np.float32)
     lon = np.array([e["lon"] for e in evs], np.float32)
     cells_by_res = {}
     for res in (7, 8):
-        hi, lo = latlng_deg_to_cell_vec(lat, lon, res)
+        if rt._host_snap is not None:
+            hi, lo = rt._host_snap(np.radians(lat), np.radians(lon), res)
+        else:
+            hi, lo = latlng_deg_to_cell_vec(lat, lon, res)
         cells_by_res[res] = cells_to_strings(np.asarray(hi), np.asarray(lo))
     oracle: dict = collections.defaultdict(lambda: [0, 0.0])
     for i, e in enumerate(evs):
@@ -614,3 +619,48 @@ def test_old_checkpoint_layout_refused(tmp_path):
     np.savez(path, **old)
     with pytest.raises(ValueError, match="older state layout"):
         cm.load_state(8, 300)
+
+
+def test_memory_store_packed_dedup_last_write_wins():
+    """MemoryStore's lazy packed backlog: multiple packed batches that
+    re-emit the SAME (cell, window) groups with evolving aggregates
+    (update-mode emits) must resolve to exactly the docs the eager
+    doc-path produces for the same write order — including an
+    interleaved doc write, which must order between the packed batches
+    around it."""
+    from heatmap_tpu.sink.base import TilePackMeta, packed_tile_docs
+
+    meta = TilePackMeta(city="bos", grid="h3r8", window_s=300,
+                        ttl_minutes=45, window_minutes_tag=0, with_p95=True)
+    rng = np.random.default_rng(5)
+
+    def body_for(counts):
+        n = len(counts)
+        body = np.zeros((n, 13), np.uint32)
+        body[:, 0] = np.arange(n, dtype=np.uint32)        # key_hi
+        body[:, 1] = np.uint32(7)                         # key_lo
+        body[:, 2] = np.int32(1_700_000_100 // 300 * 300).view(np.uint32)
+        body[:, 3] = np.asarray(counts, np.int32).view(np.uint32)
+        for col in (4, 5, 6, 7, 9, 10, 11, 12):
+            body[:, col] = rng.uniform(0, 50, n).astype(
+                np.float32).view(np.uint32)
+        body[:, 8] = 1
+        return body
+
+    batches = [body_for([3] * 16), body_for([9] * 10 + [0] * 6),
+               body_for([27] * 4)]
+    s_packed, s_docs = MemoryStore(), MemoryStore()
+    for i, body in enumerate(batches):
+        s_packed.upsert_tiles_packed(body, meta)
+        s_docs.upsert_tiles(packed_tile_docs(body, meta))
+        if i == 1:  # interleaved doc write must order between batches
+            extra = packed_tile_docs(body_for([5] * 2), meta)
+            s_packed.upsert_tiles(extra)
+            s_docs.upsert_tiles(extra)
+    assert s_packed._tiles == s_docs._tiles
+    # last write won: keys 0..1 got the interleaved count-5 doc then the
+    # final count-27 batch; keys 2..3 the count-27 batch; 4..9 count 9
+    counts = {int(k.split("|")[2], 16) >> 32: v["count"]
+              for k, v in s_packed._tiles.items()}
+    assert counts[0] == 27 and counts[3] == 27
+    assert counts[5] == 9 and counts[15] == 3
