@@ -69,6 +69,27 @@ class Config:
     serve_host: str = "127.0.0.1"
     serve_port: int = 5000
     store: str = "auto"                # "auto" | "memory" | "mongo" | "jsonl"
+    grow_margin: str = "worst"         # "worst" | "observed": free-slot
+                                       # margin the auto-grower keeps.
+                                       # worst = 2x batch (a batch CAN
+                                       # mint one group per event, so
+                                       # overflow is structurally
+                                       # impossible below the ceiling —
+                                       # but the slab ends up 4x batch
+                                       # and the bandwidth-bound fold
+                                       # pays ~3x for the guarantee).
+                                       # observed = 4x the largest
+                                       # per-batch group minting seen so
+                                       # far (floor batch/8): near-peak
+                                       # throughput for real workloads.
+                                       # A burst beyond the observed
+                                       # margin overflows LOUDLY
+                                       # (/metrics + log); pair with
+                                       # HEATMAP_ON_OVERFLOW=fail for a
+                                       # lossless stop-and-replay
+                                       # backstop — without it the
+                                       # overflowing groups are dropped
+                                       # (the runtime warns at startup)
     emit_pull: str = "auto"            # "auto" | "full" | "prefix": prefix
                                        # pulls head row + live-rows bucket
                                        # (2 transfers, far fewer bytes) —
@@ -140,6 +161,7 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         serve_port=_int(e, "SERVE_PORT", Config.serve_port),
         store=e.get("HEATMAP_STORE", Config.store),
         emit_pull=e.get("HEATMAP_EMIT_PULL", Config.emit_pull),
+        grow_margin=e.get("HEATMAP_GROW_MARGIN", Config.grow_margin),
     )
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
@@ -152,6 +174,10 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         raise ValueError(
             f"HEATMAP_STATE_MAX_LOG2 ({cfg.state_max_log2}) below "
             f"STATE_CAPACITY_LOG2 ({cfg.state_capacity_log2})")
+    if cfg.grow_margin not in ("worst", "observed"):
+        raise ValueError(
+            f"HEATMAP_GROW_MARGIN must be 'worst' or 'observed', "
+            f"got {cfg.grow_margin!r}")
     if cfg.emit_pull not in ("auto", "full", "prefix"):
         raise ValueError(
             f"HEATMAP_EMIT_PULL must be auto|full|prefix, "

@@ -109,6 +109,14 @@ class MicroBatchRuntime:
         self._ckpt_due = False  # cadence hit while mid-carry; commit ASAP
         self._last_pull_s = 0.0  # wall of the most recent deferred pull
         self._n_active_peak = 0  # max live groups (any pair) since startup
+        self._prev_active: dict[tuple, int] = {}  # last n_active per pair
+        self._mint_peak = 0      # max per-batch new-group count seen
+        if cfg.grow_margin == "observed" and cfg.on_overflow != "fail":
+            log.warning(
+                "HEATMAP_GROW_MARGIN=observed with HEATMAP_ON_OVERFLOW=%s:"
+                " a minting burst beyond the observed margin DROPS groups"
+                " (loudly, at /metrics) — set HEATMAP_ON_OVERFLOW=fail for"
+                " the lossless stop-and-replay backstop", cfg.on_overflow)
         self._step_began = None  # monotonic start of the in-flight step
         self._hb_watchdog = None  # in-flight beacon thread (lazy, daemon)
         self._cap_max = 1 << (cfg.state_max_log2
@@ -120,7 +128,8 @@ class MicroBatchRuntime:
         n_shards_planned = (mesh.devices.size
                             if mesh is not None and mesh.devices.size > 1
                             else 1)
-        if self._cap_max > cap and cap * n_shards_planned < 2 * cfg.batch_size:
+        if (cfg.grow_margin == "worst" and self._cap_max > cap
+                and cap * n_shards_planned < 2 * cfg.batch_size):
             # one batch can mint up to batch_size new groups: below this
             # floor the first batches could overflow before stats-driven
             # growth sees them.  Start at the floor (loudly) — cheap here,
@@ -642,7 +651,20 @@ class MicroBatchRuntime:
         else:
             self.metrics.count(f"events_late_r{res}m{wmin}",
                                int(stats.n_late))
-        self._n_active_peak = max(self._n_active_peak, int(stats.n_active))
+        n_active = int(stats.n_active)
+        self._n_active_peak = max(self._n_active_peak, n_active)
+        # per-batch group minting (for grow_margin=observed): the raw
+        # n_active delta UNDERcounts minting when eviction freed rows the
+        # same batch, so add evictions back in.  The FIRST observation
+        # for a pair only seeds the baseline — after a checkpoint
+        # restore n_active starts at the whole restored population, and
+        # counting that as one batch's minting would permanently
+        # oversize the observed margin to ~4x the live group count
+        prev = self._prev_active.get((res, wmin))
+        self._prev_active[(res, wmin)] = n_active
+        if prev is not None:
+            minted = n_active - prev + int(stats.n_evicted)
+            self._mint_peak = max(self._mint_peak, minted)
         return int(stats.batch_max_ts)
 
     def _maybe_grow(self) -> None:
@@ -661,7 +683,17 @@ class MicroBatchRuntime:
         the same decision from the replicated stats."""
         agg = self._multi if self._multi is not None else self._sharded
         shards = agg.n_shards
-        margin = 2 * self.cfg.batch_size
+        if self.cfg.grow_margin == "observed":
+            # measured minting rate instead of the one-group-per-event
+            # worst case: 4x the largest per-batch minting seen (2x for
+            # the one-batch stats lag, 2x headroom for a hotter batch),
+            # floored at batch/8.  An adversarial key stream can still
+            # outrun this — the overflow accounting and
+            # HEATMAP_ON_OVERFLOW=fail's checkpoint replay are the loud,
+            # lossless backstop (config.grow_margin).
+            margin = max(4 * self._mint_peak, self.cfg.batch_size // 8)
+        else:
+            margin = 2 * self.cfg.batch_size
         skew = 2 if shards > 1 else 1
         cap = agg.capacity_per_shard
         if self._n_active_peak * skew + margin <= cap * shards:

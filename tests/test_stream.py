@@ -664,3 +664,48 @@ def test_memory_store_packed_dedup_last_write_wins():
               for k, v in s_packed._tiles.items()}
     assert counts[0] == 27 and counts[3] == 27
     assert counts[5] == 9 and counts[15] == 3
+
+
+def test_grow_margin_observed(tmp_path):
+    """HEATMAP_GROW_MARGIN=observed sizes the free-slot margin from the
+    measured per-batch group minting instead of the one-group-per-event
+    worst case: a small-cardinality stream keeps the configured slab
+    (worst mode would pre-grow it at init just because cap < 2x batch),
+    and a sudden high-cardinality burst still triggers growth before
+    overflow."""
+    cfg = mk_cfg(tmp_path, batch_size=512, state_capacity_log2=9,
+                 state_max_log2=13, grow_margin="observed")
+    store = MemoryStore()
+    src = MemorySource()
+    rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=0)
+    agg = rt._multi
+    assert agg.capacity_per_shard == 512  # no worst-case init floor
+
+    def events_at(points, t0):
+        return [{"provider": "p", "vehicleId": f"v{i}", "lat": la,
+                 "lon": lo, "speedKmh": 10.0, "ts": t0}
+                for i, (la, lo) in enumerate(points)]
+
+    rng = np.random.default_rng(3)
+    few = [(42.30 + 0.001 * i, -71.05) for i in range(40)]
+    for k in range(3):  # low-cardinality steady state: ~40 groups/batch
+        src.push(events_at(few, T_NOW + k))
+        rt.step_once()
+    rt.flush_pending()
+    rt._maybe_grow()
+    assert agg.capacity_per_shard == 512  # margin stayed observed-sized
+    # the first observation per pair only seeds the baseline (a restore
+    # would otherwise count the whole restored population as one
+    # batch's minting); steady-state repeats mint nothing
+    assert rt._mint_peak == 0
+
+    # burst: ~400 brand-new far-apart cells in ONE batch
+    burst = [(float(rng.uniform(40.0, 44.0)), float(rng.uniform(-75.0, -70.0)))
+             for _ in range(400)]
+    src.push(events_at(burst, T_NOW + 10))
+    rt.step_once()
+    rt.flush_pending()
+    rt._maybe_grow()
+    assert agg.capacity_per_shard > 512  # minting spike grew the slab
+    assert rt.metrics.snapshot().get("state_overflow_groups", 0) == 0
+    rt.close()
