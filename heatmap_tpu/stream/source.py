@@ -14,6 +14,7 @@ import abc
 import collections
 import json
 import math
+import time as _time
 from typing import Any, Iterable, Sequence
 
 import numpy as np
@@ -418,6 +419,10 @@ class _WireImpl:
     partition starves under a small max_events.
     """
 
+    # extra fetch sweeps per poll may start within this wall budget (the
+    # first sweep always runs); see _poll_record_loop
+    sweep_budget_s = 0.2
+
     def __init__(self, bootstrap, topic):
         import logging
         import os
@@ -503,31 +508,58 @@ class _WireImpl:
         if not parts:
             return
         n_out = 0
-        for k in range(len(parts)):
-            if n_out >= max_events:
-                break
-            p = parts[(self._rr + k) % len(parts)]
-            fr = self._guarded_fetch(
-                p, lambda p=p: self.c.fetch(self.topic, p, self._offsets[p],
-                                            max_wait_ms=50))
-            if fr is None:
-                continue
-            if fr.skipped_batches:
-                self.log.warning("skipped %d undecodable batches on %s[%d]",
-                                 fr.skipped_batches, self.topic, p)
-            taken = 0
-            for r in fr.records:
+        # Sweep the partitions REPEATEDLY until the request is filled or
+        # a full sweep makes no progress: one fetch returns at most
+        # ~max_bytes (1 MiB) of records, so a single round-robin pass
+        # caps a poll at ~n_partitions MiB — far below a large
+        # micro-batch, and the resulting partial polls made the runtime
+        # pay carry/dispatch overhead per MiB instead of per batch.
+        # Only the FIRST sweep's fetches wait (max_wait_ms); follow-up
+        # sweeps use 0 so a drained topic never stalls the loop.  Extra
+        # sweeps start only within ``sweep_budget_s``: on a LIVE tail a
+        # trickle producer keeps every sweep barely progressing, and an
+        # unbounded loop would sit here up to max_events/producer_rate —
+        # stalling watermarks, emits, and the supervisor heartbeat —
+        # instead of returning a partial batch like a streaming poll
+        # must.  (A backfill replay fills from a full broker in a couple
+        # of sweeps, well inside the budget.)
+        sweep_wait = 50
+        t0 = _time.monotonic()
+        while n_out < max_events:
+            progressed = False
+            for k in range(len(parts)):
                 if n_out >= max_events:
                     break
-                taken += 1
-                self._offsets[p] = r.offset + 1
-                if r.value is None:
+                p = parts[(self._rr + k) % len(parts)]
+                fr = self._guarded_fetch(
+                    p, lambda p=p, w=sweep_wait: self.c.fetch(
+                        self.topic, p, self._offsets[p], max_wait_ms=w))
+                if fr is None:
                     continue
-                n_out += handle(p, r)
-            if taken == len(fr.records):
-                # consumed everything fetched: also jump past skipped
-                # batches / trailing tombstones
-                self._offsets[p] = max(self._offsets[p], fr.next_offset)
+                if fr.skipped_batches:
+                    self.log.warning(
+                        "skipped %d undecodable batches on %s[%d]",
+                        fr.skipped_batches, self.topic, p)
+                taken = 0
+                for r in fr.records:
+                    if n_out >= max_events:
+                        break
+                    taken += 1
+                    self._offsets[p] = r.offset + 1
+                    if r.value is None:
+                        continue
+                    n_out += handle(p, r)
+                if taken:
+                    progressed = True
+                if taken == len(fr.records):
+                    # consumed everything fetched: also jump past skipped
+                    # batches / trailing tombstones
+                    self._offsets[p] = max(self._offsets[p], fr.next_offset)
+            if not progressed:
+                break
+            if _time.monotonic() - t0 >= self.sweep_budget_s:
+                break
+            sweep_wait = 0
         self._rr = (self._rr + 1) % max(len(parts), 1)
 
     def _poll_colfmt(self, max_events):

@@ -72,3 +72,31 @@ def test_banked_headline_res_filter(tmp_path, monkeypatch):
     assert bench._banked_hw_headline(8) == {}
     got = bench._banked_hw_headline(7)
     assert got["hw_banked_events_per_sec"] == 9e6
+
+
+def test_e2e_runtime_attach_maps_and_gates(monkeypatch):
+    """The CPU-fallback e2e attach maps the tool's JSON into artifact
+    keys, disables via BENCH_E2E=0, and swallows subprocess failure."""
+    import json as _json
+    import subprocess as _sp
+
+    class P:
+        returncode = 0
+        stdout = _json.dumps({"wall_events_per_sec": 5.0,
+                              "steady_events_per_sec": 7.0}) + "\n"
+        stderr = ""
+
+    monkeypatch.setattr(bench.sys, "executable", bench.sys.executable)
+    monkeypatch.setattr(_sp, "run", lambda *a, **k: P())
+    out = bench._e2e_runtime_attach()
+    assert out["e2e_runtime_events_per_sec"] == 5.0
+    assert out["e2e_runtime_steady_events_per_sec"] == 7.0
+
+    monkeypatch.setenv("BENCH_E2E", "0")
+    assert bench._e2e_runtime_attach() == {}
+    monkeypatch.delenv("BENCH_E2E")
+
+    def boom(*a, **k):
+        raise _sp.TimeoutExpired("x", 1)
+    monkeypatch.setattr(_sp, "run", boom)
+    assert bench._e2e_runtime_attach() == {}

@@ -395,3 +395,36 @@ def test_dropped_pin_fails_actionably():
         msg = str(ei.value)
         assert "Produce" in msg and "v12..v15" in msg and "v3..v7" in msg
         assert "HEATMAP_KAFKA_IMPL" in msg
+
+
+def test_poll_sweeps_until_filled(broker, monkeypatch):
+    """A poll larger than one fetch's ~1 MiB worth of records must keep
+    sweeping the partitions until it fills (a single round-robin pass
+    used to cap a poll at ~n_partitions MiB, forcing the runtime into
+    partial-batch carries), while the sweep loop stays bounded by
+    sweep_budget_s for live tails."""
+    import numpy as np
+
+    from heatmap_tpu.producers.base import KafkaPublisher
+    from heatmap_tpu.stream.events import columns_from_arrays
+    from heatmap_tpu.stream.source import KafkaSource
+
+    monkeypatch.setenv("HEATMAP_EVENT_FORMAT", "columnar")
+    monkeypatch.setenv("HEATMAP_KAFKA_IMPL", "wire")
+    src = KafkaSource(broker.bootstrap, "sweep.topic")  # at LATEST
+    pub = KafkaPublisher(broker.bootstrap, "sweep.topic",
+                         event_format="columnar")
+    n = 1 << 17  # ~3.4 MiB of columnar records — >3 fetches worth
+    cols = columns_from_arrays(
+        np.full(n, 42.3, np.float32), np.full(n, -71.05, np.float32),
+        np.full(n, 30.0, np.float32),
+        np.full(n, 1_700_000_000, np.int32),
+        provider_id=np.zeros(n, np.int32),
+        vehicle_id=(np.arange(n) % 50).astype(np.int32),
+        providers=["p"], vehicles=[f"v{i}" for i in range(50)])
+    assert pub.publish_columns(cols) == n
+    pub.flush()
+    polled = src.poll(n)
+    assert len(polled) >= n  # ONE poll call filled the whole request
+    src.close()
+    pub.close()

@@ -249,3 +249,37 @@ def test_runtime_heartbeat(tmp_path, monkeypatch):
     content = open(hb).read()
     assert content.startswith(tuple("0123456789"))
     assert "epoch=" in content
+
+
+def test_sigterm_during_backoff_exits_promptly(tmp_path):
+    """A REAL SIGTERM delivered while the supervisor sleeps in a long
+    restart backoff must stop it within ~poll_s.  stop() runs inside the
+    signal handler on the sleeping main thread, so it must be
+    async-signal-safe: the round-4 Event-based stop could self-deadlock
+    there (Event.set() needs the Condition lock the interrupted wait
+    holds); the plain-bool flag + sliced _wait cannot."""
+    import signal
+
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        os.pardir))
+    prog = (
+        "import sys; sys.path.insert(0, %r); "
+        "from heatmap_tpu.stream.supervisor import supervise_cli; "
+        "sys.exit(supervise_cli([sys.executable, '-c', "
+        "'raise SystemExit(3)']))" % repo)
+    env = {**os.environ, "PYTHONPATH": "",  # skip slow interpreter hooks
+           "HEATMAP_SUPERVISE_BACKOFF_S": "60",
+           "HEATMAP_SUPERVISE_BACKOFF_MAX_S": "60",
+           "HEATMAP_SUPERVISE_MAX_RESTARTS": "9"}
+    p = subprocess.Popen([sys.executable, "-c", prog], env=env)
+    try:
+        time.sleep(3.0)  # child exits code 3 fast -> 60s backoff begins
+        assert p.poll() is None, "supervisor ended before the signal"
+        t0 = time.monotonic()
+        p.send_signal(signal.SIGTERM)
+        rc = p.wait(timeout=10)
+        assert time.monotonic() - t0 < 5.0
+        assert rc == 0  # stop() during backoff is a clean stop
+    finally:
+        if p.poll() is None:
+            p.kill()

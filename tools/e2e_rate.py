@@ -44,6 +44,13 @@ def main() -> int:
     ap.add_argument("--batch", type=int, default=1 << 16)
     ap.add_argument("--vehicles", type=int, default=5000)
     ap.add_argument("--store", choices=("mongo", "memory"), default="mongo")
+    ap.add_argument("--source", choices=("synthetic", "kafka"),
+                    default="synthetic",
+                    help="kafka = pre-publish the synthetic events to the "
+                    "in-process wire-protocol mock broker (columnar "
+                    "format) and feed the runtime through KafkaSource, so "
+                    "the measured rate covers produce->fetch->decode->"
+                    "fold->sink jointly")
     ap.add_argument("--no-positions", action="store_true")
     ap.add_argument("--cap-log2", type=int, default=17,
                     help="starting state slab rows per shard (log2).  The "
@@ -82,8 +89,64 @@ def main() -> int:
         state_max_log2=args.cap_log2 + 3, grow_margin="observed",
         speed_hist_bins=32, store=args.store,
         checkpoint_dir=tempfile.mkdtemp(prefix="e2e-rate-ckpt-"))
-    src = SyntheticSource(n_events=args.events, n_vehicles=args.vehicles,
+    syn = SyntheticSource(n_events=args.events, n_vehicles=args.vehicles,
                           events_per_second=args.batch * 4)
+    broker = pub = None
+    if args.source == "kafka":
+        os.environ["HEATMAP_EVENT_FORMAT"] = "columnar"
+        os.environ["HEATMAP_KAFKA_IMPL"] = "wire"  # mock broker's dialect
+        from heatmap_tpu.producers.base import KafkaPublisher
+        from heatmap_tpu.stream.source import KafkaSource
+        from heatmap_tpu.testing.mock_kafka import MockKafkaBroker
+
+        class BoundedKafka(KafkaSource):
+            """A live Kafka stream never claims exhaustion; this run is
+            a bounded replay, so count consumed events and let run()
+            end once the pre-published total has been delivered.  The
+            consecutive-empty-poll strike is the backstop: if any
+            record is dropped as undecodable, _got can never reach
+            _total, and without the strike rt.run() would spin on the
+            drained topic forever."""
+
+            def __init__(self, bootstrap, topic):
+                super().__init__(bootstrap, topic)
+                self._total, self._got, self._idle = None, 0, 0
+
+            def poll(self, n):
+                out = super().poll(n)
+                got = len(out) if out is not None else 0
+                self._got += got
+                self._idle = 0 if got else self._idle + 1
+                return out
+
+            @property
+            def exhausted(self):
+                if self._total is None:
+                    return False  # still publishing
+                return self._got >= self._total or self._idle >= 3
+
+        broker = MockKafkaBroker()
+        # the consumer attaches FIRST: KafkaSource starts from the
+        # latest offsets, so a source created after the pre-publish
+        # would see an empty stream
+        src = BoundedKafka(broker.bootstrap, "e2e")
+        pub = KafkaPublisher(broker.bootstrap, "e2e",
+                             event_format="columnar")
+        t_pub0 = time.monotonic()
+        published = 0
+        while True:
+            cols = syn.poll(1 << 16)
+            if not len(cols):
+                break
+            published += pub.publish_columns(cols)
+        pub.flush()
+        t_pub = time.monotonic() - t_pub0
+        src._total = published
+        topology = (f"columnar Kafka wire client <- in-process mock "
+                    f"broker (pre-published {published:,} events in "
+                    f"{t_pub:.1f}s) -> ") + topology
+    else:
+        src = syn
     rt = MicroBatchRuntime(cfg, src, store,
                            positions_enabled=not args.no_positions,
                            checkpoint_every=20)
@@ -119,6 +182,10 @@ def main() -> int:
         out["mongod_positions_docs"] = len(
             mongod.state.coll("mobility", "positions_latest"))
         mongod.close()
+    if pub is not None:
+        pub.close()
+    if broker is not None:
+        broker.close()
     print(json.dumps(out))
     return 0
 
