@@ -283,3 +283,46 @@ def test_sigterm_during_backoff_exits_promptly(tmp_path):
     finally:
         if p.poll() is None:
             p.kill()
+
+
+def test_watchdog_vouches_for_in_flight_step_up_to_grace(tmp_path,
+                                                         monkeypatch):
+    """The in-flight beacon watchdog keeps the beacon fresh while a step
+    is dispatching (so a slow mid-run recompile outlives
+    stall_timeout_s), but stops vouching once HEATMAP_DISPATCH_GRACE_S
+    lapses — a truly wedged device op must still go quiet and trip the
+    supervisor."""
+    from heatmap_tpu.config import load_config
+    from heatmap_tpu.sink import MemoryStore
+    from heatmap_tpu.stream import MemorySource, MicroBatchRuntime
+
+    hb = tmp_path / "hb"
+    monkeypatch.setenv("HEATMAP_HEARTBEAT_FILE", str(hb))
+    monkeypatch.setenv("HEATMAP_DISPATCH_GRACE_S", "2.5")
+    cfg = load_config({}, batch_size=64, state_capacity_log2=10,
+                      speed_hist_bins=8, store="memory",
+                      checkpoint_dir=str(tmp_path / "ckpt"))
+    t0 = int(time.time()) - 600
+    src = MemorySource([{"provider": "t", "vehicleId": "v0", "lat": 42.0,
+                         "lon": -71.0, "speedKmh": 10.0, "bearing": 0.0,
+                         "accuracyM": 1.0, "ts": t0}])
+    rt = MicroBatchRuntime(cfg, src, MemoryStore())
+    rt.step_once()
+    rt._touch_heartbeat()  # first beacon: the watchdog thread starts now
+    assert rt._hb_watchdog is not None and rt._hb_watchdog.is_alive()
+
+    # simulate a long in-flight step: the watchdog must refresh the
+    # beacon while the (fake) dispatch is younger than the grace
+    rt._step_began = time.monotonic()
+    before = os.stat(hb).st_mtime
+    time.sleep(1.6)
+    assert os.stat(hb).st_mtime > before, "watchdog never touched beacon"
+
+    # past the grace the watchdog stops vouching: beacon goes quiet
+    rt._step_began = time.monotonic() - 10.0  # "dispatching" for 10s > 2.5s
+    quiet_from = os.stat(hb).st_mtime
+    time.sleep(1.6)
+    assert os.stat(hb).st_mtime == quiet_from, (
+        "watchdog kept vouching past the dispatch grace")
+    rt._step_began = None
+    rt.close()
