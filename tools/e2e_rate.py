@@ -52,6 +52,12 @@ def main() -> int:
                     "the measured rate covers produce->fetch->decode->"
                     "fold->sink jointly")
     ap.add_argument("--no-positions", action="store_true")
+    ap.add_argument("--resolutions", default="8",
+                    help="comma list; e.g. 7,8,9 = the BASELINE #4 "
+                    "hex-pyramid fused through ONE runtime program")
+    ap.add_argument("--windows", default="5",
+                    help="comma list of minutes; e.g. 1,5,15 = the "
+                    "BASELINE #5 multi-window config")
     ap.add_argument("--cap-log2", type=int, default=17,
                     help="starting state slab rows per shard (log2).  The "
                     "run uses grow_margin=observed with headroom to grow "
@@ -85,7 +91,9 @@ def main() -> int:
         topology = "packed-columnar MemoryStore"
 
     cfg = load_config(
-        {}, batch_size=args.batch, state_capacity_log2=args.cap_log2,
+        {"H3_RESOLUTIONS": args.resolutions,
+         "WINDOW_MINUTES": args.windows},
+        batch_size=args.batch, state_capacity_log2=args.cap_log2,
         state_max_log2=args.cap_log2 + 3, grow_margin="observed",
         speed_hist_bins=32, store=args.store,
         checkpoint_dir=tempfile.mkdtemp(prefix="e2e-rate-ckpt-"))
@@ -160,6 +168,8 @@ def main() -> int:
     out = {
         "topology": topology,
         "n_events": args.events,
+        "pairs": [f"r{r}m{w}" for r in cfg.resolutions
+                  for w in cfg.windows_minutes],
         "batch": args.batch,
         "store": args.store,
         "positions": not args.no_positions,

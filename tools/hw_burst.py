@@ -21,7 +21,10 @@ Design:
   * `--report` renders HARDWARE.md from whatever has been banked, with
     the same decision rules as tools/validate_on_tpu.py.
 
-Units (priority order — headline first, nice-to-haves last):
+Units (priority order — cheapest durable proof first, then the
+headline, nice-to-haves last):
+  contact       device kind + tiny matmul (bankable in seconds)
+  micro         256k-event fold at small shapes (fits a ~2-min window)
   headline      bench.py-shaped fold throughput at the production shape
   snap_xla_r8   XLA H3 snap, res 8, 1M points         (north-star op)
   snap_pal_r8   Pallas snap res 8: Mosaic lowering + time + agreement
@@ -53,7 +56,10 @@ for _p in (ROOT, os.path.join(ROOT, "tools")):
 PROGRESS = os.path.join(ROOT, "HW_PROGRESS.json")
 CACHE_DIR = "/tmp/jax-bench-cache"
 RELAY = ("127.0.0.1", 8093)
-POLL_S = 30
+# 10 s, not 30: the observed windows can be ~2 minutes, and up to a full
+# poll interval of each window is lost to detection latency — a TCP
+# probe is nearly free, so poll tight
+POLL_S = 10
 
 # unit name -> (timeout_s, max_attempts)
 #
@@ -65,6 +71,7 @@ POLL_S = 30
 # timeouts when a window closes mid-unit (run_pending stops after one
 # timeout per window, so a closed window costs each unit <=1 attempt).
 UNITS: dict[str, tuple[int, int]] = {
+    "contact": (60, 30),
     "micro": (150, 20),
     "headline": (600, 12),
     "snap_xla_r8": (300, 10),
@@ -294,7 +301,28 @@ def unit_stream_profile() -> dict:
             "trace_dir": trace_dir, "metrics": keep}
 
 
+def unit_contact() -> dict:
+    """Absolute-minimum hardware proof: device kind + one tiny timed
+    matmul.  NO heatmap imports and no app-program compile (even the
+    snap costs ~30 s to compile cold, which could eat a short window) —
+    this banks durable evidence of TPU contact inside a window too
+    short for anything else."""
+    import jax
+    import jax.numpy as jnp
+
+    _device_ready()
+    t0 = time.perf_counter()
+    m = jax.jit(lambda a: a @ a)(jnp.ones((512, 512), jnp.bfloat16))
+    jax.block_until_ready(m)
+    matmul_s = time.perf_counter() - t0
+    return {"device": jax.devices()[0].device_kind,
+            "n_devices": jax.device_count(),
+            "matmul512_compile_run_s": round(matmul_s, 2)}
+
+
 UNIT_FNS = {
+    # proof of device contact first — bankable in seconds
+    "contact": unit_contact,
     # smallest TPU-contact proof that still measures the production fold
     # (256k events, small slab) — sized for a ~2-minute relay window
     "micro": lambda: unit_headline(total=1 << 18, batch=1 << 16,
@@ -464,6 +492,13 @@ def report() -> None:
                      f"(each stamped with its own capture time in "
                      f"HW_PROGRESS.json)")
         lines.append("")
+    if "contact" in hw:
+        d = hw["contact"]
+        lines += ["## Device contact",
+                  "",
+                  f"- {d.get('n_devices', '?')} device(s); 512-matmul "
+                  f"compile+run {d.get('matmul512_compile_run_s', '?')}s",
+                  ""]
     heads = [(k, hw[k]) for k in ("micro", "headline", "headline_big",
                                   "headline_native", "headline_bench")
              if k in hw]
