@@ -739,3 +739,14 @@ def test_stream_cli_entrypoint(tmp_path):
         cwd=str(tmp_path))
     assert p.returncode == 0, p.stderr[-2000:]
     assert "pipeline synthetic_backfill" in p.stderr
+
+    # the --supervise wiring: parent supervises, child runs the bounded
+    # job and exits 0, supervisor reports the clean completion
+    p = subprocess.run(
+        [sys.executable, "-m", "heatmap_tpu.stream", "synthetic_backfill",
+         "--max-batches", "2", "--supervise"],
+        capture_output=True, text=True, timeout=300,
+        env={**env, "CHECKPOINT": str(tmp_path / "ckpt2")},
+        cwd=str(tmp_path))
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "child exited cleanly" in p.stderr
