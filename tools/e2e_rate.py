@@ -58,6 +58,12 @@ def main() -> int:
     ap.add_argument("--windows", default="5",
                     help="comma list of minutes; e.g. 1,5,15 = the "
                     "BASELINE #5 multi-window config")
+    ap.add_argument("--shards", type=int, default=1,
+                    help=">1 runs the SHARDED runtime over an n-device "
+                    "mesh (on CPU: virtual devices via "
+                    "xla_force_host_platform_device_count — a "
+                    "correctness/soak shape, not a perf claim: all "
+                    "shards share this host's core)")
     ap.add_argument("--cap-log2", type=int, default=17,
                     help="starting state slab rows per shard (log2).  The "
                     "run uses grow_margin=observed with headroom to grow "
@@ -72,8 +78,23 @@ def main() -> int:
                     "assumption ever breaks")
     args = ap.parse_args()
 
+    mesh = None
+    if args.shards > 1:
+        # must precede backend INIT (jax is already imported by the
+        # environment's site hook, but the CPU client reads XLA_FLAGS
+        # lazily at first use)
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{args.shards}").strip()
+
     from heatmap_tpu.config import load_config
     from heatmap_tpu.stream import MicroBatchRuntime, SyntheticSource
+
+    if args.shards > 1:
+        from heatmap_tpu.parallel import make_mesh
+
+        mesh = make_mesh(args.shards)
 
     mongod = None
     if args.store == "mongo":
@@ -155,7 +176,7 @@ def main() -> int:
                     f"{t_pub:.1f}s) -> ") + topology
     else:
         src = syn
-    rt = MicroBatchRuntime(cfg, src, store,
+    rt = MicroBatchRuntime(cfg, src, store, mesh=mesh,
                            positions_enabled=not args.no_positions,
                            checkpoint_every=20)
     wall0 = time.monotonic()
@@ -170,6 +191,7 @@ def main() -> int:
         "n_events": args.events,
         "pairs": [f"r{r}m{w}" for r in cfg.resolutions
                   for w in cfg.windows_minutes],
+        "shards": args.shards,
         "batch": args.batch,
         "store": args.store,
         "positions": not args.no_positions,
