@@ -698,22 +698,29 @@ def _banked_hw_headline(res: int = 8) -> dict:
     Only entries measured at THIS run's resolution qualify (entries
     predating the res field default to 8, the units' fixed config) — a
     res-7 short run is faster per event and must never be published as
-    the res-8 headline."""
+    the res-8 headline.  Production-shaped units strictly outrank
+    ``micro`` (ADVICE r4 #3): the slab-bandwidth-bound fold runs faster
+    per event at micro's tiny 2^14 slab, so its rate can overstate the
+    production-shape headline — micro is published only when nothing
+    production-shaped has banked."""
     try:
         with open(_progress_path(), encoding="utf-8") as fh:
             units = json.load(fh)["units"]
         best = None
         best_name = None
-        for name in ("micro", "headline", "headline_big",
-                     "headline_bench"):
-            unit = units.get(name)
-            if not unit or unit["data"].get("_platform") == "cpu":
-                continue
-            if unit["data"].get("res", 8) != res:
-                continue
-            if (best is None or unit["data"]["events_per_sec"]
-                    > best["data"]["events_per_sec"]):
-                best, best_name = unit, name
+        for tier in (("headline", "headline_big", "headline_bench"),
+                     ("micro",)):
+            for name in tier:
+                unit = units.get(name)
+                if not unit or unit["data"].get("_platform") == "cpu":
+                    continue
+                if unit["data"].get("res", 8) != res:
+                    continue
+                if (best is None or unit["data"]["events_per_sec"]
+                        > best["data"]["events_per_sec"]):
+                    best, best_name = unit, name
+            if best is not None:
+                break
         if best is None:
             return {}
         data = best["data"]

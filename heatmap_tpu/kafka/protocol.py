@@ -1,9 +1,12 @@
 """Kafka protocol primitives and request/response framing.
 
-Non-flexible (pre-KIP-482) encodings only: the client pins API versions
-that predate tagged fields — ApiVersions v0, Metadata v1, ListOffsets v1,
-Produce v3, Fetch v4 — which every broker since 0.11 (message format v2)
-still serves.  Kept deliberately small; see kafka/client.py for use.
+Non-flexible (pre-KIP-482) encodings only — no tagged fields.  The
+client negotiates per-connection version RANGES within that encoding
+family (client.py `_SUPPORTED`): ApiVersions v0, Metadata v1-v7,
+ListOffsets v1-v3, Produce v3-v7, Fetch v4-v11 — floors serve pre-KIP
+brokers (0.11+, message format v2), ceilings survive the KIP-896
+(Kafka 4.0) removals of early versions.  Kept deliberately small; see
+kafka/client.py for negotiation and use.
 """
 
 from __future__ import annotations

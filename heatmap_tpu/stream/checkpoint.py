@@ -94,11 +94,19 @@ class CheckpointManager:
     # --- write ----------------------------------------------------------
     def commit(self, offset: Any, max_event_ts: int, epoch: int,
                states: dict[tuple[int, int], TileState] | None = None,
-               shards: int | None = None) -> None:
+               shards: int | None = None,
+               snap_impl: str | None = None) -> None:
         """``shards``: the writer's local shard-block count.  Recorded so
         a restart can tell a capacity change (absorbable: pad/grow) from a
         shard-count change (NOT absorbable: rows would be reinterpreted as
-        the wrong shard blocks and keys would land off their owner)."""
+        the wrong shard blocks and keys would land off their owner).
+
+        ``snap_impl``: the H3 snap implementation ("native" host C++ vs
+        "xla" in-program) that keyed the checkpointed state.  The two
+        agree everywhere except f32-rounded points lying exactly on a
+        cell edge, so a resume pins the same impl (runtime._maybe_resume)
+        rather than letting a backend failover re-key edge events
+        mid-stream (ADVICE r4 #1)."""
         name = f"commit-{epoch:012d}"
         cdir = os.path.join(self.dir, name)
         tmp = cdir + ".tmp"
@@ -111,6 +119,8 @@ class CheckpointManager:
                 "epoch": int(epoch)}
         if shards is not None:
             meta["shards"] = int(shards)
+        if snap_impl is not None:
+            meta["snap_impl"] = snap_impl
         with open(os.path.join(tmp, "meta.json"), "w", encoding="utf-8") as fh:
             json.dump(meta, fh)
         shutil.rmtree(cdir, ignore_errors=True)

@@ -188,6 +188,7 @@ class MicroBatchRuntime:
         self._host_snap = None
         self._idle_keys = None
         h3_impl = os.environ.get("HEATMAP_H3_IMPL", "auto")
+        self._h3_env = h3_impl
         # auto: on the CPU backend the C++ host pre-snap is the measured
         # winner (round-3 autotune on this host: native+sort 1.11M ev/s
         # vs xla+sort 0.23M — the in-program snap dominates the batch);
@@ -309,6 +310,7 @@ class MicroBatchRuntime:
         if not meta:
             return
         log.info("resuming from checkpoint: %s", meta)
+        self._pin_snap_impl(meta.get("snap_impl"))
         snap_shards = meta.get("shards")
         if snap_shards is not None and snap_shards != self._local_shards:
             # even an exact-shape restore would be wrong: rows would be
@@ -346,6 +348,73 @@ class MicroBatchRuntime:
                         f"restore STATE_CAPACITY_LOG2/SPEED_HIST_BINS or "
                         f"clear {self.cfg.checkpoint_dir}"
                     ) from e2
+
+    @property
+    def _snap_impl_name(self) -> str:
+        """The H3 snap keying this run's state: host C++ pre-snap vs the
+        in-program (XLA) snap.  Recorded in every checkpoint."""
+        return "native" if self._host_snap is not None else "xla"
+
+    def _pin_snap_impl(self, ck_snap: str | None) -> None:
+        """Keep the snap impl FIXED across a resume (ADVICE r4 #1).
+
+        The native C++ (f64) and XLA (f32) snaps agree except for points
+        landing exactly on a cell edge after f32 rounding; flipping impls
+        mid-stream (e.g. a supervisor TPU→CPU failover where
+        HEATMAP_H3_IMPL=auto re-resolves to native on the CPU backend)
+        would re-key those edge events and split their groups across the
+        resume.  Under ``auto`` the checkpointed impl wins; an explicit
+        env override is honored but the re-keying hazard is logged.
+        """
+        if ck_snap not in ("native", "xla"):
+            # host-uniform branch: the field is written post-agreement,
+            # so every host sees the same (absent/legacy) value and none
+            # reaches the collective below — no desync
+            return
+        if ck_snap != self._snap_impl_name:
+            if self._h3_env != "auto":
+                log.warning(
+                    "checkpoint state was keyed with the %r H3 snap but "
+                    "HEATMAP_H3_IMPL=%s forces %r; events on f32 cell "
+                    "edges may re-key across this resume", ck_snap,
+                    self._h3_env, self._snap_impl_name)
+            elif ck_snap == "xla":
+                self._host_snap = None
+                log.info("pinned H3 snap impl 'xla' from checkpoint "
+                         "(was 'native' under HEATMAP_H3_IMPL=auto)")
+            else:
+                from heatmap_tpu.hexgrid import native_snap
+
+                if native_snap.available():
+                    self._host_snap = native_snap.snap_arrays
+                    log.info("pinned H3 snap impl 'native' from "
+                             "checkpoint (was 'xla' under "
+                             "HEATMAP_H3_IMPL=auto)")
+                else:
+                    log.warning(
+                        "checkpoint state was keyed with the native C++ "
+                        "snap but no C++ toolchain is available; "
+                        "continuing with the in-program snap (f32 "
+                        "cell-edge events may re-key)")
+        if self._multiproc:
+            # same all-or-nothing rule as startup.  EVERY host must reach
+            # this collective whenever ck_snap is valid — the pin outcome
+            # is per-host (toolchain loss, skewed HEATMAP_H3_IMPL), so an
+            # early return above on one host would strand its peers in
+            # the barrier (r5 review finding)
+            have, total, _ = self._gpair(
+                1.0 if self._host_snap is not None else 0.0, 1.0)
+            if self._host_snap is not None and have != total:
+                log.warning(
+                    "only %d/%d hosts resolved the native snap after the "
+                    "checkpoint pin; all hosts fall back to in-program "
+                    "(f32 cell-edge events may re-key)", int(have),
+                    int(total))
+                self._host_snap = None
+            elif self._host_snap is None and have > 0:
+                log.warning(
+                    "peer hosts resolved the native snap but this host "
+                    "cannot; all hosts fall back to in-program")
 
     @property
     def _local_shards(self) -> int:
@@ -415,7 +484,8 @@ class MicroBatchRuntime:
                 for (res, wmin), agg in self.aggs.items()
             }
             self.ckpt.commit(self._offsets_dispatched, self.max_event_ts,
-                             self.epoch, states, shards=self._local_shards)
+                             self.epoch, states, shards=self._local_shards,
+                             snap_impl=self._snap_impl_name)
             self.metrics.count("checkpoints")
             return
         # Single host: capture fresh-buffer device copies + offsets now
@@ -438,7 +508,8 @@ class MicroBatchRuntime:
                 self.writer.drain()
                 states = {k: to_host(s) for k, (s, to_host) in snaps.items()}
                 self.ckpt.commit(offset, max_ts, epoch, states,
-                                 shards=self._local_shards)
+                                 shards=self._local_shards,
+                                 snap_impl=self._snap_impl_name)
                 self.metrics.count("checkpoints")
             except BaseException as e:  # surfaced on the step thread
                 self._ckpt_err = e

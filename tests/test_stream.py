@@ -3,6 +3,7 @@
 plus checkpoint/resume and the monotonic positions contract."""
 
 import datetime as dt
+import json
 import time
 
 import numpy as np
@@ -171,6 +172,44 @@ def test_checkpoint_resume(tmp_path):
     agg3 = rt3.aggs[(res, wmin)]
     for a, b in zip(agg2.state, agg3.state):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_resume_pins_snap_impl_across_backend_failover(tmp_path):
+    """HEATMAP_H3_IMPL=auto re-resolves per backend (native on CPU), so a
+    TPU→CPU supervisor failover would re-key f32 cell-edge events with a
+    different snap than the checkpointed state was built with.  The
+    checkpoint records the impl and a resume under `auto` pins it
+    (ADVICE r4 #1)."""
+    cfg = mk_cfg(tmp_path)
+    src = SyntheticSource(n_events=1024, n_vehicles=20,
+                          events_per_second=512)
+    rt = MicroBatchRuntime(cfg, src, MemoryStore(), checkpoint_every=1)
+    impl_run1 = rt._snap_impl_name
+    rt.step_once()
+    rt._checkpoint()
+    rt._ckpt_join()
+    meta = rt.ckpt.load_meta()
+    assert meta["snap_impl"] == impl_run1
+    rt.close()
+
+    # simulate the post-failover backend resolving the OTHER impl: force
+    # the opposite of what run 1 recorded, then resume under auto
+    other = "xla" if impl_run1 == "native" else "native"
+    cdir = rt.ckpt._commit_dir()
+    meta["snap_impl"] = other
+    with open(f"{cdir}/meta.json", "w", encoding="utf-8") as fh:
+        json.dump(meta, fh)
+    src2 = SyntheticSource(n_events=1024, n_vehicles=20,
+                          events_per_second=512)
+    rt2 = MicroBatchRuntime(cfg, src2, MemoryStore(), checkpoint_every=0)
+    from heatmap_tpu.hexgrid import native_snap
+
+    if other == "xla" or native_snap.available():
+        assert rt2._snap_impl_name == other, (
+            "resume under auto must keep the checkpointed snap impl")
+    else:  # pin unsatisfiable without a toolchain: falls back loudly
+        assert rt2._snap_impl_name == "xla"
+    rt2.close()
 
 
 def test_watermark_drops_late_events(tmp_path):
@@ -494,6 +533,36 @@ def test_end_to_end_per_cell_differential(tmp_path):
         else:
             hi, lo = latlng_deg_to_cell_vec(lat, lon, res)
         cells_by_res[res] = cells_to_strings(np.asarray(hi), np.asarray(lo))
+    # the oracle above deliberately shares the runtime's own snap, so by
+    # itself it could not see a native-vs-XLA cell-assignment divergence
+    # in the very pipeline it exercises (ADVICE r4 #2) — pin the two
+    # impls against each other independently for THIS test's events:
+    # whichever impl `auto` resolved, the other must agree except on f32
+    # cell-edge points, and every disagreement must be attributable to
+    # f32 rounding (the f64 host oracle sides with native there)
+    from heatmap_tpu.hexgrid import host, native_snap
+
+    if native_snap.available():
+        for res in (7, 8):
+            hi_x, lo_x = latlng_deg_to_cell_vec(lat, lon, res)
+            hi_n, lo_n = native_snap.snap_arrays(
+                np.radians(lat), np.radians(lon), res)
+            mism = np.nonzero((np.asarray(hi_x) != np.asarray(hi_n))
+                              | (np.asarray(lo_x) != np.asarray(lo_n)))[0]
+            assert mism.size <= max(1, len(evs) // 500), (
+                f"native vs XLA snap diverge on {mism.size}/{len(evs)} "
+                f"events at res {res} — far beyond f32 edge rounding; "
+                f"the auto default re-keys cells")
+            for i in mism:
+                want = host.latlng_to_cell_int(
+                    float(np.float64(np.radians(lat[i]))),
+                    float(np.float64(np.radians(lon[i]))), res)
+                got_n = (int(np.asarray(hi_n)[i]) << 32) | int(
+                    np.asarray(lo_n)[i])
+                assert got_n == want, (
+                    f"event {i} res {res}: native snap disagrees with the "
+                    f"f64 host oracle — a real mis-keying, not f32 edge "
+                    f"rounding")
     oracle: dict = collections.defaultdict(lambda: [0, 0.0])
     for i, e in enumerate(evs):
         ts = int(dt.datetime.strptime(e["ts"], "%Y-%m-%dT%H:%M:%S%z")

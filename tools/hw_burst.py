@@ -73,6 +73,7 @@ POLL_S = 10
 UNITS: dict[str, tuple[int, int]] = {
     "contact": (60, 30),
     "micro": (150, 20),
+    "pallas_lowers": (120, 15),
     "headline": (600, 12),
     "snap_xla_r8": (300, 10),
     "snap_pal_r8": (420, 10),
@@ -179,6 +180,32 @@ def unit_snap_pallas(res: int) -> dict:
             "xla_ms": round(t_xla * 1e3, 3),
             "speedup_vs_xla": round(t_xla / t_pal, 3),
             "agree_frac": round(agree, 6)}
+
+
+def unit_pallas_lowers() -> dict:
+    """Cheapest possible Mosaic-lowering probe: does the Pallas snap
+    kernel compile for this device at all?  Banked as a standalone
+    boolean so even a ~60-second relay window answers the question the
+    snap_pal_* timing units need minutes for (hexgrid/pallas_kernel.py's
+    'never lowered through Mosaic on hardware' caveat)."""
+    import jax
+
+    _device_ready()
+    from heatmap_tpu.hexgrid import pallas_kernel
+
+    n = 1 << 10  # tiny: we want the compile verdict, not a timing
+    lat, lng = _rand_latlng(n)
+    t0 = time.perf_counter()
+    try:
+        fn = jax.jit(
+            lambda a, b: pallas_kernel.latlng_to_cell_pallas(a, b, 8))
+        jax.block_until_ready(fn(lat, lng))
+    except Exception as e:  # noqa: BLE001 - Mosaic lowering may fail
+        return {"pallas_lowers": False, "res": 8, "n": n,
+                "compile_s": round(time.perf_counter() - t0, 2),
+                "error": f"{type(e).__name__}: {e}"[:500]}
+    return {"pallas_lowers": True, "res": 8, "n": n,
+            "compile_s": round(time.perf_counter() - t0, 2)}
 
 
 def unit_merge(shape: str) -> dict:
@@ -327,6 +354,7 @@ UNIT_FNS = {
     # (256k events, small slab) — sized for a ~2-minute relay window
     "micro": lambda: unit_headline(total=1 << 18, batch=1 << 16,
                                    chunk=2, cap=1 << 14),
+    "pallas_lowers": unit_pallas_lowers,
     "headline": unit_headline,
     "headline_big": lambda: unit_headline(total=1 << 23, batch=1 << 20,
                                           chunk=4, cap=1 << 18),
@@ -516,6 +544,13 @@ def report() -> None:
                 f"{d['emitted_rows']} emit rows, "
                 f"overflow {d['state_overflow']}")
         lines.append("")
+    if "pallas_lowers" in hw:
+        d = hw["pallas_lowers"]
+        verdict = ("**lowers**" if d.get("pallas_lowers")
+                   else f"**FAILS**: {d.get('error', '?')[:160]}")
+        lines += ["## Pallas Mosaic lowering (standalone probe)", "",
+                  f"- res {d.get('res')} snap kernel on-device: {verdict} "
+                  f"(compile {d.get('compile_s', '?')}s)", ""]
     snaps = {k: v for k, v in hw.items() if k.startswith("snap_")}
     if snaps:
         lines += ["## H3 snap: Pallas vs XLA (1M points)", "",
