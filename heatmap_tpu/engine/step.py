@@ -65,7 +65,7 @@ MERGE_IMPL: "str | None" = None
 
 def _resolve_merge_impl() -> str:
     return (MERGE_IMPL if MERGE_IMPL is not None
-            else os.environ.get("HEATMAP_MERGE_IMPL", "sort"))
+            else os.environ.get("HEATMAP_MERGE_IMPL", "auto"))
 
 # _merge_probe tunables (resolved once at import — they only shape the
 # probe impl's internal loop, not results, and tests patch the module
@@ -74,6 +74,16 @@ def _resolve_merge_impl() -> str:
 # floor 256).
 PROBE_ROUNDS = int(os.environ.get("HEATMAP_PROBE_ROUNDS", "16"))
 PROBE_UNIQ_DIV = int(os.environ.get("HEATMAP_PROBE_UNIQ_DIV", "8"))
+
+# Steady-state fast path (HEATMAP_FASTPATH=0 disables; module override
+# slot for tests).  Read at trace time like the merge impl.
+FASTPATH: "bool | None" = None
+
+
+def _resolve_fastpath() -> bool:
+    if FASTPATH is not None:
+        return FASTPATH
+    return os.environ.get("HEATMAP_FASTPATH", "1") != "0"
 
 
 class AggParams(NamedTuple):
@@ -225,9 +235,14 @@ def merge_batch(
     with ``HEATMAP_MERGE_IMPL=rank`` — a batch-only sort merged into the
     already-sorted slab by rank (searchsorted), which does ~sort(N)
     instead of ~sort(C+N) work and wins when the slab dwarfs the batch
-    (latency-oriented streaming configs).  ``auto`` picks by the measured
-    crossover: rank when capacity >= 4x batch (both shapes benched on
-    CPU, see ROADMAP.md — to be re-confirmed on chip).  The env var is
+    (latency-oriented streaming configs).  ``auto`` (the default) picks
+    by the measured crossover: rank when capacity >= 4x batch.  The
+    round-5 warm-slab arg-passing A/B (the only valid methodology —
+    closed-over batch arrays get constant-folded by XLA and an empty
+    slab drops every state-side scatter, both of which silently flatter
+    rank) confirms it on CPU: sort wins 2^18-batch shapes, rank wins
+    2^14-batch streaming shapes by ~1.5x; on-chip crossover pending
+    tools/hw_burst.py merge units.  The env var is
     read at trace time (module override slot ``MERGE_IMPL`` wins when
     set — bench sweeps and tests use it); pass ``impl`` explicitly to
     override per call."""
@@ -235,16 +250,14 @@ def merge_batch(
         impl = _resolve_merge_impl()
     if impl == "auto":
         impl = "rank" if state.capacity >= 4 * ev_hi.shape[0] else "sort"
-    if impl == "rank":
-        return _merge_rank(state, ev_hi, ev_lo, ev_ws, ev_speed, ev_lat_deg,
-                           ev_lon_deg, ev_ts, ev_valid, watermark_cutoff,
-                           params)
-    if impl == "probe":
-        return _merge_probe(state, ev_hi, ev_lo, ev_ws, ev_speed,
-                            ev_lat_deg, ev_lon_deg, ev_ts, ev_valid,
-                            watermark_cutoff, params)
-    return _merge_sort(state, ev_hi, ev_lo, ev_ws, ev_speed, ev_lat_deg,
-                       ev_lon_deg, ev_ts, ev_valid, watermark_cutoff, params)
+    slow = {"rank": _merge_rank, "probe": _merge_probe,
+            "sort": _merge_sort}[impl]
+    if _resolve_fastpath():
+        return _merge_fastpath(state, ev_hi, ev_lo, ev_ws, ev_speed,
+                               ev_lat_deg, ev_lon_deg, ev_ts, ev_valid,
+                               watermark_cutoff, params, impl)
+    return slow(state, ev_hi, ev_lo, ev_ws, ev_speed, ev_lat_deg,
+                ev_lon_deg, ev_ts, ev_valid, watermark_cutoff, params)
 
 
 @functools.partial(jax.jit, static_argnames=("params",))
@@ -538,6 +551,226 @@ def _merge_probe(
     return _apply_routing(state, ev_hi, ev_lo, ev_ws, ev_speed, ev_lat_deg,
                           ev_lon_deg, ev_ts, ev_valid, late, evict, keep,
                           state_seg, batch_seg, n_distinct, params)
+
+
+def _fastpath_probe_full(state, ev_hi, ev_lo, ev_ws, ev_valid,
+                         watermark_cutoff, params: AggParams):
+    """The fast-path predicate: per-event binary search against the
+    sorted slab.  Returns the masked prologue outputs, compressed keys,
+    per-event row position, hit mask, and the tier-1 fast_ok scalar.
+
+    The prologue runs on masked COPIES of the event arrays; the slow
+    branch gets the ORIGINALS (its own prologue must see late rows to
+    count them in its stats)."""
+    C = state.capacity
+    (late, ev_valid_m, ev_hi_m, ev_lo_m, ev_ws_m, evict, keep, st_hi,
+     st_lo, st_ws) = _drop_and_evict(state, ev_hi, ev_lo, ev_ws, ev_valid,
+                                     watermark_cutoff, params)
+    st_k1 = _compress_key(st_hi, st_ws, ~keep, params)
+    ev_k1 = _compress_key(ev_hi_m, ev_ws_m, ~ev_valid_m, params)
+    pos = _searchsorted_pair(st_k1, st_lo, ev_k1, ev_lo_m)
+    i = jnp.clip(pos, 0, C - 1)
+    hit = (ev_valid_m & (pos < C) & (st_k1[i] == ev_k1)
+           & (st_lo[i] == ev_lo_m))
+    # with evictions the slab has EMPTY holes mid-array and the search
+    # above ran against an unsorted sequence — `hit` is then garbage,
+    # but the evict term already forces the slow branch
+    fast_ok = jnp.all(hit == ev_valid_m) & ~jnp.any(evict)
+    return (late, ev_valid_m, ev_hi_m, ev_lo_m, ev_ws_m, evict, keep,
+            ev_k1, st_k1, st_lo, pos, hit, fast_ok)
+
+
+def _fastpath_probe(state, ev_hi, ev_lo, ev_ws, ev_valid,
+                    watermark_cutoff, params: AggParams):
+    """Compact view of `_fastpath_probe_full` for the predicate tests:
+    (late, masked ev_valid, positions, hit mask, tier-1 fast_ok)."""
+    (late, ev_valid_m, _hi, _lo, _ws, _evict, _keep, _k1, _sk1, _slo,
+     pos, hit, fast_ok) = _fastpath_probe_full(
+        state, ev_hi, ev_lo, ev_ws, ev_valid, watermark_cutoff, params)
+    return late, ev_valid_m, pos, hit, fast_ok
+
+
+@functools.partial(jax.jit, static_argnames=("params", "slow_impl"))
+def _merge_fastpath(
+    state: TileState,
+    ev_hi, ev_lo, ev_ws, ev_speed, ev_lat_deg, ev_lon_deg, ev_ts, ev_valid,
+    watermark_cutoff,
+    params: AggParams,
+    slow_impl: str,
+):
+    """Steady-state fast path wrapped around any routing impl.
+
+    In a warmed stream most batches touch ONLY existing (cell, window)
+    groups and evict nothing — yet every impl above rebuilds the entire
+    slab (sort/scatter every lane of every row) per batch, which is the
+    dominant cost at production shapes (~4/5 of the fold wall on CPU,
+    round-5 attribution).  This wrapper binary-searches each event
+    against the sorted slab directly (no batch sort, no dedup —
+    duplicate hits are scatter-adds) and, when every valid event hits an
+    existing row and no window evicts, applies the batch with in-place
+    scatter-adds on the touched rows only; otherwise it falls through to
+    the configured slow impl for THIS batch via ``lax.cond``.
+
+    Three tiers, cheapest condition first (``lax.cond`` nest):
+
+    1. **all-hit**: every valid event matched an existing row and no
+       window evicts — in-place scatter-adds only, the slab untouched.
+    2. **few misses** (≤ max(1024, N/16) events): hit events take their
+       searched positions directly; only the miss events compact into a
+       small buffer, sort, and ride the rank impl's insertion rails
+       (`_route_via_uniques` + `_apply_routing`).  This replaces rank's
+       full-batch sort — the dominant term at production batches — with
+       a sort of just the misses, and produces the exact routing tables
+       rank would (proof sketch: a matched unique's shift `before(u)`
+       equals `cumsum(cnt_new)[p_state(u)]` because a new key inserting
+       at or before a matched row is strictly smaller than it).
+    3. otherwise (evictions, miss burst, window turnover): the
+       configured slow impl, unchanged.
+
+    Bit-identity with the slow path on tier-1/2 batches is by
+    construction: tier 1 replicates `_apply_routing`'s arithmetic under
+    its no-new-keys/no-evict conditions — including the slow path's
+    Kahan rewrite of untouched rows (sum' = sum - comp, comp' absorbs
+    it) — and tier 2 feeds `_apply_routing` itself with rank-identical
+    routing tables.  Differential-tested per batch
+    (tests/test_merge_fastpath.py).  The slab's sorted invariant is
+    preserved by every tier."""
+    C = state.capacity
+    N = ev_hi.shape[0]
+    M = max(1024, N // 16)  # miss-event budget for the insert tier
+    (late, ev_valid_m, ev_hi_m, ev_lo_m, ev_ws_m, evict, keep,
+     ev_k1, st_k1, st_lo_m, pos, hit, fast_ok) = _fastpath_probe_full(
+        state, ev_hi, ev_lo, ev_ws, ev_valid, watermark_cutoff, params)
+
+    def fast(_):
+        B = state.hist_bins
+        E = params.emit_capacity
+        gi = jnp.where(hit, pos, C)          # drop bin for misses
+        gic = jnp.clip(gi, 0, C - 1)
+        one = hit.astype(jnp.int32)
+        count = state.count.at[gi].add(one, mode="drop")
+
+        resid = lambda ev, anc: jnp.where(hit, ev - anc[gic], 0.0)
+        r_speed = resid(ev_speed, state.anchor_speed)
+        r_lat = resid(ev_lat_deg, state.anchor_lat)
+        r_lon = resid(ev_lon_deg, state.anchor_lon)
+        ev_vals = jnp.stack([
+            r_speed, r_speed * r_speed, r_lat, r_lon,
+        ], axis=1)
+        # the slow path's epilogue Kahan-rewrites EVERY row (untouched
+        # rows become sum-comp with comp absorbing the shift); replicate
+        # it exactly so fast and slow batches interleave bit-identically
+        base = jnp.stack([
+            state.sum_speed, state.sum_speed2, state.sum_lat,
+            state.sum_lon,
+        ], axis=1)
+        delta = jnp.zeros((C, 4), jnp.float32).at[gi].add(
+            ev_vals, mode="drop")
+        y = delta - state.comp
+        t = base + y
+        comp = (t - base) - y
+        sum_speed, sum_speed2, sum_lat, sum_lon = (
+            t[:, 0], t[:, 1], t[:, 2], t[:, 3]
+        )
+        if B > 0:
+            bin_w = params.speed_hist_max / B
+            ev_bin = jnp.clip((ev_speed / bin_w).astype(jnp.int32), 0,
+                              B - 1)
+            hist = state.hist.at[gi, ev_bin].add(one, mode="drop")
+        else:
+            hist = state.hist
+        new_state = TileState(
+            key_hi=state.key_hi, key_lo=state.key_lo, key_ws=state.key_ws,
+            count=count, sum_speed=sum_speed, sum_speed2=sum_speed2,
+            sum_lat=sum_lat, sum_lon=sum_lon, hist=hist,
+            anchor_speed=state.anchor_speed, anchor_lat=state.anchor_lat,
+            anchor_lon=state.anchor_lon, comp=comp,
+        )
+
+        touched = jnp.zeros((C,), bool).at[gi].set(True, mode="drop")
+        n_emitted = jnp.sum(touched.astype(jnp.int32))
+        emit_idx = jnp.nonzero(touched, size=E, fill_value=C)[0]
+        emit_ok = emit_idx < C
+        g = jnp.where(emit_ok, emit_idx, 0)
+        emit = BatchEmit(
+            key_hi=jnp.where(emit_ok, state.key_hi[g], EMPTY_KEY_HI),
+            key_lo=jnp.where(emit_ok, state.key_lo[g], EMPTY_KEY_LO),
+            key_ws=jnp.where(emit_ok, state.key_ws[g], EMPTY_WS),
+            count=jnp.where(emit_ok, count[g], 0),
+            sum_speed=jnp.where(emit_ok, sum_speed[g], 0.0),
+            sum_speed2=jnp.where(emit_ok, sum_speed2[g], 0.0),
+            sum_lat=jnp.where(emit_ok, sum_lat[g], 0.0),
+            sum_lon=jnp.where(emit_ok, sum_lon[g], 0.0),
+            anchor_speed=jnp.where(emit_ok, state.anchor_speed[g], 0.0),
+            anchor_lat=jnp.where(emit_ok, state.anchor_lat[g], 0.0),
+            anchor_lon=jnp.where(emit_ok, state.anchor_lon[g], 0.0),
+            hist=hist[g] * emit_ok[:, None].astype(jnp.int32) if B > 0
+            else jnp.zeros((E, 0), jnp.int32),
+            valid=emit_ok,
+            n_emitted=n_emitted,
+            overflowed=n_emitted > E,
+        )
+        n_valid = jnp.sum(one)
+        stats = StepStats(
+            n_valid=n_valid,
+            n_late=jnp.sum(late.astype(jnp.int32)),
+            # zero by the tier-1 predicate, but derived from varying data
+            # (a literal 0 would give this branch an unvarying aval and
+            # break lax.cond type agreement under shard_map)
+            n_evicted=jnp.sum(evict.astype(jnp.int32)),
+            n_active=jnp.sum((state.key_hi != EMPTY_KEY_HI)
+                             .astype(jnp.int32)),
+            state_overflow=0 * n_valid,
+            batch_max_ts=jnp.max(jnp.where(ev_valid_m, ev_ts, I32_MIN)),
+        )
+        return new_state, emit, stats
+
+    miss = ev_valid_m & ~hit
+    n_miss = jnp.sum(miss.astype(jnp.int32))
+    insert_ok = (~jnp.any(evict)) & (n_miss <= M) & (n_miss > 0)
+
+    def insert(_):
+        """Tier 2: hits keep their searched rows; only the miss events
+        sort (M rows, not N) and ride the rank insertion rails."""
+        U32MAX = jnp.uint32(0xFFFFFFFF)
+        midx = jnp.nonzero(miss, size=M, fill_value=N)[0]
+        mvalid = midx < N
+        mi = jnp.clip(midx, 0, N - 1)
+        mk1 = jnp.where(mvalid, ev_k1[mi], U32MAX)
+        mk2 = jnp.where(mvalid, ev_lo_m[mi], U32MAX)
+        mu1, mu2, uid_m = _sorted_batch_uniques(mk1, mk2, M)
+        # event -> its M-slot -> unique id (only meaningful for misses)
+        slot_of_event = (jnp.zeros((N,), jnp.int32)
+                         .at[jnp.where(mvalid, midx, N)]
+                         .set(jnp.arange(M, dtype=jnp.int32), mode="drop"))
+        c1, c2, pos_k, n_keep = _compact_state(keep, st_k1, st_lo_m, C)
+        state_seg, batch_seg_u, n_distinct = _route_via_uniques(
+            c1, c2, pos_k, keep, n_keep, mu1, mu2,
+            uid_m[jnp.clip(slot_of_event, 0, M - 1)],
+            miss, C)
+        # hits: final position = searched row + #new keys inserted at or
+        # before it (== rank's `before` for a matched unique; see proof
+        # sketch in the docstring).  Recover the shift from state_seg:
+        # row r moved to state_seg[r], so shift lives in the same table.
+        hit_rows = jnp.clip(pos, 0, C - 1)
+        batch_seg = jnp.where(
+            hit, state_seg[hit_rows],
+            jnp.where(miss, batch_seg_u, C))
+        return _apply_routing(state, ev_hi_m, ev_lo_m, ev_ws_m, ev_speed,
+                              ev_lat_deg, ev_lon_deg, ev_ts, ev_valid_m,
+                              late, evict, keep, state_seg, batch_seg,
+                              n_distinct, params)
+
+    def slow(_):
+        fn = {"rank": _merge_rank, "probe": _merge_probe,
+              "sort": _merge_sort}[slow_impl]
+        return fn(state, ev_hi, ev_lo, ev_ws, ev_speed, ev_lat_deg,
+                  ev_lon_deg, ev_ts, ev_valid, watermark_cutoff, params)
+
+    def not_fast(_):
+        return jax.lax.cond(insert_ok, insert, slow, None)
+
+    return jax.lax.cond(fast_ok, fast, not_fast, None)
 
 
 def _apply_routing(

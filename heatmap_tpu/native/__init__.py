@@ -128,7 +128,7 @@ def _load():
             i64p, ctypes.POINTER(ctypes.c_int64),
         ]
         f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
-        lib.h3_snap_f32.argtypes = [
+        _snap_args = [
             f32p, f32p, ctypes.c_int64, ctypes.c_int,
             f64p, f64p, f64p,
             ctypes.c_double, ctypes.c_double, ctypes.c_double,
@@ -136,6 +136,10 @@ def _load():
             ctypes.c_int,
             u32p, u32p,
         ]
+        lib.h3_snap_f32.argtypes = _snap_args
+        # scalar-only entry: the reference path the SIMD block path is
+        # differential-tested against (tests/test_native_snap.py)
+        lib.h3_snap_f32_scalar.argtypes = _snap_args
         _LIB = lib
         return _LIB
 
@@ -542,9 +546,11 @@ class NativeH3Snap:
     def available() -> bool:
         return _load() is not None
 
-    def snap(self, lat_rad, lng_rad, res: int):
+    def snap(self, lat_rad, lng_rad, res: int, scalar: bool = False):
         """(N,) f32 radians -> (hi, lo) uint32 arrays.  res <= 10 (the
-        packed-digit-chain form; higher res goes through the XLA path)."""
+        packed-digit-chain form; higher res goes through the XLA path).
+        ``scalar=True`` forces the scalar reference path (bypassing the
+        AVX-512 block path) — for differential tests only."""
         if not 0 <= res <= 10:
             raise ValueError(f"native snap supports res 0..10, got {res}")
         lat = np.ascontiguousarray(lat_rad, np.float32).reshape(-1)
@@ -557,12 +563,13 @@ class NativeH3Snap:
         n = lat.shape[0]
         hi = np.empty(n, np.uint32)
         lo = np.empty(n, np.uint32)
-        self._lib.h3_snap_f32(
-            lat, lng, n, res, self._face_xyz, self._u1, self._u2,
-            self._rot_cos, self._rot_sin, float(self._sqrt7 ** res),
-            self._down_ap7, self._down_ap7r, self._bc, self._rot,
-            self._pent, self._cw_off, self._ccw_pow, self._k_digit,
-            hi, lo)
+        fn = (self._lib.h3_snap_f32_scalar if scalar
+              else self._lib.h3_snap_f32)
+        fn(lat, lng, n, res, self._face_xyz, self._u1, self._u2,
+           self._rot_cos, self._rot_sin, float(self._sqrt7 ** res),
+           self._down_ap7, self._down_ap7r, self._bc, self._rot,
+           self._pent, self._cw_off, self._ccw_pow, self._k_digit,
+           hi, lo)
         shape = np.shape(lat_rad)
         return hi.reshape(shape), lo.reshape(shape)
 

@@ -1,0 +1,181 @@
+"""Steady-state fast-path differential: `_merge_fastpath` must be
+bit-identical to the wrapped slow impl on EVERY batch — fast ones (all
+events hit existing rows, nothing evicts) take the in-place branch,
+everything else falls through — and the predicate itself is pinned on
+concrete scenarios so the equivalence test cannot pass vacuously with
+the fast branch never taken."""
+
+import numpy as np
+import pytest
+from unittest import mock
+
+import jax
+
+from heatmap_tpu.engine import AggParams, init_state
+from heatmap_tpu.engine import step as step_mod
+from heatmap_tpu.engine.step import (
+    _fastpath_probe,
+    merge_batch,
+    snap_and_window,
+)
+
+P = AggParams(res=8, window_s=300, emit_capacity=512)
+T0 = 1_700_000_000 - (1_700_000_000 % 300)
+
+
+def mk_batch(rng, n, t0=T0, spread_s=200):
+    lat = np.radians(rng.uniform(42.30, 42.40, n)).astype(np.float32)
+    lng = np.radians(rng.uniform(-71.10, -71.00, n)).astype(np.float32)
+    speed = rng.uniform(0, 120, n).astype(np.float32)
+    ts = (t0 + rng.integers(0, spread_s, n)).astype(np.int32)
+    valid = np.ones(n, bool)
+    return lat, lng, speed, ts, valid
+
+
+def fold_args(batch, params=P):
+    lat, lng, speed, ts, valid = batch
+    hi, lo, ws = snap_and_window(lat, lng, ts, valid, params)
+    return (hi, lo, ws, speed,
+            np.degrees(lat.astype(np.float64)).astype(np.float32),
+            np.degrees(lng.astype(np.float64)).astype(np.float32),
+            ts, valid)
+
+
+def assert_trees_equal(a, b, msg=""):
+    fa, _ = jax.tree_util.tree_flatten(a)
+    fb, _ = jax.tree_util.tree_flatten(b)
+    assert len(fa) == len(fb)
+    for x, y in zip(fa, fb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=msg)
+
+
+@pytest.mark.parametrize("impl", ["sort", "rank", "probe"])
+def test_fastpath_bit_identical_over_stream(impl):
+    """A 6-batch stream interleaving fast batches (repeat keys), a
+    new-key batch, a late batch, and an eviction batch: state, emit, and
+    stats must match the fastpath-disabled run bit-for-bit at every
+    step."""
+    rng = np.random.default_rng(3)
+    b1 = mk_batch(rng, 1024)
+    b2 = mk_batch(np.random.default_rng(3), 1024)      # same keys as b1
+    b3 = mk_batch(rng, 1024, t0=T0)                    # mostly new cells
+    late = mk_batch(rng, 256, t0=T0 - 7200)            # all late
+    fut = mk_batch(rng, 256, t0=T0 + 1800)             # next windows
+    batches = [
+        (b1, np.int32(-(2**31))),
+        (b2, np.int32(-(2**31))),                      # fast candidate
+        (b3, np.int32(-(2**31))),
+        (late, np.int32(T0 - 600)),                    # drops + maybe evict
+        (b2, np.int32(T0 - 600)),                      # fast again
+        (fut, np.int32(T0 + 1500)),                    # evicts old windows
+    ]
+
+    def run(fastpath):
+        with mock.patch.object(step_mod, "FASTPATH", fastpath):
+            st = init_state(1 << 12, 16)
+            outs = []
+            for batch, cutoff in batches:
+                st, emit, stats = merge_batch(st, *fold_args(batch),
+                                              cutoff, P, impl=impl)
+                outs.append((st, emit, stats))
+            return outs
+
+    for i, (a, b) in enumerate(zip(run(True), run(False))):
+        assert_trees_equal(a, b, msg=f"batch {i} impl {impl}")
+
+
+@pytest.mark.parametrize("impl", ["sort", "rank"])
+def test_tier2_gradual_turnover_bit_identical(impl):
+    """The realistic streaming pattern — most events hit existing rows,
+    a few new cells appear per batch (tier 2), and occasionally a miss
+    burst exceeds the budget (tier 3) — stays bit-identical to the
+    fastpath-disabled run.  N=4096 puts the miss budget at
+    max(1024, 256)=1024, so the 2000-new-cell burst batch exercises the
+    full-slow tier while the 50-cell drips exercise the insert tier."""
+    rng = np.random.default_rng(11)
+    base = mk_batch(rng, 4096)
+
+    def with_new_cells(n_new, seed):
+        r = np.random.default_rng(seed)
+        lat, lng, speed, ts, valid = mk_batch(np.random.default_rng(11),
+                                              4096)
+        idx = r.choice(4096, n_new, replace=False)
+        lat[idx] = np.radians(r.uniform(43.0, 43.5, n_new)).astype(
+            np.float32)
+        lng[idx] = np.radians(r.uniform(-70.5, -70.0, n_new)).astype(
+            np.float32)
+        return lat, lng, speed, ts, valid
+
+    batches = [base, with_new_cells(50, 1), with_new_cells(50, 2),
+               with_new_cells(2000, 3), base]
+    cut = np.int32(-(2**31))
+
+    def run(fastpath):
+        with mock.patch.object(step_mod, "FASTPATH", fastpath):
+            st = init_state(1 << 13, 8)
+            outs = []
+            for b in batches:
+                st, emit, stats = merge_batch(st, *fold_args(b), cut, P,
+                                              impl=impl)
+                outs.append((st, emit, stats))
+            return outs
+
+    for i, (a, b) in enumerate(zip(run(True), run(False))):
+        assert_trees_equal(a, b, msg=f"batch {i} impl {impl}")
+
+
+def test_predicate_scenarios():
+    """fast_ok exactly when every valid event hits an existing row and
+    no window evicts."""
+    rng = np.random.default_rng(5)
+    b1 = mk_batch(rng, 1024)
+    st = init_state(1 << 12, 0)
+    cut = np.int32(-(2**31))
+    st, _, _ = merge_batch(st, *fold_args(b1), cut, P, impl="sort")
+
+    # same keys again -> fast
+    b2 = mk_batch(np.random.default_rng(5), 1024)
+    *_, ok = _fastpath_probe(st, *fold_args(b2)[:3], fold_args(b2)[7],
+                             cut, P)
+    assert bool(ok)
+
+    # a genuinely new cell -> slow
+    b3 = mk_batch(rng, 8, t0=T0)
+    lat, lng, speed, ts, valid = b3
+    lat = lat + np.float32(np.radians(0.5))            # outside the box
+    hi, lo, ws = snap_and_window(lat, lng, ts, valid, P)
+    *_, ok = _fastpath_probe(st, hi, lo, ws, valid, cut, P)
+    assert not bool(ok)
+
+    # watermark that closes the resident window -> slow (evictions)
+    b2a = fold_args(b2)
+    *_, ok = _fastpath_probe(st, *b2a[:3], b2a[7], np.int32(T0 + 600), P)
+    assert not bool(ok)
+
+    # late-only batch against live slab: lates are masked out, nothing
+    # evicts, every REMAINING (zero) event hits -> fast (vacuously)
+    bl = mk_batch(rng, 16, t0=T0 - 7200)
+    bla = fold_args(bl)
+    *_, ok = _fastpath_probe(st, *bla[:3], bla[7], np.int32(T0 - 600), P)
+    assert bool(ok)
+
+
+def test_fastpath_env_gate(monkeypatch):
+    """HEATMAP_FASTPATH=0 routes straight to the slow impl (no cond)."""
+    rng = np.random.default_rng(7)
+    b = mk_batch(rng, 256)
+    st = init_state(1 << 10, 0)
+    with mock.patch.object(step_mod, "FASTPATH", None):
+        monkeypatch.setenv("HEATMAP_FASTPATH", "0")
+        with mock.patch.object(step_mod, "_merge_fastpath",
+                               wraps=step_mod._merge_fastpath) as fp:
+            merge_batch(st, *fold_args(b), np.int32(-(2**31)), P,
+                        impl="sort")
+            assert not fp.called
+        monkeypatch.delenv("HEATMAP_FASTPATH")
+        with mock.patch.object(step_mod, "_merge_fastpath",
+                               wraps=step_mod._merge_fastpath) as fp:
+            merge_batch(st, *fold_args(b), np.int32(-(2**31)), P,
+                        impl="sort")
+            assert fp.called

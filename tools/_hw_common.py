@@ -66,7 +66,18 @@ def merge_impl_times(batch: int, cap: int, hist_bins: int = 16) -> dict:
     """Time every merge-fold impl at one (batch, slab) shape — THE
     shared measurement both hw_burst's merge units and validate_on_tpu's
     merge bench report, so the tools cannot drift on what they compare.
-    Returns {impl_name: ms}."""
+    Returns {impl_name: ms}.
+
+    Methodology (round-5 correction): the batch arrays are passed as
+    jit ARGUMENTS (closed-over numpy becomes jaxpr constants and XLA
+    constant-folds the batch sort — flattering rank by >2x), and the
+    folds run against a WARM slab (an empty slab routes every state-side
+    scatter to the drop bin, hiding the full rebuild cost), with the
+    impls interleaved per round so host clock drift cancels."""
+    import statistics
+
+    import jax
+
     from heatmap_tpu.engine import init_state
     from heatmap_tpu.engine.step import (
         _merge_probe,
@@ -74,13 +85,23 @@ def merge_impl_times(batch: int, cap: int, hist_bins: int = 16) -> dict:
         _merge_sort,
     )
 
-    args = merge_fold_args(batch)
-    out = {}
-    for name, fn in (("sort", _merge_sort), ("rank", _merge_rank),
-                     ("probe", _merge_probe)):
-        out[name] = timed(lambda s, f=fn: f(s, *args)[0],
-                          init_state(cap, hist_bins)) * 1e3
-    return out
+    *args, p = merge_fold_args(batch)
+    fns = {
+        name: jax.jit(lambda s, *a, f=f: f(s, *a, p)[0])
+        for name, f in (("sort", _merge_sort), ("rank", _merge_rank),
+                        ("probe", _merge_probe))
+    }
+    warm = fns["sort"](init_state(cap, hist_bins), *args)
+    jax.block_until_ready(warm)
+    for fn in fns.values():  # compile+warm every impl before timing any
+        jax.block_until_ready(fn(warm, *args))
+    times: dict[str, list] = {k: [] for k in fns}
+    for _ in range(5):
+        for name, fn in fns.items():
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(warm, *args))
+            times[name].append(time.perf_counter() - t0)
+    return {k: statistics.median(v) * 1e3 for k, v in times.items()}
 
 
 def merge_fold_args(batch: int, seed: int = 1):
