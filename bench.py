@@ -298,24 +298,46 @@ def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
 
         emitted_rows = 0
         chunk_walls = []
+        # per-phase attribution (VERDICT r4 item 2: the artifact must
+        # say WHERE the batch wall goes): host snap+feed vs device fold
+        # vs emit pull, per chunk
+        span_feed, span_fold, span_pull = [], [], []
+        on_cpu = jax.default_backend() == "cpu"
         pending = None
         t_start = time.monotonic()
         last = t_start
         for c in range(n_chunks):
+            t0 = time.monotonic()
             ev = {k: jax.device_put(v[c]) for k, v in host_events.items()}
             if host_snap is not None:
                 # inside the timed wall: the pipeline pays this host work
                 ev.update({k: jax.device_put(v)
                            for k, v in _chunk_keys(c).items()})
+            t1 = time.monotonic()
             carry, packed = run_chunk(carry, ev)
+            if on_cpu:
+                # single-core: no real compute/pull overlap exists, so a
+                # sync here cleanly splits fold from pull.  On
+                # accelerators dispatch stays async (the pull of the
+                # previous chunk overlaps this chunk's compute) and
+                # span_pull absorbs the device wall instead.
+                jax.block_until_ready(packed)
+            t2 = time.monotonic()
             if pending is not None:
                 # ONE D2H for the whole chunk's emits (per-pull dominates)
                 emitted_rows += pull_chunk_emits(pending)
             pending = packed  # pulled while the next chunk computes
             now = time.monotonic()
+            span_feed.append(t1 - t0)
+            span_fold.append(t2 - t1)
+            span_pull.append(now - t2)
             chunk_walls.append(now - last)
             last = now
+        # the final pull (the only one when n_chunks == 1) must be timed
+        # too, or span_pull_ms reads ~0 for short sweep configs
+        t_fp = time.monotonic()
         emitted_rows += pull_chunk_emits(pending)
+        span_pull.append(time.monotonic() - t_fp)
         states, ovf = carry
         n_active = int(sum(int(np.asarray(jnp.sum(st.count > 0)))
                            for st in states))
@@ -344,6 +366,9 @@ def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
     feed_bytes = batch * (16 + (8 * len({p.res for p in params_list})
                                 if host_snap is not None else 0))
     per_batch_bytes = len(params_list) * 2 * cap * row_bytes + feed_bytes
+    def _p50(spans):
+        return round(sorted(spans)[len(spans) // 2] / chunk * 1e3, 1)
+
     info = {
         "total": total, "wall": wall, "n_chunks": n_chunks,
         "n_batches": n_batches, "p50_batch_ms": p50_batch,
@@ -351,6 +376,12 @@ def _run_config(flat, *, res, cap, bins, emit_cap, batch, chunk,
         "state_overflow": state_overflow,
         "modeled_bytes_per_event": per_batch_bytes / batch,
         "hbm_gbps_achieved": per_batch_bytes * n_batches / wall / 1e9,
+        # where the batch wall goes (per batch, p50): host snap + feed
+        # H2D, device fold, emit pull D2H.  On accelerators fold is the
+        # async dispatch only and pull absorbs the device wall.
+        "span_feed_ms": _p50(span_feed),
+        "span_fold_ms": _p50(span_fold),
+        "span_pull_ms": _p50(span_pull),
     }
     return eps, info
 
@@ -614,7 +645,14 @@ def main() -> dict:
         "roofline_note": "floor model: batch feed + 2x slab row traffic "
                          "per pair per batch; sorts/emits move more, so "
                          "this understates true bytes",
+        # per-batch wall attribution (p50): host snap + H2D feed, device
+        # fold, emit pull D2H — the span breakdown VERDICT r4 item 2 asks
+        # the artifact to carry
+        "span_feed_ms": info.get("span_feed_ms"),
+        "span_fold_ms": info.get("span_fold_ms"),
+        "span_pull_ms": info.get("span_pull_ms"),
     }
+    result.update(_ref_cpu_baseline_attach(eps))
     if dev.platform == "cpu":
         # The relay flaps (up for ~minutes at a time); tools/hw_burst.py
         # banks real-hardware measurements whenever it answers.  If this
@@ -741,6 +779,39 @@ def _banked_hw_headline(res: int = 8) -> dict:
         }
     except (OSError, KeyError, ValueError):
         return {}
+
+
+def _ref_baseline_path() -> str:
+    """REF_CPU_BASELINE.json next to this file (patchable seam)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "REF_CPU_BASELINE.json")
+
+
+def _ref_cpu_baseline_attach(eps: float) -> dict:
+    """MEASURED reference denominator (VERDICT r4 item 6): the rate of a
+    single-process reenactment of the reference pipeline at its exact
+    semantics (tools/ref_reenact.py, banked in REF_CPU_BASELINE.json).
+    `vs_target` keeps the 5M ev/s design-target denominator; this adds
+    the apples-to-apples measured one alongside it."""
+    path = _ref_baseline_path()
+    try:
+        with open(path, encoding="utf-8") as fh:
+            ref = json.load(fh)
+        ref_eps = float(ref["ref_cpu_events_per_sec"])
+    except (OSError, KeyError, ValueError, TypeError):
+        # TypeError covers a null rate / non-dict top level — a corrupt
+        # bank file must not kill the artifact after a full bench run
+        return {}
+    if ref_eps <= 0:
+        return {}
+    return {
+        "ref_cpu_events_per_sec": ref_eps,
+        "vs_cpu_reference": round(eps / ref_eps, 1),
+        "ref_cpu_note": ref.get(
+            "note", "single-process reference-semantics reenactment "
+                    "(tools/ref_reenact.py)"),
+        "ref_cpu_measured_at": ref.get("measured_at"),
+    }
 
 
 def _e2e_runtime_attach() -> dict:

@@ -101,6 +101,23 @@ def test_banked_headline_prefers_production_shape(tmp_path, monkeypatch):
     assert got["hw_banked_unit"] == "micro"
 
 
+def test_ref_cpu_baseline_attach(tmp_path, monkeypatch):
+    """vs_cpu_reference = headline / banked reenactment rate; absent or
+    degenerate bank files attach nothing."""
+    import json
+
+    path = tmp_path / "REF_CPU_BASELINE.json"
+    monkeypatch.setattr(bench, "_ref_baseline_path", lambda: str(path))
+    assert bench._ref_cpu_baseline_attach(1e6) == {}
+    path.write_text(json.dumps({"ref_cpu_events_per_sec": 12500.0,
+                                "note": "n", "measured_at": "t"}))
+    got = bench._ref_cpu_baseline_attach(2.5e6)
+    assert got["vs_cpu_reference"] == 200.0
+    assert got["ref_cpu_events_per_sec"] == 12500.0
+    path.write_text(json.dumps({"ref_cpu_events_per_sec": 0}))
+    assert bench._ref_cpu_baseline_attach(1e6) == {}
+
+
 def test_e2e_runtime_attach_maps_and_gates(monkeypatch):
     """The CPU-fallback e2e attach maps the tool's JSON into artifact
     keys, disables via BENCH_E2E=0, and swallows subprocess failure."""
