@@ -1,0 +1,129 @@
+"""Shared-memory feeder process (stream/shmfeed.py): the runtime's
+Kafka ingest in its own OS process.  Covers the full chain — wire mock
+broker → feeder process (fetch + columnar decode) → shm ring →
+MicroBatchRuntime → MemoryStore — plus offset resume through seek and
+clean shutdown.  (The perf story lives in PERF_E2E.md; these tests pin
+correctness: conservation, intern-table sync, generation-fenced seek.)"""
+
+import os
+
+import numpy as np
+import pytest
+
+from heatmap_tpu.config import load_config
+from heatmap_tpu.sink import MemoryStore
+from heatmap_tpu.stream import MicroBatchRuntime, SyntheticSource
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("HEATMAP_SKIP_SUBPROC") == "1",
+    reason="subprocess tests disabled")
+
+
+@pytest.fixture()
+def broker_env(monkeypatch):
+    from heatmap_tpu.testing.mock_kafka import MockKafkaBroker
+
+    monkeypatch.setenv("HEATMAP_EVENT_FORMAT", "columnar")
+    monkeypatch.setenv("HEATMAP_KAFKA_IMPL", "wire")
+    broker = MockKafkaBroker()
+    yield broker
+    broker.close()
+
+
+def _publish(broker, n_events, batch=4096):
+    from heatmap_tpu.producers.base import KafkaPublisher
+
+    syn = SyntheticSource(n_events=n_events, n_vehicles=200,
+                          events_per_second=batch * 4)
+    pub = KafkaPublisher(broker.bootstrap, "t", event_format="columnar")
+    published = 0
+    while True:
+        cols = syn.poll(batch)
+        if not len(cols):
+            break
+        published += pub.publish_columns(cols)
+    pub.flush()
+    pub.close()
+    return published
+
+
+def test_feeder_runtime_conservation(tmp_path, broker_env):
+    """Every published event reaches the fold through the feeder
+    process, and the runtime's tile counts account for all of them."""
+    from heatmap_tpu.stream.shmfeed import ShmFeederSource
+
+    batch = 2048
+    src = ShmFeederSource(broker_env.bootstrap, "t", batch_size=batch,
+                          slots=3)
+    try:
+        published = _publish(broker_env, 20_000, batch)
+        assert published == 20_000
+        cfg = load_config({}, batch_size=batch, state_capacity_log2=12,
+                          speed_hist_bins=0, store="memory",
+                          checkpoint_dir=str(tmp_path / "ckpt"))
+        store = MemoryStore()
+        rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=0)
+        got = 0
+        while got < published:
+            before = rt.metrics.counters.get("events_valid", 0)
+            rt.step_once()
+            got = rt.metrics.counters.get("events_valid", 0)
+            rt.flush_pending()
+            got = rt.metrics.counters.get("events_valid", 0)
+        rt.writer.drain()
+        assert rt.metrics.counters["events_valid"] == published
+        total = sum(d["count"] for d in store._tiles.values())
+        assert total == published
+        rt.close()
+    finally:
+        src.close()
+
+
+def test_feeder_seek_replays_from_offset(broker_env):
+    """seek() is generation-fenced: after a seek to an earlier offset
+    the feeder re-delivers exactly the suffix, with no stale pre-seek
+    slots leaking through."""
+    from heatmap_tpu.stream.shmfeed import ShmFeederSource
+
+    batch = 1024
+    src = ShmFeederSource(broker_env.bootstrap, "t", batch_size=batch,
+                          slots=2)
+    try:
+        published = _publish(broker_env, 8_192, batch)
+        first = None
+        got = 0
+        while got < published:
+            cols = src.poll(batch)
+            if first is None and len(cols):
+                first_off = src.offset()
+                first = got + len(cols)
+            got += len(cols)
+        assert got == published
+        # replay from the offset after the first delivered batch
+        src.seek(first_off)
+        regot = 0
+        empties = 0
+        while regot < published - first and empties < 50:
+            cols = src.poll(batch)
+            if len(cols):
+                regot += len(cols)
+                empties = 0
+            else:
+                empties += 1
+        assert regot == published - first
+    finally:
+        src.close()
+
+
+def test_feeder_close_is_clean(broker_env):
+    """close() terminates the child and unlinks the shm block (no
+    resource-tracker leaks)."""
+    from heatmap_tpu.stream.shmfeed import ShmFeederSource
+
+    src = ShmFeederSource(broker_env.bootstrap, "t", batch_size=512,
+                          slots=2)
+    proc = src._proc
+    src.close()
+    assert not proc.is_alive()
+    # double close is a no-op
+    src.close()

@@ -38,19 +38,74 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 
 
+def _mongod_proc_main(info_q, stop_evt) -> None:
+    """Own OS process: wire-level mock mongod.  In-process, its handler
+    threads (BSON decode + upsert application) time-share the runtime's
+    core and starve the feeder exactly like the broker did; a real
+    mongod is off-host, so out-of-process is the faithful shape.  Doc
+    counts are reported back through the queue at shutdown."""
+    from heatmap_tpu.testing import MockMongod
+
+    mongod = MockMongod()
+    info_q.put(("uri", mongod.uri))
+    stop_evt.wait()
+    info_q.put(("docs",
+                len(mongod.state.coll("mobility", "tiles")),
+                len(mongod.state.coll("mobility", "positions_latest"))))
+    mongod.close()
+
+
+def _broker_proc_main(info_q, publish_evt, stop_evt, events, vehicles,
+                      batch) -> None:
+    """Own OS process: wire-level mock broker + the pre-publish.
+
+    Serving fetches is real Python work; in-process it time-shares the
+    runtime's core and pollutes the measurement (PERF_E2E.md round-4
+    note).  Publishing waits for `publish_evt` so the consumer can
+    attach first (KafkaSource starts at the LATEST offsets)."""
+    os.environ["HEATMAP_EVENT_FORMAT"] = "columnar"
+    from heatmap_tpu.producers.base import KafkaPublisher
+    from heatmap_tpu.stream import SyntheticSource
+    from heatmap_tpu.testing.mock_kafka import MockKafkaBroker
+
+    broker = MockKafkaBroker()
+    info_q.put(("bootstrap", broker.bootstrap))
+    publish_evt.wait()
+    syn = SyntheticSource(n_events=events, n_vehicles=vehicles,
+                          events_per_second=batch * 4)
+    pub = KafkaPublisher(broker.bootstrap, "e2e", event_format="columnar")
+    t0 = time.monotonic()
+    published = 0
+    while True:
+        cols = syn.poll(1 << 16)
+        if not len(cols):
+            break
+        published += pub.publish_columns(cols)
+    pub.flush()
+    info_q.put(("published", published, time.monotonic() - t0))
+    stop_evt.wait()
+    broker.close()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--events", type=int, default=2_000_000)
     ap.add_argument("--batch", type=int, default=1 << 16)
     ap.add_argument("--vehicles", type=int, default=5000)
     ap.add_argument("--store", choices=("mongo", "memory"), default="mongo")
-    ap.add_argument("--source", choices=("synthetic", "kafka"),
+    ap.add_argument("--source", choices=("synthetic", "kafka",
+                                         "kafka-proc"),
                     default="synthetic",
                     help="kafka = pre-publish the synthetic events to the "
                     "in-process wire-protocol mock broker (columnar "
                     "format) and feed the runtime through KafkaSource, so "
                     "the measured rate covers produce->fetch->decode->"
-                    "fold->sink jointly")
+                    "fold->sink jointly.  kafka-proc = the 3-process "
+                    "topology: broker in its own process, fetch+decode "
+                    "in the shared-memory feeder process "
+                    "(stream/shmfeed.py), the runtime alone in this one "
+                    "— the executor/driver split the reference gets "
+                    "from Spark")
     ap.add_argument("--no-positions", action="store_true")
     ap.add_argument("--resolutions", default="8",
                     help="comma list; e.g. 7,8,9 = the BASELINE #4 "
@@ -97,7 +152,27 @@ def main() -> int:
         mesh = make_mesh(args.shards)
 
     mongod = None
-    if args.store == "mongo":
+    mongod_proc = mongod_stop = mongod_q = None
+    if args.store == "mongo" and args.source == "kafka-proc":
+        # the 3-process topology moves the fake server out too: the
+        # runtime process holds ONLY the runtime (see _mongod_proc_main)
+        import multiprocessing as mp
+
+        from heatmap_tpu.sink.mongo import MongoStore
+
+        ctx = mp.get_context("spawn")
+        mongod_q = ctx.Queue()
+        mongod_stop = ctx.Event()
+        mongod_proc = ctx.Process(target=_mongod_proc_main,
+                                  args=(mongod_q, mongod_stop),
+                                  daemon=True)
+        mongod_proc.start()
+        kind, uri = mongod_q.get(timeout=60)
+        assert kind == "uri"
+        store = MongoStore(uri, "mobility")
+        topology = "mongo wire client -> own-process mock mongod (wire-" \
+                   "level fake; same OP_MSG bytes as a real server)"
+    elif args.store == "mongo":
         from heatmap_tpu.sink.mongo import MongoStore
         from heatmap_tpu.testing import MockMongod
 
@@ -121,7 +196,58 @@ def main() -> int:
     syn = SyntheticSource(n_events=args.events, n_vehicles=args.vehicles,
                           events_per_second=args.batch * 4)
     broker = pub = None
-    if args.source == "kafka":
+    broker_proc = broker_stop = None
+    if args.source == "kafka-proc":
+        import multiprocessing as mp
+
+        os.environ["HEATMAP_EVENT_FORMAT"] = "columnar"
+        os.environ["HEATMAP_KAFKA_IMPL"] = "wire"
+        from heatmap_tpu.stream.shmfeed import ShmFeederSource
+
+        ctx = mp.get_context("spawn")
+        info_q = ctx.Queue()
+        publish_evt = ctx.Event()
+        broker_stop = ctx.Event()
+        broker_proc = ctx.Process(
+            target=_broker_proc_main,
+            args=(info_q, publish_evt, broker_stop, args.events,
+                  args.vehicles, args.batch), daemon=True)
+        broker_proc.start()
+        kind, bootstrap = info_q.get(timeout=60)
+        assert kind == "bootstrap"
+
+        class BoundedShm(ShmFeederSource):
+            """Bounded replay: exhausted once the pre-published total
+            has been delivered (same strike backstop as BoundedKafka)."""
+
+            _total = None
+
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                self._got, self._idle = 0, 0
+
+            def poll(self, n):
+                out = super().poll(n)
+                got = len(out) if out is not None else 0
+                self._got += got
+                self._idle = 0 if got else self._idle + 1
+                return out
+
+            @property
+            def exhausted(self):
+                if self._total is None:
+                    return False
+                return self._got >= self._total or self._idle >= 10
+
+        src = BoundedShm(bootstrap, "e2e", batch_size=args.batch)
+        publish_evt.set()  # feeder attached; broker may publish now
+        kind, published, t_pub = info_q.get(timeout=300)
+        assert kind == "published"
+        src._total = published
+        topology = (f"shared-memory feeder process <- own-process mock "
+                    f"broker (pre-published {published:,} events in "
+                    f"{t_pub:.1f}s) -> ") + topology
+    elif args.source == "kafka":
         os.environ["HEATMAP_EVENT_FORMAT"] = "columnar"
         os.environ["HEATMAP_KAFKA_IMPL"] = "wire"  # mock broker's dialect
         from heatmap_tpu.producers.base import KafkaPublisher
@@ -214,10 +340,28 @@ def main() -> int:
         out["mongod_positions_docs"] = len(
             mongod.state.coll("mobility", "positions_latest"))
         mongod.close()
+    if mongod_proc is not None:
+        mongod_stop.set()
+        kind, n_tiles, n_pos = mongod_q.get(timeout=30)
+        assert kind == "docs"
+        out["mongod_tiles_docs"] = n_tiles
+        out["mongod_positions_docs"] = n_pos
+        mongod_proc.join(timeout=10)
+        if mongod_proc.is_alive():
+            mongod_proc.terminate()
     if pub is not None:
         pub.close()
     if broker is not None:
         broker.close()
+    if broker_proc is not None:
+        # stop the feeder BEFORE the broker: a live feeder error-loops
+        # on the dead broker socket otherwise (close is idempotent; the
+        # runtime's own close() normally got here first)
+        src.close()
+        broker_stop.set()
+        broker_proc.join(timeout=10)
+        if broker_proc.is_alive():
+            broker_proc.terminate()
     print(json.dumps(out))
     return 0
 
