@@ -106,6 +106,10 @@ def _load():
         lib.kc_crc32c.restype = ctypes.c_uint32
         lib.kc_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                   ctypes.c_uint32]
+        lib.cf_strtab_offsets.restype = ctypes.c_int
+        lib.cf_strtab_offsets.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32, i32p, i32p,
+        ]
         lib.kc_decode_values.restype = ctypes.c_int64
         lib.kc_decode_values.argtypes = [
             ctypes.c_char_p, ctypes.c_int64,
@@ -142,6 +146,27 @@ def _load():
         lib.h3_snap_f32_scalar.argtypes = _snap_args
         _LIB = lib
         return _LIB
+
+
+def strtab_offsets_native(blob: bytes, n: int):
+    """(offsets, lengths) int32 arrays for a colfmt strtab blob, parsed
+    in C++ (decoder.cpp cf_strtab_offsets).  None when no toolchain
+    (caller falls back to the Python parse); ValueError when an entry
+    runs past the blob (same rejection the Python parse performs)."""
+    lib = _load()
+    if lib is None:
+        return None
+    # bound BEFORE allocating: n is an unvalidated u32 from the record
+    # header, and every entry needs at least its 2 length bytes — a
+    # corrupt record claiming n=0xFFFFFFFF must be a cheap reject, not
+    # a pair of giant allocations (r5 review finding)
+    if n < 0 or 2 * n > len(blob):
+        raise ValueError("strtab count exceeds blob")
+    offs = np.empty(n, np.int32)
+    lens = np.empty(n, np.int32)
+    if lib.cf_strtab_offsets(blob, len(blob), n, offs, lens) != 0:
+        raise ValueError("malformed strtab blob")
+    return offs, lens
 
 
 def crc32c_native(data: bytes, crc: int = 0) -> "int | None":

@@ -561,3 +561,29 @@ int64_t dec_decode_binary(void* dv, const char* buf, int64_t len,
 }
 
 }  // extern "C"
+
+// ---- columnar strtab offsets (stream/colfmt.py hot path) -------------
+//
+// Parses the [u16 len][bytes]*n string-table blob into per-entry
+// (offset, length) arrays in one pass — the Python loop doing this
+// (struct.unpack_from per entry) was the top term of the round-5 ingest
+// profile.  Returns 0 on success, -1 when an entry runs past the blob.
+
+extern "C" {
+
+int cf_strtab_offsets(const uint8_t* blob, int64_t blob_len, int32_t n,
+                      int32_t* offs, int32_t* lens) {
+  int64_t off = 0;
+  for (int32_t i = 0; i < n; ++i) {
+    if (off + 2 > blob_len) return -1;
+    uint16_t ln = (uint16_t)(blob[off] | ((uint16_t)blob[off + 1] << 8));
+    off += 2;
+    if (off + ln > blob_len) return -1;
+    offs[i] = (int32_t)off;
+    lens[i] = (int32_t)ln;
+    off += ln;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -50,6 +50,9 @@ MAGIC = 0xB2
 VERSION = 1
 _HEAD = struct.Struct("<BBHIII")
 HEADER_SIZE = _HEAD.size  # 16
+# sentinel key for the session bytes->str memo stashed inside the
+# caller-owned lut_cache (cannot collide with the (blob, n) tuple keys)
+_BYTES_MEMO_KEY = ("__strtab_bytes_memo__",)
 
 from heatmap_tpu.stream.events import EventColumns, parse_ts  # noqa: E402
 
@@ -166,8 +169,45 @@ def _encode_strtab(strings) -> bytes:
     return b"".join(parts)
 
 
-def _parse_strtab(blob: bytes, n_strings: int) -> list[str] | None:
+def _parse_strtab(blob: bytes, n_strings: int,
+                  bytes_memo: dict | None = None) -> list[str] | None:
+    """Strtab blob -> list of strings.
+
+    ``bytes_memo`` (session-lifetime, caller-owned) maps raw utf-8
+    entries to their decoded strings: producers resend mostly the same
+    names record after record but with drifting record boundaries the
+    whole-blob memo in decode_batch misses, and decoding ~5k names per
+    record was the top term of the round-5 ingest profile.  A bytes-key
+    dict hit skips the decode (and reuses the one str object, which also
+    makes the downstream intern setdefault a pointer-compare hit).  The
+    entry offsets come from the C++ one-pass parser when a toolchain
+    exists (decoder.cpp cf_strtab_offsets), replacing the per-entry
+    struct.unpack_from loop."""
+    offs = None
+    try:
+        from heatmap_tpu.native import strtab_offsets_native
+
+        res = strtab_offsets_native(blob, n_strings)
+        if res is not None:
+            offs = res[0].tolist()
+            lens = res[1].tolist()
+    except ValueError:  # entry runs past the blob: same reject as below
+        return None
     out = []
+    memo_get = bytes_memo.get if bytes_memo is not None else None
+    if offs is not None:
+        for i in range(n_strings):
+            o = offs[i]
+            raw = blob[o:o + lens[i]]
+            s = memo_get(raw) if memo_get is not None else None
+            if s is None:
+                s = raw.decode("utf-8", "replace")
+                if bytes_memo is not None:
+                    if len(bytes_memo) >= 1 << 20:  # unbounded-name safety
+                        bytes_memo.clear()
+                    bytes_memo[raw] = s
+            out.append(s)
+        return out
     off = 0
     for _ in range(n_strings):
         if off + 2 > len(blob):
@@ -176,7 +216,15 @@ def _parse_strtab(blob: bytes, n_strings: int) -> list[str] | None:
         off += 2
         if off + ln > len(blob):
             return None
-        out.append(blob[off:off + ln].decode("utf-8", "replace"))
+        raw = blob[off:off + ln]
+        s = memo_get(raw) if memo_get is not None else None
+        if s is None:
+            s = raw.decode("utf-8", "replace")
+            if bytes_memo is not None:
+                if len(bytes_memo) >= 1 << 20:
+                    bytes_memo.clear()
+                bytes_memo[raw] = s
+        out.append(s)
         off += ln
     return out
 
@@ -227,7 +275,9 @@ def decode_batch(value: bytes, intern_p: dict, intern_v: dict,
     key = (blob, n_strings)
     cached = lut_cache.get(key) if lut_cache is not None else None
     if cached is None:
-        strings = _parse_strtab(blob, n_strings)
+        bytes_memo = (lut_cache.setdefault(_BYTES_MEMO_KEY, {})
+                      if lut_cache is not None else None)
+        strings = _parse_strtab(blob, n_strings, bytes_memo)
         if strings is None:
             return None
         # role-split LUTs, filled lazily as ids are seen in each role

@@ -146,12 +146,13 @@ def columns_from_arrays(lat_deg, lng_deg, speed_kmh, ts_s,
 
 
 def empty_columns(providers=None, vehicles=None) -> EventColumns:
-    """A zero-row batch (shared string tables passed through)."""
-    zf = np.zeros(0, np.float32)
-    zi = np.zeros(0, np.int32)
-    return EventColumns(
-        lat_rad=zf, lng_rad=zf, lat_deg=zf, lng_deg=zf, speed_kmh=zf,
-        ts_s=zi, provider_id=zi, vehicle_id=zi,
+    """A zero-row batch (shared string tables passed through, NOT the
+    defaulted ones columns_from_arrays would substitute)."""
+    import dataclasses
+
+    cols = columns_from_arrays([], [], [], [])
+    return dataclasses.replace(
+        cols,
         providers=providers if providers is not None else [],
         vehicles=vehicles if vehicles is not None else [],
     )

@@ -20,8 +20,13 @@ Protocol
 --------
 * a SharedMemory block holds `slots` fixed-capacity columnar slabs
   (8 f32/i32 lanes x `cap` rows, the EventColumns array fields);
-* `full_q` carries (slot, n, gen, offsets, prov_delta, veh_delta,
-  n_dropped) metas feeder -> runtime; `free_q` returns slot ids;
+* `full_q` carries (slot, n, gen, final, offsets, prov_delta,
+  veh_delta, n_dropped) metas feeder -> runtime; `free_q` returns slot
+  ids.  A poll that overshoots the slot capacity (the wire source
+  consumes whole columnar records) spans MULTIPLE slots: only the last
+  carries `final=True` and the post-poll offset, and the runtime side
+  reassembles them into one logical batch — so a checkpointed offset
+  can never advance past rows still sitting in the ring;
 * provider/vehicle intern tables are synchronized by DELTA: the feeder
   sends only newly-interned names, both sides append in order, so the
   id arrays index identical tables;
@@ -128,21 +133,36 @@ def _feeder_loop(shm, slots: int, cap: int, bootstrap: str, topic: str,
             if n == 0:
                 free_q.put(slot)
                 # an EMPTY meta keeps the runtime's poll from blocking a
-                # full timeout when the topic is simply drained
-                full_q.put((None, 0, gen, src.offset(), [], [], 0))
+                # full timeout when the topic is simply drained — but
+                # only when none is pending, or a slow-polling runtime
+                # accumulates stale metas without bound (r5 review)
+                if full_q.empty():
+                    full_q.put((None, 0, gen, True, src.offset(), [],
+                                [], 0))
                 time.sleep(_IDLE_SLEEP_S)
                 continue
-            v = views[slot]
-            for name, _dt in _LANES:
-                v[name][:n] = getattr(cols, name)[:n]
             # intern-table deltas: cols carries the source's GLOBAL
             # tables; send only what the runtime has not seen
             providers, vehicles = cols.providers, cols.vehicles
             pd = providers[sent_p:]
             vd = vehicles[sent_v:]
             sent_p, sent_v = len(providers), len(vehicles)
-            full_q.put((slot, n, gen, src.offset(), pd, vd,
-                        cols.n_dropped))
+            off = src.offset()
+            # the wire source consumes whole records and may overshoot
+            # cap: span slots, final flag + offset on the LAST slice
+            start = 0
+            while start < n:
+                if start > 0:
+                    slot = free_q.get()  # blocking: the batch must land
+                take = min(cap, n - start)
+                v = views[slot]
+                for name, _dt in _LANES:
+                    v[name][:take] = getattr(cols, name)[start:start + take]
+                final = start + take >= n
+                full_q.put((slot, take, gen, final, off,
+                            pd if final else [], vd if final else [],
+                            cols.n_dropped if final else 0))
+                start += take
     finally:
         src.close()
 
@@ -194,18 +214,23 @@ class ShmFeederSource(Source):
     # ------------------------------------------------------------- source
     def poll(self, max_events: int):
         """Like KafkaSource's columnar behavior, a poll may return MORE
-        than ``max_events``: slots are record-aligned, and truncating a
-        slot would silently drop its tail (the recorded offset already
-        covers the whole slot).  The runtime absorbs oversize returns
-        through its carry path and defers checkpoints mid-carry, so
-        offsets never advance past undelivered rows."""
+        than ``max_events``: the feeder consumes whole records, and an
+        oversize poll arrives as a multi-slot spanning batch reassembled
+        here (offset stamped only on the final slice).  The runtime
+        absorbs oversize returns through its carry path and defers
+        checkpoints mid-carry, so offsets never advance past
+        undelivered rows."""
         deadline = time.monotonic() + 1.0
+        parts: list[dict] = []
         while True:
             timeout = max(0.05, deadline - time.monotonic())
             try:
-                slot, n, gen, off, pd, vd, dropped = self._full_q.get(
-                    timeout=timeout)
+                (slot, n, gen, final, off, pd, vd,
+                 dropped) = self._full_q.get(timeout=timeout)
             except queue_mod.Empty:
+                if parts:  # mid-assembly: the final slice is coming
+                    deadline = time.monotonic() + 1.0
+                    continue
                 return empty_columns(self._providers, self._vehicles)
             # intern deltas are generation-INDEPENDENT (append-only, and
             # the feeder never resends them): a stale post-seek meta must
@@ -216,18 +241,29 @@ class ShmFeederSource(Source):
             if gen != self._gen:
                 if slot is not None:
                     self._free_q.put(slot)  # pre-seek leftover
+                parts = []  # any assembly in flight was pre-seek too
+                continue
+            if slot is None:
+                if parts:
+                    continue  # stray empty meta between slices
+                self._offset = off
+                return empty_columns(self._providers, self._vehicles)
+            v = self._views[slot]
+            parts.append({name: v[name][:n].copy()
+                          for name, _dt in _LANES})
+            self._free_q.put(slot)
+            if not final:
                 continue
             self._offset = off
             self.n_dropped_total += dropped
-            if slot is None:
-                return empty_columns(self._providers, self._vehicles)
-            v = self._views[slot]
-            cols = EventColumns(
-                **{name: v[name][:n].copy() for name, _dt in _LANES},
-                providers=self._providers, vehicles=self._vehicles,
-                n_dropped=dropped)
-            self._free_q.put(slot)
-            return cols
+            if len(parts) == 1:
+                lanes = parts[0]
+            else:
+                lanes = {name: np.concatenate([p[name] for p in parts])
+                         for name, _dt in _LANES}
+            return EventColumns(**lanes, providers=self._providers,
+                                vehicles=self._vehicles,
+                                n_dropped=dropped)
 
     def offset(self):
         return self._offset

@@ -14,6 +14,7 @@ import abc
 import collections
 import json
 import math
+import os
 import time as _time
 from typing import Any, Iterable, Sequence
 
@@ -449,6 +450,16 @@ class _WireImpl:
         self._intern_p: dict = {}
         self._intern_v: dict = {}
         self._col_cache: dict = {}  # colfmt LUT memo (same lifetime)
+        # per-fetch response cap.  The protocol default (1 MiB) costs a
+        # full request/response round trip per ~37k columnar events;
+        # large micro-batches sweep partitions repeatedly to fill, and
+        # the round-trip count was a measurable slice of the round-5
+        # ingest profile.  4 MiB ≈ one 150k-event columnar record batch
+        # per fetch.  Read here (not at import) so tools/tests setting
+        # the env var after import are honored, like the neighboring
+        # format/impl knobs.
+        self.fetch_max_bytes = int(os.environ.get(
+            "HEATMAP_FETCH_MAX_BYTES", str(4 << 20)))
 
     def _discover(self) -> None:
         """(Re)initialize offsets for newly visible partitions at LATEST.
@@ -533,7 +544,8 @@ class _WireImpl:
                 p = parts[(self._rr + k) % len(parts)]
                 fr = self._guarded_fetch(
                     p, lambda p=p, w=sweep_wait: self.c.fetch(
-                        self.topic, p, self._offsets[p], max_wait_ms=w))
+                        self.topic, p, self._offsets[p],
+                        max_bytes=self.fetch_max_bytes, max_wait_ms=w))
                 if fr is None:
                     continue
                 if fr.skipped_batches:
@@ -622,7 +634,8 @@ class _WireImpl:
             p = parts[(self._rr + k) % len(parts)]
             res = self._guarded_fetch(
                 p, lambda p=p: self.c.fetch_values(
-                    self.topic, p, self._offsets[p], max_wait_ms=50,
+                    self.topic, p, self._offsets[p],
+                    max_bytes=self.fetch_max_bytes, max_wait_ms=50,
                     framing=framing))
             if res is None:
                 continue

@@ -11,15 +11,21 @@ import socket
 
 
 def recv_exact(sock: socket.socket, n: int) -> bytes:
-    """Read exactly n bytes or raise ConnectionError on EOF."""
-    chunks = []
-    while n:
-        b = sock.recv(n)
-        if not b:
+    """Read exactly n bytes or raise ConnectionError on EOF.
+
+    recv_into a single preallocated buffer: the chunks+join pattern
+    allocated and copied every receive twice, which at multi-MiB fetch
+    responses was a measurable slice of the ingest wall (round-5
+    profile)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if not r:
             raise ConnectionError("connection closed by peer")
-        chunks.append(b)
-        n -= len(b)
-    return b"".join(chunks)
+        got += r
+    return bytes(buf)
 
 
 def recv_exact_or_none(sock: socket.socket, n: int) -> bytes | None:

@@ -268,7 +268,11 @@ def test_lut_cache_correct_across_batches():
             assert (got.vehicles[got.vehicle_id[i]]
                     == uncached.vehicles[uncached.vehicle_id[i]])
     assert p1 == p2 and v1 == v2
-    assert len(cache) == 2  # a/b share one table; c is the other
+    # a/b share one LUT entry; c is the other; plus the session
+    # bytes->str memo the parser stashes under its sentinel key
+    from heatmap_tpu.stream.colfmt import _BYTES_MEMO_KEY
+
+    assert len(cache) == 3 and _BYTES_MEMO_KEY in cache
 
 
 def test_lut_cache_hit_rejects_inflated_n_strings():

@@ -115,6 +115,37 @@ def test_feeder_seek_replays_from_offset(broker_env):
         src.close()
 
 
+def test_oversize_poll_spans_slots(broker_env):
+    """A record bigger than the slot capacity must arrive whole as one
+    logical batch spanning several slots (regression: the slot copy
+    used to raise a broadcast error and silently kill the feeder).  The
+    offset may only move once the final slice has been delivered."""
+    from heatmap_tpu.stream.shmfeed import ShmFeederSource
+
+    src = ShmFeederSource(broker_env.bootstrap, "t", batch_size=512,
+                          slots=3)
+    try:
+        published = _publish(broker_env, 8_192, batch=4096)
+        got = 0
+        oversize_seen = False
+        empties = 0
+        while got < published and empties < 100:
+            cols = src.poll(512)
+            if len(cols) > 512:
+                oversize_seen = True
+            if len(cols):
+                got += len(cols)
+                empties = 0
+            else:
+                empties += 1
+        assert got == published
+        assert oversize_seen, (
+            "publish chunks of 4096 over 3 partitions must produce "
+            "records larger than the 512-row slots")
+    finally:
+        src.close()
+
+
 def test_feeder_close_is_clean(broker_env):
     """close() terminates the child and unlinks the shm block (no
     resource-tracker leaks)."""
