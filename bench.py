@@ -502,22 +502,39 @@ def main() -> dict:
         pull = pull_env or default_pull  # sweep + headline share it;
         # the final A/B below may flip it by measurement
 
+        # On the CPU-fallback host, clock speed flaps ~2x on a minutes
+        # timescale and a sequential sweep can crown whichever candidate
+        # ran in a fast phase (round-5 post-mortem of the round-3 merge
+        # pick).  Each candidate therefore runs BENCH_TRY_REPS short
+        # runs and keeps its best — repetition spreads each candidate
+        # across phases.  On accelerators a relay window is too precious
+        # to spend on repeats (and the device clock doesn't flap).
+        try_reps = int(os.environ.get("BENCH_TRY_REPS",
+                                      "1" if on_accel else "2"))
+
         def _try(b, c, im, cp, h3, best):
             short = min(n_events, 4 * b * c)
             tag = f"{im} b={b} c={c} cap={cp} h3={h3}"
-            try:
-                eps, inf = _run_config(flat, res=res, cap=cp, bins=bins,
-                                       emit_cap=emit_cap, batch=b, chunk=c,
-                                       merge_impl=im, n_events=short,
-                                       h3_impl=h3, pull=pull, pairs=pairs)
-            except Exception as e:  # noqa: BLE001 - skip bad configs
-                print(f"# autotune [{tag}] failed: {e}", file=sys.stderr)
-                return best
-            if inf["state_overflow"]:
-                print(f"# autotune [{tag}] rejected: "
-                      f"{inf['state_overflow']} groups dropped at capacity",
-                      file=sys.stderr)
-                return best
+            eps = 0.0
+            for _rep in range(max(1, try_reps)):
+                try:
+                    e1, inf = _run_config(flat, res=res, cap=cp, bins=bins,
+                                          emit_cap=emit_cap, batch=b,
+                                          chunk=c, merge_impl=im,
+                                          n_events=short, h3_impl=h3,
+                                          pull=pull, pairs=pairs)
+                except Exception as e:  # noqa: BLE001 - skip bad configs
+                    print(f"# autotune [{tag}] failed: {e}",
+                          file=sys.stderr)
+                    if eps > 0:  # an earlier rep already measured it
+                        break
+                    return best
+                if inf["state_overflow"]:
+                    print(f"# autotune [{tag}] rejected: "
+                          f"{inf['state_overflow']} groups dropped at "
+                          f"capacity", file=sys.stderr)
+                    return best
+                eps = max(eps, e1)
             print(f"# autotune [{tag}]: {eps / 1e6:.2f}M ev/s",
                   file=sys.stderr)
             return max(best, (eps, b, c, im, cp, h3))
@@ -605,6 +622,22 @@ def main() -> dict:
         print(f"# headline run dropped {info['state_overflow']} groups at "
               f"cap={cap}; re-running at {cap * 2}", file=sys.stderr)
         cap *= 2
+    # CPU-fallback hosts flap ~2x on a minutes timescale; the headline
+    # is a capability measure, so take the best of BENCH_HEADLINE_REPS
+    # identical runs (default 2 on CPU) rather than publishing whatever
+    # phase one run landed in.  Accelerator runs stay single-shot (a
+    # relay window is too precious for repeats).
+    reps = int(os.environ.get("BENCH_HEADLINE_REPS",
+                              "1" if on_accel else "2"))
+    if not info["state_overflow"]:
+        for _rep in range(max(1, reps) - 1):
+            e2, i2 = _run_config(flat, res=res, cap=cap, bins=bins,
+                                 emit_cap=emit_cap, batch=batch,
+                                 chunk=chunk, merge_impl=impl,
+                                 n_events=n_events, h3_impl=h3,
+                                 pull=pull, pairs=pairs)
+            if not i2["state_overflow"] and e2 > eps:
+                eps, info = e2, i2
     print(
         f"# {info['total']:,} events in {info['wall']:.2f}s "
         f"({info['n_chunks']} chunks x {chunk} batches of {batch:,}, "
@@ -869,19 +902,22 @@ def _fallback_reexec() -> None:
     env.setdefault("BENCH_EVENTS", str(8 * (1 << 20)))
     env.setdefault("BENCH_BATCH", str(1 << 18))
     env.setdefault("BENCH_CHUNK", "4")
-    # measured on this 1-core host (2026-07-31, 2^21 events, bins=64,
-    # shape above): h3=native+sort 1111k ev/s > native+rank 1019k >
-    # native+probe 828k >> xla+rank 239k > xla+sort 227k — the C++ host
-    # pre-snap (hexgrid/native_snap.py) removes the dominant CPU cost.
-    # Pin the CPU fallback to the winner — but NOT when the user
-    # explicitly asked for an autotune sweep, where a pin would collapse
-    # the candidates to one value.  main() downgrades native -> xla
-    # when no C++ toolchain exists.
+    # measured on this 1-core host (round 5, warm-slab arg-passing
+    # methodology, fastpath active, 2^21 events, bins=64): native+sort
+    # 2.93M ev/s at slab 2^16 > 2.32M at 2^17; sort > rank at this
+    # batch/slab ratio (auto would pick sort here too).  The slab pin:
+    # the workload holds ~1.5k active groups, so 2^16 rows is 40x
+    # headroom and the config is rejected if anything overflows.  Pin
+    # the CPU fallback to the winner — but NOT when the user explicitly
+    # asked for an autotune sweep, where a pin would collapse the
+    # candidates to one value.  main() downgrades native -> xla when no
+    # C++ toolchain exists.
     if os.environ.get("BENCH_AUTOTUNE") != "1":
         pinned = [k for k in ("HEATMAP_MERGE_IMPL", "HEATMAP_H3_IMPL")
                   if k not in env]
         env.setdefault("HEATMAP_MERGE_IMPL", "sort")
         env.setdefault("HEATMAP_H3_IMPL", "native")
+        env.setdefault("BENCH_CAP_LOG2", "16")
         if pinned:
             env["BENCH_PINNED_BY_FALLBACK"] = ",".join(pinned)
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)],
