@@ -146,6 +146,90 @@ def test_oversize_poll_spans_slots(broker_env):
         src.close()
 
 
+def test_feeder_restart_replay_equivalence(tmp_path, broker_env):
+    """Kill the runtime mid-stream and resume with a FRESH feeder
+    process: the checkpointed offsets seek the new feeder (generation
+    fencing discards anything in flight) and the store converges to
+    exactly what an uncrashed run produces."""
+    from heatmap_tpu.stream.shmfeed import ShmFeederSource
+
+    batch = 2048
+    n_events = 16_384
+
+    src0 = ShmFeederSource(broker_env.bootstrap, "t", batch_size=batch,
+                           slots=2)
+    try:
+        published = _publish(broker_env, n_events, batch)
+
+        def drain(rt, target):
+            while rt.metrics.counters.get("events_valid", 0) < target:
+                rt.step_once()
+                rt.flush_pending()
+            rt.writer.drain()
+
+        # uncrashed oracle
+        cfg0 = load_config({}, batch_size=batch, state_capacity_log2=12,
+                           speed_hist_bins=0, store="memory",
+                           checkpoint_dir=str(tmp_path / "ckpt0"))
+        store0 = MemoryStore()
+        rt0 = MicroBatchRuntime(cfg0, src0, store0, checkpoint_every=0)
+        drain(rt0, published)
+        expected = {k: (d["count"], d["avgSpeedKmh"])
+                    for k, d in store0._tiles.items()}
+        rt0.close()
+    finally:
+        src0.close()
+
+    # crashed run: checkpoint every batch, stop after 3, abandon the
+    # runtime AND the feeder process (the crash takes both)
+    cfg = load_config({}, batch_size=batch, state_capacity_log2=12,
+                      speed_hist_bins=0, store="memory",
+                      checkpoint_dir=str(tmp_path / "ckpt"))
+    store = MemoryStore()
+    src1 = ShmFeederSource(broker_env.bootstrap, "t", batch_size=batch,
+                           slots=2)
+    try:
+        # a consumer attached after the publish sits at LATEST; replay
+        # the topic from the start like the checkpointed seek would
+        src1.seek({p: 0 for p in range(broker_env.state.num_partitions)})
+        rt1 = MicroBatchRuntime(cfg, src1, store, checkpoint_every=1)
+        for _ in range(3):
+            rt1.step_once()
+        rt1.flush_pending()
+        rt1.writer.drain()
+        rt1._ckpt_join()
+    finally:
+        src1.close()  # the "crash"
+
+    # restart: fresh feeder, resume from the checkpoint.  rt2 only
+    # re-delivers the suffix past the committed offsets, so its own
+    # events_valid never reaches `published` — drain to idle instead.
+    src2 = ShmFeederSource(broker_env.bootstrap, "t", batch_size=batch,
+                           slots=2)
+    try:
+        rt2 = MicroBatchRuntime(cfg, src2, store, checkpoint_every=1)
+        idle = 0
+        while idle < 8:
+            before = rt2.metrics.counters.get("events_valid", 0)
+            rt2.step_once()
+            rt2.flush_pending()
+            idle = (idle + 1
+                    if rt2.metrics.counters.get("events_valid",
+                                                0) == before else 0)
+        rt2.writer.drain()
+        got = {k: (d["count"], d["avgSpeedKmh"])
+               for k, d in store._tiles.items()}
+        assert set(got) == set(expected)
+        for k, (cnt, avg) in got.items():
+            assert cnt == expected[k][0], k
+            # fetch interleaving can shift batch boundaries between the
+            # runs, so the Kahan sums may differ in the last ulp
+            assert avg == pytest.approx(expected[k][1], rel=1e-5), k
+        rt2.close()
+    finally:
+        src2.close()
+
+
 def test_feeder_close_is_clean(broker_env):
     """close() terminates the child and unlinks the shm block (no
     resource-tracker leaks)."""
