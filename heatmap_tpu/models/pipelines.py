@@ -22,12 +22,32 @@ def _kafka_or_synthetic(cfg: Config) -> Source:
     """Live pipelines consume the Kafka ingress when a broker is reachable
     (the reference contract; the framework's own wire client needs no
     client library); otherwise fall back to synthetic data so the pipeline
-    still runs hermetically."""
+    still runs hermetically.
+
+    ``HEATMAP_FEEDER=proc`` moves the fetch+decode leg into its own OS
+    process over a shared-memory ring (stream/shmfeed.py) — the
+    executor/driver split the reference gets from Spark; measured 7.3x
+    end-to-end on a contended host (PERF_E2E.md).  The in-process source
+    remains the default: one fewer moving part when the host has cores
+    to spare."""
     import logging
+    import os
 
     from heatmap_tpu.stream.source import KafkaSource
 
     try:
+        if os.environ.get("HEATMAP_FEEDER") == "proc":
+            from heatmap_tpu.stream.shmfeed import ShmFeederSource
+
+            # probe reachability BEFORE spawning the feeder so the
+            # synthetic fallback engages promptly.  Pinned to the wire
+            # impl: it contacts the broker in its constructor and fails
+            # fast, whereas a confluent client connects lazily and would
+            # vacuously pass this probe
+            KafkaSource(cfg.kafka_bootstrap, cfg.kafka_topic,
+                        impl="wire").close()
+            return ShmFeederSource(cfg.kafka_bootstrap, cfg.kafka_topic,
+                                   batch_size=cfg.batch_size)
         return KafkaSource(cfg.kafka_bootstrap, cfg.kafka_topic)
     except (ImportError, ConnectionError, OSError, RuntimeError) as e:
         # RuntimeError covers KafkaError (unknown topic / leaderless)

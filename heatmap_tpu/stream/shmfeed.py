@@ -200,11 +200,22 @@ class ShmFeederSource(Source):
         self._proc.start()
         # interpreter startup in the child is seconds on this host; the
         # construction contract matches KafkaSource's (consumer attached,
-        # offsets pinned at latest, before __init__ returns)
-        if not self._ready.wait(timeout=120):
-            self.close()
-            raise RuntimeError("shm feeder process failed to attach "
-                               "to the broker")
+        # offsets pinned at latest, before __init__ returns).  Watch
+        # child liveness too: a broker that died between the caller's
+        # probe and the child's attach makes the child EXIT, and waiting
+        # the full budget for a dead process would stall pipeline
+        # startup ~2 minutes before the synthetic fallback engages
+        deadline = time.monotonic() + 120
+        while not self._ready.wait(timeout=0.25):
+            if not self._proc.is_alive():
+                self.close()
+                raise RuntimeError(
+                    "shm feeder process exited before attaching to the "
+                    "broker (unreachable or incompatible)")
+            if time.monotonic() >= deadline:
+                self.close()
+                raise RuntimeError("shm feeder process failed to attach "
+                                   "to the broker")
         self._gen = 0
         self._offset: Any = None
         self._providers: list[str] = []

@@ -178,6 +178,39 @@ def test_pipelines_registry():
     with pytest.raises(KeyError):
         get_pipeline("nope")
 
+
+def test_pipeline_feeder_proc_switch(monkeypatch):
+    """HEATMAP_FEEDER=proc puts the Kafka leg of a live pipeline in the
+    shared-memory feeder process; without a broker the synthetic
+    fallback still engages."""
+    from heatmap_tpu.models import get_pipeline
+    from heatmap_tpu.stream import SyntheticSource as Syn
+    from heatmap_tpu.stream.shmfeed import ShmFeederSource
+    from heatmap_tpu.testing.mock_kafka import MockKafkaBroker
+
+    monkeypatch.setenv("HEATMAP_FEEDER", "proc")
+    monkeypatch.setenv("HEATMAP_KAFKA_IMPL", "wire")
+    p = get_pipeline("mbta_default")
+
+    # no broker at the configured bootstrap -> synthetic fallback
+    assert isinstance(p.make_source(p.config), Syn)
+
+    broker = MockKafkaBroker()
+    try:
+        monkeypatch.setenv("KAFKA_BOOTSTRAP", broker.bootstrap)
+        from heatmap_tpu.config import load_config
+
+        cfg = load_config({"KAFKA_BOOTSTRAP": broker.bootstrap},
+                          batch_size=1024)
+        src = p.make_source(cfg)
+        try:
+            assert isinstance(src, ShmFeederSource)
+            assert src.cap == 1024
+        finally:
+            src.close()
+    finally:
+        broker.close()
+
 def test_mbta_numeric_label_unwrapped():
     """A numeric label is published unwrapped, exactly like the ref
     (mbta_to_kafka.py:68: `attributes.label or id or "unknown"` with no
