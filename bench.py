@@ -783,7 +783,9 @@ def _banked_hw_headline(res: int = 8) -> dict:
             units = json.load(fh)["units"]
         best = None
         best_name = None
-        for tier in (("headline", "headline_big", "headline_bench"),
+        for tier in (("headline", "headline_big", "headline_bench",
+                      "headline_native", "headline_full", "headline_b21",
+                      "headline_b21_native"),
                      ("micro",)):
             for name in tier:
                 unit = units.get(name)
@@ -803,12 +805,15 @@ def _banked_hw_headline(res: int = 8) -> dict:
             "hw_banked_events_per_sec": data["events_per_sec"],
             "hw_banked_device": data.get("_device_kind", "?"),
             "hw_banked_at": best.get("ts", "?"),
-            # units differ in batch/chunk shape — publish the winner's
-            # config with its number so a big-batch result can't
+            # units differ in batch/chunk AND snap-path/pull-mode —
+            # publish the winner's full config with its number so a
+            # big-batch, native-snap, or full-pull result can't
             # masquerade as the round-comparable headline
             "hw_banked_unit": best_name,
             "hw_banked_batch": data.get("batch"),
             "hw_banked_chunk": data.get("chunk"),
+            "hw_banked_h3": data.get("h3", "xla"),
+            "hw_banked_pull": data.get("pull"),
             "hw_banked_note": "measured on hardware during a relay uptime "
                               "window (by tools/hw_burst.py or an earlier "
                               "bench attempt); this run itself fell back "

@@ -67,6 +67,72 @@ def _resolve_merge_impl() -> str:
     return (MERGE_IMPL if MERGE_IMPL is not None
             else os.environ.get("HEATMAP_MERGE_IMPL", "auto"))
 
+
+# In-program snap routing (xla|pallas|auto) — same override-slot pattern
+# as MERGE_IMPL: ``SNAP_IMPL`` wins when set (the stream runtime assigns
+# it to pin the checkpointed impl across a resume — unlike the merge
+# impls, the two snaps are NOT bit-identical on f32 cell-edge points, so
+# a mid-stream flip would re-key a handful of groups); otherwise
+# HEATMAP_H3_IMPL is read at trace time.
+SNAP_IMPL: "str | None" = None
+
+# Frozen bank verdict for the merge-impl ``auto`` path.  Sentinel
+# ``_BANK_LIVE`` (the import-time default) means "consult
+# hwbank.merge_winner() at trace time" — right for standalone
+# merge_batch users (bench, tests, notebooks).  The stream runtime
+# REPLACES it at init with a one-shot snapshot (a winner name or None),
+# because (a) re-reading the bank at every trace would let a bank file
+# rewritten MID-RUN — hw_burst --loop is the documented companion —
+# flip the impl after the multihost startup collective validated a
+# snapshot, compiling divergent lockstep programs across hosts, and
+# (b) the getmtime stat has no place on the per-batch hot path.  The
+# collective demotes the snapshot to None when hosts' banks disagree
+# (every host then shares the static capacity-ratio rule; the merge
+# impls are bit-identical, so results never depend on the choice).
+_BANK_LIVE = object()
+MERGE_BANK_PIN: "str | None | object" = _BANK_LIVE
+
+
+def _resolve_snap_impl() -> str:
+    return (SNAP_IMPL if SNAP_IMPL is not None
+            else os.environ.get("HEATMAP_H3_IMPL", "auto"))
+
+
+def resolve_snap_policy(ignore_pin: bool = False) -> str:
+    """The in-program snap POLICY ("pallas" | "xla"): explicit
+    env/override wins; "auto" consults the hardware bank.  Per-res
+    eligibility (res <= 10, kernel lowers) still applies at trace time,
+    so a policy of "pallas" deterministically degrades to the XLA snap
+    for ineligible resolutions — recording the policy is enough to
+    reproduce the exact per-res kernel choice across a resume.
+    The stream runtime FREEZES this in ``SNAP_IMPL`` at init so a bank
+    file appearing/changing mid-run cannot flip the kernel at a
+    growth retrace or float the checkpointed name.  ``ignore_pin``
+    resolves from env+bank even when the slot is set (the runtime uses
+    it to detect a conflicting pin left by another runtime in the
+    process — comparing against the slot-reading resolution would
+    always agree with itself)."""
+    impl = (os.environ.get("HEATMAP_H3_IMPL", "auto") if ignore_pin
+            else _resolve_snap_impl())
+    if impl == "auto":
+        from heatmap_tpu import hwbank
+
+        impl = hwbank.snap_winner() or "xla"
+    # "native" is handled upstream via host prekeys; any other value
+    # (incl. typos) keeps the safe default
+    return impl if impl == "pallas" else "xla"
+
+
+def inprogram_snap_name(res: int = 8) -> str:
+    """The in-program snap ``_snap_impl`` would hand back right now,
+    as a checkpointable name ("pallas" | "xla")."""
+    if resolve_snap_policy() == "pallas" and res <= 10:
+        from heatmap_tpu.hexgrid import pallas_kernel
+
+        if pallas_kernel.pallas_available():
+            return "pallas"
+    return "xla"
+
 # _merge_probe tunables (resolved once at import — they only shape the
 # probe impl's internal loop, not results, and tests patch the module
 # constants directly): probe rounds before the per-batch sort fallback,
@@ -138,13 +204,16 @@ def _snap_impl(res: int):
     (engine.multi.fused_fold; the stream runtime and bench do this) —
     a pure_callback inside the jitted program deadlocked intermittently
     on the CPU runtime, see hexgrid/native_snap.py."""
-    import os
-
-    if os.environ.get("HEATMAP_H3_IMPL", "xla") == "pallas" and res <= 10:
+    # measured-winner default under "auto" (hwbank, HARDWARE.md): on the
+    # v5e the Pallas kernel lowers and wins 2.6-3.1x vs the XLA snap in
+    # same-unit A/Bs with >=99.78% cell agreement; without a banked A/B
+    # for the live platform "auto" resolves to the XLA snap (CPU's
+    # `auto` winner — the native host pre-snap — never reaches here: it
+    # rides the prekeys path upstream)
+    if inprogram_snap_name(res) == "pallas":
         from heatmap_tpu.hexgrid import pallas_kernel
 
-        if pallas_kernel.pallas_available():
-            return pallas_kernel.latlng_to_cell_pallas
+        return pallas_kernel.latlng_to_cell_pallas
     return hexdev.latlng_to_cell_vec
 
 
@@ -249,7 +318,20 @@ def merge_batch(
     if impl is None:
         impl = _resolve_merge_impl()
     if impl == "auto":
-        impl = "rank" if state.capacity >= 4 * ev_hi.shape[0] else "sort"
+        # a banked on-chip crossover (tools/hw_burst.py merge units,
+        # HARDWARE.md) outranks the static capacity-ratio rule: on the
+        # v5e sort won ALL three shapes, including the streaming shape
+        # the 4x rule would hand to rank (rank is the measured CPU
+        # winner there, so the static rule stays as the fallback)
+        if MERGE_BANK_PIN is _BANK_LIVE:
+            from heatmap_tpu import hwbank
+
+            banked = hwbank.merge_winner()
+        else:
+            banked = MERGE_BANK_PIN
+        impl = (banked
+                or ("rank" if state.capacity >= 4 * ev_hi.shape[0]
+                    else "sort"))
     slow = {"rank": _merge_rank, "probe": _merge_probe,
             "sort": _merge_sort}[impl]
     if _resolve_fastpath():

@@ -1,0 +1,180 @@
+"""Measured-winner ``auto`` defaults from banked on-chip data.
+
+Round 5: the TPU relay finally stayed up long enough for
+``tools/hw_burst.py --loop`` to bank every measurement unit
+(HW_PROGRESS.json, rendered as HARDWARE.md).  Two measured winners
+contradict the CPU-derived static heuristics:
+
+- **merge impl**: ``sort`` won ALL three (batch, slab) shapes on the
+  v5e — the capacity>=4x-batch rule would have picked ``rank`` for the
+  streaming shape (rank IS the measured CPU winner there, so the static
+  rule stays as the no-bank fallback);
+- **emit pull**: ``full`` beat ``prefix`` at every live-row count on
+  the tunnel attachment (124 vs 138 ms at 256 live rows) — round-trips,
+  not D2H bytes, dominate a remote-attached chip.  ``prefix`` remains
+  the static off-CPU fallback for locally-attached chips;
+- **snap**: the Pallas kernel lowers through Mosaic and wins 2.6-3.1x
+  vs the XLA in-program snap in same-unit A/Bs at res 7/8/9 with
+  >=99.78% cell agreement (f32 cell-edge points only).
+
+``auto`` config values consult this bank so each attachment runs its
+own measured winner; without a bank file (normal production deploys)
+the static fallbacks apply unchanged.  ``HEATMAP_HW_BANK`` overrides
+the bank path (empty string disables the bank entirely).  Entries only
+apply when their ``_platform`` AND ``_device_kind`` stamps match the
+live JAX backend, so a bank harvested on TPU never steers a
+CPU-failover run.  LIMITATION: device kind cannot distinguish a
+tunnel-attached v5e from a locally-attached one, and several winners
+(emit pull above all) encode attachment latency — a deploy on
+same-model hardware with a different attachment should re-harvest
+(``tools/hw_burst.py --loop``) or disable the shipped bank
+(``HEATMAP_HW_BANK=``).  Every banked steer is logged at INFO so it is
+visible in production logs.
+
+The reference has no analogue: its perf knobs are Spark conf
+(/root/reference/heatmap_stream.py:241-249) tuned by hand.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any
+
+log = logging.getLogger(__name__)
+
+# one INFO line per distinct (knob, winner) per process — banked steers
+# must be visible in production logs without spamming per trace
+_logged: "set[tuple[str, str]]" = set()
+
+
+def _steer(knob: str, winner: str) -> str:
+    if (knob, winner) not in _logged:
+        _logged.add((knob, winner))
+        log.info("hardware bank steers %s=%r (measured winner from %s; "
+                 "set HEATMAP_HW_BANK= to disable)", knob, winner,
+                 _bank_path())
+    return winner
+
+_DEFAULT_BANK = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "HW_PROGRESS.json")
+
+# (path, mtime) -> units dict; the bank is small and read at most a few
+# times per process (config/trace time), so one mtime-keyed slot is
+# plenty.
+_cache: "tuple[tuple[str, float], dict[str, Any]] | None" = None
+
+
+def _bank_path() -> str:
+    return os.environ.get("HEATMAP_HW_BANK", _DEFAULT_BANK)
+
+
+def units() -> "dict[str, Any]":
+    """Banked unit-name -> data mapping, or {} when no bank exists."""
+    global _cache
+    path = _bank_path()
+    if not path:
+        return {}
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return {}
+    key = (path, mtime)
+    if _cache is not None and _cache[0] == key:
+        return _cache[1]
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = {name: entry["data"]
+                    for name, entry in json.load(fh)["units"].items()
+                    if isinstance(entry, dict) and "data" in entry}
+    except (OSError, ValueError, KeyError, TypeError):
+        return {}
+    _cache = (key, data)
+    return data
+
+
+def _platform() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+def _device_kind() -> "str | None":
+    import jax
+
+    try:
+        return jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001 - no devices / backend init failure
+        return None
+
+
+def _on_platform(name: str) -> "dict[str, Any] | None":
+    """Unit data iff its platform AND device-kind stamps match the live
+    backend.  The bank file ships in the checkout, so a winner measured
+    on the tunnel-attached "TPU v5 lite" must not steer, say, a
+    locally-attached v4 pod slice — attachment latency is exactly what
+    several winners (emit pull above all) encode.  Entries without a
+    device-kind stamp (CPU units, legacy banks) gate on platform only.
+    """
+    data = units().get(name)
+    if not isinstance(data, dict):
+        return None
+    if data.get("_platform") != _platform():
+        return None
+    stamped = data.get("_device_kind")
+    if stamped is not None and stamped != _device_kind():
+        return None
+    return data
+
+
+def merge_winner() -> "str | None":
+    """Unanimous banked merge-impl winner for this platform, else None.
+
+    All three shape units (streaming/backfill/balanced) must be banked
+    for the live platform and agree; a split verdict falls back to the
+    static capacity-ratio heuristic in engine.step.merge_batch.
+    """
+    winners = set()
+    for name in ("merge_stream", "merge_backfill", "merge_balanced"):
+        data = _on_platform(name)
+        if data is None or data.get("winner") not in ("sort", "rank",
+                                                      "probe"):
+            return None
+        winners.add(data["winner"])
+    if len(winners) != 1:
+        return None
+    return _steer("merge_impl", winners.pop())
+
+
+def pull_winner() -> "str | None":
+    """Majority banked emit-pull winner for this platform, else None."""
+    data = _on_platform("pull")
+    if data is None:
+        return None
+    rows = data.get("rows") or []
+    votes = [r.get("winner") for r in rows
+             if r.get("winner") in ("full", "prefix")]
+    if not votes:
+        return None
+    full = sum(1 for v in votes if v == "full")
+    return _steer("emit_pull", "full" if full * 2 > len(votes)
+                  else "prefix")
+
+
+def snap_winner() -> "str | None":
+    """"pallas" iff the banked A/B passes the HARDWARE.md decision rule.
+
+    Rule (stated in HARDWARE.md next to the table): the kernel lowers,
+    wins at the operating res 8, and agrees with the XLA snap on
+    >99.7% of 1M uniform points (disagreements are f32 cell-edge
+    rounding; the snap impl is pinned across checkpoint resume, see
+    stream/checkpoint.py, so a mid-stream impl change cannot re-key
+    cells).  Anything else -> None (static default: in-program XLA).
+    """
+    data = _on_platform("snap_pal_r8")
+    if (data is None or data.get("lowering") != "ok"
+            or data.get("speedup_vs_xla", 0.0) <= 1.0
+            or data.get("agree_frac", 0.0) <= 0.997):
+        return None
+    return _steer("h3_snap", "pallas")

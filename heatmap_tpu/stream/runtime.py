@@ -101,10 +101,17 @@ class MicroBatchRuntime:
         self._pending = None  # last batch's emits, still on device
         # live-prefix emit pulls (flush_pending): explicit knob wins;
         # auto = on for accelerators (where D2H bytes cost), off for CPU
-        # (an extra round trip with nothing to save)
-        self._prefix_pull = (
-            cfg.emit_pull == "prefix"
-            or (cfg.emit_pull == "auto" and jax.default_backend() != "cpu"))
+        # (an extra round trip with nothing to save).  A banked pull A/B
+        # for this platform (hwbank, HARDWARE.md) overrides the static
+        # off-CPU choice: on the tunnel-attached v5e `full` measured
+        # faster at EVERY live-row count — round-trips dominate there,
+        # not D2H bytes.
+        if cfg.emit_pull == "auto" and jax.default_backend() != "cpu":
+            from heatmap_tpu import hwbank
+
+            self._prefix_pull = (hwbank.pull_winner() or "prefix") == "prefix"
+        else:
+            self._prefix_pull = cfg.emit_pull == "prefix"
         self._carry_cols = None  # overshoot remainder of a batch-granular poll
         self._ckpt_due = False  # cadence hit while mid-carry; commit ASAP
         self._last_pull_s = 0.0  # wall of the most recent deferred pull
@@ -189,6 +196,39 @@ class MicroBatchRuntime:
         self._idle_keys = None
         h3_impl = os.environ.get("HEATMAP_H3_IMPL", "auto")
         self._h3_env = h3_impl
+        # Freeze the in-program snap POLICY now (r5 review): resolving
+        # lazily at trace time would let a hardware bank file appearing
+        # or changing MID-RUN flip the kernel at a slab-growth retrace
+        # and float the checkpointed impl name.  The slot is
+        # module-global: concurrent runtimes in one process share one
+        # policy (a resumed runtime's checkpoint pin overwrites this
+        # below; mixing runtimes with conflicting policies is
+        # unsupported and warned about).
+        from heatmap_tpu.engine import step as engine_step
+
+        snap_policy = engine_step.resolve_snap_policy(ignore_pin=True)
+        if engine_step.SNAP_IMPL not in (None, snap_policy):
+            log.warning(
+                "overriding in-process H3 snap policy pin %r -> %r; "
+                "concurrent runtimes with different snap policies in "
+                "one process are unsupported",
+                engine_step.SNAP_IMPL, snap_policy)
+        engine_step.SNAP_IMPL = snap_policy
+        # Freeze the merge-impl bank verdict the same way (r5 review):
+        # one snapshot at init — never the live file from inside a
+        # trace — so a bank rewritten mid-run (hw_burst --loop) cannot
+        # recompile a different lockstep program after the multihost
+        # collective below validated this snapshot.
+        from heatmap_tpu import hwbank
+
+        merge_pin = hwbank.merge_winner()
+        prior = engine_step.MERGE_BANK_PIN
+        if prior is not engine_step._BANK_LIVE and prior != merge_pin:
+            log.warning(
+                "overriding in-process merge bank pin %r -> %r; "
+                "concurrent runtimes with different bank verdicts in "
+                "one process are unsupported", prior, merge_pin)
+        engine_step.MERGE_BANK_PIN = merge_pin
         # auto: on the CPU backend the C++ host pre-snap is the measured
         # winner (round-3 autotune on this host: native+sort 1.11M ev/s
         # vs xla+sort 0.23M — the in-program snap dominates the batch);
@@ -263,6 +303,43 @@ class MicroBatchRuntime:
                 log.warning(
                     "peer hosts requested the native snap but this host "
                     "can't provide it; all hosts fall back to in-program")
+            # cross-host agreement on the BANK-derived trace-time
+            # choices (r5 review): each host resolved its snap policy
+            # and merge winner from its LOCAL HW_PROGRESS.json above; a
+            # skewed checkout/bank must not let hosts trace different
+            # kernels (pallas-vs-xla snaps re-key f32 cell-edge events
+            # by ingesting host; divergent merge impls compile
+            # different lockstep programs).  Unanimity per value via
+            # zero-variance over (code, code^2) sums — every host
+            # reaches the same verdict, so the fallbacks converge.
+            from heatmap_tpu.engine import step as engine_step
+
+            def _unanimous(code: float) -> bool:
+                s, s2, n = self._gpair(code, code * code, 1.0)
+                return bool(s == code * n and s2 == code * code * n)
+
+            # probe the RESOLVED kernel, not the policy: two hosts can
+            # agree on policy "pallas" while only one can actually
+            # lower it (per-host jaxlib/toolchain) — the kernels traced
+            # are what must match
+            snap_resolved = engine_step.inprogram_snap_name(
+                min(cfg.resolutions))
+            if not _unanimous(1.0 if snap_resolved == "pallas" else 0.0):
+                if snap_resolved == "pallas":
+                    log.warning(
+                        "pallas snap disabled: not every host resolves "
+                        "it (bank skew or Mosaic lowering) — all hosts "
+                        "use the XLA snap")
+                engine_step.SNAP_IMPL = "xla"
+            mw = engine_step.MERGE_BANK_PIN  # frozen snapshot from above
+            if not _unanimous(
+                    float({"sort": 1, "rank": 2, "probe": 3}.get(mw, 0))):
+                if mw is not None:
+                    log.warning(
+                        "banked merge winner %r ignored: hosts' "
+                        "hardware banks disagree — every host uses the "
+                        "static auto rule", mw)
+                engine_step.MERGE_BANK_PIN = None
 
         # the pair whose stats define the batch-level counters
         self._primary = (
@@ -352,8 +429,20 @@ class MicroBatchRuntime:
     @property
     def _snap_impl_name(self) -> str:
         """The H3 snap keying this run's state: host C++ pre-snap vs the
-        in-program (XLA) snap.  Recorded in every checkpoint."""
-        return "native" if self._host_snap is not None else "xla"
+        RESOLVED in-program snap ("pallas" | "xla" — under "auto" a
+        banked on-chip A/B can pick pallas, engine.step.inprogram_snap_name).
+        Recorded in every checkpoint so the pin below survives the bank
+        file appearing or vanishing across a resume.  Stable for the
+        life of the runtime: the policy behind it was frozen into
+        engine_step.SNAP_IMPL at init.  Probed at min(resolutions) —
+        pallas eligibility is per-res (res <= 10) and the LOWEST res is
+        the one eligible whenever any is; higher ineligible resolutions
+        degrade to xla deterministically from the same recorded policy."""
+        if self._host_snap is not None:
+            return "native"
+        from heatmap_tpu.engine import step as engine_step
+
+        return engine_step.inprogram_snap_name(min(self.cfg.resolutions))
 
     def _pin_snap_impl(self, ck_snap: str | None) -> None:
         """Keep the snap impl FIXED across a resume (ADVICE r4 #1).
@@ -366,7 +455,7 @@ class MicroBatchRuntime:
         resume.  Under ``auto`` the checkpointed impl wins; an explicit
         env override is honored but the re-keying hazard is logged.
         """
-        if ck_snap not in ("native", "xla"):
+        if ck_snap not in ("native", "xla", "pallas"):
             # host-uniform branch: the field is written post-agreement,
             # so every host sees the same (absent/legacy) value and none
             # reaches the collective below — no desync
@@ -378,24 +467,42 @@ class MicroBatchRuntime:
                     "HEATMAP_H3_IMPL=%s forces %r; events on f32 cell "
                     "edges may re-key across this resume", ck_snap,
                     self._h3_env, self._snap_impl_name)
-            elif ck_snap == "xla":
-                self._host_snap = None
-                log.info("pinned H3 snap impl 'xla' from checkpoint "
-                         "(was 'native' under HEATMAP_H3_IMPL=auto)")
-            else:
+            elif ck_snap == "native":
                 from heatmap_tpu.hexgrid import native_snap
 
+                was = self._snap_impl_name
                 if native_snap.available():
                     self._host_snap = native_snap.snap_arrays
                     log.info("pinned H3 snap impl 'native' from "
-                             "checkpoint (was 'xla' under "
-                             "HEATMAP_H3_IMPL=auto)")
+                             "checkpoint (was %r under "
+                             "HEATMAP_H3_IMPL=auto)", was)
                 else:
                     log.warning(
                         "checkpoint state was keyed with the native C++ "
                         "snap but no C++ toolchain is available; "
                         "continuing with the in-program snap (f32 "
                         "cell-edge events may re-key)")
+            else:
+                # in-program impl recorded ("xla" | "pallas"): disable
+                # any host pre-snap and pin the engine's trace-time
+                # resolution so a hardware bank appearing/vanishing
+                # across the resume (hwbank's "auto" input) cannot flip
+                # the in-program kernel mid-stream
+                from heatmap_tpu.engine import step as engine_step
+
+                was = self._snap_impl_name
+                self._host_snap = None
+                engine_step.SNAP_IMPL = ck_snap
+                if self._snap_impl_name != ck_snap:  # pallas unavailable
+                    log.warning(
+                        "checkpoint state was keyed with the %r snap "
+                        "but it is unavailable on this backend; "
+                        "continuing with %r (f32 cell-edge events may "
+                        "re-key)", ck_snap, self._snap_impl_name)
+                else:
+                    log.info("pinned H3 snap impl %r from checkpoint "
+                             "(was %r under HEATMAP_H3_IMPL=auto)",
+                             ck_snap, was)
         if self._multiproc:
             # same all-or-nothing rule as startup.  EVERY host must reach
             # this collective whenever ck_snap is valid — the pin outcome
@@ -415,6 +522,27 @@ class MicroBatchRuntime:
                 log.warning(
                     "peer hosts resolved the native snap but this host "
                     "cannot; all hosts fall back to in-program")
+            # and the same rule for the RESOLVED in-program kernel: a
+            # checkpoint pin of "pallas" lands on every host, but a
+            # host whose Mosaic lowering fails degrades to xla — the
+            # init-time unanimity collective ran BEFORE this pin could
+            # override its forced value, so re-check here (uniform:
+            # every host reaches this whenever ck_snap is valid)
+            from heatmap_tpu.engine import step as engine_step
+
+            resolved = engine_step.inprogram_snap_name(
+                min(self.cfg.resolutions))
+            pal, total, _ = self._gpair(
+                1.0 if resolved == "pallas" else 0.0, 1.0)
+            if 0 < pal < total:
+                if resolved == "pallas":
+                    log.warning(
+                        "pallas snap disabled after checkpoint pin: "
+                        "only %d/%d shards can lower it — all hosts "
+                        "use the XLA snap (f32 cell-edge events may "
+                        "re-key vs the checkpoint)", int(pal),
+                        int(total))
+                engine_step.SNAP_IMPL = "xla"
 
     @property
     def _local_shards(self) -> int:
@@ -1063,3 +1191,11 @@ class MicroBatchRuntime:
                 self.source.close()
             finally:
                 self.writer.close()
+                # release the runtime-frozen engine policy globals (r5
+                # review): standalone merge_batch/bench callers in this
+                # process get the documented live-bank consult back
+                # instead of inheriting this runtime's snapshot forever
+                from heatmap_tpu.engine import step as engine_step
+
+                engine_step.SNAP_IMPL = None
+                engine_step.MERGE_BANK_PIN = engine_step._BANK_LIVE

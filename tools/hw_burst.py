@@ -88,6 +88,10 @@ UNITS: dict[str, tuple[int, int]] = {
     "headline_big": (600, 6),
     "headline_native": (600, 6),
     "stream_profile": (600, 6),
+    "headline_full": (600, 6),
+    "headline_b21": (600, 6),
+    "headline_b21_native": (600, 6),
+    "stream_tuned": (600, 6),
 }
 
 
@@ -257,7 +261,8 @@ def unit_pull() -> dict:
 def unit_headline(total=HEADLINE_SHAPE["total"],
                   batch=HEADLINE_SHAPE["batch"],
                   chunk=HEADLINE_SHAPE["chunk"],
-                  cap=HEADLINE_SHAPE["cap"], h3="xla") -> dict:
+                  cap=HEADLINE_SHAPE["cap"], h3="xla",
+                  pull=None) -> dict:
     """Production-shaped fold throughput: bench.py's own `_run_config`,
     without the autotune sweep (too slow for a flap window).  bench.py
     remains the canonical end-of-round harness; this banks a number
@@ -271,7 +276,8 @@ def unit_headline(total=HEADLINE_SHAPE["total"],
 
     flat = bench._gen_capture(bench._required_events(total, batch, chunk),
                               batch)
-    pull = "prefix" if jax.default_backend() != "cpu" else "full"
+    if pull is None:
+        pull = "prefix" if jax.default_backend() != "cpu" else "full"
     eps, info = bench._run_config(
         flat, res=8, cap=cap, bins=HEADLINE_SHAPE["bins"],
         emit_cap=HEADLINE_SHAPE["emit_cap"], batch=batch,
@@ -286,7 +292,11 @@ def unit_headline(total=HEADLINE_SHAPE["total"],
     return out
 
 
-def unit_stream_profile() -> dict:
+def _stream_run(n: int, batch_log2: int, profile: bool) -> dict:
+    """Full MicroBatchRuntime run (runtime, not the bare bench fold) on
+    the live backend; ``profile`` additionally captures a jax.profiler
+    trace into tpu-trace/ (adds overhead — keep comparisons
+    like-for-like)."""
     import numpy as np
 
     _device_ready()
@@ -296,9 +306,10 @@ def unit_stream_profile() -> dict:
     from heatmap_tpu.sink import MemoryStore
     from heatmap_tpu.stream import MemorySource, MicroBatchRuntime
 
-    trace_dir = os.path.join(ROOT, "tpu-trace")
-    os.environ["HEATMAP_PROFILE_DIR"] = trace_dir
-    n = 500_000
+    trace_dir = None
+    if profile:
+        trace_dir = os.path.join(ROOT, "tpu-trace")
+        os.environ["HEATMAP_PROFILE_DIR"] = trace_dir
     rng = np.random.default_rng(2)
     t0 = int(time.time()) - 600
     evs = [{"provider": "bench", "vehicleId": f"v{i % 5000}",
@@ -306,7 +317,8 @@ def unit_stream_profile() -> dict:
             "lon": float(rng.uniform(-72.0, -70.0)),
             "speedKmh": 30.0, "bearing": 0.0, "accuracyM": 4.0,
             "ts": t0 + (i % 300)} for i in range(n)]
-    cfg = load_config({}, batch_size=1 << 14, state_capacity_log2=17,
+    cfg = load_config({}, batch_size=1 << batch_log2,
+                      state_capacity_log2=max(17, batch_log2 + 1),
                       speed_hist_bins=32, store="memory",
                       checkpoint_dir=tempfile.mkdtemp(prefix="hwb-ckpt-"))
     src = MemorySource(evs)
@@ -321,11 +333,27 @@ def unit_stream_profile() -> dict:
         "span_build_p50_ms", "span_pull_p50_ms", "span_device_p50_ms",
         "span_sink_submit_p50_ms") if k in snap}
     p50 = snap.get("batch_latency_p50_ms", 0.0)
-    return {"n": n, "wall_s": round(wall, 2),
-            "wall_mev_s": round(n / wall / 1e6, 3),
-            "steady_mev_s": round(cfg.batch_size / (p50 / 1e3) / 1e6, 3)
-            if p50 else None,
-            "trace_dir": trace_dir, "metrics": keep}
+    out = {"n": n, "batch": 1 << batch_log2, "wall_s": round(wall, 2),
+           "wall_mev_s": round(n / wall / 1e6, 3),
+           "steady_mev_s": round(cfg.batch_size / (p50 / 1e3) / 1e6, 3)
+           if p50 else None,
+           "pull": "prefix" if rt._prefix_pull else "full",
+           "metrics": keep}
+    if trace_dir:
+        out["trace_dir"] = trace_dir
+    return out
+
+
+def unit_stream_profile() -> dict:
+    return _stream_run(n=500_000, batch_log2=14, profile=True)
+
+
+def unit_stream_tuned() -> dict:
+    """Sustained runtime with the banked measured-winner defaults
+    engaged (full pull / unanimous merge / pallas snap via hwbank) and
+    a batch big enough to amortize the tunnel round-trip — the
+    end-to-end proof that the flipped `auto` defaults pay."""
+    return _stream_run(n=2_000_000, batch_log2=18, profile=False)
 
 
 def unit_contact() -> dict:
@@ -362,12 +390,27 @@ UNIT_FNS = {
     # accelerator this trades device compute for host work + transfer;
     # only a measurement says which wins on this attachment
     "headline_native": lambda: unit_headline(h3="native"),
+    # tuned-shape probes added after the first full harvest (round 5):
+    # the pull unit measured `full` beating `prefix` on this tunnel
+    # attachment, and headline_big showed bigger batches amortizing the
+    # per-call round-trip — chase the product of both.
+    "headline_full": lambda: unit_headline(total=1 << 23, batch=1 << 20,
+                                           chunk=4, cap=1 << 18,
+                                           pull="full"),
+    "headline_b21": lambda: unit_headline(total=1 << 24, batch=1 << 21,
+                                          chunk=8, cap=1 << 18,
+                                          pull="full"),
+    "headline_b21_native": lambda: unit_headline(total=1 << 24,
+                                                 batch=1 << 21, chunk=8,
+                                                 cap=1 << 18, h3="native",
+                                                 pull="full"),
     "snap_xla_r7": lambda: unit_snap_xla(7),
     "snap_xla_r8": lambda: unit_snap_xla(8),
     "snap_xla_r9": lambda: unit_snap_xla(9),
     "snap_pal_r7": lambda: unit_snap_pallas(7),
     "snap_pal_r8": lambda: unit_snap_pallas(8),
     "snap_pal_r9": lambda: unit_snap_pallas(9),
+    "stream_tuned": unit_stream_tuned,
     "merge_stream": lambda: unit_merge("streaming"),
     "merge_backfill": lambda: unit_merge("backfill"),
     "merge_balanced": lambda: unit_merge("balanced"),
@@ -528,7 +571,9 @@ def report() -> None:
                   f"compile+run {d.get('matmul512_compile_run_s', '?')}s",
                   ""]
     heads = [(k, hw[k]) for k in ("micro", "headline", "headline_big",
-                                  "headline_native", "headline_bench")
+                                  "headline_native", "headline_full",
+                                  "headline_b21", "headline_b21_native",
+                                  "headline_bench")
              if k in hw]
     if heads:
         lines += ["## Headline fold throughput (bench.py `_run_config`)",
@@ -537,7 +582,9 @@ def report() -> None:
             bs = f"{d['batch']:,}" if "batch" in d else "?"
             lines.append(
                 f"- {k} (batch {bs} x chunk "
-                f"{d.get('chunk', '?')}): **{d['mev_per_s']} M ev/s** "
+                f"{d.get('chunk', '?')}, pull {d.get('pull', '?')}, "
+                f"h3 {d.get('h3', 'xla')}): "
+                f"**{d['mev_per_s']} M ev/s** "
                 f"({d['events_per_sec']:,.0f} events/sec), "
                 f"p50 batch {d['p50_batch_ms']:.1f} ms, "
                 f"{d['n_active']} active groups, "
@@ -553,27 +600,48 @@ def report() -> None:
                   f"(compile {d.get('compile_s', '?')}s)", ""]
     snaps = {k: v for k, v in hw.items() if k.startswith("snap_")}
     if snaps:
-        lines += ["## H3 snap: Pallas vs XLA (1M points)", "",
+        # The A/B columns come from the SAME unit (xla and pallas timed
+        # back-to-back in one subprocess) — cross-unit timings on the
+        # tunnel-attached relay swing several-x run to run, so mixing
+        # the standalone snap_xla ms into this table would contradict
+        # the within-unit speedup.  The standalone unit is reported as
+        # its own row below the table.
+        lines += ["## H3 snap: Pallas vs XLA (1M points, same-unit A/B)",
+                  "",
                   "| res | XLA ms | Pallas ms | speedup | agree |",
                   "|---|---|---|---|---|"]
         for res in (7, 8, 9):
-            x = hw.get(f"snap_xla_r{res}")
             p = hw.get(f"snap_pal_r{res}")
-            xm = f"{x['ms']:.2f}" if x else "—"
             if p is None:
-                pm, sp, ag = "—", "—", "—"
+                xm, pm, sp, ag = "—", "—", "—", "—"
             elif p.get("lowering") != "ok":
-                pm, sp, ag = "LOWERING FAILED", "—", "—"
+                xm, pm, sp, ag = "—", "LOWERING FAILED", "—", "—"
             else:
+                xm = f"{p['xla_ms']:.2f}"
                 pm = f"{p['pallas_ms']:.2f}"
                 sp = f"{p['speedup_vs_xla']:.2f}x"
                 ag = f"{p['agree_frac']:.4%}"
             lines.append(f"| {res} | {xm} | {pm} | {sp} | {ag} |")
+        solo = [f"res {r}: {hw[f'snap_xla_r{r}']['ms']:.2f} ms "
+                f"({hw[f'snap_xla_r{r}']['mev_per_s']:.0f} Mev/s)"
+                for r in (7, 8, 9) if f"snap_xla_r{r}" in hw]
+        if solo:
+            lines += ["", "Standalone XLA snap unit (separate capture; "
+                      "tunnel variance makes it incomparable to the A/B "
+                      "rows): " + "; ".join(solo)]
         lines += ["", "Decision rule: flip HEATMAP_H3_IMPL default to "
                   "pallas iff it lowers, wins at res 8, and agree > "
-                  "99.7%.", ""]
+                  "99.7%.  Wired: `auto` consults this bank via "
+                  "heatmap_tpu.hwbank.snap_winner() at trace time "
+                  "(engine.step._snap_impl); the resolved impl is "
+                  "pinned across checkpoint resume.", ""]
     merges = [hw[k] for k in ("merge_stream", "merge_backfill",
                               "merge_balanced") if k in hw]
+    _merge_note = (
+        "Decision: `auto` consults this bank (hwbank.merge_winner()) "
+        "and takes a UNANIMOUS banked winner for the live platform over "
+        "the static capacity-ratio rule; a split verdict falls back to "
+        "the rule (rank stays the measured CPU streaming winner).")
     if merges:
         lines += ["## Merge fold: sort vs rank vs probe crossover", "",
                   "| shape | batch | slab | sort ms | rank ms | probe ms "
@@ -584,9 +652,7 @@ def report() -> None:
                          f"{d['slab']:,} | {d['sort_ms']} | "
                          f"{d['rank_ms']} | {d.get('probe_ms', '—')} | "
                          f"{d['winner']} |")
-        lines += ["", "Decision rule: if rank wins the streaming shape "
-                  "and auto's 4x-ratio pick matches the winners, make "
-                  "HEATMAP_MERGE_IMPL=auto the process default.", ""]
+        lines += ["", _merge_note, ""]
     if "pull" in hw:
         d = hw["pull"]
         lines += ["## Emit pull: full vs live-prefix", "",
@@ -597,14 +663,26 @@ def report() -> None:
         for r in d["rows"]:
             lines.append(f"| {r['live']:,} | {r['full_ms']} | "
                          f"{r['prefix_ms']} | {r['winner']} |")
-        lines.append("")
-    if "stream_profile" in hw:
-        d = hw["stream_profile"]
-        lines += ["## Sustained streaming run", "",
-                  f"- {d['n']:,} events in {d['wall_s']}s "
-                  f"({d['wall_mev_s']} M ev/s wall incl. compile; "
-                  f"steady-state {d['steady_mev_s']} M ev/s from p50)",
-                  f"- trace: `{d['trace_dir']}`"]
+        lines += ["", "Decision: HEATMAP_EMIT_PULL=auto consults this "
+                  "bank (hwbank.pull_winner(), majority of rows) on "
+                  "non-CPU backends; without a bank the static off-CPU "
+                  "fallback stays `prefix` (locally-attached chips pay "
+                  "D2H bytes, not round-trips).", ""]
+    for name, title in (("stream_profile",
+                         "Sustained streaming run (profiled)"),
+                        ("stream_tuned",
+                         "Sustained streaming run (banked defaults, "
+                         "no profiler)")):
+        if name not in hw:
+            continue
+        d = hw[name]
+        lines += [f"## {title}", "",
+                  f"- {d['n']:,} events, batch {d.get('batch', 16384):,}"
+                  f", pull {d.get('pull', '?')}: {d['wall_s']}s wall "
+                  f"({d['wall_mev_s']} M ev/s incl. compile; "
+                  f"steady-state {d['steady_mev_s']} M ev/s from p50)"]
+        if "trace_dir" in d:
+            lines.append(f"- trace: `{d['trace_dir']}`")
         for k, v in d["metrics"].items():
             lines.append(f"- {k}: {v}")
         lines.append("")
