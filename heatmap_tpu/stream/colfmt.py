@@ -100,7 +100,23 @@ def encode_batch(events) -> bytes:
         vid.append(strings.setdefault(vehicle, len(strings)))
 
     n = len(lat)
-    tab = _encode_strtab(strings)
+    # canonicalize the table: ids above were assigned first-seen, so the
+    # SAME name set arriving in a different row order (live pollers,
+    # rotating replay windows) would produce a different blob record
+    # after record — defeating the decoder's blob-keyed LUT cache, whose
+    # misses (a ~5k-name Python parse + re-intern per record) were the
+    # top term of the round-5 ingest profile.  Sorted names make the
+    # blob a pure function of the name SET, so steady-state decode does
+    # no per-string work at all.
+    order = sorted(range(len(strings)), key=list(strings).__getitem__)
+    remap = np.empty(max(len(strings), 1), "<u4")
+    remap[np.asarray(order, np.int64)] = np.arange(len(order), dtype="<u4")
+    names = sorted(strings)
+    tab = _encode_strtab(names)
+    pid_arr = remap[np.asarray(pid, np.int64)] if pid else \
+        np.zeros(0, "<u4")
+    vid_arr = remap[np.asarray(vid, np.int64)] if vid else \
+        np.zeros(0, "<u4")
     head = _HEAD.pack(MAGIC, VERSION, 0, n, len(strings), len(tab))
     return b"".join([
         head,
@@ -110,8 +126,8 @@ def encode_batch(events) -> bytes:
         np.asarray(bearing, "<f4").tobytes(),
         np.asarray(acc, "<f4").tobytes(),
         np.asarray(ts, "<i8").tobytes(),
-        np.asarray(pid, "<u4").tobytes(),
-        np.asarray(vid, "<u4").tobytes(),
+        pid_arr.astype("<u4", copy=False).tobytes(),
+        vid_arr.astype("<u4", copy=False).tobytes(),
         tab,
     ])
 
