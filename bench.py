@@ -512,7 +512,30 @@ def main() -> dict:
         try_reps = int(os.environ.get("BENCH_TRY_REPS",
                                       "1" if on_accel else "2"))
 
+        # Sweep deadline (r5): each config costs a fresh 75-90s compile
+        # on the tunnel-attached chip, and one pathological lowering
+        # (probe at batch 2^19 compiled >20 min in the round-5 run) can
+        # eat the whole BENCH_TIMEOUT_S watchdog — which then discards
+        # EVERY on-chip result for a CPU fallback.  Candidates that
+        # would START after the deadline are skipped (best-so-far wins);
+        # the budget deliberately leaves the other half of the watchdog
+        # for the full headline run + overflow re-runs.
+        t_sweep0 = time.monotonic()
+        tune_deadline = float(os.environ.get(
+            "BENCH_TUNE_DEADLINE_S",
+            str(min(900.0,
+                    0.5 * float(os.environ.get("BENCH_TIMEOUT_S", "1800"))))
+            if on_accel else "1e18"))
+        deadline_hit = []
+
         def _try(b, c, im, cp, h3, best):
+            if time.monotonic() - t_sweep0 > tune_deadline:
+                if not deadline_hit:
+                    deadline_hit.append(True)
+                    print(f"# autotune deadline ({tune_deadline:.0f}s) "
+                          f"reached — keeping best-so-far, skipping "
+                          f"remaining candidates", file=sys.stderr)
+                return best
             short = min(n_events, 4 * b * c)
             tag = f"{im} b={b} c={c} cap={cp} h3={h3}"
             eps = 0.0
