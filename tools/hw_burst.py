@@ -92,6 +92,12 @@ UNITS: dict[str, tuple[int, int]] = {
     "headline_b21": (600, 6),
     "headline_b21_native": (600, 6),
     "stream_tuned": (600, 6),
+    # the fused 3-pair program is ONE compile and a killed compile
+    # leaves nothing in the persistent cache — the cap must cover the
+    # whole first compile (~>10 min on the tunnel) or every attempt
+    # restarts from scratch
+    "hex_pyramid": (1800, 3),
+    "multi_window": (1800, 3),
 }
 
 
@@ -262,7 +268,7 @@ def unit_headline(total=HEADLINE_SHAPE["total"],
                   batch=HEADLINE_SHAPE["batch"],
                   chunk=HEADLINE_SHAPE["chunk"],
                   cap=HEADLINE_SHAPE["cap"], h3="xla",
-                  pull=None) -> dict:
+                  pull=None, pairs=None) -> dict:
     """Production-shaped fold throughput: bench.py's own `_run_config`,
     without the autotune sweep (too slow for a flap window).  bench.py
     remains the canonical end-of-round harness; this banks a number
@@ -282,13 +288,15 @@ def unit_headline(total=HEADLINE_SHAPE["total"],
         flat, res=8, cap=cap, bins=HEADLINE_SHAPE["bins"],
         emit_cap=HEADLINE_SHAPE["emit_cap"], batch=batch,
         chunk=chunk, merge_impl=HEADLINE_SHAPE["merge"], n_events=total,
-        h3_impl=h3, pull=pull)
+        h3_impl=h3, pull=pull, pairs=pairs)
     out = headline_result(jax.devices()[0].device_kind, eps, info,
                           batch=batch, chunk=chunk,
                           bins=HEADLINE_SHAPE["bins"],
                           emit_cap=HEADLINE_SHAPE["emit_cap"], cap=cap,
                           res=8, pull=pull)
     out["h3"] = h3
+    if pairs is not None:
+        out["pairs"] = [list(pr) for pr in pairs]
     return out
 
 
@@ -411,6 +419,15 @@ UNIT_FNS = {
     "snap_pal_r8": lambda: unit_snap_pallas(8),
     "snap_pal_r9": lambda: unit_snap_pallas(9),
     "stream_tuned": unit_stream_tuned,
+    # fused BASELINE #4/#5 pipelines on chip (round-5 session 2): the
+    # single-pair units above can't answer what the 3-pair fusion costs
+    # on the v5e; same shape as headline_full, all pairs in ONE program
+    "hex_pyramid": lambda: unit_headline(
+        total=1 << 22, batch=1 << 20, chunk=4, cap=1 << 18, pull="full",
+        pairs=[(7, 300), (8, 300), (9, 300)]),
+    "multi_window": lambda: unit_headline(
+        total=1 << 22, batch=1 << 20, chunk=4, cap=1 << 18, pull="full",
+        pairs=[(8, 60), (8, 300), (8, 900)]),
     "merge_stream": lambda: unit_merge("streaming"),
     "merge_backfill": lambda: unit_merge("backfill"),
     "merge_balanced": lambda: unit_merge("balanced"),
@@ -573,6 +590,7 @@ def report() -> None:
     heads = [(k, hw[k]) for k in ("micro", "headline", "headline_big",
                                   "headline_native", "headline_full",
                                   "headline_b21", "headline_b21_native",
+                                  "hex_pyramid", "multi_window",
                                   "headline_bench")
              if k in hw]
     if heads:
@@ -580,10 +598,12 @@ def report() -> None:
                   ""]
         for k, d in heads:
             bs = f"{d['batch']:,}" if "batch" in d else "?"
+            pairs_tag = (f", pairs {d['pairs']}" if d.get("pairs")
+                         else "")
             lines.append(
                 f"- {k} (batch {bs} x chunk "
                 f"{d.get('chunk', '?')}, pull {d.get('pull', '?')}, "
-                f"h3 {d.get('h3', 'xla')}): "
+                f"h3 {d.get('h3', 'xla')}{pairs_tag}): "
                 f"**{d['mev_per_s']} M ev/s** "
                 f"({d['events_per_sec']:,.0f} events/sec), "
                 f"p50 batch {d['p50_batch_ms']:.1f} ms, "
