@@ -496,6 +496,29 @@ def run_pending(state: dict) -> bool:
             continue
         if state["attempts"].get(name, 0) >= max_att:
             continue
+        if timeout_s > 600 and not _cpu_mode():
+            # contact-gate expensive units (r5): a relay can accept TCP
+            # while its device backend is WEDGED (observed after a
+            # watchdog-killed client left an op dangling) — a wedged
+            # init would silently burn a 30-min attempt.  One cheap
+            # contact probe (60s) proves the backend is actually
+            # serving before the attempt counter is spent.
+            stamp = time.strftime("%H:%M:%S")
+            try:
+                gate = subprocess.run(
+                    [sys.executable, __file__, "--unit", "contact"],
+                    capture_output=True, text=True, timeout=60,
+                    cwd=ROOT)
+                gate_ok = gate.returncode == 0 and gate.stdout.strip()
+            except subprocess.TimeoutExpired:
+                gate_ok = False
+            if not gate_ok:
+                print(f"[{stamp}] contact-gate failed before {name}; "
+                      f"backend wedged — backing off", flush=True)
+                state["log"].append(f"{stamp} {name}: contact-gate "
+                                    f"failed (attempt not spent)")
+                _save(state)
+                return False
         state["attempts"][name] = state["attempts"].get(name, 0) + 1
         _save(state)
         stamp = time.strftime("%H:%M:%S")
