@@ -218,6 +218,41 @@ def test_merge_bank_pin_overrides_live_consult(monkeypatch, tmp_path):
         "gated-off bank still routed the banked winner")
 
 
+def test_runtime_close_restores_engine_globals(monkeypatch, tmp_path):
+    """A finished runtime must hand standalone merge_batch/bench callers
+    the documented live-bank consult back: run() freezes SNAP_IMPL and
+    MERGE_BANK_PIN at init, close() restores them (r5 review — the leak
+    made later same-process callers inherit the runtime's snapshot)."""
+    import tempfile
+    import time as _t
+
+    import numpy as np
+
+    from heatmap_tpu.config import load_config
+    from heatmap_tpu.engine import step as engine_step
+    from heatmap_tpu.sink import MemoryStore
+    from heatmap_tpu.stream import MemorySource, MicroBatchRuntime
+
+    monkeypatch.setenv("HEATMAP_HW_BANK",
+                       _write_bank(tmp_path, _merge_units("sort")))
+    t0 = int(_t.time()) - 60
+    evs = [{"provider": "p", "vehicleId": f"v{i}", "lat": 42.0,
+            "lon": -71.0, "speedKmh": 1.0, "bearing": 0.0,
+            "accuracyM": 1.0, "ts": t0} for i in range(64)]
+    cfg = load_config({}, batch_size=32, state_capacity_log2=8,
+                      speed_hist_bins=4, store="memory",
+                      checkpoint_dir=tempfile.mkdtemp())
+    src = MemorySource(evs)
+    src.finish()
+    rt = MicroBatchRuntime(cfg, src, MemoryStore(), checkpoint_every=2)
+    # init froze the knobs
+    assert engine_step.MERGE_BANK_PIN == "sort"
+    assert engine_step.SNAP_IMPL is not None
+    rt.run()
+    assert engine_step.MERGE_BANK_PIN is engine_step._BANK_LIVE
+    assert engine_step.SNAP_IMPL is None
+
+
 def test_inprogram_snap_name_pins_and_falls_back(monkeypatch, tmp_path):
     """SNAP_IMPL slot wins over env/bank; pallas degrades to xla when
     the kernel can't lower on this backend (CPU)."""
