@@ -395,9 +395,7 @@ def test_canonical_strtab_stable_under_row_order():
     for variant in (evs, rot, rev):
         value = encode_batch(variant)
         # table blob = everything after the fixed-size columns
-        import struct as _s
-
-        from heatmap_tpu.stream.colfmt import _HEAD, HEADER_SIZE
+        from heatmap_tpu.stream.colfmt import _HEAD
 
         magic, ver, _f, n, n_strings, tab_bytes = _HEAD.unpack_from(value)
         blobs.add(value[len(value) - tab_bytes:])
