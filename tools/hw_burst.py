@@ -548,6 +548,14 @@ def run_pending(state: dict) -> bool:
                 state["log"].append(f"{stamp} {name}: ok")
                 _save(state)
                 print(f"  -> ok: {json.dumps(data)[:200]}", flush=True)
+                try:
+                    # keep the rendered report current with every bank:
+                    # the round can end (driver commits the tree) while
+                    # this loop is unattended, and a stale HARDWARE.md
+                    # would contradict HW_PROGRESS.json
+                    report()
+                except Exception as e:  # noqa: BLE001 - never kill the loop
+                    print(f"  -> report render failed: {e}", flush=True)
                 continue
         tail = (proc.stderr or "")[-400:]
         state["log"].append(f"{stamp} {name}: rc={proc.returncode} {tail}")
