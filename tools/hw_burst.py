@@ -569,8 +569,9 @@ def run_pending(state: dict) -> bool:
 
 def loop() -> None:
     state = _load()
-    print(f"burst loop: {len(state['units'])}/{len(UNITS)} units banked",
-          flush=True)
+    print(f"burst loop: "
+          f"{sum(1 for n in UNITS if _done(state, n))}/{len(UNITS)} "
+          f"units banked", flush=True)
     while True:
         state = _load()  # see results banked by concurrent invocations
         if all(_done(state, n) for n in UNITS):
