@@ -19,7 +19,9 @@ import functools
 import gzip
 import json
 import logging
+import os
 import threading
+import time
 from wsgiref.simple_server import WSGIServer, WSGIRequestHandler, make_server
 from socketserver import ThreadingMixIn
 
@@ -104,9 +106,54 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
     default_grid = (cfg.default_grid()
                     if cfg is not None and hasattr(cfg, "default_grid")
                     else None)
+    # Render cache for the two data endpoints: rendering + gzipping a
+    # city-scale FeatureCollection costs ~0.5 s of the one host core
+    # PER REQUEST (measured: 6.4k tiles -> 3.7 MB body,
+    # tools/bench_serve.py), and the UI re-polls every refresh_ms with
+    # N clients multiplying it.  A hit requires BOTH an unchanged store
+    # write-version (any local upsert bumps it -> in-process writes
+    # invalidate instantly) AND a 1 s TTL (the bound that protects
+    # deployments where OTHER processes also write the backing store,
+    # which a local counter cannot see) — staleness is therefore capped
+    # at 1 s, far inside the ~10 s freshness budget the reference
+    # implies (5 s UI poll, 5-min windows).  HEATMAP_SERVE_CACHE_MS=0
+    # disables caching entirely.  Keyed per (path, grid); stores the
+    # ENCODED body and its gzip twin so repeat polls are a memcpy
+    # either way.
+    try:
+        cache_ttl_s = float(os.environ.get("HEATMAP_SERVE_CACHE_MS",
+                                           "1000")) / 1e3
+    except ValueError:
+        log.warning("HEATMAP_SERVE_CACHE_MS=%r is not a number; "
+                    "render cache disabled",
+                    os.environ.get("HEATMAP_SERVE_CACHE_MS"))
+        cache_ttl_s = 0.0
+    render_cache: dict = {}
+
+    def _cached_json(key, build):
+        if cache_ttl_s <= 0:
+            return json.dumps(build()).encode("utf-8"), None
+        now = time.monotonic()
+        ver = store.version()
+        hit = render_cache.get(key)
+        if hit is not None and hit[0] == ver and hit[1] > now:
+            return hit[2], hit[3]
+        data = json.dumps(build()).encode("utf-8")
+        gz = gzip.compress(data, compresslevel=1) if len(data) >= 1024 \
+            else None
+        if len(render_cache) >= 64:
+            # bounded against client-controlled ?grid= values — evict
+            # ONE arbitrary entry, not everything: a loop of bogus grid
+            # names must not wipe the hot tile render that real UI
+            # polls depend on
+            render_cache.pop(next(iter(render_cache)))
+        render_cache[key] = (ver, now + cache_ttl_s, data, gz)
+        return data, gz
 
     def app(environ, start_response):
         path = environ.get("PATH_INFO", "/")
+        pre_gz = None
+        data = None
         try:
             if path == "/api/tiles/latest":
                 qs = environ.get("QUERY_STRING", "")
@@ -118,10 +165,14 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                     # a multi-res pyramid would otherwise mix overlapping
                     # hexes in a single FeatureCollection
                     grid = default_grid
-                body = json.dumps(tiles_feature_collection(store, grid))
+                data, pre_gz = _cached_json(
+                    ("tiles", grid),
+                    lambda: tiles_feature_collection(store, grid))
                 ctype = "application/json"
             elif path == "/api/positions/latest":
-                body = json.dumps(positions_feature_collection(store))
+                data, pre_gz = _cached_json(
+                    ("positions",),
+                    lambda: positions_feature_collection(store))
                 ctype = "application/json"
             elif path == "/metrics":
                 m = runtime.metrics.snapshot() if runtime is not None else {}
@@ -143,14 +194,18 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
             start_response("500 Internal Server Error",
                            [("Content-Type", "application/json")])
             return [b'{"error": "internal"}']
-        data = body.encode("utf-8")
+        if data is None:
+            data = body.encode("utf-8")
         headers = [("Content-Type", ctype)]
         # tile FeatureCollections run to hundreds of KB and the UI polls
         # every few seconds; GeoJSON gzips ~5-10x
-        if len(data) >= 1024 and _accepts_gzip(
-                environ.get("HTTP_ACCEPT_ENCODING", "")):
-            data = gzip.compress(data, compresslevel=1)
-            headers.append(("Content-Encoding", "gzip"))
+        if _accepts_gzip(environ.get("HTTP_ACCEPT_ENCODING", "")):
+            if pre_gz is not None:
+                data = pre_gz
+                headers.append(("Content-Encoding", "gzip"))
+            elif len(data) >= 1024:
+                data = gzip.compress(data, compresslevel=1)
+                headers.append(("Content-Encoding", "gzip"))
         headers.append(("Vary", "Accept-Encoding"))
         headers.append(("Content-Length", str(len(data))))
         start_response("200 OK", headers)

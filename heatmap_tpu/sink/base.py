@@ -211,3 +211,12 @@ class Store(abc.ABC):
 
     def close(self) -> None:
         pass
+
+    def version(self) -> "int | None":
+        """Monotonic write-version for cache invalidation, or None when
+        this store cannot know about writers outside this process (the
+        serve layer then falls back to a short TTL).  Single-writer
+        stores (memory/jsonl; mongo in the embedded deployment where
+        this process is the only writer) bump it on every upsert, so an
+        unchanged version means a cached rendering is exact."""
+        return None

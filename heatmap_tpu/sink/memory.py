@@ -44,6 +44,11 @@ class MemoryStore(Store):
         self._tile_backlog: list[tuple[np.ndarray, TilePackMeta]] = []
         self._lock = threading.Lock()
         self._now = now_fn or (lambda: dt.datetime.now(UTC))
+        self._version = 0  # bumped on every write (serve cache key)
+
+    def version(self) -> int:
+        with self._lock:
+            return self._version
 
     # --- writes ---------------------------------------------------------
     def upsert_tiles(self, docs: Sequence[dict]) -> int:
@@ -51,6 +56,8 @@ class MemoryStore(Store):
             self._compact_tiles()  # doc writes order AFTER banked packed rows
             for d in docs:
                 self._tile_docs[d["_id"]] = dict(d)
+            if docs:
+                self._version += 1
         return len(docs)
 
     def upsert_tiles_packed(self, body, meta: TilePackMeta) -> int:
@@ -61,6 +68,7 @@ class MemoryStore(Store):
             return 0
         with self._lock:
             self._tile_backlog.append((body[keep], meta))
+            self._version += 1
         return n
 
     def upsert_positions(self, docs: Sequence[dict]) -> int:
@@ -71,6 +79,8 @@ class MemoryStore(Store):
                 if cur is None or cur.get("ts") is None or cur["ts"] < d["ts"]:
                     self._pos_docs[d["_id"]] = dict(d)
                     applied += 1
+            if applied:
+                self._version += 1
         return applied
 
     # --- lazy fold of the packed backlog (callers hold the lock) --------

@@ -167,8 +167,15 @@ class MongoStore(Store):
         self._tile_ops = None
         self._pos_ops = None
         self._native_probed = False
+        # serve-cache version: valid while THIS process is the only
+        # writer (the embedded-UI deployment); external writers are why
+        # the serve layer still bounds version-keyed hits with a TTL
+        self._version = 0
         if ensure_indexes:
             self.ensure_indexes()
+
+    def version(self) -> int:
+        return self._version
 
     def _probe_native(self) -> None:
         """One-shot probe of the C++ encoders (wire backend only — the
@@ -194,6 +201,7 @@ class MongoStore(Store):
                    for d in docs]
         if updates:
             self._b.bulk_update("tiles", updates)
+            self._version += 1
         return len(updates)
 
     def upsert_tiles_packed(self, body, meta) -> int:
@@ -208,6 +216,7 @@ class MongoStore(Store):
             meta.window_minutes_tag, meta.with_p95)
         if n:
             self._b.bulk_update_raw("tiles", ops, end_offsets)
+            self._version += 1
         return n
 
     def upsert_positions_packed(self, rows) -> int:
@@ -219,6 +228,7 @@ class MongoStore(Store):
         if self._pos_ops is None or not len(rows.ts_ms):
             return super().upsert_positions_packed(rows)
         ops, end_offsets, _ = self._pos_ops.encode(rows)
+        self._version += 1
         return self._b.bulk_update_raw("positions_latest", ops, end_offsets)
 
     def upsert_positions(self, docs: Sequence[dict]) -> int:
@@ -230,6 +240,8 @@ class MongoStore(Store):
                     "upsert": True}
                    for d in docs]
         # Store contract: return docs actually APPLIED (stale ones are no-ops)
+        if updates:
+            self._version += 1
         return self._b.bulk_update("positions_latest", updates) if updates else 0
 
     def latest_window_start(self, grid=None):

@@ -269,3 +269,44 @@ def test_bare_tiles_default_grid_without_default_window():
         assert fc15["features"][0]["properties"]["count"] == 15
     finally:
         httpd.shutdown()
+
+
+def test_render_cache_invalidates_on_upsert(store, server):
+    """The serve render cache must re-render the MOMENT this process
+    upserts (store write-version keying, r5) — a pure-TTL cache would
+    serve a sub-second-stale FeatureCollection right after a write."""
+    import datetime as dt
+
+    from heatmap_tpu import hexgrid
+    from heatmap_tpu.sink.base import TileDoc
+    from heatmap_tpu.sink.memory import UTC
+
+    first = get_json(server + "/api/tiles/latest")
+    assert len(first["features"]) == 1
+    # warm the cache again, then write a second tile into the SAME window
+    get_json(server + "/api/tiles/latest")
+    now = dt.datetime.now(UTC).replace(microsecond=0)
+    ws = now - dt.timedelta(minutes=2)
+    cell2 = hexgrid.latlng_to_cell(42.40, -71.10, 8)
+    store.upsert_tiles([
+        TileDoc("bos", 8, cell2, ws, ws + dt.timedelta(minutes=5),
+                count=3, avg_speed_kmh=10.0, avg_lat=42.40,
+                avg_lon=-71.10, ttl_minutes=45),
+    ])
+    fresh = get_json(server + "/api/tiles/latest")
+    assert len(fresh["features"]) == 2, (
+        "upsert invisible through the render cache")
+
+
+def test_render_cache_disabled_by_env(monkeypatch, store):
+    from heatmap_tpu.config import load_config
+    from heatmap_tpu.serve.api import start_background
+
+    monkeypatch.setenv("HEATMAP_SERVE_CACHE_MS", "0")
+    cfg = load_config({}, serve_port=0)
+    httpd, _t, port = start_background(store, cfg)
+    try:
+        body = get_json(f"http://127.0.0.1:{port}/api/tiles/latest")
+        assert body["type"] == "FeatureCollection"
+    finally:
+        httpd.shutdown()

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Serving-layer bench: GeoJSON latency/size at a realistic tile load.
+
+The reference's serving layer is a Flask dev server rendering the same
+FeatureCollections (/root/reference/app.py:45-88); this measures OUR
+WSGI path end-to-end over real HTTP: store query -> boundary
+computation -> GeoJSON encode -> (optional gzip) -> socket.  Prints one
+JSON line.
+
+Usage: python tools/bench_serve.py [n_tiles] [n_positions]
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import gzip
+import io
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def _populate(n_tiles: int, n_pos: int):
+    import numpy as np
+
+    from heatmap_tpu.hexgrid import host as hexhost
+    from heatmap_tpu.hexgrid.device import cells_to_strings
+    from heatmap_tpu.sink import MemoryStore
+    from heatmap_tpu.sink.base import PositionDoc, TileDoc
+
+    store = MemoryStore()
+    now = dt.datetime.now(dt.timezone.utc)
+    ws = now.replace(second=0, microsecond=0) - dt.timedelta(minutes=1)
+    rng = np.random.default_rng(7)
+    lat = rng.uniform(42.0, 42.8, n_tiles)
+    lon = rng.uniform(-71.4, -70.7, n_tiles)
+    docs, seen = [], set()
+    for i in range(n_tiles):
+        cell = hexhost.latlng_to_cell_int(
+            float(np.radians(lat[i])), float(np.radians(lon[i])), 8)
+        cid = cells_to_strings(
+            np.array([cell >> 32], np.uint32),
+            np.array([cell & 0xFFFFFFFF], np.uint32))[0]
+        if cid in seen:
+            continue
+        seen.add(cid)
+        docs.append(TileDoc(
+            "bos", 8, cid, ws, ws + dt.timedelta(minutes=5),
+            int(rng.integers(1, 500)), float(rng.uniform(1, 90)),
+            float(lat[i]), float(lon[i]), ttl_minutes=45,
+            extra={"p95SpeedKmh": float(rng.uniform(10, 120))}))
+    store.upsert_tiles(docs)
+    pos = [PositionDoc("bench", f"veh-{i}", now,
+                       float(lat[i % n_tiles]), float(lon[i % n_tiles]))
+           for i in range(n_pos)]
+    store.upsert_positions(pos)
+    return store, len(docs)
+
+
+def _get(url: str, gz: bool) -> tuple[float, int, int]:
+    req = urllib.request.Request(url)
+    if gz:
+        req.add_header("Accept-Encoding", "gzip")
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(req, timeout=30) as r:
+        body = r.read()
+        enc = r.headers.get("Content-Encoding", "")
+    ms = (time.perf_counter() - t0) * 1e3
+    raw = len(body)
+    if enc == "gzip":
+        body = gzip.GzipFile(fileobj=io.BytesIO(body)).read()
+    return ms, raw, len(body)
+
+
+def main() -> None:
+    n_tiles = int(sys.argv[1]) if len(sys.argv) > 1 else 20_000
+    n_pos = int(sys.argv[2]) if len(sys.argv) > 2 else 2_000
+    from heatmap_tpu.config import load_config
+    from heatmap_tpu.serve.api import start_background
+
+    store, n_unique = _populate(n_tiles, n_pos)
+    cfg = load_config({}, store="memory")
+    httpd, _t, port = start_background(store, cfg, port=0)
+    base = f"http://127.0.0.1:{port}"
+    out = {"tiles_in_store": n_unique, "positions_in_store": n_pos}
+    try:
+        for name, path, gz in (
+                ("tiles", "/api/tiles/latest", False),
+                ("tiles_gzip", "/api/tiles/latest", True),
+                ("positions", "/api/positions/latest", False),
+                ("metrics", "/metrics", False)):
+            times = []
+            for _ in range(12):
+                ms, raw, full = _get(base + path, gz)
+                times.append(ms)
+            times.sort()
+            out[name] = {"p50_ms": round(times[len(times) // 2], 1),
+                         "min_ms": round(times[0], 1),
+                         # the slowest request is the cold render (the
+                         # cache re-renders once per store write / TTL)
+                         "cold_ms": round(times[-1], 1),
+                         "wire_bytes": raw, "body_bytes": full}
+        body = json.loads(
+            urllib.request.urlopen(base + "/api/tiles/latest",
+                                   timeout=30).read())
+        assert body["type"] == "FeatureCollection"
+        assert len(body["features"]) == n_unique
+        out["contract"] = "FeatureCollection OK, all tiles present"
+    finally:
+        httpd.shutdown()
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    main()
