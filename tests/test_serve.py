@@ -275,12 +275,6 @@ def test_render_cache_invalidates_on_upsert(store, server):
     """The serve render cache must re-render the MOMENT this process
     upserts (store write-version keying, r5) — a pure-TTL cache would
     serve a sub-second-stale FeatureCollection right after a write."""
-    import datetime as dt
-
-    from heatmap_tpu import hexgrid
-    from heatmap_tpu.sink.base import TileDoc
-    from heatmap_tpu.sink.memory import UTC
-
     first = get_json(server + "/api/tiles/latest")
     assert len(first["features"]) == 1
     # warm the cache again, then write a second tile into the SAME window
@@ -310,3 +304,34 @@ def test_render_cache_disabled_by_env(monkeypatch, store):
         assert body["type"] == "FeatureCollection"
     finally:
         httpd.shutdown()
+
+
+def test_fast_tiles_json_byte_identical(store):
+    """The string-assembled hot-path renderer must produce EXACTLY what
+    json.dumps of the dict spec produces — any drift (separators, float
+    repr, key order, extras) silently changes the wire contract."""
+    from heatmap_tpu.serve.api import (tiles_feature_collection,
+                                       tiles_feature_collection_json)
+
+    # widen the store: several cells, extras present and absent,
+    # non-round floats
+    now = dt.datetime.now(UTC).replace(microsecond=0)
+    ws = now - dt.timedelta(minutes=2)
+    docs = []
+    for i, (la, lo) in enumerate(
+            [(42.31, -71.01), (42.52, -71.22), (42.405, -70.95)]):
+        cell = hexgrid.latlng_to_cell(la, lo, 8)
+        extra = ({"p95SpeedKmh": 41.7 + i, "stddevSpeedKmh": 3.3}
+                 if i % 2 else None)
+        docs.append(TileDoc("bos", 8, cell, ws,
+                            ws + dt.timedelta(minutes=5), count=i + 1,
+                            avg_speed_kmh=17.123456 + i, avg_lat=la,
+                            avg_lon=lo, ttl_minutes=45, extra=extra))
+    store.upsert_tiles(docs)
+    want = json.dumps(tiles_feature_collection(store))
+    got = tiles_feature_collection_json(store)
+    assert got == want
+    # and the empty case
+    empty = MemoryStore()
+    assert (tiles_feature_collection_json(empty)
+            == json.dumps(tiles_feature_collection(empty)))
