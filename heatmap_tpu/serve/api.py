@@ -219,6 +219,17 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 m = runtime.metrics.snapshot() if runtime is not None else {}
                 if runtime is not None:
                     m.update(runtime.writer.counters)
+                    # resolved engine policies (hwbank measured winners
+                    # or static fallbacks) — operators see WHICH
+                    # kernel/pull/merge choices this run actually made
+                    from heatmap_tpu.engine import step as engine_step
+
+                    pin = engine_step.MERGE_BANK_PIN
+                    m["policy_snap_impl"] = runtime._snap_impl_name
+                    m["policy_emit_pull"] = ("prefix" if runtime._prefix_pull
+                                             else "full")
+                    m["policy_merge_banked"] = (
+                        None if pin is engine_step._BANK_LIVE else pin)
                 body = json.dumps(m)
                 ctype = "application/json"
             elif path == "/healthz":

@@ -335,3 +335,38 @@ def test_fast_tiles_json_byte_identical(store):
     empty = MemoryStore()
     assert (tiles_feature_collection_json(empty)
             == json.dumps(tiles_feature_collection(empty)))
+
+
+def test_metrics_reports_resolved_policies(tmp_path):
+    """/metrics surfaces the engine policies this run resolved (hwbank
+    winners or static fallbacks) so operators can see which snap/pull/
+    merge choices actually engaged."""
+    import tempfile
+    import time as _t
+
+    from heatmap_tpu.sink import MemoryStore as _MS
+    from heatmap_tpu.stream import MicroBatchRuntime
+    from heatmap_tpu.stream.source import MemorySource
+
+    t0 = int(_t.time()) - 60
+    evs = [{"provider": "p", "vehicleId": f"v{i}", "lat": 42.0,
+            "lon": -71.0, "speedKmh": 1.0, "ts": t0} for i in range(32)]
+    cfg = load_config({}, batch_size=16, state_capacity_log2=8,
+                      speed_hist_bins=4, store="memory", serve_port=0,
+                      checkpoint_dir=tempfile.mkdtemp())
+    src = MemorySource(evs)
+    src.finish()
+    st = _MS()
+    rt = MicroBatchRuntime(cfg, src, st, checkpoint_every=0)
+    try:
+        httpd, _t2, port = start_background(st, cfg, runtime=rt)
+        try:
+            m = get_json(f"http://127.0.0.1:{port}/metrics")
+            assert m["policy_snap_impl"] in ("native", "xla", "pallas")
+            assert m["policy_emit_pull"] in ("full", "prefix")
+            assert m["policy_merge_banked"] in (None, "sort", "rank",
+                                                "probe")
+        finally:
+            httpd.shutdown()
+    finally:
+        rt.close()
