@@ -202,3 +202,52 @@ def test_report_renders_all_unit_schemas(progress, tmp_path, monkeypatch):
     assert "5.0 M ev/s" in md and "batch ? x chunk ?" in md
     assert "| streaming | 16,384 |" in md and "| 3.0 | — | rank |" in md
     assert "banked on CPU, excluded: pull" in md
+
+
+def test_contact_gate_shields_expensive_attempts(progress, monkeypatch):
+    """A wedged backend (TCP up, device init dead) must not burn an
+    expensive unit's attempt: the 60s contact gate fails first and the
+    attempt counter stays unspent (r5 — observed live after a
+    watchdog-killed client wedged the relay)."""
+    state = hw_burst._load()
+    for name, (cap, _) in hw_burst.UNITS.items():
+        if cap <= 600:  # bank every cheap unit so an expensive one is next
+            state["units"][name] = {
+                "data": {"_platform": "axon"}, "ts": "t"}
+    hw_burst._save(state)
+    state = hw_burst._load()
+    expensive = next(n for n, (cap, _) in hw_burst.UNITS.items()
+                     if cap > 600 and n not in state["units"])
+    results = {"contact": ["timeout"]}
+    monkeypatch.setattr(hw_burst.subprocess, "run", _fake_run(results))
+    monkeypatch.setattr(hw_burst, "tcp_up", lambda: True)
+    assert hw_burst.run_pending(state) is False
+    out = json.load(open(progress))
+    assert out["attempts"].get(expensive, 0) == 0, (
+        "gate failure must not charge the expensive unit")
+    assert any("contact-gate" in line for line in out["log"])
+
+
+def test_contact_gate_pass_runs_the_unit(progress, monkeypatch):
+    """When the gate answers, the expensive unit runs and banks."""
+    state = hw_burst._load()
+    for name, (cap, _) in hw_burst.UNITS.items():
+        if cap <= 600:
+            state["units"][name] = {
+                "data": {"_platform": "axon"}, "ts": "t"}
+    hw_burst._save(state)
+    state = hw_burst._load()
+    pending = [n for n, (cap, _) in hw_burst.UNITS.items()
+               if cap > 600 and n not in state["units"]]
+    results = {"contact": [{"device": "TPU v5 lite",
+                            "_platform": "axon"}] * len(pending)}
+    for n in pending:
+        results[n] = [{"events_per_sec": 5.0, "_platform": "axon"}]
+    monkeypatch.setattr(hw_burst.subprocess, "run", _fake_run(results))
+    monkeypatch.setattr(hw_burst, "tcp_up", lambda: True)
+    monkeypatch.setattr(hw_burst, "report", lambda: None)
+    assert hw_burst.run_pending(state) is True
+    out = json.load(open(progress))
+    for n in pending:
+        assert n in out["units"]
+        assert out["attempts"][n] == 1

@@ -94,6 +94,11 @@ def test_pull_winner_majority(monkeypatch, tmp_path):
     monkeypatch.setenv("HEATMAP_HW_BANK", _write_bank(
         tmp_path, {"pull": {"rows": rows, "_platform": "cpu"}}))
     assert hwbank.pull_winner() == "prefix"
+    # an even split is NOT a majority for full -> conservative prefix
+    rows.append({"live": 65536, "winner": "full"})
+    monkeypatch.setenv("HEATMAP_HW_BANK", _write_bank(
+        tmp_path, {"pull": {"rows": rows, "_platform": "cpu"}}))
+    assert hwbank.pull_winner() == "prefix"
 
 
 def test_snap_winner_decision_rule(monkeypatch, tmp_path):

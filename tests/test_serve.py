@@ -370,3 +370,52 @@ def test_metrics_reports_resolved_policies(tmp_path):
             httpd.shutdown()
     finally:
         rt.close()
+
+
+def test_render_cache_eviction_keeps_hot_entries(monkeypatch, store):
+    """64 bogus ?grid= values must not wipe the hot default-grid render
+    (single-entry eviction, not clear()) — and junk grids simply return
+    empty collections, cached or not."""
+    cfg = load_config({}, serve_port=0)
+    httpd, _t, port = start_background(store, cfg)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        hot = get_json(base + "/api/tiles/latest")
+        assert len(hot["features"]) == 1
+        for i in range(70):
+            fc = get_json(base + f"/api/tiles/latest?grid=junk{i}")
+            assert fc["features"] == []
+        hot2 = get_json(base + "/api/tiles/latest")
+        assert hot2 == hot
+    finally:
+        httpd.shutdown()
+
+
+def test_render_cache_bad_env_disables_not_crashes(monkeypatch, store):
+    monkeypatch.setenv("HEATMAP_SERVE_CACHE_MS", "half-a-second")
+    cfg = load_config({}, serve_port=0)
+    httpd, _t, port = start_background(store, cfg)
+    try:
+        fc = get_json(f"http://127.0.0.1:{port}/api/tiles/latest")
+        assert len(fc["features"]) == 1
+    finally:
+        httpd.shutdown()
+
+
+def test_fast_tiles_json_grid_filter_byte_identical(store):
+    """Byte identity must hold under the ?grid= filter too (the pyramid
+    UI's zoom-adaptive requests)."""
+    from heatmap_tpu.serve.api import (tiles_feature_collection,
+                                       tiles_feature_collection_json)
+
+    now = dt.datetime.now(UTC).replace(microsecond=0)
+    ws = now - dt.timedelta(minutes=2)
+    c7 = hexgrid.latlng_to_cell(42.37, -71.06, 7)
+    store.upsert_tiles([
+        TileDoc("bos", 7, c7, ws, ws + dt.timedelta(minutes=5),
+                count=2, avg_speed_kmh=20.0, avg_lat=42.37,
+                avg_lon=-71.06, ttl_minutes=45),
+    ])
+    for grid in ("h3r7", "h3r8", "h3r9"):
+        assert (tiles_feature_collection_json(store, grid)
+                == json.dumps(tiles_feature_collection(store, grid))), grid
