@@ -14,6 +14,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
                                 "tools"))
 import hw_burst  # noqa: E402
 
+# captured before the `progress` fixture no-ops the module attr (which
+# protects the repo's real HARDWARE.md from run_pending's auto-render)
+_REAL_REPORT = hw_burst.report
+
 
 def _hw(name, eps=1.0):
     return {"data": {"events_per_sec": eps, "_platform": "axon",
@@ -29,6 +33,11 @@ def _cpu(name):
 def progress(tmp_path, monkeypatch):
     path = tmp_path / "HW_PROGRESS.json"
     monkeypatch.setattr(hw_burst, "PROGRESS", str(path))
+    # run_pending re-renders HARDWARE.md after every bank (r5) — in
+    # tests that would overwrite the REPO's real report with fixture
+    # data (it happened: commit 5e90194 briefly shipped a 2-unit
+    # HARDWARE.md rendered from a test bank)
+    monkeypatch.setattr(hw_burst, "report", lambda: None)
     monkeypatch.delenv("HW_BURST_CPU", raising=False)
     monkeypatch.delenv("HEATMAP_PLATFORM", raising=False)
     return path
@@ -197,7 +206,7 @@ def test_report_renders_all_unit_schemas(progress, tmp_path, monkeypatch):
         "attempts": {}, "log": [],
     }
     json.dump(state, open(progress, "w"))
-    hw_burst.report()
+    _REAL_REPORT()
     md = open(tmp_path / "HARDWARE.md").read()
     assert "5.0 M ev/s" in md and "batch ? x chunk ?" in md
     assert "| streaming | 16,384 |" in md and "| 3.0 | — | rank |" in md
