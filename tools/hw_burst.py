@@ -552,8 +552,13 @@ def run_pending(state: dict) -> bool:
                     # keep the rendered report current with every bank:
                     # the round can end (driver commits the tree) while
                     # this loop is unattended, and a stale HARDWARE.md
-                    # would contradict HW_PROGRESS.json
-                    report()
+                    # would contradict HW_PROGRESS.json.  Only when the
+                    # bank is the repo's real one — a relocated PROGRESS
+                    # (tests, ad-hoc runs) must never overwrite the
+                    # repo report with its data (this happened:
+                    # commit 28f7231).
+                    if PROGRESS == os.path.join(ROOT, "HW_PROGRESS.json"):
+                        report()
                 except Exception as e:  # noqa: BLE001 - never kill the loop
                     print(f"  -> report render failed: {e}", flush=True)
                 continue
