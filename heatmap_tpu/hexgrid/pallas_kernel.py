@@ -75,7 +75,10 @@ def _snap_kernel(lat_ref, lng_ref, face_ref, flat_ref, p_ref, *, res: int):
     # best-of-20 face search, fully unrolled against scalar constants;
     # the winning face's basis vectors ride along in the same selects
     best = jnp.full_like(vx, -2.0)
-    face = jnp.zeros(vx.shape, jnp.int32)
+    # derive from the tracer (zeros_like), NOT jnp.zeros(shape): a
+    # no-tracer-input op evaluates to a concrete array under an ambient
+    # eager context and pallas rejects concrete captures as constants
+    face = jnp.zeros_like(vx, dtype=jnp.int32)
     acc = [jnp.zeros_like(vx) for _ in range(9)]
     for f, consts in enumerate(_face_constants()):
         cx, cy, cz = consts[0], consts[1], consts[2]
@@ -170,15 +173,24 @@ def pallas_available() -> bool:
     """True when the kernel compiles on the current default backend
     (probed once; engine._snap_impl uses this to fall back to XLA).
 
-    The probe is forced eager: _snap_impl runs at trace time inside the
-    engine's jit, and under an ambient trace a jitted call would be traced
-    rather than executed — no lowering happens, no error surfaces, and the
-    probe would "succeed" on backends that can't lower the kernel at all.
+    The probe must work at trace time (engine._snap_impl runs inside the
+    engine's jit) yet actually LOWER the kernel — under an ambient trace
+    a plain jitted call is traced, not compiled, so no Mosaic error
+    would surface.  AOT ``lower().compile()`` on abstract shapes does
+    both: it opens a fresh trace independent of any ambient tracer and
+    runs the real backend compile.  The previous probe forced eagerness
+    with ``jax.ensure_compile_time_eval()`` instead, which made every
+    no-tracer-input op inside the kernel trace (``jnp.zeros``, np-scalar
+    wraps) evaluate to a CONCRETE array that pallas then rejected as a
+    captured constant — the probe returned False on the very v5e where
+    the kernel lowers and wins 2.6-3.1x (HW_PROGRESS ``pallas_lowers``
+    banked ok because that unit jits normally), silently degrading the
+    banked "pallas" policy to XLA on hardware.
     """
     try:
-        with jax.ensure_compile_time_eval():
-            z = jnp.zeros(_LANES * _SUBLANES, jnp.float32)
-            jax.block_until_ready(latlng_to_cell_pallas(z, z, 8))
+        spec = jax.ShapeDtypeStruct((_LANES * _SUBLANES,), jnp.float32)
+        jax.jit(functools.partial(
+            latlng_to_cell_pallas, res=8)).lower(spec, spec).compile()
         return True
     except Exception:  # Mosaic lowering / platform errors
         return False

@@ -147,8 +147,33 @@ def merge_winner() -> "str | None":
     return _steer("merge_impl", winners.pop())
 
 
-def pull_winner() -> "str | None":
-    """Majority banked emit-pull winner for this platform, else None."""
+def pull_winner(n_pairs: int = 1) -> "str | None":
+    """Majority banked emit-pull winner for this platform, else None.
+
+    ``n_pairs`` is the number of fused (res, window) pairs the program
+    will run.  The single-pair ``pull`` unit's verdict does NOT
+    transfer to fused programs: on the tunnel-attached v5e ``full``
+    won every single-pair live-row count (round trips dominate), yet
+    the fused 3-pair A/B (``hex_pyramid`` vs ``hex_pyramid_prefix``)
+    measured prefix 3.4x faster — a full pull moves n_pairs whole emit
+    buffers per batch, so D2H bytes re-dominate as width grows.  For
+    n_pairs > 1, banked fused A/Bs (same shape, pull flipped) vote by
+    measured events_per_sec; single-pair verdict is the fallback when
+    no fused A/B is banked for this attachment.
+    """
+    if n_pairs > 1:
+        votes = []
+        for base in ("hex_pyramid", "multi_window"):
+            a = _on_platform(base)
+            b = _on_platform(base + "_prefix")
+            if (a and b and a.get("events_per_sec")
+                    and b.get("events_per_sec")):
+                votes.append("prefix" if b["events_per_sec"]
+                             > a["events_per_sec"] else "full")
+        if votes:
+            prefix = sum(1 for v in votes if v == "prefix")
+            return _steer("emit_pull(fused)",
+                          "prefix" if prefix * 2 >= len(votes) else "full")
     data = _on_platform("pull")
     if data is None:
         return None

@@ -106,10 +106,19 @@ class MicroBatchRuntime:
         # off-CPU choice: on the tunnel-attached v5e `full` measured
         # faster at EVERY live-row count — round-trips dominate there,
         # not D2H bytes.
+        # the ONE (res, window_s) pair list every consumer below shares:
+        # aggregator construction AND the banked pull verdict must see
+        # the same pair count
+        pairs = list(dict.fromkeys(
+            (res, wmin * 60) for res in cfg.resolutions
+            for wmin in cfg.windows_minutes))
         if cfg.emit_pull == "auto" and jax.default_backend() != "cpu":
             from heatmap_tpu import hwbank
 
-            self._prefix_pull = (hwbank.pull_winner() or "prefix") == "prefix"
+            # fused multi-pair programs get their own banked verdict —
+            # the single-pair winner does not transfer (hwbank)
+            self._prefix_pull = (hwbank.pull_winner(len(pairs))
+                                 or "prefix") == "prefix"
         else:
             self._prefix_pull = cfg.emit_pull == "prefix"
         self._carry_cols = None  # overshoot remainder of a batch-granular poll
@@ -155,9 +164,6 @@ class MicroBatchRuntime:
         bins = cfg.speed_hist_bins
         self._multi = None
         self._sharded = None
-        pairs = list(dict.fromkeys(
-            (res, wmin * 60) for res in cfg.resolutions
-            for wmin in cfg.windows_minutes))
         if mesh is not None and mesh.devices.size > 1:
             from heatmap_tpu.parallel import ShardedAggregator
 

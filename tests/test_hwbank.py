@@ -14,8 +14,16 @@ import pytest
 from heatmap_tpu import hwbank
 
 
+_bank_seq = 0
+
+
 def _write_bank(tmp_path, units: dict):
-    path = tmp_path / "bank.json"
+    # unique filename per call: hwbank.units() caches on (path, mtime)
+    # and Linux mtime granularity is coarse enough that two writes to
+    # the same path in one tick would serve the first bank's contents
+    global _bank_seq
+    _bank_seq += 1
+    path = tmp_path / f"bank{_bank_seq}.json"
     path.write_text(json.dumps(
         {"units": {k: {"data": v, "ts": "t"} for k, v in units.items()},
          "attempts": {}, "log": []}))
@@ -101,6 +109,37 @@ def test_pull_winner_majority(monkeypatch, tmp_path):
     assert hwbank.pull_winner() == "prefix"
 
 
+def test_pull_winner_fused_ab_overrides_single_pair(monkeypatch, tmp_path):
+    """n_pairs>1 consults the fused A/B units: on the tunnel v5e the
+    single-pair unit says full wins, yet the 3-pair A/B measured prefix
+    3.4x faster (hex_pyramid 83.7k full vs 281.7k prefix ev/s) — a full
+    pull moves n_pairs whole emit buffers, so D2H bytes re-dominate."""
+    rows = [{"live": 256, "winner": "full"},
+            {"live": 4096, "winner": "full"}]
+    units = {"pull": {"rows": rows, "_platform": "cpu"},
+             "hex_pyramid": {"events_per_sec": 83740.4,
+                             "_platform": "cpu"},
+             "hex_pyramid_prefix": {"events_per_sec": 281720.4,
+                                    "_platform": "cpu"}}
+    monkeypatch.setenv("HEATMAP_HW_BANK", _write_bank(tmp_path, units))
+    assert hwbank.pull_winner() == "full"          # single-pair verdict
+    assert hwbank.pull_winner(n_pairs=3) == "prefix"   # fused verdict
+    # no fused A/B banked -> fused programs fall back to the
+    # single-pair verdict rather than guessing
+    monkeypatch.setenv("HEATMAP_HW_BANK", _write_bank(
+        tmp_path, {"pull": {"rows": rows, "_platform": "cpu"}}))
+    assert hwbank.pull_winner(n_pairs=3) == "full"
+    # fused A/Bs vote; a split between the two fused shapes leans
+    # prefix (the conservative: never move n_pairs full buffers on a
+    # tie)
+    units["multi_window"] = {"events_per_sec": 300000.0,
+                             "_platform": "cpu"}
+    units["multi_window_prefix"] = {"events_per_sec": 200000.0,
+                                    "_platform": "cpu"}
+    monkeypatch.setenv("HEATMAP_HW_BANK", _write_bank(tmp_path, units))
+    assert hwbank.pull_winner(n_pairs=3) == "prefix"
+
+
 def test_snap_winner_decision_rule(monkeypatch, tmp_path):
     good = {"lowering": "ok", "speedup_vs_xla": 2.64,
             "agree_frac": 0.999919, "_platform": "cpu"}
@@ -118,10 +157,18 @@ def test_bank_reload_on_mtime_change(monkeypatch, tmp_path):
     import os
     import time
 
-    path = _write_bank(tmp_path, _merge_units("sort"))
+    # deliberately rewrite the SAME path (this test pins the
+    # mtime-triggered reload; _write_bank's unique names would dodge it)
+    def write_same(units):
+        (tmp_path / "reload.json").write_text(json.dumps(
+            {"units": {k: {"data": v, "ts": "t"} for k, v in units.items()},
+             "attempts": {}, "log": []}))
+        return str(tmp_path / "reload.json")
+
+    path = write_same(_merge_units("sort"))
     monkeypatch.setenv("HEATMAP_HW_BANK", path)
     assert hwbank.merge_winner() == "sort"
-    _write_bank(tmp_path, _merge_units("probe"))
+    write_same(_merge_units("probe"))
     # same-second rewrites can share an mtime; force it forward
     os.utime(path, (time.time() + 2, time.time() + 2))
     assert hwbank.merge_winner() == "probe"

@@ -98,6 +98,12 @@ UNITS: dict[str, tuple[int, int]] = {
     # restarts from scratch
     "hex_pyramid": (1800, 3),
     "multi_window": (1800, 3),
+    # prefix-pull A/Bs on the fused shapes: the fold program is already
+    # in the persistent compile cache after the full-pull units, so the
+    # cap only needs to cover the pull-path retrace + the run
+    "hex_pyramid_prefix": (1200, 3),
+    "multi_window_prefix": (1200, 3),
+    "headline_pal": (1200, 3),
 }
 
 
@@ -433,6 +439,24 @@ UNIT_FNS = {
     "merge_balanced": lambda: unit_merge("balanced"),
     "pull": unit_pull,
     "stream_profile": unit_stream_profile,
+    # round-5 session 3 follow-ups from the first fused-pipeline bank:
+    # hex_pyramid@full measured span_pull 12.0 s/batch vs span_fold
+    # 0.1 ms — the tunnel moves the FULL 3x16k-row emit buffer at
+    # ~200 KB/s, so the single-pair pull verdict ("full wins, round
+    # trips dominate") plausibly inverts when the buffer is 3 pairs
+    # wide; only an A/B on the same shape says.
+    "hex_pyramid_prefix": lambda: unit_headline(
+        total=1 << 22, batch=1 << 20, chunk=4, cap=1 << 18,
+        pull="prefix", pairs=[(7, 300), (8, 300), (9, 300)]),
+    "multi_window_prefix": lambda: unit_headline(
+        total=1 << 22, batch=1 << 20, chunk=4, cap=1 << 18,
+        pull="prefix", pairs=[(8, 60), (8, 300), (8, 900)]),
+    # pallas snap inside the full fold at the tuned shape: the snap
+    # A/Bs banked pallas 2.6-3.1x over xla in isolation, but no banked
+    # unit shows what that buys the END-TO-END headline program
+    "headline_pal": lambda: unit_headline(total=1 << 23, batch=1 << 20,
+                                          chunk=4, cap=1 << 18,
+                                          h3="pallas", pull="full"),
 }
 
 
@@ -626,8 +650,10 @@ def report() -> None:
                   ""]
     heads = [(k, hw[k]) for k in ("micro", "headline", "headline_big",
                                   "headline_native", "headline_full",
+                                  "headline_pal",
                                   "headline_b21", "headline_b21_native",
-                                  "hex_pyramid", "multi_window",
+                                  "hex_pyramid", "hex_pyramid_prefix",
+                                  "multi_window", "multi_window_prefix",
                                   "headline_bench")
              if k in hw]
     if heads:
