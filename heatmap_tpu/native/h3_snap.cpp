@@ -18,12 +18,30 @@
 //     integer work is done in f64 — exact, because all intermediates
 //     stay far below 2^53 and div7_round's operand (2x+7, odd) is never
 //     a multiple of 14, so floor((2x+7)/14.0) == floor-div exactly.
-//     The trig stays scalar libm sincos (bit-identical to sin/cos) so
-//     results match the host oracle bit-for-bit; base-cell lookup and
-//     the (rare) home-orientation/pentagon rotations run scalar per
-//     lane.  The block path is differential-tested against `snap_one`
-//     over random sweeps (tests/test_native_snap.py), and the whole lib
-//     against the f64 host oracle.
+//
+//     TRIG + MARGIN FALLBACK: the two scalar libm sincos calls per
+//     point used to dominate the block path (~half of ~139 ns/pt on
+//     the round-5 host — see tools/bench_snap_native.py), so the block
+//     path computes sin/cos with a vectorized fdlibm-style polynomial
+//     (~1 ulp, NOT bit-identical to libm) and proves per lane that the
+//     last-ulp trig difference cannot change the DISCRETE outputs:
+//       * face argmax margin: best dot minus second-best dot;
+//       * hex rounding margin: distance from the scaled hex-plane
+//         point to its rounded cell's nearest edge (0.5 - max lattice
+//         projection; unit cell spacing).
+//     A lane whose margin is below tolerance (conservatively ~1000x
+//     the worst-case f64 error amplification through the projection at
+//     res <= 10) is REDONE with scalar `snap_one` (libm sincos), so
+//     the library's outputs remain bit-identical to the scalar
+//     reference — and to the f64 host oracle — everywhere, by
+//     construction rather than by luck: lanes where poly-vs-libm could
+//     matter never take the poly result.  Fallback fraction is ~1e-7
+//     of uniform points (boundary-epsilon neighborhoods), amortized to
+//     nothing.  Base-cell lookup and the (rare) home-orientation/
+//     pentagon rotations run scalar per lane as before.  The block
+//     path is differential-tested against `snap_one` over random +
+//     near-boundary sweeps (tests/test_native_snap.py), and the whole
+//     lib against the f64 host oracle.
 //
 // No code is copied from the C h3 library; this is a port of this
 // package's own device.py math (see hexgrid/__init__.py provenance
@@ -293,6 +311,95 @@ inline void snap_one(const Tables& T, int res, bool res_class_iii,
 
 #define H3_TGT __attribute__((target("avx512f,avx512dq")))
 
+// ---- vector f64 sincos (fdlibm-style minimax, ~1 ulp) ----------------
+//
+// Good to ~1 ulp for |x| <= SINCOS_MAX_ABS (GPS radians are <= pi, so
+// the 2-constant Cody-Waite pi/2 reduction is far more than enough:
+// with |q| <= 11 the reduction error is ~q*6e-28, invisible at f64).
+// Lanes outside that range (or non-finite) are reported in `bad` and
+// must be redone scalar — snap_one's libm handles any finite input.
+// The minimax coefficients are the public fdlibm __kernel_sin /
+// __kernel_cos constants (pure mathematical constants, reproduced in
+// every libm derivative); the combine differs (mask blends, no
+// precision-preserving correction terms — the margin fallback absorbs
+// the last-ulp difference vs libm).
+constexpr double kSinC1 = -1.66666666666666324348e-01;
+constexpr double kSinC2 = 8.33333333332248946124e-03;
+constexpr double kSinC3 = -1.98412698298579493134e-04;
+constexpr double kSinC4 = 2.75573137070700676789e-06;
+constexpr double kSinC5 = -2.50507602534068634195e-08;
+constexpr double kSinC6 = 1.58969099521155010221e-10;
+constexpr double kCosC1 = 4.16666666666666019037e-02;
+constexpr double kCosC2 = -1.38888888888741095749e-03;
+constexpr double kCosC3 = 2.48015872894767294178e-05;
+constexpr double kCosC4 = -2.75573143513906633035e-07;
+constexpr double kCosC5 = 2.08757232129817482790e-09;
+constexpr double kCosC6 = -1.13596475577881948265e-11;
+constexpr double kPio2Hi = 1.57079632673412561417e+00;   // 33 bits of pi/2
+constexpr double kPio2Lo = 6.07710050650619224932e-11;   // next 53 bits
+constexpr double kTwoOverPi = 6.36619772367581382433e-01;
+constexpr double kSincosMaxAbs = 16.0;
+
+H3_TGT static inline void vsincos(__m512d x, __m512d* s_out,
+                                  __m512d* c_out, __mmask8* bad) {
+  const __m512d one = _mm512_set1_pd(1.0), half = _mm512_set1_pd(0.5);
+  // lanes the poly path must not answer: |x| too large or non-finite
+  __m512d ax = _mm512_abs_pd(x);
+  __mmask8 in_range =
+      _mm512_cmp_pd_mask(ax, _mm512_set1_pd(kSincosMaxAbs), _CMP_LE_OQ);
+  *bad = (__mmask8)~in_range;  // unordered (NaN) fails LE -> bad too
+  // quadrant: q = round(x * 2/pi); r = (x - q*hi) - q*lo
+  __m512d q = _mm512_roundscale_pd(
+      _mm512_mul_pd(x, _mm512_set1_pd(kTwoOverPi)),
+      _MM_FROUND_TO_NEAREST_INT | _MM_FROUND_NO_EXC);
+  __m512d r = _mm512_sub_pd(x, _mm512_mul_pd(q, _mm512_set1_pd(kPio2Hi)));
+  r = _mm512_sub_pd(r, _mm512_mul_pd(q, _mm512_set1_pd(kPio2Lo)));
+  __m512i qi = _mm512_cvtpd_epi64(q);  // avx512dq
+
+  __m512d z = _mm512_mul_pd(r, r);
+  // sin(r) = r + r*z*(S1 + z*(S2 + ... z*S6))
+  __m512d sp = _mm512_set1_pd(kSinC6);
+  sp = _mm512_add_pd(_mm512_mul_pd(sp, z), _mm512_set1_pd(kSinC5));
+  sp = _mm512_add_pd(_mm512_mul_pd(sp, z), _mm512_set1_pd(kSinC4));
+  sp = _mm512_add_pd(_mm512_mul_pd(sp, z), _mm512_set1_pd(kSinC3));
+  sp = _mm512_add_pd(_mm512_mul_pd(sp, z), _mm512_set1_pd(kSinC2));
+  sp = _mm512_add_pd(_mm512_mul_pd(sp, z), _mm512_set1_pd(kSinC1));
+  __m512d sr = _mm512_add_pd(r, _mm512_mul_pd(_mm512_mul_pd(r, z), sp));
+  // cos(r) = 1 - z/2 + z*z*(C1 + z*(C2 + ... z*C6))
+  __m512d cp = _mm512_set1_pd(kCosC6);
+  cp = _mm512_add_pd(_mm512_mul_pd(cp, z), _mm512_set1_pd(kCosC5));
+  cp = _mm512_add_pd(_mm512_mul_pd(cp, z), _mm512_set1_pd(kCosC4));
+  cp = _mm512_add_pd(_mm512_mul_pd(cp, z), _mm512_set1_pd(kCosC3));
+  cp = _mm512_add_pd(_mm512_mul_pd(cp, z), _mm512_set1_pd(kCosC2));
+  cp = _mm512_add_pd(_mm512_mul_pd(cp, z), _mm512_set1_pd(kCosC1));
+  __m512d cr = _mm512_add_pd(
+      _mm512_sub_pd(one, _mm512_mul_pd(z, half)),
+      _mm512_mul_pd(_mm512_mul_pd(z, z), cp));
+
+  // quadrant combine: n = q & 3
+  //   sin(x) = [ sr,  cr, -sr, -cr][n]    cos(x) = [ cr, -sr, -cr,  sr][n]
+  __m512i n = _mm512_and_epi64(qi, _mm512_set1_epi64(3));
+  __mmask8 swap = _mm512_test_epi64_mask(n, _mm512_set1_epi64(1));
+  __mmask8 n_ge2 = _mm512_cmp_epi64_mask(n, _mm512_set1_epi64(2),
+                                         _MM_CMPINT_NLT);
+  __mmask8 n12 = _mm512_test_epi64_mask(
+      _mm512_add_epi64(n, _mm512_set1_epi64(1)), _mm512_set1_epi64(2));
+  __m512d s = _mm512_mask_mov_pd(sr, swap, cr);
+  __m512d c = _mm512_mask_mov_pd(cr, swap, sr);
+  const __m512d zero = _mm512_setzero_pd();
+  s = _mm512_mask_sub_pd(s, n_ge2, zero, s);  // negate where n in {2,3}
+  c = _mm512_mask_sub_pd(c, n12, zero, c);    // negate where n in {1,2}
+  *s_out = s;
+  *c_out = c;
+}
+
+// Margin tolerances: the poly-vs-libm trig difference propagates to the
+// scaled hex coords as at most ~|coord| * few-ulps ~ 1e-10 grid units
+// at res 10 (scale 7^5), and to the face dots as ~1e-15.  Tolerances
+// sit ~1000x above those bounds; lanes inside the band redo scalar.
+constexpr double kHexMarginTol = 1e-7;    // grid units (cell spacing 1)
+constexpr double kFaceMarginTol = 1e-12;  // unit-sphere dot difference
+
 H3_TGT static inline __m512d vmin(__m512d a, __m512d b) {
   return _mm512_min_pd(a, b);
 }
@@ -449,17 +556,32 @@ H3_TGT static inline void vhex2d_to_ijk(__m512d x, __m512d y, __m512d& io,
   io = i; jo = j; ko = k;
 }
 
+// One 8-lane block: poly trig -> face argmax -> projection -> hex
+// rounding -> digit chain, PLUS the decision-margin proof.  Returns in
+// `fallback` the lanes whose outputs must NOT be used (trig out of
+// range / non-finite input / margin below tolerance) — the caller
+// redoes those with scalar snap_one, keeping the library bit-identical
+// to the scalar reference everywhere.
 H3_TGT static void snap_block8(const Tables& T, int res,
-                               bool res_class_iii, const double* v0a,
-                               const double* v1a, const double* v2a,
-                               int32_t* face_out, double* p_out,
-                               double* i_out, double* j_out,
-                               double* k_out) {
-  __m512d v0 = _mm512_loadu_pd(v0a), v1 = _mm512_loadu_pd(v1a),
-          v2 = _mm512_loadu_pd(v2a);
+                               bool res_class_iii, const float* latf,
+                               const float* lngf, int32_t* face_out,
+                               double* p_out, double* i_out,
+                               double* j_out, double* k_out,
+                               __mmask8* fallback) {
+  __m512d la = _mm512_cvtps_pd(_mm256_loadu_ps(latf));
+  __m512d lo = _mm512_cvtps_pd(_mm256_loadu_ps(lngf));
+  __m512d sla, cla, slo, clo;
+  __mmask8 bad_la, bad_lo;
+  vsincos(la, &sla, &cla, &bad_la);
+  vsincos(lo, &slo, &clo, &bad_lo);
+  __mmask8 redo = bad_la | bad_lo;
+  __m512d v0 = _mm512_mul_pd(cla, clo);
+  __m512d v1 = _mm512_mul_pd(cla, slo);
+  __m512d v2 = sla;
 
-  // --- face argmax: d > best keeps the FIRST maximal face, as scalar
-  __m512d best = _mm512_set1_pd(-2.0);
+  // --- face argmax: d > best keeps the FIRST maximal face, as scalar;
+  //     second-best dot rides along for the decision margin
+  __m512d best = _mm512_set1_pd(-2.0), best2 = _mm512_set1_pd(-2.0);
   __m512i face = _mm512_setzero_si512();
   for (int f = 0; f < 20; ++f) {
     __m512d fx = _mm512_set1_pd(T.face_xyz[3 * f]),
@@ -469,9 +591,13 @@ H3_TGT static void snap_block8(const Tables& T, int res,
         _mm512_add_pd(_mm512_mul_pd(v0, fx), _mm512_mul_pd(v1, fy)),
         _mm512_mul_pd(v2, fz));
     __mmask8 gt = _mm512_cmp_pd_mask(d, best, _CMP_GT_OQ);
+    best2 = _mm512_mask_mov_pd(_mm512_max_pd(best2, d), gt, best);
     best = _mm512_mask_mov_pd(best, gt, d);
     face = _mm512_mask_mov_epi64(face, gt, _mm512_set1_epi64(f));
   }
+  redo |= _mm512_cmp_pd_mask(
+      _mm512_sub_pd(best, best2), _mm512_set1_pd(kFaceMarginTol),
+      _CMP_LT_OQ);
   __m256i face32 = _mm512_cvtepi64_epi32(face);
   __m256i idx3 = _mm256_mullo_epi32(face32, _mm256_set1_epi32(3));
 
@@ -513,6 +639,31 @@ H3_TGT static void snap_block8(const Tables& T, int res,
   // --- hex rounding + digit chain ------------------------------------
   __m512d i, j, k;
   vhex2d_to_ijk(x, y, i, j, k);
+
+  // decision margin for the rounding: distance from (x, y) to the
+  // rounded cell's nearest edge.  Cell center via the lattice inverse
+  // (i' = i-k, j' = j-k; cx = i' - j'/2, cy = j'*sin60 — unit
+  // spacing), then margin = 1/2 - max |projection on the 3 neighbor
+  // directions (1,0), (±1/2, sin60)|.  A lane inside the tolerance
+  // band could round differently under libm-vs-poly trig: redo scalar.
+  {
+    __m512d ip = _mm512_sub_pd(i, k), jp = _mm512_sub_pd(j, k);
+    __m512d cx = _mm512_sub_pd(
+        ip, _mm512_mul_pd(jp, _mm512_set1_pd(0.5)));
+    __m512d cy = _mm512_mul_pd(jp, _mm512_set1_pd(kSin60));
+    __m512d dx = _mm512_sub_pd(x, cx), dy = _mm512_sub_pd(y, cy);
+    __m512d hdx = _mm512_mul_pd(dx, _mm512_set1_pd(0.5));
+    __m512d sdy = _mm512_mul_pd(dy, _mm512_set1_pd(kSin60));
+    __m512d proj = _mm512_max_pd(
+        _mm512_abs_pd(dx),
+        _mm512_max_pd(_mm512_abs_pd(_mm512_add_pd(hdx, sdy)),
+                      _mm512_abs_pd(_mm512_sub_pd(sdy, hdx))));
+    __m512d margin = _mm512_sub_pd(_mm512_set1_pd(0.5), proj);
+    redo |= _mm512_cmp_pd_mask(margin, _mm512_set1_pd(kHexMarginTol),
+                               _CMP_LT_OQ);
+  }
+  *fallback = redo;
+
   __m512d p = _mm512_setzero_pd();
   for (int r = res; r >= 1; --r) {
     __m512d li = i, lj = j, lk = k, ci, cj, ck;

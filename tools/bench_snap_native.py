@@ -1,0 +1,74 @@
+"""Microbench the native C++ H3 snap: total ns/pt, scalar-vs-block,
+and a sincos-share estimate (the block path's trig runs scalar libm —
+tools/bench_snap_native.py quantifies how much of the budget that is).
+
+Run on an otherwise idle host; numbers feed the CPU-headline work
+(CPU_HEADLINE_BANK.json) where the snap is the top term at ~195 ns/pt.
+"""
+import ctypes
+import ctypes.util
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from heatmap_tpu.hexgrid import native_snap  # noqa: E402
+
+
+def timeit(fn, *args, reps=5):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn(*args)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    assert native_snap.available()
+    n = 1 << 20
+    rng = np.random.default_rng(7)
+    lat = np.radians(rng.uniform(-85, 85, n)).astype(np.float32)
+    lng = np.radians(rng.uniform(-180, 180, n)).astype(np.float32)
+    snap = native_snap._snap()
+
+    snap.snap(lat, lng, 8)  # warm
+    for res in (7, 8, 9):
+        t = timeit(lambda: snap.snap(lat, lng, res))
+        ts = timeit(lambda: snap.snap(lat, lng, res, scalar=True))
+        print(f"res {res}: block {t / n * 1e9:6.1f} ns/pt "
+              f"({n / t / 1e6:6.2f} M/s)   scalar {ts / n * 1e9:6.1f} ns/pt")
+
+    # sincos share: glibc sincos at the same call pattern (2 per point)
+    libm = ctypes.CDLL("libm.so.6")
+    libm.sincos.argtypes = [ctypes.c_double,
+                            ctypes.POINTER(ctypes.c_double),
+                            ctypes.POINTER(ctypes.c_double)]
+
+    # C-loop proxy via numpy (vectorized, so this UNDERSTATES the
+    # scalar-call cost): np.sin+np.cos on f64
+    la64 = lat.astype(np.float64)
+    t_np = timeit(lambda: (np.sin(la64), np.cos(la64),
+                           np.sin(la64 + 1.0), np.cos(la64 + 1.0)))
+    print(f"numpy 2x(sin+cos) f64: {t_np / n * 1e9:6.1f} ns/pt "
+          f"(vectorized lower bound)")
+
+    # actual scalar libm sincos, 2 calls/pt over a small sample
+    m = 1 << 16
+    s = ctypes.c_double()
+    c = ctypes.c_double()
+    vals = la64[:m]
+    t0 = time.perf_counter()
+    for v in vals:
+        libm.sincos(v, ctypes.byref(s), ctypes.byref(c))
+        libm.sincos(v + 1.0, ctypes.byref(s), ctypes.byref(c))
+    t_py = time.perf_counter() - t0
+    print(f"ctypes 2x sincos: {t_py / m * 1e9:6.1f} ns/pt "
+          f"(OVERSTATES: ctypes overhead dominates; C-side is lower)")
+
+
+if __name__ == "__main__":
+    main()
