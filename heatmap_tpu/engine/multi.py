@@ -23,13 +23,15 @@ reference parity: heatmap_stream.py:112-133 run once per configuration).
 
 from __future__ import annotations
 
+import time
 from typing import NamedTuple, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from heatmap_tpu.engine.state import TileState, init_state
+from heatmap_tpu.engine.state import (TileState, donate_state_argnums,
+                                      init_state)
 from heatmap_tpu.engine.step import (
     AggParams,
     merge_batch,
@@ -113,6 +115,14 @@ class MultiAggregator:
         self.states: list[TileState] = [
             init_state(capacity, hist_bins) for _ in self.pairs
         ]
+        # host wall spent in step dispatch, per local shard (one entry
+        # here: the fused single-device program).  The dispatch is async,
+        # so this clocks trace+enqueue, not device execution — the
+        # runtime's "pull" span is where a slow device shows up; a
+        # growing dispatch clock means retraces or host-side stalls.
+        # Read by stream.runtime's callback gauges at /metrics scrapes.
+        self.device_seconds = [0.0]
+        self.n_steps = 0
 
         param_list = self.params
 
@@ -125,7 +135,8 @@ class MultiAggregator:
                      for p, (emit, stats) in zip(param_list, folded)]
             return new_states, jnp.stack(packs)
 
-        self._step = jax.jit(_step, donate_argnums=(0,))
+        self._step = jax.jit(_step,
+                     donate_argnums=donate_state_argnums())
 
         uniq_res = list(dict.fromkeys(p.res for p in param_list))
         self._uniq_res = uniq_res
@@ -139,7 +150,8 @@ class MultiAggregator:
                      for p, (emit, stats) in zip(param_list, folded)]
             return new_states, jnp.stack(packs)
 
-        self._step_pre = jax.jit(_step_pre, donate_argnums=(0,))
+        self._step_pre = jax.jit(
+            _step_pre, donate_argnums=donate_state_argnums())
 
     def step_packed_all(self, lat_rad, lng_rad, speed, ts, valid,
                         watermark_cutoff, prekeys=None):
@@ -156,6 +168,7 @@ class MultiAggregator:
         when prekeys is given (a partial dict raises) — the pre-jitted
         _step_pre signature takes the full key tuple.
         """
+        t0 = time.monotonic()
         if prekeys is not None:
             missing = [r for r in self._uniq_res if r not in prekeys]
             if missing:
@@ -177,6 +190,8 @@ class MultiAggregator:
                 jnp.int32(watermark_cutoff),
             )
         self.states = list(states)
+        self.device_seconds[0] += time.monotonic() - t0
+        self.n_steps += 1
         return packed
 
     def view(self, res: int, window_s: int) -> "PairView":

@@ -11,7 +11,8 @@ import jax
 import jax.numpy as jnp
 
 
-from heatmap_tpu.engine.state import TileState, init_state
+from heatmap_tpu.engine.state import (TileState, donate_state_argnums,
+                                      init_state)
 from heatmap_tpu.engine.step import AggParams, aggregate_batch, pack_emit
 
 
@@ -29,7 +30,8 @@ class SingleAggregator:
             return aggregate_batch(state, lat, lng, speed, ts, valid, cutoff,
                                    self.params)
 
-        self._step = jax.jit(_step, donate_argnums=(0,))
+        self._step = jax.jit(_step,
+                     donate_argnums=donate_state_argnums())
 
         def _step_packed(state, lat, lng, speed, ts, valid, cutoff):
             state, emit, stats = aggregate_batch(
@@ -37,7 +39,8 @@ class SingleAggregator:
             )
             return state, pack_emit(emit, self.params.speed_hist_max), stats
 
-        self._step_packed = jax.jit(_step_packed, donate_argnums=(0,))
+        self._step_packed = jax.jit(
+            _step_packed, donate_argnums=donate_state_argnums())
 
     def step(self, lat_rad, lng_rad, speed, ts, valid, watermark_cutoff):
         self.state, emit, stats = self._step(

@@ -82,6 +82,23 @@ def init_state(capacity: int, hist_bins: int = 0) -> TileState:
     )
 
 
+def donate_state_argnums() -> tuple:
+    """``(0,)`` off-CPU, ``()`` on CPU — the donate_argnums value for
+    the jitted step programs that fold the state slabs in place.
+
+    Donation is the memory-correct choice on accelerators (the slab is
+    the dominant HBM tenant; without donation every step holds two).
+    On this jaxlib's CPU client, however, donated step buffers + the
+    async dispatch pipeline corrupt the heap (glibc "corrupted
+    double-linked list" aborts mid-suite, reproducibly in the
+    resume-then-step path) — and on CPU the donation saves only a
+    host-RAM copy.  So the step programs donate exactly where it pays
+    and is safe: any non-CPU backend."""
+    import jax
+
+    return () if jax.default_backend() == "cpu" else (0,)
+
+
 _device_copy = None
 
 

@@ -698,27 +698,24 @@ H3_TGT static void snap_avx512(const Tables& T, int res,
                                bool res_class_iii, const float* lat,
                                const float* lng, int64_t n, uint32_t* hi,
                                uint32_t* lo) {
-  alignas(64) double v0[8], v1[8], v2[8], pbuf[8], ibuf[8], jbuf[8],
-      kbuf[8];
+  alignas(64) double pbuf[8], ibuf[8], jbuf[8], kbuf[8];
   alignas(32) int32_t faces[8];
   int64_t idx = 0;
   for (; idx + 8 <= n; idx += 8) {
+    __mmask8 fallback = 0;
+    snap_block8(T, res, res_class_iii, lat + idx, lng + idx, faces, pbuf,
+                ibuf, jbuf, kbuf, &fallback);
     for (int t = 0; t < 8; ++t) {
-      double la = (double)lat[idx + t], lo_ = (double)lng[idx + t];
-      if (!std::isfinite(la) || !std::isfinite(lo_)) {
-        la = 0.0;
-        lo_ = 0.0;
+      if ((fallback >> t) & 1) {
+        // non-finite / out-of-range trig input, or a face-argmax /
+        // hex-rounding decision inside the margin tolerance: the poly
+        // trig may not reproduce libm's discrete outcome, so this lane
+        // is redone scalar end-to-end — the bit-identical guarantee
+        // holds by construction
+        snap_one(T, res, res_class_iii, lat[idx + t], lng[idx + t],
+                 &hi[idx + t], &lo[idx + t]);
+        continue;
       }
-      double sla, cla, slo, clo;
-      h3_sincos(la, &sla, &cla);
-      h3_sincos(lo_, &slo, &clo);
-      v0[t] = cla * clo;
-      v1[t] = cla * slo;
-      v2[t] = sla;
-    }
-    snap_block8(T, res, res_class_iii, v0, v1, v2, faces, pbuf, ibuf,
-                jbuf, kbuf);
-    for (int t = 0; t < 8; ++t) {
       int face = faces[t];
       int64_t i = (int64_t)ibuf[t], j = (int64_t)jbuf[t],
               k = (int64_t)kbuf[t];

@@ -1,0 +1,27 @@
+"""obs — pipeline-wide observability substrate.
+
+Three pieces, all dependency-free:
+
+- :mod:`registry` — counters / gauges / fixed-bucket histograms with
+  Prometheus text exposition (``Registry.expose_text``);
+- :mod:`tracebuf` — bounded ring of structured per-micro-batch trace
+  records (``/trace/recent``; optional JSONL export via
+  ``HEATMAP_TRACE_JSONL``);
+- :mod:`xproc` — the file-backed supervisor→child metrics channel
+  (``HEATMAP_SUPERVISOR_CHANNEL``), so the child's ``/metrics`` reports
+  its parent supervisor's restart counters and they survive restarts.
+
+stream.metrics.Metrics builds on the registry and keeps its historical
+``snapshot()`` JSON keys — served at ``/metrics.json`` — while
+``/metrics`` serves the scrape-able exposition.  Metric names and SLO
+knobs are documented in ARCHITECTURE.md §Observability.
+"""
+
+from heatmap_tpu.obs.registry import (  # noqa: F401
+    DEFAULT_LAG_BUCKETS,
+    DEFAULT_TIME_BUCKETS,
+    Registry,
+    render_flat_counters,
+)
+from heatmap_tpu.obs.tracebuf import TraceRing  # noqa: F401
+from heatmap_tpu.obs.xproc import ENV_CHANNEL, SupervisorChannel  # noqa: F401

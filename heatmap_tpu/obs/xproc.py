@@ -1,0 +1,145 @@
+"""Cross-process supervisor→child metrics channel (file-backed).
+
+The supervisor (stream/supervisor.py) runs the streaming job as a child
+process; the child owns the HTTP /metrics endpoint.  Without a channel,
+the supervisor's restart/backoff/failover counters — exactly the
+telemetry that explains "why did the stream blip" — are invisible to
+scrapes, and everything resets when the child dies.
+
+This channel is a single small JSON file written atomically
+(tmp + rename) by the supervisor and read by anyone holding the path:
+
+- the supervisor passes the path to the child via
+  ``HEATMAP_SUPERVISOR_CHANNEL`` in its env, so the child's /metrics can
+  merge ``supervisor_*`` series into its exposition;
+- counters survive child restarts trivially (the parent owns them), and
+  survive *supervisor* restarts too: ``SupervisorChannel.load()`` at
+  startup resumes the persisted totals.
+
+A file (not a pipe/socket) because the reader must never block the
+writer, a half-written read must be impossible (rename is atomic on
+POSIX), and stale data must be detectable (``updated_unix`` rides in the
+payload).  mmap would save a syscall per scrape — not worth the
+portability trade at a 1/scrape read rate.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+log = logging.getLogger(__name__)
+
+ENV_CHANNEL = "HEATMAP_SUPERVISOR_CHANNEL"
+
+# numeric fields exported to /metrics as supervisor_* series; everything
+# else in the payload (reason strings, timestamps) serves /trace-style
+# debugging via /metrics.json
+COUNTER_FIELDS = ("restarts_total", "failures_total", "stalls_total",
+                  "failovers_total")
+GAUGE_FIELDS = ("failed_over", "backoff_s", "gave_up",
+                "recent_failures", "child_running")
+
+
+class SupervisorChannel:
+    def __init__(self, path: str):
+        self.path = path
+        self.state: dict = {
+            "restarts_total": 0,
+            "failures_total": 0,
+            "stalls_total": 0,
+            "failovers_total": 0,
+            "failed_over": 0,
+            "gave_up": 0,
+            "child_running": 0,
+            "backoff_s": 0.0,
+            "failure_times": [],     # wall clock of recent failures
+            "last_reason": "",
+            "started_unix": round(time.time(), 3),
+            "updated_unix": 0.0,
+        }
+
+    def resume(self) -> "SupervisorChannel":
+        """Fold persisted TOTALS back in (a restarted supervisor keeps
+        counting where its predecessor stopped).  Point-in-time flags
+        (gave_up, failed_over, child_running, backoff_s) deliberately do
+        NOT resume: they describe the predecessor process — a fresh
+        supervisor is actively supervising again, and carrying a stale
+        gave_up=1 would pin /healthz at down (503) forever."""
+        prior = self.load(self.path)
+        for k in COUNTER_FIELDS:
+            if isinstance(prior.get(k), (int, float)):
+                self.state[k] = prior[k]
+        if isinstance(prior.get("failure_times"), list):
+            self.state["failure_times"] = [
+                float(t) for t in prior["failure_times"][-64:]
+                if isinstance(t, (int, float))]
+        return self
+
+    def update(self, **fields) -> None:
+        self.state.update(fields)
+        self.publish()
+
+    def note_failure(self, reason: str, stalled: bool = False,
+                     window_s: float = 3600.0) -> None:
+        now = time.time()
+        ft = [t for t in self.state["failure_times"] if now - t <= window_s]
+        ft.append(now)
+        self.state["failure_times"] = ft[-64:]
+        self.state["failures_total"] += 1
+        if stalled:
+            self.state["stalls_total"] += 1
+        self.state["last_reason"] = str(reason)[:200]
+        self.publish()
+
+    def publish(self) -> None:
+        """Atomic write; an unwritable channel degrades to a warning —
+        telemetry must never take the supervisor down."""
+        self.state["updated_unix"] = round(time.time(), 3)
+        tmp = f"{self.path}.tmp{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(self.state, fh, separators=(",", ":"))
+            os.replace(tmp, self.path)
+        except OSError as e:
+            log.warning("supervisor channel write failed: %s", e)
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    @staticmethod
+    def load(path: str | None) -> dict:
+        """Read a channel file; {} when absent/unreadable/corrupt (a
+        scrape must never 500 because the supervisor died mid-write —
+        which the atomic rename already precludes — or never existed)."""
+        if not path:
+            return {}
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                d = json.load(fh)
+            return d if isinstance(d, dict) else {}
+        except (OSError, ValueError):
+            return {}
+
+    @staticmethod
+    def metrics_from(path: str | None,
+                     rate_window_s: float = 3600.0) -> dict:
+        """Flatten a channel file into /metrics-ready numeric fields,
+        with the derived recent-failure count the /healthz restart-rate
+        SLO evaluates.  {} when no channel."""
+        d = SupervisorChannel.load(path)
+        if not d:
+            return {}
+        now = time.time()
+        ft = [t for t in d.get("failure_times", ())
+              if isinstance(t, (int, float)) and now - t <= rate_window_s]
+        out = {"recent_failures": len(ft)}
+        for k in (*COUNTER_FIELDS, "failed_over", "gave_up",
+                  "child_running", "backoff_s"):
+            v = d.get(k)
+            if isinstance(v, (int, float)):
+                out[k] = v
+        return out

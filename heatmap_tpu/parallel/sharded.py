@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import functools
 import math
+import time
 from typing import NamedTuple, Sequence
 
 import jax
@@ -32,12 +33,21 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax.shard_map graduated from jax.experimental after this environment's
+# jax; bind whichever exists (identical signature for the kwargs used
+# here: f, mesh, in_specs, out_specs)
+try:
+    _shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from heatmap_tpu.parallel import multihost
 from heatmap_tpu.engine.state import (
     EMPTY_KEY_HI,
     EMPTY_KEY_LO,
     EMPTY_WS,
     TileState,
+    donate_state_argnums,
     init_state,
 )
 from heatmap_tpu.engine.step import (
@@ -421,17 +431,17 @@ class ShardedAggregator:
             return states, packed
 
         self._step = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 body_full, mesh=mesh, in_specs=in_specs,
                 out_specs=(states_specs, tuple([emit_specs] * n_pairs),
                            tuple([stats_specs] * n_pairs)),
             ),
-            donate_argnums=(0,),  # fold the state slabs in place
+            donate_argnums=donate_state_argnums(),  # fold slabs in place
         )
         self._step_packed = jax.jit(
-            jax.shard_map(body_packed, mesh=mesh, in_specs=in_specs,
+            _shard_map(body_packed, mesh=mesh, in_specs=in_specs,
                           out_specs=(states_specs, spec2)),
-            donate_argnums=(0,),
+            donate_argnums=donate_state_argnums(),
         )
 
         # prekeys variant: host-precomputed (hi, lo) planes per unique
@@ -450,11 +460,18 @@ class ShardedAggregator:
 
         in_specs_pre = in_specs + tuple([spec1] * (2 * len(uniq_res)))
         self._step_packed_pre = jax.jit(
-            jax.shard_map(body_packed_pre, mesh=mesh, in_specs=in_specs_pre,
+            _shard_map(body_packed_pre, mesh=mesh, in_specs=in_specs_pre,
                           out_specs=(states_specs, spec2)),
-            donate_argnums=(0,),
+            donate_argnums=donate_state_argnums(),
         )
         self._in_sharding = shard1
+        # host wall spent dispatching the fused sharded step (one fused
+        # program drives every local shard, so one dispatch clock per
+        # HOST — not separable per shard host-side).  Same surface as
+        # MultiAggregator.device_seconds; stream.runtime exports it as
+        # the heatmap_device_dispatch_seconds{shard="0"} gauge.
+        self.device_seconds = [0.0]
+        self.n_steps = 0
 
     # --- compat aliases (single-pair callers: tests, dryrun) ---------------
 
@@ -494,6 +511,7 @@ class ShardedAggregator:
         host-precomputed cell keys for THIS host's local rows (same
         local-slice convention as lat_rad); required for EVERY unique
         resolution when given (a partial dict raises)."""
+        t0 = time.monotonic()
         if prekeys is not None:
             missing = [r for r in self._uniq_res if r not in prekeys]
             if missing:
@@ -512,6 +530,8 @@ class ShardedAggregator:
                 jnp.int32(watermark_cutoff),
             )
         self.states = list(states)
+        self.device_seconds[0] += time.monotonic() - t0
+        self.n_steps += 1
         return packed
 
     def _puts(self, *arrays):

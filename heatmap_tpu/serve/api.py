@@ -8,8 +8,18 @@ Contract parity notes (all against /root/reference/app.py):
 - GET /api/positions/latest → FeatureCollection of Point features,
   properties {provider, vehicleId, ts} (app.py:71-88).
 - GET /            → embedded Leaflet UI (app.py:92-189).
-- GET /metrics     → runtime counters (new; the reference has none).
-- GET /healthz     → liveness.
+- GET /metrics      → Prometheus text exposition (obs.registry): batch /
+  span / freshness histograms, watermark + state gauges, sink + source
+  counters, supervisor channel, resolved-policy info.
+- GET /metrics.json → the historical JSON counter snapshot (every
+  pre-obs key preserved; the back-compat surface tools consume).
+- GET /trace/recent → newest-first structured per-batch trace records
+  (obs.tracebuf; ?n= bounds the count).
+- GET /healthz      → SLO evaluation: ok / degraded / down from recent
+  batch p50 vs HEATMAP_SLO_BATCH_P50_MS (default 500, the paper
+  budget), freshness p50 vs HEATMAP_SLO_FRESHNESS_P50_S, supervisor
+  restart rate vs HEATMAP_SLO_RESTARTS_PER_H; "down" (HTTP 503) on a
+  poisoned sink or a supervisor that gave up.
 """
 
 from __future__ import annotations
@@ -118,6 +128,146 @@ def tiles_feature_collection_json(store: Store,
             + ", ".join(parts) + ']}')
 
 
+def _policy_values(runtime) -> dict:
+    """The engine policies this run resolved (hwbank winners or static
+    fallbacks) — one place feeding both /metrics.json keys and the
+    /metrics info series."""
+    from heatmap_tpu.engine import step as engine_step
+
+    pin = engine_step.MERGE_BANK_PIN
+    return {
+        "policy_snap_impl": runtime._snap_impl_name,
+        "policy_emit_pull": "prefix" if runtime._prefix_pull else "full",
+        "policy_merge_banked": (None if pin is engine_step._BANK_LIVE
+                                else pin),
+    }
+
+
+def _metrics_json(runtime) -> dict:
+    """The historical /metrics JSON body, now served at /metrics.json
+    (every pre-obs key preserved), plus source transport counters and
+    the supervisor channel when present.  The channel is cross-process
+    state, so it reports even on a serve-only process (runtime=None) —
+    matching what /metrics exposes in the same configuration."""
+    from heatmap_tpu.obs import ENV_CHANNEL, SupervisorChannel
+
+    m: dict = {}
+    chan = SupervisorChannel.metrics_from(os.environ.get(ENV_CHANNEL))
+    if chan:
+        m["supervisor"] = chan
+    if runtime is None:
+        return m
+    m.update(runtime.metrics.snapshot())
+    m.update(runtime.writer.counters)
+    m.update(getattr(runtime.source, "counters", None) or {})
+    m.update(_policy_values(runtime))
+    return m
+
+
+def _supervisor_lines(chan: dict) -> list:
+    """Supervisor channel fields -> exposition lines (obs.xproc names
+    already carry their _total suffixes, so they bypass the generic
+    counter renderer)."""
+    from heatmap_tpu.obs.registry import _fmt
+    from heatmap_tpu.obs.xproc import COUNTER_FIELDS, GAUGE_FIELDS
+
+    lines = []
+    for k in COUNTER_FIELDS:
+        if isinstance(chan.get(k), (int, float)):
+            lines.append(f"# TYPE heatmap_supervisor_{k} counter")
+            lines.append(f"heatmap_supervisor_{k} {_fmt(chan[k])}")
+    for k in GAUGE_FIELDS:
+        if isinstance(chan.get(k), (int, float)):
+            lines.append(f"# TYPE heatmap_supervisor_{k} gauge")
+            lines.append(f"heatmap_supervisor_{k} {_fmt(chan[k])}")
+    return lines
+
+
+def _metrics_text(runtime) -> str:
+    """Prometheus text exposition for /metrics."""
+    from heatmap_tpu.obs import ENV_CHANNEL, SupervisorChannel
+    from heatmap_tpu.obs.registry import _escape_label
+
+    chan = SupervisorChannel.metrics_from(os.environ.get(ENV_CHANNEL))
+    extra_lines = _supervisor_lines(chan)
+    if runtime is None:
+        return "\n".join(extra_lines) + ("\n" if extra_lines else "")
+    pol = _policy_values(runtime)
+    labels = ",".join(
+        f'{k.removeprefix("policy_")}="{_escape_label(str(v))}"'
+        for k, v in pol.items())
+    extra_lines.append("# TYPE heatmap_policy_info gauge")
+    extra_lines.append("heatmap_policy_info{%s} 1" % labels)
+    extra = dict(runtime.writer.counters)
+    # the writer's retry count is already a first-class registry series
+    # (heatmap_sink_retries_total, sink/writer.py) — merging the flat
+    # 'sink_retries' key too would emit a duplicate series + TYPE line,
+    # which the Prometheus text parser rejects (failing the whole scrape)
+    extra.pop("sink_retries", None)
+    extra.update(getattr(runtime.source, "counters", None) or {})
+    return runtime.metrics.expose_text(extra_counters=extra,
+                                       extra_lines=extra_lines)
+
+
+# ---- /healthz SLO evaluation -----------------------------------------
+# Env knobs (read per request — they are three getenv calls):
+#   HEATMAP_SLO_BATCH_P50_MS     recent p50 batch latency budget (500,
+#                                the paper's headline bound)
+#   HEATMAP_SLO_FRESHNESS_P50_S  recent p50 emit freshness budget (60)
+#   HEATMAP_SLO_RESTARTS_PER_H   supervisor failures tolerated in the
+#                                trailing hour before degraded (4)
+def _slo(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        log.warning("%s=%r is not a number; using %s", name,
+                    os.environ.get(name), default)
+        return float(default)
+
+
+def healthz_payload(runtime) -> tuple[dict, bool]:
+    """(payload, down): SLO checks against the recent-window histogram
+    quantiles and the supervisor channel.  ok -> degraded on any budget
+    breach; down (serve 503) only when the pipeline cannot make
+    progress — poisoned sink or a supervisor that gave up."""
+    from heatmap_tpu.obs import ENV_CHANNEL, SupervisorChannel
+
+    checks: dict = {}
+    degraded = down = False
+    if runtime is not None:
+        m = runtime.metrics
+        if m.batch_latency.count:
+            p50_ms = m.batch_latency.quantile(0.5) * 1e3
+            budget = _slo("HEATMAP_SLO_BATCH_P50_MS", 500.0)
+            ok = p50_ms <= budget
+            checks["batch_p50_ms"] = {"value": round(p50_ms, 3),
+                                      "budget": budget, "ok": ok}
+            degraded |= not ok
+        if m.freshness.count:
+            f50 = m.freshness.quantile(0.5)
+            budget = _slo("HEATMAP_SLO_FRESHNESS_P50_S", 60.0)
+            ok = f50 <= budget
+            checks["freshness_p50_s"] = {"value": round(f50, 3),
+                                         "budget": budget, "ok": ok}
+            degraded |= not ok
+        if runtime.writer.poisoned:
+            checks["sink"] = {"value": "poisoned", "ok": False}
+            down = True
+    chan = SupervisorChannel.metrics_from(os.environ.get(ENV_CHANNEL))
+    if chan:
+        budget = _slo("HEATMAP_SLO_RESTARTS_PER_H", 4.0)
+        n = chan.get("recent_failures", 0)
+        ok = n <= budget
+        checks["supervisor_restarts_1h"] = {"value": n, "budget": budget,
+                                            "ok": ok}
+        degraded |= not ok
+        if chan.get("gave_up"):
+            checks["supervisor"] = {"value": "gave_up", "ok": False}
+            down = True
+    status = "down" if down else ("degraded" if degraded else "ok")
+    return {"ok": not down, "status": status, "checks": checks}, down
+
+
 def positions_feature_collection(store: Store) -> dict:
     features = []
     for doc in store.all_positions():
@@ -195,6 +345,7 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
         path = environ.get("PATH_INFO", "/")
         pre_gz = None
         data = None
+        status = "200 OK"
         try:
             if path == "/api/tiles/latest":
                 qs = environ.get("QUERY_STRING", "")
@@ -216,24 +367,31 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                     lambda: json.dumps(positions_feature_collection(store)))
                 ctype = "application/json"
             elif path == "/metrics":
-                m = runtime.metrics.snapshot() if runtime is not None else {}
-                if runtime is not None:
-                    m.update(runtime.writer.counters)
-                    # resolved engine policies (hwbank measured winners
-                    # or static fallbacks) — operators see WHICH
-                    # kernel/pull/merge choices this run actually made
-                    from heatmap_tpu.engine import step as engine_step
-
-                    pin = engine_step.MERGE_BANK_PIN
-                    m["policy_snap_impl"] = runtime._snap_impl_name
-                    m["policy_emit_pull"] = ("prefix" if runtime._prefix_pull
-                                             else "full")
-                    m["policy_merge_banked"] = (
-                        None if pin is engine_step._BANK_LIVE else pin)
-                body = json.dumps(m)
+                body = _metrics_text(runtime)
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/metrics.json":
+                body = json.dumps(_metrics_json(runtime))
+                ctype = "application/json"
+            elif path == "/trace/recent":
+                qs = environ.get("QUERY_STRING", "")
+                n = 50
+                for part in qs.split("&"):
+                    if part.startswith("n="):
+                        try:
+                            n = max(0, min(int(part[2:]), 1024))
+                        except ValueError:
+                            pass
+                traces = (runtime.tracering.recent(n)
+                          if runtime is not None
+                          and getattr(runtime, "tracering", None) is not None
+                          else [])
+                body = json.dumps({"traces": traces})
                 ctype = "application/json"
             elif path == "/healthz":
-                body = json.dumps({"ok": True})
+                payload, down = healthz_payload(runtime)
+                if down:
+                    status = "503 Service Unavailable"
+                body = json.dumps(payload)
                 ctype = "application/json"
             elif path == "/":
                 body = render_index(refresh_ms, resolutions)
@@ -260,7 +418,7 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 headers.append(("Content-Encoding", "gzip"))
         headers.append(("Vary", "Accept-Encoding"))
         headers.append(("Content-Length", str(len(data))))
-        start_response("200 OK", headers)
+        start_response(status, headers)
         return [data]
 
     return app

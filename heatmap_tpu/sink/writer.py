@@ -27,7 +27,7 @@ log = logging.getLogger(__name__)
 
 class AsyncWriter:
     def __init__(self, store: Store, max_queue: int = 64,
-                 retries: int = 3, backoff_s: float = 0.2):
+                 retries: int = 3, backoff_s: float = 0.2, metrics=None):
         self.store = store
         self.retries = retries
         self.backoff_s = backoff_s
@@ -36,6 +36,23 @@ class AsyncWriter:
         self._written_tiles = 0
         self._written_positions = 0
         self._retried = 0
+        if metrics is not None:
+            # queue depth read at scrape time (callback gauge) — a deep
+            # queue means the sink can't keep up with the device step;
+            # retry/poison counters live in the registry so /metrics
+            # shows sink trouble without waiting for a snapshot merge
+            metrics.gauge("heatmap_sink_queue_depth",
+                          "pending write batches in the async sink queue",
+                          fn=self._q.qsize)
+            self._c_retries = metrics.registry.counter(
+                "heatmap_sink_retries_total",
+                "sink write attempts that failed and were retried")
+            self._g_poisoned = metrics.gauge(
+                "heatmap_sink_poisoned",
+                "1 once a sink write exhausted its retries (writer "
+                "permanently failed; offsets can no longer advance)")
+        else:
+            self._c_retries = self._g_poisoned = None
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="sink-writer")
         self._thread.start()
@@ -57,6 +74,8 @@ class AsyncWriter:
                 if attempt == self.retries:
                     raise
                 self._retried += 1
+                if self._c_retries is not None:
+                    self._c_retries.inc()
                 log.warning("sink write failed (attempt %d/%d); retrying "
                             "in %.1fs", attempt + 1, self.retries, delay,
                             exc_info=True)
@@ -81,6 +100,8 @@ class AsyncWriter:
                 log.exception("sink write failed after %d retries",
                               self.retries)
                 self._exc = e
+                if self._g_poisoned is not None:
+                    self._g_poisoned.set(1)
             finally:
                 self._q.task_done()
 

@@ -1,47 +1,76 @@
 """Runtime metrics: the counters BASELINE.json measures (SURVEY.md §5.5).
 
 events/sec in, rows upserted, p50/p95 micro-batch latency, plus per-span
-timings (ingest / build / device / sink) so the bottleneck is visible.
-Exposed by the serving layer at /metrics.
+timings (poll / build / pull / snap / device / sink_submit) so the
+bottleneck is visible.  Built on the obs registry: latency, freshness,
+and spans are real fixed-bucket histograms with Prometheus exposition
+(served at /metrics), while ``snapshot()`` keeps every historical JSON
+key byte-compatible (served at /metrics.json) — the recent-window
+quantiles the old Percentiles deque provided now come from each
+histogram's bounded sample window.
+
+Named event counters stay a plain ``collections.Counter`` (names are
+dynamic, e.g. per-pair late counts) and are rendered into the
+exposition generically as ``heatmap_<name>_total``.
 """
 
 from __future__ import annotations
 
 import collections
 import time
-from typing import Mapping
+from typing import Iterable, Mapping
 
+from heatmap_tpu.obs import (
+    DEFAULT_LAG_BUCKETS,
+    DEFAULT_TIME_BUCKETS,
+    Registry,
+    render_flat_counters,
+)
 
-class Percentiles:
-    def __init__(self, window: int = 512):
-        self.samples: collections.deque = collections.deque(maxlen=window)
-
-    def add(self, v: float) -> None:
-        self.samples.append(v)
-
-    def quantile(self, q: float) -> float:
-        if not self.samples:
-            return 0.0
-        s = sorted(self.samples)
-        i = min(len(s) - 1, int(q * len(s)))
-        return s[i]
+# Counter-dict entries that are point-in-time values, not monotonic
+# counts — typed as gauges in the exposition
+GAUGE_NAMES = frozenset({
+    "state_overflow_last_epoch", "state_capacity_per_shard",
+    "uptime_s", "events_per_sec",
+})
 
 
 class Metrics:
     def __init__(self):
         self.t_start = time.monotonic()
         self.counters: collections.Counter = collections.Counter()
-        self.batch_latency = Percentiles()
-        self.freshness = Percentiles()  # emit wall time − newest event ts
-        self.spans: dict[str, Percentiles] = collections.defaultdict(Percentiles)
+        self.registry = Registry()
+        self.batch_latency = self.registry.histogram(
+            "heatmap_batch_latency_seconds",
+            "end-to-end wall time of one micro-batch step",
+            buckets=DEFAULT_TIME_BUCKETS)
+        self.freshness = self.registry.histogram(
+            "heatmap_freshness_seconds",
+            "emit wall time minus the batch's newest event timestamp",
+            buckets=DEFAULT_LAG_BUCKETS)
+        self._span_fam = self.registry.histogram(
+            "heatmap_batch_span_seconds",
+            "per-batch span wall time (poll/build/pull/snap/device/"
+            "sink_submit)", labels=("span",), buckets=DEFAULT_TIME_BUCKETS)
+        # name -> histogram child, in observation order (snapshot() keys)
+        self.spans: dict[str, object] = {}
 
     def count(self, name: str, n: int = 1) -> None:
         self.counters[name] += n
 
-    def observe_batch(self, latency_s: float, spans: Mapping[str, float]) -> None:
-        self.batch_latency.add(latency_s)
+    def gauge(self, name: str, help_: str = "", fn=None, labels=()):
+        """Registry gauge pass-through for the layers this Metrics is
+        threaded into (runtime state capacity, writer queue depth, …)."""
+        return self.registry.gauge(name, help_, labels=labels, fn=fn)
+
+    def observe_batch(self, latency_s: float,
+                      spans: Mapping[str, float]) -> None:
+        self.batch_latency.observe(latency_s)
         for k, v in spans.items():
-            self.spans[k].add(v)
+            h = self.spans.get(k)
+            if h is None:
+                h = self.spans[k] = self._span_fam.labels(span=k)
+            h.observe(v)
 
     def snapshot(self) -> dict:
         elapsed = max(time.monotonic() - self.t_start, 1e-9)
@@ -56,3 +85,21 @@ class Metrics:
         for k, p in self.spans.items():
             out[f"span_{k}_p50_ms"] = round(p.quantile(0.5) * 1e3, 3)
         return out
+
+    def expose_text(self, extra_counters: Mapping[str, float] | None = None,
+                    extra_lines: Iterable[str] = ()) -> str:
+        """Prometheus text exposition: the registry's typed series, then
+        the ad-hoc counter dict (plus any caller-merged dicts — writer /
+        source counters) as generically-typed series."""
+        flat = dict(self.counters)
+        elapsed = max(time.monotonic() - self.t_start, 1e-9)
+        flat["uptime_s"] = round(elapsed, 3)
+        flat["events_per_sec"] = round(
+            self.counters.get("events_valid", 0) / elapsed, 1)
+        if extra_counters:
+            flat.update({k: v for k, v in extra_counters.items()
+                         if isinstance(v, (int, float))})
+        lines = render_flat_counters(flat, prefix="heatmap_",
+                                     gauge_names=GAUGE_NAMES)
+        lines.extend(extra_lines)
+        return self.registry.expose_text(extra=lines)

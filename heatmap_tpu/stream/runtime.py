@@ -81,9 +81,26 @@ class MicroBatchRuntime:
         self.cfg = cfg
         self.source = source
         self.store = store
-        self.writer = AsyncWriter(store)
         self.metrics = Metrics()
+        self.writer = AsyncWriter(store, metrics=self.metrics)
         self.tracer = Tracer()
+        from heatmap_tpu.obs import TraceRing
+
+        self.tracering = TraceRing()
+        # pipeline-state gauges: watermark/event-time lag, state slab
+        # occupancy vs capacity (the overflow early-warning), and the
+        # per-shard device dispatch clock (engine.multi accumulates it;
+        # callback gauges read it at scrape time)
+        self._g_watermark = self.metrics.gauge(
+            "heatmap_watermark_age_seconds",
+            "wall clock minus the event-time high watermark "
+            "(max event ts seen)")
+        self._g_capacity = self.metrics.gauge(
+            "heatmap_state_capacity_rows",
+            "state slab capacity per shard (rows)")
+        self._g_active = self.metrics.gauge(
+            "heatmap_state_active_groups_peak",
+            "max live (cell,window) groups seen on any pair")
         self.positions_enabled = positions_enabled
         self.checkpoint_every = checkpoint_every
         self.ckpt = CheckpointManager(cfg.checkpoint_dir)
@@ -193,6 +210,19 @@ class MicroBatchRuntime:
             )
             for res, win_s in pairs:
                 self.aggs[(res, win_s // 60)] = self._multi.view(res, win_s)
+        self._g_capacity.set(cap)
+        # per-shard device dispatch clock: the fused aggregator keeps a
+        # host-wall accumulator per local shard; a callback gauge reads
+        # it at scrape time so the step loop pays nothing extra
+        agg_obs = self._multi if self._multi is not None else self._sharded
+        fam = self.metrics.gauge(
+            "heatmap_device_dispatch_seconds",
+            "cumulative host wall seconds spent dispatching the fused "
+            "device step (one clock per local dispatch stream)",
+            labels=("shard",))
+        for shard, _ in enumerate(getattr(agg_obs, "device_seconds", ())):
+            fam.labels(shard=str(shard)).fn = (
+                lambda a=agg_obs, s=shard: a.device_seconds[s])
         # HEATMAP_H3_IMPL=native: snap on the host (C++, ~11x faster per
         # CPU core than the XLA-CPU snap and f64-exact) and feed the fold
         # pre-computed keys — both paths: the fused single-device step
@@ -809,6 +839,8 @@ class MicroBatchRuntime:
             # Meaningful for live feeds; replays of old data show the
             # replay lag, which is itself the honest answer.
             self.metrics.freshness.add(time.time() - batch_max)
+        if self.max_event_ts > I32_MIN:
+            self._g_watermark.set(time.time() - self.max_event_ts)
         self._last_pull_s = time.monotonic() - t_flush
 
     def _account_stats(self, res: int, wmin: int, stats,
@@ -858,6 +890,7 @@ class MicroBatchRuntime:
                                int(stats.n_late))
         n_active = int(stats.n_active)
         self._n_active_peak = max(self._n_active_peak, n_active)
+        self._g_active.set(self._n_active_peak)
         # per-batch group minting (for grow_margin=observed): the raw
         # n_active delta UNDERcounts minting when eviction freed rows the
         # same batch, so add evictions back in.  The FIRST observation
@@ -913,6 +946,7 @@ class MicroBatchRuntime:
         agg.grow(new_cap)
         self.metrics.count("state_grown")
         self.metrics.counters["state_capacity_per_shard"] = new_cap
+        self._g_capacity.set(new_cap)
         log.warning(
             "state slabs grown 2^%d -> 2^%d rows/shard (%d live groups; "
             "%.2fs; next step retraces)", cap.bit_length() - 1,
@@ -1018,22 +1052,33 @@ class MicroBatchRuntime:
         self.epoch += 1
         t_end = time.monotonic()
         pull_s, self._last_pull_s = self._last_pull_s, 0.0
-        self.metrics.observe_batch(
-            t_end - t0,
-            {
-                "poll": t_poll - t0,
-                "build": t_build - t_poll,
-                # the deferred pull of batch k-1 (waits out its fold) vs
-                # this batch's own dispatch — the split that shows whether
-                # checkpoint/pull work ever gaps the step loop
-                "pull": pull_s,
-                # host pre-snap (HEATMAP_H3_IMPL=native) is host work
-                # billed separately from the device dispatch it precedes
-                "snap": snap_s,
-                "device": (t_device - t_build) - pull_s - snap_s,
-                "sink_submit": t_end - t_device,
-            },
-        )
+        spans = {
+            "poll": t_poll - t0,
+            "build": t_build - t_poll,
+            # the deferred pull of batch k-1 (waits out its fold) vs
+            # this batch's own dispatch — the split that shows whether
+            # checkpoint/pull work ever gaps the step loop
+            "pull": pull_s,
+            # host pre-snap (HEATMAP_H3_IMPL=native) is host work
+            # billed separately from the device dispatch it precedes
+            "snap": snap_s,
+            "device": (t_device - t_build) - pull_s - snap_s,
+            "sink_submit": t_end - t_device,
+        }
+        self.metrics.observe_batch(t_end - t0, spans)
+        # structured trace record (obs.tracebuf -> /trace/recent, JSONL).
+        # Late/overflow counts account one batch behind (the deferred
+        # pull), so the record carries the delta since the last record —
+        # a nonzero flag points at the incident window either way.
+        c = self.metrics.counters
+        cum = (c.get("events_late", 0), c.get("state_overflow_groups", 0),
+               c.get("events_bucket_dropped", 0))
+        last = getattr(self, "_trace_cum", (0, 0, 0))
+        self._trace_cum = cum
+        self.tracering.record(
+            self.epoch - 1, t_end - t0, spans, n_events=n,
+            n_late=cum[0] - last[0], overflow_groups=cum[1] - last[1],
+            late_dropped=cum[2] - last[2])
         progressed = cols is not None
         carrying = self._carry_cols is not None
         if self._multiproc:
@@ -1164,6 +1209,7 @@ class MicroBatchRuntime:
 
     def close(self) -> None:
         self.tracer.stop()  # flush a partial profiler capture, if any
+        self.tracering.close()  # flush/close the JSONL trace export
         if getattr(self, "_hb_stop", None) is not None:
             self._hb_stop.set()
         try:

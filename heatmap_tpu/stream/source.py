@@ -42,6 +42,13 @@ class Source(abc.ABC):
         """True when no more data will ever arrive (bounded replays)."""
         return False
 
+    @property
+    def counters(self) -> dict:
+        """Transport-health counters (fetch errors, timeouts, offset
+        resets) merged into /metrics by the serving layer; sources with
+        no transport report nothing."""
+        return {}
+
     def close(self) -> None:
         pass
 
@@ -290,6 +297,10 @@ class KafkaSource(Source):
     def seek(self, offset) -> None:
         self._impl.seek(offset)
 
+    @property
+    def counters(self) -> dict:
+        return dict(getattr(self._impl, "counters", None) or {})
+
     def close(self) -> None:
         self._impl.close()
 
@@ -438,6 +449,13 @@ class _WireImpl:
         # (stream/colfmt.py — whole batches per value, memcpy decode)
         self._fmt = os.environ.get("HEATMAP_EVENT_FORMAT", "json")
         self._offsets: dict[int, int] = {}
+        # transport-health counters (surfaced at /metrics via
+        # Source.counters): every handled fetch/discovery error and
+        # retention-forced offset reset counts, so a flapping broker is
+        # visible without grepping warnings out of the logs
+        self.counters = {"kafka_fetch_errors": 0,
+                         "kafka_offset_resets": 0,
+                         "kafka_discover_errors": 0}
         self._discover()
         self._rr = 0  # round-robin cursor
         # hot path: decode fetched record values to columnar arrays in C++
@@ -472,6 +490,7 @@ class _WireImpl:
             for p, off in self.c.list_offsets(self.topic, LATEST).items():
                 self._offsets.setdefault(p, off)
         except (KafkaError, ConnectionError, OSError) as e:
+            self.counters["kafka_discover_errors"] += 1
             self.log.warning("kafka partition discovery failed: %s", e)
 
     def _guarded_fetch(self, p: int, fn):
@@ -485,6 +504,7 @@ class _WireImpl:
         except KafkaError as e:
             if e.code == 1:  # OFFSET_OUT_OF_RANGE: retention truncated
                 # past our checkpoint — resume from the log start
+                self.counters["kafka_offset_resets"] += 1
                 try:
                     earliest = self.c.list_offsets(self.topic, EARLIEST)
                     self.log.warning(
@@ -495,8 +515,10 @@ class _WireImpl:
                 except (KafkaError, ConnectionError, OSError) as e2:
                     self.log.warning("offset reset failed: %s", e2)
             else:
+                self.counters["kafka_fetch_errors"] += 1
                 self.log.warning("fetch %s[%d]: %s", self.topic, p, e)
         except (ConnectionError, OSError) as e:
+            self.counters["kafka_fetch_errors"] += 1
             self.log.warning("fetch %s[%d]: %s", self.topic, p, e)
         return None
 

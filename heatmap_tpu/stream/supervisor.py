@@ -42,6 +42,8 @@ import tempfile
 import time
 from typing import NamedTuple
 
+from heatmap_tpu.obs import ENV_CHANNEL, SupervisorChannel
+
 log = logging.getLogger("supervisor")
 
 
@@ -105,7 +107,7 @@ class RestartPolicy(NamedTuple):
 class Supervisor:
     def __init__(self, argv: list[str], policy: RestartPolicy | None = None,
                  env: dict | None = None, heartbeat_path: str | None = None,
-                 poll_s: float = 0.2):
+                 poll_s: float = 0.2, channel_path: str | None = None):
         self.argv = list(argv)
         self.policy = policy or RestartPolicy()
         self.env = dict(env if env is not None else os.environ)
@@ -114,6 +116,18 @@ class Supervisor:
         self.poll_s = poll_s
         self.restarts = 0            # total child launches after the first
         self.failed_over = False
+        # cross-process metrics channel (obs.xproc): the child's /metrics
+        # merges this file's restart/backoff/failover counters.  The path
+        # defaults next to the heartbeat; a caller-supplied STABLE path
+        # (or a pre-set env var) also survives supervisor restarts —
+        # resume() folds persisted totals back in either way.
+        self.channel = SupervisorChannel(
+            channel_path or self.env.get(ENV_CHANNEL)
+            or self.heartbeat_path + ".chan").resume()
+        # resumed launch total: published counters continue from the
+        # predecessor supervisor's count instead of resetting to this
+        # process's self.restarts
+        self._restarts_base = int(self.channel.state["restarts_total"])
         # A plain bool, NOT a threading.Event: stop() runs inside signal
         # handlers (supervise_cli), and Event.set() acquires the Event's
         # non-reentrant Condition lock — which the interrupted main
@@ -130,11 +144,16 @@ class Supervisor:
     def _spawn(self) -> subprocess.Popen:
         env = dict(self.env)
         env["HEATMAP_HEARTBEAT_FILE"] = self.heartbeat_path
+        env[ENV_CHANNEL] = self.channel.path
         try:
             os.remove(self.heartbeat_path)  # age from THIS child's start
         except OSError:
             pass
         log.info("starting child: %s", " ".join(self.argv))
+        self.channel.update(
+            child_running=1,
+            restarts_total=self._restarts_base + self.restarts,
+            failed_over=int(self.failed_over))
         return subprocess.Popen(self.argv, env=env)
 
     def _heartbeat_age(self, child_started: float) -> tuple[float, bool]:
@@ -196,6 +215,7 @@ class Supervisor:
                 if code is not None:
                     if code == 0:
                         log.info("child exited cleanly; done")
+                        self.channel.update(child_running=0)
                         return 0
                     reason = f"exit code {code}"
                     # exit-code failure: the child ran under its own
@@ -222,7 +242,14 @@ class Supervisor:
             if self._stop_flag:
                 self._kill(proc)
                 log.info("stopped; child terminated")
+                self.channel.update(child_running=0)
                 return 0
+            # failure bookkeeping for the child's /metrics and the
+            # /healthz restart-rate SLO: timestamps retained for at
+            # least an hour (the SLO's rate window)
+            self.channel.note_failure(
+                reason, stalled=reason.startswith("stall"),
+                window_s=max(3600.0, p.window_s))
             if healthy_span > p.window_s:
                 # the child ran healthy for a full budget window before
                 # this failure — an isolated blip, not a streak.  Without
@@ -238,6 +265,7 @@ class Supervisor:
             if len(recent) > p.max_restarts:
                 log.error("giving up: %d failures within %.0fs (last: %s)",
                           len(recent), p.window_s, reason)
+                self.channel.update(gave_up=1, child_running=0)
                 return rc
             if (p.failover_after is not None and not self.failed_over
                     and failures_in_a_row >= p.failover_after):
@@ -248,10 +276,16 @@ class Supervisor:
                     failures_in_a_row, p.failover_platform)
                 self.env["HEATMAP_PLATFORM"] = p.failover_platform
                 self.failed_over = True
+                self.channel.update(
+                    failovers_total=self.channel.state["failovers_total"]
+                    + 1, failed_over=1)
             log.warning("child failed (%s); restarting in %.1fs "
                         "(%d/%d in window)", reason, backoff,
                         len(recent), p.max_restarts)
             self.restarts += 1
+            self.channel.update(
+                child_running=0, backoff_s=backoff,
+                restarts_total=self._restarts_base + self.restarts)
             self._wait(backoff)
             backoff = min(backoff * 2, p.backoff_max_s)
         return 0 if self._stop_flag else rc  # stop() during backoff = clean stop

@@ -16,7 +16,20 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jaxlib: the config option doesn't exist, but the XLA flag is
+    # honored at (lazy) backend init, which happens inside the tests
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+if not hasattr(jax, "enable_x64"):
+    # jax.enable_x64 graduated from jax.experimental after this
+    # environment's jax; alias it so the hexgrid f64-oracle tests run
+    # on both
+    from jax.experimental import enable_x64 as _enable_x64
+
+    jax.enable_x64 = _enable_x64
 # persistent compile cache: the suite is dominated by CPU XLA compiles
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax-test-cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

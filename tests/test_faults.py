@@ -112,6 +112,7 @@ def test_permanent_sink_failure_poisons_and_blocks_checkpoint():
         w.submit_tiles([{"_id": "y"}])
 
 
+@pytest.mark.slow  # tier-1 budget: see pyproject markers
 def test_profiler_trace_capture(tmp_path, monkeypatch):
     """HEATMAP_PROFILE_DIR captures a device trace over the hot loop."""
     trace_dir = tmp_path / "trace"

@@ -132,6 +132,7 @@ class TestPallasKernel:
             np.asarray(lo_p) == np.asarray(lo_x))
         return same.mean()
 
+    @pytest.mark.slow  # tier-1 budget: see pyproject markers
     def test_matches_xla_path_city(self, rng):
         n = 5000
         lat = np.radians(rng.uniform(42.2, 42.5, n)).astype(np.float32)
@@ -139,6 +140,7 @@ class TestPallasKernel:
         for res in (7, 8, 9):
             assert self._agreement(lat, lng, res) >= 0.998
 
+    @pytest.mark.slow  # tier-1 budget: see pyproject markers
     def test_matches_xla_path_global_and_padding(self, rng):
         # odd size forces internal padding; global points cross faces
         n = 8192 + 137

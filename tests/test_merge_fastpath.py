@@ -50,6 +50,7 @@ def assert_trees_equal(a, b, msg=""):
                                       err_msg=msg)
 
 
+@pytest.mark.slow  # tier-1 budget: see pyproject markers
 @pytest.mark.parametrize("impl", ["sort", "rank", "probe"])
 def test_fastpath_bit_identical_over_stream(impl):
     """A 6-batch stream interleaving fast batches (repeat keys), a
@@ -85,6 +86,7 @@ def test_fastpath_bit_identical_over_stream(impl):
         assert_trees_equal(a, b, msg=f"batch {i} impl {impl}")
 
 
+@pytest.mark.slow  # tier-1 budget: see pyproject markers
 @pytest.mark.parametrize("impl", ["sort", "rank"])
 def test_tier2_gradual_turnover_bit_identical(impl):
     """The realistic streaming pattern — most events hit existing rows,
@@ -125,6 +127,7 @@ def test_tier2_gradual_turnover_bit_identical(impl):
         assert_trees_equal(a, b, msg=f"batch {i} impl {impl}")
 
 
+@pytest.mark.slow  # tier-1 budget: see pyproject markers
 def test_predicate_scenarios():
     """fast_ok exactly when every valid event hits an existing row and
     no window evicts."""

@@ -61,6 +61,7 @@ def test_rank_matches_sort_overflow(rng):
     run_pair(rng, n=512, cap=64, bins=0)
 
 
+@pytest.mark.slow  # tier-1 budget: see pyproject markers
 def test_rank_matches_sort_all_invalid(rng):
     a = init_state(256, 0)
     b = init_state(256, 0)

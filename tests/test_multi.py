@@ -3,6 +3,7 @@ must agree exactly with independent SingleAggregators driven pair by pair,
 and its packed head rows must carry the per-pair step stats."""
 
 import numpy as np
+import pytest
 
 from heatmap_tpu.engine import AggParams
 from heatmap_tpu.engine.multi import MultiAggregator, stats_from_packed
@@ -33,6 +34,7 @@ def _emit_as_dict(e):
     return out
 
 
+@pytest.mark.slow  # tier-1 budget: see pyproject markers
 def test_multi_matches_singles(rng):
     multi = MultiAggregator(PAIRS, capacity=CAP, batch_size=N,
                             emit_capacity=N, hist_bins=BINS)
@@ -73,6 +75,7 @@ def test_multi_matches_singles(rng):
             np.testing.assert_array_equal(np.asarray(g), np.asarray(s))
 
 
+@pytest.mark.slow  # tier-1 budget: see pyproject markers
 def test_pair_view_checkpoint_roundtrip(rng):
     multi = MultiAggregator(PAIRS[:2], capacity=CAP, batch_size=N,
                             emit_capacity=N, hist_bins=0)

@@ -183,6 +183,7 @@ def _free_port() -> int:
     return port
 
 
+@pytest.mark.slow  # tier-1 budget: see pyproject markers
 def test_two_process_sharded_aggregation(tmp_path):
     coord = f"127.0.0.1:{_free_port()}"
     worker_py = tmp_path / "worker.py"

@@ -7,6 +7,7 @@ snaps).  The startup collective (stream/runtime.py) demotes the merge
 pin to None unless every host's verdict matches; when the banks agree,
 the unanimous pin must SURVIVE the collective."""
 
+import pytest
 import json
 import os
 import socket
@@ -83,6 +84,7 @@ def _free_port() -> int:
     return port
 
 
+@pytest.mark.slow  # tier-1 budget: see pyproject markers
 def test_two_process_bank_skew_agreement(tmp_path):
     coord = f"127.0.0.1:{_free_port()}"
     worker_py = tmp_path / "worker.py"

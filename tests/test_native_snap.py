@@ -216,3 +216,54 @@ def test_runtime_end_to_end_native(tmp_path, monkeypatch):
     total = sum(doc["count"] for doc in store._tiles.values())
     assert total == 1024
     assert rt.metrics.snapshot()["events_valid"] == 1024
+
+
+def test_block_redo_path_near_boundary_and_bad_lanes():
+    """Lanes the block path must hand back to the scalar redo
+    (h3_snap.cpp snap_block8's fallback mask): near-cell-edge points
+    (hex-rounding margin), points near icosahedron vertices (face-argmax
+    margin), non-finite coords, and finite trig inputs outside the
+    polynomial's range (|x| > 16 rad).  All must come out bit-identical
+    to the scalar reference — and, for finite in-range points, to the
+    f64 host oracle."""
+    from heatmap_tpu import hexgrid
+
+    rng = np.random.default_rng(17)
+    lats, lngs = [], []
+    # boundary-vertex neighborhoods: every vertex of a spread of cells,
+    # jittered at log-spaced tiny offsets so some lanes land inside the
+    # rounding-margin band
+    for la, lo in [(42.36, -71.06), (0.001, 0.001), (-33.9, 151.2),
+                   (64.1, -21.9)]:
+        for res in (5, 8, 10):
+            cell = hexgrid.latlng_to_cell(la, lo, res)
+            for (vla, vlo) in hexgrid.cell_to_boundary(cell):
+                for eps in (0.0, 1e-9, -1e-9, 1e-7, -1e-7, 1e-5):
+                    lats.append(vla + eps)
+                    lngs.append(vlo - eps)
+    # icosahedron-vertex neighborhood (face decision margin)
+    for eps in (0.0, 1e-9, 1e-7, 1e-5):
+        lats.append(26.57 + eps)
+        lngs.append(0.0 + eps)
+    lat = np.radians(np.array(lats, np.float32))
+    lng = np.radians(np.array(lngs, np.float32))
+    n_finite = len(lat)
+    # bad lanes: non-finite and out-of-poly-range (finite but |x| > 16),
+    # interleaved so full 8-lane blocks contain a mix
+    bad_lat = np.array([np.nan, 0.5, np.inf, -0.5, 0.5, 20.0, -np.inf,
+                        0.5], np.float32)
+    bad_lng = np.array([0.1, np.nan, 0.1, 20.0, -17.5, 0.1, 0.1,
+                        -np.inf], np.float32)
+    lat = np.concatenate([lat, bad_lat, lat[:8]])
+    lng = np.concatenate([lng, bad_lng, lng[:8]])
+    snap = native_snap._snap()
+    for res in (0, 5, 8, 10):
+        hi_v, lo_v = snap.snap(lat, lng, res)
+        hi_s, lo_s = snap.snap(lat, lng, res, scalar=True)
+        np.testing.assert_array_equal(_u64(hi_v, lo_v), _u64(hi_s, lo_s),
+                                      err_msg=f"res {res}")
+        # finite, in-range prefix also matches the f64 host oracle
+        np.testing.assert_array_equal(
+            _u64(hi_v[:n_finite], lo_v[:n_finite]),
+            _oracle(lat[:n_finite], lng[:n_finite], res),
+            err_msg=f"res {res} oracle")

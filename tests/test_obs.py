@@ -1,0 +1,243 @@
+"""obs subsystem: registry semantics, histogram bucketing, Prometheus
+text exposition, the trace ring + JSONL export, and the supervisor
+cross-process channel (restart counters visible through a child's
+/metrics after a kill+restart cycle driven by testing/faults.py)."""
+
+import json
+import os
+import sys
+import urllib.request
+
+import pytest
+
+from heatmap_tpu.obs import Registry, SupervisorChannel, TraceRing
+from heatmap_tpu.obs.registry import render_flat_counters
+
+
+# ------------------------------------------------------------ registry
+def test_counter_gauge_semantics():
+    r = Registry()
+    c = r.counter("x_total", "help text")
+    c.inc()
+    c.inc(5)
+    assert c.value == 6
+    with pytest.raises(ValueError):
+        c.inc(-1)  # counters are monotonic
+    g = r.gauge("g", "")
+    g.set(3.5)
+    g.inc()
+    assert g.value == 4.5
+    # callback-backed gauge reads at collect time
+    box = {"v": 7}
+    r.gauge("cb", "", fn=lambda: box["v"])
+    assert "cb 7" in r.expose_text()
+    box["v"] = 9
+    assert "cb 9" in r.expose_text()
+
+
+def test_registration_idempotent_and_type_checked():
+    r = Registry()
+    a = r.counter("dup", "")
+    assert r.counter("dup", "") is a
+    with pytest.raises(ValueError):
+        r.gauge("dup", "")  # same name, different type
+    lab = r.counter("lab", "", labels=("k",))
+    with pytest.raises(ValueError):
+        r.counter("lab", "")  # same name, different labelset
+
+
+def test_labels_children_independent():
+    r = Registry()
+    fam = r.counter("reqs", "", labels=("code",))
+    fam.labels(code="200").inc(2)
+    fam.labels(code="500").inc()
+    assert fam.labels(code="200").value == 2
+    assert fam.labels(code="500").value == 1
+    with pytest.raises(ValueError):
+        fam.labels(wrong="x")
+    with pytest.raises(ValueError):
+        fam.inc()  # labeled family needs .labels()
+
+
+def test_histogram_bucketing():
+    r = Registry()
+    h = r.histogram("lat", "", buckets=(0.1, 0.5, 1.0))
+    for v in (0.05, 0.1, 0.3, 0.7, 2.0):
+        h.observe(v)
+    # le semantics: 0.1 lands in the 0.1 bucket, 2.0 in +Inf
+    assert h.count == 5
+    assert h.sum == pytest.approx(3.15)
+    child = h._solo()
+    assert child.bucket_counts == [2, 1, 1, 1]
+    # recent-window quantile matches the legacy Percentiles pick rule
+    assert h.quantile(0.5) == 0.3
+    assert h.quantile(0.0) == 0.05
+
+
+def test_histogram_exposition_invariants():
+    r = Registry()
+    h = r.histogram("t", "seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    txt = r.expose_text()
+    lines = txt.splitlines()
+    assert "# TYPE t histogram" in lines
+    assert 't_bucket{le="0.1"} 1' in lines
+    assert 't_bucket{le="1"} 2' in lines      # cumulative
+    assert 't_bucket{le="+Inf"} 3' in lines
+    assert "t_count 3" in lines
+    assert any(ln.startswith("t_sum ") for ln in lines)
+    # every sample line parses as "name{labels} value"
+    for ln in lines:
+        if ln.startswith("#"):
+            continue
+        name, val = ln.rsplit(" ", 1)
+        float(val)
+
+
+def test_label_escaping():
+    r = Registry()
+    fam = r.gauge("g", "", labels=("k",))
+    fam.labels(k='a"b\\c\nd').set(1)
+    txt = r.expose_text()
+    assert 'k="a\\"b\\\\c\\nd"' in txt
+
+
+def test_render_flat_counters():
+    lines = render_flat_counters(
+        {"events_valid": 10, "state_capacity_per_shard": 256,
+         "weird-name!": 1, "skipme": "str"},
+        prefix="heatmap_",
+        gauge_names=frozenset({"state_capacity_per_shard"}))
+    joined = "\n".join(lines)
+    assert "heatmap_events_valid_total 10" in joined
+    assert "# TYPE heatmap_events_valid_total counter" in joined
+    assert "heatmap_state_capacity_per_shard 256" in joined
+    assert "# TYPE heatmap_state_capacity_per_shard gauge" in joined
+    assert "heatmap_weird_name__total 1" in joined  # sanitized
+    assert "skipme" not in joined                   # non-numeric dropped
+
+
+# ------------------------------------------------------------ tracebuf
+def test_trace_ring_bounded_and_ordered(tmp_path):
+    jl = tmp_path / "trace.jsonl"
+    ring = TraceRing(capacity=4, jsonl_path=str(jl))
+    for i in range(10):
+        ring.record(i, 0.001 * i, {"poll": 0.0001}, n_events=i)
+    assert len(ring) == 4
+    recent = ring.recent(10)
+    assert [r["epoch"] for r in recent] == [9, 8, 7, 6]  # newest first
+    assert recent[0]["spans_ms"] == {"poll": 0.1}
+    # JSONL export got EVERY record, not just the surviving window
+    ring.close()
+    rows = [json.loads(ln) for ln in open(jl)]
+    assert [r["epoch"] for r in rows] == list(range(10))
+    assert rows[3]["n_events"] == 3
+
+
+def test_trace_ring_jsonl_errors_never_raise(tmp_path):
+    ring = TraceRing(capacity=2,
+                     jsonl_path=str(tmp_path / "no" / "dir" / "t.jsonl"))
+    ring.record(0, 0.001, {})  # unwritable path: logged, not raised
+    assert ring.recent(1)[0]["epoch"] == 0
+
+
+# ------------------------------------------------------------ xproc
+def test_channel_roundtrip_and_resume(tmp_path):
+    path = str(tmp_path / "chan")
+    ch = SupervisorChannel(path)
+    ch.note_failure("exit code 1")
+    ch.note_failure("stall: no heartbeat for >8.0s", stalled=True)
+    ch.update(restarts_total=2, child_running=1)
+    d = SupervisorChannel.load(path)
+    assert d["failures_total"] == 2
+    assert d["stalls_total"] == 1
+    assert d["last_reason"].startswith("stall")
+    # a restarted supervisor resumes the persisted totals
+    ch2 = SupervisorChannel(path).resume()
+    assert ch2.state["failures_total"] == 2
+    assert ch2.state["restarts_total"] == 2
+    m = SupervisorChannel.metrics_from(path)
+    assert m["recent_failures"] == 2
+    assert m["failures_total"] == 2
+
+
+def test_channel_corrupt_and_missing_files(tmp_path):
+    assert SupervisorChannel.load(str(tmp_path / "nope")) == {}
+    assert SupervisorChannel.metrics_from(None) == {}
+    bad = tmp_path / "bad"
+    bad.write_text("{not json")
+    assert SupervisorChannel.load(str(bad)) == {}
+
+
+# A supervised child that dies once via testing/faults.py (the injected
+# source crash IS the simulated kill), then exits cleanly on relaunch.
+# No jax import: faults/source are host-only modules, so the cycle runs
+# in well under a second.
+_CRASHING_CHILD = """
+import os, sys
+sys.path.insert(0, os.environ["REPO_ROOT"])
+from heatmap_tpu.stream.source import MemorySource
+from heatmap_tpu.testing.faults import CrashingSource, InjectedCrash
+marker = os.environ["LAUNCH_MARKER"]
+first = not os.path.exists(marker)
+open(marker, "a").write("x")
+src = CrashingSource(MemorySource([{"a": 1}]), crash_after_polls=0 if first else 99)
+try:
+    src.poll(16)
+except InjectedCrash:
+    sys.exit(1)   # the simulated kill
+sys.exit(0)
+"""
+
+
+def test_supervisor_channel_survives_child_kill(tmp_path):
+    """The acceptance cycle: child killed (InjectedCrash via
+    testing/faults.py) -> supervisor restarts it -> the channel the
+    CHILD's env points at reports the restart, and a /metrics scrape
+    of a server in the child's place exposes supervisor_* series."""
+    from heatmap_tpu.config import load_config
+    from heatmap_tpu.obs import ENV_CHANNEL
+    from heatmap_tpu.serve import start_background
+    from heatmap_tpu.sink import MemoryStore
+    from heatmap_tpu.stream.supervisor import RestartPolicy, Supervisor
+
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        os.pardir))
+    sup = Supervisor(
+        [sys.executable, "-c", _CRASHING_CHILD],
+        RestartPolicy(max_restarts=5, backoff_s=0.05, backoff_max_s=0.1,
+                      term_grace_s=1.0, window_s=60.0),
+        env={**os.environ, "REPO_ROOT": repo,
+             "LAUNCH_MARKER": str(tmp_path / "marker"),
+             "PYTHONPATH": ""},
+        heartbeat_path=str(tmp_path / "hb"), poll_s=0.02,
+        channel_path=str(tmp_path / "chan"))
+    assert sup.run() == 0
+    assert sup.restarts == 1
+
+    d = SupervisorChannel.load(sup.channel.path)
+    assert d["restarts_total"] == 1
+    assert d["failures_total"] == 1
+    assert d["child_running"] == 0  # clean exit recorded
+    assert d["last_reason"] == "exit code 1"
+
+    # what the child's own /metrics would scrape: the env var the
+    # supervisor sets points at the channel, and the serving layer
+    # merges it
+    os.environ[ENV_CHANNEL] = sup.channel.path
+    try:
+        httpd, _t, port = start_background(
+            MemoryStore(), load_config({}, serve_port=0), port=0)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                txt = r.read().decode()
+            assert "heatmap_supervisor_restarts_total 1" in txt
+            assert "heatmap_supervisor_failures_total 1" in txt
+            assert "heatmap_supervisor_child_running 0" in txt
+        finally:
+            httpd.shutdown()
+    finally:
+        del os.environ[ENV_CHANNEL]

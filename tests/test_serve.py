@@ -100,8 +100,12 @@ def test_index_and_health_and_metrics(server):
     assert "leaflet" in html.lower()
     assert "/api/tiles/latest" in html
     assert "/api/positions/latest" in html
-    assert get_json(server + "/healthz") == {"ok": True}
-    assert get_json(server + "/metrics") == {}  # no runtime attached
+    hz = get_json(server + "/healthz")
+    assert hz["ok"] is True and hz["status"] == "ok"
+    assert get_json(server + "/metrics.json") == {}  # no runtime attached
+    with urllib.request.urlopen(server + "/metrics", timeout=10) as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+    assert get_json(server + "/trace/recent") == {"traces": []}
     with pytest.raises(urllib.error.HTTPError):
         urllib.request.urlopen(server + "/nope", timeout=10)
 
@@ -225,7 +229,7 @@ def test_gzip_negotiation():
 
     cap3, body3 = req("/healthz", accept_gzip=True)  # tiny: identity
     assert "Content-Encoding" not in cap3["headers"]
-    assert _json.loads(body3) == {"ok": True}
+    assert _json.loads(body3)["ok"] is True
 
 
 def test_gzip_qvalue_refusal():
@@ -361,11 +365,18 @@ def test_metrics_reports_resolved_policies(tmp_path):
     try:
         httpd, _t2, port = start_background(st, cfg, runtime=rt)
         try:
-            m = get_json(f"http://127.0.0.1:{port}/metrics")
+            m = get_json(f"http://127.0.0.1:{port}/metrics.json")
             assert m["policy_snap_impl"] in ("native", "xla", "pallas")
             assert m["policy_emit_pull"] in ("full", "prefix")
             assert m["policy_merge_banked"] in (None, "sort", "rank",
                                                 "probe")
+            # the same policies ride the Prometheus exposition as an
+            # info-style gauge
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                txt = r.read().decode()
+            assert "heatmap_policy_info{" in txt
+            assert f'snap_impl="{m["policy_snap_impl"]}"' in txt
         finally:
             httpd.shutdown()
     finally:
@@ -419,3 +430,175 @@ def test_fast_tiles_json_grid_filter_byte_identical(store):
     for grid in ("h3r7", "h3r8", "h3r9"):
         assert (tiles_feature_collection_json(store, grid)
                 == json.dumps(tiles_feature_collection(store, grid))), grid
+
+
+# ---------------------------------------------------------------- obs
+def _mini_runtime(tmpdir, events=32, batch=16):
+    """A tiny real runtime, run to exhaustion (closed), with its metrics
+    intact for the serving layer."""
+    import tempfile
+    import time as _t
+
+    from heatmap_tpu.sink import MemoryStore as _MS
+    from heatmap_tpu.stream import MicroBatchRuntime
+    from heatmap_tpu.stream.source import MemorySource
+
+    t0 = int(_t.time()) - 5  # recent: keeps the freshness SLO green
+    evs = [{"provider": "p", "vehicleId": f"v{i}", "lat": 42.0 + i * 1e-4,
+            "lon": -71.0, "speedKmh": 1.0, "ts": t0} for i in range(events)]
+    cfg = load_config({}, batch_size=batch, state_capacity_log2=8,
+                      speed_hist_bins=4, store="memory", serve_port=0,
+                      checkpoint_dir=tempfile.mkdtemp(dir=tmpdir))
+    src = MemorySource(evs)
+    src.finish()
+    st = _MS()
+    rt = MicroBatchRuntime(cfg, src, st, checkpoint_every=0)
+    rt.run()
+    return cfg, st, rt
+
+
+def _parse_prom(text):
+    """Minimal Prometheus text-format parser: {series_name: {labels_str:
+    value}} plus {name: type}.  Raises on malformed lines, duplicate
+    TYPE declarations, and duplicate samples — the things the real
+    Prometheus parser rejects — so using it IS the format check."""
+    series, types = {}, {}
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(" ", 3)
+            assert name not in types, f"duplicate TYPE for {name}"
+            types[name] = mtype
+            continue
+        if line.startswith("#"):
+            continue
+        name_labels, value = line.rsplit(" ", 1)
+        float(value)  # must parse
+        if "{" in name_labels:
+            name, _, rest = name_labels.partition("{")
+            labels = rest.rstrip("}")
+        else:
+            name, labels = name_labels, ""
+        assert labels not in series.get(name, {}), (
+            f"duplicate sample {name}{{{labels}}}")
+        series.setdefault(name, {})[labels] = float(value)
+    return series, types
+
+
+def test_metrics_prometheus_exposition(tmp_path):
+    """/metrics is valid text exposition with counter, gauge, and
+    histogram (_bucket/_sum/_count) series whose invariants hold."""
+    cfg, st, rt = _mini_runtime(str(tmp_path))
+    httpd, _t, port = start_background(st, cfg, runtime=rt, port=0)
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                    timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            txt = r.read().decode()
+        series, types = _parse_prom(txt)
+        assert types["heatmap_batch_latency_seconds"] == "histogram"
+        assert types["heatmap_events_valid_total"] == "counter"
+        assert types["heatmap_state_capacity_rows"] == "gauge"
+        # histogram invariants: buckets cumulative and monotone, +Inf
+        # bucket == _count, _sum present
+        buckets = series["heatmap_batch_latency_seconds_bucket"]
+        bounds = sorted(buckets.items(),
+                        key=lambda kv: float(kv[0].split('"')[1])
+                        if "+Inf" not in kv[0] else float("inf"))
+        vals = [v for _, v in bounds]
+        assert vals == sorted(vals)
+        count = series["heatmap_batch_latency_seconds_count"][""]
+        assert buckets['le="+Inf"'] == count > 0
+        assert "heatmap_batch_latency_seconds_sum" in series
+        # per-span histogram labels
+        assert any('span="poll"' in k for k in
+                   series["heatmap_batch_span_seconds_bucket"])
+        # counters conserve: 32 events through a 16-batch
+        assert series["heatmap_events_valid_total"][""] == 32
+        # /metrics.json still carries every historical key
+        mj = get_json(f"http://127.0.0.1:{port}/metrics.json")
+        for k in ("events_valid", "uptime_s", "events_per_sec",
+                  "batch_latency_p50_ms", "batch_latency_p95_ms",
+                  "tiles_written", "positions_written", "sink_retries"):
+            assert k in mj, k
+    finally:
+        httpd.shutdown()
+
+
+def test_trace_recent_records(tmp_path):
+    cfg, st, rt = _mini_runtime(str(tmp_path), events=48, batch=16)
+    httpd, _t, port = start_background(st, cfg, runtime=rt, port=0)
+    try:
+        tr = get_json(f"http://127.0.0.1:{port}/trace/recent?n=2")
+        assert len(tr["traces"]) == 2
+        newest = tr["traces"][0]
+        assert newest["epoch"] > tr["traces"][1]["epoch"]
+        assert set(newest) >= {"epoch", "t_wall", "latency_ms", "spans_ms",
+                               "n_events", "n_late", "overflow_groups"}
+        assert set(newest["spans_ms"]) >= {"poll", "build", "device",
+                                           "sink_submit"}
+    finally:
+        httpd.shutdown()
+
+
+def test_healthz_slo_transitions(tmp_path, monkeypatch):
+    """ok with sane budgets; degraded once the (real, observed) batch
+    p50 exceeds an absurdly tight budget; down (503) when the sink is
+    poisoned."""
+    cfg, st, rt = _mini_runtime(str(tmp_path))
+    httpd, _t, port = start_background(st, cfg, runtime=rt, port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        # generous budget for the ok phase: with only two batches, the
+        # p50 sample IS the first-step XLA compile batch
+        monkeypatch.setenv("HEATMAP_SLO_BATCH_P50_MS", "60000")
+        hz = get_json(base + "/healthz")
+        assert hz["status"] == "ok" and hz["ok"]
+        assert hz["checks"]["batch_p50_ms"]["ok"]
+
+        monkeypatch.setenv("HEATMAP_SLO_BATCH_P50_MS", "0.000001")
+        hz = get_json(base + "/healthz")
+        assert hz["status"] == "degraded" and hz["ok"]  # still serving
+        assert not hz["checks"]["batch_p50_ms"]["ok"]
+        monkeypatch.setenv("HEATMAP_SLO_BATCH_P50_MS", "60000")
+
+        rt.writer._exc = IOError("injected")  # poisoned sink -> down
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/healthz", timeout=10)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["status"] == "down"
+        rt.writer._exc = None
+    finally:
+        httpd.shutdown()
+
+
+def test_healthz_degrades_on_supervisor_restart_rate(tmp_path,
+                                                     monkeypatch):
+    """A supervisor channel recording recent failures past the restart
+    SLO flips /healthz to degraded, and the supervisor_* series appear
+    in /metrics — without any runtime attached (the channel is
+    cross-process state)."""
+    from heatmap_tpu.obs import ENV_CHANNEL, SupervisorChannel
+
+    chan = SupervisorChannel(str(tmp_path / "chan"))
+    for _ in range(3):
+        chan.note_failure("exit code 1")
+    chan.update(restarts_total=3, child_running=1)
+    monkeypatch.setenv(ENV_CHANNEL, chan.path)
+    monkeypatch.setenv("HEATMAP_SLO_RESTARTS_PER_H", "2")
+    httpd, _t, port = start_background(MemoryStore(),
+                                       load_config({}, serve_port=0),
+                                       port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        hz = get_json(base + "/healthz")
+        assert hz["status"] == "degraded"
+        assert hz["checks"]["supervisor_restarts_1h"]["value"] == 3
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            txt = r.read().decode()
+        assert "heatmap_supervisor_restarts_total 3" in txt
+        assert "heatmap_supervisor_failures_total 3" in txt
+        # under the rate budget it is ok again
+        monkeypatch.setenv("HEATMAP_SLO_RESTARTS_PER_H", "10")
+        assert get_json(base + "/healthz")["status"] == "ok"
+    finally:
+        httpd.shutdown()

@@ -124,6 +124,7 @@ def test_positions_monotonic(tmp_path):
     assert pos[0]["loc"]["coordinates"][1] == pytest.approx(42.35, abs=1e-4)
 
 
+@pytest.mark.slow  # tier-1 budget: see pyproject markers
 def test_multi_res_multi_window(tmp_path):
     cfg = mk_cfg(tmp_path, resolutions=(7, 8), windows_minutes=(1, 5))
     store = MemoryStore()
@@ -495,6 +496,7 @@ def test_resume_refuses_shard_count_change(tmp_path):
         MicroBatchRuntime(cfg, src2, MemoryStore(), checkpoint_every=0)
 
 
+@pytest.mark.slow  # tier-1 budget: see pyproject markers
 def test_end_to_end_per_cell_differential(tmp_path):
     """Exact per-(grid, cell, window) counts and speed sums vs a
     host-side oracle built straight from the events with hexgrid's host

@@ -1,12 +1,13 @@
 """Microbench the native C++ H3 snap: total ns/pt, scalar-vs-block,
-and a sincos-share estimate (the block path's trig runs scalar libm —
-tools/bench_snap_native.py quantifies how much of the budget that is).
+and a sincos-share estimate.  The block path now computes sin/cos with
+a vectorized polynomial (h3_snap.cpp vsincos); the sincos timings below
+quantify the former scalar-libm share that motivated vectorizing it —
+keep them as the comparison baseline when re-tuning.
 
 Run on an otherwise idle host; numbers feed the CPU-headline work
 (CPU_HEADLINE_BANK.json) where the snap is the top term at ~195 ns/pt.
 """
 import ctypes
-import ctypes.util
 import os
 import sys
 import time

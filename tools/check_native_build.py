@@ -1,0 +1,56 @@
+"""Fail loudly when the native C++ components do not compile.
+
+The native library (heatmap_tpu/native/*.cpp) builds lazily on first
+use and, on ANY compile error, silently degrades to the Python
+fallbacks with nothing but a warning — which is right for production
+resilience and wrong for CI: a broken .cpp can sit unnoticed while the
+decoder/tile-ops/kafka-codec/h3-snap fast paths (and every test guarded
+by ``native available()``) quietly stop running.  This check makes the
+failure mode impossible to miss: it attempts the exact lazy build and
+exits non-zero with the compiler's stderr on failure.
+
+Usage: ``python tools/check_native_build.py`` — run it in CI next to
+the test suite, and locally after touching any native source.
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def main() -> int:
+    # a throwaway cache dir forces a REAL compile even when a cached .so
+    # for the current source hash exists
+    with tempfile.TemporaryDirectory(prefix="native-check-") as tmp:
+        os.environ["HEATMAP_NATIVE_CACHE"] = tmp
+        from heatmap_tpu import native
+
+        try:
+            so_path = native._build_lib()
+        except FileNotFoundError as e:
+            print(f"SKIP: no C++ toolchain available ({e})")
+            # no compiler is an environment property, not a source
+            # regression — don't fail CI images without g++
+            return 0
+        except subprocess.CalledProcessError as e:
+            print("FAIL: native build broken:", file=sys.stderr)
+            print(" ".join(e.cmd), file=sys.stderr)
+            stderr = e.stderr.decode(errors="replace") if e.stderr else ""
+            print(stderr[-8000:], file=sys.stderr)
+            return 1
+        # the compiled library must also load and export every symbol
+        # the Python bindings bind (a link-time break would otherwise
+        # surface as the same silent fallback)
+        if native._load() is None:
+            print(f"FAIL: built {so_path} but load failed: "
+                  f"{native._LIB_ERR}", file=sys.stderr)
+            return 1
+        print(f"OK: native library builds and loads ({so_path})")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
