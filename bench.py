@@ -118,8 +118,38 @@ def _ensure_device() -> None:
                   file=sys.stderr)
         if k + 1 < attempts:
             time.sleep(backoff_s)
-    print(f"# accelerator unreachable after {attempts} attempts; "
-          "falling back to CPU", file=sys.stderr)
+    # The relay flaps on a minutes timescale (tools/hw_burst.py watches
+    # it for exactly this reason): before surrendering the round to a
+    # CPU-fallback headline (the r5 4.5x scorecard flap), spend a
+    # BOUNDED extra budget waiting for an uptime window — cheap TCP
+    # probes with backoff, and one full subprocess probe whenever the
+    # port answers.  BENCH_RELAY_WAIT_S tunes the budget (default 120 s;
+    # 0 disables and falls back immediately, the old behavior).
+    budget_s = float(os.environ.get("BENCH_RELAY_WAIT_S", "120"))
+    t0 = time.monotonic()
+    poll_s = 2.0
+    while time.monotonic() - t0 < budget_s:
+        state = _tunnel_state(addr)
+        if state == "open":
+            left = budget_s - (time.monotonic() - t0)
+            print(f"# relay window: {addr} answers; re-probing "
+                  f"({left:.0f}s of wait budget left)", file=sys.stderr)
+            try:
+                r = subprocess.run([sys.executable, "-c", probe_src],
+                                   capture_output=True, text=True,
+                                   timeout=max(15.0, min(timeout_s, left)))
+            except subprocess.TimeoutExpired:
+                pass
+            else:
+                if "PROBE_OK" in (r.stdout or ""):
+                    print(f"# relay wait paid off: {r.stdout.strip()}",
+                          file=sys.stderr)
+                    return
+        time.sleep(min(poll_s, max(0.0, budget_s - (time.monotonic() - t0))))
+        poll_s = min(poll_s * 2, 15.0)  # bounded backoff
+    print(f"# accelerator unreachable after {attempts} attempts + "
+          f"{budget_s:.0f}s relay wait; falling back to CPU",
+          file=sys.stderr)
     _fallback_reexec()
 
 
@@ -682,6 +712,14 @@ def main() -> dict:
                   f"count+avg+p95 update-mode emits)",
         "value": round(eps, 1),
         "unit": "events/sec",
+        # which path ACTUALLY produced `value` — the r5 scorecard flap
+        # was a CPU-fallback number with nothing in the artifact saying
+        # so at the headline level.  "hw" = measured on an accelerator;
+        # "cpu" = the CPU backend (with `fallback` saying whether that
+        # was a choice or a dead-relay surrender).
+        "backend_path": "cpu" if dev.platform == "cpu" else "hw",
+        "backend_device": f"{dev.platform} {dev.device_kind}",
+        "backend_fallback": bool(os.environ.get("BENCH_DEVICE_FALLBACK")),
         # vs_baseline is the harness contract key; the reference publishes
         # no measured numbers (BASELINE.md §methodology), so the
         # denominator is the DESIGN TARGET — 5M ev/s on v5e-4

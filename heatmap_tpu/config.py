@@ -97,6 +97,28 @@ class Config:
                                        # auto = prefix off-CPU (single-
                                        # device paths; sharded pulls stay
                                        # full)
+    emit_flush_k: int = 8              # HEATMAP_EMIT_FLUSH_K: device-
+                                       # resident emit-ring depth — packed
+                                       # emits of up to K batches stay on
+                                       # device and are pulled in ONE
+                                       # flush, amortizing the per-batch
+                                       # D2H round trip (ruinous on
+                                       # remote-attached chips).  Flush is
+                                       # forced before checkpoints, on
+                                       # idle polls, at close, and under
+                                       # watermark/growth pressure, so
+                                       # sink semantics and replay
+                                       # equivalence are unchanged.  1 =
+                                       # per-batch pull (the pre-ring
+                                       # behavior); multi-host runs force
+                                       # 1 (lockstep accounting).
+    prefetch_batches: int = 1          # HEATMAP_PREFETCH_BATCHES: batches
+                                       # the runtime polls/pads/transfers
+                                       # AHEAD of the fold so the H2D feed
+                                       # overlaps device compute (double
+                                       # buffering).  0 disables; multi-
+                                       # host runs force 0 (the lockstep
+                                       # collectives pin poll ordering).
 
     @property
     def tile_seconds(self) -> int:
@@ -162,6 +184,9 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         store=e.get("HEATMAP_STORE", Config.store),
         emit_pull=e.get("HEATMAP_EMIT_PULL", Config.emit_pull),
         grow_margin=e.get("HEATMAP_GROW_MARGIN", Config.grow_margin),
+        emit_flush_k=_int(e, "HEATMAP_EMIT_FLUSH_K", Config.emit_flush_k),
+        prefetch_batches=_int(e, "HEATMAP_PREFETCH_BATCHES",
+                              Config.prefetch_batches),
     )
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
@@ -182,4 +207,11 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         raise ValueError(
             f"HEATMAP_EMIT_PULL must be auto|full|prefix, "
             f"got {cfg.emit_pull!r}")
+    if cfg.emit_flush_k < 1:
+        raise ValueError(
+            f"HEATMAP_EMIT_FLUSH_K must be >= 1, got {cfg.emit_flush_k}")
+    if not (0 <= cfg.prefetch_batches <= 32):
+        raise ValueError(
+            f"HEATMAP_PREFETCH_BATCHES must be in 0..32, "
+            f"got {cfg.prefetch_batches}")
     return cfg

@@ -13,7 +13,8 @@ import jax.numpy as jnp
 
 from heatmap_tpu.engine.state import (TileState, donate_state_argnums,
                                       init_state)
-from heatmap_tpu.engine.step import AggParams, aggregate_batch, pack_emit
+from heatmap_tpu.engine.step import (AggParams, aggregate_batch, pack_emit,
+                                     ride_stats)
 
 
 class SingleAggregator:
@@ -42,6 +43,16 @@ class SingleAggregator:
         self._step_packed = jax.jit(
             _step_packed, donate_argnums=donate_state_argnums())
 
+        def _step_ride(state, lat, lng, speed, ts, valid, cutoff):
+            state, emit, stats = aggregate_batch(
+                state, lat, lng, speed, ts, valid, cutoff, self.params
+            )
+            return state, ride_stats(
+                pack_emit(emit, self.params.speed_hist_max), stats)
+
+        self._step_ride = jax.jit(
+            _step_ride, donate_argnums=donate_state_argnums())
+
     def step(self, lat_rad, lng_rad, speed, ts, valid, watermark_cutoff):
         self.state, emit, stats = self._step(
             self.state,
@@ -67,6 +78,22 @@ class SingleAggregator:
             jnp.int32(watermark_cutoff),
         )
         return packed, stats
+
+    def step_packed_ride(self, lat_rad, lng_rad, speed, ts, valid,
+                         watermark_cutoff):
+        """Like step_packed, but the StepStats ride the packed head row
+        (engine.step.ride_stats) so the WHOLE batch output is one device
+        array — the shape engine.step.EmitRing accumulates and
+        ``stats_from_packed`` decodes (parity with MultiAggregator /
+        ShardedAggregator).  Returns the (E+1, 13) packed matrix on
+        device."""
+        self.state, packed = self._step_ride(
+            self.state,
+            jnp.asarray(lat_rad), jnp.asarray(lng_rad), jnp.asarray(speed),
+            jnp.asarray(ts), jnp.asarray(valid),
+            jnp.int32(watermark_cutoff),
+        )
+        return packed
 
     def emit_to_host(self, emit) -> dict:
         """Emit leaves as host numpy (API parity with ShardedAggregator)."""

@@ -1203,3 +1203,78 @@ def pull_emit_prefix(packed):
     """Live-prefix pull of ONE packed emit matrix ((E+1, L) uint32) —
     the single-block view of ``pull_packed_stack``."""
     return pull_packed_stack(packed[None], prefix=True)[0]
+
+
+class EmitRing:
+    """Fixed-capacity accumulator of DEVICE-RESIDENT packed emits.
+
+    Each ``append`` parks one batch's stacked packed-emit matrix
+    ((P, E+1, L) uint32, stats ridden in the head rows) on device; a
+    ``flush_stacked`` concatenates every parked batch in ONE eager device
+    op and crosses the device->host link with a single
+    ``pull_packed_stack`` call — so K batches pay one pull's round trips
+    instead of K (the per-batch pull over the ~200 KB/s tunnel dominated
+    the fused hex_pyramid/multi_window pipelines, VERDICT r5 §3).  While
+    entries sit in the ring the device runs ahead unforced: nothing
+    synchronizes on batch k's fold until the flush that covers it.
+
+    Entries must share one shape — the owner flushes before any slab /
+    emit-capacity resize (``append`` refuses a mismatched shape loudly
+    rather than corrupting the stack).  ``take`` hands the raw entries
+    back un-pulled for callers with their own transfer discipline (the
+    sharded path pulls addressable shards per entry).
+    """
+
+    def __init__(self, capacity: int):
+        self.capacity = max(1, int(capacity))
+        self._entries: list = []      # (packed_device, tag) append order
+        self.n_flushes = 0            # pulls issued (telemetry)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def full(self) -> bool:
+        return len(self._entries) >= self.capacity
+
+    def append(self, packed, tag=None) -> bool:
+        """Park one batch's packed emits; True when the ring is full
+        (flush before the next append)."""
+        if self._entries and tuple(packed.shape) != tuple(
+                self._entries[0][0].shape):
+            raise ValueError(
+                f"emit ring entries must share one shape "
+                f"(got {tuple(packed.shape)} vs "
+                f"{tuple(self._entries[0][0].shape)}); flush before a "
+                f"slab/emit-capacity resize")
+        self._entries.append((packed, tag))
+        return self.full
+
+    def take(self) -> list:
+        """Drain the raw (packed, tag) entries without pulling."""
+        entries, self._entries = self._entries, []
+        if entries:
+            self.n_flushes += 1
+        return entries
+
+    def flush_stacked(self, prefix: bool) -> list:
+        """Pull every parked batch in one transfer.
+
+        Returns [(bufs, tag)] in append order, where ``bufs`` is the
+        per-pair list of host matrices ``pull_packed_stack`` would have
+        produced for that batch alone — consumers (unpack_emit,
+        stats_from_packed, packed_tile_docs) are unchanged.
+        """
+        entries = self.take()
+        if not entries:
+            return []
+        if len(entries) == 1:
+            packed, tag = entries[0]
+            return [(pull_packed_stack(packed, prefix), tag)]
+        import jax.numpy as jnp
+
+        n_pairs = entries[0][0].shape[0]
+        blocks = jnp.concatenate([p for p, _ in entries], axis=0)
+        bufs = pull_packed_stack(blocks, prefix)
+        return [(bufs[i * n_pairs:(i + 1) * n_pairs], tag)
+                for i, (_, tag) in enumerate(entries)]

@@ -36,6 +36,12 @@ class AsyncWriter:
         self._written_tiles = 0
         self._written_positions = 0
         self._retried = 0
+        # wall spent BLOCKED on a full queue at submit time.  The emit
+        # ring hands the writer up to K batches of packed bodies in one
+        # flush; if the store can't absorb the burst, the step thread
+        # stalls HERE — this counter makes that visible at /metrics
+        # (vs. a mystery gap in the batch spans).
+        self._backpressure_s = 0.0
         if metrics is not None:
             # queue depth read at scrape time (callback gauge) — a deep
             # queue means the sink can't keep up with the device step;
@@ -115,28 +121,39 @@ class AsyncWriter:
         if self._exc is not None:
             raise RuntimeError("async sink write failed") from self._exc
 
+    def _put(self, item) -> None:
+        """Enqueue, booking any time spent blocked on a full queue."""
+        try:
+            self._q.put_nowait(item)
+            return
+        except queue.Full:
+            pass
+        t0 = time.monotonic()
+        self._q.put(item)
+        self._backpressure_s += time.monotonic() - t0
+
     def submit_tiles(self, docs: Sequence[dict]) -> None:
         self._check()
         if docs:
-            self._q.put(("tiles", docs))
+            self._put(("tiles", docs))
 
     def submit_tiles_packed(self, body, meta) -> None:
         """Packed emit body rows + TilePackMeta; the store-side encode
         (C++ when available) runs on this writer thread, overlapping the
         next batch's device step."""
         self._check()
-        self._q.put(("tiles_packed", (body, meta)))
+        self._put(("tiles_packed", (body, meta)))
 
     def submit_positions_packed(self, rows) -> None:
         """Columnar changed-vehicle rows (sink.base.PositionRows)."""
         self._check()
         if len(rows.ts_ms):
-            self._q.put(("positions_packed", rows))
+            self._put(("positions_packed", rows))
 
     def submit_positions(self, docs: Sequence[dict]) -> None:
         self._check()
         if docs:
-            self._q.put(("positions", docs))
+            self._put(("positions", docs))
 
     def drain(self) -> None:
         """Block until every submitted write has been applied."""
@@ -155,4 +172,5 @@ class AsyncWriter:
     def counters(self) -> dict:
         return {"tiles_written": self._written_tiles,
                 "positions_written": self._written_positions,
-                "sink_retries": self._retried}
+                "sink_retries": self._retried,
+                "sink_backpressure_ms": int(self._backpressure_s * 1e3)}

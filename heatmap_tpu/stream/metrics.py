@@ -82,7 +82,10 @@ class Metrics:
         if self.freshness.samples:
             out["freshness_p50_s"] = round(self.freshness.quantile(0.5), 3)
             out["freshness_p95_s"] = round(self.freshness.quantile(0.95), 3)
-        for k, p in self.spans.items():
+        # list() snapshot: observe_batch (step thread) inserts new span
+        # keys mid-run (conditional sub-spans like poll_wait appear on
+        # first observation) while scrapes iterate from the HTTP thread
+        for k, p in list(self.spans.items()):
             out[f"span_{k}_p50_ms"] = round(p.quantile(0.5) * 1e3, 3)
         return out
 

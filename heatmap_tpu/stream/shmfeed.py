@@ -221,6 +221,16 @@ class ShmFeederSource(Source):
         self._providers: list[str] = []
         self._vehicles: list[str] = []
         self.n_dropped_total = 0
+        # poll sub-spans (Source.take_spans): wall spent WAITING on the
+        # feeder process (full_q) vs copying slot lanes out of the shm
+        # ring — a big "wait" means the feeder can't keep up (or shares
+        # the core), a big "decode" means the slot memcpy itself costs
+        self._spans = {"wait": 0.0, "decode": 0.0}
+
+    def take_spans(self):
+        out = {k: v for k, v in self._spans.items() if v > 0.0}
+        self._spans = {"wait": 0.0, "decode": 0.0}
+        return out
 
     # ------------------------------------------------------------- source
     def poll(self, max_events: int):
@@ -235,10 +245,13 @@ class ShmFeederSource(Source):
         parts: list[dict] = []
         while True:
             timeout = max(0.05, deadline - time.monotonic())
+            t_wait = time.monotonic()
             try:
                 (slot, n, gen, final, off, pd, vd,
                  dropped) = self._full_q.get(timeout=timeout)
+                self._spans["wait"] += time.monotonic() - t_wait
             except queue_mod.Empty:
+                self._spans["wait"] += time.monotonic() - t_wait
                 if parts:  # mid-assembly: the final slice is coming
                     deadline = time.monotonic() + 1.0
                     continue
@@ -259,19 +272,23 @@ class ShmFeederSource(Source):
                     continue  # stray empty meta between slices
                 self._offset = off
                 return empty_columns(self._providers, self._vehicles)
+            t_copy = time.monotonic()
             v = self._views[slot]
             parts.append({name: v[name][:n].copy()
                           for name, _dt in _LANES})
             self._free_q.put(slot)
+            self._spans["decode"] += time.monotonic() - t_copy
             if not final:
                 continue
             self._offset = off
             self.n_dropped_total += dropped
+            t_copy = time.monotonic()
             if len(parts) == 1:
                 lanes = parts[0]
             else:
                 lanes = {name: np.concatenate([p[name] for p in parts])
                          for name, _dt in _LANES}
+            self._spans["decode"] += time.monotonic() - t_copy
             return EventColumns(**lanes, providers=self._providers,
                                 vehicles=self._vehicles,
                                 n_dropped=dropped)

@@ -49,6 +49,16 @@ class Source(abc.ABC):
         no transport report nothing."""
         return {}
 
+    def take_spans(self) -> dict:
+        """Sub-span seconds accumulated inside poll() since the last
+        call — e.g. {"fetch": ..., "decode": ...} — and reset.  The
+        runtime folds them into the per-batch span histograms
+        (heatmap_batch_span_seconds{span="poll_fetch"|...}) so a feed
+        wall is diagnosable from /metrics: wire-fetch-bound vs
+        decode-bound vs feeder-wait-bound.  Sources with no meaningful
+        split report nothing."""
+        return {}
+
     def close(self) -> None:
         pass
 
@@ -301,6 +311,10 @@ class KafkaSource(Source):
     def counters(self) -> dict:
         return dict(getattr(self._impl, "counters", None) or {})
 
+    def take_spans(self) -> dict:
+        fn = getattr(self._impl, "take_spans", None)
+        return fn() if fn is not None else {}
+
     def close(self) -> None:
         self._impl.close()
 
@@ -478,6 +492,14 @@ class _WireImpl:
         # format/impl knobs.
         self.fetch_max_bytes = int(os.environ.get(
             "HEATMAP_FETCH_MAX_BYTES", str(4 << 20)))
+        # poll sub-spans (Source.take_spans): wall spent in broker fetch
+        # round trips vs value decode, drained by the runtime per batch
+        self._spans = {"fetch": 0.0, "decode": 0.0}
+
+    def take_spans(self) -> dict:
+        out = {k: v for k, v in self._spans.items() if v > 0.0}
+        self._spans = {"fetch": 0.0, "decode": 0.0}
+        return out
 
     def _discover(self) -> None:
         """(Re)initialize offsets for newly visible partitions at LATEST.
@@ -499,6 +521,7 @@ class _WireImpl:
         from heatmap_tpu.kafka import KafkaError
         from heatmap_tpu.kafka.client import EARLIEST
 
+        t0 = _time.monotonic()
         try:
             return fn()
         except KafkaError as e:
@@ -520,6 +543,8 @@ class _WireImpl:
         except (ConnectionError, OSError) as e:
             self.counters["kafka_fetch_errors"] += 1
             self.log.warning("fetch %s[%d]: %s", self.topic, p, e)
+        finally:
+            self._spans["fetch"] += _time.monotonic() - t0
         return None
 
     def poll(self, max_events):
@@ -606,8 +631,10 @@ class _WireImpl:
         out = []
 
         def handle(p, r):
+            t0 = _time.monotonic()
             cols = decode_batch(r.value, self._intern_p, self._intern_v,
                                 self._col_cache)
+            self._spans["decode"] += _time.monotonic() - t0
             if cols is None:
                 self.log.warning("dropping malformed columnar value at "
                                  "%s[%d]@%d", self.topic, p, r.offset)
@@ -630,8 +657,11 @@ class _WireImpl:
             return 1
 
         self._poll_record_loop(max_events, handle)
-        return _decode_raw_values(self._dec, out,
+        t0 = _time.monotonic()
+        cols = _decode_raw_values(self._dec, out,
                                   self._intern_p, self._intern_v, self._fmt)
+        self._spans["decode"] += _time.monotonic() - t0
+        return cols
 
     def _poll_columnar(self, max_events):
         """Hot path: Fetch blobs decode to joined value buffers in C++
@@ -712,11 +742,13 @@ class _WireImpl:
                 cols.n_dropped = pre_dropped
                 return cols
             return []
+        t0 = _time.monotonic()
         joined = b"".join(blobs)
         if binary:
             cols, _ = self._dec.decode_binary(joined)
         else:
             cols, _ = self._dec.decode(joined, final=True)
+        self._spans["decode"] += _time.monotonic() - t0
         cols.n_dropped += pre_dropped
         return cols
 

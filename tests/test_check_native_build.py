@@ -1,0 +1,28 @@
+"""Tier-1 guard: a broken native C++ build FAILS the suite.
+
+The native library builds lazily and, on any compile error, silently
+degrades to the Python fallbacks — right for production resilience,
+wrong for CI: a broken .cpp would quietly disable the decoder/tile-ops/
+kafka-codec/h3-snap fast paths AND skip every test gated on
+``native available()``.  tools/check_native_build.py forces a real
+compile + load + symbol bind; running it here (tier-1, not slow) turns
+the silent fallback into a red suite.  ~17 s on this host — inside the
+tier-1 budget.  A host without a C++ toolchain is an environment
+property, not a regression: the tool exits 0 with a SKIP line there.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def test_native_build_compiles_and_loads():
+    tool = os.path.join(REPO, "tools", "check_native_build.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    p = subprocess.run([sys.executable, tool], capture_output=True,
+                       text=True, timeout=280, env=env, cwd=REPO)
+    assert p.returncode == 0, (
+        f"native build check failed:\n{p.stdout}\n{p.stderr[-8000:]}")
+    assert "OK:" in p.stdout or "SKIP:" in p.stdout, p.stdout

@@ -1,0 +1,176 @@
+"""Columnar fast path vs dict path: byte-identical sink output.
+
+The tentpole's dict-free ingest lane (EventColumns straight from the
+columnar decode into pad-and-transfer) must be a pure transport change:
+for the same event stream — including invalid, late, and duplicate
+events — the store must end up with EXACTLY the docs the per-event-dict
+path produces, and the accounting (valid/invalid/late) must match.
+Validation parity between parse_events and colfmt.decode_batch is
+load-bearing here and asserted end-to-end through the full runtime.
+"""
+
+import copy
+import time
+
+import numpy as np
+
+from heatmap_tpu.config import load_config
+from heatmap_tpu.sink import MemoryStore
+from heatmap_tpu.stream import MemorySource, MicroBatchRuntime
+from heatmap_tpu.stream.colfmt import decode_batch, encode_batch
+from heatmap_tpu.stream.source import Source
+
+T_NOW = int(time.time()) - 600
+BATCH = 256
+
+
+class ColumnarReplay(Source):
+    """Replays pre-encoded colfmt batch values as EventColumns — the
+    wire KafkaSource's HEATMAP_EVENT_FORMAT=columnar decode path, minus
+    the broker (decode_batch + session intern maps + LUT cache are the
+    production objects)."""
+
+    def __init__(self, blobs):
+        self._blobs = list(blobs)
+        self._i = 0
+        self._intern_p: dict = {}
+        self._intern_v: dict = {}
+        self._cache: dict = {}
+
+    def poll(self, max_events):
+        if self._i >= len(self._blobs):
+            return []
+        cols = decode_batch(self._blobs[self._i], self._intern_p,
+                            self._intern_v, self._cache)
+        assert cols is not None, "test blobs are well-formed"
+        self._i += 1
+        return cols
+
+    def offset(self):
+        return self._i
+
+    @property
+    def exhausted(self):
+        return self._i >= len(self._blobs)
+
+
+def mk_stream():
+    """Event stream with every hazard the differential must cover.
+
+    Invalid rows use values that ENCODE into the columnar format but
+    fail row validation on BOTH paths (out-of-range lat/lon, negative
+    ts, non-finite coordinates) — parse_events and decode_batch must
+    drop the identical set.  Late rows arrive a full hour behind the
+    established watermark.  Duplicates repeat (vehicle, ts, position)
+    exactly — the positions fold must pick one winner per vehicle
+    either way.
+    """
+    rng = np.random.default_rng(11)
+
+    def ev(i, t, veh=None, lat=None, lon=None):
+        return {
+            "provider": "mbta" if i % 3 else "opensky",
+            "vehicleId": veh if veh is not None else f"veh-{i % 37}",
+            "lat": float(rng.uniform(42.3, 42.4)) if lat is None else lat,
+            "lon": float(rng.uniform(-71.1, -71.0)) if lon is None else lon,
+            "speedKmh": float(rng.uniform(0, 80)),
+            "bearing": 0.0,
+            "accuracyM": 5.0,
+            "ts": t,
+        }
+
+    out = []
+    # batch 1-2: clean traffic establishing the watermark
+    out += [ev(i, T_NOW + i % 120) for i in range(2 * BATCH)]
+    # batch 3: invalid rows interleaved with clean ones
+    bad = [
+        ev(1, T_NOW + 130, lat=95.0),            # lat out of range
+        ev(2, T_NOW + 130, lon=-200.0),          # lon out of range
+        ev(3, -5),                               # negative ts
+        ev(4, T_NOW + 130, lat=float("nan")),    # non-finite lat
+        ev(5, T_NOW + 130, lon=float("inf")),    # non-finite lon
+        ev(6, 2**31 + 10),                       # ts past epoch-int32
+    ]
+    clean3 = [ev(i, T_NOW + 130 + i % 60) for i in range(BATCH - len(bad))]
+    out += clean3 + bad
+    # batch 4: duplicates (same vehicle, ts, position repeated) + late
+    # events a full hour behind the watermark
+    dup = ev(0, T_NOW + 200, veh="veh-dup", lat=42.35, lon=-71.05)
+    out += [copy.deepcopy(dup) for _ in range(8)]
+    out += [ev(i, T_NOW - 3600) for i in range(24)]          # late
+    out += [ev(i, T_NOW + 210 + i % 30) for i in range(BATCH - 32)]
+    return out
+
+
+def run_runtime(tmp_path, src, tag):
+    cfg = load_config({}, batch_size=BATCH, state_capacity_log2=12,
+                      speed_hist_bins=8, store="memory", emit_flush_k=3,
+                      checkpoint_dir=str(tmp_path / f"ckpt-{tag}"))
+    store = MemoryStore()
+    rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=0)
+    rt.run()
+    return rt, store
+
+
+def test_columnar_and_dict_paths_byte_identical(tmp_path):
+    events = mk_stream()
+    # dict path: per-event dicts through parse_events (the reference's
+    # ingest shape)
+    src_d = MemorySource(copy.deepcopy(events))
+    src_d.finish()
+    rt_d, store_d = run_runtime(tmp_path, src_d, "dict")
+
+    # columnar path: the same events pre-encoded into colfmt batch
+    # values at the SAME batch boundaries, decoded by the production
+    # decode_batch into EventColumns (zero per-event Python)
+    blobs = [encode_batch(events[i:i + BATCH])
+             for i in range(0, len(events), BATCH)]
+    rt_c, store_c = run_runtime(tmp_path, ColumnarReplay(blobs), "col")
+
+    # accounting parity: valid/invalid/late counts identical
+    for key in ("events_valid", "events_invalid", "events_late",
+                "tiles_emitted", "positions_emitted"):
+        assert rt_d.metrics.counters.get(key, 0) == \
+            rt_c.metrics.counters.get(key, 0), key
+    assert rt_d.max_event_ts == rt_c.max_event_ts
+
+    # byte-identical sink state: same tile docs (same _ids, same counts,
+    # same f64-recombined aggregates), same positions docs
+    assert store_d._tiles.keys() == store_c._tiles.keys()
+    assert len(store_d._tiles) > 0
+    for k in store_d._tiles:
+        assert store_d._tiles[k] == store_c._tiles[k], k
+    assert store_d._positions == store_c._positions
+    assert len(store_d._positions) > 0
+
+    # and the aggregation state itself is bit-identical
+    (res, wmin), agg_d = next(iter(rt_d.aggs.items()))
+    agg_c = rt_c.aggs[(res, wmin)]
+    for a, b in zip(agg_d.state, agg_c.state):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_columnar_and_dict_paths_one_flush_interval(tmp_path):
+    """Same differential with everything inside ONE flush interval
+    (emit_flush_k larger than the batch count): the close-time flush
+    alone must deliver the identical docs."""
+    events = mk_stream()[:2 * BATCH]
+    src_d = MemorySource(copy.deepcopy(events))
+    src_d.finish()
+    cfg_kw = dict(batch_size=BATCH, state_capacity_log2=12,
+                  speed_hist_bins=8, store="memory", emit_flush_k=64)
+    stores = {}
+    for tag, src in (
+            ("dict", src_d),
+            ("col", ColumnarReplay(
+                [encode_batch(events[i:i + BATCH])
+                 for i in range(0, len(events), BATCH)]))):
+        cfg = load_config({}, checkpoint_dir=str(tmp_path / f"c2-{tag}"),
+                          **cfg_kw)
+        store = MemoryStore()
+        rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=0)
+        rt.run()
+        assert rt.metrics.counters["emit_pulls"] == 1  # close-time only
+        stores[tag] = store
+    assert stores["dict"]._tiles == stores["col"]._tiles
+    assert stores["dict"]._positions == stores["col"]._positions

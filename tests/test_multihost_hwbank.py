@@ -22,7 +22,11 @@ WORKER = textwrap.dedent("""
 
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 4)
+    try:
+        jax.config.update("jax_num_cpu_devices", 4)
+    except AttributeError:  # older jaxlib: XLA flag at lazy backend init
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=4")
 
     pid = int(sys.argv[1])
     coord = sys.argv[2]

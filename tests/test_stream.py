@@ -150,8 +150,9 @@ def test_checkpoint_resume(tmp_path):
         rt.step_once()
     rt._checkpoint()
     rt._ckpt_join()  # commit is async; wait for it to land
-    off = src.offset()
-    assert off == 1024
+    # the prefetch stage polls the source ahead of the fold; what the
+    # checkpoint commits is the offset of the DISPATCHED batches only
+    assert rt._offsets_dispatched == 1024
 
     # new runtime resumes from the checkpoint; finishes the stream
     src2 = SyntheticSource(n_events=2048, n_vehicles=50, events_per_second=512)
@@ -380,28 +381,26 @@ def test_async_checkpoint_errors_surface(tmp_path, monkeypatch):
 
 def test_crash_between_poll_and_dispatch_replays_polled_batch(
         tmp_path, monkeypatch):
-    """Checkpoints commit offsets of DISPATCHED batches only: a batch
-    polled right before a mid-step failure (the deferred-pull window)
-    must not be covered by the exit commit, so it replays on resume."""
+    """Checkpoints commit offsets of DISPATCHED batches only: a batch the
+    prefetch stage polled AHEAD of a mid-step device failure must not be
+    covered by the exit commit, so it replays on resume."""
     cfg = mk_cfg(tmp_path)
     store = MemoryStore()
     src = SyntheticSource(n_events=1024, n_vehicles=50,
                           events_per_second=512)
     rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=0)
-    rt.step_once()                      # batch 1 dispatched; emits pending
-    orig = rt.flush_pending
-    armed = {"on": True}
+    rt.step_once()             # batch 1 dispatched; batch 2 prefetched
+    assert src.offset() == 1024            # prefetch consumed batch 2...
+    assert rt._offsets_dispatched == 512   # ...offsets cover batch 1 only
 
-    def flaky():
-        if armed["on"] and rt._pending is not None:
-            armed["on"] = False         # fail once, mid-step, post-poll
-            raise RuntimeError("transient pull failure")
-        orig()
+    def dying(*a, **k):
+        raise RuntimeError("device died mid-step")
 
-    monkeypatch.setattr(rt, "flush_pending", flaky)
-    with pytest.raises(RuntimeError, match="transient pull"):
-        rt.step_once()                  # polled batch 2, then died
-    rt.close()                          # exit commit: dispatched offsets only
+    monkeypatch.setattr(rt._multi, "step_packed_all", dying)
+    with pytest.raises(RuntimeError, match="device died"):
+        # close() tries to drain the prefetched batch, the dispatch dies;
+        # the exit commit (finally) still covers batch 1 only
+        rt.close()
 
     src2 = SyntheticSource(n_events=1024, n_vehicles=50,
                            events_per_second=512)
@@ -615,15 +614,16 @@ def test_exit_commit_mid_carry_skip_is_collective(tmp_path, monkeypatch):
     rt._multiproc = True
     rt._gpair = gpair
 
-    # 1) local carry -> collective consulted, commit skipped pre-barrier
-    rt._carry_cols = object()
+    # 1) local mid-record state (the last dispatched batch overshot) ->
+    # collective consulted, commit skipped pre-barrier
+    rt._carried_last = True
     rt._checkpoint()
     assert order == [("gpair", 1.0)]
     assert rt.ckpt.load_meta() is None
 
     # 2) carry-free host whose PEER carries -> skips too (the agreement)
     order.clear()
-    rt._carry_cols = None
+    rt._carried_last = False
     peer["carry"] = 1.0
     rt._checkpoint()
     assert order == [("gpair", 0.0)]

@@ -107,6 +107,15 @@ def main() -> int:
                     "— the executor/driver split the reference gets "
                     "from Spark")
     ap.add_argument("--no-positions", action="store_true")
+    ap.add_argument("--flush-k", type=int, default=None,
+                    help="emit-ring flush interval (HEATMAP_EMIT_FLUSH_K):"
+                    " packed emits of up to K batches stay device-resident"
+                    " and are pulled in ONE transfer; default = config "
+                    "default (8)")
+    ap.add_argument("--prefetch", type=int, default=None,
+                    help="batches polled/padded/transferred ahead of the "
+                    "fold (HEATMAP_PREFETCH_BATCHES); default = config "
+                    "default (1), 0 disables the double-buffered feed")
     ap.add_argument("--resolutions", default="8",
                     help="comma list; e.g. 7,8,9 = the BASELINE #4 "
                     "hex-pyramid fused through ONE runtime program")
@@ -186,13 +195,18 @@ def main() -> int:
         store = MemoryStore()
         topology = "packed-columnar MemoryStore"
 
+    over = {}
+    if args.flush_k is not None:
+        over["emit_flush_k"] = args.flush_k
+    if args.prefetch is not None:
+        over["prefetch_batches"] = args.prefetch
     cfg = load_config(
         {"H3_RESOLUTIONS": args.resolutions,
          "WINDOW_MINUTES": args.windows},
         batch_size=args.batch, state_capacity_log2=args.cap_log2,
         state_max_log2=args.cap_log2 + 3, grow_margin="observed",
         speed_hist_bins=32, store=args.store,
-        checkpoint_dir=tempfile.mkdtemp(prefix="e2e-rate-ckpt-"))
+        checkpoint_dir=tempfile.mkdtemp(prefix="e2e-rate-ckpt-"), **over)
     syn = SyntheticSource(n_events=args.events, n_vehicles=args.vehicles,
                           events_per_second=args.batch * 4)
     broker = pub = None
@@ -329,6 +343,13 @@ def main() -> int:
         "batch_latency_p95_ms": round(
             snap.get("batch_latency_p95_ms", 0.0), 2),
         "spans_p50_ms": {k: round(v, 3) for k, v in spans.items()},
+        # emit-ring accounting: pulls vs batches is the round-trip
+        # amortization the ring buys (acceptance: >= 4x at default K)
+        "flush_k": cfg.emit_flush_k,
+        "prefetch": cfg.prefetch_batches,
+        "n_batches": rt.epoch,
+        "emit_pulls": snap.get("emit_pulls", 0),
+        "emit_pull_batches": snap.get("emit_pull_batches", 0),
         "tiles_written": rt.writer.counters["tiles_written"],
         "positions_written": rt.writer.counters["positions_written"],
         "events_valid": snap.get("events_valid"),

@@ -92,6 +92,10 @@ UNITS: dict[str, tuple[int, int]] = {
     "headline_b21": (600, 6),
     "headline_b21_native": (600, 6),
     "stream_tuned": (600, 6),
+    # the columnar-feed sustained unit (emit ring + prefetch engaged) —
+    # the r6 tentpole's end-to-end proof; dict-fed stream_tuned stays
+    # as the like-for-like comparison row
+    "stream_colfeed": (600, 8),
     # the fused 3-pair program is ONE compile and a killed compile
     # leaves nothing in the persistent cache — the cap must cover the
     # whole first compile (~>10 min on the tunnel) or every attempt
@@ -306,11 +310,19 @@ def unit_headline(total=HEADLINE_SHAPE["total"],
     return out
 
 
-def _stream_run(n: int, batch_log2: int, profile: bool) -> dict:
+def _stream_run(n: int, batch_log2: int, profile: bool,
+                feed: str = "dict", grow_margin: str = "worst") -> dict:
     """Full MicroBatchRuntime run (runtime, not the bare bench fold) on
     the live backend; ``profile`` additionally captures a jax.profiler
     trace into tpu-trace/ (adds overhead — keep comparisons
-    like-for-like)."""
+    like-for-like).
+
+    ``feed``: "dict" replays per-event dicts through MemorySource — the
+    r5 shape whose one-core host parse WAS the sustained wall (span_poll
+    1134 ms vs span_device 11 ms, VERDICT r5 §2); "columnar" feeds
+    vectorized EventColumns (SyntheticSource — the shape a columnar
+    Kafka ingress delivers after the C++ decode), i.e. the dict-free
+    fast path with the emit ring + prefetch engaged."""
     import numpy as np
 
     _device_ready()
@@ -318,25 +330,39 @@ def _stream_run(n: int, batch_log2: int, profile: bool) -> dict:
 
     from heatmap_tpu.config import load_config
     from heatmap_tpu.sink import MemoryStore
-    from heatmap_tpu.stream import MemorySource, MicroBatchRuntime
+    from heatmap_tpu.stream import (MemorySource, MicroBatchRuntime,
+                                    SyntheticSource)
 
     trace_dir = None
     if profile:
         trace_dir = os.path.join(ROOT, "tpu-trace")
         os.environ["HEATMAP_PROFILE_DIR"] = trace_dir
-    rng = np.random.default_rng(2)
-    t0 = int(time.time()) - 600
-    evs = [{"provider": "bench", "vehicleId": f"v{i % 5000}",
-            "lat": float(rng.uniform(42.0, 43.0)),
-            "lon": float(rng.uniform(-72.0, -70.0)),
-            "speedKmh": 30.0, "bearing": 0.0, "accuracyM": 4.0,
-            "ts": t0 + (i % 300)} for i in range(n)]
+    if feed == "columnar":
+        src = SyntheticSource(n_events=n, n_vehicles=5000,
+                              events_per_second=(1 << batch_log2) * 4)
+    else:
+        rng = np.random.default_rng(2)
+        t0 = int(time.time()) - 600
+        evs = [{"provider": "bench", "vehicleId": f"v{i % 5000}",
+                "lat": float(rng.uniform(42.0, 43.0)),
+                "lon": float(rng.uniform(-72.0, -70.0)),
+                "speedKmh": 30.0, "bearing": 0.0, "accuracyM": 4.0,
+                "ts": t0 + (i % 300)} for i in range(n)]
+        src = MemorySource(evs)
+        src.finish()
+    cap_log2 = max(17, batch_log2 + 1)
     cfg = load_config({}, batch_size=1 << batch_log2,
-                      state_capacity_log2=max(17, batch_log2 + 1),
+                      state_capacity_log2=cap_log2,
+                      # observed margin (e2e_rate's production config)
+                      # keeps the ring's growth-pressure flush scaled to
+                      # MEASURED minting — under `worst`, a cap of only
+                      # 2x batch forces a pressure flush every other
+                      # batch and the ring never amortizes
+                      state_max_log2=cap_log2 + 3 if
+                      grow_margin == "observed" else 0,
+                      grow_margin=grow_margin,
                       speed_hist_bins=32, store="memory",
                       checkpoint_dir=tempfile.mkdtemp(prefix="hwb-ckpt-"))
-    src = MemorySource(evs)
-    src.finish()
     rt = MicroBatchRuntime(cfg, src, MemoryStore(), checkpoint_every=10)
     wall0 = time.monotonic()
     rt.run()
@@ -345,13 +371,18 @@ def _stream_run(n: int, batch_log2: int, profile: bool) -> dict:
     keep = {k: snap[k] for k in (
         "batch_latency_p50_ms", "batch_latency_p95_ms", "span_poll_p50_ms",
         "span_build_p50_ms", "span_pull_p50_ms", "span_device_p50_ms",
-        "span_sink_submit_p50_ms") if k in snap}
+        "span_sink_submit_p50_ms", "span_transfer_p50_ms",
+        "span_prefetch_p50_ms") if k in snap}
     p50 = snap.get("batch_latency_p50_ms", 0.0)
-    out = {"n": n, "batch": 1 << batch_log2, "wall_s": round(wall, 2),
+    out = {"n": n, "batch": 1 << batch_log2, "feed": feed,
+           "wall_s": round(wall, 2),
            "wall_mev_s": round(n / wall / 1e6, 3),
            "steady_mev_s": round(cfg.batch_size / (p50 / 1e3) / 1e6, 3)
            if p50 else None,
            "pull": "prefix" if rt._prefix_pull else "full",
+           "flush_k": cfg.emit_flush_k,
+           "emit_pulls": snap.get("emit_pulls", 0),
+           "n_batches": rt.epoch,
            "metrics": keep}
     if trace_dir:
         out["trace_dir"] = trace_dir
@@ -366,8 +397,18 @@ def unit_stream_tuned() -> dict:
     """Sustained runtime with the banked measured-winner defaults
     engaged (full pull / unanimous merge / pallas snap via hwbank) and
     a batch big enough to amortize the tunnel round-trip — the
-    end-to-end proof that the flipped `auto` defaults pay."""
+    end-to-end proof that the flipped `auto` defaults pay.  Still
+    dict-fed (the r5 comparison row); stream_colfeed is the fast path."""
     return _stream_run(n=2_000_000, batch_log2=18, profile=False)
+
+
+def unit_stream_colfeed() -> dict:
+    """THE sustained unit for the columnar fast path: dict-free
+    EventColumns feed + double-buffered device prefetch + on-device emit
+    accumulation (emit ring), at the tuned batch shape.  VERDICT r5 next
+    step 1: done = sustained >= 0.5x the banked fold headline."""
+    return _stream_run(n=4_000_000, batch_log2=18, profile=False,
+                       feed="columnar", grow_margin="observed")
 
 
 def unit_contact() -> dict:
@@ -425,6 +466,7 @@ UNIT_FNS = {
     "snap_pal_r8": lambda: unit_snap_pallas(8),
     "snap_pal_r9": lambda: unit_snap_pallas(9),
     "stream_tuned": unit_stream_tuned,
+    "stream_colfeed": unit_stream_colfeed,
     # fused BASELINE #4/#5 pipelines on chip (round-5 session 2): the
     # single-pair units above can't answer what the 3-pair fusion costs
     # on the v5e; same shape as headline_full, all pairs in ONE program
@@ -760,7 +802,10 @@ def report() -> None:
                          "Sustained streaming run (profiled)"),
                         ("stream_tuned",
                          "Sustained streaming run (banked defaults, "
-                         "no profiler)")):
+                         "no profiler)"),
+                        ("stream_colfeed",
+                         "Sustained streaming run (columnar feed + "
+                         "emit ring + prefetch)")):
         if name not in hw:
             continue
         d = hw[name]
