@@ -1013,6 +1013,10 @@ def _e2e_runtime_attach() -> dict:
             "e2e_runtime_events_per_sec": e2e["wall_events_per_sec"],
             "e2e_runtime_steady_events_per_sec":
                 e2e["steady_events_per_sec"],
+            # freshness rides with throughput in every BENCH_*.json: the
+            # event-age p50/p99 (event ts -> sink commit ack through the
+            # emit ring) and mean ring residency this run sustained
+            "e2e_runtime_freshness": e2e.get("freshness", {}),
             "e2e_runtime_note": "full MicroBatchRuntime at rate "
                                 "(tools/e2e_rate.py, packed-columnar "
                                 "memory sink; wall incl. compile — see "

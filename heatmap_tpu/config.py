@@ -119,6 +119,19 @@ class Config:
                                        # buffering).  0 disables; multi-
                                        # host runs force 0 (the lockstep
                                        # collectives pin poll ordering).
+    flightrec_dir: str = ""            # HEATMAP_FLIGHTREC_DIR: directory
+                                       # for post-mortem flight records
+                                       # (obs.flightrec) — on abnormal
+                                       # exit / SIGTERM the runtime dumps
+                                       # trace tail, lineage tail, metrics
+                                       # snapshot, and config there.
+                                       # Empty disables.  A NORMAL close
+                                       # writes nothing unless
+                                       # HEATMAP_FLIGHTREC_ALWAYS=1.
+    lineage_tail: int = 256            # HEATMAP_LINEAGE_TAIL: closed
+                                       # freshness-lineage records kept
+                                       # for /debug/freshness and the
+                                       # flight recorder (obs.lineage)
 
     @property
     def tile_seconds(self) -> int:
@@ -187,6 +200,8 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         emit_flush_k=_int(e, "HEATMAP_EMIT_FLUSH_K", Config.emit_flush_k),
         prefetch_batches=_int(e, "HEATMAP_PREFETCH_BATCHES",
                               Config.prefetch_batches),
+        flightrec_dir=e.get("HEATMAP_FLIGHTREC_DIR", Config.flightrec_dir),
+        lineage_tail=_int(e, "HEATMAP_LINEAGE_TAIL", Config.lineage_tail),
     )
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
@@ -214,4 +229,7 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         raise ValueError(
             f"HEATMAP_PREFETCH_BATCHES must be in 0..32, "
             f"got {cfg.prefetch_batches}")
+    if cfg.lineage_tail < 1:
+        raise ValueError(
+            f"HEATMAP_LINEAGE_TAIL must be >= 1, got {cfg.lineage_tail}")
     return cfg

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import NamedTuple
 
 import jax
@@ -1228,7 +1229,17 @@ class EmitRing:
     def __init__(self, capacity: int):
         self.capacity = max(1, int(capacity))
         self._entries: list = []      # (packed_device, tag) append order
+        self._enter: list = []        # (monotonic enter, append seq)
+        self._appends = 0             # lifetime appends (residency base)
         self.n_flushes = 0            # pulls issued (telemetry)
+        # residency of the entries the LAST take()/flush_stacked()
+        # drained, aligned with its return order: (seconds parked,
+        # batches resident — appends from the entry's own, inclusive, to
+        # the flush; the oldest entry of a K-deep flush reads K).  The
+        # stream runtime feeds these into the
+        # heatmap_emit_ring_residency_* histograms and the freshness
+        # lineage (obs.lineage) right after each flush.
+        self.last_flush_residency: list = []
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -1247,14 +1258,22 @@ class EmitRing:
                 f"(got {tuple(packed.shape)} vs "
                 f"{tuple(self._entries[0][0].shape)}); flush before a "
                 f"slab/emit-capacity resize")
+        self._appends += 1
         self._entries.append((packed, tag))
+        self._enter.append((time.monotonic(), self._appends))
         return self.full
 
     def take(self) -> list:
         """Drain the raw (packed, tag) entries without pulling."""
         entries, self._entries = self._entries, []
+        enters, self._enter = self._enter, []
         if entries:
             self.n_flushes += 1
+            now = time.monotonic()
+            self.last_flush_residency = [
+                (now - t, self._appends - seq + 1) for t, seq in enters]
+        else:
+            self.last_flush_residency = []
         return entries
 
     def flush_stacked(self, prefix: bool) -> list:

@@ -1,15 +1,21 @@
 """obs — pipeline-wide observability substrate.
 
-Three pieces, all dependency-free:
+Five pieces, all dependency-free:
 
 - :mod:`registry` — counters / gauges / fixed-bucket histograms with
   Prometheus text exposition (``Registry.expose_text``);
 - :mod:`tracebuf` — bounded ring of structured per-micro-batch trace
-  records (``/trace/recent``; optional JSONL export via
-  ``HEATMAP_TRACE_JSONL``);
+  records (``/trace/recent``; optional size-rotated JSONL export via
+  ``HEATMAP_TRACE_JSONL`` / ``HEATMAP_TRACE_JSONL_MAX_BYTES``);
+- :mod:`lineage` — per-batch freshness lineage (event ts → sink-commit
+  ack, staged through poll/prefetch/fold/ring/sink), the substrate of
+  ``heatmap_event_age_seconds`` and ``/debug/freshness``;
+- :mod:`flightrec` — crash-time state dump (trace tail, lineage tail,
+  metrics snapshot, config) to ``HEATMAP_FLIGHTREC_DIR``;
 - :mod:`xproc` — the file-backed supervisor→child metrics channel
   (``HEATMAP_SUPERVISOR_CHANNEL``), so the child's ``/metrics`` reports
-  its parent supervisor's restart counters and they survive restarts.
+  its parent supervisor's restart counters and they survive restarts;
+  plus the per-child freshness summary files next to it.
 
 stream.metrics.Metrics builds on the registry and keeps its historical
 ``snapshot()`` JSON keys — served at ``/metrics.json`` — while
@@ -17,6 +23,8 @@ stream.metrics.Metrics builds on the registry and keeps its historical
 knobs are documented in ARCHITECTURE.md §Observability.
 """
 
+from heatmap_tpu.obs.flightrec import FlightRecorder  # noqa: F401
+from heatmap_tpu.obs.lineage import LineageTracker  # noqa: F401
 from heatmap_tpu.obs.registry import (  # noqa: F401
     DEFAULT_LAG_BUCKETS,
     DEFAULT_TIME_BUCKETS,

@@ -1,0 +1,143 @@
+"""Flight recorder — crash-time state dump for post-mortem diagnosis.
+
+When a long-running stream dies — injected exception, SIGTERM from an
+orchestrator, a poisoned sink — the /metrics and /trace/recent
+endpoints die with it, and the operator is left with an exit code.  The
+flight recorder closes that gap: on abnormal runtime exit it dumps the
+trace-ring tail, the freshness-lineage tail, the metrics snapshot, and
+the resolved config to a timestamped ``flightrec-*.json`` under
+``HEATMAP_FLIGHTREC_DIR``, so the last seconds before the incident are
+diagnosable offline.
+
+Contract (tests/test_lineage.py):
+
+- armed only when ``HEATMAP_FLIGHTREC_DIR`` is set (the config knob);
+- a NORMAL close writes nothing unless ``HEATMAP_FLIGHTREC_ALWAYS=1``;
+- one dump per recorder (the first reason wins — a SIGTERM that unwinds
+  into close() must not write twice);
+- sources are callables evaluated at dump time, each guarded: a broken
+  source contributes its error string instead of killing the dump;
+- the file is written atomically (tmp + rename), so a half-written
+  record is impossible even when the process is dying.
+
+Wiring: the runtime dumps from ``close()`` (it knows fatal/poisoned/
+unwinding); ``stream/__main__.py`` converts SIGTERM into a SystemExit
+so that close() runs (and registers an atexit backstop for exits that
+bypass it); the supervisor dumps its OWN view (channel state, failure
+reason) when a child dies, via :func:`dump_snapshot`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import threading
+import time
+
+from heatmap_tpu.obs.lineage import json_safe
+
+log = logging.getLogger(__name__)
+
+ENV_DIR = "HEATMAP_FLIGHTREC_DIR"
+ENV_ALWAYS = "HEATMAP_FLIGHTREC_ALWAYS"
+
+# process-wide dump counter: several recorders (runtime + supervisor, or
+# repeated child failures) in one second must not collide on a filename
+_DUMP_SEQ = itertools.count(1)
+
+
+class FlightRecorder:
+    # dumps retained per directory: a supervised stream that flaps for
+    # weeks writes one record per failure, and an unbounded directory
+    # is the disk-filling failure mode the trace JSONL rotation exists
+    # to prevent — after each dump the oldest files beyond this cap are
+    # pruned
+    RETAIN = 16
+
+    def __init__(self, dir_path: str):
+        self.dir = dir_path
+        self._sources: dict = {}
+        self._lock = threading.Lock()
+        self._dumped: str | None = None  # path of the dump, once written
+        self._disarmed = False
+
+    def add_source(self, name: str, fn) -> None:
+        """Register ``fn() -> JSON-serializable`` evaluated at dump time."""
+        self._sources[name] = fn
+
+    def disarm(self) -> None:
+        """A clean close: the atexit backstop must not dump after this."""
+        self._disarmed = True
+
+    @property
+    def dumped(self) -> str | None:
+        return self._dumped
+
+    def dump(self, reason: str) -> str | None:
+        """Write the flight record; returns its path, or None when this
+        recorder already dumped / was disarmed / cannot write.  Never
+        raises — the recorder runs on dying codepaths."""
+        with self._lock:
+            if self._dumped is not None or self._disarmed:
+                return None
+            self._dumped = ""  # claim before the (slow) source walk
+        payload = {
+            "reason": str(reason)[:500],
+            "t_wall": round(time.time(), 3),
+            "pid": os.getpid(),
+        }
+        for name, fn in self._sources.items():
+            try:
+                payload[name] = json_safe(fn())
+            except Exception as e:  # noqa: BLE001 - partial dump > no dump
+                payload[name] = f"<source failed: {type(e).__name__}: {e}>"
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        fname = (f"flightrec-{stamp}-{os.getpid()}"
+                 f"-{next(_DUMP_SEQ)}.json")
+        path = os.path.join(self.dir, fname)
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            from heatmap_tpu.obs.xproc import atomic_write_json
+
+            atomic_write_json(path, payload)
+        except (OSError, TypeError, ValueError) as e:
+            log.warning("flight record write to %s failed: %s", path, e)
+            with self._lock:
+                self._dumped = None  # release the claim: the atexit
+                # backstop (or a later close) may retry on a dying disk
+            return None
+        self._dumped = path
+        log.error("flight record written: %s (%s)", path, reason)
+        self._prune()
+        return path
+
+    def _prune(self) -> None:
+        """Keep the newest RETAIN flightrec-*.json in the directory."""
+        import glob
+
+        try:
+            files = sorted(
+                glob.glob(os.path.join(glob.escape(self.dir),
+                                       "flightrec-*.json")),
+                key=os.path.getmtime)
+            for p in files[: max(0, len(files) - self.RETAIN)]:
+                os.remove(p)
+        except OSError:  # retention is best-effort on a dying codepath
+            pass
+
+
+def from_env(env=None) -> FlightRecorder | None:
+    """A recorder for ``HEATMAP_FLIGHTREC_DIR``, or None when unset."""
+    e = os.environ if env is None else env
+    d = e.get(ENV_DIR, "")
+    return FlightRecorder(d) if d else None
+
+
+def dump_snapshot(dir_path: str, reason: str, sources: dict) -> str | None:
+    """One-shot dump of already-materialized values (the supervisor's
+    child-failure hook: it has no live runtime to source from)."""
+    rec = FlightRecorder(dir_path)
+    for name, value in sources.items():
+        rec.add_source(name, lambda v=value: v)
+    return rec.dump(reason)

@@ -1,0 +1,157 @@
+"""Per-batch freshness lineage: event time → sink-commit ack, staged.
+
+The paper's headline claims are end-to-end (<500 ms p50 micro-batch
+latency, real-time freshness of the served heatmap), but the per-stage
+span telemetry stopped being end-to-end the moment the feed stage ran
+AHEAD of the fold (prefetch) and packed emits started PARKING on device
+(engine.step.EmitRing): a batch's wall-time spans describe work, not how
+stale its events are when they finally reach the sink.  GeoFlink and
+LMStream (PAPERS.md) both report ingest-to-availability latency as the
+quantity a streaming spatial system must publish — this module is that
+substrate.
+
+One ``LineageRecord`` (a plain JSON-friendly dict) is opened per polled
+batch and stamped at every stage boundary with ONE shared clock, so the
+decomposition telescopes exactly:
+
+    event ts --poll_wait--> poll --prefetch_queue--> dispatch
+      --fold--> ring-enter --ring--> flush/pull --sink_commit--> ack
+
+    age(mean event ts -> ack) == poll_wait + prefetch_queue + fold
+                                 + ring + sink_commit      (exactly)
+
+The tracker keeps a bounded tail of CLOSED (sink-acked) records for
+``/debug/freshness`` and the flight recorder, plus the newest committed
+event timestamp the serving layer samples into the ingest→serve
+freshness gauge.  The clock is injectable so tests can prove the
+conservation property with a synthetic clock.
+
+Stamping is lock-free on the record itself: each stage has a single
+owner (step thread through the flush, writer thread for the commit ack)
+and the writer queue is the happens-before edge between them.  Only the
+tail append and the newest-committed watermark take the tracker lock.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+# Stage keys, in pipeline order (the decomposition /debug/freshness and
+# the conservation test enumerate).
+STAGES = ("poll_wait", "prefetch_queue", "fold", "ring", "sink_commit")
+
+
+def json_safe(obj):
+    """Best-effort conversion to JSON-serializable types: numpy scalars
+    via ``.item()``, containers recursively, anything else via repr.
+    Lineage records carry source offsets (arbitrary per-source objects)
+    and must stay dump-able for /debug/freshness and flightrec."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    item = getattr(obj, "item", None)
+    if item is not None and getattr(obj, "shape", None) == ():
+        try:
+            return item()  # numpy scalar
+        except Exception:  # noqa: BLE001 - fall through to repr
+            pass
+    if isinstance(obj, dict):
+        return {str(k): json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_safe(v) for v in obj]
+    return repr(obj)
+
+
+class LineageTracker:
+    """Opens, stamps, and retains per-batch freshness lineage records."""
+
+    def __init__(self, capacity: int = 256, clock=time.time):
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._tail: collections.deque = collections.deque(
+            maxlen=max(1, int(capacity)))
+        self._newest_committed_ts: float | None = None
+
+    # ------------------------------------------------------------ stages
+    def open(self, *, n_events: int, ev_min_ts: int, ev_max_ts: int,
+             ev_mean_ts: float, offset=None,
+             t_poll: float | None = None) -> dict:
+        """Create a record at poll time (t_poll = now).  ``t_poll``
+        overrides the stamp for rows fetched by an EARLIER poll — a
+        carry-drained overshoot tail must bill its wait since that poll
+        as queue time, not hide it inside poll_wait."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        return {
+            "seq": seq,
+            "epoch": None,              # stamped at dispatch
+            "n_events": int(n_events),
+            "ev_min_ts": int(ev_min_ts),
+            "ev_max_ts": int(ev_max_ts),
+            "ev_mean_ts": float(ev_mean_ts),
+            "offset": json_safe(offset),
+            "t_poll": self.clock() if t_poll is None else float(t_poll),
+        }
+
+    def dispatched(self, rec: dict, epoch: int) -> None:
+        """The batch left the prefetch queue and entered the fold."""
+        rec["epoch"] = int(epoch)
+        rec["t_dispatch"] = self.clock()
+
+    def ring_entered(self, rec: dict) -> None:
+        """The fold dispatched; its packed emits parked in the EmitRing."""
+        rec["t_ring"] = self.clock()
+
+    def flushed(self, rec: dict, ring_batches: int | None = None) -> None:
+        """The flush covering this batch pulled it off the device."""
+        rec["t_flush"] = self.clock()
+        if ring_batches is not None:
+            rec["ring_batches"] = int(ring_batches)
+
+    def committed(self, rec: dict) -> dict:
+        """Sink-commit ack: close the record — derive the per-stage
+        decomposition and event ages, append to the tail, and advance
+        the newest-committed event-time watermark.  Returns ``rec``."""
+        t_sink = rec["t_sink"] = self.clock()
+        rec["stages"] = {
+            "poll_wait": rec["t_poll"] - rec["ev_mean_ts"],
+            "prefetch_queue": rec["t_dispatch"] - rec["t_poll"],
+            "fold": rec["t_ring"] - rec["t_dispatch"],
+            "ring": rec["t_flush"] - rec["t_ring"],
+            "sink_commit": t_sink - rec["t_flush"],
+        }
+        rec["age_s"] = {
+            # ages keyed by which event of the batch they describe: the
+            # oldest event (min ts) has aged the most by ack time
+            "oldest": t_sink - rec["ev_min_ts"],
+            "mean": t_sink - rec["ev_mean_ts"],
+            "newest": t_sink - rec["ev_max_ts"],
+        }
+        with self._lock:
+            self._tail.append(rec)
+            if (self._newest_committed_ts is None
+                    or rec["ev_max_ts"] > self._newest_committed_ts):
+                self._newest_committed_ts = rec["ev_max_ts"]
+        return rec
+
+    # ------------------------------------------------------------ reads
+    @property
+    def newest_committed_ts(self) -> float | None:
+        """Max event timestamp across sink-acked batches — what the
+        ingest→serve freshness gauge subtracts from render wall time."""
+        with self._lock:
+            return self._newest_committed_ts
+
+    def tail(self, n: int = 50) -> list:
+        """Newest-first closed records (shallow copies — callers may
+        serialize while the writer thread closes more records)."""
+        with self._lock:
+            items = list(self._tail)
+        return [dict(r) for r in items[::-1][: max(0, int(n))]]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._tail)

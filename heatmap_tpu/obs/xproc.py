@@ -42,6 +42,77 @@ COUNTER_FIELDS = ("restarts_total", "failures_total", "stalls_total",
 GAUGE_FIELDS = ("failed_over", "backoff_s", "gave_up",
                 "recent_failures", "child_running")
 
+# Per-child freshness summary keys (obs.lineage): each CHILD runtime
+# publishes these into a sibling file next to the channel
+# (``<channel>.fresh-<tag>``, tag = "p<process_index>"), so the process
+# that owns /metrics — the child itself, a serve-only process, or a
+# multi-host parent holding the same channel path — exposes per-child
+# freshness as ``heatmap_child_<key>{child="<tag>"}`` gauges.  Lineage
+# itself stays host-local; only this summary crosses processes.
+FRESHNESS_FIELDS = ("event_age_p50_s", "event_age_p99_s",
+                    "ring_residency_mean_s")
+
+
+def atomic_write_json(path: str, payload: dict) -> None:
+    """THE tmp+rename JSON write (channel, child freshness, flight
+    records all use it): a reader can never see a half-written file;
+    the tmp is cleaned up on failure and the error re-raised for the
+    caller to contextualize."""
+    tmp = f"{path}.tmp{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, separators=(",", ":"))
+        os.replace(tmp, path)
+    except (OSError, TypeError, ValueError):
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def child_freshness_path(channel_path: str, tag: str) -> str:
+    return f"{channel_path}.fresh-{tag}"
+
+
+def publish_child_freshness(channel_path: str, tag: str,
+                            summary: dict) -> None:
+    """Atomic write of one child's freshness summary next to the
+    channel; unwritable degrades to a warning (telemetry must never
+    take the pipeline down)."""
+    payload = {k: summary[k] for k in FRESHNESS_FIELDS
+               if isinstance(summary.get(k), (int, float))}
+    payload["updated_unix"] = round(time.time(), 3)
+    try:
+        atomic_write_json(child_freshness_path(channel_path, tag), payload)
+    except OSError as e:
+        log.warning("child freshness publish failed: %s", e)
+
+
+def child_freshness_from(channel_path: str | None,
+                         max_age_s: float = 900.0) -> dict:
+    """{tag: summary dict} for every published child next to the
+    channel; {} when no channel / none published.  Summaries whose
+    ``updated_unix`` is older than ``max_age_s`` are dropped — a dead
+    child's last file must not keep exporting a frozen-green freshness
+    gauge forever (staleness is detectable, per the channel contract)."""
+    if not channel_path:
+        return {}
+    import glob
+
+    now = time.time()
+    out = {}
+    for p in sorted(glob.glob(glob.escape(channel_path) + ".fresh-*")):
+        tag = p.rsplit(".fresh-", 1)[1]
+        if ".tmp" in tag:  # in-flight atomic write of any publisher
+            continue
+        d = SupervisorChannel.load(p)
+        upd = d.get("updated_unix")
+        if not isinstance(upd, (int, float)) or now - upd > max_age_s:
+            continue
+        out[tag] = d
+    return out
+
 
 class SupervisorChannel:
     def __init__(self, path: str):
@@ -98,17 +169,10 @@ class SupervisorChannel:
         """Atomic write; an unwritable channel degrades to a warning —
         telemetry must never take the supervisor down."""
         self.state["updated_unix"] = round(time.time(), 3)
-        tmp = f"{self.path}.tmp{os.getpid()}"
         try:
-            with open(tmp, "w", encoding="utf-8") as fh:
-                json.dump(self.state, fh, separators=(",", ":"))
-            os.replace(tmp, self.path)
+            atomic_write_json(self.path, self.state)
         except OSError as e:
             log.warning("supervisor channel write failed: %s", e)
-            try:
-                os.remove(tmp)
-            except OSError:
-                pass
 
     @staticmethod
     def load(path: str | None) -> dict:

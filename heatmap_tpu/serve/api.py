@@ -14,12 +14,17 @@ Contract parity notes (all against /root/reference/app.py):
 - GET /metrics.json → the historical JSON counter snapshot (every
   pre-obs key preserved; the back-compat surface tools consume).
 - GET /trace/recent → newest-first structured per-batch trace records
-  (obs.tracebuf; ?n= bounds the count).
+  (obs.tracebuf; ?n= bounds the count, ?fields= selects record keys).
+- GET /debug/freshness → the per-stage freshness decomposition
+  (poll_wait/prefetch_queue/fold/ring/sink_commit) for the last N
+  closed lineage records (obs.lineage) plus the event-age summary —
+  the operator answer to "WHERE is the staleness coming from".
 - GET /healthz      → SLO evaluation: ok / degraded / down from recent
   batch p50 vs HEATMAP_SLO_BATCH_P50_MS (default 500, the paper
-  budget), freshness p50 vs HEATMAP_SLO_FRESHNESS_P50_S, supervisor
-  restart rate vs HEATMAP_SLO_RESTARTS_PER_H; "down" (HTTP 503) on a
-  poisoned sink or a supervisor that gave up.
+  budget), emit freshness p50 vs HEATMAP_SLO_FRESHNESS_P50_S,
+  end-to-end event-age p50 vs HEATMAP_SLO_FRESHNESS_P50_MS,
+  supervisor restart rate vs HEATMAP_SLO_RESTARTS_PER_H; "down"
+  (HTTP 503) on a poisoned sink or a supervisor that gave up.
 """
 
 from __future__ import annotations
@@ -183,13 +188,41 @@ def _supervisor_lines(chan: dict) -> list:
     return lines
 
 
+def _child_freshness_lines(channel_path: str | None) -> list:
+    """Per-child freshness summaries published next to the supervisor
+    channel (obs.xproc) -> ``heatmap_child_<key>{child="<tag>"}``
+    gauges, so a parent/serve-only /metrics exposes every child's
+    end-to-end freshness (lineage itself stays host-local)."""
+    from heatmap_tpu.obs.registry import _escape_label, _fmt
+    from heatmap_tpu.obs.xproc import FRESHNESS_FIELDS, child_freshness_from
+
+    kids = child_freshness_from(channel_path)
+    if not kids:
+        return []
+    lines = []
+    for k in FRESHNESS_FIELDS:
+        samples = [
+            (tag, d[k]) for tag, d in sorted(kids.items())
+            if isinstance(d.get(k), (int, float))]
+        if not samples:
+            continue
+        lines.append(f"# TYPE heatmap_child_{k} gauge")
+        for tag, v in samples:
+            lines.append(
+                f'heatmap_child_{k}{{child="{_escape_label(tag)}"}} '
+                f"{_fmt(v)}")
+    return lines
+
+
 def _metrics_text(runtime) -> str:
     """Prometheus text exposition for /metrics."""
     from heatmap_tpu.obs import ENV_CHANNEL, SupervisorChannel
     from heatmap_tpu.obs.registry import _escape_label
 
-    chan = SupervisorChannel.metrics_from(os.environ.get(ENV_CHANNEL))
+    chan_path = os.environ.get(ENV_CHANNEL)
+    chan = SupervisorChannel.metrics_from(chan_path)
     extra_lines = _supervisor_lines(chan)
+    extra_lines.extend(_child_freshness_lines(chan_path))
     if runtime is None:
         return "\n".join(extra_lines) + ("\n" if extra_lines else "")
     pol = _policy_values(runtime)
@@ -210,12 +243,18 @@ def _metrics_text(runtime) -> str:
 
 
 # ---- /healthz SLO evaluation -----------------------------------------
-# Env knobs (read per request — they are three getenv calls):
-#   HEATMAP_SLO_BATCH_P50_MS     recent p50 batch latency budget (500,
-#                                the paper's headline bound)
-#   HEATMAP_SLO_FRESHNESS_P50_S  recent p50 emit freshness budget (60)
-#   HEATMAP_SLO_RESTARTS_PER_H   supervisor failures tolerated in the
-#                                trailing hour before degraded (4)
+# Env knobs (read per request — they are four getenv calls):
+#   HEATMAP_SLO_BATCH_P50_MS      recent p50 batch latency budget (500,
+#                                 the paper's headline bound)
+#   HEATMAP_SLO_FRESHNESS_P50_S   recent p50 emit freshness budget (60)
+#   HEATMAP_SLO_FRESHNESS_P50_MS  recent p50 END-TO-END event age
+#                                 budget (10000 ms): event ts -> sink
+#                                 commit ack, through prefetch + the
+#                                 emit ring (obs.lineage) — catches the
+#                                 ring-hold staleness the batch spans
+#                                 cannot see
+#   HEATMAP_SLO_RESTARTS_PER_H    supervisor failures tolerated in the
+#                                 trailing hour before degraded (4)
 def _slo(name: str, default: float) -> float:
     try:
         return float(os.environ.get(name, "") or default)
@@ -250,6 +289,16 @@ def healthz_payload(runtime) -> tuple[dict, bool]:
             checks["freshness_p50_s"] = {"value": round(f50, 3),
                                          "budget": budget, "ok": ok}
             degraded |= not ok
+        event_age = getattr(m, "event_age", None)
+        if event_age is not None:
+            ea = event_age.labels(bound="mean")
+            if ea.count:
+                p50_ms = ea.quantile(0.5) * 1e3
+                budget = _slo("HEATMAP_SLO_FRESHNESS_P50_MS", 10000.0)
+                ok = p50_ms <= budget
+                checks["event_age_p50_ms"] = {"value": round(p50_ms, 3),
+                                              "budget": budget, "ok": ok}
+                degraded |= not ok
         if runtime.writer.poisoned:
             checks["sink"] = {"value": "poisoned", "ok": False}
             down = True
@@ -266,6 +315,67 @@ def healthz_payload(runtime) -> tuple[dict, bool]:
             down = True
     status = "down" if down else ("degraded" if degraded else "ok")
     return {"ok": not down, "status": status, "checks": checks}, down
+
+
+def _qs_params(qs: str) -> dict:
+    """Query string -> {name: last value}, URL-decoded (a client that
+    urlencodes ``fields=a,b`` to ``a%2Cb`` must not 400)."""
+    from urllib.parse import parse_qs
+
+    try:
+        return {k: v[-1]
+                for k, v in parse_qs(qs, keep_blank_values=True).items()}
+    except ValueError:
+        return {}
+
+
+def _qs_int(params: dict, name: str, default: int, cap: int) -> int:
+    """Bounded non-negative int param; the default on absence/garbage."""
+    try:
+        return max(0, min(int(params[name]), cap))
+    except (KeyError, TypeError, ValueError):
+        return default
+
+
+_FIELD_RE = None  # compiled lazily (re import stays off the hot path)
+
+
+def _parse_fields(raw: str) -> tuple[list, str | None]:
+    """Validate a /trace/recent ``fields=`` projection: up to 16
+    comma-separated identifier-shaped names.  Returns (names, None) or
+    ([], error) — the caller answers 400 on error rather than guessing."""
+    global _FIELD_RE
+    if _FIELD_RE is None:
+        import re
+
+        _FIELD_RE = re.compile(r"^[A-Za-z0-9_]{1,64}$")
+    names = [f for f in raw.split(",") if f]
+    if not names:
+        return [], "fields= needs at least one name"
+    if len(names) > 16:
+        return [], "fields= accepts at most 16 names"
+    for f in names:
+        if not _FIELD_RE.match(f):
+            return [], f"invalid field name: {f[:80]!r}"
+    return names, None
+
+
+def _sample_serve_freshness(runtime) -> None:
+    """Ingest→serve freshness, sampled at /tiles render time: render
+    wall clock minus the newest SINK-COMMITTED event timestamp (the
+    lineage watermark).  This is the number the paper's 'real-time'
+    claim is about — what a map client actually sees."""
+    lin = getattr(runtime, "lineage", None)
+    g = getattr(runtime, "_g_serve_fresh", None)
+    if lin is None or g is None:
+        return
+    ts = lin.newest_committed_ts
+    if ts is not None:
+        # clamp at 0: sub-threshold clock skew (a provider running
+        # minutes fast passes lineage's poison filter) must read as
+        # "fully fresh", never as a negative gauge that hides real
+        # staleness from dashboards
+        g.set(max(0.0, time.time() - ts))
 
 
 def positions_feature_collection(store: Store) -> dict:
@@ -360,6 +470,7 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 data, pre_gz = _cached_json(
                     ("tiles", grid),
                     lambda: tiles_feature_collection_json(store, grid))
+                _sample_serve_freshness(runtime)
                 ctype = "application/json"
             elif path == "/api/positions/latest":
                 data, pre_gz = _cached_json(
@@ -373,19 +484,40 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 body = json.dumps(_metrics_json(runtime))
                 ctype = "application/json"
             elif path == "/trace/recent":
-                qs = environ.get("QUERY_STRING", "")
-                n = 50
-                for part in qs.split("&"):
-                    if part.startswith("n="):
-                        try:
-                            n = max(0, min(int(part[2:]), 1024))
-                        except ValueError:
-                            pass
+                params = _qs_params(environ.get("QUERY_STRING", ""))
+                n = _qs_int(params, "n", 50, 1024)
+                fields = params.get("fields")
                 traces = (runtime.tracering.recent(n)
                           if runtime is not None
                           and getattr(runtime, "tracering", None) is not None
                           else [])
+                if fields is not None:
+                    # slim traces for operators: bounded, validated
+                    # key projection (missing keys just drop out)
+                    names, err = _parse_fields(fields)
+                    if err:
+                        start_response("400 Bad Request",
+                                       [("Content-Type",
+                                         "application/json")])
+                        return [json.dumps({"error": err}).encode()]
+                    traces = [{k: r[k] for k in names if k in r}
+                              for r in traces]
                 body = json.dumps({"traces": traces})
+                ctype = "application/json"
+            elif path == "/debug/freshness":
+                params = _qs_params(environ.get("QUERY_STRING", ""))
+                n = _qs_int(params, "n", 32, 256)
+                lin = (getattr(runtime, "lineage", None)
+                       if runtime is not None else None)
+                from heatmap_tpu.obs.lineage import STAGES
+
+                payload = {
+                    "records": lin.tail(n) if lin is not None else [],
+                    "summary": (runtime.metrics.freshness_summary()
+                                if runtime is not None else {}),
+                    "stage_order": list(STAGES),
+                }
+                body = json.dumps(payload)
                 ctype = "application/json"
             elif path == "/healthz":
                 payload, down = healthz_payload(runtime)

@@ -96,7 +96,20 @@ class AsyncWriter:
                 if item is None:
                     return
                 kind, docs = item
-                if self._exc is None:
+                if kind == "mark":
+                    # commit-ack barrier (submit_mark): every write
+                    # submitted before it has been applied by now.  A
+                    # broken callback must not poison the writer —
+                    # telemetry never takes the pipeline down — and a
+                    # poisoned writer runs no marks: its writes were
+                    # dropped, so acking them would lie.
+                    if self._exc is None:
+                        try:
+                            docs()
+                        except Exception:
+                            log.exception("sink commit-mark callback "
+                                          "failed")
+                elif self._exc is None:
                     n = self._apply(kind, docs)
                     if kind.startswith("tiles"):
                         self._written_tiles += n
@@ -154,6 +167,13 @@ class AsyncWriter:
         self._check()
         if docs:
             self._put(("positions", docs))
+
+    def submit_mark(self, fn) -> None:
+        """Run ``fn`` on the writer thread once every previously
+        submitted write has been applied — the sink-commit ack hook the
+        freshness lineage stamps its final stage with (obs.lineage)."""
+        self._check()
+        self._put(("mark", fn))
 
     def drain(self) -> None:
         """Block until every submitted write has been applied."""

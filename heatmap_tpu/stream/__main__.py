@@ -16,6 +16,33 @@ from heatmap_tpu.models.pipelines import PIPELINES, get_pipeline
 from heatmap_tpu.sink import make_store
 
 
+def install_flightrec_handlers(rt) -> None:
+    """Flight-recorder wiring for a standalone streaming job (no-op when
+    the runtime has no recorder armed — HEATMAP_FLIGHTREC_DIR unset).
+
+    SIGTERM becomes a SystemExit raised in the main thread, so run()'s
+    finally reaches rt.close(), which sees the unwinding exception and
+    writes the flight record before the process dies (the supervisor's
+    kill path and any orchestrator stop signal both land here).  The
+    atexit hook is the backstop for exits that bypass close(); it is a
+    no-op once close() dumped or disarmed the recorder."""
+    rec = getattr(rt, "flightrec", None)
+    if rec is None:
+        return
+    import atexit
+    import signal
+
+    def _on_term(signum, frame):  # noqa: ARG001
+        raise SystemExit(143)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:  # not the main thread (embedded use)
+        pass
+    atexit.register(
+        lambda: rec.dump("atexit: interpreter exit bypassed close()"))
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("pipeline", nargs="?", default="mbta_default",
@@ -68,6 +95,7 @@ def main(argv=None) -> None:
     store = make_store(p.config)
     src = p.make_source(p.config)
     rt = MicroBatchRuntime(p.config, src, store, mesh=mesh)
+    install_flightrec_handlers(rt)
     log = logging.getLogger("stream")
     log.info("pipeline %s: %s", p.name, p.description)
     try:

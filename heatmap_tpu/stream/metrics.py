@@ -51,7 +51,30 @@ class Metrics:
         self._span_fam = self.registry.histogram(
             "heatmap_batch_span_seconds",
             "per-batch span wall time (poll/build/pull/snap/device/"
-            "sink_submit)", labels=("span",), buckets=DEFAULT_TIME_BUCKETS)
+            "sink_submit; span=total is the whole step)",
+            labels=("span",), buckets=DEFAULT_TIME_BUCKETS)
+        # ---- freshness lineage series (obs.lineage): these measure the
+        # END-TO-END quantity the batch spans cannot — event timestamp
+        # to sink-commit ack, through prefetch queueing and the
+        # device-resident emit ring (batches park up to
+        # HEATMAP_EMIT_FLUSH_K deep, which the per-stage spans
+        # systematically understate)
+        self.event_age = self.registry.histogram(
+            "heatmap_event_age_seconds",
+            "event timestamp to sink commit ack per flushed batch "
+            "(bound=oldest/mean/newest event of the batch) — the "
+            "end-to-end ingest-to-durability freshness",
+            labels=("bound",), buckets=DEFAULT_LAG_BUCKETS)
+        self.ring_residency = self.registry.histogram(
+            "heatmap_emit_ring_residency_seconds",
+            "wall seconds a packed emit batch stayed parked in the "
+            "device emit ring before the flush that pulled it",
+            buckets=DEFAULT_TIME_BUCKETS)
+        self.ring_residency_batches = self.registry.histogram(
+            "heatmap_emit_ring_residency_batches",
+            "ring appends from a batch's own (inclusive) to the flush "
+            "that pulled it — how many batches deep it was held",
+            buckets=(1, 2, 4, 8, 16, 32, 64))
         # name -> histogram child, in observation order (snapshot() keys)
         self.spans: dict[str, object] = {}
 
@@ -71,6 +94,32 @@ class Metrics:
             if h is None:
                 h = self.spans[k] = self._span_fam.labels(span=k)
             h.observe(v)
+        # span=total rides in the span family too, so PER-STAGE vs
+        # WHOLE-STEP comparisons (and the event-age-vs-step acceptance
+        # check) stay within one labeled series
+        t = self.spans.get("total")
+        if t is None:
+            t = self.spans["total"] = self._span_fam.labels(span="total")
+        t.observe(latency_s)
+
+    def freshness_summary(self) -> dict:
+        """Event-age / ring-residency summary keys — what bench &
+        e2e_rate stamp into their artifacts and the per-child xproc
+        freshness files publish.  {} until the first flushed batch.
+        The quantiles come from the histogram's bounded RECENT window
+        (not lifetime buckets) — ``window_batches`` rides along so an
+        artifact reader knows how much of the run the p50/p99 cover;
+        the mean is lifetime (sum/count)."""
+        out: dict = {}
+        mean = self.event_age.labels(bound="mean")
+        if mean.count:
+            out["event_age_p50_s"] = round(mean.quantile(0.5), 6)
+            out["event_age_p99_s"] = round(mean.quantile(0.99), 6)
+            out["window_batches"] = len(mean.samples)
+        if self.ring_residency.count:
+            out["ring_residency_mean_s"] = round(
+                self.ring_residency.sum / self.ring_residency.count, 6)
+        return out
 
     def snapshot(self) -> dict:
         elapsed = max(time.monotonic() - self.t_start, 1e-9)
@@ -87,6 +136,7 @@ class Metrics:
         # first observation) while scrapes iterate from the HTTP thread
         for k, p in list(self.spans.items()):
             out[f"span_{k}_p50_ms"] = round(p.quantile(0.5) * 1e3, 3)
+        out.update(self.freshness_summary())
         return out
 
     def expose_text(self, extra_counters: Mapping[str, float] | None = None,

@@ -25,6 +25,7 @@ group (heatmap_stream.py:243), as-fast-as-possible triggering unless
 from __future__ import annotations
 
 import collections
+import functools
 import logging
 import os
 import threading
@@ -75,6 +76,8 @@ class _FeedBatch(NamedTuple):
     offset: object        # source offset AFTER this batch's poll
     carried: bool         # overshoot tail pending (record incomplete)
     spans: dict           # feed-stage sub-span seconds (poll/pad/snap/…)
+    lineage: object = None  # freshness lineage record opened at poll
+                            # time (obs.lineage); None on idle batches
 
 
 def _make_global_pair(mesh):
@@ -115,9 +118,42 @@ class MicroBatchRuntime:
         self.metrics = Metrics()
         self.writer = AsyncWriter(store, metrics=self.metrics)
         self.tracer = Tracer()
-        from heatmap_tpu.obs import TraceRing
+        from heatmap_tpu.obs import LineageTracker, TraceRing
 
         self.tracering = TraceRing()
+        # Freshness lineage (obs.lineage): one record per polled batch,
+        # stamped at poll -> dispatch -> ring-enter -> flush -> sink
+        # commit ack, so heatmap_event_age_seconds measures the
+        # END-TO-END staleness the prefetch stage and the emit ring hide
+        # from the per-stage spans.  Records open at poll and park in
+        # _lineage_open (epoch-keyed) from dispatch until their flush.
+        self.lineage = LineageTracker(capacity=cfg.lineage_tail)
+        self._lineage_open: dict[int, dict] = {}
+        self._fresh_pub_last = 0.0  # child-freshness publish rate limit
+        self._fresh_tag = f"p{jax.process_index()}"
+        # Flight recorder (obs.flightrec): armed when
+        # HEATMAP_FLIGHTREC_DIR is set; close() dumps on abnormal exit
+        # (fatal overflow, poisoned sink, an exception unwinding through
+        # run(), SIGTERM via stream.__main__'s SystemExit handler).
+        self.flightrec = None
+        if cfg.flightrec_dir:
+            import dataclasses as _dc
+
+            from heatmap_tpu.obs import FlightRecorder
+
+            fr = FlightRecorder(cfg.flightrec_dir)
+            fr.add_source("trace_tail", lambda: self.tracering.recent(64))
+            fr.add_source("lineage_tail", lambda: self.lineage.tail(64))
+            fr.add_source("metrics", lambda: self.metrics.snapshot())
+            fr.add_source("config", lambda: _dc.asdict(self.cfg))
+            fr.add_source("run_state", lambda: {
+                "epoch": self.epoch,
+                "max_event_ts": self.max_event_ts,
+                "ring_pending": len(self._ring),
+                "prefetched": len(self._prefetched),
+                "writer_poisoned": self.writer.poisoned,
+            })
+            self.flightrec = fr
         # pipeline-state gauges: watermark/event-time lag, state slab
         # occupancy vs capacity (the overflow early-warning), and the
         # per-shard device dispatch clock (engine.multi accumulates it;
@@ -132,6 +168,17 @@ class MicroBatchRuntime:
         self._g_active = self.metrics.gauge(
             "heatmap_state_active_groups_peak",
             "max live (cell,window) groups seen on any pair")
+        # sampled by serve/api.py at every /api/tiles/latest render:
+        # render wall time minus the newest SINK-COMMITTED event
+        # timestamp (lineage watermark) — the ingest->serve freshness
+        # the paper's real-time claim is about.  NaN until the first
+        # render after the first commit.
+        self._g_serve_fresh = self.metrics.gauge(
+            "heatmap_serve_freshness_seconds",
+            "/tiles render wall time minus the newest sink-committed "
+            "event timestamp (ingest-to-serve freshness; NaN before "
+            "the first render)")
+        self._g_serve_fresh.set(float("nan"))
         self.positions_enabled = positions_enabled
         self.checkpoint_every = checkpoint_every
         self.ckpt = CheckpointManager(cfg.checkpoint_dir)
@@ -194,6 +241,7 @@ class MicroBatchRuntime:
         else:
             self._prefix_pull = cfg.emit_pull == "prefix"
         self._carry_cols = None  # overshoot remainder of a batch-granular poll
+        self._carry_polled_at = 0.0  # lineage poll stamp of that remainder
         self._ckpt_due = False  # cadence hit while mid-carry; commit ASAP
         self._last_pull_s = 0.0  # wall of the most recent deferred pull
         self._n_active_peak = 0  # max live groups (any pair) since startup
@@ -875,7 +923,9 @@ class MicroBatchRuntime:
             # one shared live-prefix bucket instead of the full (K*P,
             # E+1, L) stack — KB instead of MB per flush on remote-
             # attached chips (engine.step.pull_packed_stack)
-            for bufs, epoch in self._ring.flush_stacked(self._prefix_pull):
+            flushed = self._ring.flush_stacked(self._prefix_pull)
+            residency = self._ring.last_flush_residency
+            for i, (bufs, epoch) in enumerate(flushed):
                 bm = I32_MIN
                 for idx, (res, win_s) in enumerate(self._multi.pairs):
                     stats = stats_from_packed(bufs[idx])
@@ -886,6 +936,8 @@ class MicroBatchRuntime:
                                                   epoch),
                     )
                 batch_max = self._book_flushed_batch(bm, batch_max)
+                self._note_flushed(
+                    epoch, residency[i] if i < len(residency) else None)
         else:
             from heatmap_tpu.parallel import multihost
             from heatmap_tpu.parallel.sharded import packed_pair_bodies
@@ -893,7 +945,9 @@ class MicroBatchRuntime:
             # sharded path: per-entry addressable pulls (stacking global
             # sharded arrays eagerly would bounce through collectives);
             # accumulation still lets the device run ahead K batches
-            for packed, epoch in self._ring.take():
+            entries = self._ring.take()
+            residency = self._ring.last_flush_residency
+            for i, (packed, epoch) in enumerate(entries):
                 rows = multihost.addressable_rows(packed)
                 bodies = packed_pair_bodies(
                     rows, self._sharded.params.emit_capacity,
@@ -907,6 +961,8 @@ class MicroBatchRuntime:
                                                   stats, epoch),
                     )
                 batch_max = self._book_flushed_batch(bm, batch_max)
+                self._note_flushed(
+                    epoch, residency[i] if i < len(residency) else None)
         # pull accounting: the fused path crosses the link once per
         # flush (the stacked transfer); the sharded path pays one
         # addressable pull PER parked entry — count what was paid
@@ -934,6 +990,49 @@ class MicroBatchRuntime:
             self.metrics.freshness.add(time.time() - bm)
             return max(batch_max, bm)
         return batch_max
+
+    def _note_flushed(self, epoch: int, residency) -> None:
+        """Per-flushed-batch freshness accounting: emit-ring residency
+        histograms (from the ring's own enter stamps) and the lineage
+        flush stamp, then a sink-commit mark so the record closes on
+        the writer thread once every write of this batch is applied."""
+        if residency is not None:
+            self.metrics.ring_residency.observe(residency[0])
+            self.metrics.ring_residency_batches.observe(residency[1])
+        rec = self._lineage_open.pop(epoch, None)
+        if rec is None:
+            return
+        self.lineage.flushed(
+            rec, ring_batches=residency[1] if residency else None)
+        self.writer.submit_mark(functools.partial(self._lineage_commit,
+                                                  rec))
+
+    def _lineage_commit(self, rec: dict) -> None:
+        """Sink-commit ack (runs ON THE WRITER THREAD, after every write
+        of the batch has been applied): close the lineage record and
+        observe the end-to-end event ages."""
+        rec = self.lineage.committed(rec)
+        for bound, age in rec["age_s"].items():
+            self.metrics.event_age.labels(bound=bound).observe(age)
+        self._publish_child_freshness()
+
+    def _publish_child_freshness(self) -> None:
+        """Cross-process freshness summary (obs.xproc): when a
+        supervisor channel is attached, publish this host's event-age /
+        ring-residency summary next to it (rate-limited 1/s; runs on
+        the writer thread, so the step loop pays nothing)."""
+        from heatmap_tpu.obs import ENV_CHANNEL
+        from heatmap_tpu.obs.xproc import publish_child_freshness
+
+        path = os.environ.get(ENV_CHANNEL)
+        if not path:
+            return
+        now = time.monotonic()
+        if now - self._fresh_pub_last < 1.0:
+            return
+        self._fresh_pub_last = now
+        publish_child_freshness(path, self._fresh_tag,
+                                self.metrics.freshness_summary())
 
     def _host_batch_max_ts(self, ts_s: np.ndarray) -> int:
         """Watermark advance for one batch, computed HOST-side with
@@ -1147,8 +1246,13 @@ class MicroBatchRuntime:
         t0 = time.monotonic()
         if self._carry_cols is not None:
             # a batch-granular source (columnar values) overshot the feed
-            # shape: drain the remainder before polling again
+            # shape: drain the remainder before polling again.  The
+            # lineage poll stamp is the ORIGINAL poll's — the tail rows
+            # have been waiting since then, and that wait must show up
+            # as queue time in the decomposition, not vanish into
+            # poll_wait.
             cols, self._carry_cols = self._carry_cols, None
+            t_polled = self._carry_polled_at
         else:
             polled = self.source.poll(self._feed_batch)
             # fetch-vs-decode split of the poll (Source.take_spans) —
@@ -1157,11 +1261,13 @@ class MicroBatchRuntime:
             for k, v in self.source.take_spans().items():
                 spans[f"poll_{k}"] = spans.get(f"poll_{k}", 0.0) + v
             cols = self._build_batch(polled)
+            t_polled = self.lineage.clock()
         if cols is not None and len(cols) > self._feed_batch:
             from heatmap_tpu.stream.events import slice_columns
 
             self._carry_cols = slice_columns(cols, self._feed_batch,
                                              len(cols))
+            self._carry_polled_at = t_polled
             cols = slice_columns(cols, 0, self._feed_batch)
         # span_poll keeps its historical meaning — source poll PLUS any
         # host columnarize/parse (_build_batch): the r5 feed-wall was
@@ -1175,6 +1281,25 @@ class MicroBatchRuntime:
         offset = self.source.offset()
         carried = self._carry_cols is not None
         n = len(cols)
+        # freshness lineage opens HERE, at poll time (wall clock +
+        # event-time extrema of the rows this batch will dispatch), so
+        # the prefetch-queue stage is measured from the poll that paid
+        # the work, not from the step that consumed it.  Clock-skew
+        # poison rows (far-future timestamps, e.g. an ms-for-s unit
+        # error) are excluded from the extrema the same way the device
+        # fold drops them: one such row would otherwise latch the
+        # newest-committed watermark into the future forever, pinning
+        # heatmap_serve_freshness_seconds negative and hiding real
+        # staleness from the event-age SLO.
+        ts_col = cols.ts_s
+        sane = ts_col.astype(np.int64) <= int(t_polled) + 3600
+        lin = None
+        if sane.any():
+            tv = ts_col if sane.all() else ts_col[sane]
+            lin = self.lineage.open(
+                n_events=n, ev_min_ts=int(tv.min()),
+                ev_max_ts=int(tv.max()), ev_mean_ts=float(tv.mean()),
+                offset=offset, t_poll=t_polled)
         t1 = time.monotonic()
         valid = np.zeros(self._feed_batch, bool)
         valid[:n] = True
@@ -1207,7 +1332,8 @@ class MicroBatchRuntime:
         spans["transfer"] = time.monotonic() - t3
         spans["build"] = spans["pad"] + spans["transfer"]
         return _FeedBatch(cols=cols, n=n, feed=feed, prekeys=prekeys,
-                          offset=offset, carried=carried, spans=spans)
+                          offset=offset, carried=carried, spans=spans,
+                          lineage=lin)
 
     def _step_once_inner(self) -> bool:
         t0 = time.monotonic()
@@ -1257,6 +1383,11 @@ class MicroBatchRuntime:
                     else self._sharded)
             prekeys = self._presnap(feed["lat"], feed["lng"],
                                     feed["valid"], None, agg_._uniq_res)
+        lin = entry.lineage
+        if lin is not None:
+            # lineage: the batch leaves the prefetch queue and enters
+            # the fold under THIS epoch
+            self.lineage.dispatched(lin, self.epoch)
         if self._multi is not None:
             # fused path: one dispatch for every (res, window) pair; the
             # packed emits + stats park in the device-resident ring and
@@ -1274,6 +1405,9 @@ class MicroBatchRuntime:
                 feed["lat"], feed["lng"], feed["speed"], feed["ts"],
                 feed["valid"], cutoff, prekeys=prekeys)
         self._ring.append(packed, self.epoch)
+        if lin is not None:
+            self.lineage.ring_entered(lin)
+            self._lineage_open[self.epoch] = lin
         self._carried_last = entry.carried
         if not entry.carried:
             # offsets only advance once EVERY row of the polled records
@@ -1490,6 +1624,30 @@ class MicroBatchRuntime:
             self.close()
 
     def close(self) -> None:
+        if self.flightrec is not None:
+            # Flight record BEFORE the drain, so ring/prefetch depths
+            # still describe the incident.  Abnormal = fatal overflow, a
+            # poisoned sink, or an exception unwinding through run()'s
+            # finally into this close (sys.exc_info() sees it) — incl.
+            # the SystemExit stream.__main__ raises on SIGTERM.  A
+            # normal close writes nothing unless HEATMAP_FLIGHTREC_
+            # ALWAYS=1; either way the recorder then stands down so the
+            # atexit backstop cannot double-dump.
+            import sys as _sys
+
+            exc = _sys.exc_info()[1]
+            if isinstance(exc, SystemExit) and not exc.code:
+                exc = None  # sys.exit(0) mid-run is a clean shutdown
+            if self._fatal or self.writer.poisoned or exc is not None:
+                why = ("fatal state overflow" if self._fatal
+                       else "poisoned sink" if self.writer.poisoned
+                       else f"abnormal exit: {type(exc).__name__}: {exc}")
+                self.flightrec.dump(why)
+            elif os.environ.get("HEATMAP_FLIGHTREC_ALWAYS") == "1":
+                self.flightrec.dump("clean close "
+                                    "(HEATMAP_FLIGHTREC_ALWAYS=1)")
+            else:
+                self.flightrec.disarm()
         self.tracer.stop()  # flush a partial profiler capture, if any
         self.tracering.close()  # flush/close the JSONL trace export
         self._closing = True  # no further prefetch refills

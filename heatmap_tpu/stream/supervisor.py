@@ -250,6 +250,20 @@ class Supervisor:
             self.channel.note_failure(
                 reason, stalled=reason.startswith("stall"),
                 window_s=max(3600.0, p.window_s))
+            # supervisor-side flight record (obs.flightrec): the child's
+            # own recorder misses hard deaths (SIGKILL, a wedged device
+            # op the stall detector shot) — dump the PARENT's view so
+            # every failure leaves a post-mortem artifact.  Best-effort:
+            # dump_snapshot never raises.
+            frdir = self.env.get("HEATMAP_FLIGHTREC_DIR")
+            if frdir:
+                from heatmap_tpu.obs.flightrec import dump_snapshot
+
+                dump_snapshot(frdir, f"supervisor: child failed ({reason})",
+                              {"channel": dict(self.channel.state),
+                               "argv": self.argv,
+                               "failed_over": self.failed_over,
+                               "restarts": self.restarts})
             if healthy_span > p.window_s:
                 # the child ran healthy for a full budget window before
                 # this failure — an isolated blip, not a streak.  Without

@@ -1,0 +1,25 @@
+"""Tier-1 guard: an undocumented /metrics family FAILS the suite.
+
+The ARCHITECTURE.md metrics table is the operator contract (dashboards
+and alerts are written against it), and nothing else keeps it honest:
+a registry family with an empty HELP string or no table row ships
+silently.  tools/check_metrics_docs.py smoke-assembles a real runtime
+and cross-checks every exposed family; running it here (same pattern as
+check_native_build) turns doc drift into a red suite.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def test_metrics_families_documented():
+    tool = os.path.join(REPO, "tools", "check_metrics_docs.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    p = subprocess.run([sys.executable, tool], capture_output=True,
+                       text=True, timeout=280, env=env, cwd=REPO)
+    assert p.returncode == 0, (
+        f"metrics docs check failed:\n{p.stdout}\n{p.stderr[-4000:]}")
+    assert "OK:" in p.stdout, p.stdout

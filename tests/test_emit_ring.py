@@ -104,6 +104,29 @@ def test_emitring_refuses_shape_change():
         ring.append(np.zeros((1, 17, 13), np.uint32))
 
 
+def test_emitring_residency_accounting():
+    """take()/flush_stacked record per-entry residency: seconds parked
+    and batches-resident (appends from the entry's own, inclusive, to
+    the flush — the oldest entry of a K-deep flush reads K)."""
+    from heatmap_tpu.engine.step import EmitRing
+
+    ring = EmitRing(4)
+    a = np.zeros((2, 3, 4), np.uint32)
+    for tag in range(3):
+        ring.append(a, tag)
+    entries = ring.take()
+    res = ring.last_flush_residency
+    assert len(entries) == len(res) == 3
+    assert [b for _, b in res] == [3, 2, 1]
+    assert all(s >= 0.0 for s, _ in res)
+    # the lifetime append counter keeps counting across flushes
+    ring.append(a, 9)
+    ring.take()
+    assert [b for _, b in ring.last_flush_residency] == [1]
+    ring.take()
+    assert ring.last_flush_residency == []
+
+
 def test_emitring_capacity():
     from heatmap_tpu.engine.step import EmitRing
 

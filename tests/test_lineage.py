@@ -1,0 +1,301 @@
+"""Freshness lineage (obs.lineage) + flight recorder (obs.flightrec).
+
+The acceptance pins of ISSUE 3: the per-stage decomposition is
+conservation-exact under a synthetic clock; with the emit ring holding
+K>1 batches the END-TO-END event age strictly exceeds the per-step span
+total (the staleness the PR 2 telemetry could not see); a killed stream
+leaves a parseable flightrec-*.json while a normal close leaves none
+unless HEATMAP_FLIGHTREC_ALWAYS=1.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+from heatmap_tpu.config import load_config
+from heatmap_tpu.obs import LineageTracker
+from heatmap_tpu.sink import MemoryStore
+from heatmap_tpu.stream import MicroBatchRuntime
+from heatmap_tpu.stream.source import MemorySource
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# ------------------------------------------------- tracker unit level
+def test_lineage_conservation_synthetic_clock():
+    """The decomposition telescopes EXACTLY: age(mean event -> ack) ==
+    poll_wait + prefetch_queue + fold + ring + sink_commit."""
+    clk = FakeClock(1000.0)
+    tr = LineageTracker(capacity=8, clock=clk)
+    rec = tr.open(n_events=10, ev_min_ts=900, ev_max_ts=980,
+                  ev_mean_ts=950.0, offset=42)
+    clk.advance(1.5)              # waiting in the prefetch queue
+    tr.dispatched(rec, epoch=7)
+    clk.advance(0.25)             # fold dispatch
+    tr.ring_entered(rec)
+    clk.advance(3.0)              # held K flushes in the emit ring
+    tr.flushed(rec, ring_batches=4)
+    clk.advance(0.5)              # sink commit
+    tr.committed(rec)
+
+    st = rec["stages"]
+    assert st == {"poll_wait": 50.0, "prefetch_queue": 1.5, "fold": 0.25,
+                  "ring": 3.0, "sink_commit": 0.5}
+    assert rec["age_s"]["mean"] == sum(st.values())      # conservation
+    assert rec["age_s"]["oldest"] == rec["age_s"]["mean"] + 50.0
+    assert rec["age_s"]["newest"] == rec["age_s"]["mean"] - 30.0
+    assert rec["epoch"] == 7 and rec["ring_batches"] == 4
+    assert tr.newest_committed_ts == 980
+    tail = tr.tail(5)
+    assert len(tail) == 1 and tail[0]["seq"] == rec["seq"]
+
+
+def test_lineage_tail_bounded_and_newest_first():
+    clk = FakeClock()
+    tr = LineageTracker(capacity=3, clock=clk)
+    for i in range(6):
+        r = tr.open(n_events=1, ev_min_ts=i, ev_max_ts=i, ev_mean_ts=i)
+        tr.dispatched(r, i)
+        tr.ring_entered(r)
+        tr.flushed(r)
+        tr.committed(r)
+    tail = tr.tail(10)
+    assert [r["epoch"] for r in tail] == [5, 4, 3]
+    assert tr.newest_committed_ts == 5
+    assert len(tr) == 3
+
+
+def test_json_safe_offsets():
+    import numpy as np
+
+    from heatmap_tpu.obs.lineage import json_safe
+
+    v = json_safe({"p0": np.int64(7), "nested": [np.float32(1.5), None],
+                   "obj": object()})
+    json.dumps(v)  # must not raise
+    assert v["p0"] == 7 and v["nested"][0] == 1.5
+    assert isinstance(v["obj"], str)
+
+
+# ------------------------------------------------- runtime integration
+def _mk_events(n, t0=None):
+    t0 = int(time.time()) if t0 is None else t0
+    return [{"provider": "p", "vehicleId": f"v{i % 7}",
+             "lat": 42.0 + (i % 40) * 1e-3, "lon": -71.0,
+             "speedKmh": 10.0, "ts": t0} for i in range(n)]
+
+
+def _mk_cfg(tmp_path, **over):
+    over.setdefault("checkpoint_dir", str(tmp_path / "ckpt"))
+    over.setdefault("batch_size", 16)
+    over.setdefault("state_capacity_log2", 10)
+    over.setdefault("speed_hist_bins", 4)
+    over.setdefault("store", "memory")
+    return load_config({}, **over)
+
+
+def test_event_age_exceeds_span_total_under_ring_hold(tmp_path):
+    """With the emit ring parking K=4 batches (and a 15 ms trigger), the
+    END-TO-END event age p50 strictly exceeds the per-step span-total
+    p50 — the staleness the per-stage spans systematically understate —
+    and the ring stage of the decomposition accounts for the hold."""
+    cfg = _mk_cfg(tmp_path, emit_flush_k=4, prefetch_batches=0,
+                  trigger_ms=15)
+    src = MemorySource(_mk_events(16 * 12))
+    src.finish()
+    rt = MicroBatchRuntime(cfg, src, MemoryStore(), checkpoint_every=0)
+    rt.run()
+
+    ea = rt.metrics.event_age.labels(bound="mean")
+    tot = rt.metrics.spans["total"]
+    assert ea.count >= 12
+    assert ea.quantile(0.5) > tot.quantile(0.5)
+
+    recs = rt.lineage.tail(100)
+    assert len(recs) == 12
+    # conservation holds on the live clock too (shared stamps telescope)
+    for r in recs:
+        assert abs(r["age_s"]["mean"] - sum(r["stages"].values())) < 5e-3
+    # a batch held the full K=4 interval shows the hold in its ring
+    # stage: >= 2 trigger sleeps of the steps that ran past it
+    deep = [r for r in recs if r.get("ring_batches") == 4]
+    assert deep
+    assert all(r["stages"]["ring"] >= 2 * 0.015 for r in deep)
+    # ring residency histograms saw every flushed batch, K deep at most
+    assert rt.metrics.ring_residency_batches.count == 12
+    assert max(rt.metrics.ring_residency_batches.samples) == 4
+    assert rt.metrics.ring_residency.count == 12
+
+
+def test_flush_k1_ring_residency_is_shallow(tmp_path):
+    """K=1 (the pre-ring behavior): every batch flushes one append deep."""
+    cfg = _mk_cfg(tmp_path, emit_flush_k=1, prefetch_batches=0)
+    src = MemorySource(_mk_events(16 * 3))
+    src.finish()
+    rt = MicroBatchRuntime(cfg, src, MemoryStore(), checkpoint_every=0)
+    rt.run()
+    assert set(rt.metrics.ring_residency_batches.samples) == {1}
+    assert len(rt.lineage) == 3
+
+
+def test_lineage_ignores_clock_skew_poison(tmp_path):
+    """A far-future poison timestamp (clock skew / unit error) must not
+    latch the newest-committed watermark into the future — that would
+    pin serve freshness negative and hide real staleness forever."""
+    evs = _mk_events(32)
+    evs[5]["ts"] = int(time.time()) + 10**8  # ~3 years in the future
+    cfg = _mk_cfg(tmp_path, emit_flush_k=1, prefetch_batches=0)
+    src = MemorySource(evs)
+    src.finish()
+    rt = MicroBatchRuntime(cfg, src, MemoryStore(), checkpoint_every=0)
+    rt.run()
+    assert rt.lineage.newest_committed_ts is not None
+    assert rt.lineage.newest_committed_ts <= time.time() + 3600
+    for r in rt.lineage.tail(10):
+        assert r["age_s"]["newest"] > 0  # no negative event ages
+
+
+# ------------------------------------------------- flight recorder
+def test_flightrec_on_injected_crash(tmp_path):
+    from heatmap_tpu.testing.faults import CrashingSource, InjectedCrash
+
+    frdir = tmp_path / "fr"
+    cfg = _mk_cfg(tmp_path, emit_flush_k=1, prefetch_batches=0,
+                  flightrec_dir=str(frdir))
+    src = CrashingSource(MemorySource(_mk_events(48)),
+                         crash_after_polls=2)
+    rt = MicroBatchRuntime(cfg, src, MemoryStore(), checkpoint_every=0)
+    with pytest.raises(InjectedCrash):
+        rt.run()
+    files = sorted(frdir.glob("flightrec-*.json"))
+    assert len(files) == 1
+    d = json.loads(files[0].read_text())
+    assert d["reason"].startswith("abnormal exit: InjectedCrash")
+    assert d["trace_tail"], "trace tail must capture the pre-crash batches"
+    assert isinstance(d["lineage_tail"], list)
+    assert d["metrics"].get("events_valid", 0) > 0
+    assert d["config"]["batch_size"] == 16
+    assert d["run_state"]["epoch"] >= 1
+
+
+def test_flightrec_normal_close_writes_none_unless_always(tmp_path,
+                                                          monkeypatch):
+    frdir = tmp_path / "fr"
+    for always, expect in ((None, 0), ("1", 1)):
+        if always is None:
+            monkeypatch.delenv("HEATMAP_FLIGHTREC_ALWAYS", raising=False)
+        else:
+            monkeypatch.setenv("HEATMAP_FLIGHTREC_ALWAYS", always)
+        cfg = _mk_cfg(tmp_path, flightrec_dir=str(frdir),
+                      checkpoint_dir=str(tmp_path / f"ck-{expect}"))
+        src = MemorySource(_mk_events(32))
+        src.finish()
+        rt = MicroBatchRuntime(cfg, src, MemoryStore(), checkpoint_every=0)
+        rt.run()
+        files = list(frdir.glob("flightrec-*.json"))
+        assert len(files) == expect, (
+            f"HEATMAP_FLIGHTREC_ALWAYS={always}: {files}")
+    d = json.loads(files[0].read_text())
+    assert d["reason"].startswith("clean close")
+
+
+def test_flightrec_dump_once_and_source_errors_contained(tmp_path):
+    from heatmap_tpu.obs import FlightRecorder
+
+    rec = FlightRecorder(str(tmp_path))
+    rec.add_source("ok", lambda: {"x": 1})
+    rec.add_source("broken", lambda: 1 / 0)
+    p1 = rec.dump("first")
+    assert p1 and rec.dump("second") is None  # once-only
+    d = json.loads(open(p1).read())
+    assert d["ok"] == {"x": 1}
+    assert d["broken"].startswith("<source failed: ZeroDivisionError")
+    rec2 = FlightRecorder(str(tmp_path))
+    rec2.disarm()
+    assert rec2.dump("after disarm") is None
+
+
+def test_flightrec_retention_bounded(tmp_path):
+    """A flapping supervised stream writes one dump per failure; the
+    directory stays bounded at RETAIN files instead of filling disk."""
+    from heatmap_tpu.obs import FlightRecorder
+    from heatmap_tpu.obs.flightrec import dump_snapshot
+
+    for i in range(FlightRecorder.RETAIN + 5):
+        assert dump_snapshot(str(tmp_path), f"failure {i}", {"i": i})
+    files = sorted(tmp_path.glob("flightrec-*.json"))
+    assert len(files) == FlightRecorder.RETAIN
+    # the newest dump survived the pruning
+    assert any(json.loads(p.read_text())["i"] == FlightRecorder.RETAIN + 4
+               for p in files)
+
+
+_SIGTERM_CHILD = """
+import os, sys, time
+sys.path.insert(0, os.environ["REPO_ROOT"])
+from heatmap_tpu.config import load_config
+from heatmap_tpu.sink import MemoryStore
+from heatmap_tpu.stream import MicroBatchRuntime
+from heatmap_tpu.stream.source import MemorySource
+from heatmap_tpu.stream.__main__ import install_flightrec_handlers
+
+t0 = int(time.time())
+evs = [{"provider": "p", "vehicleId": f"v{i}", "lat": 42.0 + i * 1e-3,
+        "lon": -71.0, "speedKmh": 5.0, "ts": t0} for i in range(32)]
+cfg = load_config({}, batch_size=16, state_capacity_log2=10,
+                  speed_hist_bins=4, store="memory",
+                  flightrec_dir=os.environ["FRDIR"],
+                  checkpoint_dir=os.environ["CKPT"])
+src = MemorySource(evs)   # NOT finished: the loop idles until SIGTERM
+rt = MicroBatchRuntime(cfg, src, MemoryStore(), checkpoint_every=0)
+install_flightrec_handlers(rt)
+rt.run()
+"""
+
+
+def test_flightrec_on_sigterm(tmp_path):
+    """The acceptance kill test: SIGTERM a running stream; the handler
+    (stream.__main__) turns it into a SystemExit, close() sees the
+    unwinding exception and writes the flight record."""
+    frdir = tmp_path / "fr"
+    hb = tmp_path / "hb"
+    env = {**os.environ, "REPO_ROOT": REPO, "FRDIR": str(frdir),
+           "CKPT": str(tmp_path / "ckpt"), "JAX_PLATFORMS": "cpu",
+           "HEATMAP_HEARTBEAT_FILE": str(hb), "PYTHONPATH": ""}
+    proc = subprocess.Popen([sys.executable, "-c", _SIGTERM_CHILD],
+                            env=env, cwd=REPO)
+    try:
+        deadline = time.monotonic() + 180
+        while not hb.exists():  # first beacon == first completed step
+            assert proc.poll() is None, "child died before first step"
+            assert time.monotonic() < deadline, "child never started"
+            time.sleep(0.1)
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert rc != 0
+    files = sorted(frdir.glob("flightrec-*.json"))
+    assert len(files) == 1, "SIGTERM must leave exactly one flight record"
+    d = json.loads(files[0].read_text())
+    assert "SystemExit" in d["reason"]
+    assert d["trace_tail"] and "metrics" in d and "config" in d

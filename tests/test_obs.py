@@ -143,6 +143,55 @@ def test_trace_ring_jsonl_errors_never_raise(tmp_path):
     assert ring.recent(1)[0]["epoch"] == 0
 
 
+def test_trace_jsonl_size_rotation(tmp_path):
+    """Size-bounded export: one .1 rollover, no record lost across the
+    rotation boundary, disk usage capped near 2x the limit."""
+    jl = tmp_path / "t.jsonl"
+    ring = TraceRing(capacity=4, jsonl_path=str(jl), jsonl_max_bytes=400)
+    for i in range(40):
+        ring.record(i, 0.001, {"poll": 0.1})
+    ring.close()
+    ro = tmp_path / "t.jsonl.1"
+    assert ro.exists(), "rotation must have produced the .1 rollover"
+    rows_old = [json.loads(ln) for ln in open(ro)]
+    rows_new = [json.loads(ln) for ln in open(jl)] if jl.exists() else []
+    assert rows_old and len(rows_old) + len(rows_new) <= 40
+    if rows_new:  # strictly ordered across the boundary
+        assert rows_old[-1]["seq"] < rows_new[0]["seq"]
+    # current file stays bounded (limit + one record of slack)
+    if jl.exists():
+        assert jl.stat().st_size <= 400 + 200
+    assert ro.stat().st_size <= 400 + 200
+
+
+def test_trace_jsonl_rotation_failure_latches_dead(tmp_path, monkeypatch):
+    """A failing rotation disables the export (the existing dead-file
+    latch) instead of raising into the step loop."""
+    from heatmap_tpu.obs import tracebuf
+
+    jl = tmp_path / "t.jsonl"
+    ring = TraceRing(capacity=4, jsonl_path=str(jl), jsonl_max_bytes=100)
+
+    def boom(src, dst):
+        raise OSError("injected rotation failure")
+
+    monkeypatch.setattr(tracebuf.os, "replace", boom)
+    for i in range(10):
+        ring.record(i, 0.001, {})  # crosses the limit: rotation fails
+    assert ring._jsonl_dead
+    ring.record(99, 0.001, {})  # still silent after the latch
+    assert ring.recent(1)[0]["epoch"] == 99
+
+
+def test_trace_jsonl_max_bytes_env_tolerant(tmp_path):
+    ring = TraceRing(
+        capacity=2, env={"HEATMAP_TRACE_JSONL": str(tmp_path / "t.jsonl"),
+                         "HEATMAP_TRACE_JSONL_MAX_BYTES": "bogus"})
+    ring.record(0, 0.001, {})  # bad knob: default applies, no crash
+    ring.close()
+    assert ring._jsonl_max == 64 << 20
+
+
 # ------------------------------------------------------------ xproc
 def test_channel_roundtrip_and_resume(tmp_path):
     path = str(tmp_path / "chan")
@@ -241,3 +290,204 @@ def test_supervisor_channel_survives_child_kill(tmp_path):
             httpd.shutdown()
     finally:
         del os.environ[ENV_CHANNEL]
+
+
+def test_child_freshness_publish_roundtrip(tmp_path):
+    """A child runtime's freshness summary published next to the
+    channel surfaces as per-child gauges on any /metrics holding the
+    same channel path (lineage stays host-local; only the summary
+    crosses processes)."""
+    from heatmap_tpu.obs.xproc import (child_freshness_from,
+                                       publish_child_freshness)
+    from heatmap_tpu.serve.api import _child_freshness_lines
+
+    chan = str(tmp_path / "chan")
+    publish_child_freshness(chan, "p0", {"event_age_p50_s": 1.25,
+                                         "event_age_p99_s": 4.5,
+                                         "ring_residency_mean_s": 0.02})
+    publish_child_freshness(chan, "p1", {"event_age_p50_s": 9.0})
+    kids = child_freshness_from(chan)
+    assert set(kids) == {"p0", "p1"}
+    assert kids["p0"]["event_age_p50_s"] == 1.25
+    joined = "\n".join(_child_freshness_lines(chan))
+    assert 'heatmap_child_event_age_p50_s{child="p0"} 1.25' in joined
+    assert 'heatmap_child_event_age_p50_s{child="p1"} 9' in joined
+    assert joined.count("# TYPE heatmap_child_event_age_p50_s gauge") == 1
+    # unwritable + absent paths degrade silently
+    publish_child_freshness(str(tmp_path / "no" / "chan"), "p0", {})
+    assert child_freshness_from(None) == {}
+    # a dead child's stale summary drops out (updated_unix past the
+    # window) instead of exporting a frozen-green gauge forever
+    assert set(child_freshness_from(chan, max_age_s=-1.0)) == set()
+    stale = json.loads(open(chan + ".fresh-p1").read())
+    stale["updated_unix"] = 1000.0
+    with open(chan + ".fresh-p1", "w") as fh:
+        json.dump(stale, fh)
+    assert set(child_freshness_from(chan)) == {"p0"}
+
+
+# ------------------------------------- exposition grammar validation
+_SAMPLE_RE = __import__("re").compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? '
+    r'(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?|NaN|[+-]Inf)$')
+_LABEL_RE = __import__("re").compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\\\|\\"|\\n)*)"')
+
+
+def _validate_exposition(text):
+    """Grammar-level validation of the Prometheus text format (0.0.4):
+    HELP/TYPE ordering and uniqueness, sample syntax, label escaping,
+    `le` bucket monotonicity, +Inf bucket == _count, _sum presence, no
+    duplicate samples.  Raises AssertionError with the offending line."""
+    types, helps, seen_samples = {}, {}, set()
+    hist_buckets: dict = {}   # (family, labels-sans-le) -> [(le, cum)]
+    hist_counts: dict = {}
+    hist_sums = set()
+    for ln in text.rstrip("\n").split("\n"):
+        if ln.startswith("# HELP "):
+            name = ln.split(" ", 3)[2]
+            assert name not in types, f"HELP after TYPE for {name}: {ln}"
+            helps[name] = True
+            continue
+        if ln.startswith("# TYPE "):
+            _, _, name, mtype = ln.split(" ", 3)
+            assert name not in types, f"duplicate TYPE for {name}"
+            assert mtype in ("counter", "gauge", "histogram",
+                             "summary", "untyped"), ln
+            types[name] = mtype
+            continue
+        assert not ln.startswith("#"), f"unknown comment: {ln}"
+        m = _SAMPLE_RE.match(ln)
+        assert m, f"malformed sample line: {ln!r}"
+        series, labels, val = m.group(1), m.group(2) or "", m.group(3)
+        assert ln not in seen_samples, f"duplicate sample: {ln}"
+        seen_samples.add(ln)
+        # the label block must be FULLY consumed by valid escaped pairs
+        if labels:
+            rebuilt = ",".join(
+                f'{k}="{v}"' for k, v in _LABEL_RE.findall(labels))
+            assert rebuilt == labels, f"bad label escaping: {labels!r}"
+        fam = series
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = series.removesuffix(suffix)
+            if series.endswith(suffix) and types.get(base) == "histogram":
+                fam = base
+                break
+        ftype = types.get(fam)
+        assert ftype is not None, f"sample before TYPE: {ln}"
+        if ftype == "counter":
+            assert float(val) >= 0, f"negative counter: {ln}"
+        if ftype == "histogram":
+            pairs = dict(_LABEL_RE.findall(labels))
+            le = pairs.pop("le", None)
+            key = (fam, tuple(sorted(pairs.items())))
+            if series == fam + "_bucket":
+                assert le is not None, f"bucket without le: {ln}"
+                b = float("inf") if le == "+Inf" else float(le)
+                hist_buckets.setdefault(key, []).append((b, float(val)))
+            elif series == fam + "_count":
+                hist_counts[key] = float(val)
+            elif series == fam + "_sum":
+                hist_sums.add(key)
+    for key, buckets in hist_buckets.items():
+        les = [b for b, _ in buckets]
+        cums = [c for _, c in buckets]
+        assert les == sorted(les), f"le out of order: {key}"
+        assert cums == sorted(cums), f"non-cumulative buckets: {key}"
+        assert les[-1] == float("inf"), f"missing +Inf bucket: {key}"
+        assert key in hist_counts, f"missing _count: {key}"
+        assert cums[-1] == hist_counts[key], f"+Inf != _count: {key}"
+        assert key in hist_sums, f"missing _sum: {key}"
+    # NOTE: HELP is optional per the format (the generic flat-counter
+    # renderer emits TYPE-only series); non-empty HELP on every REGISTRY
+    # family is enforced separately by tools/check_metrics_docs.py.
+    return helps
+
+
+def test_exposition_grammar_full_surface():
+    """Grammar-validate the COMPLETE exposition a runtime-shaped
+    Metrics produces: typed registry series (incl. labeled histograms
+    and nasty label values), the generic flat-counter rendering, and
+    supervisor-style extra lines."""
+    from heatmap_tpu.serve.api import _supervisor_lines
+    from heatmap_tpu.stream.metrics import Metrics
+
+    m = Metrics()
+    m.observe_batch(0.012, {"poll": 0.001, "device": 0.01})
+    m.observe_batch(3.5, {"poll": 2.0})
+    m.count("events_valid", 64)
+    m.count("weird name!", 2)
+    m.freshness.add(1.5)
+    m.event_age.labels(bound="mean").observe(2.5)
+    m.event_age.labels(bound="oldest").observe(9.0)
+    m.ring_residency.observe(0.004)
+    m.ring_residency_batches.observe(3)
+    g = m.registry.gauge("heatmap_nasty", "labels get escaped",
+                         labels=("k",))
+    g.labels(k='a"b\\c\nd').set(1)
+    m.registry.gauge("heatmap_nan_gauge", "NaN renders fine").set(
+        float("nan"))
+    txt = m.expose_text(
+        extra_counters={"tiles_written": 5, "sink_backpressure_ms": 3},
+        extra_lines=_supervisor_lines({"restarts_total": 2,
+                                       "child_running": 1}))
+    _validate_exposition(txt)
+
+
+def test_exposition_validator_catches_breakage():
+    with pytest.raises(AssertionError):
+        _validate_exposition("# TYPE x counter\nx{bad-label=} 1")
+    with pytest.raises(AssertionError):  # non-cumulative buckets
+        _validate_exposition(
+            "# HELP h h\n# TYPE h histogram\n"
+            'h_bucket{le="0.1"} 5\nh_bucket{le="1"} 3\n'
+            'h_bucket{le="+Inf"} 5\nh_sum 1\nh_count 5')
+    with pytest.raises(AssertionError):  # +Inf != _count
+        _validate_exposition(
+            "# HELP h h\n# TYPE h histogram\n"
+            'h_bucket{le="0.1"} 1\nh_bucket{le="+Inf"} 2\n'
+            "h_sum 1\nh_count 3")
+    with pytest.raises(AssertionError):  # duplicate TYPE
+        _validate_exposition("# TYPE x counter\n# TYPE x counter\nx 1")
+
+
+# ------------------------------------------------------------ obs_top
+def _load_obs_top():
+    import importlib.util
+
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        os.pardir))
+    spec = importlib.util.spec_from_file_location(
+        "obs_top", os.path.join(repo, "tools", "obs_top.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_obs_top_parse_and_quantile():
+    top = _load_obs_top()
+    text = (
+        "# HELP heatmap_batch_latency_seconds x\n"
+        "# TYPE heatmap_batch_latency_seconds histogram\n"
+        'heatmap_batch_latency_seconds_bucket{le="0.1"} 2\n'
+        'heatmap_batch_latency_seconds_bucket{le="0.5"} 8\n'
+        'heatmap_batch_latency_seconds_bucket{le="1"} 10\n'
+        'heatmap_batch_latency_seconds_bucket{le="+Inf"} 10\n'
+        "heatmap_batch_latency_seconds_sum 3.2\n"
+        "heatmap_batch_latency_seconds_count 10\n"
+        "heatmap_events_valid_total 1000\n"
+        "heatmap_emit_ring_pending 3\n")
+    m = top.parse_prom(text)
+    assert m["heatmap_events_valid_total"][""] == 1000
+    buckets = m["heatmap_batch_latency_seconds_bucket"]
+    # lifetime p50: target 5 falls in the (0.1, 0.5] bucket, halfway
+    p50 = top.hist_quantile(buckets, None, 0.5)
+    assert p50 == pytest.approx(0.3)
+    # delta mode: previous scrape had the first 2 observations only
+    prev = {'{le="0.1"}': 2.0, '{le="0.5"}': 2.0, '{le="1"}': 2.0,
+            '{le="+Inf"}': 2.0}
+    p50d = top.hist_quantile(buckets, prev, 0.5)
+    assert 0.1 < p50d <= 0.5
+    assert top.hist_quantile({}, None, 0.5) is None
+    frame = top.render_frame(m, None, 0.0, {"status": "ok", "checks": {}})
+    assert "ingest" in frame and "SLO" in frame and "OK" in frame

@@ -433,7 +433,7 @@ def test_fast_tiles_json_grid_filter_byte_identical(store):
 
 
 # ---------------------------------------------------------------- obs
-def _mini_runtime(tmpdir, events=32, batch=16):
+def _mini_runtime(tmpdir, events=32, batch=16, **cfg_over):
     """A tiny real runtime, run to exhaustion (closed), with its metrics
     intact for the serving layer."""
     import tempfile
@@ -448,7 +448,8 @@ def _mini_runtime(tmpdir, events=32, batch=16):
             "lon": -71.0, "speedKmh": 1.0, "ts": t0} for i in range(events)]
     cfg = load_config({}, batch_size=batch, state_capacity_log2=8,
                       speed_hist_bins=4, store="memory", serve_port=0,
-                      checkpoint_dir=tempfile.mkdtemp(dir=tmpdir))
+                      checkpoint_dir=tempfile.mkdtemp(dir=tmpdir),
+                      **cfg_over)
     src = MemorySource(evs)
     src.finish()
     st = _MS()
@@ -567,6 +568,102 @@ def test_healthz_slo_transitions(tmp_path, monkeypatch):
         assert ei.value.code == 503
         assert json.loads(ei.value.read())["status"] == "down"
         rt.writer._exc = None
+    finally:
+        httpd.shutdown()
+
+
+def test_trace_recent_fields_projection(tmp_path):
+    """?fields= returns slim traces; an invalid name answers 400 with
+    an error body instead of guessing."""
+    cfg, st, rt = _mini_runtime(str(tmp_path), events=48, batch=16)
+    httpd, _t, port = start_background(st, cfg, runtime=rt, port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        tr = get_json(base + "/trace/recent?n=2&fields=epoch,n_events")
+        assert len(tr["traces"]) == 2
+        assert all(set(r) == {"epoch", "n_events"} for r in tr["traces"])
+        # unknown-but-valid names simply drop out of the projection
+        tr = get_json(base + "/trace/recent?n=1&fields=epoch,nope")
+        assert set(tr["traces"][0]) == {"epoch"}
+        # percent-encoded commas (any urlencode-ing client) decode fine
+        tr = get_json(base + "/trace/recent?n=1&fields=epoch%2Cn_events")
+        assert set(tr["traces"][0]) == {"epoch", "n_events"}
+        for bad in ("fields=", "fields=bad-name",
+                    "fields=" + ",".join(f"f{i}" for i in range(17))):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"{base}/trace/recent?{bad}", timeout=10)
+            assert ei.value.code == 400
+            assert "error" in json.loads(ei.value.read())
+    finally:
+        httpd.shutdown()
+
+
+def test_debug_freshness_endpoint(tmp_path):
+    """/debug/freshness returns the per-stage decomposition for the
+    last N lineage records plus the event-age summary."""
+    cfg, st, rt = _mini_runtime(str(tmp_path), events=64, batch=16,
+                                emit_flush_k=2)
+    httpd, _t, port = start_background(st, cfg, runtime=rt, port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        d = get_json(base + "/debug/freshness")
+        assert d["stage_order"] == ["poll_wait", "prefetch_queue",
+                                    "fold", "ring", "sink_commit"]
+        assert len(d["records"]) == 4  # 64 events / 16-batch
+        newest = d["records"][0]
+        assert set(newest["stages"]) == set(d["stage_order"])
+        assert newest["epoch"] > d["records"][1]["epoch"]
+        # the decomposition conserves: stages sum to the mean event age
+        assert sum(newest["stages"].values()) == pytest.approx(
+            newest["age_s"]["mean"], abs=5e-3)
+        assert d["summary"]["event_age_p50_s"] > 0
+        assert "ring_residency_mean_s" in d["summary"]
+        assert len(get_json(base + "/debug/freshness?n=1")["records"]) == 1
+        # the tiles render samples the ingest->serve freshness gauge
+        with urllib.request.urlopen(base + "/api/tiles/latest",
+                                    timeout=10):
+            pass
+        v = rt._g_serve_fresh.value
+        assert v == v and 0 < v < 120  # not NaN; sane recent freshness
+    finally:
+        httpd.shutdown()
+
+
+def test_debug_freshness_without_runtime():
+    httpd, _t, port = start_background(MemoryStore(),
+                                       load_config({}, serve_port=0),
+                                       port=0)
+    try:
+        d = get_json(f"http://127.0.0.1:{port}/debug/freshness")
+        assert d["records"] == [] and d["summary"] == {}
+    finally:
+        httpd.shutdown()
+
+
+def test_healthz_event_age_freshness_slo(tmp_path, monkeypatch):
+    """The acceptance transition: a ring-held runtime (K>1) breaches a
+    tight HEATMAP_SLO_FRESHNESS_P50_MS — /healthz degrades on the
+    END-TO-END event age while every batch-span SLO stays green."""
+    cfg, st, rt = _mini_runtime(str(tmp_path), events=64, batch=16,
+                                emit_flush_k=4, trigger_ms=10)
+    httpd, _t, port = start_background(st, cfg, runtime=rt, port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        monkeypatch.setenv("HEATMAP_SLO_BATCH_P50_MS", "60000")
+        monkeypatch.setenv("HEATMAP_SLO_FRESHNESS_P50_S", "600")
+        monkeypatch.setenv("HEATMAP_SLO_FRESHNESS_P50_MS", "600000")
+        hz = get_json(base + "/healthz")
+        assert hz["status"] == "ok"
+        assert hz["checks"]["event_age_p50_ms"]["ok"]
+        # the ring hold (4 batches deep, 10 ms trigger) pushes event age
+        # past a budget the batch spans stay comfortably inside
+        monkeypatch.setenv("HEATMAP_SLO_FRESHNESS_P50_MS", "0.001")
+        hz = get_json(base + "/healthz")
+        assert hz["status"] == "degraded" and hz["ok"]  # still serving
+        assert not hz["checks"]["event_age_p50_ms"]["ok"]
+        assert hz["checks"]["batch_p50_ms"]["ok"]       # spans green
+        assert hz["checks"]["freshness_p50_s"]["ok"]
     finally:
         httpd.shutdown()
 

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Fail when an exposed metric family is undocumented.
+
+The metrics table in ARCHITECTURE.md §Observability is the operator
+contract — dashboards and alerts are written against it.  Nothing keeps
+it honest by itself: a new registry family quietly ships with an empty
+HELP string or without a table row, and the next operator greps the
+docs for a series that isn't there (exactly what happened to
+``heatmap_emit_ring_pending`` in PR 2).
+
+This check smoke-assembles a REAL runtime (tiny CPU micro-batches,
+memory store), walks every family the registry would expose at
+/metrics, and asserts each one
+
+  1. carries a non-empty HELP string, and
+  2. appears (sans ``heatmap_`` prefix) in ARCHITECTURE.md.
+
+Run next to the suite (tests/test_check_metrics_docs.py makes it
+tier-1, the same pattern as check_native_build).
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def _smoke_runtime():
+    """A tiny real runtime run to exhaustion — every layer that
+    registers metrics (runtime, writer, engine clocks, serve gauge)
+    has registered by the time it returns."""
+    from heatmap_tpu.config import load_config
+    from heatmap_tpu.sink import MemoryStore
+    from heatmap_tpu.stream import MicroBatchRuntime
+    from heatmap_tpu.stream.source import MemorySource
+
+    t0 = int(time.time()) - 5
+    evs = [{"provider": "p", "vehicleId": f"v{i}", "lat": 42.0 + i * 1e-4,
+            "lon": -71.0, "speedKmh": 1.0, "ts": t0} for i in range(32)]
+    cfg = load_config({}, batch_size=16, state_capacity_log2=8,
+                      speed_hist_bins=4, store="memory", serve_port=0,
+                      checkpoint_dir=tempfile.mkdtemp(
+                          prefix="metrics-docs-"))
+    src = MemorySource(evs)
+    src.finish()
+    rt = MicroBatchRuntime(cfg, src, MemoryStore(), checkpoint_every=0)
+    rt.run()
+    return rt
+
+
+def main() -> int:
+    os.environ.setdefault("HEATMAP_PLATFORM", "cpu")
+    with open(os.path.join(REPO, "ARCHITECTURE.md"),
+              encoding="utf-8") as fh:
+        arch = fh.read()
+    rt = _smoke_runtime()
+    failures = []
+    fams = list(rt.metrics.registry._families.values())
+    for fam in fams:
+        if not fam.help.strip():
+            failures.append(f"{fam.name}: empty HELP string")
+        short = fam.name.removeprefix("heatmap_")
+        if short not in arch and fam.name not in arch:
+            failures.append(
+                f"{fam.name}: not documented in ARCHITECTURE.md "
+                f"(add a row to the §Observability metrics table)")
+    if failures:
+        print("FAIL: undocumented metrics:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print(f"OK: {len(fams)} metric families documented with HELP strings")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

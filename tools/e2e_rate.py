@@ -354,6 +354,12 @@ def main() -> int:
         "positions_written": rt.writer.counters["positions_written"],
         "events_valid": snap.get("events_valid"),
         "state_overflow_groups": snap.get("state_overflow_groups", 0),
+        # end-to-end freshness (obs.lineage): event-age p50/p99 (event
+        # ts -> sink commit ack) and mean emit-ring residency, so the
+        # artifact tracks staleness ALONGSIDE throughput — a flush-k/
+        # prefetch sweep that buys rate by parking batches longer is
+        # visible in the same JSON line
+        "freshness": rt.metrics.freshness_summary(),
     }
     if mongod is not None:
         tiles = mongod.state.coll("mobility", "tiles")

@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""obs_top — curses-free terminal dashboard over /metrics + /healthz.
+
+Polls a running heatmap serve endpoint and renders the numbers an
+operator watches during an incident: ingest rate, batch p50/p95,
+end-to-end freshness (event-age p50/p99, through the prefetch queue and
+the device emit ring — obs.lineage), emit-ring depth, sink queue/
+backpressure, and the /healthz SLO verdict.  Rates and recent quantiles
+are computed from DELTAS between successive scrapes of the cumulative
+Prometheus histograms, so the display tracks the last interval, not the
+lifetime distribution.
+
+Plain ANSI only (no curses): one screen clear + reprint per interval,
+which also works piped into a file or over the dumbest of SSH hops.
+
+Usage:
+    python tools/obs_top.py [--url http://127.0.0.1:5000] [--interval 2]
+    python tools/obs_top.py --once          # single frame (no clear)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+def parse_prom(text: str) -> dict:
+    """Minimal Prometheus text parser: {name: {labels_str: value}}
+    (labels_str is the raw ``{...}`` block, "" for unlabeled)."""
+    out: dict = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        try:
+            series, val = line.rsplit(" ", 1)
+            v = float(val)
+        except ValueError:
+            continue
+        if "{" in series:
+            name, _, rest = series.partition("{")
+            labels = "{" + rest
+        else:
+            name, labels = series, ""
+        out.setdefault(name, {})[labels] = v
+    return out
+
+
+def bucket_bounds(samples: dict) -> list:
+    """[(le_float, labels_str)] sorted, +Inf last, from a _bucket
+    series' samples."""
+    out = []
+    for labels in samples:
+        le = None
+        for part in labels.strip("{}").split(","):
+            k, _, v = part.partition("=")
+            if k.strip() == "le":
+                v = v.strip('"')
+                le = float("inf") if v == "+Inf" else float(v)
+        if le is not None:
+            out.append((le, labels))
+    return sorted(out, key=lambda t: t[0])
+
+
+def hist_quantile(cur: dict, prev: dict | None, q: float) -> float | None:
+    """Interpolated quantile over the DELTA of two cumulative bucket
+    scrapes (prev=None → lifetime).  Returns None on an empty window."""
+    bounds = bucket_bounds(cur)
+    if not bounds:
+        return None
+    deltas, cum_prev = [], 0.0
+    for le, labels in bounds:
+        c = cur.get(labels, 0.0) - (prev.get(labels, 0.0) if prev else 0.0)
+        deltas.append((le, max(0.0, c - cum_prev)))
+        cum_prev = max(cum_prev, c)
+    total = sum(d for _, d in deltas)
+    if total <= 0:
+        return None
+    target = q * total
+    run, lo = 0.0, 0.0
+    for le, d in deltas:
+        if run + d >= target and d > 0:
+            if le == float("inf"):
+                return lo  # open-ended: report the last finite bound
+            frac = (target - run) / d
+            return lo + frac * (le - lo)
+        run += d
+        if le != float("inf"):
+            lo = le
+    return lo
+
+
+def _fetch(url: str, timeout: float = 5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _val(m: dict, name: str, labels: str = "") -> float | None:
+    return m.get(name, {}).get(labels)
+
+
+def render_frame(m: dict, prev: dict | None, dt: float,
+                 health: dict | None) -> str:
+    def rate(name):
+        cur = _val(m, name)
+        if cur is None or prev is None or dt <= 0:
+            return None
+        was = _val(prev, name)
+        return (cur - was) / dt if was is not None else None
+
+    def fmt(v, unit="", scale=1.0, digits=1):
+        return "--" if v is None else f"{v * scale:,.{digits}f}{unit}"
+
+    ev_rate = rate("heatmap_events_valid_total")
+    lines = ["heatmap obs_top — " + time.strftime("%H:%M:%S"), ""]
+    lines.append(
+        f"  ingest    {fmt(ev_rate, ' ev/s', digits=0):>14}   "
+        f"tiles {fmt(rate('heatmap_tiles_emitted_total'), '/s', digits=0)}")
+
+    def hq(name, q, pv=prev):
+        cur = m.get(name + "_bucket")
+        if cur is None:
+            return None
+        pb = pv.get(name + "_bucket") if pv else None
+        return hist_quantile(cur, pb, q)
+
+    lines.append(
+        f"  batch     p50 {fmt(hq('heatmap_batch_latency_seconds', .5), ' ms', 1e3):>10}   "
+        f"p95 {fmt(hq('heatmap_batch_latency_seconds', .95), ' ms', 1e3)}")
+    mean_b = {k: v for k, v in m.get("heatmap_event_age_seconds_bucket",
+                                     {}).items() if 'bound="mean"' in k}
+    mean_p = ({k: v for k, v in (prev or {}).get(
+        "heatmap_event_age_seconds_bucket", {}).items()
+        if 'bound="mean"' in k}) or None
+    p50 = hist_quantile(mean_b, mean_p, 0.5) if mean_b else None
+    p99 = hist_quantile(mean_b, mean_p, 0.99) if mean_b else None
+    lines.append(
+        f"  freshness p50 {fmt(p50, ' s', digits=2):>10}   "
+        f"p99 {fmt(p99, ' s', digits=2)}   (event ts -> sink ack)")
+    lines.append(
+        f"  serve     {fmt(_val(m, 'heatmap_serve_freshness_seconds'), ' s', digits=2)} behind at last /tiles render")
+    lines.append(
+        f"  ring      depth {fmt(_val(m, 'heatmap_emit_ring_pending'), digits=0)}   "
+        f"residency p50 {fmt(hq('heatmap_emit_ring_residency_seconds', .5), ' ms', 1e3)}")
+    lines.append(
+        f"  sink      queue {fmt(_val(m, 'heatmap_sink_queue_depth'), digits=0)}   "
+        f"retries {fmt(_val(m, 'heatmap_sink_retries_total'), digits=0)}   "
+        f"watermark age {fmt(_val(m, 'heatmap_watermark_age_seconds'), ' s', digits=1)}")
+    if health is not None:
+        status = health.get("status", "?")
+        bad = [k for k, c in health.get("checks", {}).items()
+               if isinstance(c, dict) and not c.get("ok", True)]
+        lines.append("")
+        lines.append(f"  SLO       {status.upper()}"
+                     + (f"   failing: {', '.join(bad)}" if bad else ""))
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--url", default="http://127.0.0.1:5000")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no screen clear)")
+    ap.add_argument("--no-clear", action="store_true")
+    args = ap.parse_args(argv)
+
+    prev, t_prev = None, 0.0
+    while True:
+        try:
+            m = parse_prom(_fetch(args.url.rstrip("/") + "/metrics"))
+        except (urllib.error.URLError, OSError) as e:
+            print(f"obs_top: {args.url} unreachable: {e}", file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        try:
+            health = json.loads(_fetch(args.url.rstrip("/") + "/healthz"))
+        except (urllib.error.HTTPError) as e:  # 503 = down, still JSON
+            try:
+                health = json.loads(e.read())
+            except ValueError:
+                health = None
+        except (urllib.error.URLError, OSError, ValueError):
+            health = None
+        now = time.monotonic()
+        frame = render_frame(m, prev, now - t_prev if prev else 0.0, health)
+        if not (args.once or args.no_clear):
+            sys.stdout.write("\x1b[2J\x1b[H")
+        sys.stdout.write(frame)
+        sys.stdout.flush()
+        if args.once:
+            return 0
+        prev, t_prev = m, now
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
