@@ -132,6 +132,42 @@ class Config:
                                        # freshness-lineage records kept
                                        # for /debug/freshness and the
                                        # flight recorder (obs.lineage)
+    query_view: bool = True            # HEATMAP_QUERY_VIEW: maintain the
+                                       # materialized tile view (query/
+                                       # matview) feeding /api/tiles/
+                                       # delta, ETag 304s, SSE, topk and
+                                       # ?res= rollups.  0 disables —
+                                       # reads fall back to direct Store
+                                       # renders.  Multi-host runs skip
+                                       # the writer-fed view (each host
+                                       # sinks only its shards); serve
+                                       # processes rebuild from the
+                                       # store instead.
+    delta_log: int = 4096              # HEATMAP_DELTA_LOG: per-grid
+                                       # changed-cell changelog depth
+                                       # backing /api/tiles/delta; a
+                                       # client whose ?since= predates
+                                       # the retained log gets a full
+                                       # resync instead of a delta
+    pyramid_levels: int = 2            # HEATMAP_PYRAMID_LEVELS: coarser
+                                       # H3 parent resolutions the view
+                                       # maintains incrementally per
+                                       # grid for ?res= zoom-out (base
+                                       # res-1 .. base res-levels); 0
+                                       # disables rollups
+    view_poll_ms: int = 1000           # HEATMAP_VIEW_POLL_MS: serve-only
+                                       # view rebuild TTL — the bound
+                                       # covering stores written by
+                                       # OTHER processes, which version
+                                       # polling cannot see
+    sse_max_clients: int = 64          # HEATMAP_SSE_MAX_CLIENTS: open
+                                       # /api/tiles/stream connections
+                                       # before new ones get 503 (each
+                                       # holds one server thread)
+    sse_heartbeat_s: float = 15.0      # HEATMAP_SSE_HEARTBEAT_S: SSE
+                                       # comment-ping cadence keeping
+                                       # idle connections (and their
+                                       # proxies) alive
 
     @property
     def tile_seconds(self) -> int:
@@ -202,6 +238,15 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
                               Config.prefetch_batches),
         flightrec_dir=e.get("HEATMAP_FLIGHTREC_DIR", Config.flightrec_dir),
         lineage_tail=_int(e, "HEATMAP_LINEAGE_TAIL", Config.lineage_tail),
+        query_view=e.get("HEATMAP_QUERY_VIEW", "1") not in ("0", "false", ""),
+        delta_log=_int(e, "HEATMAP_DELTA_LOG", Config.delta_log),
+        pyramid_levels=_int(e, "HEATMAP_PYRAMID_LEVELS",
+                            Config.pyramid_levels),
+        view_poll_ms=_int(e, "HEATMAP_VIEW_POLL_MS", Config.view_poll_ms),
+        sse_max_clients=_int(e, "HEATMAP_SSE_MAX_CLIENTS",
+                             Config.sse_max_clients),
+        sse_heartbeat_s=_float(e, "HEATMAP_SSE_HEARTBEAT_S",
+                               Config.sse_heartbeat_s),
     )
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
@@ -232,4 +277,22 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
     if cfg.lineage_tail < 1:
         raise ValueError(
             f"HEATMAP_LINEAGE_TAIL must be >= 1, got {cfg.lineage_tail}")
+    if cfg.delta_log < 1:
+        raise ValueError(
+            f"HEATMAP_DELTA_LOG must be >= 1, got {cfg.delta_log}")
+    if not (0 <= cfg.pyramid_levels <= 15):
+        raise ValueError(
+            f"HEATMAP_PYRAMID_LEVELS must be in 0..15, "
+            f"got {cfg.pyramid_levels}")
+    if cfg.view_poll_ms < 0:
+        raise ValueError(
+            f"HEATMAP_VIEW_POLL_MS must be >= 0, got {cfg.view_poll_ms}")
+    if cfg.sse_max_clients < 1:
+        raise ValueError(
+            f"HEATMAP_SSE_MAX_CLIENTS must be >= 1, "
+            f"got {cfg.sse_max_clients}")
+    if cfg.sse_heartbeat_s <= 0:
+        raise ValueError(
+            f"HEATMAP_SSE_HEARTBEAT_S must be > 0, "
+            f"got {cfg.sse_heartbeat_s}")
     return cfg

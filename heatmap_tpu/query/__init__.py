@@ -1,0 +1,29 @@
+"""query — the materialized tile-view tier between the sink and the API.
+
+The streaming fold writes tiles through the sink; until this package the
+read path re-rendered the full city-scale FeatureCollection from the
+Store on every poll (~0.5 s/core for 6.4k tiles), shielded only by a
+1 s TTL cache.  CheetahGIS (arXiv:2511.09262) and GeoFlink
+(arXiv:2004.03352) both separate the streaming fold from an
+incrementally-maintained spatial query layer; this is ours:
+
+- ``matview``  — ``TileMatView``: an in-memory per-grid view of
+  (windowStart, cell) → tile doc, applied on the AsyncWriter thread
+  AFTER each sink write has durably applied (the view never exposes
+  rows that aren't in the store), with a monotonic ``view_seq``, a
+  bounded per-grid changelog powering ``/api/tiles/delta`` and the SSE
+  stream, and lazy staleAt window eviction matching the store's TTL
+  semantics.  ``StoreViewRefresher`` rebuilds the same view by Store
+  scan + version polling for serve-only processes (no runtime
+  in-process).
+- ``pyramid``  — incremental multi-resolution rollup: base-cell deltas
+  propagate to coarser H3 parent cells (count sums, count-weighted
+  speed means and centroids) so ``?res=`` zoom-out queries are
+  O(changed cells), never a window rebuild.
+"""
+
+from heatmap_tpu.query.matview import (  # noqa: F401
+    StoreViewRefresher,
+    TileMatView,
+)
+from heatmap_tpu.query.pyramid import Pyramid, cell_to_parent  # noqa: F401

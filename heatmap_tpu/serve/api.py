@@ -1,16 +1,37 @@
-"""WSGI application: tiles/positions GeoJSON + metrics + UI.
+"""WSGI application: tiles/positions GeoJSON + query tier + metrics + UI.
 
 Contract parity notes (all against /root/reference/app.py):
 - GET /api/tiles/latest  → FeatureCollection of Polygon features for the
   newest windowStart, properties {cellId, count, avgSpeedKmh, windowStart,
   windowEnd} (app.py:45-69).  TPU-native extras (p95SpeedKmh, stddev) ride
-  along when present.
+  along when present.  ``?grid=`` selects a pyramid grid; ``?res=`` serves
+  the incremental zoom-out rollup (query.pyramid; count/avgSpeed only —
+  p95/stddev don't combine from per-cell aggregates).  Strong ``ETag`` +
+  ``If-None-Match`` → 304 whenever the materialized view (query.matview)
+  is available — the ETag is a pure view lookup, so an unchanged view
+  answers 304 without invoking the renderer.
 - GET /api/positions/latest → FeatureCollection of Point features,
-  properties {provider, vehicleId, ts} (app.py:71-88).
-- GET /            → embedded Leaflet UI (app.py:92-189).
+  properties {provider, vehicleId, ts} (app.py:71-88), with the same
+  ETag/304 handling keyed on the store write-version.
+- GET /api/tiles/delta?since=<seq> → changed cells only since view seq
+  ``since`` + the next seq: {"mode": "delta"|"full", "seq", "grid",
+  "windowStart", "features": [...]}.  mode="full" means REPLACE the
+  client's set (first sync, window switch, eviction, changelog horizon);
+  mode="delta" means upsert by cellId.  Applying responses from since=0
+  reproduces /api/tiles/latest exactly (tested byte-wise sorted).
+- GET /api/tiles/stream?since=&grid= → the same delta payloads pushed as
+  Server-Sent Events (``event: tiles``) whenever the view advances.
+- GET /api/tiles/topk?k=&grid=&res=&bbox=minLon,minLat,maxLon,maxLat →
+  top-k tiles of the latest window by count, optionally bbox-filtered on
+  the centroid, served from the view in O(window) with no geometry cost
+  for non-returned cells.
+- GET /            → embedded Leaflet UI (app.py:92-189) — polls the
+  delta endpoint, falling back to full fetches.
 - GET /metrics      → Prometheus text exposition (obs.registry): batch /
   span / freshness histograms, watermark + state gauges, sink + source
-  counters, supervisor channel, resolved-policy info.
+  counters, supervisor channel, resolved-policy info, and the serve-tier
+  series (renders, 304s, delta sizes, SSE clients, view apply/seq) —
+  also on serve-only processes, from the app's own registry.
 - GET /metrics.json → the historical JSON counter snapshot (every
   pre-obs key preserved; the back-compat surface tools consume).
 - GET /trace/recent → newest-first structured per-batch trace records
@@ -19,6 +40,8 @@ Contract parity notes (all against /root/reference/app.py):
   (poll_wait/prefetch_queue/fold/ring/sink_commit) for the last N
   closed lineage records (obs.lineage) plus the event-age summary —
   the operator answer to "WHERE is the staleness coming from".
+- GET /debug/view   → materialized-view status: seq, live cells,
+  poisoned flag, store grid labels.
 - GET /healthz      → SLO evaluation: ok / degraded / down from recent
   batch p50 vs HEATMAP_SLO_BATCH_P50_MS (default 500, the paper
   budget), emit freshness p50 vs HEATMAP_SLO_FRESHNESS_P50_S,
@@ -113,6 +136,23 @@ def _cell_geometry_json(cell_id: str) -> str:
     })
 
 
+def _feature_json(doc: dict) -> str:
+    """One tile Feature, pre-serialized — byte-identical to
+    ``json.dumps`` of the dict-spec feature (differential-pinned in
+    tests/test_serve.py).  Shared by the full render, the delta
+    endpoint, SSE pushes, and topk, so every surface emits the same
+    bytes for the same tile."""
+    return ('{"type": "Feature", "geometry": '
+            + _cell_geometry_json(doc["cellId"])
+            + ', "properties": '
+            + json.dumps(_tile_props(doc)) + '}')
+
+
+def _features_collection_json(docs) -> str:
+    return ('{"type": "FeatureCollection", "features": ['
+            + ", ".join(_feature_json(d) for d in docs) + ']}')
+
+
 def tiles_feature_collection_json(store: Store,
                                   grid: str | None = None) -> str:
     """``json.dumps(tiles_feature_collection(store, grid))``, byte for
@@ -123,14 +163,7 @@ def tiles_feature_collection_json(store: Store,
     start = store.latest_window_start(grid)
     if start is None:
         return '{"type": "FeatureCollection", "features": []}'
-    parts = []
-    for doc in store.tiles_in_window(start, grid):
-        parts.append('{"type": "Feature", "geometry": '
-                     + _cell_geometry_json(doc["cellId"])
-                     + ', "properties": '
-                     + json.dumps(_tile_props(doc)) + '}')
-    return ('{"type": "FeatureCollection", "features": ['
-            + ", ".join(parts) + ']}')
+    return _features_collection_json(store.tiles_in_window(start, grid))
 
 
 def _policy_values(runtime) -> dict:
@@ -214,8 +247,11 @@ def _child_freshness_lines(channel_path: str | None) -> list:
     return lines
 
 
-def _metrics_text(runtime) -> str:
-    """Prometheus text exposition for /metrics."""
+def _metrics_text(runtime, serve_registry=None) -> str:
+    """Prometheus text exposition for /metrics.  On a serve-only process
+    (runtime=None) the app's own registry — serve-tier counters, the
+    view apply/seq series — is the exposition body; with a runtime
+    attached those families live in the runtime's registry already."""
     from heatmap_tpu.obs import ENV_CHANNEL, SupervisorChannel
     from heatmap_tpu.obs.registry import _escape_label
 
@@ -224,6 +260,8 @@ def _metrics_text(runtime) -> str:
     extra_lines = _supervisor_lines(chan)
     extra_lines.extend(_child_freshness_lines(chan_path))
     if runtime is None:
+        if serve_registry is not None:
+            return serve_registry.expose_text(extra=extra_lines)
         return "\n".join(extra_lines) + ("\n" if extra_lines else "")
     pol = _policy_values(runtime)
     labels = ",".join(
@@ -360,6 +398,77 @@ def _parse_fields(raw: str) -> tuple[list, str | None]:
     return names, None
 
 
+_GRID_RE = None  # compiled lazily, like _FIELD_RE
+
+
+def _parse_grid(params: dict, default: str | None) -> tuple:
+    """Validated ``grid=`` value (or the default): grid labels are
+    embedded in response HEADERS (the ETag), so a raw URL-decoded value
+    would be a response-splitting vector (CR/LF or quote injection).
+    Returns (grid, None) or (None, error)."""
+    raw = params.get("grid")
+    if raw is None:
+        return default, None
+    global _GRID_RE
+    if _GRID_RE is None:
+        import re
+
+        _GRID_RE = re.compile(r"^[A-Za-z0-9_.:\-]{1,64}$")
+    if not _GRID_RE.match(raw):
+        return None, "grid= must be 1-64 chars of [A-Za-z0-9_.:-]"
+    return raw, None
+
+
+def _parse_res(params: dict) -> tuple[int | None, str | None]:
+    """Optional ``res=`` zoom-out resolution: (res, None) or (None, err)."""
+    raw = params.get("res")
+    if raw is None:
+        return None, None
+    try:
+        res = int(raw)
+    except (TypeError, ValueError):
+        return None, f"res= must be an integer, got {raw[:32]!r}"
+    if not 0 <= res <= 15:
+        return None, f"res= must be in 0..15, got {res}"
+    return res, None
+
+
+def _parse_bbox(params: dict) -> tuple[tuple | None, str | None]:
+    """Optional ``bbox=minLon,minLat,maxLon,maxLat``: (bbox, None) or
+    (None, err)."""
+    raw = params.get("bbox")
+    if raw is None:
+        return None, None
+    parts = raw.split(",")
+    if len(parts) != 4:
+        return None, "bbox= needs minLon,minLat,maxLon,maxLat"
+    try:
+        lo_lon, lo_lat, hi_lon, hi_lat = (float(p) for p in parts)
+    except ValueError:
+        return None, "bbox= values must be numbers"
+    if lo_lon > hi_lon or lo_lat > hi_lat:
+        return None, "bbox= min exceeds max"
+    return (lo_lon, lo_lat, hi_lon, hi_lat), None
+
+
+def _inm_match(environ: dict, etag: str) -> bool:
+    """If-None-Match vs a strong ETag (RFC 9110 §13.1.2: weak
+    comparison is allowed for If-None-Match, so W/-prefixed client
+    copies still match; ``*`` matches any representation)."""
+    inm = environ.get("HTTP_IF_NONE_MATCH")
+    if not inm or not etag:
+        return False
+    for cand in inm.split(","):
+        cand = cand.strip()
+        if cand == "*":
+            return True
+        if cand.startswith("W/"):
+            cand = cand[2:]
+        if cand == etag:
+            return True
+    return False
+
+
 def _sample_serve_freshness(runtime) -> None:
     """Ingest→serve freshness, sampled at /tiles render time: render
     wall clock minus the newest SINK-COMMITTED event timestamp (the
@@ -394,6 +503,73 @@ def positions_feature_collection(store: Store) -> dict:
     return {"type": "FeatureCollection", "features": features}
 
 
+class _ServeStats:
+    """Serve-tier telemetry: registered in the runtime's registry when
+    one is attached (so /metrics and the docs gate cover them), else in
+    the app's own registry, which /metrics exposes on serve-only
+    processes."""
+
+    def __init__(self, reg):
+        self.http_304 = reg.counter(
+            "heatmap_serve_304_total",
+            "requests answered 304 Not Modified from the ETag check "
+            "(no render, no body), per endpoint", labels=("endpoint",))
+        self.renders = reg.counter(
+            "heatmap_serve_renders_total",
+            "full JSON body renders per endpoint (cache and ETag "
+            "misses only)", labels=("endpoint",))
+        self.rendered_bytes = reg.counter(
+            "heatmap_serve_rendered_bytes_total",
+            "bytes of JSON rendered per endpoint, before gzip — the "
+            "cost the view/ETag/delta tier exists to avoid",
+            labels=("endpoint",))
+        self.sent_bytes = reg.counter(
+            "heatmap_serve_sent_bytes_total",
+            "response body bytes sent on the wire per endpoint (after "
+            "gzip; 0 for a 304)", labels=("endpoint",))
+        self.delta_cells = reg.histogram(
+            "heatmap_serve_delta_cells",
+            "changed cells per /api/tiles/delta response or SSE push",
+            buckets=(1, 4, 16, 64, 256, 1024, 4096, 16384))
+        self.sse_clients = reg.gauge(
+            "heatmap_serve_sse_clients",
+            "open /api/tiles/stream SSE connections")
+
+
+class _SSEBody:
+    """SSE response body: iterates the event generator, and releases the
+    admission slot exactly once from ``close()`` — which WSGI servers
+    call even when iteration never starts or dies on a client
+    disconnect (a generator's own finally offers no such guarantee)."""
+
+    def __init__(self, gen, on_close):
+        self._gen = gen
+        self._on_close = on_close
+        self._closed = False
+
+    def __iter__(self):
+        return self._gen
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._gen.close()
+        finally:
+            self._on_close()
+
+
+def _delta_body(d: dict, grid: str) -> str:
+    """Delta payload JSON: header via json.dumps, features embedded as
+    the SAME pre-rendered strings /api/tiles/latest emits."""
+    ws = d["window_start"]
+    head = json.dumps({"mode": d["mode"], "seq": d["seq"], "grid": grid,
+                       "windowStart": _iso(ws) if ws is not None else None})
+    return (head[:-1] + ', "features": ['
+            + ", ".join(_feature_json(doc) for doc in d["docs"]) + ']}')
+
+
 def make_wsgi_app(store: Store, cfg=None, runtime=None):
     refresh_ms = getattr(cfg, "refresh_ms", 5000) if cfg else 5000
     resolutions = getattr(cfg, "resolutions", None) if cfg else None
@@ -406,6 +582,40 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
     default_grid = (cfg.default_grid()
                     if cfg is not None and hasattr(cfg, "default_grid")
                     else None)
+    # ---- query tier ---------------------------------------------------
+    # The materialized tile view (query.matview) serving /latest renders,
+    # ETags, deltas, SSE, and topk without touching the Store:
+    # - runtime attached: the runtime's writer-fed view (durable rows
+    #   only; absent under HEATMAP_QUERY_VIEW=0 or multi-host).
+    # - serve-only: an app-local view rebuilt from Store scans by
+    #   version polling + the HEATMAP_VIEW_POLL_MS TTL.
+    from heatmap_tpu.obs.registry import Registry
+
+    serve_reg = (runtime.metrics.registry if runtime is not None
+                 else Registry())
+    stats = _ServeStats(serve_reg)
+    view = getattr(runtime, "matview", None) if runtime is not None else None
+    refresher = None
+    if view is None and (cfg is None or getattr(cfg, "query_view", True)):
+        from heatmap_tpu.query import StoreViewRefresher, TileMatView
+
+        # registry unconditionally: a runtime WITHOUT a writer-fed view
+        # (multi-host) still lands here, and its operators need the
+        # documented view series; registration is idempotent, and when
+        # this branch runs the runtime never registered them itself
+        view = TileMatView(
+            delta_log=getattr(cfg, "delta_log", 4096) if cfg else 4096,
+            pyramid_levels=(getattr(cfg, "pyramid_levels", 2)
+                            if cfg else 2),
+            registry=serve_reg)
+        refresher = StoreViewRefresher(
+            store, view,
+            poll_s=(getattr(cfg, "view_poll_ms", 1000)
+                    if cfg else 1000) / 1e3,
+            registry=serve_reg)
+    sse_max = getattr(cfg, "sse_max_clients", 64) if cfg else 64
+    sse_heartbeat = getattr(cfg, "sse_heartbeat_s", 15.0) if cfg else 15.0
+    sse_admit_lock = threading.Lock()
     # Render cache for the two data endpoints: rendering + gzipping a
     # city-scale FeatureCollection costs ~0.5 s of the one host core
     # PER REQUEST (measured: 6.4k tiles -> 3.7 MB body,
@@ -419,7 +629,8 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
     # implies (5 s UI poll, 5-min windows).  HEATMAP_SERVE_CACHE_MS=0
     # disables caching entirely.  Keyed per (path, grid); stores the
     # ENCODED body and its gzip twin so repeat polls are a memcpy
-    # either way.
+    # either way.  View-backed tile renders use a separate ETag-keyed
+    # cache below: the ETag is exact, so no TTL is needed.
     try:
         cache_ttl_s = float(os.environ.get("HEATMAP_SERVE_CACHE_MS",
                                            "1000")) / 1e3
@@ -429,17 +640,25 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                     os.environ.get("HEATMAP_SERVE_CACHE_MS"))
         cache_ttl_s = 0.0
     render_cache: dict = {}
+    view_cache: dict = {}
 
-    def _cached_json(key, build):
+    def _account_render(endpoint: str, data: bytes) -> None:
+        stats.renders.labels(endpoint=endpoint).inc()
+        stats.rendered_bytes.labels(endpoint=endpoint).inc(len(data))
+
+    def _cached_json(key, build, endpoint):
         # builders return pre-serialized JSON strings
         if cache_ttl_s <= 0:
-            return build().encode("utf-8"), None
+            data = build().encode("utf-8")
+            _account_render(endpoint, data)
+            return data, None
         now = time.monotonic()
         ver = store.version()
         hit = render_cache.get(key)
         if hit is not None and hit[0] == ver and hit[1] > now:
             return hit[2], hit[3]
         data = build().encode("utf-8")
+        _account_render(endpoint, data)
         gz = gzip.compress(data, compresslevel=1) if len(data) >= 1024 \
             else None
         if len(render_cache) >= 64:
@@ -451,34 +670,291 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
         render_cache[key] = (ver, now + cache_ttl_s, data, gz)
         return data, gz
 
+    def _view_cached(key, etag, build, endpoint):
+        """ETag-keyed render cache for view-backed bodies: exact (the
+        ETag changes with the view), so entries need no TTL."""
+        hit = view_cache.get(key)
+        if hit is not None and hit[0] == etag:
+            return hit[1], hit[2]
+        data = build().encode("utf-8")
+        _account_render(endpoint, data)
+        gz = gzip.compress(data, compresslevel=1) if len(data) >= 1024 \
+            else None
+        if len(view_cache) >= 64:
+            view_cache.pop(next(iter(view_cache)))
+        view_cache[key] = (etag, data, gz)
+        return data, gz
+
+    # per-app boot nonce for version-derived ETags: version counters are
+    # process-local and restart at 0, so without it a post-restart ETag
+    # could equal a pre-restart one while naming different content
+    import uuid
+
+    boot_nonce = uuid.uuid4().hex[:8]
+    seeded: set = set()
+
+    def _tiles_view(grid: str | None):
+        """The view to serve tile reads from, refreshed for serve-only
+        processes; None -> fall back to direct Store renders.  A
+        writer-fed view that has never seen ``grid`` (process restarted
+        against a durable store) is seeded ONCE from a store scan —
+        upsert-only, so racing the writer thread cannot un-expose a
+        durable row."""
+        if view is None or view.poisoned:
+            return None
+        if refresher is not None:
+            refresher.refresh(grid)
+        elif grid not in seeded:
+            try:
+                if not view.known_grid(grid):
+                    ws = store.latest_window_start(grid)
+                    if ws is not None:
+                        view.seed_grid(grid,
+                                       store.tiles_in_window(ws, grid))
+            except Exception:
+                # NOT marked seeded: a transient store error must be
+                # retried on the next request, or a populated grid
+                # would serve empty for the process lifetime
+                log.warning("view seed scan failed for grid %r; will "
+                            "retry", grid, exc_info=True)
+            else:
+                if len(seeded) >= 256:
+                    # bounded against client-controlled ?grid= values,
+                    # like the refresher's per-grid map
+                    seeded.pop()
+                seeded.add(grid)
+        return view
+
+    def _sse_response(environ, start_response):
+        params = _qs_params(environ.get("QUERY_STRING", ""))
+        grid, err = _parse_grid(params, default_grid)
+        if err:
+            start_response("400 Bad Request",
+                           [("Content-Type", "application/json")])
+            return [json.dumps({"error": err}).encode()]
+        since = _qs_int(params, "since", 0, 1 << 62)
+        v = _tiles_view(grid)
+        if v is None:
+            start_response("503 Service Unavailable",
+                           [("Content-Type", "application/json")])
+            return [b'{"error": "query view unavailable"}']
+        # admission is check-then-claim under one lock: the gauge must
+        # move BEFORE the response body is first iterated, or N
+        # concurrent connects would all pass the check and exceed the
+        # thread cap the limit exists to enforce
+        with sse_admit_lock:
+            if stats.sse_clients.value >= sse_max:
+                start_response("503 Service Unavailable",
+                               [("Content-Type", "application/json")])
+                return [b'{"error": "sse client limit reached"}']
+            stats.sse_clients.inc(1)
+        start_response("200 OK", [
+            ("Content-Type", "text/event-stream"),
+            ("Cache-Control", "no-cache"),
+            ("X-Accel-Buffering", "no"),
+        ])
+
+        def events():
+            yield b"retry: 3000\n\n"
+            last = since
+            first = True
+            last_beat = time.monotonic()
+            while True:
+                if refresher is not None:
+                    refresher.refresh(grid)
+                if view.poisoned:
+                    yield b"event: gone\ndata: {}\n\n"
+                    return
+                if first or view.changed_since(grid, last):
+                    d = view.delta(grid, last)
+                    stats.delta_cells.observe(len(d["docs"]))
+                    body = _delta_body(d, grid)
+                    yield (f"event: tiles\ndata: {body}\n\n"
+                           ).encode("utf-8")
+                    last = d["seq"]
+                    first = False
+                    last_beat = time.monotonic()
+                    continue
+                # serve-only processes must keep POLLING the store
+                # (nothing else advances the view), so their wait
+                # slices shorter than the heartbeat
+                wait_s = (1.0 if refresher is not None
+                          else sse_heartbeat)
+                view.wait_changed(grid, last,
+                                  timeout=min(wait_s, sse_heartbeat))
+                if time.monotonic() - last_beat >= sse_heartbeat:
+                    yield b": hb\n\n"
+                    last_beat = time.monotonic()
+
+        # the admission slot is released in _SSEBody.close(), which the
+        # WSGI server guarantees to call — a bare generator's finally
+        # would never run if iteration never starts
+        return _SSEBody(events(),
+                        lambda: stats.sse_clients.inc(-1))
+
     def app(environ, start_response):
         path = environ.get("PATH_INFO", "/")
+        if path == "/api/tiles/stream":
+            try:
+                return _sse_response(environ, start_response)
+            except Exception:
+                log.exception("request failed: %s", path)
+                start_response("500 Internal Server Error",
+                               [("Content-Type", "application/json")])
+                return [b'{"error": "internal"}']
         pre_gz = None
         data = None
         status = "200 OK"
+        endpoint = None          # sent-bytes accounting label
+        extra_headers: list = []
+
+        def _bad_request(msg):
+            start_response("400 Bad Request",
+                           [("Content-Type", "application/json")])
+            return [json.dumps({"error": msg}).encode()]
+
+        def _unavailable(msg):
+            start_response("503 Service Unavailable",
+                           [("Content-Type", "application/json")])
+            return [json.dumps({"error": msg}).encode()]
+
+        def _not_modified(etag, ep):
+            stats.http_304.labels(endpoint=ep).inc()
+            if ep in ("tiles", "delta") and runtime is not None:
+                # what the client sees is (still) the current view —
+                # the freshness gauge must keep tracking even when no
+                # bytes move
+                _sample_serve_freshness(runtime)
+            start_response("304 Not Modified",
+                           [("ETag", etag), ("Vary", "Accept-Encoding")])
+            return []
+
         try:
             if path == "/api/tiles/latest":
-                qs = environ.get("QUERY_STRING", "")
-                grid = None
-                for part in qs.split("&"):
-                    if part.startswith("grid="):
-                        grid = part[5:]
-                if grid is None:
-                    # a multi-res pyramid would otherwise mix overlapping
-                    # hexes in a single FeatureCollection
-                    grid = default_grid
-                data, pre_gz = _cached_json(
-                    ("tiles", grid),
-                    lambda: tiles_feature_collection_json(store, grid))
-                _sample_serve_freshness(runtime)
+                endpoint = "tiles"
+                params = _qs_params(environ.get("QUERY_STRING", ""))
+                # bare requests get the default grid: a multi-res
+                # pyramid would otherwise mix overlapping hexes in a
+                # single FeatureCollection
+                grid, err = _parse_grid(params, default_grid)
+                if err:
+                    return _bad_request(err)
+                res, err = _parse_res(params)
+                if err:
+                    return _bad_request(err)
+                v = _tiles_view(grid)
+                if v is not None:
+                    # etag + docs captured atomically: a writer apply
+                    # landing between them would label newer content
+                    # with a stale strong ETag
+                    try:
+                        etag, _ws, docs = v.snapshot(grid, res)
+                    except KeyError:
+                        return _bad_request(
+                            f"res={res} is not maintained for grid "
+                            f"{grid!r} (HEATMAP_PYRAMID_LEVELS)")
+                    if _inm_match(environ, etag):
+                        return _not_modified(etag, endpoint)
+                    data, pre_gz = _view_cached(
+                        (grid, res), etag,
+                        lambda: _features_collection_json(docs),
+                        endpoint)
+                    extra_headers.append(("ETag", etag))
+                else:
+                    if res is not None:
+                        return _unavailable(
+                            "res= rollups need the query view "
+                            "(HEATMAP_QUERY_VIEW=1)")
+                    data, pre_gz = _cached_json(
+                        ("tiles", grid),
+                        lambda: tiles_feature_collection_json(store, grid),
+                        endpoint)
+                if runtime is not None:
+                    _sample_serve_freshness(runtime)
+                ctype = "application/json"
+            elif path == "/api/tiles/delta":
+                endpoint = "delta"
+                params = _qs_params(environ.get("QUERY_STRING", ""))
+                grid, err = _parse_grid(params, default_grid)
+                if err:
+                    return _bad_request(err)
+                since = _qs_int(params, "since", 0, 1 << 62)
+                v = _tiles_view(grid)
+                if v is None:
+                    return _unavailable(
+                        "delta needs the query view (HEATMAP_QUERY_VIEW=1)")
+                d = v.delta(grid, since)
+                stats.delta_cells.observe(len(d["docs"]))
+                body = _delta_body(d, grid)
+                data = body.encode("utf-8")
+                _account_render(endpoint, data)
+                if runtime is not None:
+                    # the delta-polling UI replaced /latest polls, so
+                    # the ingest->serve freshness gauge samples here too
+                    _sample_serve_freshness(runtime)
+                ctype = "application/json"
+            elif path == "/api/tiles/topk":
+                endpoint = "topk"
+                params = _qs_params(environ.get("QUERY_STRING", ""))
+                grid, err = _parse_grid(params, default_grid)
+                if err:
+                    return _bad_request(err)
+                k = _qs_int(params, "k", 20, 1000)
+                res, err = _parse_res(params)
+                if err:
+                    return _bad_request(err)
+                bbox, err = _parse_bbox(params)
+                if err:
+                    return _bad_request(err)
+                v = _tiles_view(grid)
+                if v is None:
+                    return _unavailable(
+                        "topk needs the query view (HEATMAP_QUERY_VIEW=1)")
+                try:
+                    docs = v.topk(grid, k, res=res, bbox=bbox)
+                except KeyError:
+                    return _bad_request(
+                        f"res={res} is not maintained for grid {grid!r} "
+                        f"(HEATMAP_PYRAMID_LEVELS)")
+                body = _features_collection_json(docs)
+                data = body.encode("utf-8")
+                _account_render(endpoint, data)
                 ctype = "application/json"
             elif path == "/api/positions/latest":
+                endpoint = "positions"
+                ver = store.version()
+                etag = None
+                if ver is not None and runtime is not None:
+                    # only the writer process may trust the version
+                    # counter as a change signal (MongoStore's counter
+                    # sees ONLY this process's writes — a serve-only
+                    # deployment over a shared store would 304 forever
+                    # on '"p.0"' while positions change underneath)
+                    etag = f'"p.{boot_nonce}.{ver}"'
+                    if _inm_match(environ, etag):
+                        return _not_modified(etag, endpoint)
                 data, pre_gz = _cached_json(
                     ("positions",),
-                    lambda: json.dumps(positions_feature_collection(store)))
+                    lambda: json.dumps(positions_feature_collection(store)),
+                    endpoint)
+                if etag is not None and store.version() != ver:
+                    # a write landed between the version read and the
+                    # render: the body may be newer than the version
+                    # ETag claims — fall through to the content hash
+                    etag = None
+                if etag is None:
+                    # serve-only: a content-derived strong ETag — the
+                    # render still runs (the cache absorbs repeats) but
+                    # a 304 saves the wire bytes and is never wrong
+                    import hashlib
+
+                    etag = f'"p.h.{hashlib.md5(data).hexdigest()[:16]}"'
+                    if _inm_match(environ, etag):
+                        return _not_modified(etag, endpoint)
+                extra_headers.append(("ETag", etag))
                 ctype = "application/json"
             elif path == "/metrics":
-                body = _metrics_text(runtime)
+                body = _metrics_text(runtime, serve_registry=serve_reg)
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
             elif path == "/metrics.json":
                 body = json.dumps(_metrics_json(runtime))
@@ -496,10 +972,7 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                     # key projection (missing keys just drop out)
                     names, err = _parse_fields(fields)
                     if err:
-                        start_response("400 Bad Request",
-                                       [("Content-Type",
-                                         "application/json")])
-                        return [json.dumps({"error": err}).encode()]
+                        return _bad_request(err)
                     traces = [{k: r[k] for k in names if k in r}
                               for r in traces]
                 body = json.dumps({"traces": traces})
@@ -516,6 +989,25 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                     "summary": (runtime.metrics.freshness_summary()
                                 if runtime is not None else {}),
                     "stage_order": list(STAGES),
+                }
+                body = json.dumps(payload)
+                ctype = "application/json"
+            elif path == "/debug/view":
+                try:
+                    store_grids = store.grids()
+                except Exception:
+                    store_grids = []
+                payload = {
+                    "enabled": view is not None,
+                    "mode": ("writer-fed" if refresher is None
+                             and view is not None else
+                             "store-fed" if view is not None else None),
+                    "poisoned": view.poisoned if view is not None else None,
+                    "seq": view.seq if view is not None else None,
+                    "cells": (view.cells_live()
+                              if view is not None else None),
+                    "sse_clients": int(stats.sse_clients.value),
+                    "store_grids": store_grids,
                 }
                 body = json.dumps(payload)
                 ctype = "application/json"
@@ -538,7 +1030,7 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
             return [b'{"error": "internal"}']
         if data is None:
             data = body.encode("utf-8")
-        headers = [("Content-Type", ctype)]
+        headers = [("Content-Type", ctype)] + extra_headers
         # tile FeatureCollections run to hundreds of KB and the UI polls
         # every few seconds; GeoJSON gzips ~5-10x
         if _accepts_gzip(environ.get("HTTP_ACCEPT_ENCODING", "")):
@@ -550,6 +1042,8 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 headers.append(("Content-Encoding", "gzip"))
         headers.append(("Vary", "Accept-Encoding"))
         headers.append(("Content-Length", str(len(data))))
+        if endpoint is not None:
+            stats.sent_bytes.labels(endpoint=endpoint).inc(len(data))
         start_response(status, headers)
         return [data]
 

@@ -3,8 +3,14 @@
 Written from scratch: hex choropleth over the latest window, vehicle
 markers with popups, periodic refresh of both endpoints, waiting toast,
 auto-fit.  Additions over the reference: a live metrics readout (events/sec,
-batch p50) fed by /metrics, and a count/speed legend.
-"""
+batch p50) fed by /metrics.json, and a count/speed legend.
+
+Tile refresh rides the query tier: the UI polls ``/api/tiles/delta``
+with its last-seen view seq and upserts only the changed hexes (a
+mode="full" response replaces the set).  A delta failure falls back to
+a full ``/api/tiles/latest`` fetch for that tick; only a 404 (older
+server) or 503 (view disabled) latches full-fetch mode for the
+session — transient blips retry delta on the next tick."""
 
 from __future__ import annotations
 
@@ -52,6 +58,7 @@ L.tileLayer('https://tile.openstreetmap.org/{z}/{x}/{y}.png', {
   maxZoom: 19, attribution: '&copy; OpenStreetMap contributors'
 }).addTo(map);
 
+const cellLayers = new Map();  // cellId -> layer (delta upserts)
 const hexes = L.geoJSON(null, {
   style: f => ({weight: 0.7, color: '#666', fillOpacity: 0.55,
                 fillColor: rampColor(f.properties.count)}),
@@ -62,6 +69,7 @@ const hexes = L.geoJSON(null, {
     if (p.p95SpeedKmh !== undefined)
       html += `<br/>p95 speed: ${Number(p.p95SpeedKmh).toFixed(1)} km/h`;
     layer.bindPopup(html);
+    cellLayers.set(p.cellId, layer);
   }
 }).addTo(map);
 const vehicles = L.layerGroup().addTo(map);
@@ -102,25 +110,72 @@ map.on('zoomend', () => {
 
 let fitted = false;
 let tickSeq = 0;
+// delta-sync state: the last view seq applied, per active grid; reset
+// on grid switch (each grid's delta stream is independent)
+let tilesSince = 0;
+let deltaBroken = false;  // one failure -> full fetches for the session
+
+function clearHexes() {
+  hexes.clearLayers();
+  cellLayers.clear();
+}
+
+function applyFeatures(features) {
+  for (const f of features) {
+    const old = cellLayers.get(f.properties.cellId);
+    if (old) hexes.removeLayer(old);
+    hexes.addData(f);  // onEachFeature re-registers the cellId
+  }
+}
+
+async function fetchTiles(gridQS) {
+  // delta path: changed hexes only, O(changed) per poll
+  if (!deltaBroken) {
+    try {
+      const r = await fetch(`/api/tiles/delta?since=${tilesSince}${gridQS ? '&' + gridQS : ''}`);
+      if (!r.ok) {
+        // 404 (older server) / 503 (view disabled) are permanent for
+        // the session; anything else — a blip, a restart — retries on
+        // the next tick after one full-fetch fallback
+        if (r.status === 404 || r.status === 503) deltaBroken = true;
+        throw new Error(`delta ${r.status}`);
+      }
+      const d = await r.json();
+      return {delta: d};
+    } catch (err) {
+      console.warn('delta fetch failed; full fetch this tick', err);
+    }
+  }
+  // full-fetch fallback: the reference-shaped endpoint
+  const tiles = await fetch('/api/tiles/latest' + (gridQS ? '?' + gridQS : ''))
+    .then(r => r.json());
+  return {full: tiles};
+}
+
 async function tick() {
   const seq = ++tickSeq;  // a newer tick invalidates slower in-flight ones
   try {
-    activeGrid = gridForZoom(map.getZoom());
-    const tilesUrl = '/api/tiles/latest' +
-      (activeGrid ? `?grid=${encodeURIComponent(activeGrid)}` : '');
+    const newGrid = gridForZoom(map.getZoom());
+    if (newGrid !== activeGrid) { tilesSince = 0; clearHexes(); }
+    activeGrid = newGrid;
+    const gridQS = activeGrid ? `grid=${encodeURIComponent(activeGrid)}` : '';
     const [tiles, pts, metrics] = await Promise.all([
-      fetch(tilesUrl).then(r => r.json()),
+      fetchTiles(gridQS),
       fetch('/api/positions/latest').then(r => r.json()),
-      fetch('/metrics').then(r => r.json()).catch(() => ({})),
+      fetch('/metrics.json').then(r => r.json()).catch(() => ({})),
     ]);
     if (seq !== tickSeq) return;  // stale response; a fresher one renders
-    hexes.clearLayers();
-    if (tiles.features && tiles.features.length) {
-      hexes.addData(tiles);
-      if (!fitted) {
-        const b = hexes.getBounds();
-        if (b.isValid()) { map.fitBounds(b, {maxZoom: 14}); fitted = true; }
-      }
+    if (tiles.delta) {
+      if (tiles.delta.mode === 'full') clearHexes();
+      applyFeatures(tiles.delta.features || []);
+      tilesSince = tiles.delta.seq;
+    } else {
+      clearHexes();
+      if (tiles.full.features) applyFeatures(tiles.full.features);
+    }
+    if (cellLayers.size && !fitted) {
+      const b = hexes.getBounds();
+      if (b.isValid()) { map.fitBounds(b, {maxZoom: 14}); fitted = true; }
     }
     vehicles.clearLayers();
     for (const f of (pts.features || [])) {
@@ -131,7 +186,7 @@ async function tick() {
       m.bindPopup(`<b>${esc(p.provider)}</b> ${esc(p.vehicleId)}<br/>${esc(p.ts)}`);
       vehicles.addLayer(m);
     }
-    const nt = (tiles.features || []).length, np = (pts.features || []).length;
+    const nt = cellLayers.size, np = (pts.features || []).length;
     if (!nt && !np) status('Waiting for data…');
     renderHud(nt, np, metrics);
   } catch (err) {

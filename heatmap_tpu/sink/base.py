@@ -206,6 +206,13 @@ class Store(abc.ABC):
     def all_positions(self) -> Iterable[dict]:
         """Full scan of positions_latest (app.py:78)."""
 
+    def grids(self) -> "list[str]":
+        """Distinct grid labels with live tiles, sorted — the query tier
+        uses it to describe a store a serve-only view hasn't
+        materialized yet (/debug/view).  Stores that cannot enumerate
+        cheaply may return []."""
+        return []
+
     def flush(self) -> None:
         pass
 

@@ -132,6 +132,13 @@ class MemoryStore(Store):
         with self._lock:
             return [dict(v) for v in self._pos_docs.values()]
 
+    def grids(self) -> list:
+        with self._lock:
+            self._compact_tiles()
+            self._gc()
+            return sorted({v.get("grid") for v in self._tile_docs.values()
+                           if v.get("grid")})
+
     # --- test helpers ---------------------------------------------------
     @property
     def n_tiles(self) -> int:

@@ -259,5 +259,26 @@ class MongoStore(Store):
     def all_positions(self) -> Iterable[dict]:
         return self._b.find("positions_latest", {})
 
+    def grids(self) -> list:
+        # no server-side distinct on the minimal wire backend, so this
+        # pages the tiles collection and dedups client-side — cached
+        # for 15 s so a /debug/view monitoring probe can't impose a
+        # continuous full-collection read load on the store the query
+        # tier exists to protect
+        import time as _time
+
+        cached = getattr(self, "_grids_cache", None)
+        now = _time.monotonic()
+        if cached is not None and now - cached[1] < 15.0:
+            return cached[0]
+        seen = set()
+        for doc in self._b.find("tiles", {}):
+            g = doc.get("grid")
+            if g:
+                seen.add(g)
+        out = sorted(seen)
+        self._grids_cache = (out, now)
+        return out
+
     def close(self) -> None:
         self._b.close()

@@ -27,8 +27,17 @@ log = logging.getLogger(__name__)
 
 class AsyncWriter:
     def __init__(self, store: Store, max_queue: int = 64,
-                 retries: int = 3, backoff_s: float = 0.2, metrics=None):
+                 retries: int = 3, backoff_s: float = 0.2, metrics=None,
+                 view=None):
         self.store = store
+        # materialized tile view (query.matview): fed on THIS thread
+        # right after each tile write returns from the store — i.e.
+        # strictly after the rows are durable, so the query tier never
+        # exposes a tile a Store read-back couldn't return.  A view
+        # apply failure poisons the VIEW only (serving falls back to
+        # Store renders); telemetry/read-path trouble never takes the
+        # pipeline down.
+        self.view = view
         self.retries = retries
         self.backoff_s = backoff_s
         self._q: queue.Queue = queue.Queue(maxsize=max_queue)
@@ -113,6 +122,9 @@ class AsyncWriter:
                     n = self._apply(kind, docs)
                     if kind.startswith("tiles"):
                         self._written_tiles += n
+                        if n and self.view is not None \
+                                and not self.view.poisoned:
+                            self._feed_view(kind, docs)
                     else:
                         self._written_positions += n
             except BaseException as e:  # poisons the writer permanently
@@ -123,6 +135,18 @@ class AsyncWriter:
                     self._g_poisoned.set(1)
             finally:
                 self._q.task_done()
+
+    def _feed_view(self, kind: str, docs) -> None:
+        try:
+            if kind == "tiles_packed":
+                body, meta = docs
+                self.view.apply_packed(body, meta)
+            else:
+                self.view.apply_docs(docs)
+        except Exception:
+            log.exception("materialized view apply failed; query tier "
+                          "falls back to store renders")
+            self.view.poison()
 
     @property
     def poisoned(self) -> bool:

@@ -116,7 +116,26 @@ class MicroBatchRuntime:
         self.source = source
         self.store = store
         self.metrics = Metrics()
-        self.writer = AsyncWriter(store, metrics=self.metrics)
+        # Materialized tile view (query.matview): fed by the writer
+        # thread after each durable tile write, read by the serve layer
+        # (delta/ETag/SSE/topk/?res=) so polls stop touching the Store.
+        # Multi-host runs skip it — each host sinks only its own shards,
+        # so a host-local view would expose a partial city; serve
+        # processes rebuild from the shared store instead.
+        self.matview = None
+        if cfg.query_view and jax.process_count() == 1:
+            from heatmap_tpu.query import TileMatView
+
+            # (no store scan here: runtime construction stays read-only
+            # — the serve layer seeds unmaterialized grids lazily from
+            # the store on first access, so a restart against a durable
+            # sink still serves the current window immediately)
+            self.matview = TileMatView(
+                delta_log=cfg.delta_log,
+                pyramid_levels=cfg.pyramid_levels,
+                registry=self.metrics.registry)
+        self.writer = AsyncWriter(store, metrics=self.metrics,
+                                  view=self.matview)
         self.tracer = Tracer()
         from heatmap_tpu.obs import LineageTracker, TraceRing
 

@@ -1,0 +1,43 @@
+"""Tier-1 guard: an undocumented HEATMAP_* env knob FAILS the suite.
+
+The README §Configuration tables are the operator contract for the
+flat-env surface; tools/check_env_docs.py scans heatmap_tpu/ for
+HEATMAP_-shaped tokens and requires each in README.md (at PR 4, 13 of
+46 knobs were source-only).  Running it here (same pattern as
+check_native_build / check_metrics_docs) turns doc drift into a red
+suite.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def test_env_knobs_documented():
+    tool = os.path.join(REPO, "tools", "check_env_docs.py")
+    p = subprocess.run([sys.executable, tool], capture_output=True,
+                       text=True, timeout=120, cwd=REPO)
+    assert p.returncode == 0, (
+        f"env docs check failed:\n{p.stdout}\n{p.stderr[-4000:]}")
+    assert "OK:" in p.stdout, p.stdout
+
+
+def test_detects_missing_knob(tmp_path):
+    """The scanner genuinely catches an undocumented knob (no silent
+    always-green): point it at a fake repo with one knob and no docs."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import check_env_docs
+    finally:
+        sys.path.pop(0)
+    pkg = tmp_path / "heatmap_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        'import os\nX = os.environ.get("HEATMAP_BRAND_NEW_KNOB", "1")\n')
+    knobs = check_env_docs.knobs_in_code(str(pkg))
+    assert knobs == {"HEATMAP_BRAND_NEW_KNOB"}
+    # wrapped family prefixes reduce to their stem
+    (pkg / "mod2.py").write_text('# unless HEATMAP_FLIGHTREC_\n# ALWAYS=1\n')
+    assert "HEATMAP_FLIGHTREC" in check_env_docs.knobs_in_code(str(pkg))

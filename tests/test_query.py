@@ -1,0 +1,505 @@
+"""Query tier: materialized tile view, pyramid rollup, delta protocol.
+
+The acceptance property of the subsystem is REPLAY EQUIVALENCE:
+applying /api/tiles/delta responses from since=0 must reproduce the
+exact /api/tiles/latest feature set (sorted byte-compare), across
+window advance, staleAt eviction, and multi-grid configs.  The tests
+here drive it three ways: view-level with a fake clock (eviction),
+HTTP-level against a live runtime (the acceptance check proper), and
+serve-only against a store written out-of-process-style.
+"""
+
+import datetime as dt
+import json
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from heatmap_tpu import hexgrid
+from heatmap_tpu.config import load_config
+from heatmap_tpu.query import Pyramid, StoreViewRefresher, TileMatView
+from heatmap_tpu.query.pyramid import cell_to_parent
+from heatmap_tpu.sink import MemoryStore
+from heatmap_tpu.sink.base import TileDoc, UTC
+
+
+# ---------------------------------------------------------------- parent
+def test_cell_to_parent_structure():
+    """Parent = same index with the res field lowered and freed digits
+    invalidated — cross-checked against the host packer."""
+    import math
+
+    from heatmap_tpu.hexgrid import host
+
+    for lat, lng in [(42.36, -71.05), (-33.9, 151.2), (64.1, -21.9),
+                     (0.01, 0.01), (37.77, -122.42)]:
+        child = host.latlng_to_cell_int(
+            math.radians(lat), math.radians(lng), 9)
+        base, digits, res = host.unpack(child)
+        assert res == 9
+        for pres in (8, 6, 3, 0):
+            parent = cell_to_parent(child, pres)
+            assert parent == host.pack(base, digits[:pres], pres)
+            assert host.get_resolution(parent) == pres
+            assert host.get_base_cell(parent) == base
+    with pytest.raises(ValueError):
+        cell_to_parent(child, 10)  # finer than the cell itself
+
+
+def _doc(cell, ws, count, speed, lat=42.3, lon=-71.05, grid="h3r8",
+         ttl_minutes=45, extra=None):
+    return TileDoc("bos", 8, cell, ws, ws + dt.timedelta(minutes=5),
+                   count=count, avg_speed_kmh=speed, avg_lat=lat,
+                   avg_lon=lon, ttl_minutes=ttl_minutes, extra=extra,
+                   grid=grid)
+
+
+def _cells(n, res=8, lat0=42.30):
+    out = []
+    for i in range(n * 3):
+        c = hexgrid.latlng_to_cell(lat0 + i * 7e-3, -71.05, res)
+        if c not in out:
+            out.append(c)
+        if len(out) == n:
+            break
+    assert len(out) == n
+    return out
+
+
+# --------------------------------------------------------------- pyramid
+def test_pyramid_incremental_matches_recompute():
+    ws_dt = dt.datetime(2026, 8, 3, 10, 0, tzinfo=UTC)
+    ws = int(ws_dt.timestamp())
+    cells = _cells(6)
+    docs1 = [_doc(c, ws_dt, count=i + 1, speed=10.0 * (i + 1))
+             for i, c in enumerate(cells)]
+    # incremental: apply v1, then update half the cells to v2
+    pyr = Pyramid(8, levels=3)
+    for d in docs1:
+        pyr.apply(ws, int(d["cellId"], 16), None, d)
+    docs2 = list(docs1)
+    for i in (0, 2, 4):
+        new = dict(docs1[i])
+        new["count"] = docs1[i]["count"] + 10
+        new["avgSpeedKmh"] = 99.0
+        pyr.apply(ws, int(new["cellId"], 16), docs1[i], new)
+        docs2[i] = new
+    # recompute from scratch over the FINAL docs
+    fresh = Pyramid(8, levels=3)
+    for d in docs2:
+        fresh.apply(ws, int(d["cellId"], 16), None, d)
+    for res in (7, 6, 5):
+        got = {d["cellId"]: d for d in pyr.docs(res, ws, None, None)}
+        want = {d["cellId"]: d for d in fresh.docs(res, ws, None, None)}
+        assert set(got) == set(want)
+        for cid in want:
+            assert got[cid]["count"] == want[cid]["count"]
+            assert got[cid]["avgSpeedKmh"] == pytest.approx(
+                want[cid]["avgSpeedKmh"])
+        # and against brute force: counts sum, speeds count-weighted
+        brute: dict = {}
+        for d in docs2:
+            p = hexgrid.h3_to_string(
+                cell_to_parent(int(d["cellId"], 16), res))
+            c, s = brute.get(p, (0, 0.0))
+            brute[p] = (c + d["count"], s + d["count"] * d["avgSpeedKmh"])
+        assert {k: v[0] for k, v in brute.items()} == {
+            k: v["count"] for k, v in want.items()}
+        for k, (c, s) in brute.items():
+            assert want[k]["avgSpeedKmh"] == pytest.approx(s / c)
+
+
+def test_pyramid_zero_count_entry_drops():
+    ws_dt = dt.datetime(2026, 8, 3, 10, 0, tzinfo=UTC)
+    ws = int(ws_dt.timestamp())
+    (cell,) = _cells(1)
+    d1 = _doc(cell, ws_dt, count=5, speed=20.0)
+    pyr = Pyramid(8, levels=1)
+    pyr.apply(ws, int(cell, 16), None, d1)
+    assert len(pyr.docs(7, ws, None, None)) == 1
+    d0 = dict(d1)
+    d0["count"] = 0
+    pyr.apply(ws, int(cell, 16), d1, d0)
+    assert pyr.docs(7, ws, None, None) == []
+
+
+# --------------------------------------------------- delta protocol (view)
+def _applier():
+    """The documented delta client: full replaces, delta upserts."""
+    state = {"cells": {}, "since": 0}
+
+    def apply(view, grid):
+        d = view.delta(grid, state["since"])
+        if d["mode"] == "full":
+            state["cells"] = {}
+        for doc in d["docs"]:
+            state["cells"][doc["cellId"]] = doc
+        state["since"] = d["seq"]
+        return state["cells"]
+
+    return state, apply
+
+
+def _latest_map(view, grid):
+    _, docs = view.latest_docs(grid)
+    return {d["cellId"]: d for d in docs}
+
+
+def test_delta_replay_window_advance_and_log_horizon():
+    view = TileMatView(delta_log=4)
+    # relative windowStart: a fixed date would cross its staleAt horizon
+    # mid-suite and evict under the view's real clock (time bomb)
+    ws1 = dt.datetime.now(UTC).replace(microsecond=0) - \
+        dt.timedelta(minutes=6)
+    cells = _cells(8)
+    state, apply = _applier()
+
+    view.apply_docs([_doc(cells[0], ws1, 1, 10.0)])
+    assert apply(view, "h3r8") == _latest_map(view, "h3r8")
+    d = view.delta("h3r8", state["since"])
+    assert d["mode"] == "delta" and d["docs"] == []  # idle -> empty delta
+
+    # same-window updates flow as deltas
+    view.apply_docs([_doc(cells[1], ws1, 2, 20.0)])
+    d = view.delta("h3r8", state["since"])
+    assert d["mode"] == "delta" and len(d["docs"]) == 1
+    assert apply(view, "h3r8") == _latest_map(view, "h3r8")
+
+    # a NEW window forces a full resync (the client's baseline window died)
+    ws2 = ws1 + dt.timedelta(minutes=5)
+    view.apply_docs([_doc(cells[2], ws2, 3, 30.0)])
+    d = view.delta("h3r8", state["since"])
+    assert d["mode"] == "full"
+    assert apply(view, "h3r8") == _latest_map(view, "h3r8")
+    assert set(apply(view, "h3r8")) == {cells[2]}
+
+    # blow past the 4-deep changelog in one gap -> full resync
+    for i, c in enumerate(cells[3:]):
+        view.apply_docs([_doc(c, ws2, 4 + i, 40.0)])
+    d = view.delta("h3r8", state["since"])
+    assert d["mode"] == "full"
+    assert apply(view, "h3r8") == _latest_map(view, "h3r8")
+    # a client from the FUTURE (restarted server) resyncs too
+    assert view.delta("h3r8", 10**9)["mode"] == "full"
+
+
+def test_delta_replay_across_eviction_fake_clock():
+    """staleAt eviction mirrors the store TTL; evicting the latest
+    window forces delta clients through full resync, and the applied
+    set keeps matching the latest render byte-for-byte."""
+    clock = {"t": 1_900_000_000.0}
+    view = TileMatView(now_fn=lambda: clock["t"])
+    base = dt.datetime.fromtimestamp(clock["t"], UTC)
+    ws1 = base - dt.timedelta(minutes=10)
+    ws2 = base - dt.timedelta(minutes=5)
+    cells = _cells(4)
+    state, apply = _applier()
+    # ttl 6min: ws1 stale at ws1+5min+6min = base+1min; ws2 at base+6min
+    view.apply_docs([_doc(cells[0], ws1, 1, 10.0, ttl_minutes=6),
+                     _doc(cells[1], ws1, 2, 20.0, ttl_minutes=6)])
+    assert set(apply(view, "h3r8")) == {cells[0], cells[1]}
+    view.apply_docs([_doc(cells[2], ws2, 3, 30.0, ttl_minutes=6)])
+    assert set(apply(view, "h3r8")) == {cells[2]}  # window advanced
+    # ws1 quietly evicts (not latest): nothing visible changes
+    clock["t"] += 120
+    seq_before = view.seq
+    assert view.delta("h3r8", state["since"])["docs"] == []
+    assert apply(view, "h3r8") == _latest_map(view, "h3r8")
+    # ws2 evicts too -> the latest window is GONE: full resync to empty
+    clock["t"] += 360
+    d = view.delta("h3r8", state["since"])
+    assert d["mode"] == "full" and d["docs"] == []
+    assert apply(view, "h3r8") == {} == _latest_map(view, "h3r8")
+    assert view.seq > seq_before  # eviction of the latest is a change
+    # and the ETag moved with it
+    assert view.etag("h3r8").split(".")[-1].rstrip('"') == str(view.seq)
+
+
+def test_view_apply_is_idempotent_per_doc():
+    view = TileMatView()
+    ws = dt.datetime.now(UTC).replace(microsecond=0) - \
+        dt.timedelta(minutes=2)
+    (cell,) = _cells(1)
+    doc = _doc(cell, ws, 5, 25.0)
+    assert view.apply_docs([doc]) == 1
+    s = view.seq
+    assert view.apply_docs([dict(doc)]) == 0  # unchanged doc: no-op
+    assert view.seq == s
+
+
+# ------------------------------------------------------- runtime parity
+def _mini_runtime(tmpdir, events, **cfg_over):
+    from heatmap_tpu.stream import MicroBatchRuntime
+    from heatmap_tpu.stream.source import MemorySource
+
+    cfg = load_config({}, batch_size=16, state_capacity_log2=8,
+                      speed_hist_bins=4, store="memory", serve_port=0,
+                      checkpoint_dir=tempfile.mkdtemp(dir=tmpdir),
+                      **cfg_over)
+    src = MemorySource(events)
+    st = MemoryStore()
+    rt = MicroBatchRuntime(cfg, src, st, checkpoint_every=0)
+    return cfg, src, st, rt
+
+
+def _evs(n, t0, lat0=42.0):
+    return [{"provider": "p", "vehicleId": f"v{i}", "lat": lat0 + i * 1e-3,
+             "lon": -71.0, "speedKmh": 10.0 + i, "ts": t0 + i}
+            for i in range(n)]
+
+
+def test_runtime_view_matches_store(tmp_path):
+    """The writer-fed view holds exactly the docs a Store read-back
+    returns — the invariant that lets /latest stop touching the Store."""
+    t0 = int(time.time()) - 30
+    cfg, src, st, rt = _mini_runtime(str(tmp_path), _evs(48, t0))
+    src.finish()
+    rt.run()
+    assert rt.matview is not None and not rt.matview.poisoned
+    grid = cfg.default_grid()
+    ws = st.latest_window_start(grid)
+    store_docs = {d["cellId"]: d for d in st.tiles_in_window(ws, grid)}
+    ws_dt, view_docs = rt.matview.latest_docs(grid)
+    assert ws_dt == ws
+    assert {d["cellId"]: d for d in view_docs} == store_docs
+
+
+def test_query_view_disabled_by_env(tmp_path):
+    t0 = int(time.time()) - 30
+    cfg, src, st, rt = _mini_runtime(str(tmp_path), _evs(8, t0),
+                                     query_view=False)
+    src.finish()
+    rt.run()
+    assert rt.matview is None
+
+
+# ---------------------------------------------- HTTP replay equivalence
+def _get(url, hdrs=None):
+    req = urllib.request.Request(url)
+    for k, v in (hdrs or {}).items():
+        req.add_header(k, v)
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def _sorted_features(raw_fc: bytes) -> list:
+    fc = json.loads(raw_fc)
+    feats = fc["features"]
+    return sorted((json.dumps(f, sort_keys=True) for f in feats))
+
+
+def test_http_delta_replay_equivalence_multigrid(tmp_path):
+    """ACCEPTANCE: applying /api/tiles/delta responses from since=0
+    reproduces the exact /api/tiles/latest feature set (sorted
+    byte-compare) for every grid of a multi-grid config, across window
+    advance, polled WHILE the runtime streams."""
+    from heatmap_tpu.serve import start_background
+
+    t0 = int(time.time()) - 900
+    cfg, src, st, rt = _mini_runtime(
+        str(tmp_path), [], resolutions=(7, 8), windows_minutes=(5,))
+    httpd, _t, port = start_background(st, cfg, runtime=rt, port=0)
+    base = f"http://127.0.0.1:{port}"
+    grids = ("h3r7", "h3r8")
+    client = {g: {"cells": {}, "since": 0} for g in grids}
+
+    def poll(g):
+        _, _, b = _get(base + f"/api/tiles/delta?since={client[g]['since']}"
+                       f"&grid={g}")
+        d = json.loads(b)
+        if d["mode"] == "full":
+            client[g]["cells"] = {}
+        for f in d["features"]:
+            client[g]["cells"][f["properties"]["cellId"]] = f
+        client[g]["since"] = d["seq"]
+
+    try:
+        # three segments, the last crossing into a NEW 5-min window
+        for seg, (n, ts) in enumerate([(32, t0), (32, t0 + 40),
+                                       (32, t0 + 600)]):
+            src.push(_evs(n, ts, lat0=42.0 + seg * 0.01))
+            while rt.step_once():
+                pass
+            rt.flush_pending()
+            rt.writer.drain()
+            for g in grids:
+                poll(g)
+        # runtime idle: the client state must now equal the full render
+        for g in grids:
+            poll(g)  # drain any tail
+            _, _, full = _get(base + f"/api/tiles/latest?grid={g}")
+            want = _sorted_features(full)
+            got = sorted(json.dumps(f, sort_keys=True)
+                         for f in client[g]["cells"].values())
+            assert got == want, f"delta replay diverged for {g}"
+            assert len(want) > 0
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        rt.close()
+
+
+def test_serve_only_rebuild_and_delta(tmp_path):
+    """Serve-only mode: no runtime in-process — the view rebuilds from
+    a pre-populated Store by version polling, serves ETag 304s, and
+    flows subsequent store writes out as deltas."""
+    from heatmap_tpu.serve import start_background
+
+    st = MemoryStore()
+    now = dt.datetime.now(UTC).replace(microsecond=0)
+    ws = now - dt.timedelta(minutes=2)
+    cells = _cells(6)
+    st.upsert_tiles([_doc(c, ws, i + 1, 10.0 + i)
+                     for i, c in enumerate(cells[:4])])
+    cfg = load_config({}, serve_port=0)
+    httpd, _t, port = start_background(st, cfg, port=0)  # runtime=None
+    base = f"http://127.0.0.1:{port}"
+    try:
+        stn, h, b = _get(base + "/api/tiles/latest")
+        assert len(json.loads(b)["features"]) == 4
+        etag = h["ETag"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/api/tiles/latest", {"If-None-Match": etag})
+        assert ei.value.code == 304
+        _, _, b = _get(base + "/api/tiles/delta?since=0")
+        d = json.loads(b)
+        assert d["mode"] == "full" and len(d["features"]) == 4
+        since = d["seq"]
+        # an out-of-band store write (version bump) flows as a DELTA
+        st.upsert_tiles([_doc(cells[4], ws, 9, 50.0)])
+        _, _, b = _get(base + f"/api/tiles/delta?since={since}")
+        d2 = json.loads(b)
+        assert d2["mode"] == "delta"
+        assert [f["properties"]["cellId"] for f in d2["features"]] == \
+            [cells[4]]
+        # the ETag moved; the old one re-renders, the new one 304s
+        _, h2, _ = _get(base + "/api/tiles/latest",
+                        {"If-None-Match": etag})
+        assert h2["ETag"] != etag
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_refresher_idle_store_keeps_seq_stable():
+    st = MemoryStore()
+    now = dt.datetime.now(UTC).replace(microsecond=0)
+    ws = now - dt.timedelta(minutes=2)
+    st.upsert_tiles([_doc(c, ws, i + 1, 10.0)
+                     for i, c in enumerate(_cells(3))])
+    view = TileMatView()
+    ref = StoreViewRefresher(st, view, poll_s=0.0)  # rebuild every call
+    ref.refresh("h3r8")
+    s = view.seq
+    for _ in range(5):
+        ref.refresh("h3r8")
+    assert view.seq == s  # unchanged store -> unchanged seq -> stable ETags
+    assert view.etag("h3r8") == view.etag("h3r8")
+
+
+def test_runtime_view_seeded_from_durable_store(tmp_path):
+    """A streaming process restarting against a durable store must not
+    serve an empty map: the serve layer seeds the writer-fed view from
+    a one-time store scan on first access (r6 review finding).  Runtime
+    construction itself stays read-only — the seed happens at the serve
+    layer, not at boot."""
+    from heatmap_tpu.serve import start_background
+    from heatmap_tpu.stream import MicroBatchRuntime
+    from heatmap_tpu.stream.source import MemorySource
+
+    st = MemoryStore()
+    now = dt.datetime.now(UTC).replace(microsecond=0)
+    ws = now - dt.timedelta(minutes=2)
+    cells = _cells(3)
+    st.upsert_tiles([_doc(c, ws, i + 1, 20.0)
+                     for i, c in enumerate(cells)])
+    cfg = load_config({}, batch_size=16, state_capacity_log2=8,
+                      speed_hist_bins=4, store="memory", serve_port=0,
+                      checkpoint_dir=tempfile.mkdtemp(dir=str(tmp_path)))
+    src = MemorySource([])
+    src.finish()
+    rt = MicroBatchRuntime(cfg, src, st, checkpoint_every=0)
+    assert rt.matview.seq == 0  # boot did NOT scan the store
+    httpd, _t, port = start_background(st, cfg, runtime=rt, port=0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/tiles/latest",
+                timeout=10) as r:
+            fc = json.loads(r.read())
+        assert {f["properties"]["cellId"] for f in fc["features"]} == \
+            set(cells)
+        ws_dt, docs = rt.matview.latest_docs("h3r8")
+        assert ws_dt == ws
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        rt.close()
+
+
+def test_etag_carries_boot_nonce():
+    """Seq counters restart at 0 per process; the ETag must still never
+    repeat across restarts for different content (r6 review finding)."""
+    a, b = TileMatView(), TileMatView()
+    ws = dt.datetime.now(UTC).replace(microsecond=0)
+    doc = _doc(_cells(1)[0], ws, 1, 10.0)
+    a.apply_docs([doc])
+    b.apply_docs([doc])
+    assert a.etag("h3r8") != b.etag("h3r8")  # same state, different boot
+
+
+def test_refresher_transient_store_error_does_not_poison():
+    class FlakyStore(MemoryStore):
+        def __init__(self):
+            super().__init__()
+            self.fail = False
+
+        def latest_window_start(self, grid=None):
+            if self.fail:
+                raise IOError("injected store outage")
+            return super().latest_window_start(grid)
+
+    st = FlakyStore()
+    now = dt.datetime.now(UTC).replace(microsecond=0)
+    ws = now - dt.timedelta(minutes=2)
+    cells = _cells(2)
+    st.upsert_tiles([_doc(cells[0], ws, 1, 10.0)])
+    view = TileMatView()
+    ref = StoreViewRefresher(st, view, poll_s=0.0)
+    ref.refresh("h3r8")
+    assert len(view.latest_docs("h3r8")[1]) == 1
+    st.fail = True
+    ref.refresh("h3r8")  # outage: serves the last materialized state
+    assert not view.poisoned
+    assert len(view.latest_docs("h3r8")[1]) == 1
+    st.fail = False
+    st.upsert_tiles([_doc(cells[1], ws, 2, 20.0)])
+    ref.refresh("h3r8")  # recovered: next poll converges
+    assert len(view.latest_docs("h3r8")[1]) == 2
+
+
+def test_late_window_writes_do_not_flap_etag():
+    """Late events landing in a NON-latest window change nothing a
+    client can see: the ETag must hold (no spurious re-renders for the
+    whole polling fleet) and deltas stay empty (r6 review finding)."""
+    view = TileMatView()
+    now = dt.datetime.now(UTC).replace(microsecond=0)
+    ws_old = now - dt.timedelta(minutes=10)
+    ws_new = now - dt.timedelta(minutes=5)
+    cells = _cells(3)
+    view.apply_docs([_doc(cells[0], ws_old, 1, 10.0)])
+    view.apply_docs([_doc(cells[1], ws_new, 2, 20.0)])
+    etag = view.etag("h3r8")
+    since = view.seq
+    # a late straggler updates the OLD window only
+    view.apply_docs([_doc(cells[2], ws_old, 3, 30.0)])
+    assert view.etag("h3r8") == etag
+    assert not view.changed_since("h3r8", since)
+    d = view.delta("h3r8", since)
+    assert d["mode"] == "delta" and d["docs"] == []
+    # a latest-window write DOES move everything
+    view.apply_docs([_doc(cells[2], ws_new, 4, 40.0)])
+    assert view.etag("h3r8") != etag
+    assert view.changed_since("h3r8", since)

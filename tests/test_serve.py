@@ -699,3 +699,259 @@ def test_healthz_degrades_on_supervisor_restart_rate(tmp_path,
         assert get_json(base + "/healthz")["status"] == "ok"
     finally:
         httpd.shutdown()
+
+
+# ------------------------------------------------------- query tier (PR 4)
+def test_tiles_etag_304_with_cache_disabled(monkeypatch, store):
+    """The ETag path is independent of the render cache: with
+    HEATMAP_SERVE_CACHE_MS=0 an If-None-Match hit still answers 304
+    (previously every poll forced a full rebuild)."""
+    monkeypatch.setenv("HEATMAP_SERVE_CACHE_MS", "0")
+    cfg = load_config({}, serve_port=0)
+    httpd, _t, port = start_background(store, cfg, port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(base + "/api/tiles/latest",
+                                    timeout=10) as r:
+            etag = r.headers["ETag"]
+        assert etag.startswith('"') and etag.endswith('"')
+        for url in ("/api/tiles/latest", "/api/positions/latest"):
+            with urllib.request.urlopen(base + url, timeout=10) as r:
+                tag = r.headers["ETag"]
+            req = urllib.request.Request(base + url)
+            req.add_header("If-None-Match", tag)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 304
+            assert ei.value.headers["ETag"] == tag
+            assert ei.value.read() == b""
+    finally:
+        httpd.shutdown()
+
+
+def test_etag_304_skips_renderer(tmp_path):
+    """ACCEPTANCE: an unchanged view answers 304 without invoking the
+    renderer — the serve_renders counter stays flat while the 304
+    counter climbs."""
+    cfg, st, rt = _mini_runtime(str(tmp_path))
+    httpd, _t, port = start_background(st, cfg, runtime=rt, port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(base + "/api/tiles/latest",
+                                    timeout=10) as r:
+            etag = r.headers["ETag"]
+
+        def counters():
+            with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+                txt = r.read().decode()
+            series, _ = _parse_prom(txt)
+            return (series.get("heatmap_serve_renders_total", {}).get(
+                        'endpoint="tiles"', 0),
+                    series.get("heatmap_serve_304_total", {}).get(
+                        'endpoint="tiles"', 0))
+
+        renders0, n304_0 = counters()
+        assert renders0 >= 1
+        for _ in range(5):
+            req = urllib.request.Request(base + "/api/tiles/latest")
+            req.add_header("If-None-Match", etag)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 304
+        renders1, n304_1 = counters()
+        assert renders1 == renders0, "304s must not invoke the renderer"
+        assert n304_1 == n304_0 + 5
+    finally:
+        httpd.shutdown()
+
+
+def test_delta_endpoint_with_runtime(tmp_path):
+    cfg, st, rt = _mini_runtime(str(tmp_path), events=48, batch=16)
+    httpd, _t, port = start_background(st, cfg, runtime=rt, port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        d = get_json(base + "/api/tiles/delta?since=0")
+        assert d["mode"] == "full" and d["features"]
+        assert d["grid"] == cfg.default_grid()
+        d2 = get_json(base + f"/api/tiles/delta?since={d['seq']}")
+        assert d2["mode"] == "delta" and d2["features"] == []
+        assert d2["seq"] == d["seq"]
+        # delta features are byte-identical to the full render's
+        full = get_json(base + "/api/tiles/latest")
+        assert sorted(json.dumps(f, sort_keys=True)
+                      for f in d["features"]) == \
+            sorted(json.dumps(f, sort_keys=True)
+                   for f in full["features"])
+    finally:
+        httpd.shutdown()
+
+
+def test_topk_and_bbox(store):
+    now = dt.datetime.now(UTC).replace(microsecond=0)
+    ws = now - dt.timedelta(minutes=2)
+    lats = (42.30, 42.40, 42.50)
+    for i, la in enumerate(lats):
+        cell = hexgrid.latlng_to_cell(la, -71.05, 8)
+        store.upsert_tiles([
+            TileDoc("bos", 8, cell, ws, ws + dt.timedelta(minutes=5),
+                    count=100 * (i + 1), avg_speed_kmh=20.0, avg_lat=la,
+                    avg_lon=-71.05, ttl_minutes=45),
+        ])
+    cfg = load_config({}, serve_port=0)
+    httpd, _t, port = start_background(store, cfg, port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        fc = get_json(base + "/api/tiles/topk?k=2")
+        counts = [f["properties"]["count"] for f in fc["features"]]
+        assert counts == [300, 200]  # count desc, k-bounded
+        # bbox keeps only the northern tile (centroid filter)
+        fc = get_json(base + "/api/tiles/topk?k=10&"
+                             "bbox=-71.2,42.45,-70.9,42.6")
+        assert [f["properties"]["count"] for f in fc["features"]] == [300]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/api/tiles/topk?bbox=1,2,3",
+                                   timeout=10)
+        assert ei.value.code == 400
+    finally:
+        httpd.shutdown()
+
+
+def test_res_rollup_over_http(store):
+    """?res= zoom-out: counts sum into parent cells; avg speed is the
+    count-weighted mean; p95/stddev (non-combinable) are absent."""
+    base_fc_cfg = load_config({}, serve_port=0)
+    httpd, _t, port = start_background(store, base_fc_cfg, port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        full = get_json(base + "/api/tiles/latest")
+        want_total = sum(f["properties"]["count"]
+                         for f in full["features"])
+        fc6 = get_json(base + "/api/tiles/latest?res=6")
+        assert fc6["features"]
+        assert sum(f["properties"]["count"]
+                   for f in fc6["features"]) == want_total
+        for f in fc6["features"]:
+            assert "p95SpeedKmh" not in f["properties"]
+            ring = f["geometry"]["coordinates"][0]
+            assert ring[0] == ring[-1]
+        # an unmaintained resolution answers 400, not garbage
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/api/tiles/latest?res=1",
+                                   timeout=10)
+        assert ei.value.code == 400
+    finally:
+        httpd.shutdown()
+
+
+def test_sse_stream_pushes_on_store_write(store):
+    """SSE: the first event carries the full set; a store write (version
+    bump, picked up by the serve-only refresher poll) pushes a delta."""
+    import socket
+
+    # short heartbeat: the disconnect is only observable at the next
+    # write, so the gauge-drop assertion below needs pings to fail fast
+    cfg = load_config({"HEATMAP_VIEW_POLL_MS": "50",
+                       "HEATMAP_SSE_HEARTBEAT_S": "0.3"}, serve_port=0)
+    httpd, _t, port = start_background(store, cfg, port=0)
+    try:
+        sk = socket.create_connection(("127.0.0.1", port), timeout=10)
+        sk.sendall(b"GET /api/tiles/stream?since=0 HTTP/1.0\r\n\r\n")
+        sk.settimeout(10)
+        buf = b""
+        while buf.count(b"event: tiles") < 1:
+            buf += sk.recv(65536)
+        first = buf
+        assert b"text/event-stream" in first
+        assert b'"mode": "full"' in first
+        # out-of-band write -> a second, delta-mode push
+        now = dt.datetime.now(UTC).replace(microsecond=0)
+        ws = now - dt.timedelta(minutes=2)
+        cell2 = hexgrid.latlng_to_cell(42.44, -71.11, 8)
+        store.upsert_tiles([
+            TileDoc("bos", 8, cell2, ws, ws + dt.timedelta(minutes=5),
+                    count=4, avg_speed_kmh=12.0, avg_lat=42.44,
+                    avg_lon=-71.11, ttl_minutes=45),
+        ])
+        while buf.count(b"event: tiles") < 2:
+            buf += sk.recv(65536)
+        assert cell2.encode() in buf
+        sk.close()
+        # the SSE client gauge returns to zero once the socket closes
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                txt = r.read().decode()
+            series, _ = _parse_prom(txt)
+            if series.get("heatmap_serve_sse_clients", {}).get("") == 0:
+                break
+            time.sleep(0.2)
+        assert series["heatmap_serve_sse_clients"][""] == 0
+    finally:
+        httpd.shutdown()
+
+
+def test_query_view_disabled_falls_back(monkeypatch, store):
+    """HEATMAP_QUERY_VIEW=0: /latest serves the legacy store path (no
+    ETag), delta/topk/stream answer 503 with an error body."""
+    cfg = load_config({"HEATMAP_QUERY_VIEW": "0"}, serve_port=0)
+    httpd, _t, port = start_background(store, cfg, port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(base + "/api/tiles/latest",
+                                    timeout=10) as r:
+            assert "ETag" not in r.headers
+            assert json.loads(r.read())["features"]
+        for url in ("/api/tiles/delta?since=0", "/api/tiles/topk",
+                    "/api/tiles/stream"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + url, timeout=10)
+            assert ei.value.code == 503
+            assert "error" in json.loads(ei.value.read())
+    finally:
+        httpd.shutdown()
+
+
+def test_debug_view_endpoint(tmp_path):
+    cfg, st, rt = _mini_runtime(str(tmp_path))
+    httpd, _t, port = start_background(st, cfg, runtime=rt, port=0)
+    try:
+        v = get_json(f"http://127.0.0.1:{port}/debug/view")
+        assert v["enabled"] and v["mode"] == "writer-fed"
+        assert v["poisoned"] is False
+        assert v["seq"] >= 1 and v["cells"] >= 1
+        assert cfg.default_grid() in v["store_grids"]
+    finally:
+        httpd.shutdown()
+
+
+def test_index_references_delta_with_fallback():
+    from heatmap_tpu.serve.ui import render_index
+
+    html = render_index(5000, (8,))
+    assert "/api/tiles/delta" in html      # the query-tier poll
+    assert "/api/tiles/latest" in html     # the full-fetch fallback
+    assert "/metrics.json" in html         # HUD reads the JSON surface
+
+
+def test_grid_param_header_injection_rejected(store):
+    """?grid= is embedded in the ETag HEADER: CR/LF or quote-bearing
+    values must 400, never reach the header block (response splitting)."""
+    cfg = load_config({}, serve_port=0)
+    httpd, _t, port = start_background(store, cfg, port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        for bad in ("h3r8%0d%0aX-Injected:%20evil", "h3r8%22%20x",
+                    "a" * 65):
+            for path in ("/api/tiles/latest?grid=", "/api/tiles/delta?grid=",
+                         "/api/tiles/topk?grid=", "/api/tiles/stream?grid="):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(base + path + bad, timeout=10)
+                assert ei.value.code == 400, path
+                assert "X-Injected" not in ei.value.headers
+        # sane labels still pass
+        fc = get_json(base + "/api/tiles/latest?grid=h3r8m15")
+        assert fc["features"] == []
+    finally:
+        httpd.shutdown()
+        httpd.server_close()

@@ -3,21 +3,41 @@
 
 The reference's serving layer is a Flask dev server rendering the same
 FeatureCollections (/root/reference/app.py:45-88); this measures OUR
-WSGI path end-to-end over real HTTP: store query -> boundary
-computation -> GeoJSON encode -> (optional gzip) -> socket.  Prints one
-JSON line.
+WSGI path end-to-end over real HTTP: store query -> materialized view ->
+GeoJSON encode -> (optional gzip) -> socket.  Prints one JSON line.
+
+Beyond the single-client endpoint latencies, ``--clients N`` runs a
+concurrent polling fleet through the three read paths the query tier
+serves:
+
+- ``full``  — every poll re-fetches /api/tiles/latest (the reference
+  behavior: N x renders against an idle store),
+- ``etag``  — polls with If-None-Match; against an idle store every
+  poll after the first answers 304 with ZERO rendered bytes,
+- ``delta`` — polls /api/tiles/delta?since=<seq>; idle polls return an
+  empty changed-set.
+
+For each mode the artifact carries p50/p99 latency, wire bytes sent,
+and the server-side rendered bytes (scraped from the
+heatmap_serve_rendered_bytes_total counters), plus
+``rendered_reduction_x`` = full-mode rendered bytes / mode rendered
+bytes — the acceptance number for "a polling client against an idle
+store stops costing renders".
 
 Usage: python tools/bench_serve.py [n_tiles] [n_positions]
+                                   [--clients N] [--polls P]
 """
 
 from __future__ import annotations
 
+import argparse
 import datetime as dt
 import gzip
 import io
 import json
 import os
 import sys
+import threading
 import time
 import urllib.request
 
@@ -61,32 +81,134 @@ def _populate(n_tiles: int, n_pos: int):
     return store, len(docs)
 
 
-def _get(url: str, gz: bool) -> tuple[float, int, int]:
+def _get(url: str, gz: bool, headers: dict | None = None):
+    """(ms, wire_bytes, decoded_body, status, headers) for one request;
+    304s carry an empty body."""
     req = urllib.request.Request(url)
     if gz:
         req.add_header("Accept-Encoding", "gzip")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
     t0 = time.perf_counter()
-    with urllib.request.urlopen(req, timeout=30) as r:
-        body = r.read()
-        enc = r.headers.get("Content-Encoding", "")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            body = r.read()
+            enc = r.headers.get("Content-Encoding", "")
+            status, rh = r.status, dict(r.headers)
+    except urllib.error.HTTPError as e:
+        if e.code != 304:
+            raise
+        e.read()
+        ms = (time.perf_counter() - t0) * 1e3
+        return ms, 0, b"", 304, dict(e.headers)
     ms = (time.perf_counter() - t0) * 1e3
     raw = len(body)
     if enc == "gzip":
         body = gzip.GzipFile(fileobj=io.BytesIO(body)).read()
-    return ms, raw, len(body)
+    return ms, raw, body, status, rh
+
+
+def _scrape_rendered_bytes(base: str) -> float:
+    """Sum of heatmap_serve_rendered_bytes_total over endpoints."""
+    with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+        txt = r.read().decode()
+    total = 0.0
+    for line in txt.splitlines():
+        if line.startswith("heatmap_serve_rendered_bytes_total"):
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def _quantiles(times: list) -> dict:
+    times = sorted(times)
+    pick = lambda q: times[min(len(times) - 1, int(q * len(times)))]  # noqa: E731
+    return {"p50_ms": round(pick(0.5), 2), "p99_ms": round(pick(0.99), 2),
+            "min_ms": round(times[0], 2), "max_ms": round(times[-1], 2)}
+
+
+def _concurrent_mode(base: str, mode: str, clients: int,
+                     polls: int) -> dict:
+    """Run ``clients`` threads x ``polls`` requests through one read
+    path against the idle store; returns latency quantiles + byte
+    accounting (bytes_rendered from the server counters).  ``full`` is
+    meant for the BASELINE server (query view + render cache off — the
+    reference's render-per-poll behavior); ``etag``/``delta`` for the
+    query-tier server."""
+    rendered0 = _scrape_rendered_bytes(base)
+    times_lock = threading.Lock()
+    times: list = []
+    wire = [0]
+    n304 = [0]
+
+    def full_client():
+        for _ in range(polls):
+            ms, raw, _, _, _ = _get(base + "/api/tiles/latest", gz=True)
+            with times_lock:
+                times.append(ms)
+                wire[0] += raw
+
+    def etag_client():
+        etag = None
+        for _ in range(polls):
+            hdrs = {"If-None-Match": etag} if etag else {}
+            ms, raw, _, status, rh = _get(base + "/api/tiles/latest",
+                                          gz=True, headers=hdrs)
+            etag = rh.get("ETag", etag)
+            with times_lock:
+                times.append(ms)
+                wire[0] += raw
+                n304[0] += status == 304
+
+    def delta_client():
+        since = 0
+        for _ in range(polls):
+            ms, raw, body, _, _ = _get(
+                base + f"/api/tiles/delta?since={since}", gz=True)
+            since = json.loads(body)["seq"]
+            with times_lock:
+                times.append(ms)
+                wire[0] += raw
+
+    target = {"full": full_client, "etag": etag_client,
+              "delta": delta_client}[mode]
+    threads = [threading.Thread(target=target) for _ in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    out = _quantiles(times)
+    out.update({
+        "requests": clients * polls,
+        "req_per_sec": round(clients * polls / wall, 1),
+        "bytes_sent_wire": wire[0],
+        "bytes_rendered": round(_scrape_rendered_bytes(base) - rendered0),
+    })
+    if mode == "etag":
+        out["ratio_304"] = round(n304[0] / max(1, clients * polls), 4)
+    return out
 
 
 def main() -> None:
-    n_tiles = int(sys.argv[1]) if len(sys.argv) > 1 else 20_000
-    n_pos = int(sys.argv[2]) if len(sys.argv) > 2 else 2_000
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("n_tiles", nargs="?", type=int, default=20_000)
+    ap.add_argument("n_positions", nargs="?", type=int, default=2_000)
+    ap.add_argument("--clients", type=int,
+                    default=int(os.environ.get("BENCH_SERVE_CLIENTS", "8")))
+    ap.add_argument("--polls", type=int,
+                    default=int(os.environ.get("BENCH_SERVE_POLLS", "12")))
+    args = ap.parse_args()
+
     from heatmap_tpu.config import load_config
     from heatmap_tpu.serve.api import start_background
 
-    store, n_unique = _populate(n_tiles, n_pos)
+    store, n_unique = _populate(args.n_tiles, args.n_positions)
     cfg = load_config({}, store="memory")
     httpd, _t, port = start_background(store, cfg, port=0)
     base = f"http://127.0.0.1:{port}"
-    out = {"tiles_in_store": n_unique, "positions_in_store": n_pos}
+    out = {"tiles_in_store": n_unique,
+           "positions_in_store": args.n_positions}
     try:
         for name, path, gz in (
                 ("tiles", "/api/tiles/latest", False),
@@ -95,7 +217,7 @@ def main() -> None:
                 ("metrics", "/metrics", False)):
             times = []
             for _ in range(12):
-                ms, raw, full = _get(base + path, gz)
+                ms, raw, body, _, _ = _get(base + path, gz)
                 times.append(ms)
             times.sort()
             out[name] = {"p50_ms": round(times[len(times) // 2], 1),
@@ -103,13 +225,42 @@ def main() -> None:
                          # the slowest request is the cold render (the
                          # cache re-renders once per store write / TTL)
                          "cold_ms": round(times[-1], 1),
-                         "wire_bytes": raw, "body_bytes": full}
+                         "wire_bytes": raw, "body_bytes": len(body)}
         body = json.loads(
             urllib.request.urlopen(base + "/api/tiles/latest",
                                    timeout=30).read())
         assert body["type"] == "FeatureCollection"
         assert len(body["features"]) == n_unique
         out["contract"] = "FeatureCollection OK, all tiles present"
+        # ---- concurrent polling fleet over the three read paths ------
+        # baseline server: query view AND render cache off — every poll
+        # re-renders, which is the reference-shaped cost the query tier
+        # exists to kill
+        saved = os.environ.get("HEATMAP_SERVE_CACHE_MS")
+        os.environ["HEATMAP_SERVE_CACHE_MS"] = "0"
+        try:
+            cfg0 = load_config({"HEATMAP_QUERY_VIEW": "0"}, store="memory")
+            httpd0, _t0, port0 = start_background(store, cfg0, port=0)
+        finally:
+            if saved is None:
+                os.environ.pop("HEATMAP_SERVE_CACHE_MS", None)
+            else:
+                os.environ["HEATMAP_SERVE_CACHE_MS"] = saved
+        base0 = f"http://127.0.0.1:{port0}"
+        conc = {"clients": args.clients, "polls_per_client": args.polls}
+        try:
+            conc["full"] = _concurrent_mode(base0, "full", args.clients,
+                                            args.polls)
+        finally:
+            httpd0.shutdown()
+        for mode in ("etag", "delta"):
+            conc[mode] = _concurrent_mode(base, mode, args.clients,
+                                          args.polls)
+        full_rendered = max(1, conc["full"]["bytes_rendered"])
+        for mode in ("etag", "delta"):
+            conc[mode]["rendered_reduction_x"] = round(
+                full_rendered / max(1, conc[mode]["bytes_rendered"]), 1)
+        out["concurrent"] = conc
     finally:
         httpd.shutdown()
     print(json.dumps(out))

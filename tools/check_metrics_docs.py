@@ -47,8 +47,15 @@ def _smoke_runtime():
                           prefix="metrics-docs-"))
     src = MemorySource(evs)
     src.finish()
-    rt = MicroBatchRuntime(cfg, src, MemoryStore(), checkpoint_every=0)
+    store = MemoryStore()
+    rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=0)
     rt.run()
+    # building the WSGI app registers the serve-tier families (render /
+    # 304 / delta / SSE counters, view rebuilds) into the runtime's
+    # registry, so the docs gate covers the query tier too
+    from heatmap_tpu.serve.api import make_wsgi_app
+
+    make_wsgi_app(store, cfg, runtime=rt)
     return rt
 
 
