@@ -153,6 +153,15 @@ class MultiAggregator:
         self._step_pre = jax.jit(
             _step_pre, donate_argnums=donate_state_argnums())
 
+    def instrument(self, wrap) -> None:
+        """Wrap the jitted entry points with a compile tracker
+        (obs.runtimeinfo.CompileTracker.wrap): per-function compile
+        counts / compile seconds / retrace-after-warmup detection.
+        Idempotent enough for one runtime: call once, right after
+        construction and before the first step."""
+        self._step = wrap("multi_step", self._step)
+        self._step_pre = wrap("multi_step_pre", self._step_pre)
+
     def step_packed_all(self, lat_rad, lng_rad, speed, ts, valid,
                         watermark_cutoff, prekeys=None):
         """Fold one batch into every pair's state.

@@ -1248,6 +1248,19 @@ class EmitRing:
     def full(self) -> bool:
         return len(self._entries) >= self.capacity
 
+    @property
+    def nbytes(self) -> int:
+        """Bytes of packed emits currently parked on device — the ring
+        slab's share of HBM (obs.runtimeinfo memory telemetry).  All
+        entries share one shape, so this is len * entry-bytes.  Reads a
+        local snapshot: the scrape thread races the step thread's
+        take(), and a swap between the check and the index must not
+        turn the gauge sample into an error."""
+        entries = self._entries
+        if not entries:
+            return 0
+        return len(entries) * int(entries[0][0].nbytes)
+
     def append(self, packed, tag=None) -> bool:
         """Park one batch's packed emits; True when the ring is full
         (flush before the next append)."""

@@ -1,6 +1,6 @@
 """obs — pipeline-wide observability substrate.
 
-Five pieces, all dependency-free:
+Seven pieces, all dependency-free:
 
 - :mod:`registry` — counters / gauges / fixed-bucket histograms with
   Prometheus text exposition (``Registry.expose_text``);
@@ -12,6 +12,11 @@ Five pieces, all dependency-free:
   ``heatmap_event_age_seconds`` and ``/debug/freshness``;
 - :mod:`flightrec` — crash-time state dump (trace tail, lineage tail,
   metrics snapshot, config) to ``HEATMAP_FLIGHTREC_DIR``;
+- :mod:`runtimeinfo` — compile/retrace tracking on the jitted entry
+  points, device memory watermarks, and the SLO watchdog that
+  auto-captures an enriched flight record when /healthz degrades;
+- :mod:`prof` — the always-available sampling Python stack profiler
+  behind ``/debug/stacks`` (``HEATMAP_STACKPROF_HZ``);
 - :mod:`xproc` — the file-backed supervisor→child metrics channel
   (``HEATMAP_SUPERVISOR_CHANNEL``), so the child's ``/metrics`` reports
   its parent supervisor's restart counters and they survive restarts;
@@ -25,6 +30,7 @@ knobs are documented in ARCHITECTURE.md §Observability.
 
 from heatmap_tpu.obs.flightrec import FlightRecorder  # noqa: F401
 from heatmap_tpu.obs.lineage import LineageTracker  # noqa: F401
+from heatmap_tpu.obs.prof import StackSampler, get_sampler  # noqa: F401
 from heatmap_tpu.obs.registry import (  # noqa: F401
     DEFAULT_LAG_BUCKETS,
     DEFAULT_TIME_BUCKETS,

@@ -70,6 +70,14 @@ class FlightRecorder:
         """A clean close: the atexit backstop must not dump after this."""
         self._disarmed = True
 
+    def spawn(self) -> "FlightRecorder":
+        """A fresh recorder sharing this one's directory and sources —
+        the SLO watchdog's repeated auto-captures need the once-only
+        dump contract PER EPISODE, not per process lifetime."""
+        rec = FlightRecorder(self.dir)
+        rec._sources = dict(self._sources)
+        return rec
+
     @property
     def dumped(self) -> str | None:
         return self._dumped

@@ -473,6 +473,17 @@ class ShardedAggregator:
         self.device_seconds = [0.0]
         self.n_steps = 0
 
+    def instrument(self, wrap) -> None:
+        """Wrap the jitted entry points with a compile tracker
+        (obs.runtimeinfo.CompileTracker.wrap) — same contract as
+        MultiAggregator.instrument; the sharded program's retraces
+        (slab growth, policy flips) are the expensive ones, so they
+        must be the visible ones."""
+        self._step = wrap("sharded_step", self._step)
+        self._step_packed = wrap("sharded_step_packed", self._step_packed)
+        self._step_packed_pre = wrap("sharded_step_packed_pre",
+                                     self._step_packed_pre)
+
     # --- compat aliases (single-pair callers: tests, dryrun) ---------------
 
     @property

@@ -42,6 +42,11 @@ Contract parity notes (all against /root/reference/app.py):
   the operator answer to "WHERE is the staleness coming from".
 - GET /debug/view   → materialized-view status: seq, live cells,
   poisoned flag, store grid labels.
+- GET /debug/stacks → aggregated top-of-stack output of the sampling
+  Python profiler (obs.prof; lazily started, ``?n=`` bounds frames).
+- POST /debug/profile → arm an on-demand ``jax.profiler`` window on
+  the attached runtime (``?batches=&skip=&dir=``); 405 on non-POST,
+  409 while a capture is pending/active, 503 without a runtime.
 - GET /healthz      → SLO evaluation: ok / degraded / down from recent
   batch p50 vs HEATMAP_SLO_BATCH_P50_MS (default 500, the paper
   budget), emit freshness p50 vs HEATMAP_SLO_FRESHNESS_P50_S,
@@ -293,6 +298,12 @@ def _metrics_text(runtime, serve_registry=None) -> str:
 #                                 cannot see
 #   HEATMAP_SLO_RESTARTS_PER_H    supervisor failures tolerated in the
 #                                 trailing hour before degraded (4)
+# plus the runtime-introspection checks (obs.runtimeinfo):
+#   HEATMAP_SLO_RETRACES          post-warmup retraces tolerated in the
+#                                 trailing HEATMAP_SLO_RETRACE_WINDOW_S
+#                                 (0 in 600 s)
+#   HEATMAP_SLO_MEM_BYTES         device/live-buffer watermark budget
+#                                 (0 = disabled)
 def _slo(name: str, default: float) -> float:
     try:
         return float(os.environ.get(name, "") or default)
@@ -340,6 +351,13 @@ def healthz_payload(runtime) -> tuple[dict, bool]:
         if runtime.writer.poisoned:
             checks["sink"] = {"value": "poisoned", "ok": False}
             down = True
+        # runtime-introspection SLOs (obs.runtimeinfo): recent
+        # post-warmup retraces and the device-memory watermark budget
+        from heatmap_tpu.obs.runtimeinfo import healthz_checks
+
+        ri_checks, ri_degraded = healthz_checks(runtime)
+        checks.update(ri_checks)
+        degraded |= ri_degraded
     chan = SupervisorChannel.metrics_from(os.environ.get(ENV_CHANNEL))
     if chan:
         budget = _slo("HEATMAP_SLO_RESTARTS_PER_H", 4.0)
@@ -990,6 +1008,89 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                                 if runtime is not None else {}),
                     "stage_order": list(STAGES),
                 }
+                body = json.dumps(payload)
+                ctype = "application/json"
+            elif path == "/debug/profile":
+                # on-demand jax.profiler window capture: POST arms the
+                # stream runtime's ProfilerTracer for a fresh window
+                # (no restart, unlike the boot-time env).  Auth-free
+                # like the rest of the operator surface, but
+                # method-gated: a crawler GET must never arm a capture.
+                if environ.get("REQUEST_METHOD", "GET") != "POST":
+                    start_response("405 Method Not Allowed",
+                                   [("Allow", "POST"),
+                                    ("Content-Type", "application/json")])
+                    return [b'{"error": "POST required"}']
+                tracer = (getattr(runtime, "tracer", None)
+                          if runtime is not None else None)
+                if tracer is None:
+                    return _unavailable(
+                        "profiler capture needs an attached stream "
+                        "runtime")
+                params = _qs_params(environ.get("QUERY_STRING", ""))
+                batches = _qs_int(params, "batches", 16, 4096)
+                skip = _qs_int(params, "skip", 0, 4096)
+                prof_dir = params.get("dir") or ""
+                if prof_dir:
+                    # the endpoint is auth-free, so the client must not
+                    # choose an arbitrary write path: captures go under
+                    # the operator-configured HEATMAP_PROFILE_DIR (or
+                    # the system tempdir) only
+                    import tempfile
+
+                    base = (os.environ.get("HEATMAP_PROFILE_DIR")
+                            or tempfile.gettempdir())
+                    root = os.path.realpath(base).rstrip(os.sep)
+                    rp = os.path.realpath(prof_dir)
+                    if rp != root and not rp.startswith(root + os.sep):
+                        return _bad_request(
+                            f"dir= must be under {base} (set "
+                            f"HEATMAP_PROFILE_DIR to change the base)")
+                made_dir = False
+                if not prof_dir:
+                    import tempfile
+
+                    prof_dir = tempfile.mkdtemp(prefix="heatmap-profile-")
+                    made_dir = True
+                epoch = int(getattr(runtime, "epoch", 0))
+                if not tracer.arm(prof_dir, batches=max(1, batches),
+                                  skip=skip, base_epoch=epoch):
+                    if made_dir:
+                        # the refusal path must not leak one empty
+                        # tempdir per losing POST
+                        try:
+                            os.rmdir(prof_dir)
+                        except OSError:
+                            pass
+                    start_response("409 Conflict",
+                                   [("Content-Type", "application/json")])
+                    return [b'{"error": "a profiler capture is already '
+                            b'pending or active"}']
+                body = json.dumps({
+                    "armed": True, "dir": prof_dir,
+                    "batches": max(1, batches), "skip": skip,
+                    "from_epoch": epoch + skip,
+                })
+                ctype = "application/json"
+            elif path == "/debug/stacks":
+                # aggregated top-of-stack output of the sampling Python
+                # profiler (obs.prof) — started lazily on first read,
+                # then left running (its steady-state cost is <0.1% of
+                # a core).  GET-only for symmetry with the POST-only
+                # arm endpoint above.
+                if environ.get("REQUEST_METHOD", "GET") != "GET":
+                    start_response("405 Method Not Allowed",
+                                   [("Allow", "GET"),
+                                    ("Content-Type", "application/json")])
+                    return [b'{"error": "GET required"}']
+                from heatmap_tpu.obs.prof import get_sampler
+
+                sampler = get_sampler()
+                enabled = sampler.ensure_started()
+                params = _qs_params(environ.get("QUERY_STRING", ""))
+                n = _qs_int(params, "n", 40, 512)
+                payload = sampler.snapshot(n)
+                payload["enabled"] = enabled
                 body = json.dumps(payload)
                 ctype = "application/json"
             elif path == "/debug/view":

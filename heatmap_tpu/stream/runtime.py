@@ -172,6 +172,16 @@ class MicroBatchRuntime:
                 "prefetched": len(self._prefetched),
                 "writer_poisoned": self.writer.poisoned,
             })
+            # runtime-introspection enrichment (obs.runtimeinfo /
+            # obs.prof): compile counts + memory watermarks + the
+            # stack-sample tail ride every dump — crash AND the SLO
+            # watchdog's auto-captures (sources evaluate at dump time;
+            # self.runtimeinfo is assigned later in this __init__)
+            fr.add_source("runtimeinfo",
+                          lambda: self.runtimeinfo.snapshot())
+            from heatmap_tpu.obs.prof import get_sampler
+
+            fr.add_source("stacks", lambda: get_sampler().tail(20))
             self.flightrec = fr
         # pipeline-state gauges: watermark/event-time lag, state slab
         # occupancy vs capacity (the overflow early-warning), and the
@@ -234,6 +244,16 @@ class MicroBatchRuntime:
             "heatmap_emit_ring_pending",
             "packed emit batches parked on device awaiting the next flush",
             fn=lambda: len(self._ring))
+        # Runtime introspection (obs.runtimeinfo): the compile/retrace
+        # tracker wraps the jitted entry points below; the memory
+        # monitor samples on the step loop (1 Hz) and keeps the HBM /
+        # live-buffer watermarks /healthz budgets compare against.
+        # The ring-bytes callback reads self._ring dynamically — the
+        # multi-host branch may swap the ring for a depth-1 one.
+        from heatmap_tpu.obs.runtimeinfo import RuntimeIntrospection
+
+        self.runtimeinfo = RuntimeIntrospection(
+            self.metrics.registry, ring_bytes_fn=lambda: self._ring.nbytes)
         # live-prefix emit pulls (flush_pending): explicit knob wins;
         # auto = on for accelerators (where D2H bytes cost), off for CPU
         # (an extra round trip with nothing to save).  A banked pull A/B
@@ -317,6 +337,7 @@ class MicroBatchRuntime:
                 capacity_per_shard=cap, batch_size=cfg.batch_size,
                 hist_bins=bins, bucket_factor=cfg.bucket_factor,
             )
+            self._sharded.instrument(self.runtimeinfo.compile.wrap)
             for res, win_s in pairs:
                 self.aggs[(res, win_s // 60)] = self._sharded.view(res, win_s)
         else:
@@ -330,6 +351,7 @@ class MicroBatchRuntime:
                 emit_capacity=min(cfg.batch_size, cap), hist_bins=bins,
                 speed_hist_max=cfg.speed_hist_max_kmh,
             )
+            self._multi.instrument(self.runtimeinfo.compile.wrap)
             for res, win_s in pairs:
                 self.aggs[(res, win_s // 60)] = self._multi.view(res, win_s)
         self._g_capacity.set(cap)
@@ -521,6 +543,20 @@ class MicroBatchRuntime:
         # never the live source offsets, so a batch polled but not yet
         # dispatched (exception between poll and dispatch) always replays
         self._offsets_dispatched = self.source.offset()
+        # SLO watchdog + stack sampler, armed with the flight recorder:
+        # auto-capture an enriched dump when /healthz degrades, even
+        # when nobody is polling it (obs.runtimeinfo.SloWatchdog;
+        # HEATMAP_SLO_WATCHDOG_S=0 disables).  Started LAST — the
+        # watchdog thread evaluates healthz against this runtime, so
+        # every attribute it reads must exist.
+        self.slo_watchdog = None
+        if self.flightrec is not None:
+            from heatmap_tpu.obs.prof import get_sampler
+            from heatmap_tpu.obs.runtimeinfo import SloWatchdog
+
+            get_sampler().ensure_started()
+            self.slo_watchdog = SloWatchdog(self)
+            self.slo_watchdog.start()
 
     # ------------------------------------------------------------------
     def _maybe_resume(self) -> None:
@@ -1248,6 +1284,10 @@ class MicroBatchRuntime:
                 return self._step_once_inner()
         finally:
             self._step_began = None
+            # device-memory telemetry rides the loop at 1 Hz: cheap
+            # (live-array walk + per-device stats), and the watermark
+            # it maintains is what the /healthz memory budget reads
+            self.runtimeinfo.memory.sample(min_interval_s=1.0)
 
     def _next_batch(self) -> "_FeedBatch | None":
         """Produce the next feed batch: carry-drain or source poll,
@@ -1643,6 +1683,10 @@ class MicroBatchRuntime:
             self.close()
 
     def close(self) -> None:
+        if getattr(self, "slo_watchdog", None) is not None:
+            # first: a watchdog tick must not evaluate healthz (or
+            # spawn a capture) against a runtime mid-teardown
+            self.slo_watchdog.stop()
         if self.flightrec is not None:
             # Flight record BEFORE the drain, so ring/prefetch depths
             # still describe the incident.  Abnormal = fatal overflow, a

@@ -41,3 +41,18 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(autouse=True)
+def _quiesce_stack_sampler():
+    """Stop the process-wide stack sampler (obs.prof) after any test
+    that started it — directly, via /debug/stacks, or via a
+    flightrec-armed runtime.  In production it is designed to stay
+    running; across a test SESSION a sampler left over from one test
+    holds µs-scale frame references into every later test, which is
+    exactly the cross-test coupling a hermetic suite can't have."""
+    yield
+    from heatmap_tpu.obs import prof
+
+    if prof._SAMPLER is not None:
+        prof._SAMPLER.stop()

@@ -1,0 +1,118 @@
+"""tools/check_bench_regress.py — headline-rate regression gate over
+synthetic BENCH_r*.json artifact pairs (tier-1, same loader pattern as
+the other tools gates)."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+
+def _load():
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        os.pardir))
+    spec = importlib.util.spec_from_file_location(
+        "check_bench_regress",
+        os.path.join(repo, "tools", "check_bench_regress.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write(dir_path, rnd, value=None, rc=0, tail=None):
+    if tail is None:
+        tail = ("noise line\n"
+                + json.dumps({"metric": "GPS events/sec aggregated",
+                              "value": value, "unit": "events/sec"})
+                + "\ntrailing noise")
+    p = dir_path / f"BENCH_r{rnd:02d}.json"
+    p.write_text(json.dumps({"n": rnd, "rc": rc, "tail": tail}))
+    return p
+
+
+def test_ok_within_threshold(tmp_path, capsys):
+    m = _load()
+    _write(tmp_path, 1, 1_000_000.0)
+    _write(tmp_path, 2, 900_000.0)
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_fail_beyond_threshold(tmp_path, capsys):
+    m = _load()
+    _write(tmp_path, 1, 1_000_000.0)
+    _write(tmp_path, 2, 400_000.0)  # -60%
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "FAIL" in capsys.readouterr().err
+
+
+def test_threshold_is_configurable(tmp_path):
+    m = _load()
+    _write(tmp_path, 1, 1_000_000.0)
+    _write(tmp_path, 2, 900_000.0)  # -10%
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.05"]) == 1
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.15"]) == 0
+
+
+def test_improvement_always_passes(tmp_path):
+    m = _load()
+    _write(tmp_path, 1, 100.0)
+    _write(tmp_path, 2, 1_000_000.0)
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.05"]) == 0
+
+
+def test_compares_newest_pair_by_round_number(tmp_path):
+    """r02 -> r10 is the newest pair even though r10 sorts before r02
+    lexically at equal zero-padding widths it does not have."""
+    m = _load()
+    _write(tmp_path, 2, 1_000_000.0)
+    _write(tmp_path, 10, 950_000.0)
+    _write(tmp_path, 1, 10.0)  # ancient tiny rate must not matter
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
+
+
+def test_failed_runs_and_unparseable_tails_skipped(tmp_path, capsys):
+    """An rc!=0 artifact and a headline-free tail are skipped — the
+    comparison falls back to the surrounding good artifacts."""
+    m = _load()
+    _write(tmp_path, 1, 1_000_000.0)
+    _write(tmp_path, 2, 5.0, rc=1)          # failed run: ignore its rate
+    _write(tmp_path, 3, tail="no json here")  # unparseable: ignore
+    _write(tmp_path, 4, 900_000.0)
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
+    out = capsys.readouterr().out
+    assert "skipping r02" in out and "skipping r03" in out
+
+
+def test_nothing_to_compare_is_ok(tmp_path):
+    m = _load()
+    assert m.main(["--dir", str(tmp_path)]) == 0
+    _write(tmp_path, 1, 1000.0)
+    assert m.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_bad_threshold_rejected(tmp_path):
+    m = _load()
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0"]) == 2
+    assert m.main(["--dir", str(tmp_path), "--threshold", "1.5"]) == 2
+
+
+def test_headline_uses_last_metric_line(tmp_path):
+    """A re-run appends a second headline; the LAST one is the truth."""
+    m = _load()
+    tail = (json.dumps({"metric": "x", "value": 10.0}) + "\n"
+            + json.dumps({"metric": "x", "value": 1_000_000.0}))
+    _write(tmp_path, 1, tail=tail)
+    _write(tmp_path, 2, 990_000.0)
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.1"]) == 0
+
+
+def test_repo_artifacts_parse():
+    """The real BENCH_r*.json artifacts in the repo must stay parseable
+    (rate extraction, not the threshold — the measured host's clock
+    flaps are a fact of the artifact history)."""
+    m = _load()
+    arts = m.newest_pair(m.REPO)
+    assert arts, "repo should carry BENCH_r*.json artifacts"
+    assert any(v is not None and v > 0 for _, _, v in arts)

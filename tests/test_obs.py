@@ -491,3 +491,34 @@ def test_obs_top_parse_and_quantile():
     assert top.hist_quantile({}, None, 0.5) is None
     frame = top.render_frame(m, None, 0.0, {"status": "ok", "checks": {}})
     assert "ingest" in frame and "SLO" in frame and "OK" in frame
+
+
+def test_obs_top_runtime_introspection_rows():
+    """The dashboard's compile/memory rows: per-fn families fold into
+    one number, compile activity renders as a delta between scrapes,
+    and device watermarks outrank the live-buffer fallback."""
+    top = _load_obs_top()
+    text = (
+        'heatmap_compile_total{fn="multi_step"} 3\n'
+        'heatmap_compile_total{fn="multi_step_pre"} 2\n'
+        'heatmap_retrace_after_warmup_total{fn="multi_step"} 1\n'
+        "heatmap_live_buffer_bytes 1000000\n"
+        "heatmap_live_buffer_watermark_bytes 2000000\n"
+        "heatmap_emit_ring_slab_bytes 500000\n")
+    m = top.parse_prom(text)
+    assert top._sum(m, "heatmap_compile_total") == 5
+    assert top._sum(m, "heatmap_nope") is None
+    prev = top.parse_prom(
+        'heatmap_compile_total{fn="multi_step"} 3\n'
+        'heatmap_compile_total{fn="multi_step_pre"} 1\n')
+    frame = top.render_frame(m, prev, 2.0, None)
+    assert "compile" in frame and "memory" in frame
+    # delta = 5 - 4 = 1; totals + retraces + watermark all render
+    assert "Δ            1   total 5   post-warmup retraces 1" in frame
+    assert "watermark 2.0 MB" in frame and "ring slab 0.5 MB" in frame
+    # a device watermark (TPU/GPU) outranks the live-buffer fallback
+    m2 = top.parse_prom(
+        text + 'heatmap_device_hbm_watermark_bytes{device="0"} 9000000\n'
+        'heatmap_device_bytes_in_use{device="0"} 8000000\n')
+    frame2 = top.render_frame(m2, None, 0.0, None)
+    assert "watermark 9.0 MB" in frame2 and "8.0 MB" in frame2

@@ -1,0 +1,354 @@
+"""Runtime introspection (obs.runtimeinfo / obs.prof): compile/retrace
+tracking, memory telemetry, the stack sampler, and the SLO-triggered
+auto-capture watchdog — including the acceptance scenarios: a forced
+post-warmup retrace and a forced memory-watermark breach each produce
+(a) a visible metric, (b) a /healthz degradation, and (c) an automatic
+enriched flight-recorder dump, all on JAX_PLATFORMS=cpu."""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from heatmap_tpu.config import load_config
+from heatmap_tpu.obs.prof import StackSampler
+from heatmap_tpu.obs.registry import Registry
+from heatmap_tpu.obs.runtimeinfo import (
+    CompileTracker,
+    MemoryMonitor,
+    RuntimeIntrospection,
+    SloWatchdog,
+    healthz_checks,
+)
+from heatmap_tpu.sink import MemoryStore
+from heatmap_tpu.stream import MicroBatchRuntime
+from heatmap_tpu.stream.source import MemorySource
+
+
+# ------------------------------------------------------------ units
+def test_compile_tracker_counts_and_retrace_detection():
+    reg = Registry()
+    tr = CompileTracker(reg, warmup=3)
+    f = tr.wrap("f", jax.jit(lambda x: x + 1))
+    for _ in range(3):
+        f(jnp.ones(8)).block_until_ready()
+    # one compile (the first call), inside warmup: no retrace
+    assert reg._families["heatmap_compile_total"].labels(fn="f").value == 1
+    assert tr.retraces_recent(600) == 0
+    # a NEW SHAPE after warmup: a post-warmup retrace
+    f(jnp.ones(16)).block_until_ready()
+    assert reg._families["heatmap_compile_total"].labels(fn="f").value == 2
+    assert (reg._families["heatmap_retrace_after_warmup_total"]
+            .labels(fn="f").value == 1)
+    assert tr.retraces_recent(600) == 1
+    assert tr.retraces_recent(0) == 0  # outside a zero window
+    snap = tr.snapshot()
+    assert snap["functions"]["f"]["compiles"] == 2
+    assert snap["functions"]["f"]["calls"] == 4
+    assert snap["retraces_after_warmup"] == 1
+    # compile seconds observed for both compiles
+    assert reg._families["heatmap_compile_seconds"].labels(fn="f").count == 2
+
+
+def test_compile_tracker_transparent_on_plain_callables():
+    """A callable without a jit cache (host fallback paths) is passed
+    through unharmed: no compiles recorded, results intact."""
+    reg = Registry()
+    tr = CompileTracker(reg, warmup=1)
+    g = tr.wrap("g", lambda x: x * 2)
+    assert g(21) == 42
+    assert reg._families["heatmap_compile_total"].labels(fn="g").value == 0
+    assert tr.retraces_recent(600) == 0
+
+
+def test_memory_monitor_live_buffer_watermark():
+    reg = Registry()
+    mm = MemoryMonitor(reg)
+    keep = jnp.ones((256, 256))  # noqa: F841 - held live across samples
+    assert mm.sample()
+    live = reg._families["heatmap_live_buffer_bytes"].value
+    assert live >= keep.nbytes
+    assert mm.watermark_bytes >= live
+    # rate limit: an immediate re-sample inside the interval is skipped
+    assert not mm.sample(min_interval_s=60.0)
+    snap = mm.snapshot()
+    assert snap["watermark_bytes"] == mm.watermark_bytes
+
+
+def test_emit_ring_nbytes_accounting():
+    from heatmap_tpu.engine.step import EmitRing
+
+    ring = EmitRing(4)
+    assert ring.nbytes == 0
+    a = jnp.zeros((2, 17, 13), jnp.uint32)
+    ring.append(a, 0)
+    ring.append(jnp.ones((2, 17, 13), jnp.uint32), 1)
+    assert ring.nbytes == 2 * a.nbytes
+    ring.take()
+    assert ring.nbytes == 0
+
+
+def test_stack_sampler_aggregates_frames():
+    s = StackSampler(hz=200.0)
+    try:
+        assert s.ensure_started()
+        assert s.ensure_started()  # idempotent
+        deadline = time.monotonic() + 5.0
+        while s.snapshot(5)["samples"] < 5:
+            assert time.monotonic() < deadline, "sampler produced nothing"
+            time.sleep(0.02)
+        snap = s.snapshot(5)
+        assert snap["running"] and snap["frames"]
+        top = snap["frames"][0]
+        assert set(top) == {"thread", "frame", "count", "share"}
+        assert s.tail(3) == s.snapshot(3)["frames"]
+    finally:
+        s.stop()
+    assert not s.running
+
+
+def test_stack_sampler_disabled_by_hz_zero(monkeypatch):
+    monkeypatch.setenv("HEATMAP_STACKPROF_HZ", "0")
+    s = StackSampler()
+    assert not s.ensure_started() and not s.running
+    monkeypatch.setenv("HEATMAP_STACKPROF_HZ", "nope")
+    assert StackSampler().hz == 29.0  # garbage -> default
+
+
+# ------------------------------------------------------------ runtime
+def _mk_events(n, age_s=2):
+    t0 = int(time.time()) - age_s
+    return [{"provider": "p", "vehicleId": f"v{i % 7}",
+             "lat": 42.0 + (i % 40) * 1e-3, "lon": -71.0,
+             "speedKmh": 10.0, "ts": t0} for i in range(n)]
+
+
+def _mk_runtime(tmp_path, **over):
+    over.setdefault("checkpoint_dir", str(tmp_path / "ckpt"))
+    over.setdefault("batch_size", 16)
+    over.setdefault("state_capacity_log2", 8)
+    over.setdefault("speed_hist_bins", 4)
+    over.setdefault("store", "memory")
+    over.setdefault("emit_flush_k", 1)
+    over.setdefault("prefetch_batches", 0)
+    cfg = load_config({}, **over)
+    src = MemorySource(_mk_events(16 * 4))
+    src.finish()
+    return MicroBatchRuntime(cfg, src, MemoryStore(), checkpoint_every=0)
+
+
+def _drain(rt):
+    while rt.step_once():
+        pass
+
+
+def _force_retrace(rt):
+    """Warm the fused step, then grow the slab: the next step's new
+    shapes add a jit cache entry — a post-warmup retrace."""
+    _drain(rt)
+    assert rt.runtimeinfo.compile.retraces_recent(600) == 0
+    rt._multi.grow(2 * rt._multi.capacity_per_shard)
+    src2 = MemorySource(_mk_events(16 * 2))
+    src2.finish()
+    rt.source = src2
+    _drain(rt)
+
+
+def test_acceptance_post_warmup_retrace(tmp_path, monkeypatch):
+    """Forced retrace -> visible metric + /healthz degradation + an
+    automatic ENRICHED flight-recorder dump."""
+    monkeypatch.setenv("HEATMAP_SLO_FRESHNESS_P50_MS", "1e9")  # isolate
+    frdir = tmp_path / "fr"
+    rt = _mk_runtime(tmp_path, flightrec_dir=str(frdir))
+    try:
+        _force_retrace(rt)
+        # (a) the metric
+        fam = rt.metrics.registry._families[
+            "heatmap_retrace_after_warmup_total"]
+        assert sum(c.value for c in fam.children.values()) >= 1
+        # (b) /healthz degrades on the retrace check
+        from heatmap_tpu.serve.api import healthz_payload
+
+        payload, down = healthz_payload(rt)
+        assert not down and payload["status"] == "degraded"
+        chk = payload["checks"]["retrace_after_warmup"]
+        assert chk["value"] >= 1 and not chk["ok"]
+        # (c) the watchdog auto-captures an enriched dump
+        path = rt.slo_watchdog.check_once()
+        assert path is not None
+        d = json.loads(open(path).read())
+        assert d["reason"].startswith("slo degraded:")
+        assert "retrace_after_warmup" in d["reason"]
+        fns = d["runtimeinfo"]["compile"]["functions"]
+        assert any(f["compiles"] >= 2 for f in fns.values())
+        assert d["runtimeinfo"]["compile"]["retraces_after_warmup"] >= 1
+        assert d["runtimeinfo"]["memory"]["watermark_bytes"] > 0
+        assert isinstance(d["stacks"], list)
+        assert not d["healthz"]["checks"]["retrace_after_warmup"]["ok"]
+    finally:
+        rt.close()
+
+
+def test_acceptance_memory_watermark_breach(tmp_path, monkeypatch):
+    """Forced watermark breach (1-byte budget) -> visible metric +
+    /healthz degradation + automatic enriched dump."""
+    monkeypatch.setenv("HEATMAP_SLO_FRESHNESS_P50_MS", "1e9")
+    monkeypatch.setenv("HEATMAP_SLO_MEM_BYTES", "1")
+    frdir = tmp_path / "fr"
+    rt = _mk_runtime(tmp_path, flightrec_dir=str(frdir))
+    try:
+        _drain(rt)  # the loop samples memory at 1 Hz -> watermark set
+        # (a) the metric
+        wm = rt.metrics.registry._families[
+            "heatmap_live_buffer_watermark_bytes"].value
+        assert wm > 1
+        # (b) /healthz
+        from heatmap_tpu.serve.api import healthz_payload
+
+        payload, down = healthz_payload(rt)
+        assert payload["status"] == "degraded"
+        chk = payload["checks"]["memory_watermark_bytes"]
+        assert chk["value"] > chk["budget"] and not chk["ok"]
+        # (c) the enriched auto-capture
+        path = rt.slo_watchdog.check_once()
+        assert path is not None
+        d = json.loads(open(path).read())
+        assert "memory_watermark_bytes" in d["reason"]
+        assert d["runtimeinfo"]["memory"]["watermark_bytes"] > 1
+    finally:
+        rt.close()
+
+
+def test_healthz_checks_quiet_when_healthy(tmp_path, monkeypatch):
+    """No retraces, no memory budget: the introspection checks stay out
+    of the payload entirely (no noise on a healthy pipeline)."""
+    monkeypatch.delenv("HEATMAP_SLO_MEM_BYTES", raising=False)
+    monkeypatch.delenv("HEATMAP_SLO_RETRACES", raising=False)
+    rt = _mk_runtime(tmp_path)
+    try:
+        _drain(rt)
+        checks, degraded = healthz_checks(rt)
+        assert checks == {} and not degraded
+    finally:
+        rt.close()
+    # and on a runtime-less object (serve-only healthz path)
+    assert healthz_checks(object()) == ({}, False)
+
+
+def test_watchdog_one_capture_per_episode(tmp_path, monkeypatch):
+    """While the verdict STAYS degraded no second dump fires; a recovery
+    re-arms the watchdog for the next episode."""
+    monkeypatch.setenv("HEATMAP_SLO_FRESHNESS_P50_MS", "1e9")
+    frdir = tmp_path / "fr"
+    rt = _mk_runtime(tmp_path, flightrec_dir=str(frdir))
+    try:
+        _drain(rt)
+        wd = SloWatchdog(rt, interval_s=0, cooldown_s=0)
+        monkeypatch.setenv("HEATMAP_SLO_MEM_BYTES", "1")  # degraded
+        p1 = wd.check_once()
+        assert p1 is not None
+        assert wd.check_once() is None        # same episode: no dump
+        monkeypatch.setenv("HEATMAP_SLO_MEM_BYTES", "1e18")  # recovered
+        assert wd.check_once() is None        # transition to ok
+        monkeypatch.setenv("HEATMAP_SLO_MEM_BYTES", "1")  # episode 2
+        p2 = wd.check_once()
+        assert p2 is not None and p2 != p1
+        assert wd.n_captures == 2
+    finally:
+        rt.close()
+
+
+def test_watchdog_thread_fires_on_degradation(tmp_path, monkeypatch):
+    monkeypatch.setenv("HEATMAP_SLO_FRESHNESS_P50_MS", "1e9")
+    monkeypatch.setenv("HEATMAP_SLO_MEM_BYTES", "1")
+    frdir = tmp_path / "fr"
+    rt = _mk_runtime(tmp_path, flightrec_dir=str(frdir))
+    try:
+        _drain(rt)
+        wd = SloWatchdog(rt, interval_s=0.05, cooldown_s=0)
+        assert wd.start()
+        deadline = time.monotonic() + 5.0
+        while wd.n_captures == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        wd.stop()
+        assert wd.n_captures >= 1
+        assert list(frdir.glob("flightrec-*.json"))
+    finally:
+        rt.close()
+
+
+def test_crash_dump_carries_runtime_introspection(tmp_path):
+    """Satellite: the CRASH-path flight record is enriched too — the
+    runtimeinfo snapshot and the stack tail ride every dump."""
+    from heatmap_tpu.testing.faults import CrashingSource, InjectedCrash
+
+    frdir = tmp_path / "fr"
+    cfg = load_config({}, checkpoint_dir=str(tmp_path / "ckpt"),
+                      batch_size=16, state_capacity_log2=8,
+                      speed_hist_bins=4, store="memory", emit_flush_k=1,
+                      prefetch_batches=0, flightrec_dir=str(frdir))
+    src = CrashingSource(MemorySource(_mk_events(48)),
+                         crash_after_polls=2)
+    rt = MicroBatchRuntime(cfg, src, MemoryStore(), checkpoint_every=0)
+    with pytest.raises(InjectedCrash):
+        rt.run()
+    files = sorted(frdir.glob("flightrec-*.json"))
+    assert len(files) == 1
+    d = json.loads(files[0].read_text())
+    ri = d["runtimeinfo"]
+    assert ri["compile"]["functions"]  # the wrapped entry points
+    assert any(f["compiles"] >= 1 for f in ri["compile"]["functions"].values())
+    assert ri["memory"]["watermark_bytes"] > 0
+    assert isinstance(d["stacks"], list)
+
+
+def test_metrics_exposition_carries_new_families(tmp_path):
+    rt = _mk_runtime(tmp_path)
+    try:
+        _drain(rt)
+        txt = rt.metrics.expose_text()
+        for fam in ("heatmap_compile_total",
+                    "heatmap_compile_seconds",
+                    "heatmap_retrace_after_warmup_total",
+                    "heatmap_live_buffer_bytes",
+                    "heatmap_live_buffer_watermark_bytes",
+                    "heatmap_emit_ring_slab_bytes",
+                    "heatmap_device_hbm_watermark_bytes"):
+            assert f"# TYPE {fam}" in txt, fam
+        assert 'heatmap_compile_total{fn="multi_step' in txt
+    finally:
+        rt.close()
+
+
+def test_introspection_bundle_snapshot_shape():
+    reg = Registry()
+    ri = RuntimeIntrospection(reg, ring_bytes_fn=lambda: 123)
+    snap = ri.snapshot()
+    assert set(snap) == {"compile", "memory"}
+    assert reg._families["heatmap_emit_ring_slab_bytes"].value == 123
+
+
+def test_watchdog_episode_survives_cooldown_window(tmp_path, monkeypatch):
+    """A degradation that BEGINS inside the cooldown window must still
+    be captured once the cooldown lapses — the transition is only
+    consumed by a successful dump, never by a blocked tick."""
+    monkeypatch.setenv("HEATMAP_SLO_FRESHNESS_P50_MS", "1e9")
+    rt = _mk_runtime(tmp_path, flightrec_dir=str(tmp_path / "fr"))
+    try:
+        _drain(rt)
+        wd = SloWatchdog(rt, interval_s=0, cooldown_s=0)
+        monkeypatch.setenv("HEATMAP_SLO_MEM_BYTES", "1")
+        assert wd.check_once() is not None           # episode 1
+        monkeypatch.setenv("HEATMAP_SLO_MEM_BYTES", "1e18")
+        assert wd.check_once() is None               # recovered
+        wd.cooldown_s = 3600
+        monkeypatch.setenv("HEATMAP_SLO_MEM_BYTES", "1")
+        assert wd.check_once() is None  # episode 2, inside cooldown
+        assert wd.check_once() is None  # still blocked, NOT consumed
+        wd.cooldown_s = 0               # cooldown lapses mid-episode
+        assert wd.check_once() is not None  # episode 2 captured late
+        assert wd.n_captures == 2
+    finally:
+        rt.close()

@@ -955,3 +955,154 @@ def test_grid_param_header_injection_rejected(store):
     finally:
         httpd.shutdown()
         httpd.server_close()
+
+
+def test_debug_stacks_endpoint(tmp_path):
+    """GET /debug/stacks returns the aggregated top-of-stack payload
+    (lazily starting the sampler); non-GET answers 405."""
+    cfg, st, rt = _mini_runtime(str(tmp_path))
+    httpd, _t, port = start_background(st, cfg, runtime=rt, port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        d = get_json(base + "/debug/stacks?n=5")
+        assert d["enabled"] is True and d["running"] is True
+        assert {"samples", "hz", "frames", "uptime_s"} <= set(d)
+        # the sampler accumulates across requests; frames are bounded
+        deadline = time.time() + 5.0
+        while not d["frames"] and time.time() < deadline:
+            time.sleep(0.05)
+            d = get_json(base + "/debug/stacks?n=5")
+        assert len(d["frames"]) <= 5
+        if d["frames"]:
+            assert {"thread", "frame", "count", "share"} <= set(d["frames"][0])
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/debug/stacks", data=b"",
+                                   timeout=10)  # POST
+        assert ei.value.code == 405
+        assert ei.value.headers["Allow"] == "GET"
+    finally:
+        httpd.shutdown()
+
+
+def test_debug_profile_method_gate_and_conflict(tmp_path):
+    """POST /debug/profile arms a capture window; GET answers 405; a
+    second POST while the window is pending answers 409; a stopped
+    window re-arms."""
+    cfg, st, rt = _mini_runtime(str(tmp_path))
+    httpd, _t, port = start_background(st, cfg, runtime=rt, port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/debug/profile", timeout=10)
+        assert ei.value.code == 405
+        assert ei.value.headers["Allow"] == "POST"
+
+        prof_dir = str(tmp_path / "prof")
+        url = (base + "/debug/profile?batches=4&skip=1&dir=" + prof_dir)
+        with urllib.request.urlopen(url, data=b"", timeout=10) as r:
+            d = json.loads(r.read())
+        assert d["armed"] is True and d["dir"] == prof_dir
+        assert d["batches"] == 4
+        assert d["from_epoch"] == rt.epoch + 1
+        assert rt.tracer.busy
+
+        # concurrent-capture rejection
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url, data=b"", timeout=10)
+        assert ei.value.code == 409
+        assert "already" in json.loads(ei.value.read())["error"]
+
+        rt.tracer.stop()  # cancel the pending window -> re-armable
+        with urllib.request.urlopen(base + "/debug/profile", data=b"",
+                                    timeout=10) as r:
+            d = json.loads(r.read())
+        assert d["armed"] is True and d["dir"]  # server-chosen tmp dir
+    finally:
+        httpd.shutdown()
+
+
+def test_debug_profile_without_runtime_503():
+    httpd, _t, port = start_background(MemoryStore(),
+                                       load_config({}, serve_port=0),
+                                       port=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/profile", data=b"",
+                timeout=10)
+        assert ei.value.code == 503
+    finally:
+        httpd.shutdown()
+
+
+def test_healthz_degrades_on_post_warmup_retrace(tmp_path, monkeypatch):
+    """The acceptance transition over HTTP: a forced post-warmup
+    retrace flips /healthz to degraded on the retrace check while the
+    batch-latency SLO stays green."""
+    monkeypatch.setenv("HEATMAP_SLO_FRESHNESS_P50_MS", "1e9")
+    # enough batches that the recent batch-p50 is a steady-state step,
+    # not the first-compile outlier
+    cfg, st, rt = _mini_runtime(str(tmp_path), events=96, batch=16)
+    httpd, _t, port = start_background(st, cfg, runtime=rt, port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        assert get_json(base + "/healthz")["status"] == "ok"
+        # grow the slab and fold one more batch: new shapes retrace the
+        # warmed fused step
+        rt._multi.grow(2 * rt._multi.capacity_per_shard)
+        from heatmap_tpu.stream.source import MemorySource
+        import time as _t
+
+        t0 = int(_t.time()) - 2
+        src = MemorySource([
+            {"provider": "p", "vehicleId": "v1", "lat": 42.0,
+             "lon": -71.0, "speedKmh": 1.0, "ts": t0}])
+        src.finish()
+        rt.source = src
+        while rt.step_once():
+            pass
+        hz = get_json(base + "/healthz")
+        assert hz["status"] == "degraded"
+        chk = hz["checks"]["retrace_after_warmup"]
+        assert chk["value"] >= 1 and not chk["ok"]
+        assert hz["checks"]["batch_p50_ms"]["ok"]
+        # /metrics exposes the retrace family with the fn label
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            txt = r.read().decode()
+        assert 'heatmap_retrace_after_warmup_total{fn="multi_step' in txt
+    finally:
+        httpd.shutdown()
+
+
+def test_debug_profile_dir_constrained_and_no_tempdir_leak(tmp_path):
+    """dir= outside the allowed base answers 400 (auth-free endpoint,
+    clients must not pick arbitrary write paths), and a no-dir POST
+    losing the capture race does not leak its fallback tempdir."""
+    import glob
+    import os
+    import tempfile
+
+    cfg, st, rt = _mini_runtime(str(tmp_path))
+    httpd, _t, port = start_background(st, cfg, runtime=rt, port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                base + "/debug/profile?dir=/root/forbidden-prof",
+                data=b"", timeout=10)
+        assert ei.value.code == 400
+        assert "dir=" in json.loads(ei.value.read())["error"]
+        assert not os.path.exists("/root/forbidden-prof")
+
+        # occupy the window, then lose the race without a dir
+        assert rt.tracer.arm(str(tmp_path / "w"), batches=4)
+        pat = os.path.join(tempfile.gettempdir(), "heatmap-profile-*")
+        before = set(glob.glob(pat))
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/debug/profile", data=b"",
+                                   timeout=10)
+        assert ei.value.code == 409
+        assert set(glob.glob(pat)) == before  # no orphan dir
+    finally:
+        rt.tracer.stop()
+        httpd.shutdown()

@@ -1,0 +1,200 @@
+"""ProfilerTracer (stream/trace.py): window accounting, env handling,
+partial-capture flush, double-stop safety, runtime re-arming."""
+
+import threading
+
+import pytest
+
+from heatmap_tpu.stream.trace import ProfilerTracer, Tracer
+
+
+class FakeProfiler:
+    """Stands in for jax.profiler: records start/stop calls."""
+
+    def __init__(self, start_raises=None):
+        self.starts = []
+        self.stops = 0
+        self._start_raises = start_raises
+
+    def start_trace(self, d):
+        if self._start_raises:
+            raise self._start_raises
+        self.starts.append(d)
+
+    def stop_trace(self):
+        self.stops += 1
+
+    class StepTraceAnnotation:
+        def __init__(self, name, step_num=0):
+            pass
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+
+@pytest.fixture()
+def fake(monkeypatch):
+    import jax
+
+    fp = FakeProfiler()
+    monkeypatch.setattr(jax, "profiler", fp)
+    return fp
+
+
+def _run_batches(tr, n, start=0):
+    for epoch in range(start, start + n):
+        with tr.batch(epoch):
+            pass
+
+
+def test_alias_is_the_same_class():
+    assert Tracer is ProfilerTracer
+
+
+def test_disabled_without_dir_never_touches_profiler(fake):
+    tr = ProfilerTracer(env={})
+    _run_batches(tr, 5)
+    tr.stop()
+    assert fake.starts == [] and fake.stops == 0
+
+
+def test_skip_and_batches_accounting(fake, tmp_path):
+    """skip=2 batches untraced; the window spans exactly `batches`
+    epochs and stops at its end (not one late, not one early)."""
+    tr = ProfilerTracer(env={"HEATMAP_PROFILE_DIR": str(tmp_path),
+                             "HEATMAP_PROFILE_SKIP": "2",
+                             "HEATMAP_PROFILE_BATCHES": "3"})
+    _run_batches(tr, 2)
+    assert fake.starts == []          # still skipping
+    _run_batches(tr, 1, start=2)
+    assert fake.starts == [str(tmp_path)] and fake.stops == 0
+    _run_batches(tr, 1, start=3)
+    assert fake.stops == 0            # mid-window
+    _run_batches(tr, 1, start=4)      # epoch 4 = 3rd traced batch
+    assert fake.stops == 1
+    assert not tr.busy
+    _run_batches(tr, 5, start=5)      # window done: no re-start
+    assert fake.starts == [str(tmp_path)] and fake.stops == 1
+
+
+def test_bad_env_values_fall_back_to_defaults(fake, tmp_path):
+    tr = ProfilerTracer(env={"HEATMAP_PROFILE_DIR": str(tmp_path),
+                             "HEATMAP_PROFILE_SKIP": "banana",
+                             "HEATMAP_PROFILE_BATCHES": "2"})
+    # the ValueError aborts parsing; BOTH knobs keep their defaults
+    assert tr.skip == 2 and tr.batches == 16
+
+
+def test_negative_env_values_clamped(fake, tmp_path):
+    tr = ProfilerTracer(env={"HEATMAP_PROFILE_DIR": str(tmp_path),
+                             "HEATMAP_PROFILE_SKIP": "-5",
+                             "HEATMAP_PROFILE_BATCHES": "0"})
+    assert tr.skip == 0 and tr.batches == 1
+    _run_batches(tr, 2)
+    assert fake.starts == [str(tmp_path)]
+    assert fake.stops == 1            # 1-batch window closed itself
+
+
+def test_partial_capture_flushed_on_early_close(fake, tmp_path):
+    """runtime.close() calls stop() mid-window: the partial trace must
+    be written (stop_trace called), not dangle."""
+    tr = ProfilerTracer(env={"HEATMAP_PROFILE_DIR": str(tmp_path),
+                             "HEATMAP_PROFILE_SKIP": "0",
+                             "HEATMAP_PROFILE_BATCHES": "100"})
+    _run_batches(tr, 3)
+    assert fake.starts and fake.stops == 0
+    tr.stop()
+    assert fake.stops == 1
+
+
+def test_double_stop_safe(fake, tmp_path):
+    tr = ProfilerTracer(env={"HEATMAP_PROFILE_DIR": str(tmp_path),
+                             "HEATMAP_PROFILE_SKIP": "0"})
+    _run_batches(tr, 1)
+    tr.stop()
+    tr.stop()
+    assert fake.stops == 1
+    # and stop() before any start is a no-op on the profiler
+    tr2 = ProfilerTracer(env={"HEATMAP_PROFILE_DIR": str(tmp_path)})
+    tr2.stop()
+    assert fake.stops == 1
+
+
+def test_exception_escaping_batch_flushes(fake, tmp_path):
+    tr = ProfilerTracer(env={"HEATMAP_PROFILE_DIR": str(tmp_path),
+                             "HEATMAP_PROFILE_SKIP": "0",
+                             "HEATMAP_PROFILE_BATCHES": "50"})
+    with pytest.raises(RuntimeError):
+        with tr.batch(0):
+            raise RuntimeError("boom")
+    assert fake.stops == 1            # dangling trace would block re-arm
+    assert not tr.busy
+
+
+def test_start_failure_disables_window(fake, monkeypatch, tmp_path):
+    import jax
+
+    fp = FakeProfiler(start_raises=RuntimeError("unsupported"))
+    monkeypatch.setattr(jax, "profiler", fp)
+    tr = ProfilerTracer(env={"HEATMAP_PROFILE_DIR": str(tmp_path),
+                             "HEATMAP_PROFILE_SKIP": "0"})
+    _run_batches(tr, 3)
+    assert fp.stops == 0 and not tr.busy
+
+
+def test_arm_runtime_window_and_busy_refusal(fake, tmp_path):
+    """arm() opens a window relative to the CURRENT epoch; while it is
+    pending or active a second arm refuses (the 409 contract)."""
+    tr = ProfilerTracer(env={})
+    assert not tr.busy
+    assert tr.arm(str(tmp_path), batches=2, skip=1, base_epoch=10)
+    assert tr.busy
+    assert not tr.arm(str(tmp_path), batches=2)      # pending -> refuse
+    _run_batches(tr, 1, start=10)                    # skip batch
+    assert fake.starts == []
+    _run_batches(tr, 1, start=11)                    # window starts
+    assert fake.starts == [str(tmp_path)]
+    assert not tr.arm(str(tmp_path), batches=2)      # active -> refuse
+    _run_batches(tr, 1, start=12)                    # window ends
+    assert fake.stops == 1 and not tr.busy
+    # idle again: a SECOND window arms and captures
+    assert tr.arm(str(tmp_path / "w2"), batches=1, base_epoch=13)
+    _run_batches(tr, 1, start=13)
+    assert fake.starts[-1] == str(tmp_path / "w2") and fake.stops == 2
+
+
+def test_arm_rejects_empty_dir_and_clamps(fake, tmp_path):
+    tr = ProfilerTracer(env={})
+    assert not tr.arm("")
+    assert tr.arm(str(tmp_path), batches=0, skip=-3, base_epoch=5)
+    assert tr.batches == 1 and tr.skip == 5
+
+
+def test_stop_cancels_pending_window(fake, tmp_path):
+    tr = ProfilerTracer(env={})
+    assert tr.arm(str(tmp_path), batches=4, skip=100, base_epoch=0)
+    tr.stop()                         # cancelled before it ever started
+    assert not tr.busy and fake.stops == 0
+    _run_batches(tr, 200)
+    assert fake.starts == []
+
+
+def test_arm_is_thread_safe_single_winner(fake, tmp_path):
+    """N racing arms admit exactly one window (the HTTP threads race
+    the step thread for the state transition)."""
+    tr = ProfilerTracer(env={})
+    wins = []
+    barrier = threading.Barrier(8)
+
+    def try_arm(i):
+        barrier.wait()
+        if tr.arm(str(tmp_path / f"w{i}"), batches=1):
+            wins.append(i)
+
+    ts = [threading.Thread(target=try_arm, args=(i,)) for i in range(8)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert len(wins) == 1

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Fail when the newest bench artifact regressed vs the previous one.
+
+The repo accumulates one ``BENCH_r*.json`` per round; each embeds the
+headline metric line bench.py prints (``{"metric": ..., "value":
+<events/sec>, ...}``) in its ``tail``.  Nothing compared consecutive
+artifacts, so a change that halved the headline rate would ship
+silently unless someone eyeballed the numbers.  This check is that
+comparison: parse the newest two artifacts' headline rates and fail
+when the newest dropped by more than ``--threshold`` (fraction of the
+previous rate, default 0.5 — generous because the measured host's
+clock flaps ~3x on a minutes timescale, see the cpu_banked_note in the
+artifacts; tighten it on dedicated hardware).
+
+Artifacts whose run failed (``rc != 0``) or whose tail carries no
+parseable headline are skipped with a note — a broken bench run should
+fail ITS OWN gate, not masquerade as a perf regression here.
+
+Usage:
+    python tools/check_bench_regress.py [--dir REPO] [--threshold 0.5]
+Exit codes: 0 ok / nothing to compare, 1 regression, 2 bad arguments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def artifact_round(path: str) -> int | None:
+    m = _ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def headline_rate(path: str) -> float | None:
+    """The headline events/sec of one artifact, or None when the run
+    failed or no metric line parses."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            art = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if art.get("rc", 0) != 0:
+        return None
+    # the headline is a JSON object on its own line inside the captured
+    # tail; scan from the END so a re-run's final metric wins
+    for line in reversed(str(art.get("tail", "")).splitlines()):
+        line = line.strip()
+        if not (line.startswith("{") and '"value"' in line):
+            continue
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        v = d.get("value")
+        if isinstance(v, (int, float)) and v > 0:
+            return float(v)
+    return None
+
+
+def newest_pair(dir_path: str) -> list:
+    """[(round, path, rate)] for every parseable artifact, round-sorted."""
+    out = []
+    for p in glob.glob(os.path.join(glob.escape(dir_path),
+                                    "BENCH_r*.json")):
+        rnd = artifact_round(p)
+        if rnd is None:
+            continue
+        out.append((rnd, p, headline_rate(p)))
+    return sorted(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dir", default=REPO,
+                    help="directory holding BENCH_r*.json (default: repo)")
+    ap.add_argument("--threshold", type=float, default=0.5,
+                    help="max tolerated fractional drop vs the previous "
+                         "artifact (default 0.5 = fail below half)")
+    args = ap.parse_args(argv)
+    if not 0 < args.threshold < 1:
+        print("check_bench_regress: --threshold must be in (0, 1)",
+              file=sys.stderr)
+        return 2
+
+    arts = newest_pair(args.dir)
+    usable = [(r, p, v) for r, p, v in arts if v is not None]
+    for r, p, v in arts:
+        if v is None:
+            print(f"note: skipping r{r:02d} ({os.path.basename(p)}): "
+                  f"failed run or no parseable headline")
+    if len(usable) < 2:
+        print(f"OK: {len(usable)} usable artifact(s) — nothing to compare")
+        return 0
+    (r_prev, p_prev, prev), (r_new, p_new, new) = usable[-2], usable[-1]
+    drop = (prev - new) / prev
+    line = (f"r{r_prev:02d} {prev:,.0f} ev/s -> r{r_new:02d} "
+            f"{new:,.0f} ev/s ({-drop:+.1%})")
+    if drop > args.threshold:
+        print(f"FAIL: headline regression beyond {args.threshold:.0%}: "
+              f"{line}", file=sys.stderr)
+        return 1
+    print(f"OK: {line} within the {args.threshold:.0%} threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,7 +5,8 @@ Polls a running heatmap serve endpoint and renders the numbers an
 operator watches during an incident: ingest rate, batch p50/p95,
 end-to-end freshness (event-age p50/p99, through the prefetch queue and
 the device emit ring — obs.lineage), emit-ring depth, sink queue/
-backpressure, and the /healthz SLO verdict.  Rates and recent quantiles
+backpressure, compile/retrace activity and device-memory watermarks
+(obs.runtimeinfo), and the /healthz SLO verdict.  Rates and recent quantiles
 are computed from DELTAS between successive scrapes of the cumulative
 Prometheus histograms, so the display tracks the last interval, not the
 lifetime distribution.
@@ -102,6 +103,15 @@ def _val(m: dict, name: str, labels: str = "") -> float | None:
     return m.get(name, {}).get(labels)
 
 
+def _sum(m: dict, name: str) -> float | None:
+    """Sum a family across its labelsets (e.g. per-fn compile counters
+    folded into one number an operator can watch)."""
+    series = m.get(name)
+    if not series:
+        return None
+    return sum(series.values())
+
+
 def render_frame(m: dict, prev: dict | None, dt: float,
                  health: dict | None) -> str:
     def rate(name):
@@ -149,6 +159,30 @@ def render_frame(m: dict, prev: dict | None, dt: float,
         f"  sink      queue {fmt(_val(m, 'heatmap_sink_queue_depth'), digits=0)}   "
         f"retries {fmt(_val(m, 'heatmap_sink_retries_total'), digits=0)}   "
         f"watermark age {fmt(_val(m, 'heatmap_watermark_age_seconds'), ' s', digits=1)}")
+    # runtime introspection (obs.runtimeinfo): compile activity as a
+    # DELTA between scrapes (a nonzero steady-state compile rate IS the
+    # retrace incident), retraces + high-water marks as lifetime values
+    compiles = _sum(m, "heatmap_compile_total")
+    d_compiles = None
+    if compiles is not None and prev is not None:
+        was = _sum(prev, "heatmap_compile_total")
+        d_compiles = compiles - was if was is not None else None
+    retraces = _sum(m, "heatmap_retrace_after_warmup_total")
+    lines.append(
+        f"  compile   Δ {fmt(d_compiles, digits=0):>12}   "
+        f"total {fmt(compiles, digits=0)}   "
+        f"post-warmup retraces {fmt(retraces, digits=0)}")
+    mem = _val(m, "heatmap_live_buffer_bytes")
+    mem_wm = _val(m, "heatmap_live_buffer_watermark_bytes")
+    dev_wm = m.get("heatmap_device_hbm_watermark_bytes")
+    if dev_wm:  # device stats exist (TPU/GPU): show the hottest device
+        mem_wm = max(dev_wm.values())
+        in_use = m.get("heatmap_device_bytes_in_use")
+        mem = max(in_use.values()) if in_use else mem
+    lines.append(
+        f"  memory    in-use {fmt(mem, ' MB', 1 / 1e6):>12}   "
+        f"watermark {fmt(mem_wm, ' MB', 1 / 1e6)}   "
+        f"ring slab {fmt(_val(m, 'heatmap_emit_ring_slab_bytes'), ' MB', 1 / 1e6)}")
     if health is not None:
         status = health.get("status", "?")
         bad = [k for k, c in health.get("checks", {}).items()
