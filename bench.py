@@ -747,6 +747,11 @@ def main() -> dict:
         "span_pull_ms": info.get("span_pull_ms"),
     }
     result.update(_ref_cpu_baseline_attach(eps))
+    # fleet provenance (obs.fleet): member count + per-member rate, so
+    # scale-out rounds inherit a comparable per-member baseline
+    from heatmap_tpu.obs.fleet import fleet_stamp
+
+    result.update(fleet_stamp(eps))
     if dev.platform == "cpu":
         result.update(_cpu_headline_bank(eps, info, res=res,
                                          pipeline=pipeline, impl=impl,

@@ -82,10 +82,15 @@ class FlightRecorder:
     def dumped(self) -> str | None:
         return self._dumped
 
-    def dump(self, reason: str) -> str | None:
+    def dump(self, reason: str, episode_id: str | None = None) -> str | None:
         """Write the flight record; returns its path, or None when this
         recorder already dumped / was disarmed / cannot write.  Never
-        raises — the recorder runs on dying codepaths."""
+        raises — the recorder runs on dying codepaths.
+
+        ``episode_id`` is the fleet correlation id (obs.xproc episode
+        broadcast): every member's dump for one incident carries the
+        same id top-level, so post-mortem tooling can collect the dump
+        SET for an episode with one grep instead of mtime archaeology."""
         with self._lock:
             if self._dumped is not None or self._disarmed:
                 return None
@@ -95,6 +100,8 @@ class FlightRecorder:
             "t_wall": round(time.time(), 3),
             "pid": os.getpid(),
         }
+        if episode_id:
+            payload["episode_id"] = str(episode_id)
         for name, fn in self._sources.items():
             try:
                 payload[name] = json_safe(fn())
@@ -142,10 +149,11 @@ def from_env(env=None) -> FlightRecorder | None:
     return FlightRecorder(d) if d else None
 
 
-def dump_snapshot(dir_path: str, reason: str, sources: dict) -> str | None:
+def dump_snapshot(dir_path: str, reason: str, sources: dict,
+                  episode_id: str | None = None) -> str | None:
     """One-shot dump of already-materialized values (the supervisor's
     child-failure hook: it has no live runtime to source from)."""
     rec = FlightRecorder(dir_path)
     for name, value in sources.items():
         rec.add_source(name, lambda v=value: v)
-    return rec.dump(reason)
+    return rec.dump(reason, episode_id=episode_id)

@@ -39,8 +39,15 @@ import threading
 import time
 
 # Stage keys, in pipeline order (the decomposition /debug/freshness and
-# the conservation test enumerate).
-STAGES = ("poll_wait", "prefetch_queue", "fold", "ring", "sink_commit")
+# the conservation test enumerate).  view_apply is the cross-process
+# extension stage: time from the sink-commit ack until the batch is
+# visible in a materialized tile view — stamped by the process that
+# applies the view (the writer-fed view in-process today; a replicated
+# serve worker in the scale-out shape), and stitched into the fleet
+# decomposition by lineage id (obs.fleet).  Records without a view
+# stay 5-stage; conservation holds over whichever stages exist.
+STAGES = ("poll_wait", "prefetch_queue", "fold", "ring", "sink_commit",
+          "view_apply")
 
 
 def json_safe(obj):
@@ -66,8 +73,16 @@ def json_safe(obj):
 class LineageTracker:
     """Opens, stamps, and retains per-batch freshness lineage records."""
 
-    def __init__(self, capacity: int = 256, clock=time.time):
+    def __init__(self, capacity: int = 256, clock=time.time,
+                 origin: str = "local"):
         self.clock = clock
+        # the lineage-id namespace: records are stamped
+        # ``lid="<origin>-<seq>"`` so contributions from DIFFERENT
+        # processes (a runtime shard's fold stages, a serve worker's
+        # view-apply stage) stitch back together in the fleet
+        # aggregator.  The runtime passes its fleet tag; "local" keeps
+        # standalone trackers unique-enough within one process.
+        self.origin = str(origin)
         self._lock = threading.Lock()
         self._seq = 0
         self._tail: collections.deque = collections.deque(
@@ -87,6 +102,7 @@ class LineageTracker:
             seq = self._seq
         return {
             "seq": seq,
+            "lid": f"{self.origin}-{seq}",  # cross-process stitch key
             "epoch": None,              # stamped at dispatch
             "n_events": int(n_events),
             "ev_min_ts": int(ev_min_ts),
@@ -137,6 +153,25 @@ class LineageTracker:
                 self._newest_committed_ts = rec["ev_max_ts"]
         return rec
 
+    def view_applied(self, rec: dict, view_seq=None) -> dict:
+        """The materialized view covering this batch is applied: stamp
+        the ``view_apply`` stage (ack → view-visible) and the visible
+        age.  In the writer-fed view the apply completes before the ack
+        returns, so in-process this stage measures ~0 — its value is
+        the FORMAT: a replicated serve worker (ROADMAP item 1) stamps
+        its own view_applied on delta arrival, and the fleet stitch
+        (obs.fleet) merges it under the same lineage id.  Called on the
+        writer thread after :meth:`committed`; mutations run under the
+        tracker lock because the record is already in the tail."""
+        with self._lock:
+            t_view = rec["t_view"] = self.clock()
+            if "stages" in rec:
+                rec["stages"]["view_apply"] = t_view - rec["t_sink"]
+                rec["age_s"]["visible"] = t_view - rec["ev_mean_ts"]
+            if view_seq is not None:
+                rec["view_seq"] = int(view_seq)
+        return rec
+
     # ------------------------------------------------------------ reads
     @property
     def newest_committed_ts(self) -> float | None:
@@ -146,11 +181,21 @@ class LineageTracker:
             return self._newest_committed_ts
 
     def tail(self, n: int = 50) -> list:
-        """Newest-first closed records (shallow copies — callers may
-        serialize while the writer thread closes more records)."""
+        """Newest-first closed records.  Copies are taken UNDER the
+        tracker lock, and the nested ``stages``/``age_s`` dicts are
+        copied too: :meth:`view_applied` mutates records already in the
+        tail (under the same lock), so a shallow copy handed out here
+        would share dicts a writer-thread callback is still inserting
+        into — and callers serialize these outside any lock."""
+        out = []
         with self._lock:
-            items = list(self._tail)
-        return [dict(r) for r in items[::-1][: max(0, int(n))]]
+            for r in list(self._tail)[::-1][: max(0, int(n))]:
+                c = dict(r)
+                for k in ("stages", "age_s"):
+                    if k in c:
+                        c[k] = dict(c[k])
+                out.append(c)
+        return out
 
     def __len__(self) -> int:
         with self._lock:

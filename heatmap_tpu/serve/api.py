@@ -53,6 +53,22 @@ Contract parity notes (all against /root/reference/app.py):
   end-to-end event-age p50 vs HEATMAP_SLO_FRESHNESS_P50_MS,
   supervisor restart rate vs HEATMAP_SLO_RESTARTS_PER_H; "down"
   (HTTP 503) on a poisoned sink or a supervisor that gave up.
+
+Fleet observatory (obs.fleet; served by ANY process holding the
+supervisor channel path — 503 without one):
+- GET /fleet/metrics → the federation exposition: every member
+  snapshot's series re-emitted with a ``proc="<tag>"`` label, fleet
+  rollups (counters summed, watermark gauges maxed, additive gauges
+  summed as ``heatmap_fleet_<name>``), fleet-level interpolated
+  quantiles over the merged histograms, per-member freshness gauges,
+  and the unchanged legacy ``heatmap_child_*`` gauges.
+- GET /fleet/healthz → the aggregate SLO verdict: any member degraded
+  degrades the fleet, any member down (or a supervisor that gave up)
+  downs it (HTTP 503), and a stale / corrupt / clock-skewed / vanished
+  member degrades the fleet NAMING the member.
+- GET /fleet/freshness → the cross-process event-age decomposition:
+  member lineage contributions stitched by lineage id (``?n=`` bounds
+  the record count), with per-stage p50s and the conservation residual.
 """
 
 from __future__ import annotations
@@ -211,45 +227,21 @@ def _supervisor_lines(chan: dict) -> list:
     """Supervisor channel fields -> exposition lines (obs.xproc names
     already carry their _total suffixes, so they bypass the generic
     counter renderer)."""
-    from heatmap_tpu.obs.registry import _fmt
-    from heatmap_tpu.obs.xproc import COUNTER_FIELDS, GAUGE_FIELDS
+    from heatmap_tpu.obs.xproc import supervisor_metrics_lines
 
-    lines = []
-    for k in COUNTER_FIELDS:
-        if isinstance(chan.get(k), (int, float)):
-            lines.append(f"# TYPE heatmap_supervisor_{k} counter")
-            lines.append(f"heatmap_supervisor_{k} {_fmt(chan[k])}")
-    for k in GAUGE_FIELDS:
-        if isinstance(chan.get(k), (int, float)):
-            lines.append(f"# TYPE heatmap_supervisor_{k} gauge")
-            lines.append(f"heatmap_supervisor_{k} {_fmt(chan[k])}")
-    return lines
+    return supervisor_metrics_lines(chan)
 
 
 def _child_freshness_lines(channel_path: str | None) -> list:
     """Per-child freshness summaries published next to the supervisor
     channel (obs.xproc) -> ``heatmap_child_<key>{child="<tag>"}``
     gauges, so a parent/serve-only /metrics exposes every child's
-    end-to-end freshness (lineage itself stays host-local)."""
-    from heatmap_tpu.obs.registry import _escape_label, _fmt
-    from heatmap_tpu.obs.xproc import FRESHNESS_FIELDS, child_freshness_from
+    end-to-end freshness (lineage itself stays host-local).  One
+    renderer for /metrics and /fleet/metrics — the legacy wire surface
+    must not diverge between them."""
+    from heatmap_tpu.obs.fleet import child_freshness_lines
 
-    kids = child_freshness_from(channel_path)
-    if not kids:
-        return []
-    lines = []
-    for k in FRESHNESS_FIELDS:
-        samples = [
-            (tag, d[k]) for tag, d in sorted(kids.items())
-            if isinstance(d.get(k), (int, float))]
-        if not samples:
-            continue
-        lines.append(f"# TYPE heatmap_child_{k} gauge")
-        for tag, v in samples:
-            lines.append(
-                f'heatmap_child_{k}{{child="{_escape_label(tag)}"}} '
-                f"{_fmt(v)}")
-    return lines
+    return child_freshness_lines(channel_path)
 
 
 def _metrics_text(runtime, serve_registry=None) -> str:
@@ -710,6 +702,24 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
 
     boot_nonce = uuid.uuid4().hex[:8]
     seeded: set = set()
+    # fleet aggregator (obs.fleet), created lazily against the current
+    # channel path: it is stateful (remembers seen member tags so a
+    # VANISHED member degrades /fleet/healthz), so one instance per app
+    # — rebuilt only if the env channel path itself changes (tests)
+    fleet_state: dict = {}
+
+    def _fleet_agg():
+        from heatmap_tpu.obs import ENV_CHANNEL
+
+        chan_path = os.environ.get(ENV_CHANNEL)
+        if not chan_path:
+            return None
+        if fleet_state.get("path") != chan_path:
+            from heatmap_tpu.obs.fleet import FleetAggregator
+
+            fleet_state["path"] = chan_path
+            fleet_state["agg"] = FleetAggregator(chan_path)
+        return fleet_state["agg"]
 
     def _tiles_view(grid: str | None):
         """The view to serve tile reads from, refreshed for serve-only
@@ -974,6 +984,35 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
             elif path == "/metrics":
                 body = _metrics_text(runtime, serve_registry=serve_reg)
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/fleet/metrics":
+                agg = _fleet_agg()
+                if agg is None:
+                    return _unavailable(
+                        "fleet surfaces need a supervisor channel "
+                        "(HEATMAP_SUPERVISOR_CHANNEL)")
+                body = agg.metrics_text()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/fleet/healthz":
+                agg = _fleet_agg()
+                if agg is None:
+                    return _unavailable(
+                        "fleet surfaces need a supervisor channel "
+                        "(HEATMAP_SUPERVISOR_CHANNEL)")
+                payload, down = agg.healthz()
+                if down:
+                    status = "503 Service Unavailable"
+                body = json.dumps(payload)
+                ctype = "application/json"
+            elif path == "/fleet/freshness":
+                agg = _fleet_agg()
+                if agg is None:
+                    return _unavailable(
+                        "fleet surfaces need a supervisor channel "
+                        "(HEATMAP_SUPERVISOR_CHANNEL)")
+                params = _qs_params(environ.get("QUERY_STRING", ""))
+                n = _qs_int(params, "n", 32, 256)
+                body = json.dumps(agg.freshness(n))
+                ctype = "application/json"
             elif path == "/metrics.json":
                 body = json.dumps(_metrics_json(runtime))
                 ctype = "application/json"
@@ -1148,6 +1187,9 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
         start_response(status, headers)
         return [data]
 
+    # the serve-only fleet member publisher (ServeFleetMember) snapshots
+    # this registry; with a runtime attached it is the runtime's own
+    app.serve_registry = serve_reg
     return app
 
 
@@ -1187,11 +1229,106 @@ def _make_http_server(store, cfg, runtime, host, port):
                        handler_class=_QuietHandler)
 
 
+class ServeFleetMember:
+    """A serve-only worker's fleet membership (obs.fleet): a daemon
+    thread that publishes this process's member snapshot —
+    ``role="serve"``, the app registry's exposition text, the channel
+    /healthz verdict — next to the supervisor channel every
+    ``HEATMAP_FLEET_PUBLISH_S``, plus an :class:`SloWatchdog` in fleet
+    mode so the worker follows episode broadcasts with a correlated
+    flight-recorder dump even though it has no runtime.  The
+    runtime-attached process publishes itself (stream/runtime.py) —
+    start this only when ``runtime is None``."""
+
+    def __init__(self, serve_registry, channel_path: str,
+                 tag: str | None = None):
+        from heatmap_tpu.obs.xproc import ENV_FLEET_TAG
+
+        self.registry = serve_registry
+        self.channel_path = channel_path
+        # HEATMAP_FLEET_TAG names the RUNTIME member (stream/runtime.py
+        # adopts it verbatim when single-process), so a serve worker
+        # composes with it rather than adopting it — otherwise a serve
+        # worker and a runtime sharing the channel and env would
+        # overwrite each other's member file
+        env_tag = os.environ.get(ENV_FLEET_TAG)
+        self.tag = tag or (f"{env_tag}-serve{os.getpid()}" if env_tag
+                           else f"serve{os.getpid()}")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.watchdog = None
+
+    @classmethod
+    def from_env(cls, app) -> "ServeFleetMember | None":
+        """Build-and-start against the app's registry; None without a
+        channel or with publishing disabled (HEATMAP_FLEET_PUBLISH_S=0)."""
+        from heatmap_tpu.obs import ENV_CHANNEL
+        from heatmap_tpu.obs.xproc import fleet_publish_s
+
+        chan_path = os.environ.get(ENV_CHANNEL)
+        reg = getattr(app, "serve_registry", None)
+        if not chan_path or reg is None or fleet_publish_s() <= 0:
+            return None
+        member = cls(reg, chan_path)
+        member.start()
+        return member
+
+    def start(self) -> None:
+        from heatmap_tpu.obs.flightrec import from_env as flightrec_env
+        from heatmap_tpu.obs.runtimeinfo import SloWatchdog
+
+        self.publish()  # join the fleet now, not a cadence later
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-fleet-member")
+        self._thread.start()
+        self.watchdog = SloWatchdog(None, channel_path=self.channel_path,
+                                    tag=self.tag,
+                                    flightrec=flightrec_env())
+        self.watchdog.start()
+
+    def publish(self, left: bool = False) -> None:
+        from heatmap_tpu.obs.xproc import publish_member_snapshot
+
+        try:
+            payload, _down = healthz_payload(None)
+            publish_member_snapshot(
+                self.channel_path, self.tag, role="serve",
+                metrics_text=self.registry.expose_text(),
+                healthz=payload, left=left)
+        except Exception:  # noqa: BLE001 - telemetry never kills serving
+            log.warning("serve fleet snapshot publish failed",
+                        exc_info=True)
+
+    def _run(self) -> None:
+        from heatmap_tpu.obs.xproc import fleet_publish_s
+
+        while not self._stop.wait(max(0.05, fleet_publish_s())):
+            self.publish()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        # departure tombstone: a worker taken out of the fleet on
+        # purpose must not degrade /fleet/healthz as "stale"
+        self.publish(left=True)
+
+
 def serve_forever(store: Store, cfg=None, runtime=None,
                   host: str | None = None, port: int | None = None):
     httpd = _make_http_server(store, cfg, runtime, host, port)
+    # serve-only workers join the fleet observatory themselves; a
+    # runtime-attached process already publishes on its step loop
+    member = (ServeFleetMember.from_env(httpd.get_app())
+              if runtime is None else None)
     log.info("serving on http://%s:%d/", *httpd.server_address)
-    httpd.serve_forever()
+    try:
+        httpd.serve_forever()
+    finally:
+        if member is not None:
+            member.stop()
 
 
 def start_background(store: Store, cfg=None, runtime=None,

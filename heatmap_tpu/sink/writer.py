@@ -38,6 +38,12 @@ class AsyncWriter:
         # Store renders); telemetry/read-path trouble never takes the
         # pipeline down.
         self.view = view
+        # view seq recorded right after each successful apply, read by
+        # the runtime's lineage view_applied stamp (obs.lineage): the
+        # batch whose commit-ack barrier runs next is visible in the
+        # view AT this seq.  Written only on the writer thread; torn
+        # reads are impossible (int store).
+        self.last_view_seq: int | None = None
         self.retries = retries
         self.backoff_s = backoff_s
         self._q: queue.Queue = queue.Queue(maxsize=max_queue)
@@ -143,6 +149,7 @@ class AsyncWriter:
                 self.view.apply_packed(body, meta)
             else:
                 self.view.apply_docs(docs)
+            self.last_view_seq = getattr(self.view, "seq", None)
         except Exception:
             log.exception("materialized view apply failed; query tier "
                           "falls back to store renders")

@@ -146,10 +146,26 @@ class MicroBatchRuntime:
         # END-TO-END staleness the prefetch stage and the emit ring hide
         # from the per-stage spans.  Records open at poll and park in
         # _lineage_open (epoch-keyed) from dispatch until their flush.
-        self.lineage = LineageTracker(capacity=cfg.lineage_tail)
-        self._lineage_open: dict[int, dict] = {}
         self._fresh_pub_last = 0.0  # child-freshness publish rate limit
-        self._fresh_tag = f"p{jax.process_index()}"
+        self._member_pub_last = 0.0  # fleet member-snapshot rate limit
+        from heatmap_tpu.obs.xproc import ENV_FLEET_TAG
+
+        # a HEATMAP_FLEET_TAG override reaches every shard of a
+        # multi-process runtime through the shared env — compose it
+        # with the process index so shards can never collide on one
+        # member file (a dead shard hiding behind a live one's
+        # snapshot) or one lineage-id namespace
+        tag = os.environ.get(ENV_FLEET_TAG)
+        idx = jax.process_index()
+        if tag and jax.process_count() > 1:
+            tag = f"{tag}-p{idx}"
+        self._fresh_tag = tag or f"p{idx}"
+        # lineage ids are origin-tagged so the fleet aggregator
+        # (obs.fleet) can stitch this shard's stage contributions with
+        # other members' (e.g. a serve worker's view_apply) by lid
+        self.lineage = LineageTracker(capacity=cfg.lineage_tail,
+                                      origin=self._fresh_tag)
+        self._lineage_open: dict[int, dict] = {}
         # Flight recorder (obs.flightrec): armed when
         # HEATMAP_FLIGHTREC_DIR is set; close() dumps on abnormal exit
         # (fatal overflow, poisoned sink, an exception unwinding through
@@ -555,7 +571,10 @@ class MicroBatchRuntime:
             from heatmap_tpu.obs.runtimeinfo import SloWatchdog
 
             get_sampler().ensure_started()
-            self.slo_watchdog = SloWatchdog(self)
+            # fleet mode: degraded transitions broadcast an episode id
+            # over the channel (env default) so every member's dump for
+            # the incident correlates; the tag names this member
+            self.slo_watchdog = SloWatchdog(self, tag=self._fresh_tag)
             self.slo_watchdog.start()
 
     # ------------------------------------------------------------------
@@ -1069,6 +1088,16 @@ class MicroBatchRuntime:
         rec = self.lineage.committed(rec)
         for bound, age in rec["age_s"].items():
             self.metrics.event_age.labels(bound=bound).observe(age)
+        # view_apply stage (obs.lineage): the writer's view hook already
+        # applied this batch to the materialized view before the ack
+        # barrier ran, so the batch is view-visible NOW — stamp the
+        # stage (≈0 in-process; a replicated serve worker stamps its
+        # own, meaningful, contribution in the scale-out shape) with
+        # the seq the writer recorded at apply time
+        view = self.writer.view
+        if view is not None and not view.poisoned:
+            self.lineage.view_applied(rec,
+                                      view_seq=self.writer.last_view_seq)
         self._publish_child_freshness()
 
     def _publish_child_freshness(self) -> None:
@@ -1088,6 +1117,48 @@ class MicroBatchRuntime:
         self._fresh_pub_last = now
         publish_child_freshness(path, self._fresh_tag,
                                 self.metrics.freshness_summary())
+
+    def _publish_member_snapshot(self, force: bool = False,
+                                 left: bool = False) -> None:
+        """Fleet observatory publish (obs.xproc/obs.fleet): this
+        process's FULL registry exposition, freshness summary, /healthz
+        verdict, and compact lineage tail, written atomically next to
+        the supervisor channel so the fleet aggregator can federate
+        them.  Rate-limited to HEATMAP_FLEET_PUBLISH_S (default 2 s;
+        0 disables); runs on the step loop, guarded — telemetry never
+        takes the pipeline down."""
+        from heatmap_tpu.obs import ENV_CHANNEL
+        from heatmap_tpu.obs.xproc import (fleet_publish_s,
+                                           publish_member_snapshot)
+
+        path = os.environ.get(ENV_CHANNEL)
+        if not path:
+            return
+        interval = fleet_publish_s()
+        if interval <= 0:
+            return
+        now = time.monotonic()
+        if not force and now - self._member_pub_last < interval:
+            return
+        self._member_pub_last = now
+        try:
+            from heatmap_tpu.obs.fleet import compact_lineage
+            from heatmap_tpu.serve.api import healthz_payload
+
+            extra = dict(self.writer.counters)
+            extra.pop("sink_retries", None)  # first-class registry
+            extra.update(getattr(self.source, "counters", None) or {})
+            publish_member_snapshot(
+                path, self._fresh_tag, role="runtime",
+                metrics_text=self.metrics.expose_text(
+                    extra_counters=extra),
+                freshness=self.metrics.freshness_summary(),
+                healthz=healthz_payload(self)[0],
+                lineage=compact_lineage(self.lineage.tail(16)),
+                left=left)
+        except Exception:  # noqa: BLE001 - never kill the step loop
+            log.warning("fleet member snapshot publish failed",
+                        exc_info=True)
 
     def _host_batch_max_ts(self, ts_s: np.ndarray) -> int:
         """Watermark advance for one batch, computed HOST-side with
@@ -1666,6 +1737,10 @@ class MicroBatchRuntime:
                 # liveness exists — a pre-step beacon would drop it to
                 # stall_timeout_s and get a slow first compile killed
                 self._touch_heartbeat()
+                # fleet member snapshot rides the loop too (idle polls
+                # included, so a quiet stream still reads as alive at
+                # /fleet/healthz instead of going stale)
+                self._publish_member_snapshot()
                 done = (self._global_live == 0 if self._multiproc
                         else self.source.exhausted)
                 if progressed:
@@ -1687,21 +1762,24 @@ class MicroBatchRuntime:
             # first: a watchdog tick must not evaluate healthz (or
             # spawn a capture) against a runtime mid-teardown
             self.slo_watchdog.stop()
+        # Abnormal = fatal overflow, a poisoned sink, or an exception
+        # unwinding through run()'s finally into this close
+        # (sys.exc_info() sees it) — incl. the SystemExit
+        # stream.__main__ raises on SIGTERM.
+        import sys as _sys
+
+        exc = _sys.exc_info()[1]
+        if isinstance(exc, SystemExit) and not exc.code:
+            exc = None  # sys.exit(0) mid-run is a clean shutdown
+        clean_close = not (self._fatal or self.writer.poisoned
+                           or exc is not None)
         if self.flightrec is not None:
             # Flight record BEFORE the drain, so ring/prefetch depths
-            # still describe the incident.  Abnormal = fatal overflow, a
-            # poisoned sink, or an exception unwinding through run()'s
-            # finally into this close (sys.exc_info() sees it) — incl.
-            # the SystemExit stream.__main__ raises on SIGTERM.  A
-            # normal close writes nothing unless HEATMAP_FLIGHTREC_
-            # ALWAYS=1; either way the recorder then stands down so the
-            # atexit backstop cannot double-dump.
-            import sys as _sys
-
-            exc = _sys.exc_info()[1]
-            if isinstance(exc, SystemExit) and not exc.code:
-                exc = None  # sys.exit(0) mid-run is a clean shutdown
-            if self._fatal or self.writer.poisoned or exc is not None:
+            # still describe the incident.  A normal close writes
+            # nothing unless HEATMAP_FLIGHTREC_ALWAYS=1; either way the
+            # recorder then stands down so the atexit backstop cannot
+            # double-dump.
+            if not clean_close:
                 why = ("fatal state overflow" if self._fatal
                        else "poisoned sink" if self.writer.poisoned
                        else f"abnormal exit: {type(exc).__name__}: {exc}")
@@ -1711,6 +1789,14 @@ class MicroBatchRuntime:
                                     "(HEATMAP_FLIGHTREC_ALWAYS=1)")
             else:
                 self.flightrec.disarm()
+        # final fleet snapshot: short bounded runs (and the moments
+        # before an exit) leave their last counters/lineage on the
+        # channel instead of whatever the 2 s cadence last caught.  A
+        # clean close publishes it as a departure tombstone — a
+        # finished bounded job must not degrade /fleet/healthz as a
+        # "stale" member forever; an abnormal close leaves a live
+        # snapshot so the fleet DOES see the member go dark
+        self._publish_member_snapshot(force=True, left=clean_close)
         self.tracer.stop()  # flush a partial profiler capture, if any
         self.tracering.close()  # flush/close the JSONL trace export
         self._closing = True  # no further prefetch refills

@@ -128,6 +128,13 @@ class Supervisor:
         # predecessor supervisor's count instead of resetting to this
         # process's self.restarts
         self._restarts_base = int(self.channel.state["restarts_total"])
+        # fleet observatory (obs.fleet): the supervisor is a member too
+        # — its snapshot carries the channel counters + its own verdict
+        # so /fleet/healthz can see the control plane, not just the
+        # children.  Fixed tag: one supervisor per channel (the env
+        # HEATMAP_FLEET_TAG names the CHILD runtime, which inherits it).
+        self._fleet_tag = "supervisor"
+        self._member_pub_last = 0.0
         # A plain bool, NOT a threading.Event: stop() runs inside signal
         # handlers (supervise_cli), and Event.set() acquires the Event's
         # non-reentrant Condition lock — which the interrupted main
@@ -175,13 +182,63 @@ class Supervisor:
 
     def _wait(self, seconds: float) -> None:
         """Sleep up to ``seconds``, returning within ``poll_s`` of
-        stop() — including stop() from a signal handler."""
+        stop() — including stop() from a signal handler.  Every slice
+        also rides the fleet member publish (rate-limited inside), so
+        the supervisor stays fresh on /fleet/healthz through poll loops
+        AND long restart backoffs alike."""
         deadline = time.monotonic() + seconds
         while not self._stop_flag:
+            self._publish_member_snapshot()
             left = deadline - time.monotonic()
             if left <= 0:
                 return
             time.sleep(min(self.poll_s, left))
+
+    def _publish_member_snapshot(self, force: bool = False,
+                                 left: bool = False) -> None:
+        """Fleet member snapshot for the supervisor itself (obs.xproc):
+        channel counters as exposition text + a control-plane verdict.
+        Rate-limited to HEATMAP_FLEET_PUBLISH_S (0 disables); guarded —
+        telemetry never takes the supervisor down."""
+        from heatmap_tpu.obs.xproc import (fleet_publish_s,
+                                           publish_member_snapshot,
+                                           supervisor_metrics_lines)
+
+        interval = fleet_publish_s()
+        if interval <= 0:
+            return
+        now = time.monotonic()
+        if not force and now - self._member_pub_last < interval:
+            return
+        self._member_pub_last = now
+        try:
+            chan = SupervisorChannel.metrics_from(self.channel.path)
+            lines = supervisor_metrics_lines(chan)
+            checks = {
+                "child_running": {
+                    "value": int(chan.get("child_running", 0)), "ok": True},
+            }
+            degraded = bool(self.failed_over)
+            if self.failed_over:
+                checks["failover"] = {
+                    "value": self.env.get("HEATMAP_PLATFORM", "?"),
+                    "ok": False}
+            down = bool(chan.get("gave_up"))
+            if down:
+                checks["supervisor"] = {"value": "gave_up", "ok": False}
+            healthz = {
+                "ok": not down,
+                "status": ("down" if down
+                           else "degraded" if degraded else "ok"),
+                "checks": checks,
+            }
+            publish_member_snapshot(
+                self.channel.path, self._fleet_tag, role="supervisor",
+                metrics_text="\n".join(lines) + ("\n" if lines else ""),
+                healthz=healthz, left=left)
+        except Exception:  # noqa: BLE001 - never kill the supervise loop
+            log.warning("supervisor fleet snapshot publish failed",
+                        exc_info=True)
 
     def _kill(self, proc: subprocess.Popen) -> None:
         """SIGTERM, grace period, SIGKILL."""
@@ -216,6 +273,10 @@ class Supervisor:
                     if code == 0:
                         log.info("child exited cleanly; done")
                         self.channel.update(child_running=0)
+                        # departure tombstone: a finished job leaves
+                        # the fleet instead of going "stale" on it
+                        self._publish_member_snapshot(force=True,
+                                                      left=True)
                         return 0
                     reason = f"exit code {code}"
                     # exit-code failure: the child ran under its own
@@ -243,6 +304,7 @@ class Supervisor:
                 self._kill(proc)
                 log.info("stopped; child terminated")
                 self.channel.update(child_running=0)
+                self._publish_member_snapshot(force=True, left=True)
                 return 0
             # failure bookkeeping for the child's /metrics and the
             # /healthz restart-rate SLO: timestamps retained for at
@@ -250,6 +312,24 @@ class Supervisor:
             self.channel.note_failure(
                 reason, stalled=reason.startswith("stall"),
                 window_s=max(3600.0, p.window_s))
+            # fleet episode correlation (obs.xproc): a dead child is ONE
+            # incident across the whole fleet — claim (or join) the
+            # episode broadcast so every surviving member's watchdog
+            # writes its flight-recorder dump under the same id.  The
+            # broadcast itself is a file write; it happens whether or
+            # not THIS process records flights.
+            from heatmap_tpu.obs.xproc import clear_episode, ensure_episode
+
+            if healthy_span > p.window_s:
+                # a failure after a FULL healthy window is a separate
+                # incident: close our own previous episode (if it is
+                # still broadcast) so this one mints a fresh id — joined
+                # stale, the surviving watchdogs would skip it as
+                # already-dumped and the new incident would leave no
+                # correlated dump set
+                clear_episode(self.channel.path, origin=self._fleet_tag)
+            episode = ensure_episode(self.channel.path, self._fleet_tag,
+                                     f"child failed ({reason})")
             # supervisor-side flight record (obs.flightrec): the child's
             # own recorder misses hard deaths (SIGKILL, a wedged device
             # op the stall detector shot) — dump the PARENT's view so
@@ -263,7 +343,12 @@ class Supervisor:
                               {"channel": dict(self.channel.state),
                                "argv": self.argv,
                                "failed_over": self.failed_over,
-                               "restarts": self.restarts})
+                               "restarts": self.restarts,
+                               **({"episode": episode} if episode else {})},
+                              episode_id=episode.get("episode_id"))
+            # forced: the failure bookkeeping (and the open episode)
+            # must reach /fleet/healthz now, not a publish-cadence later
+            self._publish_member_snapshot(force=True)
             if healthy_span > p.window_s:
                 # the child ran healthy for a full budget window before
                 # this failure — an isolated blip, not a streak.  Without
@@ -280,6 +365,7 @@ class Supervisor:
                 log.error("giving up: %d failures within %.0fs (last: %s)",
                           len(recent), p.window_s, reason)
                 self.channel.update(gave_up=1, child_running=0)
+                self._publish_member_snapshot(force=True)
                 return rc
             if (p.failover_after is not None and not self.failed_over
                     and failures_in_a_row >= p.failover_after):
@@ -302,7 +388,10 @@ class Supervisor:
                 restarts_total=self._restarts_base + self.restarts)
             self._wait(backoff)
             backoff = min(backoff * 2, p.backoff_max_s)
-        return 0 if self._stop_flag else rc  # stop() during backoff = clean stop
+        if self._stop_flag:  # stop() during backoff = clean stop
+            self._publish_member_snapshot(force=True, left=True)
+            return 0
+        return rc
 
     def stop(self) -> None:
         """Ask run() to terminate the child and return (signal-safe)."""

@@ -20,14 +20,17 @@ def _load():
     return mod
 
 
-def _write(dir_path, rnd, value=None, rc=0, tail=None):
+def _write(dir_path, rnd, value=None, rc=0, tail=None, backend=None):
     if tail is None:
         tail = ("noise line\n"
                 + json.dumps({"metric": "GPS events/sec aggregated",
                               "value": value, "unit": "events/sec"})
                 + "\ntrailing noise")
     p = dir_path / f"BENCH_r{rnd:02d}.json"
-    p.write_text(json.dumps({"n": rnd, "rc": rc, "tail": tail}))
+    art = {"n": rnd, "rc": rc, "tail": tail}
+    if backend is not None:
+        art["backend_path"] = backend
+    p.write_text(json.dumps(art))
     return p
 
 
@@ -106,6 +109,55 @@ def test_headline_uses_last_metric_line(tmp_path):
     _write(tmp_path, 1, tail=tail)
     _write(tmp_path, 2, 990_000.0)
     assert m.main(["--dir", str(tmp_path), "--threshold", "0.1"]) == 0
+
+
+def test_mixed_backend_pair_refused(tmp_path, capsys):
+    """A CPU-fallback round must NOT be compared against an attached
+    headline in either direction — the comparison itself is the lie
+    (ROADMAP item 3's stuck vs_target 0.054)."""
+    m = _load()
+    _write(tmp_path, 1, 1_000_000.0, backend="hw")
+    _write(tmp_path, 2, 950_000.0, backend="cpu")
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    err = capsys.readouterr().err
+    assert "backend_path mismatch" in err
+    assert "'hw'" in err and "'cpu'" in err
+    # and the other direction (cpu -> hw) is refused too: a recovery
+    # round must re-establish its own baseline, not "improve" over cpu
+    _write(tmp_path, 3, 3_000_000.0, backend="hw")
+    os.remove(tmp_path / "BENCH_r01.json")
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+
+
+def test_same_backend_pair_still_compares(tmp_path, capsys):
+    m = _load()
+    _write(tmp_path, 1, 1_000_000.0, backend="cpu")
+    _write(tmp_path, 2, 400_000.0, backend="cpu")  # -60%: a REAL drop
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "regression" in capsys.readouterr().err
+
+
+def test_backend_read_from_headline_line(tmp_path, capsys):
+    """Provenance stamped only inside the tail's headline metric line
+    (how bench.py emits it) counts too."""
+    m = _load()
+    tail_hw = json.dumps({"metric": "x", "value": 1_000_000.0,
+                          "backend_path": "hw"})
+    tail_cpu = json.dumps({"metric": "x", "value": 990_000.0,
+                           "backend_path": "cpu"})
+    _write(tmp_path, 1, tail=tail_hw)
+    _write(tmp_path, 2, tail=tail_cpu)
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "backend_path mismatch" in capsys.readouterr().err
+
+
+def test_missing_backend_stays_comparable(tmp_path):
+    """Pre-provenance artifacts (no backend_path anywhere) keep the old
+    behavior: the pair compares on rate alone."""
+    m = _load()
+    _write(tmp_path, 1, 1_000_000.0)
+    _write(tmp_path, 2, 900_000.0, backend="cpu")  # one side unknown
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
 
 
 def test_repo_artifacts_parse():

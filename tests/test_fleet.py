@@ -1,0 +1,667 @@
+"""Fleet observatory (obs/fleet.py + the obs/xproc.py member/episode
+channel): federation of per-member snapshots into /fleet/metrics,
+/fleet/healthz, /fleet/freshness; hardened member reads (torn writes,
+clock skew, vanished members); the cross-process lineage stitch with
+its conservation invariant; episode-correlated watchdog captures; the
+obs_top --fleet view; and the bench fleet stamp."""
+
+import json
+import os
+import time
+
+import pytest
+
+from heatmap_tpu.obs.fleet import (
+    FleetAggregator,
+    compact_lineage,
+    fleet_stamp,
+    interp_quantile,
+    parse_exposition,
+)
+from heatmap_tpu.obs.xproc import (
+    ENV_CHANNEL,
+    broadcast_episode,
+    ensure_episode,
+    member_path,
+    members_from,
+    publish_child_freshness,
+    publish_member_snapshot,
+    read_episode,
+)
+
+from test_obs import _validate_exposition
+
+
+def _chan(tmp_path) -> str:
+    return str(tmp_path / "chan")
+
+
+RUNTIME_TEXT = """\
+# TYPE heatmap_events_valid_total counter
+heatmap_events_valid_total 100
+# TYPE heatmap_events_per_sec gauge
+heatmap_events_per_sec 50
+# TYPE heatmap_live_buffer_watermark_bytes gauge
+heatmap_live_buffer_watermark_bytes 7000
+# TYPE heatmap_event_age_seconds histogram
+heatmap_event_age_seconds_bucket{bound="mean",le="1"} 6
+heatmap_event_age_seconds_bucket{bound="mean",le="10"} 10
+heatmap_event_age_seconds_bucket{bound="mean",le="+Inf"} 10
+heatmap_event_age_seconds_sum{bound="mean"} 14
+heatmap_event_age_seconds_count{bound="mean"} 10
+"""
+
+
+def _publish_two_members(chan):
+    other = (RUNTIME_TEXT.replace("100", "60").replace("50", "30")
+             .replace("7000", "9000")
+             .replace('le="1"} 6', 'le="1"} 2'))
+    publish_member_snapshot(chan, "p0", role="runtime",
+                            metrics_text=RUNTIME_TEXT,
+                            freshness={"event_age_p50_s": 0.4,
+                                       "event_age_p99_s": 2.0},
+                            healthz={"status": "ok", "checks": {}})
+    publish_member_snapshot(chan, "p1", role="runtime",
+                            metrics_text=other,
+                            freshness={"event_age_p50_s": 0.9},
+                            healthz={"status": "ok", "checks": {}})
+
+
+# ------------------------------------------------------------ parsing
+def test_parse_exposition_skips_garbage():
+    types, samples = parse_exposition(
+        "# TYPE a counter\na 1\nnot a sample line ! !\nb{x=\"y\"} 2\n"
+        "c notanumber\n# HELP a h\n")
+    assert types == {"a": "counter"}
+    assert ("a", "", 1.0) in samples and ("b", 'x="y"', 2.0) in samples
+    assert all(s[0] != "c" for s in samples)
+
+
+def test_interp_quantile_merged_buckets():
+    # two members' cumulative buckets merged: 8 of 20 <=1s, rest <=10s
+    cums = {1.0: 8.0, 10.0: 20.0, float("inf"): 20.0}
+    p50 = interp_quantile(cums, 0.5)
+    assert 1.0 < p50 < 10.0
+    # +Inf-resident mass reports the last finite bound (honest floor)
+    assert interp_quantile({1.0: 0.0, float("inf") : 10.0}, 0.5) == 1.0
+    assert interp_quantile({}, 0.5) is None
+    assert interp_quantile({1.0: 0.0, float("inf"): 0.0}, 0.5) is None
+
+
+# ------------------------------------------------------- /fleet/metrics
+def test_fleet_metrics_federation(tmp_path):
+    chan = _chan(tmp_path)
+    _publish_two_members(chan)
+    txt = FleetAggregator(chan).metrics_text()
+    _validate_exposition(txt)  # grammar: contiguous families, TYPE once
+    # per-member series with the injected proc label
+    assert 'heatmap_events_valid_total{proc="p0"} 100' in txt
+    assert 'heatmap_events_valid_total{proc="p1"} 60' in txt
+    # rollups: counters summed, additive gauges summed, watermarks maxed
+    assert "heatmap_fleet_events_valid_total 160" in txt
+    assert "heatmap_fleet_events_per_sec 80" in txt
+    assert "heatmap_fleet_live_buffer_watermark_bytes 9000" in txt
+    # membership gauges
+    assert "heatmap_fleet_members 2" in txt
+    assert "heatmap_fleet_stale_members 0" in txt
+    assert 'heatmap_fleet_member_up{proc="p0",role="runtime"} 1' in txt
+    # per-member freshness gauges off the published summaries
+    assert 'heatmap_fleet_member_event_age_p50_s{proc="p0"} 0.4' in txt
+    assert 'heatmap_fleet_member_event_age_p50_s{proc="p1"} 0.9' in txt
+    assert 'heatmap_fleet_member_event_age_p99_s{proc="p0"} 2' in txt
+
+
+def test_fleet_quantiles_from_merged_buckets(tmp_path):
+    """The fleet p50 interpolates over the MERGED cumulative buckets —
+    with 8/20 events <=1s it lands in the 1..10 s bucket, which no
+    average of the two members' p50s would produce."""
+    chan = _chan(tmp_path)
+    _publish_two_members(chan)
+    txt = FleetAggregator(chan).metrics_text()
+    m = dict(line.rsplit(" ", 1) for line in txt.splitlines()
+             if line and not line.startswith("#") and "{" not in line)
+    p50 = float(m["heatmap_fleet_event_age_p50_s"])
+    p99 = float(m["heatmap_fleet_event_age_p99_s"])
+    assert 1.0 < p50 < 10.0 and p50 < p99 <= 10.0
+
+
+def test_fleet_legacy_child_gauges_unchanged_next_to_members(tmp_path):
+    """Back-compat: an old freshness-only child file surfaces as the
+    PR 3 ``heatmap_child_*`` gauges, byte-identical, next to a new
+    member snapshot for ANOTHER process."""
+    chan = _chan(tmp_path)
+    publish_child_freshness(chan, "oldchild",
+                            {"event_age_p50_s": 9.9,
+                             "ring_residency_mean_s": 0.125})
+    _publish_two_members(chan)
+    txt = FleetAggregator(chan).metrics_text()
+    assert 'heatmap_child_event_age_p50_s{child="oldchild"} 9.9' in txt
+    assert ('heatmap_child_ring_residency_mean_s{child="oldchild"} 0.125'
+            in txt)
+    # and the fleet surfaces don't double-count it as a member
+    assert "heatmap_fleet_members 2" in txt
+
+
+# ------------------------------------------------------- /fleet/healthz
+def test_fleet_healthz_degrades_on_degraded_member(tmp_path):
+    chan = _chan(tmp_path)
+    publish_member_snapshot(chan, "p0", role="runtime",
+                            healthz={"status": "ok", "checks": {}})
+    publish_member_snapshot(
+        chan, "p1", role="runtime",
+        healthz={"status": "degraded",
+                 "checks": {"batch_p50_ms": {"ok": False}}})
+    payload, down = FleetAggregator(chan).healthz()
+    assert not down and payload["status"] == "degraded"
+    assert payload["checks"]["member_p1"]["ok"] is False
+    assert payload["checks"]["member_p1"]["failing"] == ["batch_p50_ms"]
+    assert payload["checks"]["member_p0"]["ok"] is True
+
+
+def test_fleet_healthz_down_on_down_member(tmp_path):
+    chan = _chan(tmp_path)
+    publish_member_snapshot(chan, "p0", role="runtime",
+                            healthz={"status": "down", "checks": {}})
+    payload, down = FleetAggregator(chan).healthz()
+    assert down and payload["status"] == "down"
+
+
+def test_fleet_healthz_degrades_on_stale_member_naming_it(tmp_path):
+    chan = _chan(tmp_path)
+    publish_member_snapshot(chan, "alive", role="runtime",
+                            healthz={"status": "ok"})
+    # a member that stopped publishing: backdate its snapshot
+    publish_member_snapshot(chan, "dead", role="runtime",
+                            healthz={"status": "ok"})
+    p = member_path(chan, "dead")
+    d = json.loads(open(p).read())
+    d["updated_unix"] = time.time() - 3600
+    with open(p, "w") as fh:
+        json.dump(d, fh)
+    agg = FleetAggregator(chan, max_age_s=30.0)
+    payload, down = agg.healthz()
+    assert payload["status"] == "degraded" and not down
+    assert "member_dead" in payload["checks"]
+    assert "stale" in payload["checks"]["member_dead"]["value"]
+    assert payload["stale_members"] == ["dead"]
+    txt = agg.metrics_text()
+    assert "heatmap_fleet_stale_members 1" in txt
+    assert 'heatmap_fleet_member_up{proc="dead",role="?"} 0' in txt
+
+
+def test_fleet_healthz_degrades_on_vanished_member(tmp_path):
+    """A member whose snapshot file is DELETED after having been seen
+    must degrade the fleet — not silently shrink it."""
+    chan = _chan(tmp_path)
+    _publish_two_members(chan)
+    agg = FleetAggregator(chan)
+    assert agg.healthz()[0]["status"] == "ok"
+    os.remove(member_path(chan, "p1"))
+    payload, down = agg.healthz()
+    assert payload["status"] == "degraded" and not down
+    assert payload["checks"]["member_p1"]["value"] == "vanished"
+    # a FRESH aggregator never saw p1, so it reports a smaller fleet
+    assert FleetAggregator(chan).healthz()[0]["status"] == "ok"
+
+
+# ------------------------------------------------- hardened member reads
+def test_members_from_skips_torn_write(tmp_path):
+    """A half-written member file (foreign writer, disk-full cp) is
+    skipped + counted, never raised."""
+    chan = _chan(tmp_path)
+    _publish_two_members(chan)
+    with open(member_path(chan, "torn"), "w") as fh:
+        fh.write('{"tag": "torn", "updated_unix": 12')  # truncated
+    members, skipped = members_from(chan)
+    assert set(members) == {"p0", "p1"}
+    assert skipped == {"torn": "corrupt"}
+
+
+def test_members_from_skips_missing_or_garbage_updated(tmp_path):
+    chan = _chan(tmp_path)
+    with open(member_path(chan, "nots"), "w") as fh:
+        json.dump({"tag": "nots"}, fh)  # no updated_unix
+    with open(member_path(chan, "notdict"), "w") as fh:
+        json.dump(["not", "a", "dict"], fh)
+    members, skipped = members_from(chan)
+    assert members == {}
+    assert skipped == {"nots": "corrupt", "notdict": "corrupt"}
+
+
+def test_members_from_skips_clock_skew(tmp_path):
+    """A snapshot dated into the FUTURE (skewed writer clock) must not
+    masquerade as eternally fresh."""
+    chan = _chan(tmp_path)
+    publish_member_snapshot(chan, "ok", role="runtime")
+    p = member_path(chan, "skewed")
+    with open(p, "w") as fh:
+        json.dump({"tag": "skewed", "updated_unix": time.time() + 3600},
+                  fh)
+    members, skipped = members_from(chan, max_age_s=30.0)
+    assert set(members) == {"ok"}
+    assert "clock skew" in skipped["skewed"]
+
+
+def test_members_from_ignores_inflight_tmp_files(tmp_path):
+    chan = _chan(tmp_path)
+    publish_member_snapshot(chan, "ok", role="runtime")
+    with open(member_path(chan, "x") + ".tmp123", "w") as fh:
+        fh.write("{")  # an atomic write caught mid-flight
+    members, skipped = members_from(chan)
+    assert set(members) == {"ok"} and skipped == {}
+
+
+def test_members_from_empty_channel_path():
+    assert members_from(None) == ({}, {})
+    assert members_from("") == ({}, {})
+
+
+# ------------------------------------------------ /fleet/freshness stitch
+def test_fleet_freshness_stitch_conservation_synthetic_clock(tmp_path):
+    """The PR 3 invariant, across processes: the runtime shard's five
+    stages and the view member's ``view_apply`` stage, stitched by
+    lineage id, telescope EXACTLY against the final stamp."""
+    chan = _chan(tmp_path)
+    t0 = 1000.0  # synthetic epoch clock: every stamp is exact
+    publish_member_snapshot(
+        chan, "p0", role="runtime",
+        lineage=[{"lid": "p0-7", "ev_mean_ts": t0, "n_events": 16,
+                  "stages": {"poll_wait": 50.0, "prefetch_queue": 1.5,
+                             "fold": 0.25, "ring": 3.0,
+                             "sink_commit": 0.5},
+                  "t_last": t0 + 55.25}])
+    publish_member_snapshot(
+        chan, "serve1", role="serve",
+        lineage=[{"lid": "p0-7", "ev_mean_ts": t0,
+                  "stages": {"view_apply": 2.75},
+                  "t_last": t0 + 58.0}])
+    fr = FleetAggregator(chan).freshness()
+    assert len(fr["records"]) == 1
+    rec = fr["records"][0]
+    assert sorted(rec["procs"]) == ["p0", "serve1"]
+    assert set(rec["stages"]) == {"poll_wait", "prefetch_queue", "fold",
+                                  "ring", "sink_commit", "view_apply"}
+    assert rec["age_s"] == 58.0
+    assert rec["residual_s"] == 0.0          # conservation, exactly
+    assert fr["summary"]["max_abs_residual_s"] == 0.0
+    assert fr["summary"]["view_apply_p50_s"] == 2.75
+    assert fr["stage_order"][-1] == "view_apply"
+
+
+def test_fleet_freshness_orders_newest_first_and_bounds(tmp_path):
+    chan = _chan(tmp_path)
+    recs = [{"lid": f"p0-{i}", "ev_mean_ts": 1000.0 + i,
+             "stages": {"sink_commit": 1.0}, "t_last": 1001.0 + i}
+            for i in range(5)]
+    publish_member_snapshot(chan, "p0", role="runtime", lineage=recs)
+    fr = FleetAggregator(chan).freshness(n=3)
+    assert [r["lid"] for r in fr["records"]] == ["p0-4", "p0-3", "p0-2"]
+
+
+def test_compact_lineage_shapes():
+    t0 = 1000.0
+    recs = [
+        {"lid": "p0-1", "ev_mean_ts": t0, "n_events": 4,
+         "stages": {"fold": 1.0, "junk": "x"}, "t_sink": t0 + 2,
+         "t_view": t0 + 3},
+        {"lid": "p0-2", "ev_mean_ts": t0, "stages": {"fold": 1.0},
+         "t_sink": t0 + 2},                       # no view stamp
+        {"ev_mean_ts": t0, "stages": {"fold": 1.0}, "t_sink": t0 + 2},
+        {"lid": "p0-4", "ev_mean_ts": t0, "stages": None,
+         "t_sink": t0 + 2},
+    ]
+    out = compact_lineage(recs)
+    assert [r["lid"] for r in out] == ["p0-1", "p0-2"]
+    assert out[0]["t_last"] == t0 + 3            # view stamp preferred
+    assert out[1]["t_last"] == t0 + 2            # sink ack fallback
+    assert out[0]["stages"] == {"fold": 1.0}     # non-numeric dropped
+
+
+# ------------------------------------------------------------- episodes
+def test_episode_broadcast_read_roundtrip(tmp_path):
+    chan = _chan(tmp_path)
+    assert read_episode(chan) == {}
+    eid = broadcast_episode(chan, "p0", "test incident")
+    assert eid
+    ep = read_episode(chan)
+    assert ep["episode_id"] == eid and ep["origin"] == "p0"
+    # expired broadcasts read as no-episode
+    assert read_episode(chan, max_age_s=0.0) == {}
+    assert read_episode(None) == {}
+
+
+def test_ensure_episode_joins_open_episode(tmp_path):
+    """A member degrading while an incident is already broadcast must
+    correlate with it, not mint a second id."""
+    chan = _chan(tmp_path)
+    first = ensure_episode(chan, "p0", "first")
+    second = ensure_episode(chan, "p1", "second")
+    assert second["episode_id"] == first["episode_id"]
+    assert second["origin"] == "p0"              # the original claimant
+
+
+def test_watchdog_follows_foreign_episode(tmp_path):
+    """Fleet mode: a foreign episode broadcast triggers a correlated
+    dump on a member whose own /healthz is OK — once per episode id."""
+    from heatmap_tpu.obs.flightrec import FlightRecorder
+    from heatmap_tpu.obs.runtimeinfo import SloWatchdog
+
+    chan = _chan(tmp_path)
+    rec_dir = tmp_path / "fr-serve1"
+    wd = SloWatchdog(None, interval_s=0.0, cooldown_s=0.0,
+                     channel_path=chan, tag="serve1",
+                     flightrec=FlightRecorder(str(rec_dir)))
+    assert wd.check_once() is None               # no episode yet
+    eid = broadcast_episode(chan, "p0", "p0 degraded")
+    path = wd.check_once()
+    assert path is not None
+    dump = json.loads(open(path).read())
+    assert dump["episode_id"] == eid
+    assert "healthz" in dump and dump["episode"]["origin"] == "p0"
+    # once per episode id — the next tick doesn't re-dump
+    assert wd.check_once() is None
+    # a member never follows its OWN broadcast
+    wd_origin = SloWatchdog(None, interval_s=0.0, cooldown_s=0.0,
+                            channel_path=chan, tag="p0",
+                            flightrec=FlightRecorder(str(rec_dir)))
+    assert wd_origin.check_once() is None
+
+
+def test_watchdog_degrading_member_claims_and_stamps_episode(
+        tmp_path, monkeypatch):
+    """A member whose own verdict degrades claims the fleet episode and
+    stamps its id into its dump (reason + top-level episode_id)."""
+    from heatmap_tpu.obs.flightrec import FlightRecorder
+    from heatmap_tpu.obs.runtimeinfo import SloWatchdog
+
+    chan = _chan(tmp_path)
+    # a channel whose supervisor gave up reads as down even with no
+    # runtime attached (serve-only member)
+    from heatmap_tpu.obs.xproc import SupervisorChannel
+
+    sup = SupervisorChannel(chan)
+    sup.update(gave_up=1)
+    monkeypatch.setenv(ENV_CHANNEL, chan)
+    wd = SloWatchdog(None, interval_s=0.0, cooldown_s=0.0,
+                     channel_path=chan, tag="serve1",
+                     flightrec=FlightRecorder(str(tmp_path / "fr")))
+    path = wd.check_once()
+    assert path is not None
+    dump = json.loads(open(path).read())
+    eid = read_episode(chan)["episode_id"]
+    assert dump["episode_id"] == eid
+    assert f"episode {eid}" in dump["reason"]
+    # the claimant never re-dumps its own episode on the follow path
+    assert wd.check_once() is None
+
+
+def test_watchdog_recovery_clears_claimed_episode(tmp_path, monkeypatch):
+    """The claiming member's degraded->ok transition closes its episode
+    (the next incident mints a fresh id instead of being dump-suppressed
+    under the finished one); a FOREIGN episode is left for its owner."""
+    from heatmap_tpu.obs.flightrec import FlightRecorder
+    from heatmap_tpu.obs.runtimeinfo import SloWatchdog
+    from heatmap_tpu.obs.xproc import SupervisorChannel
+
+    chan = _chan(tmp_path)
+    sup = SupervisorChannel(chan)
+    sup.update(gave_up=1)
+    monkeypatch.setenv(ENV_CHANNEL, chan)
+    wd = SloWatchdog(None, interval_s=0.0, cooldown_s=0.0,
+                     channel_path=chan, tag="serve1",
+                     flightrec=FlightRecorder(str(tmp_path / "fr")))
+    assert wd.check_once() is not None          # claims + dumps
+    assert read_episode(chan)["origin"] == "serve1"
+    sup.update(gave_up=0)                       # recovery
+    assert wd.check_once() is None
+    assert read_episode(chan) == {}             # episode closed
+    # a second, separate incident gets a FRESH id the claimant dumps for
+    sup.update(gave_up=1)
+    assert wd.check_once() is not None
+    eid2 = read_episode(chan)["episode_id"]
+    # now recover while a FOREIGN broadcast replaces ours: not ours to close
+    sup.update(gave_up=0)
+    broadcast_episode(chan, "p0", "p0 still degraded")
+    wd._episodes_done.append(read_episode(chan)["episode_id"])  # quiesce
+    assert wd.check_once() is None
+    assert read_episode(chan).get("origin") == "p0"
+    assert read_episode(chan)["episode_id"] != eid2
+
+
+def test_watchdog_ignores_pre_boot_episode(tmp_path):
+    """A member restarted INTO an in-flight incident does not follow an
+    episode broadcast before it booted: its dump would describe healthy
+    post-restart state that never saw the incident."""
+    from heatmap_tpu.obs.flightrec import FlightRecorder
+    from heatmap_tpu.obs.runtimeinfo import SloWatchdog
+
+    chan = _chan(tmp_path)
+    eid = broadcast_episode(chan, "p0", "p0 degraded")
+    time.sleep(0.01)  # outlast updated_unix's round(.., 3) granularity
+    wd = SloWatchdog(None, interval_s=0.0, cooldown_s=0.0,
+                     channel_path=chan, tag="serve1",
+                     flightrec=FlightRecorder(str(tmp_path / "fr")))
+    assert wd.check_once() is None
+    # skipped ONCE, not re-walked every tick
+    assert eid in wd._episodes_done
+    # a broadcast from after boot still correlates
+    time.sleep(0.01)  # same rounding guard, the other direction
+    eid2 = broadcast_episode(chan, "p0", "p0 degraded again")
+    path = wd.check_once()
+    assert path is not None
+    assert json.loads(open(path).read())["episode_id"] == eid2
+
+
+def test_ensure_episode_adopts_broadcast_landing_mid_claim(
+        tmp_path, monkeypatch):
+    """The claim's TOCTOU window: a member whose entry read found no
+    episode, but whose O_EXCL claim lands AFTER the first winner has
+    broadcast-and-unclaimed, must adopt that broadcast on a re-read —
+    not rename its own id over it and split the incident in two."""
+    import heatmap_tpu.obs.xproc as xp
+
+    chan = _chan(tmp_path)
+    real_read = xp.read_episode
+    calls = {"n": 0}
+
+    def racy_read(path, max_age_s=600.0):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return {}                 # entry read: nothing broadcast YET
+        return real_read(path, max_age_s=max_age_s)
+
+    monkeypatch.setattr(xp, "read_episode", racy_read)
+    # the first winner broadcasts (claim already removed) in the gap
+    # between our entry read and our claim
+    eid_a = broadcast_episode(chan, "pA", "down")
+    ep = xp.ensure_episode(chan, "pB", "down too")
+    assert ep["episode_id"] == eid_a             # adopted, not replaced
+    assert real_read(chan)["episode_id"] == eid_a
+    assert not os.path.exists(xp.episode_path(chan) + ".claim")
+
+
+def test_serve_member_tag_composes_with_env(tmp_path, monkeypatch):
+    """HEATMAP_FLEET_TAG names the RUNTIME member; a serve-only worker
+    composes with it instead of adopting it, so the two sharing a
+    channel and env can never collide on one member file."""
+    from heatmap_tpu.obs.xproc import ENV_FLEET_TAG
+    from heatmap_tpu.serve.api import ServeFleetMember
+
+    chan = _chan(tmp_path)
+    monkeypatch.delenv(ENV_FLEET_TAG, raising=False)
+    assert ServeFleetMember(None, chan).tag == f"serve{os.getpid()}"
+    monkeypatch.setenv(ENV_FLEET_TAG, "city1")
+    assert (ServeFleetMember(None, chan).tag
+            == f"city1-serve{os.getpid()}")      # never bare "city1"
+    assert ServeFleetMember(None, chan, tag="x9").tag == "x9"
+
+
+def test_left_tombstone_neither_fresh_nor_stale(tmp_path):
+    """A clean close publishes a departure tombstone: the member shows
+    up as neither fresh nor stale (a finished job must not degrade the
+    fleet forever), the aggregator forgets it (no 'vanished' echo),
+    and a rejoin simply overwrites the tombstone."""
+    chan = _chan(tmp_path)
+    publish_member_snapshot(chan, "p0", role="runtime",
+                            healthz={"status": "ok", "checks": {}})
+    agg = FleetAggregator(chan)
+    assert "p0" in agg.collect()[0]          # seen live first
+    publish_member_snapshot(chan, "p0", role="runtime", left=True)
+    members, skipped = members_from(chan)
+    assert members == {} and skipped == {"p0": "left"}
+    members, skipped = agg.collect()
+    assert members == {} and skipped == {}   # forgotten, not vanished
+    payload, down = agg.healthz()
+    assert payload["status"] == "ok" and not down
+    assert "heatmap_fleet_stale_members 0" in agg.metrics_text()
+    # an hours-old tombstone still reads as left, never stale
+    p = member_path(chan, "p0")
+    d = json.loads(open(p).read())
+    d["updated_unix"] = time.time() - 7200
+    with open(p, "w") as fh:
+        json.dump(d, fh)
+    assert members_from(chan)[1] == {"p0": "left"}
+    # rejoin: the next live publish overwrites the tombstone
+    publish_member_snapshot(chan, "p0", role="runtime")
+    assert "p0" in agg.collect()[0]
+
+
+def test_ensure_episode_exclusive_claim(tmp_path):
+    """Two members degrading concurrently must converge on ONE episode
+    id: the claim is an O_EXCL create, a loser adopts the winner's
+    broadcast (or backs off empty), and an orphaned claim from a
+    crashed winner is swept instead of wedging the next incident."""
+    from heatmap_tpu.obs.xproc import episode_path
+
+    chan = _chan(tmp_path)
+    claim = episode_path(chan) + ".claim"
+    # winner path: claims, broadcasts, removes the claim
+    ep = ensure_episode(chan, "p0", "p0 degraded")
+    assert ep["episode_id"] and not os.path.exists(claim)
+    # a later caller inside the episode window joins it
+    assert ensure_episode(chan, "p1", "p1 degraded") == read_episode(chan)
+    # loser path: a FRESH foreign claim with no broadcast yet means a
+    # winner is mid-write — back off empty, do NOT mint a second id
+    os.remove(episode_path(chan))
+    open(claim, "w").close()
+    assert ensure_episode(chan, "p1", "p1 degraded") == {}
+    assert read_episode(chan) == {}          # nothing was broadcast
+    # orphaned claim (winner crashed >10s ago): swept, next tick claims
+    old = time.time() - 60
+    os.utime(claim, (old, old))
+    assert ensure_episode(chan, "p1", "p1 degraded") == {}  # sweeps
+    assert not os.path.exists(claim)
+    assert ensure_episode(chan, "p1", "p1 degraded")["episode_id"]
+
+
+def test_serve_fleet_member_publishes_and_follows_episodes(
+        tmp_path, monkeypatch):
+    """A serve-only worker (serve_forever path) joins the fleet: its
+    member publisher snapshots the app registry as role="serve" and its
+    fleet-mode watchdog writes a correlated dump for a foreign
+    episode."""
+    from heatmap_tpu.serve.api import ServeFleetMember, make_wsgi_app
+    from heatmap_tpu.sink import MemoryStore
+
+    chan = _chan(tmp_path)
+    monkeypatch.setenv(ENV_CHANNEL, chan)
+    monkeypatch.setenv("HEATMAP_FLEET_PUBLISH_S", "0.05")
+    monkeypatch.setenv("HEATMAP_FLIGHTREC_DIR", str(tmp_path / "fr"))
+    app = make_wsgi_app(MemoryStore())
+    member = ServeFleetMember.from_env(app)
+    assert member is not None
+    try:
+        snap = json.loads(open(member_path(chan, member.tag)).read())
+        assert snap["role"] == "serve"
+        assert member.tag.startswith("serve")
+        assert "heatmap_view_rebuilds_total" in snap["metrics_text"]
+        agg = FleetAggregator(chan)
+        assert f'proc="{member.tag}",role="serve"' in agg.metrics_text()
+        # fleet episode correlation without a runtime attached
+        eid = broadcast_episode(chan, "p0", "p0 degraded")
+        path = member.watchdog.check_once()
+        assert path is not None
+        assert json.loads(open(path).read())["episode_id"] == eid
+    finally:
+        member.stop()
+    # no channel -> no membership
+    monkeypatch.delenv(ENV_CHANNEL)
+    assert ServeFleetMember.from_env(app) is None
+
+
+# ----------------------------------------------------- obs_top --fleet
+def _load_obs_top():
+    import importlib.util
+
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        os.pardir))
+    spec = importlib.util.spec_from_file_location(
+        "obs_top", os.path.join(repo, "tools", "obs_top.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_obs_top_fleet_frame_from_synthetic_channel(tmp_path):
+    """--fleet renders one row per member — rate, event-age p50,
+    memory watermark, last-seen — off a two-member channel's federated
+    exposition."""
+    top = _load_obs_top()
+    chan = _chan(tmp_path)
+    _publish_two_members(chan)
+    agg = FleetAggregator(chan)
+    m = top.parse_prom(agg.metrics_text())
+    health = {"status": "ok", "checks": {}}
+    frame = top.render_fleet_frame(m, None, 0.0, health)
+    assert "p0" in frame and "p1" in frame
+    assert "runtime" in frame
+    # first frame: rate falls back to the member's events_per_sec gauge
+    assert "50 ev/s" in frame and "30 ev/s" in frame
+    assert "0.40 s" in frame and "0.90 s" in frame   # event-age p50s
+    assert "FLEET SLO OK" in frame
+    # second frame: rates come from the counter delta between scrapes
+    prev = m
+    _publish_two_members(chan)  # counters unchanged -> delta 0
+    m2 = top.parse_prom(agg.metrics_text())
+    frame2 = top.render_fleet_frame(m2, prev, 2.0, None)
+    assert "0 ev/s" in frame2
+
+
+def test_obs_top_fleet_frame_marks_stale_member(tmp_path):
+    top = _load_obs_top()
+    chan = _chan(tmp_path)
+    publish_member_snapshot(chan, "alive", role="runtime")
+    p = member_path(chan, "gone")
+    with open(p, "w") as fh:
+        json.dump({"tag": "gone", "updated_unix": time.time() - 3600},
+                  fh)
+    agg = FleetAggregator(chan, max_age_s=30.0)
+    m = top.parse_prom(agg.metrics_text())
+    frame = top.render_fleet_frame(m, None, 0.0, None)
+    assert "STALE/DOWN" in frame and "gone" in frame
+
+
+# ----------------------------------------------------- bench fleet stamp
+def test_fleet_stamp_counts_members_and_normalizes(tmp_path,
+                                                   monkeypatch):
+    chan = _chan(tmp_path)
+    _publish_two_members(chan)
+    # sidecars on the same channel do no data-path work: dividing the
+    # headline by them would corrupt the per-member baseline
+    publish_member_snapshot(chan, "supervisor", role="supervisor")
+    publish_member_snapshot(chan, "serve1", role="serve")
+    monkeypatch.setenv(ENV_CHANNEL, chan)
+    st = fleet_stamp(3_000_000.0)
+    assert st["fleet"]["members"] == 2
+    assert st["fleet"]["member_tags"] == ["p0", "p1"]
+    assert st["fleet"]["per_member_rate"] == 1_500_000.0
+    st = fleet_stamp(100.0, role="serve")
+    assert st["fleet"]["members"] == 1
+    assert st["fleet"]["member_tags"] == ["serve1"]
+
+
+def test_fleet_stamp_standalone_defaults(monkeypatch):
+    monkeypatch.delenv(ENV_CHANNEL, raising=False)
+    st = fleet_stamp(100.0)
+    assert st == {"fleet": {"members": 1, "per_member_rate": 100.0}}
+    assert fleet_stamp() == {"fleet": {"members": 1}}

@@ -609,14 +609,20 @@ def test_debug_freshness_endpoint(tmp_path):
         base = f"http://127.0.0.1:{port}"
         d = get_json(base + "/debug/freshness")
         assert d["stage_order"] == ["poll_wait", "prefetch_queue",
-                                    "fold", "ring", "sink_commit"]
+                                    "fold", "ring", "sink_commit",
+                                    "view_apply"]
         assert len(d["records"]) == 4  # 64 events / 16-batch
         newest = d["records"][0]
+        # writer-fed view present -> the cross-process view_apply stage
+        # is stamped in-process too (≈0; the stage exists for the fleet
+        # stitch — obs.fleet)
         assert set(newest["stages"]) == set(d["stage_order"])
         assert newest["epoch"] > d["records"][1]["epoch"]
-        # the decomposition conserves: stages sum to the mean event age
+        # the decomposition conserves: stages telescope to the view-
+        # visible age (the mean age through sink commit, plus the
+        # in-process view apply)
         assert sum(newest["stages"].values()) == pytest.approx(
-            newest["age_s"]["mean"], abs=5e-3)
+            newest["age_s"]["visible"], abs=5e-3)
         assert d["summary"]["event_age_p50_s"] > 0
         assert "ring_residency_mean_s" in d["summary"]
         assert len(get_json(base + "/debug/freshness?n=1")["records"]) == 1
@@ -1105,4 +1111,89 @@ def test_debug_profile_dir_constrained_and_no_tempdir_leak(tmp_path):
         assert set(glob.glob(pat)) == before  # no orphan dir
     finally:
         rt.tracer.stop()
+        httpd.shutdown()
+
+
+# ------------------------------------------------------ fleet surfaces
+def test_fleet_endpoints_503_without_channel(monkeypatch):
+    from heatmap_tpu.obs.xproc import ENV_CHANNEL
+
+    monkeypatch.delenv(ENV_CHANNEL, raising=False)
+    httpd, _t, port = start_background(MemoryStore(),
+                                       load_config({}, serve_port=0),
+                                       port=0)
+    try:
+        for path in ("/fleet/metrics", "/fleet/healthz",
+                     "/fleet/freshness"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10)
+            assert ei.value.code == 503
+            assert "channel" in json.loads(ei.value.read())["error"]
+    finally:
+        httpd.shutdown()
+
+
+def test_fleet_endpoints_over_http(tmp_path, monkeypatch):
+    """Any process holding the channel path serves the federation: the
+    three /fleet surfaces against a synthetic two-member channel."""
+    from heatmap_tpu.obs.xproc import ENV_CHANNEL, publish_member_snapshot
+
+    chan = str(tmp_path / "chan")
+    publish_member_snapshot(
+        chan, "p0", role="runtime",
+        metrics_text=("# TYPE heatmap_events_valid_total counter\n"
+                      "heatmap_events_valid_total 100\n"),
+        freshness={"event_age_p50_s": 0.4},
+        healthz={"status": "ok", "checks": {}},
+        lineage=[{"lid": "p0-1", "ev_mean_ts": 1000.0,
+                  "stages": {"sink_commit": 2.0}, "t_last": 1002.0}])
+    publish_member_snapshot(
+        chan, "serve1", role="serve",
+        healthz={"status": "degraded",
+                 "checks": {"event_age_p50_ms": {"ok": False}}},
+        lineage=[{"lid": "p0-1", "ev_mean_ts": 1000.0,
+                  "stages": {"view_apply": 0.5}, "t_last": 1002.5}])
+    monkeypatch.setenv(ENV_CHANNEL, chan)
+    httpd, _t, port = start_background(MemoryStore(),
+                                       load_config({}, serve_port=0),
+                                       port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(base + "/fleet/metrics",
+                                    timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            txt = r.read().decode()
+        assert 'heatmap_events_valid_total{proc="p0"} 100' in txt
+        assert "heatmap_fleet_members 2" in txt
+        hz = get_json(base + "/fleet/healthz")
+        assert hz["status"] == "degraded"
+        assert hz["checks"]["member_serve1"]["failing"] == [
+            "event_age_p50_ms"]
+        fr = get_json(base + "/fleet/freshness?n=8")
+        assert len(fr["records"]) == 1
+        rec = fr["records"][0]
+        assert rec["residual_s"] == pytest.approx(0.0)
+        assert sorted(rec["procs"]) == ["p0", "serve1"]
+    finally:
+        httpd.shutdown()
+
+
+def test_fleet_healthz_503_when_fleet_down(tmp_path, monkeypatch):
+    from heatmap_tpu.obs.xproc import ENV_CHANNEL, publish_member_snapshot
+
+    chan = str(tmp_path / "chan")
+    publish_member_snapshot(chan, "p0", role="runtime",
+                            healthz={"status": "down", "checks": {}})
+    monkeypatch.setenv(ENV_CHANNEL, chan)
+    httpd, _t, port = start_background(MemoryStore(),
+                                       load_config({}, serve_port=0),
+                                       port=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleet/healthz", timeout=10)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["status"] == "down"
+    finally:
         httpd.shutdown()

@@ -211,6 +211,53 @@ time.sleep(3600)   # wedged before any beacon
     assert open(log).read().split()[-1] == "cpu"
 
 
+def test_separate_incidents_mint_fresh_episode_ids(tmp_path):
+    """A child failure AFTER a full healthy window is a separate
+    incident: the supervisor closes its previous episode broadcast
+    before claiming, so the new incident gets a fresh id — joined
+    stale, every surviving watchdog would skip it as already-dumped
+    and the second incident would leave no correlated dump set."""
+    import threading
+
+    from heatmap_tpu.obs.xproc import read_episode
+
+    log = tmp_path / "launches"
+    chan = str(tmp_path / "chan")
+    body = """
+import os, sys, time
+with open(os.environ["LAUNCH_LOG"], "a") as fh:
+    fh.write("launch\\n")
+n = sum(1 for _ in open(os.environ["LAUNCH_LOG"]))
+if n >= 3:
+    sys.exit(0)
+time.sleep(0.5)   # healthy past the (tiny) budget window, then fail
+sys.exit(1)
+"""
+    sup = Supervisor(
+        _child(body),
+        RestartPolicy(max_restarts=10, window_s=0.3, backoff_s=0.05,
+                      backoff_max_s=0.1, term_grace_s=1.0),
+        env={**os.environ, "LAUNCH_LOG": str(log)},
+        heartbeat_path=str(tmp_path / "hb"), poll_s=0.02,
+        channel_path=chan)
+    rcs: list = []
+    t = threading.Thread(target=lambda: rcs.append(sup.run()), daemon=True)
+    t.start()
+    deadline = time.monotonic() + 30
+    first = None
+    while time.monotonic() < deadline and first is None:
+        first = read_episode(chan).get("episode_id")
+        time.sleep(0.01)
+    assert first, "first failure never broadcast an episode"
+    t.join(timeout=30)
+    assert rcs == [0]
+    # the second failure's broadcast survives the run: fresh id, ours
+    final = read_episode(chan)
+    assert final.get("origin") == "supervisor"
+    assert final["episode_id"] != first, \
+        "second incident joined the stale episode id"
+
+
 def test_policy_from_env():
     env = {"HEATMAP_SUPERVISE_MAX_RESTARTS": "9",
            "HEATMAP_SUPERVISE_STALL_TIMEOUT_S": "7.5",
@@ -326,3 +373,141 @@ def test_watchdog_vouches_for_in_flight_step_up_to_grace(tmp_path,
         "watchdog kept vouching past the dispatch grace")
     rt._step_began = None
     rt.close()
+
+
+# ------------------------------------------------- fleet observatory
+CHAOS_CHILD = """
+import os, sys, time
+from heatmap_tpu.obs.xproc import publish_member_snapshot
+chan = os.environ["HEATMAP_SUPERVISOR_CHANNEL"]
+open(os.environ["CHILD_PID_FILE"], "w").write(str(os.getpid()))
+hb = os.environ["HEATMAP_HEARTBEAT_FILE"]
+while True:
+    with open(hb, "w") as fh:
+        fh.write(str(time.time()))
+    publish_member_snapshot(chan, "c1", role="runtime",
+                            freshness={"event_age_p50_s": 0.1},
+                            healthz={"status": "ok", "checks": {}})
+    time.sleep(0.05)
+"""
+
+
+def test_fleet_chaos_child_killed_mid_stream(tmp_path, monkeypatch):
+    """ISSUE 6 acceptance (pinned on JAX_PLATFORMS=cpu via conftest): a
+    supervisor-managed fleet with one child KILLED mid-stream yields
+    /fleet/healthz degraded NAMING the dead member, and one
+    flight-recorder dump per surviving member — supervisor + a
+    serve-only watchdog member here — sharing a single episode id."""
+    import glob
+    import json
+    import signal
+    import threading
+
+    from heatmap_tpu.obs.fleet import FleetAggregator
+    from heatmap_tpu.obs.flightrec import FlightRecorder
+    from heatmap_tpu.obs.runtimeinfo import SloWatchdog
+    from heatmap_tpu.obs.xproc import (member_path,
+                                       publish_member_snapshot,
+                                       read_episode)
+
+    chan = str(tmp_path / "chan")
+    pid_file = tmp_path / "child.pid"
+    fr_sup = tmp_path / "fr-supervisor"
+    fr_srv = tmp_path / "fr-serve1"
+    monkeypatch.setenv("HEATMAP_FLEET_PUBLISH_S", "0.05")
+    env = {**os.environ,
+           "CHILD_PID_FILE": str(pid_file),
+           "HEATMAP_FLIGHTREC_DIR": str(fr_sup),
+           "JAX_PLATFORMS": "cpu"}
+    # long backoff: after the kill the supervisor must NOT resurrect
+    # the child inside the test window — the fleet has to actually see
+    # the member go dark
+    sup = Supervisor(
+        _child(CHAOS_CHILD),
+        RestartPolicy(max_restarts=5, backoff_s=60.0, backoff_max_s=60.0,
+                      term_grace_s=1.0, window_s=60.0,
+                      stall_timeout_s=120.0),
+        env=env, heartbeat_path=str(tmp_path / "hb"), poll_s=0.02,
+        channel_path=chan)
+    t = threading.Thread(target=sup.run, daemon=True)
+    t.start()
+    try:
+        # the fleet assembles: child + supervisor member snapshots
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if (pid_file.exists() and os.path.exists(member_path(chan, "c1"))
+                    and os.path.exists(member_path(chan, "supervisor"))):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("fleet never assembled")
+        sup_snap = json.loads(open(member_path(chan, "supervisor")).read())
+        assert sup_snap["role"] == "supervisor"
+        assert "heatmap_supervisor_restarts_total" in sup_snap["metrics_text"]
+
+        # the surviving serve-only member: publishes its snapshot and
+        # runs its own SLO watchdog against the shared channel
+        publish_member_snapshot(chan, "serve1", role="serve",
+                                healthz={"status": "ok", "checks": {}})
+        wd = SloWatchdog(None, interval_s=0.0, cooldown_s=0.0,
+                         channel_path=chan, tag="serve1",
+                         flightrec=FlightRecorder(str(fr_srv)))
+        assert wd.check_once() is None   # healthy fleet: no episode yet
+
+        # chaos: SIGKILL the child mid-stream (a hard death the child's
+        # own recorder cannot see — exactly the supervisor's job)
+        os.kill(int(pid_file.read_text()), signal.SIGKILL)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            ep = read_episode(chan)
+            if ep:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("supervisor never broadcast an episode")
+        assert ep["origin"] == "supervisor"
+        assert "child failed" in ep["reason"]
+        eid = ep["episode_id"]
+
+        # the supervisor's own dump carries the episode id
+        deadline = time.monotonic() + 15
+        sup_dumps = []
+        while time.monotonic() < deadline and not sup_dumps:
+            sup_dumps = [json.loads(open(p).read()) for p in
+                         glob.glob(str(fr_sup / "flightrec-*.json"))]
+            time.sleep(0.05)
+        assert sup_dumps and sup_dumps[0]["episode_id"] == eid
+
+        # the surviving member's watchdog follows the broadcast and
+        # writes its correlated dump under the SAME id
+        path = wd.check_once()
+        assert path is not None
+        srv_dump = json.loads(open(path).read())
+        assert srv_dump["episode_id"] == eid
+
+        # /fleet/healthz degrades NAMING the dead member once its
+        # snapshot goes stale (it stopped publishing at the kill);
+        # supervisor + serve1 keep publishing and stay fresh members
+        publish_member_snapshot(chan, "serve1", role="serve",
+                                healthz={"status": "ok", "checks": {}})
+        agg = FleetAggregator(chan, max_age_s=0.75)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            payload, down = agg.healthz()
+            if "c1" in payload.get("stale_members", []):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(
+                f"dead member never went stale: {payload}")
+        assert payload["status"] == "degraded" and not down
+        assert payload["checks"]["member_c1"]["ok"] is False
+        assert "stale" in payload["checks"]["member_c1"]["value"]
+        assert "supervisor" in payload["members"]
+        assert "serve1" in payload["members"]
+        assert payload["episode"]["episode_id"] == eid
+        txt = agg.metrics_text()
+        assert 'heatmap_fleet_member_up{proc="c1",role="?"} 0' in txt
+    finally:
+        sup.stop()
+        t.join(timeout=30)

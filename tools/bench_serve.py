@@ -263,6 +263,14 @@ def main() -> None:
         out["concurrent"] = conc
     finally:
         httpd.shutdown()
+    # fleet provenance (obs.fleet): member count + per-member request
+    # rate (the delta path — the production polling shape), so a
+    # replicated-serve round's artifact compares per-worker
+    from heatmap_tpu.obs.fleet import fleet_stamp
+
+    conc = out.get("concurrent") or {}
+    out.update(fleet_stamp((conc.get("delta") or {}).get("req_per_sec"),
+                           role="serve"))
     print(json.dumps(out))
 
 

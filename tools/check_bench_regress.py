@@ -16,9 +16,18 @@ Artifacts whose run failed (``rc != 0``) or whose tail carries no
 parseable headline are skipped with a note — a broken bench run should
 fail ITS OWN gate, not masquerade as a perf regression here.
 
+Artifacts from DIFFERENT backend paths are refused outright: a round
+that silently fell back to CPU (``backend_path: "cpu"``) must never be
+compared against an attached-hardware headline — the CPU number being
+"within threshold" of the hw number says nothing about either, and the
+comparison would mask exactly the regression that matters (ROADMAP
+item 3: BENCH_r05's stuck ``vs_target 0.054`` IS such a fallback
+round).  Mixed pair → exit 1, naming both paths.
+
 Usage:
     python tools/check_bench_regress.py [--dir REPO] [--threshold 0.5]
-Exit codes: 0 ok / nothing to compare, 1 regression, 2 bad arguments.
+Exit codes: 0 ok / nothing to compare, 1 regression or mixed-backend
+pair, 2 bad arguments.
 """
 
 from __future__ import annotations
@@ -65,6 +74,33 @@ def headline_rate(path: str) -> float | None:
     return None
 
 
+def backend_path(path: str) -> str | None:
+    """The artifact's backend provenance (``"hw"`` / ``"cpu"``), from
+    the top-level key bench.py stamps, falling back to the headline
+    metric line's copy; None when neither is present (pre-provenance
+    artifacts — treated as comparable to anything, like before)."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            art = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    bp = art.get("backend_path")
+    if isinstance(bp, str) and bp:
+        return bp
+    for line in reversed(str(art.get("tail", "")).splitlines()):
+        line = line.strip()
+        if not (line.startswith("{") and '"backend_path"' in line):
+            continue
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        bp = d.get("backend_path")
+        if isinstance(bp, str) and bp:
+            return bp
+    return None
+
+
 def newest_pair(dir_path: str) -> list:
     """[(round, path, rate)] for every parseable artifact, round-sorted."""
     out = []
@@ -100,6 +136,13 @@ def main(argv=None) -> int:
         print(f"OK: {len(usable)} usable artifact(s) — nothing to compare")
         return 0
     (r_prev, p_prev, prev), (r_new, p_new, new) = usable[-2], usable[-1]
+    bp_prev, bp_new = backend_path(p_prev), backend_path(p_new)
+    if bp_prev and bp_new and bp_prev != bp_new:
+        print(f"FAIL: backend_path mismatch — r{r_prev:02d} ran on "
+              f"{bp_prev!r} but r{r_new:02d} ran on {bp_new!r}; a "
+              f"fallback round cannot stand in for an attached headline "
+              f"(re-run the bench on the same backend)", file=sys.stderr)
+        return 1
     drop = (prev - new) / prev
     line = (f"r{r_prev:02d} {prev:,.0f} ev/s -> r{r_new:02d} "
             f"{new:,.0f} ev/s ({-drop:+.1%})")

@@ -75,12 +75,26 @@ def main() -> int:
             failures.append(
                 f"{fam.name}: not documented in ARCHITECTURE.md "
                 f"(add a row to the §Observability metrics table)")
+    # the fleet observatory's own families (obs.fleet.FAMILIES) are
+    # emitted as raw exposition text at /fleet/metrics — no registry to
+    # walk, so the gate covers the table directly
+    from heatmap_tpu.obs.fleet import FAMILIES as FLEET_FAMILIES
+
+    for name, _mtype, help_ in FLEET_FAMILIES:
+        if not help_.strip():
+            failures.append(f"{name}: empty HELP string")
+        short = name.removeprefix("heatmap_")
+        if short not in arch and name not in arch:
+            failures.append(
+                f"{name}: not documented in ARCHITECTURE.md "
+                f"(add a row to the §Fleet observatory metrics table)")
     if failures:
         print("FAIL: undocumented metrics:", file=sys.stderr)
         for f in failures:
             print(f"  - {f}", file=sys.stderr)
         return 1
-    print(f"OK: {len(fams)} metric families documented with HELP strings")
+    print(f"OK: {len(fams) + len(FLEET_FAMILIES)} metric families "
+          f"documented with HELP strings")
     return 0
 
 

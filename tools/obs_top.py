@@ -14,9 +14,16 @@ lifetime distribution.
 Plain ANSI only (no curses): one screen clear + reprint per interval,
 which also works piped into a file or over the dumbest of SSH hops.
 
+``--fleet`` switches to the fleet observatory view (obs.fleet): per-
+member rows — ingest rate, event-age p50, memory watermark, last-seen
+age, up/stale — off ``/fleet/metrics``, plus the aggregate
+``/fleet/healthz`` verdict.  Needs a serve process holding the
+supervisor channel path.
+
 Usage:
     python tools/obs_top.py [--url http://127.0.0.1:5000] [--interval 2]
     python tools/obs_top.py --once          # single frame (no clear)
+    python tools/obs_top.py --fleet         # per-member fleet rows
 """
 
 from __future__ import annotations
@@ -193,6 +200,88 @@ def render_frame(m: dict, prev: dict | None, dt: float,
     return "\n".join(lines) + "\n"
 
 
+def _label_of(labels_str: str, key: str) -> str | None:
+    """One label's (unescaped-enough) value out of a raw ``{...}``
+    block; None when absent."""
+    for part in labels_str.strip("{}").split(","):
+        k, _, v = part.partition("=")
+        if k.strip() == key:
+            return v.strip().strip('"')
+    return None
+
+
+def _by_proc(m: dict | None, name: str) -> dict:
+    """{proc_tag: value} for one family's ``proc=``-labeled samples."""
+    out: dict = {}
+    for labels, v in ((m or {}).get(name) or {}).items():
+        p = _label_of(labels, "proc")
+        if p is not None:
+            out[p] = v
+    return out
+
+
+def render_fleet_frame(m: dict, prev: dict | None, dt: float,
+                       health: dict | None) -> str:
+    """The fleet observatory view: one row per member off the
+    federated /fleet/metrics exposition (obs.fleet)."""
+    def fmt(v, unit="", scale=1.0, digits=1):
+        return "--" if v is None else f"{v * scale:,.{digits}f}{unit}"
+
+    roles: dict = {}
+    up: dict = {}
+    for labels, v in (m.get("heatmap_fleet_member_up") or {}).items():
+        tag = _label_of(labels, "proc")
+        if tag is None:
+            continue
+        up[tag] = v
+        roles[tag] = _label_of(labels, "role") or "?"
+    ages = _by_proc(m, "heatmap_fleet_member_age_seconds")
+    p50s = _by_proc(m, "heatmap_fleet_member_event_age_p50_s")
+    mem_wm = _by_proc(m, "heatmap_live_buffer_watermark_bytes")
+    valid = _by_proc(m, "heatmap_events_valid_total")
+    valid_prev = _by_proc(prev, "heatmap_events_valid_total")
+    rate_gauge = _by_proc(m, "heatmap_events_per_sec")
+    lines = ["heatmap obs_top --fleet — " + time.strftime("%H:%M:%S"), ""]
+    lines.append(
+        f"  members {fmt(_val(m, 'heatmap_fleet_members'), digits=0)}   "
+        f"stale {fmt(_val(m, 'heatmap_fleet_stale_members'), digits=0)}   "
+        f"fleet event-age p50 "
+        f"{fmt(_val(m, 'heatmap_fleet_event_age_p50_s'), ' s', digits=2)}"
+        f"   p99 "
+        f"{fmt(_val(m, 'heatmap_fleet_event_age_p99_s'), ' s', digits=2)}")
+    lines.append("")
+    lines.append(f"  {'member':<14}{'role':<12}{'rate':>12}"
+                 f"{'age p50':>10}{'mem wm':>10}{'seen':>8}  state")
+    for tag in sorted(up):
+        # rate: delta of the member's valid-event counter between
+        # scrapes; first frame falls back to the member's own lifetime
+        # events_per_sec gauge
+        rate = None
+        if dt > 0 and tag in valid and tag in valid_prev:
+            rate = (valid[tag] - valid_prev[tag]) / dt
+        elif tag in rate_gauge:
+            rate = rate_gauge[tag]
+        lines.append(
+            f"  {tag:<14}{roles.get(tag, '?'):<12}"
+            f"{fmt(rate, ' ev/s', digits=0):>12}"
+            f"{fmt(p50s.get(tag), ' s', digits=2):>10}"
+            f"{fmt(mem_wm.get(tag), ' MB', 1 / 1e6, 0):>10}"
+            f"{fmt(ages.get(tag), ' s', digits=0):>8}"
+            f"  {'up' if up.get(tag) else 'STALE/DOWN'}")
+    if health is not None:
+        status = health.get("status", "?")
+        bad = [k for k, c in health.get("checks", {}).items()
+               if isinstance(c, dict) and not c.get("ok", True)]
+        lines.append("")
+        lines.append(f"  FLEET SLO {status.upper()}"
+                     + (f"   failing: {', '.join(bad)}" if bad else ""))
+        ep = health.get("episode")
+        if ep:
+            lines.append(f"  episode   {ep.get('episode_id', '?')} from "
+                         f"{ep.get('origin', '?')}: {ep.get('reason', '')}")
+    return "\n".join(lines) + "\n"
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--url", default="http://127.0.0.1:5000")
@@ -200,12 +289,18 @@ def main(argv=None) -> int:
     ap.add_argument("--once", action="store_true",
                     help="print one frame and exit (no screen clear)")
     ap.add_argument("--no-clear", action="store_true")
+    ap.add_argument("--fleet", action="store_true",
+                    help="per-member fleet view off /fleet/metrics "
+                         "(needs a supervisor channel)")
     args = ap.parse_args(argv)
 
+    metrics_path = "/fleet/metrics" if args.fleet else "/metrics"
+    health_path = "/fleet/healthz" if args.fleet else "/healthz"
+    render = render_fleet_frame if args.fleet else render_frame
     prev, t_prev = None, 0.0
     while True:
         try:
-            m = parse_prom(_fetch(args.url.rstrip("/") + "/metrics"))
+            m = parse_prom(_fetch(args.url.rstrip("/") + metrics_path))
         except (urllib.error.URLError, OSError) as e:
             print(f"obs_top: {args.url} unreachable: {e}", file=sys.stderr)
             if args.once:
@@ -213,7 +308,7 @@ def main(argv=None) -> int:
             time.sleep(args.interval)
             continue
         try:
-            health = json.loads(_fetch(args.url.rstrip("/") + "/healthz"))
+            health = json.loads(_fetch(args.url.rstrip("/") + health_path))
         except (urllib.error.HTTPError) as e:  # 503 = down, still JSON
             try:
                 health = json.loads(e.read())
@@ -222,7 +317,7 @@ def main(argv=None) -> int:
         except (urllib.error.URLError, OSError, ValueError):
             health = None
         now = time.monotonic()
-        frame = render_frame(m, prev, now - t_prev if prev else 0.0, health)
+        frame = render(m, prev, now - t_prev if prev else 0.0, health)
         if not (args.once or args.no_clear):
             sys.stdout.write("\x1b[2J\x1b[H")
         sys.stdout.write(frame)
