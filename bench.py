@@ -720,6 +720,11 @@ def main() -> dict:
         "backend_path": "cpu" if dev.platform == "cpu" else "hw",
         "backend_device": f"{dev.platform} {dev.device_kind}",
         "backend_fallback": bool(os.environ.get("BENCH_DEVICE_FALLBACK")),
+        # shard provenance (ISSUE 7): how many H3-partitioned runtime
+        # shards produced this headline — check_bench_regress refuses to
+        # compare artifacts across differing counts, so an N-shard
+        # aggregate can never mask a single-shard regression
+        "shards": int(os.environ.get("HEATMAP_SHARDS", "1") or 1),
         # vs_baseline is the harness contract key; the reference publishes
         # no measured numbers (BASELINE.md §methodology), so the
         # denominator is the DESIGN TARGET — 5M ev/s on v5e-4

@@ -168,6 +168,29 @@ class Config:
                                        # comment-ping cadence keeping
                                        # idle connections (and their
                                        # proxies) alive
+    shards: int = 1                    # HEATMAP_SHARDS: total runtime
+                                       # shard processes partitioning
+                                       # the event stream by H3 parent
+                                       # cell (stream/shardmap.py); 1 =
+                                       # unsharded (the default)
+    shard_index: int = 0               # HEATMAP_SHARD_INDEX: this
+                                       # process's shard in 0..N-1 (the
+                                       # fleet supervisor sets it per
+                                       # child)
+    shard_res: int = -1                # HEATMAP_SHARD_RES: H3 parent
+                                       # resolution of the partition
+                                       # key; -1 = the snap resolution
+                                       # itself (parent == cell).  Must
+                                       # not exceed min(resolutions).
+    shard_oversample: int = 0          # HEATMAP_SHARD_OVERSAMPLE: how
+                                       # many feed-batches worth of
+                                       # stream rows a shard polls per
+                                       # step before the ownership
+                                       # filter compacts them (0 = auto:
+                                       # the shard count, so a shard's
+                                       # fold stays full; 1 = poll
+                                       # exactly one feed shape — the
+                                       # byte-exact differential mode)
 
     @property
     def tile_seconds(self) -> int:
@@ -247,6 +270,11 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
                              Config.sse_max_clients),
         sse_heartbeat_s=_float(e, "HEATMAP_SSE_HEARTBEAT_S",
                                Config.sse_heartbeat_s),
+        shards=_int(e, "HEATMAP_SHARDS", Config.shards),
+        shard_index=_int(e, "HEATMAP_SHARD_INDEX", Config.shard_index),
+        shard_res=_int(e, "HEATMAP_SHARD_RES", Config.shard_res),
+        shard_oversample=_int(e, "HEATMAP_SHARD_OVERSAMPLE",
+                              Config.shard_oversample),
     )
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
@@ -295,4 +323,20 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         raise ValueError(
             f"HEATMAP_SSE_HEARTBEAT_S must be > 0, "
             f"got {cfg.sse_heartbeat_s}")
+    if cfg.shards < 1:
+        raise ValueError(f"HEATMAP_SHARDS must be >= 1, got {cfg.shards}")
+    if not 0 <= cfg.shard_index < cfg.shards:
+        raise ValueError(
+            f"HEATMAP_SHARD_INDEX must be in 0..{cfg.shards - 1}, "
+            f"got {cfg.shard_index}")
+    if cfg.shards > 1:
+        snap_res = min(cfg.resolutions)
+        if not (cfg.shard_res == -1 or 0 <= cfg.shard_res <= snap_res):
+            raise ValueError(
+                f"HEATMAP_SHARD_RES must be -1 or in 0..{snap_res} "
+                f"(the coarsest fold resolution), got {cfg.shard_res}")
+    if not 0 <= cfg.shard_oversample <= 64:
+        raise ValueError(
+            f"HEATMAP_SHARD_OVERSAMPLE must be in 0..64, "
+            f"got {cfg.shard_oversample}")
     return cfg

@@ -472,7 +472,15 @@ class SloWatchdog:
                 or ep.get("origin") == self.tag):
             return None
         upd = ep.get("updated_unix")
-        if isinstance(upd, (int, float)) and upd < self._boot_unix:
+        # compare at the broadcast stamp's OWN precision: updated_unix
+        # is round(time.time(), 3), which can round DOWN up to half a
+        # millisecond — against a full-precision boot stamp, a
+        # broadcast issued microseconds AFTER boot would classify as
+        # pre-boot and be skipped forever; a same-millisecond tie goes
+        # to dumping (one extra correlated dump beats a silently
+        # missing one)
+        if isinstance(upd, (int, float)) \
+                and upd < round(self._boot_unix, 3):
             # broadcast predates this process (we restarted into an
             # in-flight incident): our dump would describe post-boot
             # state that never saw the incident — skip, once

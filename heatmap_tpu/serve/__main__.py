@@ -14,4 +14,7 @@ logging.basicConfig(level=logging.INFO,
                     format="%(asctime)s %(levelname)s %(name)s %(message)s")
 
 cfg = load_config()
-serve_forever(make_store(cfg), cfg)
+# read-side: under a sharded jsonl config, load the union of every
+# shard's log — a serve worker must present the whole city, never one
+# shard's slice
+serve_forever(make_store(cfg, writer=False), cfg)

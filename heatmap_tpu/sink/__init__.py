@@ -29,13 +29,29 @@ from heatmap_tpu.sink.jsonl import JsonlStore  # noqa: F401
 from heatmap_tpu.sink.writer import AsyncWriter  # noqa: F401
 
 
-def make_store(cfg) -> Store:
-    """Store factory honoring HEATMAP_STORE (auto | memory | jsonl | mongo)."""
+def make_store(cfg, writer: bool = True) -> Store:
+    """Store factory honoring HEATMAP_STORE (auto | memory | jsonl | mongo).
+
+    ``writer=False`` marks a read-side process (serve-only): under a
+    sharded jsonl config it loads the UNION of every shard's log
+    instead of one shard's slice of the city."""
     kind = getattr(cfg, "store", "auto")
     if kind == "memory":
         return MemoryStore()
     if kind == "jsonl":
-        return JsonlStore(cfg.checkpoint_dir)
+        # the jsonl log is SINGLE-writer (close() compacts by rewriting
+        # the file from the process-local view — a second writer's docs
+        # would be silently clobbered by whichever process closes last),
+        # so H3-partitioned shard children each get their own log under
+        # the same per-shard namespace their checkpoints use, and a
+        # read-side process re-assembles the city by loading all of
+        # them (merge is upsert-only: cell spaces are disjoint)
+        directory = cfg.checkpoint_dir
+        if getattr(cfg, "shards", 1) > 1:
+            if writer:
+                return JsonlStore(f"{directory}/shard{cfg.shard_index}")
+            return JsonlStore(directory, merge_shard_logs=True)
+        return JsonlStore(directory)
     if kind == "mongo":
         from heatmap_tpu.sink.mongo import MongoStore
 

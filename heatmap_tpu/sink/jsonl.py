@@ -37,16 +37,37 @@ def _dec(doc: dict) -> dict:
 
 
 class JsonlStore(MemoryStore):
-    def __init__(self, directory: str, now_fn=None):
+    """``merge_shard_logs`` additionally loads every per-shard child log
+    (``<directory>/shard<i>/store.jsonl``, the namespace sharded runtime
+    children write — see sink.make_store) into the in-memory view: the
+    read-side fan-in for a serve-only process over an H3-partitioned
+    fleet's jsonl sinks.  Position docs carry a monotonic-ts guard, but
+    TILE upserts are last-write-wins — the merge is correct because the
+    shardmap makes cell spaces DISJOINT (each tile ``_id`` lives in
+    exactly one shard's log, so load order across logs cannot clobber),
+    not because replays are recency-guarded.  Shard logs load AFTER the
+    base file, so each shard's own durable state wins for its cells —
+    a shard rolled back to an older checkpoint serves its rolled-back
+    tiles until its replay re-folds them (the same staleness window the
+    shard itself has), it does not corrupt other shards' cells."""
+
+    def __init__(self, directory: str, now_fn=None,
+                 merge_shard_logs: bool = False):
         super().__init__(now_fn)
         os.makedirs(directory, exist_ok=True)
         self.path = os.path.join(directory, "store.jsonl")
         if os.path.exists(self.path):
-            self._load()
+            self._load(self.path)
+        if merge_shard_logs:
+            import glob
+
+            for p in sorted(glob.glob(os.path.join(
+                    glob.escape(directory), "shard*", "store.jsonl"))):
+                self._load(p)
         self._fh = open(self.path, "a", encoding="utf-8")
 
-    def _load(self) -> None:
-        with open(self.path, encoding="utf-8") as fh:
+    def _load(self, path: str) -> None:
+        with open(path, encoding="utf-8") as fh:
             for line in fh:
                 line = line.strip()
                 if not line:

@@ -53,10 +53,34 @@ def main(argv=None) -> None:
                          "crash AND on heartbeat stall (wedged device op),"
                          " resuming from the checkpoint; policy via "
                          "HEATMAP_SUPERVISE_* (stream/supervisor.py)")
+    ap.add_argument("--shards", type=int, default=None,
+                    help="with --supervise: fan out N H3-partitioned "
+                         "runtime shard children (stream/shardmap.py), "
+                         "each folding a disjoint cell space into the "
+                         "shared store; defaults to HEATMAP_SHARDS (1)")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    import os
+
+    shards = (args.shards if args.shards is not None
+              else int(os.environ.get("HEATMAP_SHARDS", "1") or 1))
+    if args.shards is not None and args.shards > 1 and not args.supervise:
+        # the flag means "fan out a fleet", which only the supervisor
+        # does; a standalone single-shard run is instead configured via
+        # HEATMAP_SHARDS + HEATMAP_SHARD_INDEX in the env (each
+        # orchestrator-managed shard process does exactly that)
+        raise SystemExit("--shards needs --supervise (the fleet "
+                         "supervisor spawns one child per shard)")
+    if args.shards == 1 and args.supervise:
+        # an explicit --shards 1 must WIN over an inherited fleet env
+        # (HEATMAP_SHARDS=4 exported from a prior fleet run): the
+        # single-child Supervisor passes the env through unchanged, and
+        # a child silently folding 1/4 of the stream as shard 0 of a
+        # phantom fleet is exactly the footgun the flag exists to close
+        os.environ["HEATMAP_SHARDS"] = "1"
+        os.environ["HEATMAP_SHARD_INDEX"] = "0"
     if args.supervise:
         # the PARENT never probes (it runs no device op) and must not pin
         # HEATMAP_PLATFORM: each child probes per launch, so an
@@ -68,7 +92,7 @@ def main(argv=None) -> None:
         child = [sys.executable, "-m", "heatmap_tpu.stream", args.pipeline]
         if args.max_batches is not None:
             child += ["--max-batches", str(args.max_batches)]
-        raise SystemExit(supervise_cli(child))
+        raise SystemExit(supervise_cli(child, shards=shards))
 
     # with a dead accelerator relay, the first jax touch (module-level
     # engine constants behind the runtime import) hangs forever — the

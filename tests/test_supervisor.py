@@ -15,7 +15,8 @@ import time
 
 import pytest
 
-from heatmap_tpu.stream.supervisor import RestartPolicy, Supervisor
+from heatmap_tpu.stream.supervisor import (FleetSupervisor, RestartPolicy,
+                                           Supervisor)
 
 FAST = dict(backoff_s=0.05, backoff_max_s=0.1, term_grace_s=1.0,
             window_s=60.0)
@@ -508,6 +509,254 @@ def test_fleet_chaos_child_killed_mid_stream(tmp_path, monkeypatch):
         assert payload["episode"]["episode_id"] == eid
         txt = agg.metrics_text()
         assert 'heatmap_fleet_member_up{proc="c1",role="?"} 0' in txt
+    finally:
+        sup.stop()
+        t.join(timeout=30)
+
+
+# ------------------------------------------------- sharded fleet (ISSUE 7)
+# One child = one H3-partitioned runtime shard.  These children are tiny
+# scripts again: the REAL sharded runtime's checkpoint-resume and merged
+# byte-identity are pinned in-process by tests/test_shard_diff.py; what
+# the FleetSupervisor tests own is the LIFECYCLE — per-shard env fanout,
+# per-child restart budgets, episode correlation, and the fleet surfaces
+# naming the failing shard.
+
+SHARD_COUNTING = """
+import os, sys
+log = os.environ["LAUNCH_LOG"] + os.environ["HEATMAP_SHARD_INDEX"]
+with open(log, "a") as fh:
+    fh.write(os.environ["HEATMAP_SHARDS"] + ":"
+             + os.environ["HEATMAP_SHARD_INDEX"] + "\\n")
+n = sum(1 for _ in open(log))
+sys.exit(0 if n >= int(os.environ["SUCCEED_ON"]) else 1)
+"""
+
+
+def test_fleet_spawns_per_shard_env_and_restarts_each(tmp_path):
+    """Every child gets HEATMAP_SHARDS=N + its own HEATMAP_SHARD_INDEX;
+    restart bookkeeping is PER SHARD (each child here needs 2 launches,
+    so each must be restarted once — a shared budget would conflate
+    them)."""
+    sup = FleetSupervisor(
+        _child(SHARD_COUNTING), 3,
+        RestartPolicy(max_restarts=5, **FAST),
+        env={**os.environ, "LAUNCH_LOG": str(tmp_path / "log"),
+             "SUCCEED_ON": "2"},
+        heartbeat_dir=str(tmp_path), poll_s=0.02,
+        channel_path=str(tmp_path / "chan"))
+    assert sup.run() == 0
+    for i in range(3):
+        lines = open(str(tmp_path / "log") + str(i)).read().split()
+        assert lines == [f"3:{i}", f"3:{i}"]
+        assert sup.children[i].restarts == 1
+        assert sup.children[i].done
+    assert sup.restarts == 3
+
+
+def test_fleet_one_shard_exhausting_budget_degrades_not_kills(tmp_path):
+    """One shard crash-looping past its budget marks THAT shard down;
+    the others still run to completion and run() returns the failing
+    shard's exit code (the fleet keeps serving its remaining cell
+    space instead of dying wholesale)."""
+    body = """
+import os, sys
+i = os.environ["HEATMAP_SHARD_INDEX"]
+log = os.environ["LAUNCH_LOG"] + i
+with open(log, "a") as fh:
+    fh.write("launch\\n")
+sys.exit(3 if i == "1" else 0)
+"""
+    sup = FleetSupervisor(
+        _child(body), 3,
+        RestartPolicy(max_restarts=1, **FAST),
+        env={**os.environ, "LAUNCH_LOG": str(tmp_path / "log")},
+        heartbeat_dir=str(tmp_path), poll_s=0.02,
+        channel_path=str(tmp_path / "chan"))
+    assert sup.run() == 3
+    assert sup.children[1].gave_up and not sup.children[1].done
+    assert sup.children[0].done and sup.children[2].done
+    # budget = max_restarts failures in window -> 2 launches of shard 1
+    assert sum(1 for _ in open(str(tmp_path / "log") + "1")) == 2
+    # the whole fleet did NOT give up: the channel only reports gave_up
+    # when every shard exhausted its budget
+    from heatmap_tpu.obs import SupervisorChannel
+
+    assert SupervisorChannel.metrics_from(str(tmp_path / "chan"))[
+        "gave_up"] == 0
+
+
+def test_fleet_needs_two_shards():
+    with pytest.raises(ValueError):
+        FleetSupervisor(["true"], 1)
+
+
+# A "runtime shard" small enough to SIGKILL deterministically: streams a
+# shared corpus in batches, folds ONLY the rows its ShardMap owns into
+# an append-only per-shard sink, commits its own offset file AFTER each
+# batch's rows land (the offsets-after-commit discipline — replay-safe
+# because the assertion dedups like the real sink's idempotent upserts),
+# heartbeats + publishes a fleet member snapshot per batch, and leaves a
+# departure tombstone on clean exit.
+SHARD_STREAM_CHILD = """
+import json, os, sys, time
+import numpy as np
+from heatmap_tpu.obs.xproc import publish_member_snapshot
+from heatmap_tpu.stream.shardmap import ShardMap
+
+n = int(os.environ["HEATMAP_SHARDS"])
+i = int(os.environ["HEATMAP_SHARD_INDEX"])
+chan = os.environ["HEATMAP_SUPERVISOR_CHANNEL"]
+hb = os.environ["HEATMAP_HEARTBEAT_FILE"]
+outdir = os.environ["FLEET_OUTDIR"]
+batch = int(os.environ["FLEET_BATCH"])
+tag = "shard%d" % i
+with open(os.path.join(outdir, tag + ".launches"), "a") as fh:
+    fh.write("launch\\n")
+open(os.path.join(outdir, tag + ".pid"), "w").write(str(os.getpid()))
+rows = [json.loads(l) for l in open(os.environ["FLEET_CORPUS"])]
+lat = np.radians([r["lat"] for r in rows]).astype(np.float32)
+lng = np.radians([r["lon"] for r in rows]).astype(np.float32)
+own = ShardMap(n, i, 8).owned_mask(lat, lng)
+off_path = os.path.join(outdir, tag + ".offset")
+out_path = os.path.join(outdir, tag + ".rows")
+off = int(open(off_path).read()) if os.path.exists(off_path) else 0
+while off < len(rows):
+    hi = min(off + batch, len(rows))
+    with open(out_path, "a") as fh:
+        for j in range(off, hi):
+            if own[j]:
+                fh.write("%d\\n" % j)
+    with open(off_path + ".tmp", "w") as fh:
+        fh.write(str(hi))
+    os.replace(off_path + ".tmp", off_path)   # offset AFTER commit
+    off = hi
+    open(hb, "w").write(str(time.time()))
+    publish_member_snapshot(chan, tag, role="runtime",
+                            healthz={"status": "ok", "checks": {}})
+    time.sleep(0.05)
+publish_member_snapshot(chan, tag, role="runtime",
+                        healthz={"status": "ok", "checks": {}}, left=True)
+"""
+
+
+def test_fleet_chaos_shard_killed_revived_converges(tmp_path, monkeypatch):
+    """ISSUE 7 chaos satellite: SIGKILL one shard mid-stream — the
+    restart policy revives it, the resume replays only THAT shard's own
+    offsets, /fleet/healthz degrades NAMING the shard while it is dark
+    and recovers, and the merged per-shard sinks converge to the
+    single-shard baseline (every row exactly once across the fleet)."""
+    import json
+    import signal
+    import threading
+
+    import numpy as np
+
+    from heatmap_tpu.obs.fleet import FleetAggregator
+    from heatmap_tpu.obs.xproc import read_episode
+    from heatmap_tpu.stream.shardmap import ShardMap
+
+    monkeypatch.setenv("HEATMAP_FLEET_PUBLISH_S", "0.05")
+    outdir = tmp_path / "out"
+    outdir.mkdir()
+    corpus = tmp_path / "corpus.jsonl"
+    rng = np.random.default_rng(29)
+    rows = [{"lat": float(rng.uniform(42.3, 42.5)),
+             "lon": float(rng.uniform(-71.2, -71.0))} for _ in range(160)]
+    with open(corpus, "w") as fh:
+        for r in rows:
+            fh.write(json.dumps(r) + "\n")
+    chan = str(tmp_path / "chan")
+    env = {**os.environ, "FLEET_OUTDIR": str(outdir),
+           "FLEET_CORPUS": str(corpus), "FLEET_BATCH": "4",
+           "JAX_PLATFORMS": "cpu"}
+    # backoff ~3s: wide enough for the fleet to SEE the dead member go
+    # stale before the revival even on a loaded host, short enough to
+    # keep the test fast
+    sup = FleetSupervisor(
+        _child(SHARD_STREAM_CHILD), 2,
+        RestartPolicy(max_restarts=5, backoff_s=3.0, backoff_max_s=3.0,
+                      term_grace_s=1.0, window_s=60.0,
+                      stall_timeout_s=120.0),
+        env=env, heartbeat_dir=str(tmp_path), poll_s=0.02,
+        channel_path=chan)
+    rcs: list = []
+    t = threading.Thread(target=lambda: rcs.append(sup.run()), daemon=True)
+    t.start()
+    try:
+        # wait until shard 1 is genuinely MID-stream, then SIGKILL it
+        off1 = outdir / "shard1.offset"
+        pid1 = outdir / "shard1.pid"
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if off1.exists() and 0 < int(off1.read_text()) < len(rows) - 8:
+                break
+            time.sleep(0.01)
+        else:
+            raise AssertionError("shard1 never got mid-stream")
+        os.kill(int(pid1.read_text()), signal.SIGKILL)
+        killed_at = int(off1.read_text())
+        assert 0 < killed_at < len(rows)
+
+        # ONE probe loop from the moment of the kill: the failure claims
+        # an episode NAMING the shard, and /fleet/healthz degrades
+        # naming the dead member once its snapshot goes stale (it
+        # stopped publishing at the kill).  Probing both concurrently
+        # matters — the degraded window only spans the restart backoff,
+        # and a sequential wait could eat it on a loaded host, after
+        # which the revived fleet finishes and departs cleanly
+        agg = FleetAggregator(chan, max_age_s=0.5)
+        deadline = time.monotonic() + 30
+        ep, degraded_payload = {}, None
+        while time.monotonic() < deadline:
+            if not ep:
+                ep = read_episode(chan)
+            if degraded_payload is None:
+                payload, down = agg.healthz()
+                if not payload.get("checks", {}).get(
+                        "member_shard1", {}).get("ok", True):
+                    assert payload["status"] == "degraded" and not down
+                    degraded_payload = payload
+            if ep and degraded_payload is not None:
+                break
+            time.sleep(0.02)
+        assert ep and "shard1" in ep["reason"]
+        assert degraded_payload is not None, \
+            "dead shard never went stale on /fleet/healthz"
+
+        # revival: the whole fleet runs to clean completion
+        t.join(timeout=120)
+        assert rcs == [0]
+        launches = open(outdir / "shard1.launches").read().split()
+        assert len(launches) >= 2, "restart policy never revived shard1"
+        assert open(outdir / "shard0.launches").read().split() == ["launch"]
+
+        # the resume replayed only shard 1's OWN offsets: shard 0 was
+        # never killed, so its append-only sink holds exactly its owned
+        # rows once; shard 1 may replay at most the one batch whose
+        # offset commit the SIGKILL could have preempted
+        lat = np.radians([r["lat"] for r in rows]).astype(np.float32)
+        lng = np.radians([r["lon"] for r in rows]).astype(np.float32)
+        owned = [np.flatnonzero(ShardMap(2, i, 8).owned_mask(lat, lng))
+                 for i in range(2)]
+        got0 = [int(x) for x in open(outdir / "shard0.rows").read().split()]
+        got1 = [int(x) for x in open(outdir / "shard1.rows").read().split()]
+        assert got0 == list(owned[0])
+        assert len(got1) - len(set(got1)) <= 4  # <= one replayed batch
+        # merged sinks converge to the single-shard baseline: every row
+        # exactly once across the fleet (dedup = the sink's idempotent
+        # upsert), cell spaces disjoint
+        assert sorted(set(got0) | set(got1)) == list(range(len(rows)))
+        assert not set(got0) & set(got1)
+
+        # recovered: the supervisor's final control-plane verdict shows
+        # both shards done
+        from heatmap_tpu.obs.xproc import member_path
+
+        snap = json.loads(open(member_path(chan, "supervisor")).read())
+        assert snap["healthz"]["status"] == "ok"
+        assert snap["healthz"]["checks"]["shard0"]["value"] == "done"
+        assert snap["healthz"]["checks"]["shard1"]["value"] == "done"
     finally:
         sup.stop()
         t.join(timeout=30)

@@ -22,7 +22,10 @@ compared against an attached-hardware headline — the CPU number being
 "within threshold" of the hw number says nothing about either, and the
 comparison would mask exactly the regression that matters (ROADMAP
 item 3: BENCH_r05's stuck ``vs_target 0.054`` IS such a fallback
-round).  Mixed pair → exit 1, naming both paths.
+round).  Mixed pair → exit 1, naming both paths.  The same refusal
+applies to mismatched ``shards`` stamps (ISSUE 7): a 4-shard aggregate
+headline compared against a 1-shard round would mask a single-shard
+regression behind fan-out — differing shard counts → exit 1.
 
 Usage:
     python tools/check_bench_regress.py [--dir REPO] [--threshold 0.5]
@@ -74,31 +77,43 @@ def headline_rate(path: str) -> float | None:
     return None
 
 
-def backend_path(path: str) -> str | None:
-    """The artifact's backend provenance (``"hw"`` / ``"cpu"``), from
-    the top-level key bench.py stamps, falling back to the headline
-    metric line's copy; None when neither is present (pre-provenance
-    artifacts — treated as comparable to anything, like before)."""
+def _stamped(path: str, key: str, types) -> object:
+    """One provenance stamp of an artifact: the top-level key bench.py
+    stamps, falling back to the headline metric line's copy inside the
+    tail; None when neither is present (pre-provenance artifacts —
+    treated as comparable to anything, like before)."""
     try:
         with open(path, encoding="utf-8") as fh:
             art = json.load(fh)
     except (OSError, ValueError):
         return None
-    bp = art.get("backend_path")
-    if isinstance(bp, str) and bp:
-        return bp
+    v = art.get(key)
+    if isinstance(v, types) and v:
+        return v
     for line in reversed(str(art.get("tail", "")).splitlines()):
         line = line.strip()
-        if not (line.startswith("{") and '"backend_path"' in line):
+        if not (line.startswith("{") and f'"{key}"' in line):
             continue
         try:
             d = json.loads(line)
         except ValueError:
             continue
-        bp = d.get("backend_path")
-        if isinstance(bp, str) and bp:
-            return bp
+        v = d.get(key)
+        if isinstance(v, types) and v:
+            return v
     return None
+
+
+def backend_path(path: str) -> str | None:
+    """The artifact's backend provenance (``"hw"`` / ``"cpu"``)."""
+    return _stamped(path, "backend_path", str)
+
+
+def shard_count(path: str) -> int | None:
+    """The artifact's runtime shard count (``"shards"`` stamp, ISSUE 7
+    sharded rounds); None on pre-sharding artifacts."""
+    v = _stamped(path, "shards", int)
+    return int(v) if v is not None else None
 
 
 def newest_pair(dir_path: str) -> list:
@@ -142,6 +157,14 @@ def main(argv=None) -> int:
               f"{bp_prev!r} but r{r_new:02d} ran on {bp_new!r}; a "
               f"fallback round cannot stand in for an attached headline "
               f"(re-run the bench on the same backend)", file=sys.stderr)
+        return 1
+    sh_prev, sh_new = shard_count(p_prev), shard_count(p_new)
+    if sh_prev is not None and sh_new is not None and sh_prev != sh_new:
+        print(f"FAIL: shards mismatch — r{r_prev:02d} ran {sh_prev} "
+              f"shard(s) but r{r_new:02d} ran {sh_new}; an N-shard "
+              f"aggregate cannot stand in for a single-shard headline "
+              f"(or mask its regression) — re-run the bench at the same "
+              f"shard count", file=sys.stderr)
         return 1
     drop = (prev - new) / prev
     line = (f"r{r_prev:02d} {prev:,.0f} ev/s -> r{r_new:02d} "

@@ -59,6 +59,31 @@ def _smoke_runtime():
     return rt
 
 
+def _smoke_shard_runtime():
+    """A CONSTRUCTED (never run) H3-partitioned shard runtime: the
+    shard gauge families (shard index/count, watermark-alignment lag)
+    only register on a sharded config, which the unsharded smoke above
+    can never expose.  The out-of-shard drop counter is a flat
+    ad-hoc counter (Metrics.count), exposed at /metrics like
+    events_valid but — like every flat counter — outside this
+    registry-walking gate."""
+    from heatmap_tpu.config import load_config
+    from heatmap_tpu.sink import MemoryStore
+    from heatmap_tpu.stream import MicroBatchRuntime
+    from heatmap_tpu.stream.source import MemorySource
+
+    cfg = load_config({}, batch_size=16, state_capacity_log2=8,
+                      speed_hist_bins=4, store="memory", serve_port=0,
+                      shards=2, shard_index=0,
+                      checkpoint_dir=tempfile.mkdtemp(
+                          prefix="metrics-docs-shard-"))
+    src = MemorySource([])
+    src.finish()
+    rt = MicroBatchRuntime(cfg, src, MemoryStore(), checkpoint_every=0)
+    rt.close()
+    return rt
+
+
 def main() -> int:
     os.environ.setdefault("HEATMAP_PLATFORM", "cpu")
     with open(os.path.join(REPO, "ARCHITECTURE.md"),
@@ -67,6 +92,10 @@ def main() -> int:
     rt = _smoke_runtime()
     failures = []
     fams = list(rt.metrics.registry._families.values())
+    seen = {f.name for f in fams}
+    fams += [f for f in
+             _smoke_shard_runtime().metrics.registry._families.values()
+             if f.name not in seen]
     for fam in fams:
         if not fam.help.strip():
             failures.append(f"{fam.name}: empty HELP string")

@@ -18,7 +18,10 @@ which also works piped into a file or over the dumbest of SSH hops.
 member rows — ingest rate, event-age p50, memory watermark, last-seen
 age, up/stale — off ``/fleet/metrics``, plus the aggregate
 ``/fleet/healthz`` verdict.  Needs a serve process holding the
-supervisor channel path.
+supervisor channel path.  When the members are H3-partitioned runtime
+shards (stream/shardmap.py), a per-shard table follows: shard index,
+owned-cell share, steady rate, event-age p50, and the max/mean
+shard-imbalance ratio that makes a skewed partition obvious.
 
 Usage:
     python tools/obs_top.py [--url http://127.0.0.1:5000] [--interval 2]
@@ -241,6 +244,14 @@ def render_fleet_frame(m: dict, prev: dict | None, dt: float,
     valid = _by_proc(m, "heatmap_events_valid_total")
     valid_prev = _by_proc(prev, "heatmap_events_valid_total")
     rate_gauge = _by_proc(m, "heatmap_events_per_sec")
+    def member_rate(tag):
+        # rate: delta of the member's valid-event counter between
+        # scrapes; first frame falls back to the member's own lifetime
+        # events_per_sec gauge
+        if dt > 0 and tag in valid and tag in valid_prev:
+            return (valid[tag] - valid_prev[tag]) / dt
+        return rate_gauge.get(tag)
+
     lines = ["heatmap obs_top --fleet — " + time.strftime("%H:%M:%S"), ""]
     lines.append(
         f"  members {fmt(_val(m, 'heatmap_fleet_members'), digits=0)}   "
@@ -253,21 +264,45 @@ def render_fleet_frame(m: dict, prev: dict | None, dt: float,
     lines.append(f"  {'member':<14}{'role':<12}{'rate':>12}"
                  f"{'age p50':>10}{'mem wm':>10}{'seen':>8}  state")
     for tag in sorted(up):
-        # rate: delta of the member's valid-event counter between
-        # scrapes; first frame falls back to the member's own lifetime
-        # events_per_sec gauge
-        rate = None
-        if dt > 0 and tag in valid and tag in valid_prev:
-            rate = (valid[tag] - valid_prev[tag]) / dt
-        elif tag in rate_gauge:
-            rate = rate_gauge[tag]
         lines.append(
             f"  {tag:<14}{roles.get(tag, '?'):<12}"
-            f"{fmt(rate, ' ev/s', digits=0):>12}"
+            f"{fmt(member_rate(tag), ' ev/s', digits=0):>12}"
             f"{fmt(p50s.get(tag), ' s', digits=2):>10}"
             f"{fmt(mem_wm.get(tag), ' MB', 1 / 1e6, 0):>10}"
             f"{fmt(ages.get(tag), ' s', digits=0):>8}"
             f"  {'up' if up.get(tag) else 'STALE/DOWN'}")
+    # sharded runtime fleet (stream/shardmap.py): one row per shard off
+    # the shard gauges each shard member's snapshot carries, plus the
+    # imbalance ratio that makes a skewed H3 partition visible at a
+    # glance — owned-cell share is the fraction of the full stream's
+    # rows this shard's cell space owns (valid / (valid + out-of-shard))
+    shard_idx = _by_proc(m, "heatmap_shard_index")
+    if shard_idx:
+        foreign = _by_proc(m, "heatmap_events_out_of_shard_total")
+        lines.append("")
+        lines.append(f"  {'shard':<14}{'idx':>4}{'own-cell %':>12}"
+                     f"{'rate':>14}{'age p50':>10}")
+        rates = {}
+        for tag in sorted(shard_idx):
+            own = None
+            v, f = valid.get(tag), foreign.get(tag)
+            if v is not None and f is not None and v + f > 0:
+                own = v / (v + f)
+            rates[tag] = member_rate(tag)
+            lines.append(
+                f"  {tag:<14}{fmt(shard_idx[tag], digits=0):>4}"
+                f"{fmt(own, ' %', 100.0):>12}"
+                f"{fmt(rates[tag], ' ev/s', digits=0):>14}"
+                f"{fmt(p50s.get(tag), ' s', digits=2):>10}")
+        # a wedged shard reports rate 0.0 — it must stay IN the
+        # imbalance/aggregate math (a dead shard is the skew this
+        # readout exists to expose), only unknown rates drop out
+        known = [r for r in rates.values() if r is not None]
+        imbalance = (max(known) / (sum(known) / len(known))
+                     if len(known) >= 2 and sum(known) > 0 else None)
+        lines.append(f"  imbalance max/mean "
+                     f"{fmt(imbalance, 'x', digits=2)}   aggregate "
+                     f"{fmt(sum(known) if known else None, ' ev/s', digits=0)}")
     if health is not None:
         status = health.get("status", "?")
         bad = [k for k, c in health.get("checks", {}).items()
