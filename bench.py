@@ -753,10 +753,13 @@ def main() -> dict:
     }
     result.update(_ref_cpu_baseline_attach(eps))
     # fleet provenance (obs.fleet): member count + per-member rate, so
-    # scale-out rounds inherit a comparable per-member baseline
-    from heatmap_tpu.obs.fleet import fleet_stamp
+    # scale-out rounds inherit a comparable per-member baseline; the
+    # repl block (replica count + max seq lag) rides along when a
+    # replicated serve fleet is attached to the channel
+    from heatmap_tpu.obs.fleet import fleet_stamp, repl_stamp
 
     result.update(fleet_stamp(eps))
+    result.update(repl_stamp())
     if dev.platform == "cpu":
         result.update(_cpu_headline_bank(eps, info, res=res,
                                          pipeline=pipeline, impl=impl,

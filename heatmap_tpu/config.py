@@ -182,6 +182,38 @@ class Config:
                                        # key; -1 = the snap resolution
                                        # itself (parent == cell).  Must
                                        # not exceed min(resolutions).
+    repl_dir: str = ""                 # HEATMAP_REPL_DIR: directory the
+                                       # writer process publishes the
+                                       # view-replication feed into
+                                       # (query/repl.py: segment log +
+                                       # snapshots + meta, one writer
+                                       # per dir).  The serve app also
+                                       # re-exposes the feed at
+                                       # /api/repl/* for remote
+                                       # replicas.  Empty disables
+                                       # publishing.
+    repl_feed: str = ""                # HEATMAP_REPL_FEED: what a
+                                       # serve-only worker FOLLOWS to
+                                       # hold a hot seq-consistent
+                                       # replica view with zero
+                                       # steady-state store reads: a
+                                       # feed directory (same host) or
+                                       # an http(s):// base URL of a
+                                       # process serving /api/repl/*.
+                                       # Empty keeps the PR 4 store-
+                                       # scan polling behavior.
+    repl_seg_bytes: int = 1 << 22      # HEATMAP_REPL_SEG_BYTES: feed
+                                       # segment rotation bound; each
+                                       # rotation also refreshes the
+                                       # catch-up snapshot
+    repl_segments: int = 4             # HEATMAP_REPL_SEGMENTS: feed
+                                       # segments retained on disk
+                                       # (including the live one); a
+                                       # follower that falls behind the
+                                       # oldest re-bootstraps from the
+                                       # snapshot
+    repl_poll_ms: int = 200            # HEATMAP_REPL_POLL_MS: replica
+                                       # follower tail-poll cadence
     shard_oversample: int = 0          # HEATMAP_SHARD_OVERSAMPLE: how
                                        # many feed-batches worth of
                                        # stream rows a shard polls per
@@ -270,6 +302,14 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
                              Config.sse_max_clients),
         sse_heartbeat_s=_float(e, "HEATMAP_SSE_HEARTBEAT_S",
                                Config.sse_heartbeat_s),
+        repl_dir=e.get("HEATMAP_REPL_DIR", Config.repl_dir),
+        repl_feed=e.get("HEATMAP_REPL_FEED", Config.repl_feed),
+        repl_seg_bytes=_int(e, "HEATMAP_REPL_SEG_BYTES",
+                            Config.repl_seg_bytes),
+        repl_segments=_int(e, "HEATMAP_REPL_SEGMENTS",
+                           Config.repl_segments),
+        repl_poll_ms=_int(e, "HEATMAP_REPL_POLL_MS",
+                          Config.repl_poll_ms),
         shards=_int(e, "HEATMAP_SHARDS", Config.shards),
         shard_index=_int(e, "HEATMAP_SHARD_INDEX", Config.shard_index),
         shard_res=_int(e, "HEATMAP_SHARD_RES", Config.shard_res),
@@ -323,6 +363,16 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         raise ValueError(
             f"HEATMAP_SSE_HEARTBEAT_S must be > 0, "
             f"got {cfg.sse_heartbeat_s}")
+    if cfg.repl_seg_bytes < 4096:
+        raise ValueError(
+            f"HEATMAP_REPL_SEG_BYTES must be >= 4096, "
+            f"got {cfg.repl_seg_bytes}")
+    if cfg.repl_segments < 1:
+        raise ValueError(
+            f"HEATMAP_REPL_SEGMENTS must be >= 1, got {cfg.repl_segments}")
+    if cfg.repl_poll_ms < 10:
+        raise ValueError(
+            f"HEATMAP_REPL_POLL_MS must be >= 10, got {cfg.repl_poll_ms}")
     if cfg.shards < 1:
         raise ValueError(f"HEATMAP_SHARDS must be >= 1, got {cfg.shards}")
     if not 0 <= cfg.shard_index < cfg.shards:

@@ -497,6 +497,36 @@ def fleet_stamp(rate: float | None = None,
     return {"fleet": out}
 
 
+def repl_stamp() -> dict:
+    """The ``repl`` artifact block bench.py / tools/bench_serve.py /
+    tools/e2e_rate.py stamp when a REPLICATED serve fleet is attached
+    to the channel: how many members are following a replication feed
+    (their snapshots expose ``heatmap_repl_seq_lag``) and the worst
+    seq lag among them.  {} when none — a standalone round's artifact
+    stays byte-compatible with pre-replication rounds.
+
+    Like the ``shards`` stamp (ISSUE 7), this is refusal provenance:
+    tools/check_bench_regress.py rejects serve-artifact pairs whose
+    replica counts differ, so an N-replica aggregate can never mask a
+    single-replica regression."""
+    import os
+
+    from heatmap_tpu.obs.xproc import ENV_CHANNEL
+
+    members, _skipped = members_from(os.environ.get(ENV_CHANNEL))
+    lags = []
+    for _tag, snap in sorted(members.items()):
+        _types, samples = parse_exposition(
+            str(snap.get("metrics_text", "")))
+        for series, _labels, v in samples:
+            if series == "heatmap_repl_seq_lag":
+                lags.append(v)
+    if not lags:
+        return {}
+    return {"repl": {"replicas": len(lags),
+                     "max_seq_lag": int(max(lags))}}
+
+
 def compact_lineage(records: list) -> list:
     """Closed lineage records -> the compact cross-process form a
     member snapshot publishes: lid, event-time anchor, stage

@@ -20,6 +20,12 @@ incrementally-maintained spatial query layer; this is ours:
   propagate to coarser H3 parent cells (count sums, count-weighted
   speed means and centroids) so ``?res=`` zoom-out queries are
   O(changed cells), never a window rebuild.
+- ``repl``     — delta-log view replication: the writer publishes the
+  view's mutation stream (file-backed segment log + snapshots, epoch
+  nonce per boot) and ``ReplicaViewFollower`` drives a replica-mode
+  ``TileMatView`` in any number of serve workers with zero
+  steady-state store reads; ``StoreViewRefresher`` is demoted to a
+  counted, healthz-warning fallback on replicas.
 """
 
 from heatmap_tpu.query.matview import (  # noqa: F401

@@ -88,12 +88,25 @@ class _Grid:
 
 class TileMatView:
     def __init__(self, delta_log: int = 4096, pyramid_levels: int = 2,
-                 registry=None, now_fn=None):
+                 registry=None, now_fn=None, replica: bool = False):
         self._delta_log = max(1, int(delta_log))
         self._pyramid_levels = max(0, int(pyramid_levels))
         self._now = now_fn or time.time
         self._grids: dict[str, _Grid] = {}
         self._seq = 0
+        # Replica mode (query.repl): the view is a seq-exact FOLLOWER of
+        # a writer's replication feed.  Local clock-driven eviction of
+        # the LATEST window is disabled — the seq advance it implies
+        # must come from the writer's feed marker, or the replica's seq
+        # stream would diverge from the writer's and /api/tiles/delta
+        # responses would stop being byte-interchangeable across the
+        # fleet.  Non-latest stale windows still evict locally (they
+        # never advance seq on the writer either).
+        self._replica = bool(replica)
+        # mutation hook (query.repl.DeltaLogPublisher): called under
+        # the view lock with one record per seq-advancing mutation, in
+        # seq order — the replication feed is exactly this stream
+        self._hook = None
         # per-boot nonce folded into every ETag: seq counters restart at
         # 0 each process, so without it a post-restart ETag string could
         # equal a pre-restart one while naming DIFFERENT content — and a
@@ -119,6 +132,29 @@ class TileMatView:
                 "tile view across all grids",
                 fn=self.cells_live)
 
+    def set_hook(self, fn) -> None:
+        """Attach the replication mutation hook (one per view).  ``fn``
+        receives {"kind": "apply"|"evict"|"resync", "seq": int, ...}
+        under the view lock — it must only enqueue (the publisher
+        drains on its own thread)."""
+        with self._lock:
+            self._hook = fn
+
+    def _emit(self, rec: dict) -> None:
+        """Fire the mutation hook (callers hold the lock).  A hook
+        failure detaches it and is logged — replication trouble must
+        never poison the apply path the sink depends on; the detached
+        publisher's feed goes stale, which is exactly what the
+        replicas' staleness handling exists to absorb."""
+        if self._hook is None:
+            return
+        try:
+            self._hook(rec)
+        except Exception:
+            log.exception("view mutation hook failed; detaching "
+                          "replication publisher")
+            self._hook = None
+
     # ---- write side ----------------------------------------------------
     def apply_packed(self, body, meta) -> int:
         """Apply packed emit BODY rows (engine layout) — the writer-thread
@@ -137,15 +173,19 @@ class TileMatView:
         t0 = time.perf_counter()
         with self._cond:
             seq = self._seq + 1
-            changed = 0
+            changed_docs: list = []
             touched: set = set()
             for doc in docs:
-                changed += self._apply_one(doc, seq)
+                if self._apply_one(doc, seq):
+                    changed_docs.append(doc)
                 if doc.get("grid"):
                     touched.add(doc["grid"])
+            changed = len(changed_docs)
             if changed:
                 self._seq = seq
                 self._cond.notify_all()
+                self._emit({"kind": "apply", "seq": seq,
+                            "docs": changed_docs})
             # evict on the WRITE path too: a grid nobody polls over
             # HTTP (replica behind an LB, secondary grid of a pyramid)
             # would otherwise retain every expired window's cell docs
@@ -154,7 +194,7 @@ class TileMatView:
             for grid in touched:
                 g = self._grids.get(grid)
                 if g is not None:
-                    self._evict(g)
+                    self._evict(grid, g)
         if self._h_apply is not None:
             self._h_apply.observe(time.perf_counter() - t0)
         return changed
@@ -220,31 +260,34 @@ class TileMatView:
                     return 0  # junk ?grid= probes must not grow state
                 g = self._grid(grid)
             new_ws = int(docs[0]["windowStart"].timestamp()) if docs else None
-            self._evict(g)
+            self._evict(grid, g)
             cur_ws = g.latest_ws()
             changed = 0
             if new_ws is None:
                 if g.windows:
-                    changed = self._full_resync(g, None, [])
+                    changed = self._full_resync(grid, g, None, [])
             elif new_ws != cur_ws:
-                changed = self._full_resync(g, new_ws, docs)
+                changed = self._full_resync(grid, g, new_ws, docs)
             else:
                 w = g.windows[cur_ws]
                 new_cells = {d["cellId"]: d for d in docs}
                 if set(w) - set(new_cells):
                     # cells vanished inside one window (an external
                     # writer replaced the store) — full resync
-                    changed = self._full_resync(g, new_ws, docs)
+                    changed = self._full_resync(grid, g, new_ws, docs)
                 else:
                     delta = [d for cid, d in new_cells.items()
                              if w.get(cid) != d]
                     if delta:
                         seq = self._seq + 1
-                        for d in delta:
-                            changed += self._apply_one(d, seq, g)
+                        applied = [d for d in delta
+                                   if self._apply_one(d, seq, g)]
+                        changed = len(applied)
                         if changed:
                             self._seq = seq
                             self._cond.notify_all()
+                            self._emit({"kind": "apply", "seq": seq,
+                                        "docs": applied})
         if self._h_apply is not None:
             self._h_apply.observe(time.perf_counter() - t0)
         return changed
@@ -253,7 +296,8 @@ class TileMatView:
         self._seq += 1
         return self._seq
 
-    def _full_resync(self, g: _Grid, ws: int | None, docs) -> int:
+    def _full_resync(self, grid: str, g: _Grid, ws: int | None,
+                     docs) -> int:
         """Replace a grid's whole state (empty when ws is None) and force
         delta clients through mode=full — the one resync sequence every
         replace_grid branch shares (callers hold the lock)."""
@@ -263,7 +307,10 @@ class TileMatView:
             self._install_window(g, ws, docs)
         g.window_seq = g.mod_seq = seq
         g.log.clear()
+        g.dropped_seq = seq
         self._cond.notify_all()
+        self._emit({"kind": "resync", "seq": seq, "grid": grid,
+                    "ws": ws, "docs": list(docs)})
         return max(1, len(docs))
 
     def _drop_all_windows(self, g: _Grid) -> None:
@@ -303,13 +350,12 @@ class TileMatView:
                 return 0
             g = self._grid(grid)
             seq = self._seq + 1
-            changed = 0
-            for doc in docs:
-                changed += self._apply_one(doc, seq, g)
-            if changed:
+            applied = [doc for doc in docs if self._apply_one(doc, seq, g)]
+            if applied:
                 self._seq = seq
                 self._cond.notify_all()
-            return changed
+                self._emit({"kind": "apply", "seq": seq, "docs": applied})
+            return len(applied)
 
     def poison(self) -> None:
         """An apply failed: the view may have diverged from the store.
@@ -318,15 +364,131 @@ class TileMatView:
             self.poisoned = True
             self._cond.notify_all()
 
+    # ---- replication (query.repl) --------------------------------------
+    # The follower half of the mutation-hook contract: apply records at
+    # the WRITER'S seq values, so a replica's delta/ETag seq stream is
+    # interchangeable with the writer's.  Records at or below the
+    # replica's seq are skipped (idempotent replay: snapshot + tail may
+    # overlap).
+
+    def replica_apply(self, rec: dict) -> int:
+        """Apply one replication feed record; returns changed cells."""
+        kind = rec.get("kind")
+        seq = int(rec.get("seq", 0))
+        with self._cond:
+            if seq <= self._seq:
+                return 0
+            changed = 0
+            if kind == "apply":
+                for doc in rec.get("docs") or []:
+                    changed += self._apply_one(doc, seq)
+            elif kind == "evict":
+                g = self._grids.get(rec.get("grid") or "")
+                if g is not None:
+                    for ws in rec.get("ws") or []:
+                        if ws in g.windows:
+                            del g.windows[ws]
+                            del g.meta[ws]
+                            if g.pyramid is not None:
+                                g.pyramid.drop_window(ws)
+                    g.window_seq = g.mod_seq = seq
+                    changed = 1
+            elif kind == "resync":
+                grid = rec.get("grid") or ""
+                g = self._grid(grid)
+                self._drop_all_windows(g)
+                ws = rec.get("ws")
+                docs = rec.get("docs") or []
+                if ws is not None and docs:
+                    self._install_window(g, int(ws), docs)
+                g.window_seq = g.mod_seq = seq
+                g.log.clear()
+                g.dropped_seq = seq
+                changed = max(1, len(docs))
+            # the seq tracks the writer even when nothing changed
+            # locally (replayed no-ops): lag accounting and delta
+            # "since > seq -> full" behavior depend on it
+            self._seq = seq
+            if changed:
+                self._cond.notify_all()
+            self._emit(rec)  # relay topologies republish verbatim
+        return changed
+
+    def replica_reset(self, state: dict) -> None:
+        """Replace the whole view with a publisher snapshot
+        (``export_state`` shape): the follower's bootstrap, epoch
+        switch, and post-fallback resync path.  Mints a fresh ETag
+        nonce — after a reset the seq counter may move BACKWARD (a
+        restarted writer), and a strong ETag must never name two
+        representations."""
+        with self._cond:
+            self._grids.clear()
+            seq = int(state.get("seq", 0))
+            for grid, gs in (state.get("grids") or {}).items():
+                g = self._grid(grid)
+                for ws_key, cells in (gs.get("windows") or {}).items():
+                    ws = int(ws_key)
+                    w = g.windows[ws] = {}
+                    meta = (gs.get("meta") or {}).get(ws_key)
+                    if meta:
+                        g.meta[ws] = (meta[0], meta[1], meta[2])
+                    else:
+                        any_doc = next(iter(cells.values()), None)
+                        stale = (any_doc or {}).get("staleAt")
+                        g.meta[ws] = (
+                            (any_doc or {}).get("windowStart"),
+                            (any_doc or {}).get("windowEnd"),
+                            stale.timestamp() if stale is not None
+                            else None)
+                    for cid, doc in cells.items():
+                        w[cid] = doc
+                        if g.pyramid is not None:
+                            try:
+                                g.pyramid.apply(ws, int(cid, 16),
+                                                None, doc)
+                            except ValueError:
+                                g.pyramid = None
+                g.window_seq = int(gs.get("window_seq", seq))
+                g.mod_seq = int(gs.get("mod_seq", seq))
+                # the snapshot carries no changelog: anything before
+                # its seq is beyond this replica's delta horizon
+                g.dropped_seq = seq
+            self._seq = seq
+            self._nonce = os.urandom(4).hex()
+            self._cond.notify_all()
+
+    def export_state(self) -> dict:
+        """The publisher's snapshot of the whole view under ONE lock
+        acquisition (``replica_reset``'s input).  Window dicts are
+        shallow-copied — docs are replaced, never mutated in place, so
+        sharing the doc dicts with concurrent appliers is safe."""
+        with self._lock:
+            grids = {}
+            for grid, g in self._grids.items():
+                grids[grid] = {
+                    "windows": {str(ws): dict(w)
+                                for ws, w in g.windows.items()},
+                    "meta": {str(ws): list(m)
+                             for ws, m in g.meta.items()},
+                    "window_seq": g.window_seq,
+                    "mod_seq": g.mod_seq,
+                }
+            return {"seq": self._seq, "grids": grids}
+
     # ---- eviction (lazy, under the lock) -------------------------------
-    def _evict(self, g: _Grid) -> None:
+    def _evict(self, grid: str, g: _Grid) -> None:
         """Drop windows past their staleAt, mirroring the store's TTL
         index.  Evicting the LATEST window is a visible change: the seq
-        advances and delta clients resync (their baseline is gone)."""
+        advances and delta clients resync (their baseline is gone).  A
+        replica never evicts its latest window locally — that seq
+        advance arrives as the writer's feed marker (or not at all,
+        which is what its staleness SLO is for)."""
         now = self._now()
         latest_before = g.latest_ws()
         dead = [ws for ws, (_, _, stale) in g.meta.items()
                 if stale is not None and stale <= now]
+        if self._replica:
+            dead = [ws for ws in dead if ws != latest_before]
         for ws in dead:
             del g.windows[ws]
             del g.meta[ws]
@@ -336,6 +498,8 @@ class TileMatView:
             seq = self._advance()
             g.window_seq = g.mod_seq = seq
             self._cond.notify_all()
+            self._emit({"kind": "evict", "seq": seq, "grid": grid,
+                        "ws": dead})
 
     # ---- read side -----------------------------------------------------
     def known_grid(self, grid: str) -> bool:
@@ -349,7 +513,7 @@ class TileMatView:
             g = self._grids.get(grid)
             if g is None:
                 return f'"{self._nonce}.{grid}.{res}.none.0"'
-            self._evict(g)
+            self._evict(grid, g)
             return (f'"{self._nonce}.{grid}.{res}.'
                     f'{g.latest_ws()}.{g.mod_seq}"')
 
@@ -373,7 +537,7 @@ class TileMatView:
             if g is None:
                 self._check_res(None, grid, res)
                 return f'"{self._nonce}.{grid}.{res}.none.0"', None, []
-            self._evict(g)
+            self._evict(grid, g)
             ws = g.latest_ws()
             self._check_res(g, grid, res)
             etag = (f'"{self._nonce}.{grid}.{res}.'
@@ -409,7 +573,7 @@ class TileMatView:
             if g is None:
                 return {"mode": "full", "seq": self._seq,
                         "window_start": None, "docs": []}
-            self._evict(g)
+            self._evict(grid, g)
             ws = g.latest_ws()
             if ws is None:
                 return {"mode": "full", "seq": self._seq,
@@ -435,7 +599,7 @@ class TileMatView:
             g = self._grids.get(grid)
             if g is None:
                 return False
-            self._evict(g)
+            self._evict(grid, g)
             return g.mod_seq > since
 
     def wait_changed(self, grid: str, since: int, timeout: float) -> bool:
@@ -502,13 +666,33 @@ class StoreViewRefresher:
         self.poll_s = poll_s
         self._max_grids = max_grids
         self._lock = threading.Lock()
-        self._st: dict[str, tuple] = {}  # grid -> (ver, t_monotonic)
+        self._st: dict[str, tuple] = {}  # grid -> (ver, next_eligible_t)
+        self._fails: dict[str, int] = {}  # grid -> consecutive failures
+        # catch-up health for /healthz: a replica whose FIRST scan
+        # failed must report degraded, not ok-but-empty — ever_ok flips
+        # on the first successful rebuild (even of an empty store, which
+        # is a legitimate fresh deployment, not a failure)
+        self.ever_ok = False
+        self.ever_failed = False
         self._c_rebuilds = None
         if registry is not None:
             self._c_rebuilds = registry.counter(
                 "heatmap_view_rebuilds_total",
                 "serve-only materialized-view rebuild scans (store "
                 "version moved or the poll TTL lapsed)")
+
+    def health(self) -> dict:
+        """One /healthz check fragment: not-ok while the view has never
+        successfully caught up from the store AND a scan has failed —
+        the serves-empty-until-recovery window an LB must see as
+        degraded.  Steady-state transient failures keep serving the
+        bounded-stale view (ok), as before."""
+        catching_up = self.ever_failed and not self.ever_ok
+        fails = max(self._fails.values(), default=0)
+        return {"value": ("catching up" if catching_up
+                          else f"{fails} consecutive scan failures"
+                          if fails else "ok"),
+                "ok": not catching_up}
 
     def refresh(self, grid: str) -> None:
         try:
@@ -518,7 +702,15 @@ class StoreViewRefresher:
         with self._lock:
             now = time.monotonic()
             st = self._st.get(grid)
-            if (st is not None and now - st[1] < self.poll_s
+            # one guard covers both regimes: st[1] is the next-eligible
+            # deadline — poll TTL after a success, the exponential
+            # backoff deadline after a failure (retry SOONER than the
+            # TTL at first, 0.2 s doubling toward a 30 s cap: a replica
+            # must not serve empty for a full TTL because one boot-time
+            # scan flaked, nor hammer a down store at request rate).  A
+            # MOVED version bypasses either wait: the store is
+            # answering again (or changed) and a rescan is due.
+            if (st is not None and now < st[1]
                     and (ver is None or ver == st[0])):
                 return
             # claim the poll slot BEFORE scanning and scan outside the
@@ -528,7 +720,7 @@ class StoreViewRefresher:
                 # bounded against client-controlled ?grid= values; evict
                 # ONE arbitrary entry, like the serve render cache
                 self._st.pop(next(iter(self._st)))
-            self._st[grid] = (ver, now)
+            self._st[grid] = (ver, now + self.poll_s)
         try:
             ws = self.store.latest_window_start(grid)
             docs = (list(self.store.tiles_in_window(ws, grid))
@@ -537,9 +729,21 @@ class StoreViewRefresher:
         except Exception:
             # a rebuild scan is idempotent: a transient store error
             # must NOT poison the view — serve the (bounded-stale)
-            # current state and retry at the next poll slot
-            log.warning("view rebuild failed for grid %r; serving the "
-                        "last materialized state", grid, exc_info=True)
+            # current state and retry with backoff
+            with self._lock:
+                n = self._fails.get(grid, 0) + 1
+                self._fails[grid] = n
+                self.ever_failed = True
+                retry = min(30.0, 0.1 * (2 ** min(n, 9)))
+                if grid in self._st:
+                    self._st[grid] = (self._st[grid][0],
+                                      time.monotonic() + retry)
+            log.warning("view rebuild failed for grid %r (attempt %d); "
+                        "serving the last materialized state, retrying "
+                        "in %.1fs", grid, n, retry, exc_info=True)
             return
+        with self._lock:
+            self._fails.pop(grid, None)
+            self.ever_ok = True
         if self._c_rebuilds is not None:
             self._c_rebuilds.inc()

@@ -47,6 +47,12 @@ Contract parity notes (all against /root/reference/app.py):
 - POST /debug/profile → arm an on-demand ``jax.profiler`` window on
   the attached runtime (``?batches=&skip=&dir=``); 405 on non-POST,
   409 while a capture is pending/active, 503 without a runtime.
+- GET /api/repl/meta | /snapshot?epoch= | /feed?epoch=&since=&max= →
+  the view-replication feed re-exposed over HTTP (query.repl): the
+  feed header (epoch nonce, last/min seq), the epoch's catch-up
+  snapshot, and the mutation records after ``since`` — what a REMOTE
+  replica's ``HEATMAP_REPL_FEED=http://writer:port`` follower polls;
+  503 without a HEATMAP_REPL_DIR on this process.
 - GET /healthz      → SLO evaluation: ok / degraded / down from recent
   batch p50 vs HEATMAP_SLO_BATCH_P50_MS (default 500, the paper
   budget), emit freshness p50 vs HEATMAP_SLO_FRESHNESS_P50_S,
@@ -305,15 +311,28 @@ def _slo(name: str, default: float) -> float:
         return float(default)
 
 
-def healthz_payload(runtime) -> tuple[dict, bool]:
+def healthz_payload(runtime, extra_checks=None) -> tuple[dict, bool]:
     """(payload, down): SLO checks against the recent-window histogram
     quantiles and the supervisor channel.  ok -> degraded on any budget
     breach; down (serve 503) only when the pipeline cannot make
-    progress — poisoned sink or a supervisor that gave up."""
+    progress — poisoned sink or a supervisor that gave up.
+
+    ``extra_checks`` (a callable returning (checks_dict, degraded)) is
+    the serve tier's contribution: replication sync/lag on a replica,
+    store catch-up state on a serve-only worker — evaluated for the
+    HTTP endpoint AND the fleet member snapshot, so /fleet/healthz
+    degrades on a lagging replica the same way a local probe would."""
     from heatmap_tpu.obs import ENV_CHANNEL, SupervisorChannel
 
     checks: dict = {}
     degraded = down = False
+    if extra_checks is not None:
+        try:
+            ec, ec_degraded = extra_checks()
+            checks.update(ec)
+            degraded |= ec_degraded
+        except Exception:  # noqa: BLE001 - a probe bug must not 500 /healthz
+            log.exception("serve-tier healthz checks failed")
     if runtime is not None:
         m = runtime.metrics
         if m.batch_latency.count:
@@ -606,6 +625,9 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
     stats = _ServeStats(serve_reg)
     view = getattr(runtime, "matview", None) if runtime is not None else None
     refresher = None
+    follower = None
+    repl_dir = getattr(cfg, "repl_dir", "") if cfg else ""
+    repl_feed = getattr(cfg, "repl_feed", "") if cfg else ""
     if view is None and (cfg is None or getattr(cfg, "query_view", True)):
         from heatmap_tpu.query import StoreViewRefresher, TileMatView
 
@@ -617,12 +639,35 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
             delta_log=getattr(cfg, "delta_log", 4096) if cfg else 4096,
             pyramid_levels=(getattr(cfg, "pyramid_levels", 2)
                             if cfg else 2),
-            registry=serve_reg)
+            registry=serve_reg,
+            replica=bool(repl_feed))
         refresher = StoreViewRefresher(
             store, view,
             poll_s=(getattr(cfg, "view_poll_ms", 1000)
                     if cfg else 1000) / 1e3,
             registry=serve_reg)
+        if repl_feed:
+            # replicated serve fleet (query.repl): the view follows the
+            # writer's delta-log feed — zero steady-state store reads.
+            # The StoreViewRefresher above is DEMOTED to a counted,
+            # healthz-warning fallback: it runs only while the follower
+            # is unsynced or its feed has gone stale, and every request
+            # that takes that path bumps heatmap_repl_fallback_total.
+            from heatmap_tpu.query.repl import (ReplicaViewFollower,
+                                                feed_source)
+
+            follower = ReplicaViewFollower(
+                view, feed_source(repl_feed),
+                poll_s=(getattr(cfg, "repl_poll_ms", 200)
+                        if cfg else 200) / 1e3,
+                registry=serve_reg)
+            follower.start()
+        # NOTE: a serve-only app never PUBLISHES to repl_dir implicitly
+        # — only the writer process's runtime creates the publisher.
+        # HEATMAP_REPL_DIR on a serve process only re-exposes the feed
+        # at /api/repl/* (a same-host relay for remote replicas): env
+        # is often fleet-shared, and an implicit leader would boot-sweep
+        # the live writer's feed to a fresh epoch on every worker start.
     sse_max = getattr(cfg, "sse_max_clients", 64) if cfg else 64
     sse_heartbeat = getattr(cfg, "sse_heartbeat_s", 15.0) if cfg else 15.0
     sse_admit_lock = threading.Lock()
@@ -721,15 +766,57 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
             fleet_state["agg"] = FleetAggregator(chan_path)
         return fleet_state["agg"]
 
+    def _serve_checks() -> tuple[dict, bool]:
+        """The serve tier's /healthz contribution (query view state):
+        replication sync/lag/staleness on a replica, store catch-up on
+        a serve-only worker — also published in the fleet member
+        snapshot, so /fleet/healthz degrades on a lagging or stale
+        replica naming it."""
+        checks: dict = {}
+        degraded = False
+        if view is not None and view.poisoned:
+            checks["query_view"] = {"value": "poisoned", "ok": False}
+            degraded = True
+        if follower is not None:
+            fc, f_degraded = follower.healthz_checks(
+                _slo("HEATMAP_SLO_REPL_LAG_S", 10.0))
+            checks.update(fc)
+            degraded |= f_degraded
+        elif refresher is not None:
+            h = refresher.health()
+            checks["view_catchup"] = h
+            degraded |= not h["ok"]
+        return checks, degraded
+
+    healthz = functools.partial(healthz_payload, runtime,
+                                extra_checks=_serve_checks)
+
     def _tiles_view(grid: str | None):
         """The view to serve tile reads from, refreshed for serve-only
         processes; None -> fall back to direct Store renders.  A
         writer-fed view that has never seen ``grid`` (process restarted
         against a durable store) is seeded ONCE from a store scan —
         upsert-only, so racing the writer thread cannot un-expose a
-        durable row."""
+        durable row.  On a REPLICA the follower feeds the view and the
+        store-scan refresher runs only while the follower is unhealthy
+        (unsynced / stale feed) — counted, so 'zero store reads in
+        steady state' is a number, not a claim."""
         if view is None or view.poisoned:
             return None
+        if follower is not None:
+            if not follower.synced:
+                # demoted fallback: store content beats serving nothing
+                # — but ONLY while the replica has never synced.  Once
+                # a snapshot applied, a stale feed keeps serving the
+                # last replicated state: a store scan here would WIPE
+                # the feed-fed view (replicas run with empty stores in
+                # the zero-store-read topology) and fork the seq
+                # stream.  Every pass through here is an incident
+                # signal (/healthz is degraded right now too).
+                if follower.c_fallback is not None:
+                    follower.c_fallback.inc()
+                refresher.refresh(grid)
+            return view
         if refresher is not None:
             refresher.refresh(grid)
         elif grid not in seeded:
@@ -788,7 +875,13 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
             first = True
             last_beat = time.monotonic()
             while True:
-                if refresher is not None:
+                store_polling = (refresher is not None
+                                 and (follower is None
+                                      or not follower.synced))
+                if store_polling:
+                    if follower is not None \
+                            and follower.c_fallback is not None:
+                        follower.c_fallback.inc()
                     refresher.refresh(grid)
                 if view.poisoned:
                     yield b"event: gone\ndata: {}\n\n"
@@ -803,11 +896,12 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                     first = False
                     last_beat = time.monotonic()
                     continue
-                # serve-only processes must keep POLLING the store
-                # (nothing else advances the view), so their wait
-                # slices shorter than the heartbeat
-                wait_s = (1.0 if refresher is not None
-                          else sse_heartbeat)
+                # store-polling loops must keep POLLING (nothing else
+                # advances the view), so their wait slices shorter than
+                # the heartbeat; a replica's follower notifies the
+                # view's condvar, so it waits event-driven like the
+                # writer-fed case
+                wait_s = (1.0 if store_polling else sse_heartbeat)
                 view.wait_changed(grid, last,
                                   timeout=min(wait_s, sse_heartbeat))
                 if time.monotonic() - last_beat >= sse_heartbeat:
@@ -981,6 +1075,51 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                         return _not_modified(etag, endpoint)
                 extra_headers.append(("ETag", etag))
                 ctype = "application/json"
+            elif path.startswith("/api/repl/"):
+                # the replication feed over HTTP (query.repl): any
+                # process holding the feed directory re-exposes its
+                # three artifacts, so remote replicas follow over plain
+                # TCP with the same snapshot-then-tail protocol the
+                # same-host file transport uses
+                if not repl_dir:
+                    return _unavailable(
+                        "replication feed endpoints need "
+                        "HEATMAP_REPL_DIR")
+                from heatmap_tpu.query import repl as replmod
+
+                params = _qs_params(environ.get("QUERY_STRING", ""))
+                if path == "/api/repl/meta":
+                    body = json.dumps(replmod.read_meta(repl_dir))
+                elif path == "/api/repl/snapshot":
+                    epoch = params.get("epoch") or \
+                        replmod.read_meta(repl_dir).get("epoch") or ""
+                    snap = replmod.read_snapshot(repl_dir, epoch)
+                    if snap is None:
+                        start_response("404 Not Found",
+                                       [("Content-Type",
+                                         "application/json")])
+                        return [b'{"error": "no snapshot for that '
+                                b'epoch"}']
+                    body = replmod.dumps(snap)
+                elif path == "/api/repl/feed":
+                    epoch = params.get("epoch") or ""
+                    since = _qs_int(params, "since", 0, 1 << 62)
+                    max_n = _qs_int(params, "max", 512, 4096)
+                    meta = replmod.read_meta(repl_dir)
+                    recs = (replmod.read_records(repl_dir, epoch, since,
+                                                 max_n or 512)
+                            if epoch == meta.get("epoch") else [])
+                    body = replmod.dumps({
+                        "epoch": meta.get("epoch"),
+                        "last_seq": meta.get("last_seq", 0),
+                        "min_seq": meta.get("min_seq", 1),
+                        "records": recs,
+                    })
+                else:
+                    start_response("404 Not Found",
+                                   [("Content-Type", "text/plain")])
+                    return [b"not found"]
+                ctype = "application/json"
             elif path == "/metrics":
                 body = _metrics_text(runtime, serve_registry=serve_reg)
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
@@ -1139,9 +1278,10 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                     store_grids = []
                 payload = {
                     "enabled": view is not None,
-                    "mode": ("writer-fed" if refresher is None
-                             and view is not None else
-                             "store-fed" if view is not None else None),
+                    "mode": (None if view is None else
+                             "replica" if follower is not None else
+                             "writer-fed" if refresher is None else
+                             "store-fed"),
                     "poisoned": view.poisoned if view is not None else None,
                     "seq": view.seq if view is not None else None,
                     "cells": (view.cells_live()
@@ -1149,10 +1289,18 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                     "sse_clients": int(stats.sse_clients.value),
                     "store_grids": store_grids,
                 }
+                if follower is not None:
+                    payload["repl"] = {
+                        "synced": follower.synced,
+                        "epoch": follower.epoch,
+                        "applied_seq": follower.applied,
+                        "seq_lag": follower.seq_lag(),
+                        "healthy": follower.healthy(),
+                    }
                 body = json.dumps(payload)
                 ctype = "application/json"
             elif path == "/healthz":
-                payload, down = healthz_payload(runtime)
+                payload, down = healthz()
                 if down:
                     status = "503 Service Unavailable"
                 body = json.dumps(payload)
@@ -1190,6 +1338,17 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
     # the serve-only fleet member publisher (ServeFleetMember) snapshots
     # this registry; with a runtime attached it is the runtime's own
     app.serve_registry = serve_reg
+    # the member snapshot's healthz verdict includes the serve-tier
+    # checks (replication lag/sync), so /fleet/healthz degrades on a
+    # lagging replica without scraping it
+    app.healthz_fn = healthz
+    app.repl_follower = follower
+
+    def close_repl():
+        if follower is not None:
+            follower.stop()
+
+    app.close_repl = close_repl
     return app
 
 
@@ -1241,11 +1400,14 @@ class ServeFleetMember:
     start this only when ``runtime is None``."""
 
     def __init__(self, serve_registry, channel_path: str,
-                 tag: str | None = None):
+                 tag: str | None = None, healthz_fn=None):
         from heatmap_tpu.obs.xproc import ENV_FLEET_TAG
 
         self.registry = serve_registry
         self.channel_path = channel_path
+        # the app's healthz closure carries the serve-tier checks
+        # (replication sync/lag) the bare payload can't see
+        self.healthz_fn = healthz_fn or (lambda: healthz_payload(None))
         # HEATMAP_FLEET_TAG names the RUNTIME member (stream/runtime.py
         # adopts it verbatim when single-process), so a serve worker
         # composes with it rather than adopting it — otherwise a serve
@@ -1269,7 +1431,8 @@ class ServeFleetMember:
         reg = getattr(app, "serve_registry", None)
         if not chan_path or reg is None or fleet_publish_s() <= 0:
             return None
-        member = cls(reg, chan_path)
+        member = cls(reg, chan_path,
+                     healthz_fn=getattr(app, "healthz_fn", None))
         member.start()
         return member
 
@@ -1290,7 +1453,7 @@ class ServeFleetMember:
         from heatmap_tpu.obs.xproc import publish_member_snapshot
 
         try:
-            payload, _down = healthz_payload(None)
+            payload, _down = self.healthz_fn()
             publish_member_snapshot(
                 self.channel_path, self.tag, role="serve",
                 metrics_text=self.registry.expose_text(),
@@ -1329,6 +1492,9 @@ def serve_forever(store: Store, cfg=None, runtime=None,
     finally:
         if member is not None:
             member.stop()
+        close_repl = getattr(httpd.get_app(), "close_repl", None)
+        if close_repl is not None:
+            close_repl()
 
 
 def start_background(store: Store, cfg=None, runtime=None,

@@ -170,6 +170,22 @@ class MicroBatchRuntime:
                 delta_log=cfg.delta_log,
                 pyramid_levels=cfg.pyramid_levels,
                 registry=self.metrics.registry)
+        # Delta-log view replication (query.repl): with HEATMAP_REPL_DIR
+        # set, every view mutation the writer thread applies is
+        # published to the feed, so serve-only replicas
+        # (HEATMAP_REPL_FEED) hold a hot seq-consistent copy with zero
+        # steady-state store reads.  Only the SELF-OWNED view publishes
+        # here — an externally shared fan-in view gets one publisher
+        # from whoever owns it, never one per shard.
+        self.repl_pub = None
+        if self.matview is not None and view is None and cfg.repl_dir:
+            from heatmap_tpu.query.repl import DeltaLogPublisher
+
+            self.repl_pub = DeltaLogPublisher(
+                self.matview, cfg.repl_dir,
+                seg_bytes=cfg.repl_seg_bytes,
+                segments=cfg.repl_segments,
+                registry=self.metrics.registry)
         self.writer = AsyncWriter(store, metrics=self.metrics,
                                   view=self.matview)
         self.tracer = Tracer()
@@ -2016,12 +2032,21 @@ class MicroBatchRuntime:
             try:
                 self.source.close()
             finally:
-                self.writer.close()
-                # release the runtime-frozen engine policy globals (r5
-                # review): standalone merge_batch/bench callers in this
-                # process get the documented live-bank consult back
-                # instead of inheriting this runtime's snapshot forever
-                from heatmap_tpu.engine import step as engine_step
+                try:
+                    self.writer.close()
+                finally:
+                    # AFTER the writer close: every view apply has run
+                    # by now, so the final feed flush + closed-meta
+                    # marker cover the run's full mutation stream even
+                    # when the writer close raised (poisoned)
+                    if self.repl_pub is not None:
+                        self.repl_pub.close()
+                    # release the runtime-frozen engine policy globals
+                    # (r5 review): standalone merge_batch/bench callers
+                    # in this process get the documented live-bank
+                    # consult back instead of inheriting this runtime's
+                    # snapshot forever
+                    from heatmap_tpu.engine import step as engine_step
 
-                engine_step.SNAP_IMPL = None
-                engine_step.MERGE_BANK_PIN = engine_step._BANK_LIVE
+                    engine_step.SNAP_IMPL = None
+                    engine_step.MERGE_BANK_PIN = engine_step._BANK_LIVE

@@ -223,3 +223,88 @@ def test_repo_artifacts_parse():
     arts = m.newest_pair(m.REPO)
     assert arts, "repo should carry BENCH_r*.json artifacts"
     assert any(v is not None and v > 0 for _, _, v in arts)
+
+
+# ------------------------------------------------- serve-tier artifacts
+def _write_serve(dir_path, rnd, p99=100.0, wire=1_000_000, replicas=None,
+                 rc=0, soak=True):
+    art = {"rc": rc}
+    sec = {"p99_ms": p99, "bytes_sent_wire": wire}
+    if soak:
+        if replicas is not None:
+            sec["replicas"] = replicas
+        art["soak"] = sec
+    else:
+        art["concurrent"] = {"delta": sec}
+        if replicas is not None:
+            art["repl"] = {"replicas": replicas}
+    p = dir_path / f"BENCH_SERVE_r{rnd:02d}.json"
+    p.write_text(json.dumps(art))
+    return p
+
+
+def test_serve_ok_within_threshold(tmp_path, capsys):
+    mod = _load()
+    _write_serve(tmp_path, 1, p99=100.0, wire=1_000_000, replicas=2)
+    _write_serve(tmp_path, 2, p99=120.0, wire=1_100_000, replicas=2)
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "serve r01" in out and "+20.0%" in out
+
+
+def test_serve_p99_regression_fails(tmp_path, capsys):
+    mod = _load()
+    _write_serve(tmp_path, 1, p99=100.0, wire=1_000_000, replicas=2)
+    _write_serve(tmp_path, 2, p99=200.0, wire=1_000_000, replicas=2)
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    assert "p99_ms" in capsys.readouterr().err
+
+
+def test_serve_wire_bytes_regression_fails(tmp_path, capsys):
+    mod = _load()
+    _write_serve(tmp_path, 1, p99=100.0, wire=1_000_000, replicas=2)
+    _write_serve(tmp_path, 2, p99=100.0, wire=2_000_000, replicas=2)
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    assert "bytes_sent_wire" in capsys.readouterr().err
+
+
+def test_serve_mixed_replica_count_refused(tmp_path, capsys):
+    """A 4-replica fleet's numbers cannot stand in for a 1-replica
+    round — mixed pairs are refused outright, mirroring the
+    backend/shards logic."""
+    mod = _load()
+    _write_serve(tmp_path, 1, p99=100.0, wire=1_000_000, replicas=1)
+    _write_serve(tmp_path, 2, p99=100.0, wire=1_000_000, replicas=4)
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    err = capsys.readouterr().err
+    assert "replica-count mismatch" in err
+    assert "r01" in err and "r02" in err
+
+
+def test_serve_pre_repl_artifact_comparable(tmp_path):
+    """Non-soak artifacts (the concurrent delta block, no replica
+    stamp) stay comparable — like pre-provenance BENCH_r artifacts."""
+    mod = _load()
+    _write_serve(tmp_path, 1, p99=100.0, wire=1_000_000, soak=False)
+    _write_serve(tmp_path, 2, p99=110.0, wire=900_000, replicas=3)
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_serve_failed_run_skipped(tmp_path, capsys):
+    mod = _load()
+    _write_serve(tmp_path, 1, p99=100.0, wire=1_000_000, replicas=2)
+    _write_serve(tmp_path, 2, p99=9999.0, wire=9_999_999, replicas=2,
+                 rc=1)  # broken run: fails its own gate, not this one
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    assert "skipping serve r02" in capsys.readouterr().out
+
+
+def test_serve_and_bench_gates_compose(tmp_path, capsys):
+    """A serve regression fails the run even when the BENCH_r pair is
+    green (and vice versa the refusals already pin)."""
+    mod = _load()
+    _write(tmp_path, 1, value=1000.0)
+    _write(tmp_path, 2, value=990.0)
+    _write_serve(tmp_path, 1, p99=100.0, wire=1_000_000, replicas=2)
+    _write_serve(tmp_path, 2, p99=500.0, wire=1_000_000, replicas=2)
+    assert mod.main(["--dir", str(tmp_path)]) == 1

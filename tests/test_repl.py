@@ -1,0 +1,677 @@
+"""Replicated serve fleet (query.repl): delta-log view replication.
+
+The acceptance property is BYTE-INTERCHANGEABILITY: a replica following
+the writer's feed serves /api/tiles/latest and /api/tiles/delta?since=0
+byte-identical to the writer-fed view — across window advance, staleAt
+eviction, and a writer restart (the epoch nonce rejects the stale
+tail) — with METRIC-ASSERTED zero store reads in steady state (the
+store-scan fallback and rebuild counters stay 0)."""
+
+import datetime as dt
+import importlib.util
+import json
+import os
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from heatmap_tpu import hexgrid
+from heatmap_tpu.config import load_config
+from heatmap_tpu.query import TileMatView
+from heatmap_tpu.query.repl import (
+    DeltaLogPublisher,
+    FileFeedSource,
+    HttpFeedSource,
+    ReplicaViewFollower,
+    read_meta,
+)
+from heatmap_tpu.serve import start_background
+from heatmap_tpu.sink import MemoryStore
+from heatmap_tpu.sink.base import TileDoc, UTC
+
+
+def _doc(cell, ws, count, speed=30.0, grid="h3r8", ttl_minutes=45):
+    return TileDoc("bos", 8, cell, ws, ws + dt.timedelta(minutes=5),
+                   count=count, avg_speed_kmh=speed, avg_lat=42.3,
+                   avg_lon=-71.05, ttl_minutes=ttl_minutes, grid=grid)
+
+
+def _cells(n, res=8, lat0=42.30):
+    out = []
+    for i in range(n * 3):
+        c = hexgrid.latlng_to_cell(lat0 + i * 7e-3, -71.05, res)
+        if c not in out:
+            out.append(c)
+        if len(out) == n:
+            break
+    assert len(out) == n
+    return out
+
+
+def _render(view, grid="h3r8"):
+    from heatmap_tpu.serve.api import _features_collection_json
+
+    return _features_collection_json(view.latest_docs(grid)[1])
+
+
+def _delta_json(view, since, grid="h3r8"):
+    return json.dumps(view.delta(grid, since), default=str)
+
+
+def _drain(pub, fol):
+    pub.flush()
+    while fol.step():
+        pass
+
+
+# ------------------------------------------------------------ view level
+def test_replica_follows_feed_byte_identical_incl_eviction(tmp_path):
+    """Writer applies + window advance + latest-window eviction (fake
+    clock) all replicate seq-exactly; renders and delta responses are
+    byte-identical at every checkpoint."""
+    clock = {"t": 1_900_000_000.0}
+    w = TileMatView(now_fn=lambda: clock["t"])
+    pub = DeltaLogPublisher(w, str(tmp_path), start=False)
+    r = TileMatView(replica=True, now_fn=lambda: clock["t"])
+    fol = ReplicaViewFollower(r, FileFeedSource(str(tmp_path)))
+    base = dt.datetime.fromtimestamp(clock["t"], UTC)
+    ws1 = base - dt.timedelta(minutes=10)
+    ws2 = base - dt.timedelta(minutes=5)
+    cells = _cells(4)
+
+    def check():
+        assert r.seq == w.seq
+        assert _render(w) == _render(r)
+        for since in (0, 1, 2, w.seq):
+            assert _delta_json(w, since) == _delta_json(r, since)
+
+    w.apply_docs([_doc(cells[0], ws1, 1, ttl_minutes=6),
+                  _doc(cells[1], ws1, 2, ttl_minutes=6)])
+    _drain(pub, fol)
+    check()
+    # same-window update + window advance
+    w.apply_docs([_doc(cells[0], ws1, 7, ttl_minutes=6)])
+    w.apply_docs([_doc(cells[2], ws2, 3, ttl_minutes=6)])
+    _drain(pub, fol)
+    check()
+    # latest-window eviction: writer's lazy evict advances seq and
+    # publishes the marker; the replica applies it instead of running
+    # its own clock-driven latest eviction
+    clock["t"] += 12 * 60
+    w.etag("h3r8")
+    _drain(pub, fol)
+    check()
+    assert w.latest_docs("h3r8")[1] == [] == r.latest_docs("h3r8")[1]
+    assert fol.synced and fol.seq_lag() == 0 and fol.healthy()
+
+
+def test_snapshot_catchup_after_rotation(tmp_path):
+    """A follower arriving after the log rotated past seq 0 bootstraps
+    from the rotation snapshot, then tails — and lands byte-identical
+    with exactly one snapshot load."""
+    from heatmap_tpu.obs.registry import Registry
+
+    w = TileMatView()
+    pub = DeltaLogPublisher(w, str(tmp_path), seg_bytes=4096, segments=2,
+                            start=False)
+    ws = dt.datetime.now(UTC).replace(microsecond=0) - \
+        dt.timedelta(minutes=2)
+    for i in range(60):
+        w.apply_docs([_doc(f"8a2a1072b59f{i:03x}", ws, i + 1)])
+        pub.flush()
+    meta = read_meta(str(tmp_path))
+    assert meta["min_seq"] > 1  # seq 1 really is gone from the log
+    reg = Registry()
+    r = TileMatView(replica=True)
+    fol = ReplicaViewFollower(r, FileFeedSource(str(tmp_path)),
+                              registry=reg)
+    while fol.step():
+        pass
+    assert r.seq == w.seq == 60
+    assert _render(w) == _render(r)
+    text = reg.expose_text()
+    assert "heatmap_repl_snapshot_loads_total 1" in text
+    assert "heatmap_repl_seq_lag 0" in text
+    assert "heatmap_repl_synced 1" in text
+
+
+def test_writer_restart_epoch_rejects_stale_tail(tmp_path):
+    """A restarted writer mints a fresh epoch: its publisher sweeps the
+    old epoch's artifacts, and a live follower discards EVERYTHING it
+    held and re-bootstraps — the old epoch's seqs can never splice
+    into the new feed even though both start from 1."""
+    import glob as _glob
+
+    w1 = TileMatView()
+    pub1 = DeltaLogPublisher(w1, str(tmp_path), start=False)
+    ws = dt.datetime.now(UTC).replace(microsecond=0) - \
+        dt.timedelta(minutes=2)
+    cells = _cells(3)
+    w1.apply_docs([_doc(cells[0], ws, 1), _doc(cells[1], ws, 2)])
+    pub1.flush()
+    r = TileMatView(replica=True)
+    fol = ReplicaViewFollower(r, FileFeedSource(str(tmp_path)))
+    _drain(pub1, fol)
+    assert r.seq == 1 and fol.epoch == pub1.epoch
+    pub1.close()
+    assert read_meta(str(tmp_path)).get("closed") is True
+    # restart: DIFFERENT content, same seq numbers
+    w2 = TileMatView()
+    pub2 = DeltaLogPublisher(w2, str(tmp_path), start=False)
+    w2.apply_docs([_doc(cells[2], ws, 9)])
+    pub2.flush()
+    # the stale epoch's tail is gone from disk
+    assert not [p for p in _glob.glob(str(tmp_path / "seg-*"))
+                if pub1.epoch in p]
+    fol.step()
+    assert fol.epoch == pub2.epoch
+    assert r.seq == w2.seq == 1
+    assert _render(w2) == _render(r)  # NOT the old epoch's seq-1 state
+
+
+def test_follower_behind_pruned_horizon_resyncs(tmp_path):
+    """A follower that stalls past the retained segments re-bootstraps
+    from the snapshot instead of silently skipping the pruned seqs."""
+    w = TileMatView()
+    pub = DeltaLogPublisher(w, str(tmp_path), seg_bytes=4096, segments=1,
+                            start=False)
+    ws = dt.datetime.now(UTC).replace(microsecond=0) - \
+        dt.timedelta(minutes=2)
+    w.apply_docs([_doc("8a2a1072b59f001", ws, 1)])
+    pub.flush()
+    r = TileMatView(replica=True)
+    fol = ReplicaViewFollower(r, FileFeedSource(str(tmp_path)))
+    fol.step()
+    assert r.seq == 1
+    # the follower stalls while the writer churns the log past it
+    for i in range(60):
+        w.apply_docs([_doc(f"8a2a1072b59f{i:03x}", ws, i + 2)])
+        pub.flush()
+    assert read_meta(str(tmp_path))["min_seq"] > 2
+    with pytest.raises(OSError):
+        fol.step()  # detects the horizon overrun...
+    fol.step()      # ...and the next round re-bootstraps
+    assert r.seq == w.seq
+    assert _render(w) == _render(r)
+
+
+# ----------------------------------------------------- serve integration
+def _get(url, hdrs=None):
+    req = urllib.request.Request(url)
+    for k, v in (hdrs or {}).items():
+        req.add_header(k, v)
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def _wait_synced(httpd, want_seq=None, timeout=15.0):
+    fol = httpd.get_app().repl_follower
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fol.synced and fol.seq_lag() == 0 and \
+                (want_seq is None or fol.applied == want_seq):
+            return fol
+        time.sleep(0.02)
+    raise AssertionError(
+        f"replica never caught up (synced={fol.synced}, "
+        f"applied={fol.applied}, want={want_seq})")
+
+
+class _CountingStore(MemoryStore):
+    """MemoryStore that counts read-path calls — the zero-store-read
+    assertion is a number, not a log grep."""
+
+    def __init__(self):
+        super().__init__()
+        self.reads = 0
+
+    def latest_window_start(self, grid=None):
+        self.reads += 1
+        return super().latest_window_start(grid)
+
+    def tiles_in_window(self, start, grid=None):
+        self.reads += 1
+        return super().tiles_in_window(start, grid)
+
+
+def test_http_feed_endpoints_and_remote_follower(tmp_path):
+    """The writer-side serve app re-exposes the feed at /api/repl/*;
+    a remote follower over the TCP transport lands byte-identical."""
+    w = TileMatView()
+    pub = DeltaLogPublisher(w, str(tmp_path), start=False)
+    ws = dt.datetime.now(UTC).replace(microsecond=0) - \
+        dt.timedelta(minutes=2)
+    cells = _cells(3)
+    w.apply_docs([_doc(c, ws, i + 1) for i, c in enumerate(cells)])
+    pub.flush()
+    cfg = load_config({}, serve_port=0, repl_dir=str(tmp_path))
+    httpd, _t, port = start_background(MemoryStore(), cfg, port=0)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        _, _, b = _get(base + "/api/repl/meta")
+        meta = json.loads(b)
+        assert meta["epoch"] == pub.epoch and meta["last_seq"] == 1
+        _, _, b = _get(base + f"/api/repl/snapshot?epoch={pub.epoch}")
+        assert json.loads(b)["epoch"] == pub.epoch
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/api/repl/snapshot?epoch=deadbeef")
+        assert ei.value.code == 404
+        r = TileMatView(replica=True)
+        fol = ReplicaViewFollower(r, HttpFeedSource(base))
+        while fol.step():
+            pass
+        assert r.seq == w.seq
+        assert _render(w) == _render(r)
+    finally:
+        httpd.shutdown()
+    # without a feed dir the endpoints answer 503, not garbage
+    httpd2, _t2, port2 = start_background(
+        MemoryStore(), load_config({}, serve_port=0), port=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"http://127.0.0.1:{port2}/api/repl/meta")
+        assert ei.value.code == 503
+    finally:
+        httpd2.shutdown()
+
+
+def _mini_runtime(tmpdir, events, **cfg_over):
+    from heatmap_tpu.stream import MicroBatchRuntime
+    from heatmap_tpu.stream.source import MemorySource
+
+    cfg = load_config({}, batch_size=16, state_capacity_log2=8,
+                      speed_hist_bins=4, store="memory", serve_port=0,
+                      checkpoint_dir=tempfile.mkdtemp(dir=tmpdir),
+                      **cfg_over)
+    src = MemorySource(events)
+    st = MemoryStore()
+    rt = MicroBatchRuntime(cfg, src, st, checkpoint_every=0)
+    return cfg, src, st, rt
+
+
+def _evs(n, t0, lat0=42.0):
+    return [{"provider": "p", "vehicleId": f"v{i}", "lat": lat0 + i * 1e-3,
+             "lon": -71.0, "speedKmh": 10.0 + i, "ts": t0 + i}
+            for i in range(n)]
+
+
+def test_runtime_replica_differential_with_writer_restart(tmp_path):
+    """ACCEPTANCE: a replica following the streaming writer's feed
+    serves /api/tiles/latest + /api/tiles/delta?since=0 byte-identical
+    to the writer, across window advance AND a writer restart — and
+    with zero store reads (the replica's store counts ZERO read calls;
+    its fallback/rebuild counters stay 0)."""
+    feed = tempfile.mkdtemp(dir=str(tmp_path))
+    t0 = int(time.time()) - 900
+    cfg, src, st, rt = _mini_runtime(str(tmp_path), [], repl_dir=feed)
+    w_httpd, _t, w_port = start_background(st, cfg, runtime=rt, port=0)
+    r_store = _CountingStore()
+    cfg_r = load_config({}, serve_port=0, repl_feed=feed,
+                        repl_poll_ms=20)
+    r_httpd, _t2, r_port = start_background(r_store, cfg_r, port=0)
+
+    def compare():
+        for path in ("/api/tiles/latest", "/api/tiles/delta?since=0"):
+            _, _, a = _get(f"http://127.0.0.1:{w_port}{path}")
+            _, _, b = _get(f"http://127.0.0.1:{r_port}{path}")
+            assert a == b, f"replica diverged on {path}"
+        return a
+
+    try:
+        # segment 1 + a segment crossing into a NEW 5-min window
+        for seg, (n, ts) in enumerate([(32, t0), (32, t0 + 600)]):
+            src.push(_evs(n, ts, lat0=42.0 + seg * 0.01))
+            while rt.step_once():
+                pass
+            rt.flush_pending()
+            rt.writer.drain()
+            rt.repl_pub.flush()
+            _wait_synced(r_httpd, want_seq=rt.matview.seq)
+            body = compare()
+            assert json.loads(body)["features"]
+        # ---- writer restart: new runtime (fresh view + epoch), same
+        # durable store; the replica must discard the old epoch and
+        # converge on the new writer's state
+        rt.close()
+        w_httpd.shutdown()
+        cfg2, src2, _st2, rt2 = _mini_runtime(str(tmp_path), [],
+                                              repl_dir=feed)
+        rt2.store = st  # same durable store
+        rt2.writer.store = st
+        w_httpd2, _t3, w_port2 = start_background(st, cfg2, runtime=rt2,
+                                                  port=0)
+        w_port = w_port2
+        try:
+            src2.push(_evs(24, t0 + 660, lat0=42.05))
+            while rt2.step_once():
+                pass
+            rt2.flush_pending()
+            rt2.writer.drain()
+            rt2.repl_pub.flush()
+            fol = _wait_synced(r_httpd, want_seq=rt2.matview.seq)
+            assert fol.epoch == rt2.repl_pub.epoch
+            body = compare()
+            assert json.loads(body)["features"]
+        finally:
+            w_httpd2.shutdown()
+            rt2.close()
+        # ---- metric-asserted zero store reads on the replica
+        assert r_store.reads == 0
+        _, _, mt = _get(f"http://127.0.0.1:{r_port}/metrics")
+        text = mt.decode()
+        assert "heatmap_repl_fallback_total 0" in text
+        assert "heatmap_view_rebuilds_total 0" in text
+        assert "heatmap_repl_synced 1" in text
+        # and the replica's healthz is green with the repl checks in it
+        _, _, hz = _get(f"http://127.0.0.1:{r_port}/healthz")
+        payload = json.loads(hz)
+        assert payload["status"] == "ok"
+        assert payload["checks"]["repl_synced"]["ok"] is True
+        assert payload["checks"]["repl_lag_s"]["ok"] is True
+    finally:
+        r_httpd.shutdown()
+        r_httpd.get_app().close_repl()
+
+
+def test_replica_sse_and_topk_work_from_feed(tmp_path):
+    """The replica's whole serving surface runs off the feed: SSE
+    pushes fire when the follower applies new records (no store
+    polling), and topk serves from the replicated view."""
+    import socket
+
+    w = TileMatView()
+    pub = DeltaLogPublisher(w, str(tmp_path), flush_s=0.02)
+    ws = dt.datetime.now(UTC).replace(microsecond=0) - \
+        dt.timedelta(minutes=2)
+    cells = _cells(3)
+    w.apply_docs([_doc(cells[0], ws, 5)])
+    cfg_r = load_config({}, serve_port=0, repl_feed=str(tmp_path),
+                        repl_poll_ms=20,
+                        sse_heartbeat_s=0.3)
+    httpd, _t, port = start_background(MemoryStore(), cfg_r, port=0)
+    try:
+        # pin the seq: the boot snapshot alone (seq 0) already counts
+        # as synced, and an SSE client admitted before the first apply
+        # lands would see an extra empty full-sync event
+        _wait_synced(httpd, want_seq=w.seq)
+        sk = socket.create_connection(("127.0.0.1", port), timeout=10)
+        sk.sendall(b"GET /api/tiles/stream?since=0 HTTP/1.0\r\n\r\n")
+        sk.settimeout(10)
+        buf = b""
+        while buf.count(b"event: tiles") < 1:
+            buf += sk.recv(65536)
+        assert b'"mode": "full"' in buf
+        w.apply_docs([_doc(cells[1], ws, 9)])  # writer side
+        while buf.count(b"event: tiles") < 2:
+            buf += sk.recv(65536)
+        assert cells[1].encode() in buf
+        sk.close()
+        _, _, b = _get(f"http://127.0.0.1:{port}/api/tiles/topk?k=1")
+        top = json.loads(b)["features"]
+        assert len(top) == 1
+        assert top[0]["properties"]["cellId"] == cells[1]  # count 9 > 5
+        _, _, b = _get(f"http://127.0.0.1:{port}/debug/view")
+        dv = json.loads(b)
+        assert dv["mode"] == "replica" and dv["repl"]["synced"]
+    finally:
+        httpd.shutdown()
+        httpd.get_app().close_repl()
+        pub.close()
+
+
+def test_unsynced_replica_falls_back_counted_and_degraded(tmp_path):
+    """A replica whose feed never materializes serves the store through
+    the DEMOTED fallback: content still flows, the fallback counter
+    moves, and /healthz reports degraded (repl_synced false) — never
+    ok-but-empty."""
+    st = MemoryStore()
+    ws = dt.datetime.now(UTC).replace(microsecond=0) - \
+        dt.timedelta(minutes=2)
+    cells = _cells(2)
+    st.upsert_tiles([_doc(cells[0], ws, 3)])
+    empty_feed = tempfile.mkdtemp(dir=str(tmp_path))  # no meta ever
+    cfg = load_config({}, serve_port=0, repl_feed=empty_feed,
+                      repl_poll_ms=20)
+    httpd, _t, port = start_background(st, cfg, port=0)
+    try:
+        _, _, b = _get(f"http://127.0.0.1:{port}/api/tiles/latest")
+        fc = json.loads(b)
+        assert {f["properties"]["cellId"] for f in fc["features"]} == \
+            {cells[0]}
+        _, _, hz = _get(f"http://127.0.0.1:{port}/healthz")
+        payload = json.loads(hz)
+        assert payload["status"] == "degraded"
+        assert payload["checks"]["repl_synced"]["ok"] is False
+        _, _, mt = _get(f"http://127.0.0.1:{port}/metrics")
+        text = mt.decode()
+        lines = [ln for ln in text.splitlines()
+                 if ln.startswith("heatmap_repl_fallback_total")]
+        assert lines and float(lines[0].split()[-1]) > 0
+    finally:
+        httpd.shutdown()
+        httpd.get_app().close_repl()
+
+
+def test_failed_seed_scan_keeps_healthz_degraded_until_catchup():
+    """r9 satellite: a serve-only worker whose initial store scan fails
+    must answer /healthz degraded (not ok-but-empty) and retry with
+    backoff; the first successful rebuild clears the check."""
+    class FlakyStore(MemoryStore):
+        def __init__(self):
+            super().__init__()
+            self.fail = True
+
+        def latest_window_start(self, grid=None):
+            if self.fail:
+                raise IOError("injected boot-time store outage")
+            return super().latest_window_start(grid)
+
+    st = FlakyStore()
+    ws = dt.datetime.now(UTC).replace(microsecond=0) - \
+        dt.timedelta(minutes=2)
+    (cell,) = _cells(1)
+    st.fail = False
+    st.upsert_tiles([_doc(cell, ws, 4)])
+    st.fail = True
+    cfg = load_config({"HEATMAP_VIEW_POLL_MS": "40"}, serve_port=0)
+    httpd, _t, port = start_background(st, cfg, port=0)
+    try:
+        _, _, b = _get(f"http://127.0.0.1:{port}/api/tiles/latest")
+        assert json.loads(b)["features"] == []  # store down: empty...
+        _, _, hz = _get(f"http://127.0.0.1:{port}/healthz")
+        payload = json.loads(hz)
+        # ...but NOT ok-but-empty: the catch-up check is failing
+        assert payload["status"] == "degraded"
+        assert payload["checks"]["view_catchup"]["ok"] is False
+        st.fail = False
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            _, _, b = _get(f"http://127.0.0.1:{port}/api/tiles/latest")
+            if json.loads(b)["features"]:
+                break
+            time.sleep(0.05)  # backoff retry: recovers without a poke
+        assert json.loads(b)["features"]
+        _, _, hz = _get(f"http://127.0.0.1:{port}/healthz")
+        payload = json.loads(hz)
+        assert payload["status"] == "ok"
+        assert payload["checks"]["view_catchup"]["ok"] is True
+    finally:
+        httpd.shutdown()
+
+
+# -------------------------------------------------------- fleet surfaces
+def test_fleet_healthz_degrades_on_unsynced_replica(tmp_path,
+                                                    monkeypatch):
+    """The replica's member snapshot carries its serve-tier healthz
+    verdict, so /fleet/healthz degrades NAMING the lagging/unsynced
+    replica without scraping it."""
+    from heatmap_tpu.obs.fleet import FleetAggregator
+    from heatmap_tpu.obs.xproc import ENV_CHANNEL
+    from heatmap_tpu.serve.api import ServeFleetMember, make_wsgi_app
+
+    chan = str(tmp_path / "chan.json")
+    monkeypatch.setenv(ENV_CHANNEL, chan)
+    empty_feed = tempfile.mkdtemp(dir=str(tmp_path))
+    cfg = load_config({}, serve_port=0, repl_feed=empty_feed,
+                      repl_poll_ms=20)
+    app = make_wsgi_app(MemoryStore(), cfg)
+    member = ServeFleetMember(app.serve_registry, chan, tag="replica0",
+                              healthz_fn=app.healthz_fn)
+    try:
+        member.publish()
+        agg = FleetAggregator(chan)
+        payload, down = agg.healthz()
+        assert not down
+        assert payload["status"] == "degraded"
+        chk = payload["checks"]["member_replica0"]
+        assert chk["ok"] is False
+        assert "repl_synced" in chk.get("failing", [])
+        # and the federated exposition carries the replica's lag gauge
+        # under its proc label (what obs_top --fleet renders)
+        text = agg.metrics_text()
+        assert 'heatmap_repl_synced{proc="replica0"} 0' in text
+    finally:
+        app.close_repl()
+
+
+def _load_tool(name):
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        os.pardir))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(repo, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_obs_top_fleet_renders_serve_replica_rows():
+    """obs_top --fleet: serve-role members get the replica table —
+    seq lag, SSE clients, 304 ratio — plus the fleet max-lag line."""
+    top = _load_tool("obs_top")
+    text = """\
+heatmap_fleet_members 2
+heatmap_fleet_member_up{proc="serve1",role="serve"} 1
+heatmap_fleet_member_up{proc="serve2",role="serve"} 1
+heatmap_repl_seq_lag{proc="serve1"} 0
+heatmap_repl_seq_lag{proc="serve2"} 7
+heatmap_serve_sse_clients{proc="serve1"} 12
+heatmap_serve_sse_clients{proc="serve2"} 3
+heatmap_serve_304_total{proc="serve1",endpoint="tiles"} 75
+heatmap_serve_renders_total{proc="serve1",endpoint="tiles"} 25
+heatmap_serve_renders_total{proc="serve2",endpoint="tiles"} 10
+"""
+    m = top.parse_prom(text)
+    frame = top.render_fleet_frame(m, None, 0.0, {"status": "ok",
+                                                  "checks": {}})
+    assert "serve1" in frame and "serve2" in frame
+    assert "75.0 %" in frame      # serve1: 75 of 100 answered 304
+    assert "0.0 %" in frame       # serve2: renders only
+    assert "repl max seq lag 7" in frame
+    assert "replicas 2" in frame
+
+
+def test_repl_stamp_reads_member_lag(tmp_path, monkeypatch):
+    from heatmap_tpu.obs.fleet import repl_stamp
+    from heatmap_tpu.obs.xproc import ENV_CHANNEL, publish_member_snapshot
+
+    chan = str(tmp_path / "chan.json")
+    monkeypatch.setenv(ENV_CHANNEL, chan)
+    assert repl_stamp() == {}  # nothing on the channel yet
+    publish_member_snapshot(
+        chan, "serve1", role="serve",
+        metrics_text="# TYPE heatmap_repl_seq_lag gauge\n"
+                     "heatmap_repl_seq_lag 0\n")
+    publish_member_snapshot(
+        chan, "serve2", role="serve",
+        metrics_text="# TYPE heatmap_repl_seq_lag gauge\n"
+                     "heatmap_repl_seq_lag 5\n")
+    publish_member_snapshot(chan, "p0", role="runtime",
+                            metrics_text="")  # no follower: not counted
+    assert repl_stamp() == {"repl": {"replicas": 2, "max_seq_lag": 5}}
+
+
+def test_stale_feed_keeps_serving_replicated_state(tmp_path, monkeypatch):
+    """r8 review finding pinned: once a replica has synced, a feed
+    going dark must NOT trigger the store-scan fallback — with the
+    zero-store-read topology (empty store) a fallback scan would WIPE
+    the replicated view to empty.  The replica keeps serving the last
+    replicated state (bounded-stale) and /healthz degrades on feed
+    age; the fallback/rebuild counters stay 0."""
+    import shutil
+
+    from heatmap_tpu.obs.xproc import ENV_FLEET_MAX_AGE
+
+    monkeypatch.setenv(ENV_FLEET_MAX_AGE, "0.3")
+    feed = tempfile.mkdtemp(dir=str(tmp_path))
+    w = TileMatView()
+    pub = DeltaLogPublisher(w, feed, start=False)
+    ws = dt.datetime.now(UTC).replace(microsecond=0) - \
+        dt.timedelta(minutes=2)
+    (cell,) = _cells(1)
+    w.apply_docs([_doc(cell, ws, 6)])
+    pub.flush()
+    cfg = load_config({}, serve_port=0, repl_feed=feed, repl_poll_ms=20)
+    httpd, _t, port = start_background(MemoryStore(), cfg, port=0)
+    try:
+        _wait_synced(httpd, want_seq=1)
+        # the writer vanishes: no heartbeats, meta gone
+        shutil.rmtree(feed)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            _, _, hz = _get(f"http://127.0.0.1:{port}/healthz")
+            if json.loads(hz)["status"] == "degraded":
+                break
+            time.sleep(0.05)
+        payload = json.loads(hz)
+        assert payload["status"] == "degraded"
+        # content survives: the replicated state keeps serving
+        _, _, b = _get(f"http://127.0.0.1:{port}/api/tiles/latest")
+        assert {f["properties"]["cellId"]
+                for f in json.loads(b)["features"]} == {cell}
+        _, _, mt = _get(f"http://127.0.0.1:{port}/metrics")
+        text = mt.decode()
+        assert "heatmap_repl_fallback_total 0" in text
+        assert "heatmap_view_rebuilds_total 0" in text
+    finally:
+        httpd.shutdown()
+        httpd.get_app().close_repl()
+        pub.close()
+
+
+# ------------------------------------------------------------ soak smoke
+def test_bench_serve_soak_smoke():
+    """A miniature --soak run completes green: replicas sync, lag stays
+    inside the SLO, and the zero-store-read counters hold at 0."""
+    bench = _load_tool("bench_serve")
+    out = bench.run_soak(n_tiles=60, replicas=2, clients=40,
+                         duration_s=1.5, workers=4, sse_n=2,
+                         mutate_ms=200.0)
+    assert out["replicas"] == 2 and out["replicas_synced"] == 2
+    assert out["requests"] > 0 and out["errors"] == 0
+    assert out["sse_events"] >= 2          # every SSE got its full sync
+    assert out["zero_store_reads"] is True
+    assert out["store_scan_fallbacks"] == 0 and out["view_rebuilds"] == 0
+    assert out["repl_lag_ok"] is True
+    assert out["max_repl_lag_s"] <= out["slo_repl_lag_s"]
+    assert out["p99_ms"] > 0 and out["bytes_sent_wire"] > 0
+
+
+# ------------------------------------------------------------ config
+def test_repl_config_validation():
+    with pytest.raises(ValueError):
+        load_config({}, repl_seg_bytes=100)
+    with pytest.raises(ValueError):
+        load_config({}, repl_segments=0)
+    with pytest.raises(ValueError):
+        load_config({}, repl_poll_ms=1)
+    cfg = load_config({"HEATMAP_REPL_DIR": "/tmp/x",
+                       "HEATMAP_REPL_FEED": "http://h:1",
+                       "HEATMAP_REPL_SEG_BYTES": "8192",
+                       "HEATMAP_REPL_SEGMENTS": "3",
+                       "HEATMAP_REPL_POLL_MS": "100"})
+    assert (cfg.repl_dir, cfg.repl_feed) == ("/tmp/x", "http://h:1")
+    assert (cfg.repl_seg_bytes, cfg.repl_segments,
+            cfg.repl_poll_ms) == (8192, 3, 100)

@@ -1197,3 +1197,89 @@ def test_fleet_healthz_503_when_fleet_down(tmp_path, monkeypatch):
         assert json.loads(ei.value.read())["status"] == "down"
     finally:
         httpd.shutdown()
+
+
+def test_sse_admission_under_client_churn_at_cap(store):
+    """r9 satellite: the check-then-claim SSE admission (one lock, gauge
+    moved before the body is iterated) holds under rapid connect/drop
+    churn AT the cap — the live-client gauge never exceeds the cap, the
+    overflow answers are clean 503s, and every slot is released (gauge
+    returns to 0) even for clients that vanish before reading a byte."""
+    import socket
+    import threading
+
+    cap = 4
+    cfg = load_config({"HEATMAP_SSE_MAX_CLIENTS": str(cap),
+                       "HEATMAP_VIEW_POLL_MS": "50",
+                       "HEATMAP_SSE_HEARTBEAT_S": "0.2"}, serve_port=0)
+    httpd, _t, port = start_background(store, cfg, port=0)
+    app = httpd.get_app()
+    # the admission gauge lives in the app's serve registry
+    gauge = None
+    for fam in app.serve_registry._families.values():
+        if fam.name == "heatmap_serve_sse_clients":
+            gauge = fam
+    assert gauge is not None
+
+    stop = threading.Event()
+    seen_max = [0]
+
+    def watch():
+        while not stop.is_set():
+            seen_max[0] = max(seen_max[0], int(gauge.value))
+            time.sleep(0.001)
+
+    stats = {"ok": 0, "refused": 0, "lock": threading.Lock()}
+
+    def churn(n):
+        for _ in range(n):
+            try:
+                sk = socket.create_connection(("127.0.0.1", port),
+                                              timeout=10)
+                sk.sendall(b"GET /api/tiles/stream?since=0 "
+                           b"HTTP/1.0\r\n\r\n")
+                sk.settimeout(5)
+                head = sk.recv(256)
+                with stats["lock"]:
+                    if b"503" in head:
+                        stats["refused"] += 1
+                    else:
+                        stats["ok"] += 1
+                # half the clients slam the door before reading the
+                # body; the other half read one event first
+                if b"200" in head:
+                    sk.recv(1024)
+                sk.close()
+            except OSError:
+                pass
+
+    watcher = threading.Thread(target=watch, daemon=True)
+    watcher.start()
+    try:
+        threads = [threading.Thread(target=churn, args=(6,))
+                   for _ in range(cap * 3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        # churned hard enough to hit the cap at least once
+        assert stats["ok"] + stats["refused"] == cap * 3 * 6
+        assert stats["ok"] > 0
+        # every slot released: the gauge drains back to 0
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=10) as r:
+                txt = r.read().decode()
+            series, _ = _parse_prom(txt)
+            live = series.get("heatmap_serve_sse_clients", {}).get("")
+            if live == 0:
+                break
+            time.sleep(0.1)
+        assert live == 0
+        # and the cap was never exceeded while churning
+        assert seen_max[0] <= cap
+    finally:
+        stop.set()
+        httpd.shutdown()

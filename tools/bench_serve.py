@@ -24,8 +24,25 @@ heatmap_serve_rendered_bytes_total counters), plus
 bytes — the acceptance number for "a polling client against an idle
 store stops costing renders".
 
+``--soak`` switches to the replicated-fleet soak (ISSUE 9): a writer
+view + delta-log publisher (query.repl) feeds ``--replicas`` serve
+workers that follow it with ZERO store reads (their stores are
+empty), while ``--clients`` logical polling clients — persistent
+per-client ETag/delta session state, driven by a bounded worker pool
+with keep-alive connections — and ``--sse`` real SSE connections mix
+the three read paths against the fleet for ``--duration`` seconds, as
+a background mutator keeps tiles changing.  The artifact stamps p50/
+p99, wire bytes, replica count, max replica seq/time lag vs the
+``HEATMAP_SLO_REPL_LAG_S`` budget, and the store-scan fallback +
+rebuild counters (both must stay 0 — the metric-asserted
+zero-store-read property), plus the ``repl`` provenance block
+``check_bench_regress`` refuses to compare across replica counts.
+
 Usage: python tools/bench_serve.py [n_tiles] [n_positions]
                                    [--clients N] [--polls P]
+       python tools/bench_serve.py [n_tiles] --soak [--replicas N]
+                                   [--clients N] [--duration S]
+                                   [--workers W] [--sse S]
 """
 
 from __future__ import annotations
@@ -190,15 +207,389 @@ def _concurrent_mode(base: str, mode: str, clients: int,
     return out
 
 
+# ---------------------------------------------------------------- soak
+# The replicated-fleet soak: N zero-store-read replicas following one
+# writer's delta-log feed, thousands of logical clients mixing
+# SSE/delta/ETag.  "Logical client" = persistent per-client protocol
+# state (its delta cursor / cached ETag), driven by a bounded worker
+# pool — the way 10k concurrent pollers actually look to a server:
+# thousands of sessions, a few hundred in flight at any instant.
+
+
+def _soak_docs(n_tiles: int):
+    """TileDoc list for the writer view (same shape _populate sinks)."""
+    import numpy as np
+
+    from heatmap_tpu.hexgrid import host as hexhost
+    from heatmap_tpu.hexgrid.device import cells_to_strings
+    from heatmap_tpu.sink.base import TileDoc
+
+    now = dt.datetime.now(dt.timezone.utc)
+    ws = now.replace(second=0, microsecond=0) - dt.timedelta(minutes=1)
+    rng = np.random.default_rng(7)
+    lat = rng.uniform(42.0, 42.8, n_tiles)
+    lon = rng.uniform(-71.4, -70.7, n_tiles)
+    docs, seen = [], set()
+    for i in range(n_tiles):
+        cell = hexhost.latlng_to_cell_int(
+            float(np.radians(lat[i])), float(np.radians(lon[i])), 8)
+        cid = cells_to_strings(
+            np.array([cell >> 32], np.uint32),
+            np.array([cell & 0xFFFFFFFF], np.uint32))[0]
+        if cid in seen:
+            continue
+        seen.add(cid)
+        docs.append(TileDoc(
+            "bos", 8, cid, ws, ws + dt.timedelta(minutes=5),
+            int(rng.integers(1, 500)), float(rng.uniform(1, 90)),
+            float(lat[i]), float(lon[i]), ttl_minutes=45))
+    return docs
+
+
+def _req(port: int, path: str, headers: dict | None = None):
+    """(ms, status, wire_bytes, body, etag) over one short-lived
+    connection (wsgiref serves one request per connection).  Sends
+    Accept-Encoding: gzip like a real client — wire bytes measure the
+    compressed path, the decoded body feeds the delta cursor."""
+    import http.client
+
+    hdrs = {"Accept-Encoding": "gzip"}
+    hdrs.update(headers or {})
+    t0 = time.perf_counter()
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        c.request("GET", path, headers=hdrs)
+        r = c.getresponse()
+        body = r.read()
+        etag = r.getheader("ETag")
+        status = r.status
+        gz = r.getheader("Content-Encoding") == "gzip"
+    finally:
+        c.close()
+    ms = (time.perf_counter() - t0) * 1e3
+    raw = len(body)
+    if gz and body:
+        body = gzip.GzipFile(fileobj=io.BytesIO(body)).read()
+    return ms, status, raw, body, etag
+
+
+def _scrape_family(port: int, names) -> dict:
+    """{family: summed value} scraped from one replica's /metrics."""
+    _, _, _, body, _ = _req(port, "/metrics")
+    out = {n: 0.0 for n in names}
+    for line in body.decode().splitlines():
+        if not line or line.startswith("#"):
+            continue
+        series, _, val = line.rpartition(" ")
+        name = series.partition("{")[0]
+        if name in out:
+            try:
+                out[name] += float(val)
+            except ValueError:
+                pass
+    return out
+
+
+def _soak_clients(ports: list, states: list, deadline: float,
+                  workers: int):
+    """Drive the logical clients until the deadline; returns merged
+    (latencies_ms, wire_bytes, n_304, n_requests, errors)."""
+    results = []
+
+    def worker(idx: int):
+        lat, wire, n304, nreq, errs = [], 0, 0, 0, 0
+        my = range(idx, len(states), workers)
+        while time.perf_counter() < deadline:
+            progressed = False
+            for i in my:
+                if time.perf_counter() >= deadline:
+                    break
+                st = states[i]
+                port = ports[i % len(ports)]
+                try:
+                    if st["kind"] == "delta":
+                        ms, _s, raw, body, _e = _req(
+                            port,
+                            f"/api/tiles/delta?since={st['since']}")
+                        st["since"] = json.loads(body)["seq"]
+                    else:
+                        hdrs = ({"If-None-Match": st["etag"]}
+                                if st["etag"] else {})
+                        ms, status, raw, _b, etag = _req(
+                            port, "/api/tiles/latest", hdrs)
+                        if etag:
+                            st["etag"] = etag
+                        n304 += status == 304
+                except Exception:
+                    errs += 1
+                    continue
+                lat.append(ms)
+                wire += raw
+                nreq += 1
+                progressed = True
+            if not progressed:
+                time.sleep(0.005)
+        results.append((lat, wire, n304, nreq, errs))
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lat: list = []
+    wire = n304 = nreq = errs = 0
+    for wl, ww, w3, wn, we in results:
+        lat.extend(wl)
+        wire += ww
+        n304 += w3
+        nreq += wn
+        errs += we
+    return lat, wire, n304, nreq, errs
+
+
+def _sse_reader(port: int, deadline: float, out: list, idx: int):
+    """One real SSE connection held for the soak, counting pushes."""
+    import socket
+
+    events = 0
+    try:
+        s = socket.create_connection(("127.0.0.1", port), timeout=5)
+        s.sendall(b"GET /api/tiles/stream?since=0 HTTP/1.1\r\n"
+                  b"Host: bench\r\nAccept: text/event-stream\r\n\r\n")
+        s.settimeout(0.25)
+        carry = b""
+        while time.perf_counter() < deadline:
+            try:
+                chunk = s.recv(16384)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            if not chunk:
+                break
+            buf = carry + chunk
+            events += buf.count(b"event: tiles")
+            # keep strictly less than one marker: a whole marker left
+            # in the carry would be counted again next iteration
+            carry = buf[-(len(b"event: tiles") - 1):]
+        s.close()
+    except OSError:
+        pass
+    out[idx] = events
+
+
+def run_soak(n_tiles: int, replicas: int, clients: int, duration_s: float,
+             workers: int, sse_n: int, mutate_ms: float = 500.0) -> dict:
+    """The replicated-fleet soak; returns the artifact's ``soak``
+    block.  The replicas' stores are EMPTY MemoryStores — every byte
+    they serve came through the replication feed, so the fallback/
+    rebuild counters staying 0 is the zero-store-read proof."""
+    import tempfile
+
+    from heatmap_tpu.config import load_config
+    from heatmap_tpu.query import TileMatView
+    from heatmap_tpu.query.repl import DeltaLogPublisher
+    from heatmap_tpu.serve.api import start_background
+    from heatmap_tpu.sink import MemoryStore
+
+    try:
+        slo_lag_s = float(os.environ.get("HEATMAP_SLO_REPL_LAG_S", "")
+                          or 10.0)
+    except ValueError:
+        slo_lag_s = 10.0
+    try:
+        slo_p99_ms = float(os.environ.get("HEATMAP_SLO_SERVE_P99_MS", "")
+                           or 1000.0)
+    except ValueError:
+        slo_p99_ms = 1000.0
+    feed = tempfile.mkdtemp(prefix="bench-repl-feed-")
+    view = TileMatView()
+    pub = DeltaLogPublisher(view, feed, flush_s=0.02)
+    docs = _soak_docs(n_tiles)
+    view.apply_docs(docs)
+    fleet = []
+    try:
+        for _ in range(replicas):
+            cfg_r = load_config(
+                {}, store="memory", serve_port=0, repl_feed=feed,
+                repl_poll_ms=50,
+                sse_max_clients=max(64, sse_n + 8))
+            httpd, _t, port = start_background(MemoryStore(), cfg_r,
+                                               port=0)
+            fleet.append((httpd, port))
+        ports = [p for _h, p in fleet]
+        # every replica must finish its snapshot bootstrap before the
+        # clock starts — the soak measures steady state, not boot
+        t_sync = time.perf_counter() + 30
+        for httpd, _p in fleet:
+            fol = httpd.get_app().repl_follower
+            while time.perf_counter() < t_sync and not (
+                    fol.synced and fol.seq_lag() == 0):
+                time.sleep(0.02)
+            assert fol.synced, "replica never synced from the feed"
+
+        stop = threading.Event()
+        maxima = {"seq_lag": 0.0, "lag_s": 0.0}
+
+        def mutator():
+            import random
+
+            rng = random.Random(11)
+            while not stop.wait(mutate_ms / 1e3):
+                batch = []
+                for d in rng.sample(docs, min(32, len(docs))):
+                    d = dict(d)
+                    d["count"] = int(d["count"]) + 1
+                    batch.append(d)
+                view.apply_docs(batch)
+
+        def lag_sampler():
+            while not stop.wait(0.25):
+                for p in ports:
+                    try:
+                        m = _scrape_family(
+                            p, ("heatmap_repl_seq_lag",
+                                "heatmap_repl_lag_seconds"))
+                    except OSError:
+                        continue
+                    maxima["seq_lag"] = max(maxima["seq_lag"],
+                                            m["heatmap_repl_seq_lag"])
+                    maxima["lag_s"] = max(maxima["lag_s"],
+                                          m["heatmap_repl_lag_seconds"])
+
+        aux = [threading.Thread(target=mutator, daemon=True),
+               threading.Thread(target=lag_sampler, daemon=True)]
+        for t in aux:
+            t.start()
+        deadline = time.perf_counter() + duration_s
+        sse_counts = [0] * sse_n
+        sse_threads = [
+            threading.Thread(target=_sse_reader,
+                             args=(ports[i % len(ports)], deadline,
+                                   sse_counts, i), daemon=True)
+            for i in range(sse_n)]
+        for t in sse_threads:
+            t.start()
+        # client mix: 80% delta pollers (the production UI shape since
+        # PR 4), 20% ETag pollers; 95% of each arrive WARM (cursor /
+        # ETag seeded at the current view state, like a fleet that has
+        # been polling all along), 5% cold (client churn: full resync
+        # on first poll).  Without warm seeding a bounded soak only
+        # ever measures 10k cold syncs, not the steady state the tier
+        # exists to serve.
+        seed = {}
+        for p in ports:
+            _ms, _s, _raw, body, etag = _req(p, "/api/tiles/latest")
+            _ms, _s, _raw, body, _e = _req(p, "/api/tiles/delta?since=0")
+            seed[p] = (etag, json.loads(body)["seq"])
+        states = []
+        for i in range(clients):
+            port = ports[i % len(ports)]
+            kind = "etag" if i % 5 == 0 else "delta"
+            cold = i % 20 == 19
+            states.append({
+                "kind": kind,
+                "since": 0 if cold else seed[port][1],
+                "etag": None if cold else seed[port][0],
+            })
+        t0 = time.perf_counter()
+        lat, wire, n304, nreq, errs = _soak_clients(
+            ports, states, deadline, workers)
+        wall = time.perf_counter() - t0
+        for t in sse_threads:
+            t.join(timeout=5)
+        stop.set()
+        for t in aux:
+            t.join(timeout=5)
+        # final per-replica zero-store-read + health accounting
+        fallbacks = rebuilds = 0.0
+        synced = 0.0
+        for p in ports:
+            m = _scrape_family(
+                p, ("heatmap_repl_fallback_total",
+                    "heatmap_view_rebuilds_total",
+                    "heatmap_repl_synced",
+                    "heatmap_repl_seq_lag",
+                    "heatmap_repl_lag_seconds"))
+            fallbacks += m["heatmap_repl_fallback_total"]
+            rebuilds += m["heatmap_view_rebuilds_total"]
+            synced += m["heatmap_repl_synced"]
+            maxima["seq_lag"] = max(maxima["seq_lag"],
+                                    m["heatmap_repl_seq_lag"])
+            maxima["lag_s"] = max(maxima["lag_s"],
+                                  m["heatmap_repl_lag_seconds"])
+        out = {
+            "replicas": replicas,
+            "clients": clients,
+            "workers": workers,
+            "sse_connections": sse_n,
+            "sse_events": sum(sse_counts),
+            "duration_s": round(wall, 2),
+            "tiles": len(docs),
+            "requests": nreq,
+            "req_per_sec": round(nreq / max(1e-9, wall), 1),
+            "errors": errs,
+            "ratio_304": round(n304 / max(1, nreq), 4),
+            "bytes_sent_wire": wire,
+            "max_seq_lag": int(maxima["seq_lag"]),
+            "max_repl_lag_s": round(maxima["lag_s"], 3),
+            "slo_repl_lag_s": slo_lag_s,
+            "repl_lag_ok": maxima["lag_s"] <= slo_lag_s,
+            "store_scan_fallbacks": int(fallbacks),
+            "view_rebuilds": int(rebuilds),
+            "zero_store_reads": fallbacks == 0 and rebuilds == 0,
+            "replicas_synced": int(synced),
+        }
+        if lat:
+            out.update(_quantiles(lat))
+            out["slo_serve_p99_ms"] = slo_p99_ms
+            out["p99_ok"] = out["p99_ms"] <= slo_p99_ms
+        return out
+    finally:
+        for httpd, _p in fleet:
+            httpd.shutdown()
+            httpd.get_app().close_repl()
+        pub.close()
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("n_tiles", nargs="?", type=int, default=20_000)
     ap.add_argument("n_positions", nargs="?", type=int, default=2_000)
-    ap.add_argument("--clients", type=int,
-                    default=int(os.environ.get("BENCH_SERVE_CLIENTS", "8")))
+    ap.add_argument("--clients", type=int, default=None)
     ap.add_argument("--polls", type=int,
                     default=int(os.environ.get("BENCH_SERVE_POLLS", "12")))
+    ap.add_argument("--soak", action="store_true",
+                    help="replicated-fleet soak: N replicas follow the "
+                         "delta-log feed, clients mix SSE/delta/ETag")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--duration", type=float, default=20.0)
+    ap.add_argument("--workers", type=int, default=None,
+                    help="client worker threads (default: clients/64, "
+                         "capped 64)")
+    ap.add_argument("--sse", type=int, default=16,
+                    help="real SSE connections held for the soak")
+    ap.add_argument("--mutate-ms", type=float, default=500.0,
+                    help="writer mutation cadence during the soak")
     args = ap.parse_args()
+
+    if args.soak:
+        clients = args.clients if args.clients is not None else 10_000
+        # GIL-bound co-located soak: past ~16 workers the extra threads
+        # only thrash the tail (measured: 64 workers tripled p99)
+        workers = args.workers or min(16, max(4, clients // 64))
+        soak = run_soak(args.n_tiles, args.replicas, clients,
+                        args.duration, workers, args.sse,
+                        mutate_ms=args.mutate_ms)
+        out = {"soak": soak,
+               "repl": {"replicas": soak["replicas"],
+                        "max_seq_lag": soak["max_seq_lag"],
+                        "max_repl_lag_s": soak["max_repl_lag_s"]}}
+        print(json.dumps(out))
+        return
+    args.clients = (args.clients if args.clients is not None
+                    else int(os.environ.get("BENCH_SERVE_CLIENTS", "8")))
 
     from heatmap_tpu.config import load_config
     from heatmap_tpu.serve.api import start_background
@@ -266,11 +657,14 @@ def main() -> None:
     # fleet provenance (obs.fleet): member count + per-member request
     # rate (the delta path — the production polling shape), so a
     # replicated-serve round's artifact compares per-worker
-    from heatmap_tpu.obs.fleet import fleet_stamp
+    from heatmap_tpu.obs.fleet import fleet_stamp, repl_stamp
 
     conc = out.get("concurrent") or {}
     out.update(fleet_stamp((conc.get("delta") or {}).get("req_per_sec"),
                            role="serve"))
+    # replicated-fleet provenance: replica count + max seq lag off the
+    # fleet channel, when a replicated serve fleet is attached
+    out.update(repl_stamp())
     print(json.dumps(out))
 
 

@@ -27,10 +27,21 @@ applies to mismatched ``shards`` stamps (ISSUE 7): a 4-shard aggregate
 headline compared against a 1-shard round would mask a single-shard
 regression behind fan-out — differing shard counts → exit 1.
 
+Serve-tier artifacts (``BENCH_SERVE_r*.json``, tools/bench_serve.py
+soak rounds) are ratcheted the same way, on the two numbers a serve
+regression shows up in first: ``p99_ms`` (latency tail) and
+``bytes_sent_wire`` (the delta/ETag tier's whole point is fewer
+bytes) — both LOWER-is-better, so the check fails when the newest
+GREW past the threshold.  And mirroring the backend/shards refusal:
+pairs whose replica counts differ are refused outright — a 4-replica
+fleet's aggregate latency/bytes say nothing about a 1-replica round,
+and comparing them would mask exactly the per-replica regression the
+ratchet exists to catch.
+
 Usage:
     python tools/check_bench_regress.py [--dir REPO] [--threshold 0.5]
-Exit codes: 0 ok / nothing to compare, 1 regression or mixed-backend
-pair, 2 bad arguments.
+Exit codes: 0 ok / nothing to compare, 1 regression or mixed-backend /
+mixed-replica pair, 2 bad arguments.
 """
 
 from __future__ import annotations
@@ -128,6 +139,91 @@ def newest_pair(dir_path: str) -> list:
     return sorted(out)
 
 
+# ------------------------------------------------------- serve artifacts
+_SERVE_ROUND_RE = re.compile(r"BENCH_SERVE_r(\d+)\.json$")
+
+
+def serve_artifact_round(path: str) -> int | None:
+    m = _SERVE_ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def serve_metrics(path: str) -> tuple | None:
+    """(p99_ms, bytes_sent_wire, replicas|None) of one bench_serve
+    artifact — the ``soak`` block when present (replicated-fleet
+    rounds), else the concurrent delta mode; None when neither
+    parses (a broken run fails its own gate, not this one)."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            art = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(art, dict) or art.get("rc", 0) != 0:
+        return None
+    sec = art.get("soak")
+    if not isinstance(sec, dict):
+        sec = (art.get("concurrent") or {}).get("delta")
+    if not isinstance(sec, dict):
+        return None
+    p99, wire = sec.get("p99_ms"), sec.get("bytes_sent_wire")
+    if not isinstance(p99, (int, float)) \
+            or not isinstance(wire, (int, float)) or p99 <= 0:
+        return None
+    replicas = (art.get("soak") or {}).get("replicas") \
+        or (art.get("repl") or {}).get("replicas")
+    return (float(p99), float(wire),
+            int(replicas) if isinstance(replicas, int) else None)
+
+
+def compare_serve(dir_path: str, threshold: float) -> int:
+    """Ratchet the newest two BENCH_SERVE_r*.json artifacts: p99 and
+    wire bytes may not GROW past ``threshold``; mixed replica-count
+    pairs are refused (exit 1), mirroring the backend/shards logic."""
+    arts = []
+    for p in glob.glob(os.path.join(glob.escape(dir_path),
+                                    "BENCH_SERVE_r*.json")):
+        rnd = serve_artifact_round(p)
+        if rnd is None:
+            continue
+        arts.append((rnd, p, serve_metrics(p)))
+    arts.sort()
+    usable = [(r, p, m) for r, p, m in arts if m is not None]
+    for r, p, m in arts:
+        if m is None:
+            print(f"note: skipping serve r{r:02d} "
+                  f"({os.path.basename(p)}): failed run or no "
+                  f"parseable p99/bytes")
+    if len(usable) < 2:
+        print(f"OK: {len(usable)} usable serve artifact(s) — nothing "
+              f"to compare")
+        return 0
+    (r_prev, _p_prev, m_prev), (r_new, _p_new, m_new) = \
+        usable[-2], usable[-1]
+    (p99_prev, wire_prev, rep_prev) = m_prev
+    (p99_new, wire_new, rep_new) = m_new
+    if rep_prev is not None and rep_new is not None \
+            and rep_prev != rep_new:
+        print(f"FAIL: replica-count mismatch — serve r{r_prev:02d} ran "
+              f"{rep_prev} replica(s) but r{r_new:02d} ran {rep_new}; "
+              f"an N-replica fleet's latency/bytes cannot stand in for "
+              f"another fleet width (or mask its regression) — re-run "
+              f"the soak at the same replica count", file=sys.stderr)
+        return 1
+    rc = 0
+    for name, prev, new in (("p99_ms", p99_prev, p99_new),
+                            ("bytes_sent_wire", wire_prev, wire_new)):
+        growth = (new - prev) / prev if prev > 0 else 0.0
+        line = (f"serve r{r_prev:02d} {name} {prev:,.0f} -> "
+                f"r{r_new:02d} {new:,.0f} ({growth:+.1%})")
+        if growth > threshold:
+            print(f"FAIL: serve regression beyond {threshold:.0%}: "
+                  f"{line}", file=sys.stderr)
+            rc = 1
+        else:
+            print(f"OK: {line} within the {threshold:.0%} threshold")
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--dir", default=REPO,
@@ -140,6 +236,7 @@ def main(argv=None) -> int:
         print("check_bench_regress: --threshold must be in (0, 1)",
               file=sys.stderr)
         return 2
+    serve_rc = compare_serve(args.dir, args.threshold)
 
     arts = newest_pair(args.dir)
     usable = [(r, p, v) for r, p, v in arts if v is not None]
@@ -149,7 +246,7 @@ def main(argv=None) -> int:
                   f"failed run or no parseable headline")
     if len(usable) < 2:
         print(f"OK: {len(usable)} usable artifact(s) — nothing to compare")
-        return 0
+        return serve_rc
     (r_prev, p_prev, prev), (r_new, p_new, new) = usable[-2], usable[-1]
     bp_prev, bp_new = backend_path(p_prev), backend_path(p_new)
     if bp_prev and bp_new and bp_prev != bp_new:
@@ -174,7 +271,7 @@ def main(argv=None) -> int:
               f"{line}", file=sys.stderr)
         return 1
     print(f"OK: {line} within the {args.threshold:.0%} threshold")
-    return 0
+    return serve_rc
 
 
 if __name__ == "__main__":

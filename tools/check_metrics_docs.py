@@ -84,6 +84,26 @@ def _smoke_shard_runtime():
     return rt
 
 
+def _smoke_repl():
+    """CONSTRUCTED replication publisher + follower (query/repl.py):
+    their metric families only register on a replicated config — a
+    writer with HEATMAP_REPL_DIR and a serve replica with
+    HEATMAP_REPL_FEED — which neither runtime smoke above exposes.
+    No threads run; construction alone registers the families."""
+    from heatmap_tpu.obs.registry import Registry
+    from heatmap_tpu.query import TileMatView
+    from heatmap_tpu.query.repl import (DeltaLogPublisher,
+                                        FileFeedSource,
+                                        ReplicaViewFollower)
+
+    feed = tempfile.mkdtemp(prefix="metrics-docs-repl-")
+    reg = Registry()
+    DeltaLogPublisher(TileMatView(), feed, registry=reg, start=False)
+    ReplicaViewFollower(TileMatView(replica=True), FileFeedSource(feed),
+                        registry=reg)
+    return list(reg._families.values())
+
+
 def main() -> int:
     os.environ.setdefault("HEATMAP_PLATFORM", "cpu")
     with open(os.path.join(REPO, "ARCHITECTURE.md"),
@@ -96,6 +116,8 @@ def main() -> int:
     fams += [f for f in
              _smoke_shard_runtime().metrics.registry._families.values()
              if f.name not in seen]
+    seen = {f.name for f in fams}
+    fams += [f for f in _smoke_repl() if f.name not in seen]
     for fam in fams:
         if not fam.help.strip():
             failures.append(f"{fam.name}: empty HELP string")

@@ -339,6 +339,9 @@ def shard_fleet_main(args) -> int:
         if len(steadies) > 1 else None,
         "per_shard": results,
     }
+    from heatmap_tpu.obs.fleet import repl_stamp
+
+    out.update(repl_stamp())  # replica count + max lag when attached
     print(json.dumps(out))
     return 0
 
@@ -656,6 +659,11 @@ def main() -> int:
         # visible in the same JSON line
         "freshness": rt.metrics.freshness_summary(),
     }
+    # replicated serve fleet provenance (obs.fleet): replica count +
+    # max replication seq lag, when a follower fleet is on the channel
+    from heatmap_tpu.obs.fleet import repl_stamp
+
+    out.update(repl_stamp())
     if mongod is not None:
         tiles = mongod.state.coll("mobility", "tiles")
         out["mongod_tiles_docs"] = len(tiles)

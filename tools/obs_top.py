@@ -21,7 +21,11 @@ age, up/stale — off ``/fleet/metrics``, plus the aggregate
 supervisor channel path.  When the members are H3-partitioned runtime
 shards (stream/shardmap.py), a per-shard table follows: shard index,
 owned-cell share, steady rate, event-age p50, and the max/mean
-shard-imbalance ratio that makes a skewed partition obvious.
+shard-imbalance ratio that makes a skewed partition obvious.  When
+serve-role members (or replication followers, query/repl.py) are on
+the channel, a serve-replica table follows too: replication seq lag,
+open SSE clients, and the 304 ratio per worker, plus the fleet's max
+seq lag.
 
 Usage:
     python tools/obs_top.py [--url http://127.0.0.1:5000] [--interval 2]
@@ -223,6 +227,17 @@ def _by_proc(m: dict | None, name: str) -> dict:
     return out
 
 
+def _by_proc_sum(m: dict | None, name: str) -> dict:
+    """{proc_tag: summed value} for a family whose samples carry extra
+    labels besides ``proc`` (e.g. per-endpoint serve counters)."""
+    out: dict = {}
+    for labels, v in ((m or {}).get(name) or {}).items():
+        p = _label_of(labels, "proc")
+        if p is not None:
+            out[p] = out.get(p, 0.0) + v
+    return out
+
+
 def render_fleet_frame(m: dict, prev: dict | None, dt: float,
                        health: dict | None) -> str:
     """The fleet observatory view: one row per member off the
@@ -303,6 +318,34 @@ def render_fleet_frame(m: dict, prev: dict | None, dt: float,
         lines.append(f"  imbalance max/mean "
                      f"{fmt(imbalance, 'x', digits=2)}   aggregate "
                      f"{fmt(sum(known) if known else None, ' ev/s', digits=0)}")
+    # replicated serve fleet (query.repl): one row per serve-role
+    # member — replication seq lag, open SSE clients, and the 304
+    # ratio that says the ETag tier is actually absorbing polls
+    seq_lag = _by_proc(m, "heatmap_repl_seq_lag")
+    serve_tags = sorted(set(t for t, r in roles.items() if r == "serve")
+                        | set(seq_lag))
+    if serve_tags:
+        sse = _by_proc(m, "heatmap_serve_sse_clients")
+        n304 = _by_proc_sum(m, "heatmap_serve_304_total")
+        renders = _by_proc_sum(m, "heatmap_serve_renders_total")
+        lines.append("")
+        lines.append(f"  {'serve':<14}{'role':<8}{'seq lag':>9}"
+                     f"{'sse':>6}{'304 %':>9}  state")
+        for tag in serve_tags:
+            r304 = None
+            total = n304.get(tag, 0.0) + renders.get(tag, 0.0)
+            if total > 0:
+                r304 = n304.get(tag, 0.0) / total
+            lines.append(
+                f"  {tag:<14}{roles.get(tag, '?'):<8}"
+                f"{fmt(seq_lag.get(tag), digits=0):>9}"
+                f"{fmt(sse.get(tag), digits=0):>6}"
+                f"{fmt(r304, ' %', 100.0):>9}"
+                f"  {'up' if up.get(tag) else 'STALE/DOWN'}")
+        lags = [v for v in seq_lag.values() if v is not None]
+        if lags:
+            lines.append(f"  repl max seq lag {fmt(max(lags), digits=0)}"
+                         f"   replicas {len(lags)}")
     if health is not None:
         status = health.get("status", "?")
         bad = [k for k, c in health.get("checks", {}).items()
