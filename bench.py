@@ -700,6 +700,7 @@ def main() -> dict:
         f"{info['n_active']:,} | emit rows {info['emitted_rows']:,}",
         file=sys.stderr,
     )
+    env_cfg = _bench_env_cfg()
     desc = {
         "backfill": f"H3 res {res}, 5-min windows",
         "hex_pyramid": "fused res 7/8/9 pyramid, 5-min windows "
@@ -750,6 +751,23 @@ def main() -> dict:
         "span_feed_ms": info.get("span_feed_ms"),
         "span_fold_ms": info.get("span_fold_ms"),
         "span_pull_ms": info.get("span_pull_ms"),
+        # adaptive-governor provenance (ISSUE 10): whether this round
+        # ran with HEATMAP_GOVERN — check_bench_regress refuses to
+        # compare governed against static-knob rounds.  The fold bench
+        # itself has no runtime knobs to govern; the flag covers the
+        # e2e runtime attach below, which inherits the env.  Parsed by
+        # config.load_config (one truthiness rule for the knob), not
+        # re-implemented here.
+        "govern": {"enabled": env_cfg.govern},
+        # EFFECTIVE knob provenance: the values this round actually ran
+        # with.  BENCH_r02-r05 banked CPU-fallback rounds with nothing
+        # in the artifact saying which flush-K/prefetch the e2e attach
+        # used — default-knob runs were indistinguishable from tuned
+        # ones.  (The e2e attach adds its own post-governor effective
+        # block when it runs.)
+        "knobs": {"batch": batch, "chunk": chunk,
+                  "flush_k": env_cfg.emit_flush_k,
+                  "prefetch": env_cfg.prefetch_batches},
     }
     result.update(_ref_cpu_baseline_attach(eps))
     # fleet provenance (obs.fleet): member count + per-member rate, so
@@ -761,10 +779,11 @@ def main() -> dict:
     result.update(fleet_stamp(eps))
     result.update(repl_stamp())
     if dev.platform == "cpu":
-        result.update(_cpu_headline_bank(eps, info, res=res,
-                                         pipeline=pipeline, impl=impl,
-                                         h3=h3, batch=batch, chunk=chunk,
-                                         cap=cap))
+        result.update(_cpu_headline_bank(
+            eps, info, res=res, pipeline=pipeline, impl=impl, h3=h3,
+            batch=batch, chunk=chunk, cap=cap,
+            flush_k=result["knobs"]["flush_k"],
+            prefetch=result["knobs"]["prefetch"]))
         # The relay flaps (up for ~minutes at a time); tools/hw_burst.py
         # banks real-hardware measurements whenever it answers.  If this
         # run fell back to CPU but a hardware headline was banked, carry
@@ -776,6 +795,18 @@ def main() -> dict:
         result.update(_e2e_runtime_attach())
     print(json.dumps(result))
     return result
+
+
+def _bench_env_cfg():
+    """The env knobs parsed by the SAME parser the e2e attach's runtime
+    uses (config.load_config), so the stamped govern/knob provenance
+    can never diverge from config defaults or env truthiness rules."""
+    from heatmap_tpu.config import Config, load_config
+
+    try:
+        return load_config()
+    except ValueError:  # an unrelated bad knob must not kill the stamp
+        return Config()
 
 
 def _resolve_h3_env() -> "str | None":
@@ -1026,6 +1057,12 @@ def _e2e_runtime_attach() -> dict:
             "e2e_runtime_events_per_sec": e2e["wall_events_per_sec"],
             "e2e_runtime_steady_events_per_sec":
                 e2e["steady_events_per_sec"],
+            # the knob values the attach run ACTUALLY executed with —
+            # post-governor when HEATMAP_GOVERN was inherited from the
+            # env — so a banked round is self-describing instead of
+            # silently carrying default provenance
+            "e2e_runtime_knobs": e2e.get("effective"),
+            "e2e_runtime_govern": e2e.get("govern"),
             # freshness rides with throughput in every BENCH_*.json: the
             # event-age p50/p99 (event ts -> sink commit ack through the
             # emit ring) and mean ring residency this run sustained

@@ -214,6 +214,49 @@ class Config:
                                        # snapshot
     repl_poll_ms: int = 200            # HEATMAP_REPL_POLL_MS: replica
                                        # follower tail-poll cadence
+    govern: bool = False               # HEATMAP_GOVERN: adaptive
+                                       # micro-batching (stream/
+                                       # govern.py) — a feedback
+                                       # governor on the step loop
+                                       # resizes the live batch size
+                                       # (power-of-two pad buckets,
+                                       # precompiled at startup),
+                                       # emit_flush_k, and
+                                       # prefetch_batches within the
+                                       # bounds below to hold
+                                       # HEATMAP_SLO_FRESHNESS_P50_MS
+                                       # under load swings.  The static
+                                       # knobs above become INITIAL
+                                       # values.  0 (the default) is
+                                       # the kill switch: all knobs
+                                       # stay static.
+    govern_interval_s: float = 5.0     # HEATMAP_GOVERN_INTERVAL_S:
+                                       # governor control-loop cadence
+    govern_min_batch: int = 4096       # HEATMAP_GOVERN_MIN_BATCH:
+                                       # bucket-ladder floor — the
+                                       # smallest pad bucket the
+                                       # governor may shrink the live
+                                       # batch to (ladder = powers of
+                                       # two from here up to
+                                       # BATCH_SIZE, every bucket
+                                       # warmed/compiled at startup)
+    govern_max_flush_k: int = 32       # HEATMAP_GOVERN_MAX_FLUSH_K:
+                                       # emit-ring depth ceiling the
+                                       # governor may grow flush-K to
+                                       # (floor is always 1)
+    govern_max_prefetch: int = 4       # HEATMAP_GOVERN_MAX_PREFETCH:
+                                       # prefetch-depth ceiling
+                                       # (floor is always 0);
+                                       # prefetch x batch growth is
+                                       # additionally capped by the
+                                       # HEATMAP_SLO_MEM_BYTES
+                                       # watermark budget
+    govern_healthy_frac: float = 0.5   # HEATMAP_GOVERN_HEALTHY_FRAC:
+                                       # recovery hysteresis — the
+                                       # governor only takes upward
+                                       # (throughput) moves while the
+                                       # recent event-age p50 is below
+                                       # this fraction of the SLO
     shard_oversample: int = 0          # HEATMAP_SHARD_OVERSAMPLE: how
                                        # many feed-batches worth of
                                        # stream rows a shard polls per
@@ -310,6 +353,17 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
                            Config.repl_segments),
         repl_poll_ms=_int(e, "HEATMAP_REPL_POLL_MS",
                           Config.repl_poll_ms),
+        govern=e.get("HEATMAP_GOVERN", "0") not in ("0", "false", ""),
+        govern_interval_s=_float(e, "HEATMAP_GOVERN_INTERVAL_S",
+                                 Config.govern_interval_s),
+        govern_min_batch=_int(e, "HEATMAP_GOVERN_MIN_BATCH",
+                              Config.govern_min_batch),
+        govern_max_flush_k=_int(e, "HEATMAP_GOVERN_MAX_FLUSH_K",
+                                Config.govern_max_flush_k),
+        govern_max_prefetch=_int(e, "HEATMAP_GOVERN_MAX_PREFETCH",
+                                 Config.govern_max_prefetch),
+        govern_healthy_frac=_float(e, "HEATMAP_GOVERN_HEALTHY_FRAC",
+                                   Config.govern_healthy_frac),
         shards=_int(e, "HEATMAP_SHARDS", Config.shards),
         shard_index=_int(e, "HEATMAP_SHARD_INDEX", Config.shard_index),
         shard_res=_int(e, "HEATMAP_SHARD_RES", Config.shard_res),
@@ -385,6 +439,31 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
             raise ValueError(
                 f"HEATMAP_SHARD_RES must be -1 or in 0..{snap_res} "
                 f"(the coarsest fold resolution), got {cfg.shard_res}")
+    if cfg.govern_interval_s <= 0:
+        raise ValueError(
+            f"HEATMAP_GOVERN_INTERVAL_S must be > 0, "
+            f"got {cfg.govern_interval_s}")
+    if cfg.govern_min_batch < 64:
+        raise ValueError(
+            f"HEATMAP_GOVERN_MIN_BATCH must be >= 64, "
+            f"got {cfg.govern_min_batch}")
+    if cfg.govern and cfg.govern_min_batch > cfg.batch_size:
+        raise ValueError(
+            f"HEATMAP_GOVERN_MIN_BATCH ({cfg.govern_min_batch}) above "
+            f"BATCH_SIZE ({cfg.batch_size}); the ladder floor cannot "
+            f"exceed its ceiling")
+    if cfg.govern_max_flush_k < 1:
+        raise ValueError(
+            f"HEATMAP_GOVERN_MAX_FLUSH_K must be >= 1, "
+            f"got {cfg.govern_max_flush_k}")
+    if not 0 <= cfg.govern_max_prefetch <= 32:
+        raise ValueError(
+            f"HEATMAP_GOVERN_MAX_PREFETCH must be in 0..32, "
+            f"got {cfg.govern_max_prefetch}")
+    if not 0 < cfg.govern_healthy_frac < 1:
+        raise ValueError(
+            f"HEATMAP_GOVERN_HEALTHY_FRAC must be in (0, 1), "
+            f"got {cfg.govern_healthy_frac}")
     if not 0 <= cfg.shard_oversample <= 64:
         raise ValueError(
             f"HEATMAP_SHARD_OVERSAMPLE must be in 0..64, "

@@ -162,6 +162,14 @@ class CompileTracker:
         return wrapped
 
     # ------------------------------------------------------------ reads
+    @property
+    def retraces_total(self) -> int:
+        """Lifetime post-warmup retrace count — the cheap accessor the
+        governor's per-step guardrail polls (snapshot() builds the full
+        per-function dict and is too heavy for a step-loop check)."""
+        with self._lock:
+            return len(self._retraces)
+
     def retraces_recent(self, window_s: float) -> int:
         cut = time.time() - window_s
         with self._lock:

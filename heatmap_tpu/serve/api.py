@@ -359,6 +359,20 @@ def healthz_payload(runtime, extra_checks=None) -> tuple[dict, bool]:
                 checks["event_age_p50_ms"] = {"value": round(p50_ms, 3),
                                               "budget": budget, "ok": ok}
                 degraded |= not ok
+        gov = getattr(runtime, "governor", None)
+        if gov is not None:
+            # adaptive micro-batching guardrail (stream/govern.py): a
+            # frozen governor means the no-retrace invariant tripped —
+            # degrade NAMING the latched bucket so the operator knows
+            # which shape left the ladder (knobs are pinned, the
+            # pipeline itself keeps running)
+            ok = not gov.frozen
+            checks["govern_frozen"] = {
+                "value": (f"frozen: {gov.frozen_why} "
+                          f"(bucket {gov.latched_bucket} latched)"
+                          if gov.frozen else "active"),
+                "ok": ok}
+            degraded |= not ok
         if runtime.writer.poisoned:
             checks["sink"] = {"value": "poisoned", "ok": False}
             down = True

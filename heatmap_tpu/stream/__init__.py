@@ -24,6 +24,7 @@ from heatmap_tpu.stream.events import EventColumns, parse_events  # noqa: F401
 from heatmap_tpu.stream.source import (  # noqa: F401
     JsonlReplaySource,
     MemorySource,
+    RampSource,
     Source,
     SyntheticSource,
 )

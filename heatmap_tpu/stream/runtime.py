@@ -475,7 +475,7 @@ class MicroBatchRuntime:
         # (engine.multi prekeys) and the sharded step (each host snaps
         # its LOCAL slice; parallel.sharded prekeys).
         self._host_snap = None
-        self._idle_keys = None
+        self._idle_keys: dict[int, dict] = {}  # zero snap keys by shape
         h3_impl = os.environ.get("HEATMAP_H3_IMPL", "auto")
         self._h3_env = h3_impl
         # Freeze the in-program snap POLICY now (r5 review): resolving
@@ -641,6 +641,49 @@ class MicroBatchRuntime:
         )
 
         self._maybe_resume()
+        # Adaptive micro-batching (stream/govern.py): with
+        # HEATMAP_GOVERN=1 the static batch/flush-K/prefetch knobs
+        # become INITIAL values and a feedback governor on the step
+        # loop resizes them against HEATMAP_SLO_FRESHNESS_P50_MS.
+        # Single-device fused path only: multi-host lockstep pins the
+        # knobs (accounting must advance identically on every host),
+        # and the mesh-sharded program's collective shapes are not on
+        # the warmed ladder.  Each H3-partitioned shard process
+        # (stream/shardmap.py) governs independently — skewed shards
+        # converge to different batch sizes while the watermark-aligned
+        # cutoff stays fleet-bounded; the fleet member snapshot carries
+        # the decisions via the govern gauge families.  Constructed
+        # AFTER the resume: a restored-and-grown slab must be warmed at
+        # its final shape.
+        self.governor = None
+        if cfg.govern:
+            if self._multiproc or self._multi is None:
+                log.warning(
+                    "HEATMAP_GOVERN=1 ignored: the governor runs the "
+                    "single-device fused path only (multi-host/mesh "
+                    "runs pin their knobs for lockstep)")
+            else:
+                from heatmap_tpu.stream.govern import BatchGovernor
+
+                gov = BatchGovernor(
+                    cfg, self.metrics.registry,
+                    event_age=self.metrics.event_age.labels(bound="mean"),
+                    compile_tracker=self.runtimeinfo.compile,
+                    memory=self.runtimeinfo.memory)
+                # the ladder warmup below is len(ladder) extra calls into
+                # the instrumented step before steady state; widen the
+                # tracker's warmup so they never read as retraces
+                self.runtimeinfo.compile.warmup += len(gov.ladder)
+                self._warm_ladder(gov.ladder)
+                # re-baseline AFTER warming: any retrace from here on
+                # (a slab grow invalidating the warmed shapes, an
+                # unwarmed shape slipping through) freezes the governor
+                gov._retrace_base = gov._retraces()
+                self.governor = gov
+                if self.flightrec is not None:
+                    self.flightrec.add_source(
+                        "govern", lambda: (self.governor.snapshot()
+                                           if self.governor else None))
         # offsets as of the last DISPATCHED batch: checkpoints commit these,
         # never the live source offsets, so a batch polled but not yet
         # dispatched (exception between poll and dispatch) always replays
@@ -1495,6 +1538,60 @@ class MicroBatchRuntime:
         return (self._n_active_peak * skew + self._grow_margin()
                 > agg.capacity_per_shard * shards)
 
+    # ------------------------------------------------------- governor
+    def _warm_ladder(self, ladder) -> None:
+        """Precompile the fused step at every governor pad bucket.
+
+        One all-invalid dispatch per bucket (through the instrumented
+        entry point, so the jit cache the CompileTracker probes is the
+        one that warms): every row masked invalid makes the fold an
+        identity on the EMPTY state — zero sums re-normalize to zero
+        bits, no key slots mint, nothing emits, and the results are
+        discarded without touching the ring/epoch/offsets.  After this,
+        a governed bucket move is a pure cache hit; any later compile
+        IS a retrace and freezes the governor (stream/govern.py
+        guardrail 1).  On a resumed non-empty state the dispatch is
+        value-preserving (the per-batch Kahan re-normalization), which
+        is why the governor is constructed after the resume and warmed
+        exactly once."""
+        t0 = time.monotonic()
+        for n in ladder:
+            zf = np.zeros(n, np.float32)
+            feed = {"lat": zf, "lng": zf, "speed": zf,
+                    "ts": np.zeros(n, np.int32),
+                    "valid": np.zeros(n, bool)}
+            prekeys = None
+            if self._host_snap is not None:
+                prekeys = self._presnap(feed["lat"], feed["lng"],
+                                        feed["valid"], None,
+                                        self._multi._uniq_res)
+            self._multi.step_packed_all(
+                feed["lat"], feed["lng"], feed["speed"], feed["ts"],
+                feed["valid"], I32_MIN, prekeys=prekeys)
+        log.info("governor bucket ladder warmed: %s rows (%.2fs)",
+                 ladder, time.monotonic() - t0)
+
+    def _govern_step(self) -> None:
+        """Apply the governor's decisions at a step boundary (the feed
+        stage re-reads ``_feed_batch`` per poll; per-entry offset
+        snapshots keep checkpoints dispatch-aligned across size
+        changes)."""
+        gov = self.governor
+        gov.check_retrace()
+        gov.decide()
+        if gov.batch_rows != self._feed_batch:
+            self._feed_batch = gov.batch_rows
+        k = gov.flush_k
+        if k != self._ring.capacity:
+            # forced flush at the transition: pending entries drain
+            # under the OLD interval, so sink ordering and replay
+            # equivalence are untouched by the retarget (and a shrink
+            # can never strand more entries than the new capacity)
+            self.flush_pending()
+            self._ring.capacity = max(1, int(k))
+        if gov.prefetch != self._prefetch_n:
+            self._prefetch_n = gov.prefetch
+
     # ------------------------------------------------------------------
     def step_once(self) -> bool:
         """Run one micro-batch; returns False when the source yielded nothing."""
@@ -1643,6 +1740,10 @@ class MicroBatchRuntime:
 
     def _step_once_inner(self) -> bool:
         t0 = time.monotonic()
+        if self.governor is not None:
+            # control step + decision apply at the step boundary — the
+            # feed poll below reads the (possibly resized) bucket
+            self._govern_step()
         if self._prefetched:
             entry = self._prefetched.popleft()
         else:
@@ -1650,6 +1751,8 @@ class MicroBatchRuntime:
         if entry is None and not self._multiproc:
             # idle poll: settle the parked batches so stats/sink catch up
             self.flush_pending()
+            if self.governor is not None:
+                self.governor.note_idle()
             return False
         if entry is None:
             # multi-host lockstep: peers may have events and are entering
@@ -1673,8 +1776,13 @@ class MicroBatchRuntime:
         # is also the barrier (checkpoint, close, idle polls) that keeps
         # commit ordering and end-of-stream semantics exact.
         self._last_pull_s = 0.0  # only THIS window's pull is attributed
-        if (self._ring.full or self._wm_flush_due()
-                or self._grow_would_trigger()):
+        grow_due = self._grow_would_trigger()
+        if self._ring.full or self._wm_flush_due() or grow_due:
+            if grow_due and self.governor is not None:
+                # the EmitRing growth-pressure path can force the
+                # governor a step down (guardrail 2): parked batches
+                # were holding unaccounted minting against the slab
+                self.governor.note_growth_pressure()
             self.flush_pending()
             self._maybe_grow()
         wm_max = self._effective_max_ts()
@@ -1712,6 +1820,8 @@ class MicroBatchRuntime:
                 feed["lat"], feed["lng"], feed["speed"], feed["ts"],
                 feed["valid"], cutoff, prekeys=prekeys)
         self._ring.append(packed, self.epoch)
+        if self.governor is not None:
+            self.governor.note_dispatch(n)
         if lin is not None:
             self.lineage.ring_entered(lin)
             self._lineage_open[self.epoch] = lin
@@ -1849,10 +1959,15 @@ class MicroBatchRuntime:
         if self._host_snap is None:
             return None
         if cols is None:
-            if self._idle_keys is None:
+            # cached zero keys PER FEED SHAPE: the governor's bucket
+            # ladder (and the warmup over it) dispatches several pad
+            # shapes through one runtime
+            cached = self._idle_keys.get(len(lat))
+            if cached is None:
                 z = np.zeros(len(lat), np.uint32)
-                self._idle_keys = {r: (z, z) for r in uniq_res}
-            return self._idle_keys
+                cached = self._idle_keys[len(lat)] = {
+                    r: (z, z) for r in uniq_res}
+            return cached
         nz = np.flatnonzero(valid)
         n_live = int(nz[-1]) + 1 if nz.size else 0
         reuse_res = None

@@ -308,3 +308,122 @@ def test_serve_and_bench_gates_compose(tmp_path, capsys):
     _write_serve(tmp_path, 1, p99=100.0, wire=1_000_000, replicas=2)
     _write_serve(tmp_path, 2, p99=500.0, wire=1_000_000, replicas=2)
     assert mod.main(["--dir", str(tmp_path)]) == 1
+
+
+# ------------------------------------------------- govern provenance
+def _write_gov(dir_path, rnd, value, govern):
+    """BENCH_r artifact with a top-level govern stamp."""
+    p = _write(dir_path, rnd, value)
+    art = json.loads(p.read_text())
+    art["govern"] = govern
+    p.write_text(json.dumps(art))
+    return p
+
+
+def test_mixed_govern_pair_refused(tmp_path, capsys):
+    m = _load()
+    _write_gov(tmp_path, 1, 1_000_000.0, {"enabled": False})
+    _write_gov(tmp_path, 2, 990_000.0, {"enabled": True,
+                                        "min_batch": 4096})
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    err = capsys.readouterr().err
+    assert "govern mismatch" in err and "HEATMAP_GOVERN" in err
+
+
+def test_same_govern_pair_still_compares(tmp_path, capsys):
+    m = _load()
+    _write_gov(tmp_path, 1, 1_000_000.0, {"enabled": True})
+    _write_gov(tmp_path, 2, 900_000.0, {"enabled": True})
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_govern_read_from_headline_line(tmp_path, capsys):
+    """The stamp parses out of the tail metric line too (bench.py
+    prints it there; the artifact wrapper may not hoist it)."""
+    m = _load()
+    tail1 = json.dumps({"metric": "m", "value": 1_000_000.0,
+                        "govern": {"enabled": False}})
+    tail2 = json.dumps({"metric": "m", "value": 990_000.0,
+                        "govern": {"enabled": True}})
+    _write(tmp_path, 1, tail=tail1)
+    _write(tmp_path, 2, tail=tail2)
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "govern mismatch" in capsys.readouterr().err
+
+
+def test_missing_govern_stays_comparable(tmp_path):
+    """Pre-governor artifacts carry no stamp and stay comparable —
+    the gate must not retroactively fail history."""
+    m = _load()
+    _write(tmp_path, 1, 1_000_000.0)
+    _write_gov(tmp_path, 2, 900_000.0, {"enabled": True})
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
+
+
+# --------------------------------------------- BENCH_GOVERN ratchet
+def _write_govern_ramp(dir_path, rnd, low_p50=0.5, high_eps=100_000.0,
+                       rc=0, schedule=((100.0, 10.0), (10_000.0, 15.0),
+                                       (100.0, 10.0))):
+    p = dir_path / f"BENCH_GOVERN_r{rnd:02d}.json"
+    phases = []
+    for eps, dur in schedule:
+        lowish = eps == min(e for e, _ in schedule)
+        phases.append({"offered_eps": eps, "duration_s": dur,
+                       "consumed_eps": (eps if lowish else high_eps),
+                       "age_p50_s": (low_p50 if lowish else 2.0)})
+    p.write_text(json.dumps({
+        "rc": rc,
+        "governed": {"phases": phases},
+        "static": {"phases": phases},
+    }))
+    return p
+
+
+def test_govern_ramp_ok_within_threshold(tmp_path, capsys):
+    m = _load()
+    _write_govern_ramp(tmp_path, 1, low_p50=0.5, high_eps=100_000.0)
+    _write_govern_ramp(tmp_path, 2, low_p50=0.6, high_eps=95_000.0)
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
+    assert "govern r01" in capsys.readouterr().out
+
+
+def test_govern_ramp_p50_regression_fails(tmp_path, capsys):
+    m = _load()
+    _write_govern_ramp(tmp_path, 1, low_p50=0.5)
+    _write_govern_ramp(tmp_path, 2, low_p50=2.0)  # 4x worse freshness
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "freshness regression" in capsys.readouterr().err
+
+
+def test_govern_ramp_rate_regression_fails(tmp_path, capsys):
+    m = _load()
+    _write_govern_ramp(tmp_path, 1, high_eps=100_000.0)
+    _write_govern_ramp(tmp_path, 2, high_eps=30_000.0)  # -70%
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "rate regression" in capsys.readouterr().err
+
+
+def test_govern_ramp_schedule_mismatch_refused(tmp_path, capsys):
+    m = _load()
+    _write_govern_ramp(tmp_path, 1)
+    _write_govern_ramp(tmp_path, 2,
+                       schedule=((100.0, 10.0), (50_000.0, 15.0),
+                                 (100.0, 10.0)))
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "schedule mismatch" in capsys.readouterr().err
+
+
+def test_govern_ramp_single_artifact_ok(tmp_path, capsys):
+    m = _load()
+    _write_govern_ramp(tmp_path, 1)
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
+    assert "nothing to compare" in capsys.readouterr().out
+
+
+def test_govern_ramp_failed_run_skipped(tmp_path, capsys):
+    m = _load()
+    _write_govern_ramp(tmp_path, 1)
+    _write_govern_ramp(tmp_path, 2, rc=1)
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
+    assert "skipping govern r02" in capsys.readouterr().out

@@ -38,10 +38,20 @@ fleet's aggregate latency/bytes say nothing about a 1-replica round,
 and comparing them would mask exactly the per-replica regression the
 ratchet exists to catch.
 
+Adaptive-governor provenance (ISSUE 10) joins the refusal list: a
+round measured with HEATMAP_GOVERN=1 (the ``govern`` stamp) is refused
+against a static-knob round — a governor that traded freshness for
+rate (or vice versa) must not mask a static-path regression.  And the
+``BENCH_GOVERN_r*.json`` ramp artifacts (tools/e2e_rate.py --ramp) are
+ratcheted on both sides of the swing at once: the governed run's
+post-swing low-phase p50 may not grow and its high-phase consumption
+rate may not drop past the threshold; artifacts banked over different
+ramp schedules are refused outright.
+
 Usage:
     python tools/check_bench_regress.py [--dir REPO] [--threshold 0.5]
 Exit codes: 0 ok / nothing to compare, 1 regression or mixed-backend /
-mixed-replica pair, 2 bad arguments.
+mixed-replica / mixed-govern pair, 2 bad arguments.
 """
 
 from __future__ import annotations
@@ -125,6 +135,16 @@ def shard_count(path: str) -> int | None:
     sharded rounds); None on pre-sharding artifacts."""
     v = _stamped(path, "shards", int)
     return int(v) if v is not None else None
+
+
+def govern_enabled(path: str) -> bool | None:
+    """The artifact's adaptive-governor provenance (``"govern"`` stamp,
+    ISSUE 10): True/False when stamped, None on pre-governor
+    artifacts (comparable to anything, like the other stamps)."""
+    v = _stamped(path, "govern", dict)
+    if not isinstance(v, dict) or "enabled" not in v:
+        return None
+    return bool(v.get("enabled"))
 
 
 def newest_pair(dir_path: str) -> list:
@@ -224,6 +244,104 @@ def compare_serve(dir_path: str, threshold: float) -> int:
     return rc
 
 
+# ------------------------------------------------------ govern artifacts
+_GOVERN_ROUND_RE = re.compile(r"BENCH_GOVERN_r(\d+)\.json$")
+
+
+def govern_artifact_round(path: str) -> int | None:
+    m = _GOVERN_ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def govern_metrics(path: str) -> tuple | None:
+    """(recovery_low_p50_s, high_phase_eps, schedule_sig) of one
+    BENCH_GOVERN_r*.json ramp artifact — the governed run's post-swing
+    low-phase p50 (lower-better) and high-phase consumption rate
+    (higher-better), plus the offered schedule as the comparability
+    key.  None when the run failed or the phases don't parse."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            art = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(art, dict) or art.get("rc", 0) != 0:
+        return None
+    gov = art.get("governed")
+    phases = (gov or {}).get("phases")
+    if not isinstance(phases, list) or not phases:
+        return None
+    try:
+        offered = [p["offered_eps"] for p in phases]
+        lows = [p for p in phases if p["offered_eps"] == min(offered)]
+        highs = [p for p in phases if p["offered_eps"] == max(offered)]
+        low_p50 = lows[-1].get("age_p50_s")      # the post-swing side
+        high_eps = highs[-1].get("consumed_eps")
+    except (KeyError, TypeError):
+        return None
+    if not isinstance(low_p50, (int, float)) \
+            or not isinstance(high_eps, (int, float)) or high_eps <= 0:
+        return None
+    sig = tuple((p.get("offered_eps"), p.get("duration_s"))
+                for p in phases)
+    return (float(low_p50), float(high_eps), sig)
+
+
+def compare_govern(dir_path: str, threshold: float) -> int:
+    """Ratchet the newest two BENCH_GOVERN_r*.json artifacts: the
+    governed run's post-swing low-phase p50 may not GROW past
+    ``threshold`` and its high-phase rate may not DROP past it.
+    Artifacts banked over DIFFERENT ramp schedules are refused (exit
+    1) — the phases aren't the same experiment, mirroring the
+    backend/shards/replica refusals."""
+    arts = []
+    for p in glob.glob(os.path.join(glob.escape(dir_path),
+                                    "BENCH_GOVERN_r*.json")):
+        rnd = govern_artifact_round(p)
+        if rnd is None:
+            continue
+        arts.append((rnd, p, govern_metrics(p)))
+    arts.sort()
+    usable = [(r, p, m) for r, p, m in arts if m is not None]
+    for r, p, m in arts:
+        if m is None:
+            print(f"note: skipping govern r{r:02d} "
+                  f"({os.path.basename(p)}): failed run or no "
+                  f"parseable governed phases")
+    if len(usable) < 2:
+        print(f"OK: {len(usable)} usable govern artifact(s) — nothing "
+              f"to compare")
+        return 0
+    (r_prev, _pp, m_prev), (r_new, _pn, m_new) = usable[-2], usable[-1]
+    (p50_prev, eps_prev, sig_prev) = m_prev
+    (p50_new, eps_new, sig_new) = m_new
+    if sig_prev != sig_new:
+        print(f"FAIL: ramp-schedule mismatch — govern r{r_prev:02d} and "
+              f"r{r_new:02d} ran different offered-load schedules; the "
+              f"phase numbers aren't the same experiment — re-run the "
+              f"ramp with the previous schedule", file=sys.stderr)
+        return 1
+    rc = 0
+    growth = (p50_new - p50_prev) / p50_prev if p50_prev > 0 else 0.0
+    line = (f"govern r{r_prev:02d} low-phase p50 {p50_prev:.3f}s -> "
+            f"r{r_new:02d} {p50_new:.3f}s ({growth:+.1%})")
+    if growth > threshold:
+        print(f"FAIL: governed low-load freshness regression beyond "
+              f"{threshold:.0%}: {line}", file=sys.stderr)
+        rc = 1
+    else:
+        print(f"OK: {line} within the {threshold:.0%} threshold")
+    drop = (eps_prev - eps_new) / eps_prev
+    line = (f"govern r{r_prev:02d} high-phase {eps_prev:,.0f} ev/s -> "
+            f"r{r_new:02d} {eps_new:,.0f} ev/s ({-drop:+.1%})")
+    if drop > threshold:
+        print(f"FAIL: governed high-load rate regression beyond "
+              f"{threshold:.0%}: {line}", file=sys.stderr)
+        rc = 1
+    else:
+        print(f"OK: {line} within the {threshold:.0%} threshold")
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--dir", default=REPO,
@@ -237,6 +355,7 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     serve_rc = compare_serve(args.dir, args.threshold)
+    serve_rc = compare_govern(args.dir, args.threshold) or serve_rc
 
     arts = newest_pair(args.dir)
     usable = [(r, p, v) for r, p, v in arts if v is not None]
@@ -254,6 +373,17 @@ def main(argv=None) -> int:
               f"{bp_prev!r} but r{r_new:02d} ran on {bp_new!r}; a "
               f"fallback round cannot stand in for an attached headline "
               f"(re-run the bench on the same backend)", file=sys.stderr)
+        return 1
+    gv_prev, gv_new = govern_enabled(p_prev), govern_enabled(p_new)
+    if gv_prev is not None and gv_new is not None and gv_prev != gv_new:
+        print(f"FAIL: govern mismatch — r{r_prev:02d} ran "
+              f"{'governed' if gv_prev else 'static knobs'} but "
+              f"r{r_new:02d} ran "
+              f"{'governed' if gv_new else 'static knobs'}; an "
+              f"adaptively-governed round cannot stand in for a "
+              f"static-knob headline (or mask its regression) — re-run "
+              f"the bench with the same HEATMAP_GOVERN setting",
+              file=sys.stderr)
         return 1
     sh_prev, sh_new = shard_count(p_prev), shard_count(p_new)
     if sh_prev is not None and sh_new is not None and sh_prev != sh_new:

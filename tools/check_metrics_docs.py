@@ -104,6 +104,22 @@ def _smoke_repl():
     return list(reg._families.values())
 
 
+def _smoke_govern():
+    """CONSTRUCTED adaptive-batching governor (stream/govern.py): its
+    metric families only register under HEATMAP_GOVERN=1, which none
+    of the runtime smokes above enable.  Construction alone registers
+    the families; no control loop runs."""
+    from heatmap_tpu.config import load_config
+    from heatmap_tpu.obs.registry import Registry
+    from heatmap_tpu.stream.govern import BatchGovernor
+
+    cfg = load_config({}, batch_size=256, govern=True,
+                      govern_min_batch=64)
+    reg = Registry()
+    BatchGovernor(cfg, reg)
+    return list(reg._families.values())
+
+
 def main() -> int:
     os.environ.setdefault("HEATMAP_PLATFORM", "cpu")
     with open(os.path.join(REPO, "ARCHITECTURE.md"),
@@ -118,6 +134,8 @@ def main() -> int:
              if f.name not in seen]
     seen = {f.name for f in fams}
     fams += [f for f in _smoke_repl() if f.name not in seen]
+    seen = {f.name for f in fams}
+    fams += [f for f in _smoke_govern() if f.name not in seen]
     for fam in fams:
         if not fam.help.strip():
             failures.append(f"{fam.name}: empty HELP string")

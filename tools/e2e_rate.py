@@ -242,6 +242,10 @@ def _shard_fleet_child(q, a: dict, index: int) -> None:
         "tiles_written": rt.writer.counters["tiles_written"],
         "spans_p50_ms": spans,
         "freshness": rt.metrics.freshness_summary(),
+        # per-shard governor outcome: skewed shards converge to
+        # DIFFERENT effective batch sizes, and the artifact shows it
+        "govern": (dict(enabled=True, **rt.governor.snapshot())
+                   if rt.governor is not None else {"enabled": False}),
     })
 
 
@@ -260,6 +264,10 @@ def shard_fleet_main(args) -> int:
         over["emit_flush_k"] = args.flush_k
     if args.prefetch is not None:
         over["prefetch_batches"] = args.prefetch
+    if args.govern:
+        over["govern"] = True
+        over["govern_min_batch"] = max(
+            64, min(args.govern_min_batch, args.batch))
     chan_dir = tempfile.mkdtemp(prefix="e2e-fleet-chan-")
     a = {
         "events": args.events, "vehicles": args.vehicles,
@@ -337,11 +345,178 @@ def shard_fleet_main(args) -> int:
         "shard_imbalance_max_over_mean": round(
             max(steadies) / (sum(steadies) / len(steadies)), 3)
         if len(steadies) > 1 else None,
+        "govern": {"enabled": bool(args.govern)},
         "per_shard": results,
     }
     from heatmap_tpu.obs.fleet import repl_stamp
 
     out.update(repl_stamp())  # replica count + max lag when attached
+    print(json.dumps(out))
+    return 0
+
+
+def _ramp_phase_stats(schedule, samples, t0: float) -> list:
+    """Per-phase digest of a ramp run: steady consumption rate (from
+    the offset delta over the phase) and the event-age p50 over the
+    phase's settled second half (the first half is the transition the
+    governor is still reacting to)."""
+    out = []
+    t_lo = t0
+    for rate, dur in schedule:
+        t_hi = t_lo + dur
+        inside = [s for s in samples if t_lo <= s["t"] < t_hi]
+        settled = [s for s in inside if s["t"] >= t_lo + dur / 2]
+        ages = sorted(s["age_p50_s"] for s in settled
+                      if s.get("age_p50_s") is not None)
+        offs = [s["offset"] for s in inside]
+        span = (inside[-1]["t"] - inside[0]["t"]) if len(inside) > 1 else 0
+        out.append({
+            "offered_eps": rate,
+            "duration_s": dur,
+            "consumed_eps": (round((offs[-1] - offs[0]) / span, 1)
+                             if span > 0 else None),
+            "age_p50_s": (round(ages[len(ages) // 2], 3)
+                          if ages else None),
+            "max_backlog": max((s["backlog"] for s in inside),
+                               default=0),
+        })
+        t_lo = t_hi
+    return out
+
+
+def _effective_knobs(rt) -> dict:
+    """The knob values a runtime is ACTUALLY executing with — the
+    governor's live decisions when enabled, the static plumbing
+    otherwise.  One helper so every artifact stamp agrees."""
+    gov = rt.governor
+    if gov is not None:
+        return {"batch_rows": gov.batch_rows, "flush_k": gov.flush_k,
+                "prefetch": gov.prefetch}
+    return {"batch_rows": rt._feed_batch,
+            "flush_k": rt._ring.capacity,
+            "prefetch": rt._prefetch_n}
+
+
+def ramp_main(args) -> int:
+    """--ramp: piecewise offered-load schedule against the FULL runtime
+    (stream.RampSource — a real backlog queue, so falling behind shows
+    up as genuine event age), stamping the governor's decision trail
+    plus p50-vs-time into the artifact.  ``--govern`` runs it governed
+    (HEATMAP_GOVERN semantics); without it the static knobs hold, which
+    is the baseline the BENCH_GOVERN_r* bank compares against."""
+    import threading
+
+    from heatmap_tpu.config import load_config
+    from heatmap_tpu.sink import MemoryStore
+    from heatmap_tpu.stream import MicroBatchRuntime, RampSource
+
+    try:
+        schedule = [(float(r), float(d)) for r, d in
+                    (p.split(":") for p in args.ramp.split(","))]
+    except ValueError:
+        print("e2e_rate: --ramp wants 'eps:seconds,eps:seconds,...'",
+              file=sys.stderr)
+        return 2
+    over = {}
+    if args.flush_k is not None:
+        over["emit_flush_k"] = args.flush_k
+    if args.prefetch is not None:
+        over["prefetch_batches"] = args.prefetch
+    cfg = load_config(
+        {"H3_RESOLUTIONS": args.resolutions,
+         "WINDOW_MINUTES": args.windows},
+        batch_size=args.batch, state_capacity_log2=args.cap_log2,
+        state_max_log2=args.cap_log2 + 3, grow_margin="observed",
+        speed_hist_bins=32, store="memory", govern=args.govern,
+        govern_min_batch=max(64, min(args.govern_min_batch, args.batch)),
+        trigger_ms=args.trigger_ms, query_view=False,
+        checkpoint_dir=tempfile.mkdtemp(prefix="e2e-ramp-ckpt-"), **over)
+    src = RampSource(schedule, clock=time.time)
+    store = MemoryStore()
+    rt = MicroBatchRuntime(cfg, src, store,
+                           positions_enabled=not args.no_positions,
+                           checkpoint_every=0)
+    t0 = time.time()
+    # wall <-> monotonic offset: the governor's trail stamps its own
+    # (monotonic) clock — re-anchor them onto the samples' wall
+    # timeline so the decision trail correlates with p50-vs-time
+    mono_off = t0 - time.monotonic()
+    sched_end = t0 + sum(d for _, d in schedule)
+    run_err = []
+
+    def _run():
+        try:
+            rt.run()
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            run_err.append(e)
+
+    th = threading.Thread(target=_run, daemon=True)
+    th.start()
+    samples = []
+    while th.is_alive():
+        time.sleep(0.5)
+        now = time.time()
+        if now > sched_end + args.drain_s:
+            # drain bound: a config that fell 10x behind must not
+            # stretch the run by its whole backlog's drain time — the
+            # leftover backlog is visible in the samples either way
+            src.stop()
+        tail = rt.lineage.tail(64)
+        ages = sorted(r["age_s"]["mean"] for r in tail
+                      if "age_s" in r and r.get("t_sink", 0) >= now - 2.0)
+        samples.append({
+            "t": round(now, 2),
+            "offset": int(src.offset()),
+            "backlog": int(src.backlog),
+            "age_p50_s": (round(ages[len(ages) // 2], 3)
+                          if ages else None),
+            **_effective_knobs(rt),
+        })
+    th.join()
+    if run_err:
+        # a crashed run must not bank a clean-looking artifact: stamp
+        # rc (the BENCH_GOVERN ratchet skips rc != 0) and exit nonzero
+        print(json.dumps({"mode": "ramp", "rc": 1,
+                          "error": repr(run_err[0])}))
+        print(f"e2e_rate: ramp runtime failed: {run_err[0]!r}",
+              file=sys.stderr)
+        return 1
+    gov = rt.governor
+    ri = rt.runtimeinfo.compile.snapshot()
+    trail = []
+    if gov is not None:
+        # re-stamp each decision onto the wall timeline (t_wall) next
+        # to its raw monotonic stamp, so the trail lines up with the
+        # samples above
+        trail = [dict(t, t_wall=round(t["t"] + mono_off, 2))
+                 for t in gov.trail]
+    out = {
+        "mode": "ramp",
+        "rc": 0,
+        "topology": ("piecewise offered-load RampSource (real backlog "
+                     "queue) -> full MicroBatchRuntime -> "
+                     "packed-columnar MemoryStore"),
+        "schedule": [{"eps": r, "duration_s": d} for r, d in schedule],
+        "trigger_ms": cfg.trigger_ms,
+        "batch": args.batch,
+        "flush_k": cfg.emit_flush_k,
+        "prefetch": cfg.prefetch_batches,
+        # EFFECTIVE knob values at end of run (post-governor when
+        # enabled) — artifacts must be self-describing about what the
+        # run actually executed with, not what the env configured
+        "effective": _effective_knobs(rt),
+        "govern": (dict(gov.bounds(), frozen=gov.frozen)
+                   if gov is not None else {"enabled": False}),
+        "govern_trail": trail,
+        "govern_adjustments": len(trail),
+        "retraces_after_warmup": ri["retraces_after_warmup"],
+        "phases": _ramp_phase_stats(schedule, samples, t0),
+        "samples": samples,
+        "events_consumed": int(src.offset()),
+        "slo_freshness_p50_ms": float(os.environ.get(
+            "HEATMAP_SLO_FRESHNESS_P50_MS", "10000") or 10000),
+        "freshness": rt.metrics.freshness_summary(),
+    }
     print(json.dumps(out))
     return 0
 
@@ -412,6 +587,33 @@ def main() -> int:
                     "default isolated/sequential schedule that "
                     "measures per-shard capacity as deployed one "
                     "core per shard")
+    ap.add_argument("--ramp", default=None,
+                    help="piecewise offered-load schedule "
+                    "'eps:seconds,eps:seconds,...' (e.g. "
+                    "'20000:10,2000000:15,20000:12' = a 100x swing up "
+                    "and back).  Runs the full runtime against a real "
+                    "backlog queue (stream.RampSource) and stamps "
+                    "p50-vs-time plus the governor decision trail into "
+                    "the artifact.  Memory store only")
+    ap.add_argument("--govern", action="store_true",
+                    help="with --ramp (or the plain run): enable the "
+                    "adaptive micro-batching governor "
+                    "(HEATMAP_GOVERN=1 semantics, stream/govern.py); "
+                    "without it the static knobs hold — the baseline "
+                    "side of the BENCH_GOVERN_r* comparison")
+    ap.add_argument("--govern-min-batch", type=int, default=4096,
+                    help="governor bucket-ladder floor "
+                    "(HEATMAP_GOVERN_MIN_BATCH)")
+    ap.add_argument("--drain-s", type=float, default=30.0,
+                    help="with --ramp: seconds past the schedule end "
+                    "before the leftover backlog is abandoned (the "
+                    "unconsumed remainder stays visible in the "
+                    "artifact's samples)")
+    ap.add_argument("--trigger-ms", type=int, default=0,
+                    help="minimum micro-batch trigger interval "
+                    "(TRIGGER_MS); the ramp mode uses it to pin the "
+                    "step cadence so capacity scales with batch size "
+                    "the way an accelerator-bound deployment does")
     ap.add_argument("--cap-log2", type=int, default=17,
                     help="starting state slab rows per shard (log2).  The "
                     "run uses grow_margin=observed with headroom to grow "
@@ -425,6 +627,9 @@ def main() -> int:
                     "armed and overflow accounting loud if the workload "
                     "assumption ever breaks")
     args = ap.parse_args()
+
+    if args.ramp is not None:
+        return ramp_main(args)
 
     if args.shards is not None:
         if args.shards < 1:
@@ -502,7 +707,8 @@ def main() -> int:
          "WINDOW_MINUTES": args.windows},
         batch_size=args.batch, state_capacity_log2=args.cap_log2,
         state_max_log2=args.cap_log2 + 3, grow_margin="observed",
-        speed_hist_bins=32, store=args.store,
+        speed_hist_bins=32, store=args.store, govern=args.govern,
+        govern_min_batch=max(64, min(args.govern_min_batch, args.batch)),
         checkpoint_dir=tempfile.mkdtemp(prefix="e2e-rate-ckpt-"), **over)
     syn = SyntheticSource(n_events=args.events, n_vehicles=args.vehicles,
                           events_per_second=args.batch * 4)
@@ -645,6 +851,13 @@ def main() -> int:
         # amortization the ring buys (acceptance: >= 4x at default K)
         "flush_k": cfg.emit_flush_k,
         "prefetch": cfg.prefetch_batches,
+        # the EFFECTIVE values the run ended on (== configured unless
+        # the governor moved them): artifacts are self-describing about
+        # what actually executed, and check_bench_regress refuses
+        # governed-vs-ungoverned comparisons off the `govern` stamp
+        "effective": _effective_knobs(rt),
+        "govern": (dict(rt.governor.bounds(), frozen=rt.governor.frozen)
+                   if rt.governor is not None else {"enabled": False}),
         "n_batches": rt.epoch,
         "emit_pulls": snap.get("emit_pulls", 0),
         "emit_pull_batches": snap.get("emit_pull_batches", 0),

@@ -6,7 +6,10 @@ operator watches during an incident: ingest rate, batch p50/p95,
 end-to-end freshness (event-age p50/p99, through the prefetch queue and
 the device emit ring — obs.lineage), emit-ring depth, sink queue/
 backpressure, compile/retrace activity and device-memory watermarks
-(obs.runtimeinfo), and the /healthz SLO verdict.  Rates and recent quantiles
+(obs.runtimeinfo), the adaptive micro-batching governor's live
+batch/flush-K/prefetch decisions + last-adjust + frozen state
+(stream/govern.py — a per-member governor table in ``--fleet``), and
+the /healthz SLO verdict.  Rates and recent quantiles
 are computed from DELTAS between successive scrapes of the cumulative
 Prometheus histograms, so the display tracks the last interval, not the
 lifetime distribution.
@@ -197,6 +200,23 @@ def render_frame(m: dict, prev: dict | None, dt: float,
         f"  memory    in-use {fmt(mem, ' MB', 1 / 1e6):>12}   "
         f"watermark {fmt(mem_wm, ' MB', 1 / 1e6)}   "
         f"ring slab {fmt(_val(m, 'heatmap_emit_ring_slab_bytes'), ' MB', 1 / 1e6)}")
+    # adaptive micro-batching governor (stream/govern.py): the live
+    # knob decisions, the most recent adjustment (reason recovered from
+    # the adjust-counter labelset that grew since the last scrape), and
+    # the frozen guardrail state
+    gb = _val(m, "heatmap_govern_batch_rows")
+    if gb is not None:
+        last = _last_adjust(m, prev)
+        frozen = (_val(m, "heatmap_govern_frozen") or 0) > 0
+        age = _val(m, "heatmap_govern_last_adjust_age_seconds")
+        lines.append(
+            f"  governor  batch {fmt(gb, digits=0):>12}   "
+            f"flush-K {fmt(_val(m, 'heatmap_govern_flush_k'), digits=0)}"
+            f"   prefetch "
+            f"{fmt(_val(m, 'heatmap_govern_prefetch'), digits=0)}   "
+            f"last adjust {fmt(age, ' s ago', digits=0)}"
+            + (f" ({last})" if last else "")
+            + ("   FROZEN" if frozen else ""))
     if health is not None:
         status = health.get("status", "?")
         bad = [k for k, c in health.get("checks", {}).items()
@@ -205,6 +225,21 @@ def render_frame(m: dict, prev: dict | None, dt: float,
         lines.append(f"  SLO       {status.upper()}"
                      + (f"   failing: {', '.join(bad)}" if bad else ""))
     return "\n".join(lines) + "\n"
+
+
+def _last_adjust(m: dict, prev: dict | None) -> str | None:
+    """The governor adjust-counter labelset that grew since the last
+    scrape, rendered ``dir/reason`` — the most recent adjustment's
+    direction and control-law reason (None on the first frame or a
+    quiet interval)."""
+    cur = m.get("heatmap_govern_adjust_total") or {}
+    was = (prev or {}).get("heatmap_govern_adjust_total") or {}
+    for labels, v in cur.items():
+        if v > was.get(labels, 0.0):
+            d = _label_of(labels, "dir") or "?"
+            r = _label_of(labels, "reason") or "?"
+            return f"{d}/{r}"
+    return None
 
 
 def _label_of(labels_str: str, key: str) -> str | None:
@@ -318,6 +353,26 @@ def render_fleet_frame(m: dict, prev: dict | None, dt: float,
         lines.append(f"  imbalance max/mean "
                      f"{fmt(imbalance, 'x', digits=2)}   aggregate "
                      f"{fmt(sum(known) if known else None, ' ev/s', digits=0)}")
+    # per-member adaptive governors (stream/govern.py): each shard
+    # governs independently, so skewed load shows up as DIFFERENT
+    # converged batch sizes — this table makes that visible, plus the
+    # frozen guardrail state per member
+    gov_batch = _by_proc(m, "heatmap_govern_batch_rows")
+    if gov_batch:
+        gov_flush = _by_proc(m, "heatmap_govern_flush_k")
+        gov_pre = _by_proc(m, "heatmap_govern_prefetch")
+        gov_frozen = _by_proc(m, "heatmap_govern_frozen")
+        gov_age = _by_proc(m, "heatmap_govern_last_adjust_age_seconds")
+        lines.append("")
+        lines.append(f"  {'governor':<14}{'batch':>9}{'flush-K':>9}"
+                     f"{'prefetch':>10}{'adjusted':>10}  state")
+        for tag in sorted(gov_batch):
+            lines.append(
+                f"  {tag:<14}{fmt(gov_batch[tag], digits=0):>9}"
+                f"{fmt(gov_flush.get(tag), digits=0):>9}"
+                f"{fmt(gov_pre.get(tag), digits=0):>10}"
+                f"{fmt(gov_age.get(tag), ' s ago', digits=0):>10}"
+                f"  {'FROZEN' if gov_frozen.get(tag) else 'active'}")
     # replicated serve fleet (query.repl): one row per serve-role
     # member — replication seq lag, open SSE clients, and the 304
     # ratio that says the ETag tier is actually absorbing polls
