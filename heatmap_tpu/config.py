@@ -257,6 +257,22 @@ class Config:
                                        # (throughput) moves while the
                                        # recent event-age p50 is below
                                        # this fraction of the SLO
+    mesh_partitioned: str = "auto"     # HEATMAP_MESH_PARTITIONED: mesh
+                                       # execution mode when a
+                                       # multi-device mesh is attached.
+                                       # "auto" (default) = the
+                                       # shard-per-device PARTITIONED
+                                       # fast path on single-process
+                                       # meshes (feed pre-partitions
+                                       # each batch by H3 parent cell,
+                                       # every device runs the fused
+                                       # fold collective-free with its
+                                       # own emit ring and governor);
+                                       # multi-host meshes always keep
+                                       # the ICI-shuffle lockstep path.
+                                       # "1" forces partitioned (warns
+                                       # and falls back on multi-host),
+                                       # "0" forces the shuffle path.
     shard_oversample: int = 0          # HEATMAP_SHARD_OVERSAMPLE: how
                                        # many feed-batches worth of
                                        # stream rows a shard polls per
@@ -369,6 +385,8 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         shard_res=_int(e, "HEATMAP_SHARD_RES", Config.shard_res),
         shard_oversample=_int(e, "HEATMAP_SHARD_OVERSAMPLE",
                               Config.shard_oversample),
+        mesh_partitioned=e.get("HEATMAP_MESH_PARTITIONED",
+                               Config.mesh_partitioned),
     )
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
@@ -464,6 +482,10 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         raise ValueError(
             f"HEATMAP_GOVERN_HEALTHY_FRAC must be in (0, 1), "
             f"got {cfg.govern_healthy_frac}")
+    if cfg.mesh_partitioned not in ("auto", "0", "1"):
+        raise ValueError(
+            f"HEATMAP_MESH_PARTITIONED must be auto|0|1, "
+            f"got {cfg.mesh_partitioned!r}")
     if not 0 <= cfg.shard_oversample <= 64:
         raise ValueError(
             f"HEATMAP_SHARD_OVERSAMPLE must be in 0..64, "

@@ -89,6 +89,14 @@ class MultiAggregator:
 
     All pairs share capacity / hist_bins / emit capacity so states and
     emits stack along a leading pair axis.
+
+    ``device``: optional explicit jax device this aggregator's state and
+    feeds are committed to.  The partitioned mesh fast path
+    (parallel.sharded.PartitionedAggregator) runs one MultiAggregator
+    per mesh device this way — jit follows the committed inputs, so each
+    shard's program executes on its own chip with no collectives and no
+    shared dispatch stream.  ``None`` (the default) keeps the historical
+    default-device behavior.
     """
 
     n_shards = 1
@@ -101,19 +109,23 @@ class MultiAggregator:
         emit_capacity: int,
         hist_bins: int = 0,
         speed_hist_max: float = 256.0,
+        device=None,
     ):
         if len(set(pairs)) != len(pairs):
             raise ValueError(f"duplicate (res, window) pairs: {pairs}")
         self.pairs = list(pairs)
         self.capacity_per_shard = capacity
         self.batch_size = batch_size
+        self.device = device
         self.params = [
             AggParams(res=r, window_s=w, emit_capacity=emit_capacity,
                       speed_hist_max=speed_hist_max)
             for r, w in self.pairs
         ]
         self.states: list[TileState] = [
-            init_state(capacity, hist_bins) for _ in self.pairs
+            TileState(*[self._put(leaf)
+                        for leaf in init_state(capacity, hist_bins)])
+            for _ in self.pairs
         ]
         # host wall spent in step dispatch, per local shard (one entry
         # here: the fused single-device program).  The dispatch is async,
@@ -153,6 +165,14 @@ class MultiAggregator:
         self._step_pre = jax.jit(
             _step_pre, donate_argnums=donate_state_argnums())
 
+    def _put(self, x):
+        """Commit ``x`` to this aggregator's device (a no-op asarray on
+        the default-device path, and a no-op device_put for arrays
+        already committed there)."""
+        if self.device is not None:
+            return jax.device_put(x, self.device)
+        return jnp.asarray(x)
+
     def instrument(self, wrap) -> None:
         """Wrap the jitted entry points with a compile tracker
         (obs.runtimeinfo.CompileTracker.wrap): per-function compile
@@ -183,19 +203,19 @@ class MultiAggregator:
             if missing:
                 raise ValueError(f"prekeys missing resolutions {missing}")
             keys = tuple(
-                (jnp.asarray(prekeys[r][0]), jnp.asarray(prekeys[r][1]))
+                (self._put(prekeys[r][0]), self._put(prekeys[r][1]))
                 for r in self._uniq_res)
             states, packed = self._step_pre(
                 tuple(self.states), keys,
-                jnp.asarray(lat_rad), jnp.asarray(lng_rad),
-                jnp.asarray(speed), jnp.asarray(ts), jnp.asarray(valid),
+                self._put(lat_rad), self._put(lng_rad),
+                self._put(speed), self._put(ts), self._put(valid),
                 jnp.int32(watermark_cutoff),
             )
         else:
             states, packed = self._step(
                 tuple(self.states),
-                jnp.asarray(lat_rad), jnp.asarray(lng_rad),
-                jnp.asarray(speed), jnp.asarray(ts), jnp.asarray(valid),
+                self._put(lat_rad), self._put(lng_rad),
+                self._put(speed), self._put(ts), self._put(valid),
                 jnp.int32(watermark_cutoff),
             )
         self.states = list(states)
@@ -216,7 +236,7 @@ class MultiAggregator:
         from heatmap_tpu.engine.state import resize_state
 
         self.states = [
-            TileState(*[jnp.asarray(leaf)
+            TileState(*[self._put(leaf)
                         for leaf in resize_state(st, new_capacity)])
             for st in self.states
         ]
@@ -269,8 +289,8 @@ class PairView:
         got = (st.key_hi.shape, st.hist.shape)
         if want != got:
             raise ValueError(f"state shape {got} != configured {want}")
-        self._multi.states[self._idx] = TileState(*[jnp.asarray(leaf)
-                                                    for leaf in st])
+        self._multi.states[self._idx] = TileState(
+            *[self._multi._put(leaf) for leaf in st])
 
 
 class MultiStats(NamedTuple):

@@ -1224,13 +1224,24 @@ class EmitRing:
     rather than corrupting the stack).  ``take`` hands the raw entries
     back un-pulled for callers with their own transfer discipline (the
     sharded path pulls addressable shards per entry).
+
+    Per-mesh-shard rings (the partitioned mesh fast path keeps ONE ring
+    per device) additionally distinguish LIVE entries (batches that fed
+    the shard rows) from idle ones (empty dispatches parked only so
+    their eviction emits and stats are never dropped): ``full`` triggers
+    on the live count, so a hot shard's flush cadence is its own and an
+    idle shard holds its (empty) entries until a forced flush — its
+    device→host pull count stays at the idle-flush floor.  Idle entries
+    still bound memory: past ``8 * capacity`` total parked entries the
+    ring reads full regardless of liveness.
     """
 
     def __init__(self, capacity: int):
         self.capacity = max(1, int(capacity))
         self._entries: list = []      # (packed_device, tag) append order
-        self._enter: list = []        # (monotonic enter, append seq)
+        self._enter: list = []        # (monotonic enter, append seq, live)
         self._appends = 0             # lifetime appends (residency base)
+        self.live_pending = 0         # parked entries appended live=True
         self.n_flushes = 0            # pulls issued (telemetry)
         # residency of the entries the LAST take()/flush_stacked()
         # drained, aligned with its return order: (seconds parked,
@@ -1239,14 +1250,17 @@ class EmitRing:
         # stream runtime feeds these into the
         # heatmap_emit_ring_residency_* histograms and the freshness
         # lineage (obs.lineage) right after each flush.
+        # ``last_flush_live`` is the aligned per-entry live flag.
         self.last_flush_residency: list = []
+        self.last_flush_live: list = []
 
     def __len__(self) -> int:
         return len(self._entries)
 
     @property
     def full(self) -> bool:
-        return len(self._entries) >= self.capacity
+        return (self.live_pending >= self.capacity
+                or len(self._entries) >= 8 * self.capacity)
 
     @property
     def nbytes(self) -> int:
@@ -1261,9 +1275,13 @@ class EmitRing:
             return 0
         return len(entries) * int(entries[0][0].nbytes)
 
-    def append(self, packed, tag=None) -> bool:
+    def append(self, packed, tag=None, live: bool = True) -> bool:
         """Park one batch's packed emits; True when the ring is full
-        (flush before the next append)."""
+        (flush before the next append).  ``live=False`` marks an empty
+        dispatch (no input rows for this shard): it parks — eviction
+        emits and stats riding it must still be pulled eventually — but
+        does not advance the flush trigger (per-mesh-shard flush
+        independence)."""
         if self._entries and tuple(packed.shape) != tuple(
                 self._entries[0][0].shape):
             raise ValueError(
@@ -1273,20 +1291,30 @@ class EmitRing:
                 f"slab/emit-capacity resize")
         self._appends += 1
         self._entries.append((packed, tag))
-        self._enter.append((time.monotonic(), self._appends))
+        self._enter.append((time.monotonic(), self._appends, live))
+        if live:
+            self.live_pending += 1
         return self.full
 
     def take(self) -> list:
         """Drain the raw (packed, tag) entries without pulling."""
         entries, self._entries = self._entries, []
         enters, self._enter = self._enter, []
+        self.live_pending = 0
         if entries:
             self.n_flushes += 1
             now = time.monotonic()
             self.last_flush_residency = [
-                (now - t, self._appends - seq + 1) for t, seq in enters]
+                (now - t, self._appends - seq + 1)
+                for t, seq, _live in enters]
+            # aligned liveness flags: residency TELEMETRY should only
+            # describe real data batches — an idle mesh shard's empty
+            # entries park ~8x longer than any live batch and would
+            # dominate the histograms (the caller filters on this)
+            self.last_flush_live = [live for _t, _s, live in enters]
         else:
             self.last_flush_residency = []
+            self.last_flush_live = []
         return entries
 
     def flush_stacked(self, prefix: bool) -> list:

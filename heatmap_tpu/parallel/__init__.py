@@ -13,6 +13,7 @@ need no cross-shard dedup, and scalar stats ride a ``psum``/``pmax``.
 
 from heatmap_tpu.parallel import multihost  # noqa: F401
 from heatmap_tpu.parallel.sharded import (  # noqa: F401
+    PartitionedAggregator,
     ShardedAggregator,
     ShardStats,
     make_mesh,

@@ -359,6 +359,20 @@ def healthz_payload(runtime, extra_checks=None) -> tuple[dict, bool]:
                 checks["event_age_p50_ms"] = {"value": round(p50_ms, 3),
                                               "budget": budget, "ok": ok}
                 degraded |= not ok
+        pinned = getattr(runtime, "_fastpath_pinned", None)
+        if pinned:
+            # satellite bugfix (ISSUE 11): a runtime that silently
+            # pinned its fast-path knobs down (multi-host forcing
+            # emit_flush_k=1/prefetch=0, a governor request the
+            # topology can't honor) surfaces the pin here as a WARNING
+            # — visible in the checks payload without degrading the
+            # verdict (the pin is intended behavior for its topology,
+            # but an operator expecting the ring must be able to see
+            # it was lost)
+            checks["fastpath_pinned"] = {
+                "value": "; ".join(f"{k}: {v}"
+                                   for k, v in sorted(pinned.items())),
+                "ok": True, "warn": True}
         gov = getattr(runtime, "governor", None)
         if gov is not None:
             # adaptive micro-batching guardrail (stream/govern.py): a
@@ -371,6 +385,21 @@ def healthz_payload(runtime, extra_checks=None) -> tuple[dict, bool]:
                 "value": (f"frozen: {gov.frozen_why} "
                           f"(bucket {gov.latched_bucket} latched)"
                           if gov.frozen else "active"),
+                "ok": ok}
+            degraded |= not ok
+        mesh_govs = getattr(runtime, "_mesh_governors", None)
+        if mesh_govs:
+            # partitioned-mesh per-shard governors share one warmed
+            # ladder and one retrace guardrail (stream/govern.py): any
+            # frozen shard degrades, naming shard + latched bucket
+            frozen = [g for g in mesh_govs if g.frozen]
+            ok = not frozen
+            checks["govern_frozen"] = {
+                "value": ("; ".join(
+                    f"shard {g.shard} frozen: {g.frozen_why} "
+                    f"(bucket {g.latched_bucket} latched)"
+                    for g in frozen) if frozen
+                    else f"active ({len(mesh_govs)} mesh shards)"),
                 "ok": ok}
             degraded |= not ok
         if runtime.writer.poisoned:

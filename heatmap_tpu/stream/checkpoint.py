@@ -95,7 +95,8 @@ class CheckpointManager:
     def commit(self, offset: Any, max_event_ts: int, epoch: int,
                states: dict[tuple[int, int], TileState] | None = None,
                shards: int | None = None,
-               snap_impl: str | None = None) -> None:
+               snap_impl: str | None = None,
+               mesh_mode: str | None = None) -> None:
         """``shards``: the writer's local shard-block count.  Recorded so
         a restart can tell a capacity change (absorbable: pad/grow) from a
         shard-count change (NOT absorbable: rows would be reinterpreted as
@@ -106,7 +107,14 @@ class CheckpointManager:
         agree everywhere except f32-rounded points lying exactly on a
         cell edge, so a resume pins the same impl (runtime._maybe_resume)
         rather than letting a backend failover re-key edge events
-        mid-stream (ADVICE r4 #1)."""
+        mid-stream (ADVICE r4 #1).
+
+        ``mesh_mode``: how the shard blocks were KEYED on a mesh run —
+        "shuffle" (mix32 key hash, parallel.sharded.ShardedAggregator)
+        vs "partitioned" (H3 parent cell, PartitionedAggregator).  Same
+        shape, different key ownership: restoring one into the other
+        would silently duplicate groups across devices, so the resume
+        refuses a mismatch (stream.runtime._maybe_resume)."""
         name = f"commit-{epoch:012d}"
         cdir = os.path.join(self.dir, name)
         tmp = cdir + ".tmp"
@@ -121,6 +129,8 @@ class CheckpointManager:
             meta["shards"] = int(shards)
         if snap_impl is not None:
             meta["snap_impl"] = snap_impl
+        if mesh_mode is not None:
+            meta["mesh_mode"] = mesh_mode
         with open(os.path.join(tmp, "meta.json"), "w", encoding="utf-8") as fh:
             json.dump(meta, fh)
         shutil.rmtree(cdir, ignore_errors=True)

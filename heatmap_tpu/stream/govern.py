@@ -107,9 +107,21 @@ class BatchGovernor:
     decision."""
 
     def __init__(self, cfg, registry, *, event_age=None,
-                 compile_tracker=None, memory=None, clock=time.monotonic):
+                 compile_tracker=None, memory=None, clock=time.monotonic,
+                 shard=None):
         self.cfg = cfg
         self.clock = clock
+        # ``shard``: mesh-shard index for the partitioned mesh fast path
+        # (stream/runtime.py runs one governor PER mesh device, so
+        # skewed devices converge to different batch buckets).  The
+        # metric families then carry a shard= label; None keeps the
+        # historical unlabeled single-governor exposition.  All mesh
+        # governors share ONE CompileTracker, so the retrace-freeze
+        # guardrail latches per-LADDER: a post-warmup retrace anywhere
+        # on the mesh freezes every shard's governor (the warmed-shape
+        # invariant is a property of the shared ladder, not of the
+        # shard that happened to trip it).
+        self.shard = None if shard is None else int(shard)
         self.interval_s = float(cfg.govern_interval_s)
         self._age = event_age          # histogram child (bound="mean")
         self._tracker = compile_tracker
@@ -152,36 +164,56 @@ class BatchGovernor:
                               if self._tracker is not None else 0)
         self.trail: collections.deque = collections.deque(maxlen=256)
         # ---- enforced metric families (ARCHITECTURE.md §Adaptive
-        # micro-batching)
-        self._g_batch = registry.gauge(
+        # micro-batching).  With a mesh shard index the same family
+        # names carry a shard= label (one child per device governor);
+        # the fleet aggregator re-labels either shape with proc=.
+        labelnames = () if self.shard is None else ("shard",)
+
+        def _child(fam):
+            return (fam if self.shard is None
+                    else fam.labels(shard=str(self.shard)))
+
+        self._g_batch = _child(registry.gauge(
             "heatmap_govern_batch_rows",
             "live feed-batch pad bucket the governor currently targets "
-            "(rows; moves only along the precompiled bucket ladder)")
-        self._g_flush = registry.gauge(
+            "(rows; moves only along the precompiled bucket ladder)",
+            labels=labelnames))
+        self._g_flush = _child(registry.gauge(
             "heatmap_govern_flush_k",
             "live emit-ring flush interval the governor currently "
-            "targets (batches per pull)")
-        self._g_prefetch = registry.gauge(
+            "targets (batches per pull)", labels=labelnames))
+        self._g_prefetch = _child(registry.gauge(
             "heatmap_govern_prefetch",
             "live prefetch depth the governor currently targets "
-            "(batches polled ahead of the fold)")
-        self._g_frozen = registry.gauge(
+            "(batches polled ahead of the fold)", labels=labelnames))
+        self._g_frozen = _child(registry.gauge(
             "heatmap_govern_frozen",
             "1 when the governor is frozen (post-warmup retrace "
             "guardrail latched a bucket out of the ladder); knobs stay "
-            "at their last values")
-        self._c_adjust = registry.counter(
+            "at their last values", labels=labelnames))
+        self._adjust_fam = registry.counter(
             "heatmap_govern_adjust_total",
             "governor knob adjustments by direction (up/down/set/"
             "freeze) and control-law reason (latency/saturated/"
             "starved/headroom/mem/growth_pressure/forced/retrace)",
-            labels=("dir", "reason"))
-        registry.gauge(
+            labels=("dir", "reason") + labelnames)
+        age = _child(registry.gauge(
             "heatmap_govern_last_adjust_age_seconds",
             "seconds since the governor last changed any knob (NaN "
             "before the first adjustment)",
-            fn=self._last_adjust_age)
+            labels=labelnames,
+            fn=self._last_adjust_age if self.shard is None else None))
+        if self.shard is not None:
+            # labeled children share the family's make_child, so the
+            # callback must be attached per child, not per family
+            age.fn = self._last_adjust_age
         self._publish()
+
+    def _adjust_inc(self, direction: str, reason: str) -> None:
+        kw = {"dir": direction, "reason": reason}
+        if self.shard is not None:
+            kw["shard"] = str(self.shard)
+        self._adjust_fam.labels(**kw).inc()
 
     # ------------------------------------------------------------ reads
     @property
@@ -281,7 +313,7 @@ class BatchGovernor:
             self.trail.append({"t": self.clock(), "dir": "freeze",
                                "reason": why,
                                "bucket": self.latched_bucket})
-            self._c_adjust.labels(dir="freeze", reason="retrace").inc()
+            self._adjust_inc("freeze", "retrace")
             self._publish()
         log.warning("governor FROZEN (%s); bucket %s latched out of the "
                     "ladder, knobs pinned at batch=%d flush_k=%d "
@@ -422,8 +454,7 @@ class BatchGovernor:
                                if p50_ms is not None else None),
                     "fill": round(fill, 4), "idles": idles,
                 })
-                self._c_adjust.labels(dir=direction or "hold",
-                                      reason=reason).inc()
+                self._adjust_inc(direction or "hold", reason)
                 self._publish()
             return changed
 
@@ -453,7 +484,7 @@ class BatchGovernor:
                                "batch_rows": self.batch_rows,
                                "flush_k": self._flush_k,
                                "prefetch": self._prefetch})
-            self._c_adjust.labels(dir="set", reason=reason).inc()
+            self._adjust_inc("set", reason)
             self._publish()
 
     def _publish(self) -> None:

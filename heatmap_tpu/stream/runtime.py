@@ -83,6 +83,14 @@ class _FeedBatch(NamedTuple):
                           # full stream's event time, not just this
                           # shard's cells, so the cutoff sequence stays
                           # identical to the unsharded fold's
+    mesh: object = None   # partitioned-mesh feed: per-device chunk
+                          # lists ([[{n, feed, prekeys} | None, ...]])
+                          # built by _mesh_feed — each device's owned
+                          # rows compacted/padded/device_put to ITS
+                          # chip; None marks an empty dispatch (the
+                          # device still dispatches all-invalid so its
+                          # per-batch slab rewrite count matches the
+                          # single-device fold's)
 
 
 def _make_global_pair(mesh):
@@ -242,7 +250,7 @@ class MicroBatchRuntime:
             fr.add_source("run_state", lambda: {
                 "epoch": self.epoch,
                 "max_event_ts": self.max_event_ts,
-                "ring_pending": len(self._ring),
+                "ring_pending": self._ring_pending(),
                 "prefetched": len(self._prefetched),
                 "writer_poisoned": self.writer.poisoned,
             })
@@ -344,7 +352,9 @@ class MicroBatchRuntime:
         self.metrics.gauge(
             "heatmap_emit_ring_pending",
             "packed emit batches parked on device awaiting the next flush",
-            fn=lambda: len(self._ring))
+            fn=lambda: (sum(len(r) for r in self._mesh_rings)
+                        if getattr(self, "_mesh_rings", None) is not None
+                        else len(self._ring)))
         # Runtime introspection (obs.runtimeinfo): the compile/retrace
         # tracker wraps the jitted entry points below; the memory
         # monitor samples on the step loop (1 Hz) and keeps the HBM /
@@ -354,7 +364,11 @@ class MicroBatchRuntime:
         from heatmap_tpu.obs.runtimeinfo import RuntimeIntrospection
 
         self.runtimeinfo = RuntimeIntrospection(
-            self.metrics.registry, ring_bytes_fn=lambda: self._ring.nbytes)
+            self.metrics.registry,
+            ring_bytes_fn=lambda: (
+                sum(r.nbytes for r in self._mesh_rings)
+                if getattr(self, "_mesh_rings", None) is not None
+                else self._ring.nbytes))
         # live-prefix emit pulls (flush_pending): explicit knob wins;
         # auto = on for accelerators (where D2H bytes cost), off for CPU
         # (an extra round trip with nothing to save).  A banked pull A/B
@@ -425,23 +439,121 @@ class MicroBatchRuntime:
         bins = cfg.speed_hist_bins
         self._multi = None
         self._sharded = None
+        self._parted = None
+        self._mesh_mode = None          # "partitioned" | "shuffle" | None
+        self.meshmap = None             # MeshPartition (partitioned mode)
+        self._mesh_rings = None         # per-device EmitRings
+        self._mesh_governors = None     # per-device BatchGovernors
+        # knob-pin telemetry (satellite bugfix): any place that silently
+        # degrades the fast path (multi-host forcing emit_flush_k=1 /
+        # prefetch=0, a governor request a topology can't honor) records
+        # its reason here AND pins heatmap_fastpath_pinned{reason=}=1,
+        # so an attached run that lost the ring is diagnosable from
+        # /metrics and /healthz instead of one INFO log line
+        self._fastpath_pinned: dict[str, str] = {}
+        self._g_fastpath_pinned = self.metrics.gauge(
+            "heatmap_fastpath_pinned",
+            "1 per reason the runtime pinned fast-path knobs down "
+            "(emit_flush_k=1 / prefetch_batches=0 for multi-host "
+            "lockstep, a governor request the topology cannot honor) — "
+            "a run silently serving degraded throughput is diagnosable "
+            "from telemetry",
+            labels=("reason",))
         if mesh is not None and mesh.devices.size > 1:
-            from heatmap_tpu.parallel import ShardedAggregator
+            mesh_multiproc = jax.process_count() > 1
+            want_part = (cfg.mesh_partitioned in ("auto", "1")
+                         and not mesh_multiproc)
+            if cfg.mesh_partitioned == "1" and mesh_multiproc:
+                log.warning(
+                    "HEATMAP_MESH_PARTITIONED=1 ignored: multi-host "
+                    "meshes keep the ICI-shuffle lockstep path")
+            if want_part:
+                # partitioned fast path (ISSUE 11 tentpole): the feed
+                # pre-partitions each batch by H3 parent cell and every
+                # device runs the fused single-device program over ITS
+                # OWN rows — no all_to_all, no lockstep, per-device
+                # emit rings + governors (parallel.sharded
+                # .PartitionedAggregator); merged at the view
+                # upsert-only exactly like the PR 7 process fleet.
+                from heatmap_tpu.engine.step import EmitRing as _Ring
+                from heatmap_tpu.parallel import PartitionedAggregator
+                from heatmap_tpu.stream.shardmap import MeshPartition
 
-            # ALL pairs fused into one sharded program: one dispatch, one
-            # all_to_all, one addressable pull per batch (parallel.sharded)
-            self._sharded = ShardedAggregator(
-                mesh,
-                [AggParams(res=res, window_s=win_s,
-                           emit_capacity=min(cfg.batch_size, cap),
-                           speed_hist_max=cfg.speed_hist_max_kmh)
-                 for res, win_s in pairs],
-                capacity_per_shard=cap, batch_size=cfg.batch_size,
-                hist_bins=bins, bucket_factor=cfg.bucket_factor,
-            )
-            self._sharded.instrument(self.runtimeinfo.compile.wrap)
-            for res, win_s in pairs:
-                self.aggs[(res, win_s // 60)] = self._sharded.view(res, win_s)
+                self._parted = PartitionedAggregator(
+                    mesh,
+                    [AggParams(res=res, window_s=win_s,
+                               emit_capacity=min(cfg.batch_size, cap),
+                               speed_hist_max=cfg.speed_hist_max_kmh)
+                     for res, win_s in pairs],
+                    capacity_per_shard=cap, batch_size=cfg.batch_size,
+                    hist_bins=bins,
+                )
+                self._parted.instrument(self.runtimeinfo.compile.wrap)
+                self._mesh_mode = "partitioned"
+                self.meshmap = MeshPartition(
+                    self._parted.n_shards, min(cfg.resolutions),
+                    cfg.shard_res, outer_shards=cfg.shards)
+                log.info("partitioned mesh runtime: %s",
+                         self.meshmap.describe())
+                for res, win_s in pairs:
+                    self.aggs[(res, win_s // 60)] = \
+                        self._parted.view(res, win_s)
+                n_dev = self._parted.n_shards
+                self._mesh_rings = [_Ring(cfg.emit_flush_k)
+                                    for _ in range(n_dev)]
+                self._mesh_epoch_pend: dict[int, int] = {}
+                self._mesh_shard_active: dict[tuple, int] = {}
+                self._shard_active_peak = 0
+                self._mesh_idle: dict[tuple, tuple] = {}
+                self._mesh_rows = [0] * n_dev
+                self._mesh_pulls = [0] * n_dev
+                self._mesh_pull_batches = [0] * n_dev
+                self.metrics.gauge(
+                    "heatmap_mesh_devices",
+                    "mesh devices running the partitioned shard-per-"
+                    "device fast path (0/absent = not a partitioned "
+                    "mesh run)").set(n_dev)
+                self._c_mesh_rows = self.metrics.registry.counter(
+                    "heatmap_mesh_rows_total",
+                    "live rows folded per mesh shard (the feed's H3 "
+                    "partition of each batch)", labels=("shard",))
+                self._c_mesh_pulls = self.metrics.registry.counter(
+                    "heatmap_mesh_pulls_total",
+                    "device->host emit pulls per mesh shard (one per "
+                    "ring flush; the idle-flush floor on cold shards)",
+                    labels=("shard",))
+                ring_fam = self.metrics.gauge(
+                    "heatmap_mesh_ring_pending",
+                    "packed emit batches parked on each mesh shard's "
+                    "device awaiting its next flush",
+                    labels=("shard",))
+                for d in range(n_dev):
+                    ring_fam.labels(shard=str(d)).fn = (
+                        lambda i=d: len(self._mesh_rings[i]))
+                    # materialize the per-shard counter children so the
+                    # exposition carries every shard from step one
+                    self._c_mesh_rows.labels(shard=str(d))
+                    self._c_mesh_pulls.labels(shard=str(d))
+            else:
+                from heatmap_tpu.parallel import ShardedAggregator
+
+                # ALL pairs fused into one sharded program: one
+                # dispatch, one all_to_all, one addressable pull per
+                # batch (parallel.sharded)
+                self._sharded = ShardedAggregator(
+                    mesh,
+                    [AggParams(res=res, window_s=win_s,
+                               emit_capacity=min(cfg.batch_size, cap),
+                               speed_hist_max=cfg.speed_hist_max_kmh)
+                     for res, win_s in pairs],
+                    capacity_per_shard=cap, batch_size=cfg.batch_size,
+                    hist_bins=bins, bucket_factor=cfg.bucket_factor,
+                )
+                self._sharded.instrument(self.runtimeinfo.compile.wrap)
+                self._mesh_mode = "shuffle"
+                for res, win_s in pairs:
+                    self.aggs[(res, win_s // 60)] = \
+                        self._sharded.view(res, win_s)
         else:
             # single device: ALL pairs fused into one program — one
             # dispatch and one device->host pull per batch regardless of
@@ -460,7 +572,7 @@ class MicroBatchRuntime:
         # per-shard device dispatch clock: the fused aggregator keeps a
         # host-wall accumulator per local shard; a callback gauge reads
         # it at scrape time so the step loop pays nothing extra
-        agg_obs = self._multi if self._multi is not None else self._sharded
+        agg_obs = self._agg()
         fam = self.metrics.gauge(
             "heatmap_device_dispatch_seconds",
             "cumulative host wall seconds spent dispatching the fused "
@@ -556,6 +668,10 @@ class MicroBatchRuntime:
             # optimizations for now (EmitRing imported above)
             log.info("multi-host run: forcing emit_flush_k=1 and "
                      "prefetch_batches=0 (lockstep accounting)")
+            self._note_fastpath_pinned(
+                "multihost_lockstep",
+                f"emit_flush_k {self._ring.capacity}->1, "
+                f"prefetch_batches {self._prefetch_n}->0")
             self._ring = EmitRing(1)
             self._prefetch_n = 0
         if self._multiproc:
@@ -657,11 +773,46 @@ class MicroBatchRuntime:
         # its final shape.
         self.governor = None
         if cfg.govern:
-            if self._multiproc or self._multi is None:
+            if self._multiproc or (self._multi is None
+                                   and self._parted is None):
                 log.warning(
                     "HEATMAP_GOVERN=1 ignored: the governor runs the "
-                    "single-device fused path only (multi-host/mesh "
-                    "runs pin their knobs for lockstep)")
+                    "fused single-device path and the partitioned mesh "
+                    "path only (multi-host and ICI-shuffle runs pin "
+                    "their knobs for lockstep)")
+                self._note_fastpath_pinned(
+                    "govern_unsupported_topology",
+                    "HEATMAP_GOVERN=1 ignored (multi-host or "
+                    "ICI-shuffle mesh: knobs pinned for lockstep)")
+            elif self._parted is not None:
+                # per-mesh-shard governing (ISSUE 11 tentpole (3)): one
+                # AIMD governor per device over a SHARED warmed ladder —
+                # skewed devices converge to different batch buckets
+                # while the cutoff trajectory stays batch-granular (the
+                # watermark advances from the pre-partition rows).  The
+                # retrace-freeze guardrail latches per-LADDER: all
+                # governors poll one CompileTracker, so a post-warmup
+                # retrace anywhere on the mesh freezes every shard.
+                from heatmap_tpu.stream.govern import BatchGovernor
+
+                govs = []
+                for d in range(self._parted.n_shards):
+                    govs.append(BatchGovernor(
+                        cfg, self.metrics.registry,
+                        event_age=self.metrics.event_age.labels(
+                            bound="mean"),
+                        compile_tracker=self.runtimeinfo.compile,
+                        memory=self.runtimeinfo.memory, shard=d))
+                self.runtimeinfo.compile.warmup += len(govs[0].ladder)
+                self._warm_mesh_ladder(govs[0].ladder)
+                for gov in govs:
+                    gov._retrace_base = gov._retraces()
+                self._mesh_governors = govs
+                if self.flightrec is not None:
+                    self.flightrec.add_source(
+                        "govern", lambda: (
+                            [g.snapshot() for g in self._mesh_governors]
+                            if self._mesh_governors else None))
             else:
                 from heatmap_tpu.stream.govern import BatchGovernor
 
@@ -749,6 +900,20 @@ class MicroBatchRuntime:
                 f"checkpoint written with {snap_shards} local shard(s), "
                 f"this run has {self._local_shards}; restore the original "
                 f"device topology or clear {self.cfg.checkpoint_dir}")
+        ck_mode = meta.get("mesh_mode")
+        if ck_mode is None and snap_shards is not None and snap_shards > 1:
+            # pre-mesh-mode multi-shard checkpoints all came from the
+            # ICI-shuffle path (the only mesh mode that existed)
+            ck_mode = "shuffle"
+        if (ck_mode or self._mesh_mode) and ck_mode != self._mesh_mode:
+            # same block layout, DIFFERENT key ownership (mix32 hash vs
+            # H3 parent): a cross-mode restore would silently duplicate
+            # groups across devices
+            raise RuntimeError(
+                f"checkpoint state was keyed in mesh mode {ck_mode!r} "
+                f"but this run is {self._mesh_mode!r}; restore the "
+                f"original mode (HEATMAP_MESH_PARTITIONED) or clear "
+                f"{self.cfg.checkpoint_dir}")
         self.epoch = meta.get("epoch", 0)
         self.max_event_ts = meta.get("max_event_ts", I32_MIN)
         self.source.seek(meta.get("offset"))
@@ -896,12 +1061,41 @@ class MicroBatchRuntime:
                         int(total))
                 engine_step.SNAP_IMPL = "xla"
 
+    def _agg(self):
+        """Whichever aggregator this runtime drives: the fused
+        single-device program, the ICI-shuffle mesh, or the partitioned
+        shard-per-device mesh."""
+        if self._multi is not None:
+            return self._multi
+        if self._sharded is not None:
+            return self._sharded
+        return self._parted
+
     @property
     def _local_shards(self) -> int:
         """Shard blocks in THIS process's snapshots (1 on the fused
         single-device path)."""
-        return (self._sharded.local_shards if self._sharded is not None
-                else 1)
+        if self._sharded is not None:
+            return self._sharded.local_shards
+        if self._parted is not None:
+            return self._parted.local_shards
+        return 1
+
+    def _note_fastpath_pinned(self, reason: str, detail: str) -> None:
+        """Record a fast-path knob pin (satellite bugfix): gauge child
+        per reason + the dict /healthz surfaces, so a run silently
+        serving degraded throughput is diagnosable from telemetry."""
+        self._fastpath_pinned[reason] = detail
+        self._g_fastpath_pinned.labels(reason=reason).set(1.0)
+
+    def _ring_pending(self) -> int:
+        """Parked emit batches bounding the stats lag: the single ring's
+        depth, or the DEEPEST per-device ring on a partitioned mesh
+        (each shard's slab lags by its own ring; growth margins must
+        cover the worst one)."""
+        if self._mesh_rings is not None:
+            return max((len(r) for r in self._mesh_rings), default=0)
+        return len(self._ring)
 
     def _restore_resized(self, agg, st: TileState,
                          snap_shards: int | None) -> None:
@@ -918,7 +1112,7 @@ class MicroBatchRuntime:
                 f"this run has {shards}")
         snap_cap = st.key_hi.shape[0] // shards
         if snap_cap > agg.capacity_per_shard:
-            grower = self._multi if self._multi is not None else self._sharded
+            grower = self._agg()
             grower.grow(snap_cap)  # capacity is shared across pairs
             agg.restore(st)
         else:
@@ -967,7 +1161,8 @@ class MicroBatchRuntime:
             }
             self.ckpt.commit(self._offsets_dispatched, self.max_event_ts,
                              self.epoch, states, shards=self._local_shards,
-                             snap_impl=self._snap_impl_name)
+                             snap_impl=self._snap_impl_name,
+                             mesh_mode=self._mesh_mode)
             self.metrics.count("checkpoints")
             return
         # Single host: capture fresh-buffer device copies + offsets now
@@ -991,7 +1186,8 @@ class MicroBatchRuntime:
                 states = {k: to_host(s) for k, (s, to_host) in snaps.items()}
                 self.ckpt.commit(offset, max_ts, epoch, states,
                                  shards=self._local_shards,
-                                 snap_impl=self._snap_impl_name)
+                                 snap_impl=self._snap_impl_name,
+                                 mesh_mode=self._mesh_mode)
                 self.metrics.count("checkpoints")
             except BaseException as e:  # surfaced on the step thread
                 self._ckpt_err = e
@@ -1091,19 +1287,22 @@ class MicroBatchRuntime:
         )
 
     def _account_pair_packed(self, res: int, wmin: int, body, stats,
-                             epoch: int | None = None) -> int:
+                             epoch: int | None = None,
+                             shard: int | None = None) -> int:
         """Sink one pair's packed emit body rows + book its stats; returns
         its batch_max_ts.  The writer thread turns the rows into store
         writes (columnar->BSON in C++ when the store supports it);
         ``stats`` is any object with StepStats-named int attributes;
         ``epoch`` is the batch's dispatching epoch (accounting runs one
-        batch behind)."""
+        batch behind); ``shard`` is the mesh shard on the partitioned
+        path (stats are then per-device, accounted at that device's own
+        flush cadence)."""
         n_docs = int(np.count_nonzero(
             (body[:, 8] != 0) & (body[:, 3].view(np.int32) > 0)))
         if n_docs:
             self.writer.submit_tiles_packed(body, self._pack_meta[(res, wmin)])
         self.metrics.count("tiles_emitted", n_docs)
-        return self._account_stats(res, wmin, stats, epoch)
+        return self._account_stats(res, wmin, stats, epoch, shard=shard)
 
     def flush_pending(self) -> None:
         """Pull + account every batch parked in the emit ring, in order.
@@ -1113,8 +1312,16 @@ class MicroBatchRuntime:
         before every checkpoint capture (so commits cover every accounted
         batch), on idle polls, and from close().  One call = ONE pull
         covering up to emit_flush_k batches — the round-trip amortization
-        the fused pipelines were missing (VERDICT r5 §3)."""
+        the fused pipelines were missing (VERDICT r5 §3).  On the
+        partitioned mesh this is the global barrier form: EVERY shard's
+        ring drains (checkpoints, close, idle polls, window/growth
+        pressure); steady-state flushes instead run per shard
+        (_flush_mesh_shard) on each ring's own cadence."""
         t_flush = time.monotonic()
+        if self._mesh_rings is not None:
+            for d in range(len(self._mesh_rings)):
+                self._flush_mesh_shard(d)
+            return
         if not len(self._ring):
             return
         n_batches = len(self._ring)
@@ -1395,7 +1602,7 @@ class MicroBatchRuntime:
         smallest configured window since the last flush — closed windows
         may evict this step, and their final emits should reach the sink
         now instead of up to K batches later."""
-        if not len(self._ring):
+        if not self._ring_pending():
             return False
         cutoff = (self.max_event_ts - self.cfg.watermark_minutes * 60
                   if self.max_event_ts > I32_MIN else I32_MIN)
@@ -1405,7 +1612,8 @@ class MicroBatchRuntime:
         return cutoff // win > self._last_flush_cutoff // win
 
     def _account_stats(self, res: int, wmin: int, stats,
-                       epoch: int | None = None) -> int:
+                       epoch: int | None = None,
+                       shard: int | None = None) -> int:
         ovf = int(stats.state_overflow)
         if ovf > 0:
             # Data loss is never silent: every overflowing batch bumps the
@@ -1450,7 +1658,21 @@ class MicroBatchRuntime:
             self.metrics.count(f"events_late_r{res}m{wmin}",
                                int(stats.n_late))
         n_active = int(stats.n_active)
-        self._n_active_peak = max(self._n_active_peak, n_active)
+        if shard is None:
+            self._n_active_peak = max(self._n_active_peak, n_active)
+        else:
+            # partitioned mesh: n_active is ONE device's live groups.
+            # The per-shard peak drives the (exact, per-slab) growth
+            # inequality; the global gauge tracks the summed last-known
+            # occupancy per pair so the overflow early-warning still
+            # reads city-wide.
+            self._mesh_shard_active[(res, wmin, shard)] = n_active
+            self._shard_active_peak = max(self._shard_active_peak,
+                                          n_active)
+            pair_total = sum(
+                v for (r, w, _s), v in self._mesh_shard_active.items()
+                if (r, w) == (res, wmin))
+            self._n_active_peak = max(self._n_active_peak, pair_total)
         self._g_active.set(self._n_active_peak)
         # per-batch group minting (for grow_margin=observed): the raw
         # n_active delta UNDERcounts minting when eviction freed rows the
@@ -1459,8 +1681,9 @@ class MicroBatchRuntime:
         # restore n_active starts at the whole restored population, and
         # counting that as one batch's minting would permanently
         # oversize the observed margin to ~4x the live group count
-        prev = self._prev_active.get((res, wmin))
-        self._prev_active[(res, wmin)] = n_active
+        key = (res, wmin) if shard is None else (res, wmin, shard)
+        prev = self._prev_active.get(key)
+        self._prev_active[key] = n_active
         if prev is not None:
             minted = n_active - prev + int(stats.n_evicted)
             self._mint_peak = max(self._mint_peak, minted)
@@ -1481,16 +1704,24 @@ class MicroBatchRuntime:
         whenever growth may trigger), so no packed emit ever straddles an
         emit-capacity resize and the resize is a plain state swap plus a
         retrace on the next step.  In multi-host mode every host derives
-        the same decision from the replicated stats."""
-        agg = self._multi if self._multi is not None else self._sharded
-        shards = agg.n_shards
+        the same decision from the replicated stats.  On the partitioned
+        mesh per-shard occupancy is EXACT (each device holds only its
+        own cells), so the inequality runs against the hottest shard
+        with the full margin — one batch CAN mint its whole row count
+        into a single device under total geographic skew."""
+        agg = self._agg()
         margin = self._grow_margin()
-        skew = 2 if shards > 1 else 1
         cap = agg.capacity_per_shard
-        if self._n_active_peak * skew + margin <= cap * shards:
+        if self._parted is not None:
+            peak, shards, skew = self._shard_active_peak, 1, 1
+        else:
+            shards = agg.n_shards
+            skew = 2 if shards > 1 else 1
+            peak = self._n_active_peak
+        if peak * skew + margin <= cap * shards:
             return
         new_cap = cap
-        while (self._n_active_peak * skew + margin > new_cap * shards
+        while (peak * skew + margin > new_cap * shards
                and new_cap < self._cap_max):
             new_cap *= 2
         if new_cap == cap:
@@ -1511,7 +1742,8 @@ class MicroBatchRuntime:
         depth: the stats that feed the occupancy peak lag (1 + pending)
         batches behind the dispatch, so each parked batch adds one
         batch's worth of worst-case minting (or half the observed
-        margin's headroom) on top of the base rule.
+        margin's headroom) on top of the base rule.  Per-device mesh
+        rings lag independently; the DEEPEST one bounds the stats lag.
 
         Base rules (pending == 0, today's formulas): worst = 2x batch (a
         batch can mint one group per event; the 2 covers the one-batch
@@ -1521,7 +1753,7 @@ class MicroBatchRuntime:
         stream can still outrun `observed` — the overflow accounting and
         HEATMAP_ON_OVERFLOW=fail's checkpoint replay are the loud,
         lossless backstop (config.grow_margin)."""
-        pend = len(self._ring)
+        pend = self._ring_pending()
         if self.cfg.grow_margin == "observed":
             base = max(4 * self._mint_peak, self.cfg.batch_size // 8)
         else:
@@ -1532,7 +1764,10 @@ class MicroBatchRuntime:
         """The growth inequality on the CURRENT (possibly ring-stale)
         stats — the step loop's growth-pressure flush trigger: when true,
         flush first (fresh stats), then let _maybe_grow decide."""
-        agg = self._multi if self._multi is not None else self._sharded
+        agg = self._agg()
+        if self._parted is not None:
+            return (self._shard_active_peak + self._grow_margin()
+                    > agg.capacity_per_shard)
         shards = agg.n_shards
         skew = 2 if shards > 1 else 1
         return (self._n_active_peak * skew + self._grow_margin()
@@ -1575,7 +1810,25 @@ class MicroBatchRuntime:
         """Apply the governor's decisions at a step boundary (the feed
         stage re-reads ``_feed_batch`` per poll; per-entry offset
         snapshots keep checkpoints dispatch-aligned across size
-        changes)."""
+        changes).  On the partitioned mesh every shard's governor runs
+        its own control step: per-shard buckets steer the feed
+        partitioner's chunking, per-shard flush-K retargets that
+        shard's ring (with the forced transition flush), and the
+        runtime-global prefetch depth follows the deepest shard's
+        decision (the feed stage is shared)."""
+        if self._mesh_governors is not None:
+            for d, gov in enumerate(self._mesh_governors):
+                gov.check_retrace()
+                gov.decide()
+                k = gov.flush_k
+                ring = self._mesh_rings[d]
+                if k != ring.capacity:
+                    self._flush_mesh_shard(d)
+                    ring.capacity = max(1, int(k))
+            pf = max(g.prefetch for g in self._mesh_governors)
+            if pf != self._prefetch_n:
+                self._prefetch_n = pf
+            return
         gov = self.governor
         gov.check_retrace()
         gov.decide()
@@ -1703,6 +1956,15 @@ class MicroBatchRuntime:
                 n_events=n, ev_min_ts=int(tv.min()),
                 ev_max_ts=int(tv.max()), ev_mean_ts=float(tv.mean()),
                 offset=offset, t_poll=t_polled)
+        if self._parted is not None:
+            # partitioned mesh: the single padded feed is replaced by
+            # per-device row blocks (H3-parent partition, compacted to
+            # each block's prefix, device_put to the owning chip)
+            mesh_blocks = self._mesh_feed(cols, shard_cells, spans)
+            return _FeedBatch(cols=cols, n=n, feed=None, prekeys=None,
+                              offset=offset, carried=carried,
+                              spans=spans, lineage=lin, wm_ts=wm_ts,
+                              mesh=mesh_blocks)
         t1 = time.monotonic()
         valid = np.zeros(self._feed_batch, bool)
         valid[:n] = True
@@ -1740,7 +2002,7 @@ class MicroBatchRuntime:
 
     def _step_once_inner(self) -> bool:
         t0 = time.monotonic()
-        if self.governor is not None:
+        if self.governor is not None or self._mesh_governors is not None:
             # control step + decision apply at the step boundary — the
             # feed poll below reads the (possibly resized) bucket
             self._govern_step()
@@ -1753,6 +2015,9 @@ class MicroBatchRuntime:
             self.flush_pending()
             if self.governor is not None:
                 self.governor.note_idle()
+            if self._mesh_governors is not None:
+                for gov in self._mesh_governors:
+                    gov.note_idle()
             return False
         if entry is None:
             # multi-host lockstep: peers may have events and are entering
@@ -1777,7 +2042,22 @@ class MicroBatchRuntime:
         # commit ordering and end-of-stream semantics exact.
         self._last_pull_s = 0.0  # only THIS window's pull is attributed
         grow_due = self._grow_would_trigger()
-        if self._ring.full or self._wm_flush_due() or grow_due:
+        if self._mesh_rings is not None:
+            # partitioned mesh: global pressure (closing windows,
+            # growth) drains EVERY shard's ring; otherwise each shard
+            # flushes on its own live-batch cadence — independence is
+            # the point (a hot shard must not pull the idle ones)
+            if self._wm_flush_due() or grow_due:
+                if grow_due and self._mesh_governors is not None:
+                    for gov in self._mesh_governors:
+                        gov.note_growth_pressure()
+                self.flush_pending()
+                self._maybe_grow()
+            else:
+                for d, ring in enumerate(self._mesh_rings):
+                    if ring.full:
+                        self._flush_mesh_shard(d)
+        elif self._ring.full or self._wm_flush_due() or grow_due:
             if grow_due and self.governor is not None:
                 # the EmitRing growth-pressure path can force the
                 # governor a step down (guardrail 2): parked batches
@@ -1803,7 +2083,37 @@ class MicroBatchRuntime:
             # lineage: the batch leaves the prefetch queue and enters
             # the fold under THIS epoch
             self.lineage.dispatched(lin, self.epoch)
-        if self._multi is not None:
+        if self._parted is not None:
+            # partitioned mesh path: every device dispatches ITS block
+            # of this batch (collective-free fused program, async — the
+            # per-device folds overlap); each packed emit parks in its
+            # OWN device's ring.  Empty blocks still dispatch
+            # all-invalid so per-batch slab rewrite counts match the
+            # single-device fold's (the byte-identity differential).
+            n_entries = 0
+            for d, chunks in enumerate(entry.mesh):
+                for ch in chunks:
+                    if ch is None:
+                        ch = self._mesh_idle_chunk(d)
+                    f = ch["feed"]
+                    packed = self._parted.step_shard(
+                        d, f["lat"], f["lng"], f["speed"], f["ts"],
+                        f["valid"], cutoff, prekeys=ch["prekeys"])
+                    self._mesh_rings[d].append(packed, self.epoch,
+                                               live=ch["n"] > 0)
+                    n_entries += 1
+                    if ch["n"]:
+                        self._mesh_rows[d] += ch["n"]
+                        self._c_mesh_rows.labels(shard=str(d)).inc(
+                            ch["n"])
+                    if self._mesh_governors is not None:
+                        self._mesh_governors[d].note_dispatch(ch["n"])
+            self._parted.n_steps += 1
+            if lin is not None:
+                # the batch's lineage closes when its LAST shard entry
+                # flushes (per-shard flushes run independently)
+                self._mesh_epoch_pend[self.epoch] = n_entries
+        elif self._multi is not None:
             # fused path: one dispatch for every (res, window) pair; the
             # packed emits + stats park in the device-resident ring and
             # cross the link in one pull per flush interval (engine.multi
@@ -1811,6 +2121,7 @@ class MicroBatchRuntime:
             packed = self._multi.step_packed_all(
                 feed["lat"], feed["lng"], feed["speed"], feed["ts"],
                 feed["valid"], cutoff, prekeys=prekeys)
+            self._ring.append(packed, self.epoch)
         else:
             # sharded path: ONE dispatch folds every pair (single fused
             # all_to_all); the deferred pull covers this host's emit
@@ -1819,7 +2130,7 @@ class MicroBatchRuntime:
             packed = self._sharded.step_packed(
                 feed["lat"], feed["lng"], feed["speed"], feed["ts"],
                 feed["valid"], cutoff, prekeys=prekeys)
-        self._ring.append(packed, self.epoch)
+            self._ring.append(packed, self.epoch)
         if self.governor is not None:
             self.governor.note_dispatch(n)
         if lin is not None:
@@ -1899,7 +2210,8 @@ class MicroBatchRuntime:
             # overlapping the fold just dispatched)
             "prefetch": t_end - t_sink,
         }
-        for k in ("poll_fetch", "poll_decode", "poll_wait"):
+        for k in ("poll_fetch", "poll_decode", "poll_wait", "partition",
+                  "shard_filter"):
             if k in espans:
                 spans[k] = espans[k]
         self.metrics.observe_batch(t_end - t0, spans)
@@ -1987,6 +2299,240 @@ class MicroBatchRuntime:
                     lat[:n_live], lng[:n_live], r)
             prekeys[r] = (hi, lo)
         return prekeys
+
+    # ------------------------------------------------- partitioned mesh
+    def _mesh_feed(self, cols, shard_cells, spans) -> list:
+        """Partition one polled batch into per-device row blocks
+        (stream/shardmap.MeshPartition): each device's owned rows are
+        compacted to its block prefix IN STREAM ORDER (the per-group
+        f32 accumulation order byte-identity rests on), padded to the
+        device's live pad bucket, and device_put to the owning chip —
+        the H2D transfers overlap the in-flight folds when called from
+        the prefetch stage.  A device owning none of the batch's cells
+        gets ``None`` (the dispatcher sends its cached all-invalid
+        chunk so per-batch slab rewrite counts match the single-device
+        fold).  Under a per-shard governor a device whose rows exceed
+        its bucket dispatches multiple chunks — regrouping, never
+        dropping (the PR 10 exact-regrouping discipline)."""
+        t0 = time.monotonic()
+        reuse = None
+        if (shard_cells is not None and self.meshmap.native
+                and len(shard_cells) == len(cols)):
+            # composed process+mesh sharding: the ownership filter
+            # already snapped these rows at the same (coarsest-res)
+            # partition key — no second host snap
+            reuse = shard_cells
+        ids, cells = self.meshmap.partition(cols.lat_rad, cols.lng_rad,
+                                            cells=reuse)
+        spans["partition"] = time.monotonic() - t0
+        t1 = time.monotonic()
+        govs = self._mesh_governors
+        blocks = []
+        for d in range(self._parted.n_shards):
+            idx = np.flatnonzero(ids == d)
+            bucket = (govs[d].batch_rows if govs is not None
+                      else self._feed_batch)
+            if idx.size == 0:
+                blocks.append([None])
+                continue
+            chunks = []
+            for lo in range(0, int(idx.size), bucket):
+                chunks.append(self._mesh_chunk(
+                    cols, idx[lo:lo + bucket], cells, bucket, d))
+            blocks.append(chunks)
+        spans["pad"] = time.monotonic() - t1
+        spans["build"] = spans["pad"]
+        return blocks
+
+    def _mesh_chunk(self, cols, sel, cells, bucket: int, d: int) -> dict:
+        """One device's padded feed chunk: lanes gathered by ``sel``
+        (owned-row indices, stream order), padded to ``bucket``, host
+        pre-snap keys attached (reusing the partition's own cells for
+        the coarsest resolution — the PR 7 handoff), everything
+        committed to device ``d``."""
+        n = int(sel.size)
+        lat = np.zeros(bucket, np.float32)
+        lat[:n] = cols.lat_rad[sel]
+        lng = np.zeros(bucket, np.float32)
+        lng[:n] = cols.lng_rad[sel]
+        speed = np.zeros(bucket, np.float32)
+        speed[:n] = cols.speed_kmh[sel]
+        ts = np.zeros(bucket, np.int32)
+        ts[:n] = cols.ts_s[sel]
+        valid = np.zeros(bucket, bool)
+        valid[:n] = True
+        prekeys = None
+        if self._host_snap is not None:
+            sub_cells = (cells[sel] if (cells is not None
+                                        and self.meshmap.native) else None)
+            prekeys = {}
+            for r in self._parted._uniq_res:
+                hi = np.zeros(bucket, np.uint32)
+                lo = np.zeros(bucket, np.uint32)
+                if sub_cells is not None and r == self.meshmap.snap_res:
+                    hi[:n] = (sub_cells >> np.uint64(32)).astype(np.uint32)
+                    lo[:n] = sub_cells.astype(np.uint32)
+                else:
+                    hi[:n], lo[:n] = self._host_snap(lat[:n], lng[:n], r)
+                prekeys[r] = (hi, lo)
+        dev = self._parted.devices[d]
+        feed = {"lat": jax.device_put(lat, dev),
+                "lng": jax.device_put(lng, dev),
+                "speed": jax.device_put(speed, dev),
+                "ts": jax.device_put(ts, dev),
+                "valid": jax.device_put(valid, dev)}
+        if prekeys is not None:
+            prekeys = {r: (jax.device_put(hi, dev),
+                           jax.device_put(lo, dev))
+                       for r, (hi, lo) in prekeys.items()}
+        return {"n": n, "feed": feed, "prekeys": prekeys}
+
+    def _mesh_idle_chunk(self, d: int, bucket: int | None = None) -> dict:
+        """Cached all-invalid chunk for device ``d`` at the current (or
+        given) pad bucket — empty dispatches and the governor ladder
+        warmup share it, so repeat empties pay no pad/transfer.  Safe
+        to reuse: the jitted step donates only its STATE arguments."""
+        if bucket is None:
+            bucket = (self._mesh_governors[d].batch_rows
+                      if self._mesh_governors is not None
+                      else self._feed_batch)
+        key = (d, bucket)
+        cached = self._mesh_idle.get(key)
+        if cached is None:
+            dev = self._parted.devices[d]
+            zf = jax.device_put(np.zeros(bucket, np.float32), dev)
+            feed = {"lat": zf, "lng": zf, "speed": zf,
+                    "ts": jax.device_put(np.zeros(bucket, np.int32), dev),
+                    "valid": jax.device_put(np.zeros(bucket, bool), dev)}
+            prekeys = None
+            if self._host_snap is not None:
+                z = jax.device_put(np.zeros(bucket, np.uint32), dev)
+                prekeys = {r: (z, z) for r in self._parted._uniq_res}
+            cached = self._mesh_idle[key] = {
+                "n": 0, "feed": feed, "prekeys": prekeys}
+        return cached
+
+    def _warm_mesh_ladder(self, ladder) -> None:
+        """Precompile every device's fused step at every governor pad
+        bucket (the single-device _warm_ladder, per mesh shard): one
+        all-invalid dispatch per (device, bucket) through the
+        instrumented entry points — identity on the state, results
+        discarded.  After this a governed bucket move on ANY shard is a
+        pure cache hit; any later compile IS a retrace and freezes
+        every shard governor (the per-ladder latch)."""
+        t0 = time.monotonic()
+        for n_rows in ladder:
+            for d in range(self._parted.n_shards):
+                ch = self._mesh_idle_chunk(d, bucket=n_rows)
+                f = ch["feed"]
+                self._parted.step_shard(
+                    d, f["lat"], f["lng"], f["speed"], f["ts"],
+                    f["valid"], I32_MIN, prekeys=ch["prekeys"])
+        log.info("mesh governor bucket ladder warmed on %d devices: %s "
+                 "(%.2fs)", self._parted.n_shards, ladder,
+                 time.monotonic() - t0)
+
+    def _flush_mesh_shard(self, d: int) -> None:
+        """Pull + account every batch parked on ONE mesh shard's device
+        (partitioned mode).  One call = one stacked transfer off that
+        device ONLY — a hot downtown shard flushing at its own cadence
+        never forces a pull on three idle suburb shards, so idle
+        shards' pull counts stay at the idle-flush floor (checkpoints,
+        idle polls, close)."""
+        ring = self._mesh_rings[d]
+        if not len(ring):
+            return
+        t0 = time.monotonic()
+        from heatmap_tpu.engine.multi import stats_from_packed
+
+        n_batches = len(ring)
+        flushed = ring.flush_stacked(self._prefix_pull)
+        residency = ring.last_flush_residency
+        live = ring.last_flush_live
+        batch_max = I32_MIN
+        for i, (bufs, epoch) in enumerate(flushed):
+            bm = I32_MIN
+            for idx, (res, win_s) in enumerate(self._parted.pairs):
+                stats = stats_from_packed(bufs[idx])
+                bm = max(bm, self._account_pair_packed(
+                    res, win_s // 60, bufs[idx][1:], stats, epoch,
+                    shard=d))
+            batch_max = self._book_flushed_batch(bm, batch_max)
+            # idle entries' residency is synthetic (an empty dispatch
+            # can park 8xK deep by design) — keep it OUT of the
+            # ring-residency telemetry, which describes data batches
+            self._note_mesh_flushed(
+                epoch, residency[i] if (i < len(residency)
+                                        and i < len(live) and live[i])
+                else None)
+        self.metrics.count("emit_pulls", 1)
+        self.metrics.count("emit_pull_batches", n_batches)
+        self._mesh_pulls[d] += 1
+        self._mesh_pull_batches[d] += n_batches
+        self._c_mesh_pulls.labels(shard=str(d)).inc()
+        if batch_max > I32_MIN:
+            self.max_event_ts = max(self.max_event_ts, batch_max)
+        if self.max_event_ts > I32_MIN:
+            self._g_watermark.set(time.time() - self.max_event_ts)
+        self._last_flush_cutoff = (
+            self.max_event_ts - self.cfg.watermark_minutes * 60
+            if self.max_event_ts > I32_MIN else I32_MIN)
+        self._last_pull_s += time.monotonic() - t0
+
+    def _note_mesh_flushed(self, epoch: int, residency) -> None:
+        """Per-(shard, batch) flush accounting on the partitioned mesh:
+        residency histograms per pulled entry; the batch's lineage
+        record closes only when its LAST shard entry has flushed (until
+        then part of the batch's emits are still device-resident)."""
+        if residency is not None:
+            self.metrics.ring_residency.observe(residency[0])
+            self.metrics.ring_residency_batches.observe(residency[1])
+        pend = self._mesh_epoch_pend.get(epoch)
+        if pend is None:
+            return
+        if pend > 1:
+            self._mesh_epoch_pend[epoch] = pend - 1
+            return
+        del self._mesh_epoch_pend[epoch]
+        rec = self._lineage_open.pop(epoch, None)
+        if rec is None:
+            return
+        self.lineage.flushed(
+            rec, ring_batches=residency[1] if residency else None)
+        self.writer.submit_mark(functools.partial(self._lineage_commit,
+                                                  rec))
+
+    def mesh_shard_stats(self) -> list:
+        """Per-mesh-shard accounting for artifacts and tools (e2e_rate
+        --mesh-devices, hw_burst stream_colfeed_mesh): rows folded,
+        device->host pulls vs pulled batches (the ring's amortization),
+        current ring depth, and the shard's effective/governed knobs.
+        Empty list off the partitioned mesh path."""
+        if self._parted is None:
+            return []
+        out = []
+        for d in range(self._parted.n_shards):
+            gov = (self._mesh_governors[d]
+                   if self._mesh_governors is not None else None)
+            out.append({
+                "shard": d,
+                "device": str(self._parted.devices[d]),
+                "rows": int(self._mesh_rows[d]),
+                "emit_pulls": int(self._mesh_pulls[d]),
+                "emit_pull_batches": int(self._mesh_pull_batches[d]),
+                "ring_pending": len(self._mesh_rings[d]),
+                "flush_k": self._mesh_rings[d].capacity,
+                "effective": ({"batch_rows": gov.batch_rows,
+                               "flush_k": gov.flush_k,
+                               "prefetch": gov.prefetch}
+                              if gov is not None else
+                              {"batch_rows": self._feed_batch,
+                               "flush_k": self._mesh_rings[d].capacity,
+                               "prefetch": self._prefetch_n}),
+                "govern": (dict(enabled=True, **gov.snapshot())
+                           if gov is not None else {"enabled": False}),
+            })
+        return out
 
     def _touch_heartbeat(self) -> None:
         """Liveness beacon for stream.supervisor: overwrite the file named

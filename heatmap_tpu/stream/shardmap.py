@@ -80,6 +80,25 @@ def _fmix64(x: np.ndarray) -> np.ndarray:
     return x
 
 
+def _snap_cells(lat_rad: np.ndarray, lng_rad: np.ndarray, res: int,
+                host_snap) -> np.ndarray:
+    """uint64 H3 cells at ``res`` for f32-radian coordinates — C++ host
+    snap when a toolchain exists, else the exact Python host oracle
+    (slow; tests and toolchain-less hosts only)."""
+    lat_rad = np.asarray(lat_rad, np.float32)
+    lng_rad = np.asarray(lng_rad, np.float32)
+    if host_snap is not None:
+        hi, lo = host_snap(lat_rad, lng_rad, res)
+        return (hi.astype(np.uint64) << np.uint64(32)) \
+            | lo.astype(np.uint64)
+    from heatmap_tpu.hexgrid.host import latlng_to_cell_int
+
+    return np.fromiter(
+        (latlng_to_cell_int(float(la), float(lo_), res)
+         for la, lo_ in zip(lat_rad, lng_rad)),
+        np.uint64, count=len(lat_rad))
+
+
 class ShardMap:
     """Stable H3-parent → shard assignment for one runtime shard.
 
@@ -129,18 +148,8 @@ class ShardMap:
         """uint64 H3 cells at ``snap_res`` for f32-radian coordinates —
         C++ host snap when a toolchain exists, else the exact Python
         host oracle (slow; tests and toolchain-less hosts only)."""
-        lat_rad = np.asarray(lat_rad, np.float32)
-        lng_rad = np.asarray(lng_rad, np.float32)
-        if self._host_snap is not None:
-            hi, lo = self._host_snap(lat_rad, lng_rad, self.snap_res)
-            return (hi.astype(np.uint64) << np.uint64(32)) \
-                | lo.astype(np.uint64)
-        from heatmap_tpu.hexgrid.host import latlng_to_cell_int
-
-        return np.fromiter(
-            (latlng_to_cell_int(float(la), float(lo_), self.snap_res)
-             for la, lo_ in zip(lat_rad, lng_rad)),
-            np.uint64, count=len(lat_rad))
+        return _snap_cells(lat_rad, lng_rad, self.snap_res,
+                           self._host_snap)
 
     def shard_of_cells(self, cells: np.ndarray,
                        res: int | None = None) -> np.ndarray:
@@ -192,4 +201,86 @@ class ShardMap:
         return (f"shard {self.index}/{self.n_shards} "
                 f"(snap res {self.snap_res}, partition parent res "
                 f"{self.parent_res}, "
+                f"{'native' if self._host_snap else 'python'} host snap)")
+
+
+class MeshPartition:
+    """Stable H3-parent → mesh-device assignment for the partitioned
+    mesh fast path (parallel.sharded.PartitionedAggregator).
+
+    Same exactness contract as :class:`ShardMap` — the partition key is
+    the H3 parent (bit surgery) of the event's cell snapped at the
+    COARSEST fold resolution with the fold's own host snap, mapped
+    through murmur3 fmix64: a pure, stable function of the cell index,
+    so every (cell, window) group lands wholly on one device and the
+    merged per-device emits are byte-identical to the single-device
+    fold (single-resolution configs; multi-res pyramids carry the same
+    bounded boundary-sliver caveat ShardMap documents).
+
+    ``outer_shards`` composes with PROCESS-level H3 sharding
+    (HEATMAP_SHARDS): a shard process already filtered its rows by
+    ``fmix64(parent) % N``, so the device key must consume DIFFERENT
+    hash bits — the quotient ``fmix64(parent) // N`` feeds the device
+    modulus.  With correlated moduli (e.g. N == D == 2) the naive
+    same-hash assignment would park every one of a process's rows on
+    its first device."""
+
+    def __init__(self, n_devices: int, snap_res: int,
+                 parent_res: int = -1, outer_shards: int = 1):
+        if n_devices < 1:
+            raise ValueError(f"mesh device count must be >= 1, "
+                             f"got {n_devices}")
+        if not 0 <= snap_res <= 15:
+            raise ValueError(f"snap res {snap_res} out of range")
+        if parent_res == -1:
+            parent_res = snap_res
+        if not 0 <= parent_res <= snap_res:
+            raise ValueError(
+                f"mesh partition parent res must be in 0..{snap_res} "
+                f"(the snap resolution), got {parent_res}")
+        self.n_devices = int(n_devices)
+        self.snap_res = int(snap_res)
+        self.parent_res = int(parent_res)
+        self.outer_shards = max(1, int(outer_shards))
+        self._host_snap = None
+        from heatmap_tpu.hexgrid import native_snap
+
+        if native_snap.available():
+            self._host_snap = native_snap.snap_arrays
+
+    @property
+    def native(self) -> bool:
+        """True when the C++ host snap computes the partition key — the
+        runtime then reuses the cells as the fold's pre-snap keys for
+        the coarsest resolution (the PR 7 handoff, per device)."""
+        return self._host_snap is not None
+
+    def cells_of(self, lat_rad: np.ndarray, lng_rad: np.ndarray
+                 ) -> np.ndarray:
+        return _snap_cells(lat_rad, lng_rad, self.snap_res,
+                           self._host_snap)
+
+    def device_of_cells(self, cells: np.ndarray,
+                        res: int | None = None) -> np.ndarray:
+        """int32 mesh-device id per uint64 cell.  Pure function of
+        (cell, outer_shards, n_devices): stable across runs/processes."""
+        parents = parent_cells(
+            cells, self.snap_res if res is None else res, self.parent_res)
+        mix = _fmix64(parents) // np.uint64(self.outer_shards)
+        return (mix % np.uint64(self.n_devices)).astype(np.int32)
+
+    def partition(self, lat_rad: np.ndarray, lng_rad: np.ndarray,
+                  cells: np.ndarray | None = None):
+        """(device ids, cells) for a batch's rows.  ``cells`` may be the
+        process-level ownership filter's already-snapped cells (same
+        snap_res by construction — both partition at the coarsest fold
+        resolution), in which case no second snap is paid."""
+        if cells is None:
+            cells = self.cells_of(lat_rad, lng_rad)
+        return self.device_of_cells(cells), cells
+
+    def describe(self) -> str:
+        return (f"{self.n_devices}-device mesh partition (snap res "
+                f"{self.snap_res}, parent res {self.parent_res}, "
+                f"outer shards {self.outer_shards}, "
                 f"{'native' if self._host_snap else 'python'} host snap)")

@@ -427,3 +427,125 @@ def test_govern_ramp_failed_run_skipped(tmp_path, capsys):
     _write_govern_ramp(tmp_path, 2, rc=1)
     assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
     assert "skipping govern r02" in capsys.readouterr().out
+
+
+# ------------------------------------------ mesh provenance (ISSUE 11)
+def _write_mesh_bench(dir_path, rnd, value, devices, mode):
+    p = _write(dir_path, rnd, value)
+    art = json.loads(p.read_text())
+    art["mesh"] = {"devices": devices, "mode": mode}
+    p.write_text(json.dumps(art))
+    return p
+
+
+def test_mixed_mesh_device_count_refused(tmp_path, capsys):
+    m = _load()
+    _write_mesh_bench(tmp_path, 1, 1_000_000.0, 4, "partitioned")
+    _write_mesh_bench(tmp_path, 2, 900_000.0, 2, "partitioned")
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "mesh mismatch" in capsys.readouterr().err
+
+
+def test_mixed_mesh_mode_refused(tmp_path, capsys):
+    m = _load()
+    _write_mesh_bench(tmp_path, 1, 1_000_000.0, 4, "shuffle")
+    _write_mesh_bench(tmp_path, 2, 900_000.0, 4, "partitioned")
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "mesh mismatch" in capsys.readouterr().err
+
+
+def test_same_mesh_pair_still_compares(tmp_path, capsys):
+    m = _load()
+    _write_mesh_bench(tmp_path, 1, 1_000_000.0, 4, "partitioned")
+    _write_mesh_bench(tmp_path, 2, 900_000.0, 4, "partitioned")
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_missing_mesh_stamp_stays_comparable(tmp_path):
+    m = _load()
+    _write(tmp_path, 1, 1_000_000.0)
+    _write_mesh_bench(tmp_path, 2, 900_000.0, 4, "partitioned")
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
+
+
+def test_mesh_refusal_composes_with_shards_gate(tmp_path, capsys):
+    """mesh + shards refusals stack: the mesh gate fires first, and a
+    same-mesh pair still falls through to the shards refusal."""
+    m = _load()
+    p1 = _write(tmp_path, 1, 1_000_000.0, shards=1)
+    p2 = _write(tmp_path, 2, 900_000.0, shards=4)
+    for p, dev in ((p1, 4), (p2, 4)):
+        art = json.loads(p.read_text())
+        art["mesh"] = {"devices": dev, "mode": "partitioned"}
+        p.write_text(json.dumps(art))
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "shards mismatch" in capsys.readouterr().err
+
+
+# ------------------------------------- MULTICHIP_r* artifacts (ISSUE 11)
+def _write_multichip(dir_path, rnd, rate=None, devices=4,
+                     mode="partitioned", rc=0, legacy=False):
+    p = dir_path / f"MULTICHIP_r{rnd:02d}.json"
+    if legacy:
+        # the r01-r05 dryrun proofs: no headline, no mesh stamp
+        p.write_text(json.dumps({"n_devices": devices, "rc": rc,
+                                 "ok": rc == 0, "tail": "dryrun ok"}))
+        return p
+    p.write_text(json.dumps({
+        "rc": rc, "steady_events_per_sec": rate,
+        "mesh": {"devices": devices, "mode": mode}}))
+    return p
+
+
+def test_multichip_ok_within_threshold(tmp_path, capsys):
+    m = _load()
+    _write_multichip(tmp_path, 6, rate=1_000_000.0)
+    _write_multichip(tmp_path, 7, rate=900_000.0)
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
+    assert "multichip r06" in capsys.readouterr().out
+
+
+def test_multichip_rate_regression_fails(tmp_path, capsys):
+    m = _load()
+    _write_multichip(tmp_path, 6, rate=1_000_000.0)
+    _write_multichip(tmp_path, 7, rate=400_000.0)  # -60%
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "multichip regression" in capsys.readouterr().err
+
+
+def test_multichip_device_count_mismatch_refused(tmp_path, capsys):
+    m = _load()
+    _write_multichip(tmp_path, 6, rate=1_000_000.0, devices=4)
+    _write_multichip(tmp_path, 7, rate=1_000_000.0, devices=8)
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "device-count mismatch" in capsys.readouterr().err
+
+
+def test_multichip_mode_mismatch_refused(tmp_path, capsys):
+    m = _load()
+    _write_multichip(tmp_path, 6, rate=1_000_000.0, mode="partitioned")
+    _write_multichip(tmp_path, 7, rate=1_000_000.0, mode="shuffle")
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "mesh mode mismatch" in capsys.readouterr().err
+
+
+def test_multichip_legacy_dryruns_skipped(tmp_path, capsys):
+    """The banked r01-r05 dryrun proofs carry no headline: they are
+    skipped with a note, never compared (and never refused)."""
+    m = _load()
+    for rnd in (1, 2, 3):
+        _write_multichip(tmp_path, rnd, legacy=True)
+    _write_multichip(tmp_path, 6, rate=1_000_000.0)
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
+    out = capsys.readouterr().out
+    assert "skipping multichip r01" in out
+    assert "nothing to compare" in out
+
+
+def test_multichip_failed_run_skipped(tmp_path, capsys):
+    m = _load()
+    _write_multichip(tmp_path, 6, rate=1_000_000.0)
+    _write_multichip(tmp_path, 7, rate=900_000.0, rc=1)
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
+    assert "skipping multichip r07" in capsys.readouterr().out

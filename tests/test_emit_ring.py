@@ -138,6 +138,34 @@ def test_emitring_capacity():
     assert not ring.full
 
 
+def test_emitring_idle_entries_do_not_trigger(tmp_path):
+    """Per-mesh-shard flush independence (ISSUE 11): entries appended
+    ``live=False`` (empty dispatches) park — their eviction emits and
+    stats must still be pulled eventually — but never advance the flush
+    trigger, so an idle shard's ring only drains at forced barriers.
+    The 8x-capacity hard cap bounds the parked memory regardless."""
+    from heatmap_tpu.engine.step import EmitRing
+
+    ring = EmitRing(2)
+    for i in range(15):
+        assert not ring.full
+        ring.append(np.zeros((1, 9, 13), np.uint32), tag=i, live=False)
+    assert len(ring) == 15 and ring.live_pending == 0
+    # the 8 * capacity memory backstop trips on the 16th idle entry
+    assert ring.append(np.zeros((1, 9, 13), np.uint32), live=False)
+    assert ring.full
+    flushed = ring.flush_stacked(False)
+    assert len(flushed) == 16 and not ring.full
+    # one live entry among idles: the LIVE count is the trigger
+    ring.append(np.zeros((1, 9, 13), np.uint32), live=False)
+    assert not ring.append(np.zeros((1, 9, 13), np.uint32), live=True)
+    assert ring.live_pending == 1 and not ring.full
+    assert ring.append(np.zeros((1, 9, 13), np.uint32), live=True)
+    assert ring.full  # 2 live == capacity; the idle one rides along
+    assert len(ring.take()) == 3
+    assert ring.live_pending == 0
+
+
 # --------------------------------------------------------- runtime level
 def test_ring_amortizes_pulls_and_conserves(tmp_path):
     """Steady state: one pull per K batches (the >= 4x round-trip

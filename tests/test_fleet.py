@@ -675,6 +675,62 @@ heatmap_shard_count 2
     assert "own-cell %" not in plain and "imbalance" not in plain
 
 
+def test_obs_top_fleet_frame_renders_mesh_shard_rows(tmp_path):
+    """A partitioned-mesh member (ISSUE 11) gets the per-mesh-shard
+    table: device index, owned-cell share (this device's rows over the
+    member's total — the PR 7 imbalance math per device), ring depth,
+    device->host pulls, and the shard's governor batch/flush-K."""
+    mesh_text = """\
+# TYPE heatmap_mesh_devices gauge
+heatmap_mesh_devices 2
+# TYPE heatmap_mesh_rows_total counter
+heatmap_mesh_rows_total{shard="0"} 800
+heatmap_mesh_rows_total{shard="1"} 200
+# TYPE heatmap_mesh_pulls_total counter
+heatmap_mesh_pulls_total{shard="0"} 12
+heatmap_mesh_pulls_total{shard="1"} 2
+# TYPE heatmap_mesh_ring_pending gauge
+heatmap_mesh_ring_pending{shard="0"} 3
+heatmap_mesh_ring_pending{shard="1"} 1
+# TYPE heatmap_govern_batch_rows gauge
+heatmap_govern_batch_rows{shard="0"} 256
+heatmap_govern_batch_rows{shard="1"} 64
+# TYPE heatmap_govern_flush_k gauge
+heatmap_govern_flush_k{shard="0"} 8
+heatmap_govern_flush_k{shard="1"} 2
+# TYPE heatmap_govern_frozen gauge
+heatmap_govern_frozen{shard="0"} 0
+heatmap_govern_frozen{shard="1"} 1
+"""
+    top = _load_obs_top()
+    chan = _chan(tmp_path)
+    publish_member_snapshot(
+        chan, "mesh0", role="runtime", metrics_text=mesh_text,
+        freshness={"event_age_p50_s": 0.4},
+        healthz={"status": "ok", "checks": {}})
+    m = top.parse_prom(FleetAggregator(chan).metrics_text())
+    frame = top.render_fleet_frame(m, None, 0.0, None)
+    assert "mesh shard" in frame
+    assert "80.0 %" in frame and "20.0 %" in frame   # owned-cell share
+    assert "12" in frame and "256" in frame and "64" in frame
+    # max/mean over (800, 200): 800 / 500 = 1.6x
+    assert "mesh imbalance max/mean 1.60x" in frame
+    # shard 1's frozen governor is marked ON ITS OWN ROW — and the
+    # member-level governor table must NOT collapse the shard-labeled
+    # samples to one arbitrary shard per member (it skips them; the
+    # mesh table is their home)
+    shard_rows = [ln for ln in frame.splitlines()
+                  if ln.strip().startswith("mesh0")]
+    frozen_rows = [ln for ln in shard_rows if "FROZEN" in ln]
+    assert len(frozen_rows) == 1 and "   1" in frozen_rows[0]
+    assert "adjusted" not in frame  # no member-level governor table
+    # a mesh-less fleet renders NO mesh table
+    plain = top.render_fleet_frame(
+        top.parse_prom('heatmap_events_valid_total{proc="p0"} 1\n'),
+        None, 0.0, None)
+    assert "mesh shard" not in plain
+
+
 def test_obs_top_fleet_frame_marks_stale_member(tmp_path):
     top = _load_obs_top()
     chan = _chan(tmp_path)

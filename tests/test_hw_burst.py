@@ -260,3 +260,17 @@ def test_contact_gate_pass_runs_the_unit(progress, monkeypatch):
     for n in pending:
         assert n in out["units"]
         assert out["attempts"][n] == 1
+
+
+def test_mesh_unit_registered():
+    """ISSUE 11 satellite: the attached multi-chip unit exists in BOTH
+    tables (scheduler + dispatcher) so the next relay uptime window can
+    bank the partitioned-mesh headline directly — ringed, prefetched,
+    governed."""
+    assert "stream_colfeed_mesh" in hw_burst.UNITS
+    assert "stream_colfeed_mesh" in hw_burst.UNIT_FNS
+    cap, attempts = hw_burst.UNITS["stream_colfeed_mesh"]
+    # D per-device programs compile cold on the tunnel: the cap must
+    # exceed the single-device colfeed unit's
+    assert cap >= hw_burst.UNITS["stream_colfeed"][0]
+    assert attempts >= 1

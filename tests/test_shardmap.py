@@ -246,3 +246,56 @@ def test_serve_side_jsonl_store_unions_all_shard_logs(tmp_path):
              for t in reader.tiles_in_window(when, grid="h3r8")}
     reader.close()
     assert cells == {"892a300ca3bffff", "892a3008b4fffff"}
+
+
+# ------------------------------------------------- MeshPartition (ISSUE 11)
+def test_mesh_partition_every_cell_exactly_one_device():
+    from heatmap_tpu.stream.shardmap import MeshPartition
+
+    rng = np.random.default_rng(9)
+    lat = np.radians(42.3 + rng.uniform(0, 0.3, 1024)).astype(np.float32)
+    lng = np.radians(-71.2 + rng.uniform(0, 0.3, 1024)).astype(np.float32)
+    mp = MeshPartition(4, snap_res=8)
+    ids, cells = mp.partition(lat, lng)
+    assert ids.dtype == np.int32
+    assert ((ids >= 0) & (ids < 4)).all()
+    # same cell -> same device, always (pure function of the index)
+    by_cell = {}
+    for c, d in zip(cells.tolist(), ids.tolist()):
+        assert by_cell.setdefault(c, d) == d
+
+
+def test_mesh_partition_quotient_decorrelates_from_outer_shards():
+    """With outer_shards=N the device key consumes the QUOTIENT of the
+    same fmix64 mix: rows filtered to one process shard (mix % N == i)
+    still spread over the device modulus.  The naive same-hash
+    assignment (outer_shards=1) provably collapses at N == D: every
+    row of process shard 0 would satisfy mix % 2 == 0 -> device 0."""
+    from heatmap_tpu.stream.shardmap import MeshPartition, ShardMap
+
+    rng = np.random.default_rng(13)
+    lat = np.radians(42.0 + rng.uniform(0, 0.5, 2048)).astype(np.float32)
+    lng = np.radians(-71.5 + rng.uniform(0, 0.5, 2048)).astype(np.float32)
+    sm = ShardMap(2, 0, 8)
+    cells = sm.cells_of(lat, lng)
+    owned = cells[sm.shard_of_cells(cells) == 0]
+    assert len(owned) > 100
+    naive = MeshPartition(2, snap_res=8, outer_shards=1)
+    assert set(naive.device_of_cells(owned).tolist()) == {0}, \
+        "the collapse the quotient exists to prevent"
+    composed = MeshPartition(2, snap_res=8, outer_shards=2)
+    assert set(composed.device_of_cells(owned).tolist()) == {0, 1}
+
+
+def test_mesh_partition_validation():
+    from heatmap_tpu.stream.shardmap import MeshPartition
+
+    with pytest.raises(ValueError, match="device count"):
+        MeshPartition(0, snap_res=8)
+    with pytest.raises(ValueError, match="out of range"):
+        MeshPartition(2, snap_res=16)
+    with pytest.raises(ValueError, match="parent res"):
+        MeshPartition(2, snap_res=8, parent_res=9)
+    mp = MeshPartition(2, snap_res=8, parent_res=-1)
+    assert mp.parent_res == 8
+    assert "2-device" in mp.describe()

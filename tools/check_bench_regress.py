@@ -48,10 +48,19 @@ post-swing low-phase p50 may not grow and its high-phase consumption
 rate may not drop past the threshold; artifacts banked over different
 ramp schedules are refused outright.
 
+Mesh provenance (ISSUE 11) joins the refusal list: ``BENCH_r*`` pairs
+whose ``mesh`` stamps (device count, partitioned-vs-shuffle mode)
+differ are refused, and the ``MULTICHIP_r*.json`` mesh artifacts
+(tools/e2e_rate.py --mesh-devices) are ratcheted on the aggregate
+steady rate with the same device-count/mode refusals — a 4-chip
+partitioned aggregate must never mask a 2-chip or shuffle-mode
+regression.  The r01-r05 dryrun proofs carry no headline and are
+skipped with a note.
+
 Usage:
     python tools/check_bench_regress.py [--dir REPO] [--threshold 0.5]
 Exit codes: 0 ok / nothing to compare, 1 regression or mixed-backend /
-mixed-replica / mixed-govern pair, 2 bad arguments.
+mixed-replica / mixed-govern / mixed-mesh pair, 2 bad arguments.
 """
 
 from __future__ import annotations
@@ -145,6 +154,21 @@ def govern_enabled(path: str) -> bool | None:
     if not isinstance(v, dict) or "enabled" not in v:
         return None
     return bool(v.get("enabled"))
+
+
+def mesh_stamp(path: str) -> tuple | None:
+    """The artifact's mesh provenance (``"mesh"`` stamp, ISSUE 11):
+    (device count, "partitioned"|"shuffle") when stamped, None on
+    pre-mesh artifacts (comparable to anything, like the other
+    stamps)."""
+    v = _stamped(path, "mesh", dict)
+    if not isinstance(v, dict):
+        return None
+    devices, mode = v.get("devices"), v.get("mode")
+    if not isinstance(devices, int) or mode not in ("partitioned",
+                                                    "shuffle"):
+        return None
+    return (devices, mode)
 
 
 def newest_pair(dir_path: str) -> list:
@@ -242,6 +266,94 @@ def compare_serve(dir_path: str, threshold: float) -> int:
         else:
             print(f"OK: {line} within the {threshold:.0%} threshold")
     return rc
+
+
+# ---------------------------------------------------- multichip artifacts
+_MULTICHIP_ROUND_RE = re.compile(r"MULTICHIP_r(\d+)\.json$")
+
+
+def multichip_artifact_round(path: str) -> int | None:
+    m = _MULTICHIP_ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def multichip_metrics(path: str) -> tuple | None:
+    """(steady_events_per_sec, devices, mode) of one MULTICHIP_r*.json
+    mesh artifact (tools/e2e_rate.py --mesh-devices).  None when the
+    run failed, the headline doesn't parse, or the artifact predates
+    the mesh stamp (the r01-r05 dryrun_multichip proofs carry only
+    {n_devices, rc, tail} — skipped with a note, never compared)."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            art = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(art, dict) or art.get("rc", 0) != 0:
+        return None
+    rate = art.get("steady_events_per_sec")
+    mesh = art.get("mesh")
+    if not isinstance(rate, (int, float)) or rate <= 0 \
+            or not isinstance(mesh, dict):
+        return None
+    devices, mode = mesh.get("devices"), mesh.get("mode")
+    if not isinstance(devices, int) or mode not in ("partitioned",
+                                                    "shuffle"):
+        return None
+    return (float(rate), devices, mode)
+
+
+def compare_multichip(dir_path: str, threshold: float) -> int:
+    """Ratchet the newest two MULTICHIP_r*.json mesh artifacts on the
+    aggregate steady rate; REFUSE (exit 1) pairs whose mesh device
+    count or partitioned-vs-shuffle mode differ — a 4-chip partitioned
+    aggregate cannot stand in for a 2-chip or shuffle-mode round (or
+    mask its per-chip regression), mirroring the
+    backend/shards/replica/govern refusals."""
+    arts = []
+    for p in glob.glob(os.path.join(glob.escape(dir_path),
+                                    "MULTICHIP_r*.json")):
+        rnd = multichip_artifact_round(p)
+        if rnd is None:
+            continue
+        arts.append((rnd, p, multichip_metrics(p)))
+    arts.sort()
+    usable = [(r, p, m) for r, p, m in arts if m is not None]
+    for r, p, m in arts:
+        if m is None:
+            print(f"note: skipping multichip r{r:02d} "
+                  f"({os.path.basename(p)}): failed run, pre-mesh "
+                  f"dryrun proof, or no parseable headline")
+    if len(usable) < 2:
+        print(f"OK: {len(usable)} usable multichip artifact(s) — "
+              f"nothing to compare")
+        return 0
+    (r_prev, _pp, m_prev), (r_new, _pn, m_new) = usable[-2], usable[-1]
+    (rate_prev, dev_prev, mode_prev) = m_prev
+    (rate_new, dev_new, mode_new) = m_new
+    if dev_prev != dev_new:
+        print(f"FAIL: mesh device-count mismatch — multichip "
+              f"r{r_prev:02d} ran {dev_prev} device(s) but "
+              f"r{r_new:02d} ran {dev_new}; an N-device aggregate "
+              f"cannot stand in for another width (or mask its "
+              f"per-chip regression) — re-run at the same device "
+              f"count", file=sys.stderr)
+        return 1
+    if mode_prev != mode_new:
+        print(f"FAIL: mesh mode mismatch — multichip r{r_prev:02d} "
+              f"ran {mode_prev!r} but r{r_new:02d} ran {mode_new!r}; "
+              f"the partitioned fast path and the ICI-shuffle path "
+              f"are different experiments — re-run in the same "
+              f"HEATMAP_MESH_PARTITIONED mode", file=sys.stderr)
+        return 1
+    drop = (rate_prev - rate_new) / rate_prev
+    line = (f"multichip r{r_prev:02d} {rate_prev:,.0f} ev/s -> "
+            f"r{r_new:02d} {rate_new:,.0f} ev/s ({-drop:+.1%})")
+    if drop > threshold:
+        print(f"FAIL: multichip regression beyond {threshold:.0%}: "
+              f"{line}", file=sys.stderr)
+        return 1
+    print(f"OK: {line} within the {threshold:.0%} threshold")
+    return 0
 
 
 # ------------------------------------------------------ govern artifacts
@@ -356,6 +468,7 @@ def main(argv=None) -> int:
         return 2
     serve_rc = compare_serve(args.dir, args.threshold)
     serve_rc = compare_govern(args.dir, args.threshold) or serve_rc
+    serve_rc = compare_multichip(args.dir, args.threshold) or serve_rc
 
     arts = newest_pair(args.dir)
     usable = [(r, p, v) for r, p, v in arts if v is not None]
@@ -383,6 +496,16 @@ def main(argv=None) -> int:
               f"adaptively-governed round cannot stand in for a "
               f"static-knob headline (or mask its regression) — re-run "
               f"the bench with the same HEATMAP_GOVERN setting",
+              file=sys.stderr)
+        return 1
+    ms_prev, ms_new = mesh_stamp(p_prev), mesh_stamp(p_new)
+    if ms_prev is not None and ms_new is not None and ms_prev != ms_new:
+        print(f"FAIL: mesh mismatch — r{r_prev:02d} ran "
+              f"{ms_prev[0]} device(s) in {ms_prev[1]!r} mode but "
+              f"r{r_new:02d} ran {ms_new[0]} device(s) in "
+              f"{ms_new[1]!r}; a mesh aggregate cannot stand in for "
+              f"another device count or execution mode (or mask its "
+              f"regression) — re-run the bench on the same mesh",
               file=sys.stderr)
         return 1
     sh_prev, sh_new = shard_count(p_prev), shard_count(p_new)

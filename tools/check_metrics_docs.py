@@ -84,6 +84,38 @@ def _smoke_shard_runtime():
     return rt
 
 
+def _smoke_mesh_runtime():
+    """A CONSTRUCTED (never run) partitioned-mesh runtime: the
+    per-mesh-shard families (mesh devices/rows/pulls/ring gauges and
+    the shard-labeled governor gauges) only register when a
+    multi-device mesh is attached in partitioned mode.  Needs >= 2
+    devices — main() forces 2 CPU host devices before any backend
+    initializes; if the forcing is unavailable on this jaxlib the
+    smoke is skipped (the families go unenforced on that host, not
+    wrongly failed)."""
+    import jax
+
+    if jax.device_count() < 2:
+        return None
+    from heatmap_tpu.config import load_config
+    from heatmap_tpu.parallel import make_mesh
+    from heatmap_tpu.sink import MemoryStore
+    from heatmap_tpu.stream import MicroBatchRuntime
+    from heatmap_tpu.stream.source import MemorySource
+
+    cfg = load_config({}, batch_size=64, state_capacity_log2=8,
+                      speed_hist_bins=4, store="memory", serve_port=0,
+                      govern=True, govern_min_batch=64,
+                      checkpoint_dir=tempfile.mkdtemp(
+                          prefix="metrics-docs-mesh-"))
+    src = MemorySource([])
+    src.finish()
+    rt = MicroBatchRuntime(cfg, src, MemoryStore(), mesh=make_mesh(2),
+                           checkpoint_every=0)
+    rt.close()
+    return rt
+
+
 def _smoke_repl():
     """CONSTRUCTED replication publisher + follower (query/repl.py):
     their metric families only register on a replicated config — a
@@ -122,6 +154,18 @@ def _smoke_govern():
 
 def main() -> int:
     os.environ.setdefault("HEATMAP_PLATFORM", "cpu")
+    # the mesh smoke needs >= 2 devices; force 2 CPU host devices
+    # BEFORE any backend initializes (lazy init — the first smoke below
+    # is the first jax touch)
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_"
+                                 "count=2").strip()
+    try:
+        import jax
+
+        jax.config.update("jax_num_cpu_devices", 2)
+    except AttributeError:
+        pass  # older jaxlib: the XLA flag above is honored at lazy init
     with open(os.path.join(REPO, "ARCHITECTURE.md"),
               encoding="utf-8") as fh:
         arch = fh.read()
@@ -132,6 +176,11 @@ def main() -> int:
     fams += [f for f in
              _smoke_shard_runtime().metrics.registry._families.values()
              if f.name not in seen]
+    seen = {f.name for f in fams}
+    mesh_rt = _smoke_mesh_runtime()
+    if mesh_rt is not None:
+        fams += [f for f in mesh_rt.metrics.registry._families.values()
+                 if f.name not in seen]
     seen = {f.name for f in fams}
     fams += [f for f in _smoke_repl() if f.name not in seen]
     seen = {f.name for f in fams}

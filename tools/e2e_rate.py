@@ -387,11 +387,27 @@ def _ramp_phase_stats(schedule, samples, t0: float) -> list:
 def _effective_knobs(rt) -> dict:
     """The knob values a runtime is ACTUALLY executing with — the
     governor's live decisions when enabled, the static plumbing
-    otherwise.  One helper so every artifact stamp agrees."""
+    otherwise.  One helper so every artifact stamp agrees.  A governed
+    partitioned mesh has PER-SHARD knobs (the artifact's
+    mesh.per_shard[*].effective carries each one); the top-level stamp
+    then reports the across-shard ranges so it never silently shows
+    the unused static plumbing."""
+    govs = getattr(rt, "_mesh_governors", None)
+    if govs:
+        return {"batch_rows": max(g.batch_rows for g in govs),
+                "batch_rows_min": min(g.batch_rows for g in govs),
+                "flush_k": max(g.flush_k for g in govs),
+                "flush_k_min": min(g.flush_k for g in govs),
+                "prefetch": rt._prefetch_n,
+                "per_shard": True}
     gov = rt.governor
     if gov is not None:
         return {"batch_rows": gov.batch_rows, "flush_k": gov.flush_k,
                 "prefetch": gov.prefetch}
+    if getattr(rt, "_mesh_rings", None) is not None:
+        return {"batch_rows": rt._feed_batch,
+                "flush_k": rt._mesh_rings[0].capacity,
+                "prefetch": rt._prefetch_n}
     return {"batch_rows": rt._feed_batch,
             "flush_k": rt._ring.capacity,
             "prefetch": rt._prefetch_n}
@@ -557,11 +573,24 @@ def main() -> int:
                     help="comma list of minutes; e.g. 1,5,15 = the "
                     "BASELINE #5 multi-window config")
     ap.add_argument("--mesh-shards", type=int, default=1,
-                    help=">1 runs the SHARDED runtime over an n-device "
-                    "mesh (on CPU: virtual devices via "
+                    help=">1 runs the ICI-SHUFFLE sharded runtime over "
+                    "an n-device mesh (on CPU: virtual devices via "
                     "xla_force_host_platform_device_count — a "
                     "correctness/soak shape, not a perf claim: all "
-                    "shards share this host's core)")
+                    "shards share this host's core).  The partitioned "
+                    "fast path is --mesh-devices")
+    ap.add_argument("--mesh-devices", type=int, default=0,
+                    help=">1 runs the PARTITIONED mesh fast path "
+                    "(ISSUE 11): the feed buckets each batch by H3 "
+                    "parent cell per device, every device runs the "
+                    "fused fold collective-free with its own emit ring "
+                    "(and its own governor under --govern).  Stamps "
+                    "mesh provenance (device count, mode) plus "
+                    "per-shard steady rate, emit pulls vs batches, and "
+                    "effective post-governor knobs — the "
+                    "MULTICHIP_r*-family artifact of the new path.  On "
+                    "CPU the devices are forced host devices (shape "
+                    "proof, not a speedup claim)")
     ap.add_argument("--shards", type=int, default=None,
                     help="spawns an H3-PARTITIONED runtime shard fleet "
                     "(stream/shardmap.py, ISSUE 7): N OS processes, "
@@ -645,22 +674,27 @@ def main() -> int:
         return shard_fleet_main(args)
 
     mesh = None
-    if args.mesh_shards > 1:
+    n_mesh = max(args.mesh_shards, args.mesh_devices)
+    if args.mesh_shards > 1 and args.mesh_devices > 1:
+        print("e2e_rate: pick ONE of --mesh-shards (shuffle) / "
+              "--mesh-devices (partitioned)", file=sys.stderr)
+        return 2
+    if n_mesh > 1:
         # must precede backend INIT (jax is already imported by the
         # environment's site hook, but the CPU client reads XLA_FLAGS
         # lazily at first use)
         flags = os.environ.get("XLA_FLAGS", "")
         os.environ["XLA_FLAGS"] = (
             f"{flags} --xla_force_host_platform_device_count="
-            f"{args.mesh_shards}").strip()
+            f"{n_mesh}").strip()
 
     from heatmap_tpu.config import load_config
     from heatmap_tpu.stream import MicroBatchRuntime, SyntheticSource
 
-    if args.mesh_shards > 1:
+    if n_mesh > 1:
         from heatmap_tpu.parallel import make_mesh
 
-        mesh = make_mesh(args.mesh_shards)
+        mesh = make_mesh(n_mesh)
 
     mongod = None
     mongod_proc = mongod_stop = mongod_q = None
@@ -702,6 +736,10 @@ def main() -> int:
         over["emit_flush_k"] = args.flush_k
     if args.prefetch is not None:
         over["prefetch_batches"] = args.prefetch
+    if args.mesh_shards > 1:
+        over["mesh_partitioned"] = "0"   # this flag means the shuffle path
+    elif args.mesh_devices > 1:
+        over["mesh_partitioned"] = "1"
     cfg = load_config(
         {"H3_RESOLUTIONS": args.resolutions,
          "WINDOW_MINUTES": args.windows},
@@ -829,6 +867,13 @@ def main() -> int:
     p50 = snap.get("batch_latency_p50_ms", 0.0)
     spans = {k: snap[k] for k in sorted(snap) if k.startswith("span_")
              and k.endswith("_p50_ms")}
+    if rt._parted is not None:
+        topology = (f"H3-partitioned {rt._parted.n_shards}-device mesh "
+                    f"(shard-per-chip fast path: per-device feed "
+                    f"blocks, collective-free fused folds, per-device "
+                    f"emit rings"
+                    + (", per-shard governors" if rt._mesh_governors
+                       else "") + ") -> ") + topology
     out = {
         "topology": topology,
         "n_events": args.events,
@@ -857,7 +902,13 @@ def main() -> int:
         # governed-vs-ungoverned comparisons off the `govern` stamp
         "effective": _effective_knobs(rt),
         "govern": (dict(rt.governor.bounds(), frozen=rt.governor.frozen)
-                   if rt.governor is not None else {"enabled": False}),
+                   if rt.governor is not None
+                   else dict(rt._mesh_governors[0].bounds(),
+                             per_shard=True,
+                             frozen=any(g.frozen
+                                        for g in rt._mesh_governors))
+                   if rt._mesh_governors
+                   else {"enabled": False}),
         "n_batches": rt.epoch,
         "emit_pulls": snap.get("emit_pulls", 0),
         "emit_pull_batches": snap.get("emit_pull_batches", 0),
@@ -872,6 +923,31 @@ def main() -> int:
         # visible in the same JSON line
         "freshness": rt.metrics.freshness_summary(),
     }
+    # mesh provenance (ISSUE 11): device count + partitioned-vs-shuffle
+    # mode, and on the partitioned path the per-shard accounting the
+    # acceptance reads — steady rate, emit pulls vs pulled batches (the
+    # per-shard ring's <= 1/K amortization), effective post-governor
+    # knobs.  check_bench_regress refuses artifact pairs whose mesh
+    # stamps differ.
+    if rt._parted is not None:
+        p50_s = (p50 / 1e3) if p50 else None
+        per_shard = []
+        for m in rt.mesh_shard_stats():
+            m = dict(m)
+            m["wall_events_per_sec"] = round(m["rows"] / wall, 1)
+            m["steady_events_per_sec"] = (
+                round((m["rows"] / max(1, rt.epoch)) / p50_s, 1)
+                if p50_s else None)
+            per_shard.append(m)
+        out["mesh"] = {
+            "devices": rt._parted.n_shards,
+            "mode": "partitioned",
+            "platform": rt._parted.devices[0].platform,
+            "per_shard": per_shard,
+        }
+    elif rt._sharded is not None:
+        out["mesh"] = {"devices": rt._sharded.n_shards,
+                       "mode": "shuffle"}
     # replicated serve fleet provenance (obs.fleet): replica count +
     # max replication seq lag, when a follower fleet is on the channel
     from heatmap_tpu.obs.fleet import repl_stamp

@@ -252,13 +252,33 @@ def _label_of(labels_str: str, key: str) -> str | None:
     return None
 
 
-def _by_proc(m: dict | None, name: str) -> dict:
-    """{proc_tag: value} for one family's ``proc=``-labeled samples."""
+def _by_proc(m: dict | None, name: str, skip_shard: bool = False) -> dict:
+    """{proc_tag: value} for one family's ``proc=``-labeled samples.
+    ``skip_shard`` drops ``shard=``-labeled samples (the partitioned
+    mesh's per-device governor children): a dict keyed by proc alone
+    would otherwise keep one ARBITRARY shard's value per member —
+    masking, e.g., a frozen shard behind an active one."""
     out: dict = {}
     for labels, v in ((m or {}).get(name) or {}).items():
         p = _label_of(labels, "proc")
-        if p is not None:
-            out[p] = v
+        if p is None:
+            continue
+        if skip_shard and _label_of(labels, "shard") is not None:
+            continue
+        out[p] = v
+    return out
+
+
+def _by_proc_shard(m: dict | None, name: str) -> dict:
+    """{(proc_tag, shard): value} for one family's ``proc=`` +
+    ``shard=``-labeled samples (the partitioned-mesh per-device
+    families; proc falls back to "" on a direct single-runtime
+    scrape)."""
+    out: dict = {}
+    for labels, v in ((m or {}).get(name) or {}).items():
+        s = _label_of(labels, "shard")
+        if s is not None:
+            out[(_label_of(labels, "proc") or "", s)] = v
     return out
 
 
@@ -353,16 +373,67 @@ def render_fleet_frame(m: dict, prev: dict | None, dt: float,
         lines.append(f"  imbalance max/mean "
                      f"{fmt(imbalance, 'x', digits=2)}   aggregate "
                      f"{fmt(sum(known) if known else None, ' ev/s', digits=0)}")
+    # partitioned-mesh shards (parallel.sharded.PartitionedAggregator):
+    # one row per (member, device) off the heatmap_mesh_* families —
+    # owned-cell share (this shard's rows over its member's total, the
+    # PR 7 imbalance math per device), ring depth, device->host pulls,
+    # and the shard's governor knobs when per-shard governing is on
+    mesh_rows = _by_proc_shard(m, "heatmap_mesh_rows_total")
+    if mesh_rows:
+        mesh_pulls = _by_proc_shard(m, "heatmap_mesh_pulls_total")
+        mesh_ring = _by_proc_shard(m, "heatmap_mesh_ring_pending")
+        gov_b = _by_proc_shard(m, "heatmap_govern_batch_rows")
+        gov_k = _by_proc_shard(m, "heatmap_govern_flush_k")
+        gov_f = _by_proc_shard(m, "heatmap_govern_frozen")
+        totals: dict = {}
+        for (tag, _s), v in mesh_rows.items():
+            totals[tag] = totals.get(tag, 0.0) + v
+        lines.append("")
+        lines.append(f"  {'mesh shard':<14}{'dev':>4}{'own-cell %':>12}"
+                     f"{'rows':>14}{'ring':>6}{'pulls':>8}"
+                     f"{'gov batch':>11}{'flush-K':>9}")
+        def _shard_key(k):
+            tag, s = k
+            # numeric labels sort as numbers (shard "10" after "9")
+            return ((tag, 0, int(s), "") if s.isdigit()
+                    else (tag, 1, 0, s))
+
+        for (tag, s) in sorted(mesh_rows, key=_shard_key):
+            share = (mesh_rows[(tag, s)] / totals[tag]
+                     if totals.get(tag) else None)
+            lines.append(
+                f"  {tag:<14}{s:>4}"
+                f"{fmt(share, ' %', 100.0):>12}"
+                f"{fmt(mesh_rows[(tag, s)], digits=0):>14}"
+                f"{fmt(mesh_ring.get((tag, s)), digits=0):>6}"
+                f"{fmt(mesh_pulls.get((tag, s)), digits=0):>8}"
+                f"{fmt(gov_b.get((tag, s)), digits=0):>11}"
+                f"{fmt(gov_k.get((tag, s)), digits=0):>9}"
+                + ("  FROZEN" if gov_f.get((tag, s)) else ""))
+        # the PR 7 imbalance readout, per device: a skewed H3 partition
+        # (or a wedged device at 0 rows) is visible at a glance
+        vals = list(mesh_rows.values())
+        if len(vals) >= 2 and sum(vals) > 0:
+            imb = max(vals) / (sum(vals) / len(vals))
+            lines.append(f"  mesh imbalance max/mean "
+                         f"{fmt(imb, 'x', digits=2)}")
     # per-member adaptive governors (stream/govern.py): each shard
     # governs independently, so skewed load shows up as DIFFERENT
     # converged batch sizes — this table makes that visible, plus the
-    # frozen guardrail state per member
-    gov_batch = _by_proc(m, "heatmap_govern_batch_rows")
+    # frozen guardrail state per member.  Mesh members' per-device
+    # governors (shard=-labeled) live in the mesh table above; keyed
+    # by proc alone they would collapse to one arbitrary shard here.
+    gov_batch = _by_proc(m, "heatmap_govern_batch_rows",
+                         skip_shard=True)
     if gov_batch:
-        gov_flush = _by_proc(m, "heatmap_govern_flush_k")
-        gov_pre = _by_proc(m, "heatmap_govern_prefetch")
-        gov_frozen = _by_proc(m, "heatmap_govern_frozen")
-        gov_age = _by_proc(m, "heatmap_govern_last_adjust_age_seconds")
+        gov_flush = _by_proc(m, "heatmap_govern_flush_k",
+                             skip_shard=True)
+        gov_pre = _by_proc(m, "heatmap_govern_prefetch",
+                           skip_shard=True)
+        gov_frozen = _by_proc(m, "heatmap_govern_frozen",
+                              skip_shard=True)
+        gov_age = _by_proc(m, "heatmap_govern_last_adjust_age_seconds",
+                           skip_shard=True)
         lines.append("")
         lines.append(f"  {'governor':<14}{'batch':>9}{'flush-K':>9}"
                      f"{'prefetch':>10}{'adjusted':>10}  state")
