@@ -1063,6 +1063,13 @@ def _e2e_runtime_attach() -> dict:
             # silently carrying default provenance
             "e2e_runtime_knobs": e2e.get("effective"),
             "e2e_runtime_govern": e2e.get("govern"),
+            # integrity provenance (obs.audit): stamped top-level as
+            # ``audit`` too (below) so check_bench_regress can refuse
+            # a round whose conservation ledger reported a leak or a
+            # digest mismatch; absent when HEATMAP_AUDIT was off
+            **({"audit": e2e["audit"],
+                "e2e_runtime_audit": e2e["audit"]}
+               if isinstance(e2e.get("audit"), dict) else {}),
             # freshness rides with throughput in every BENCH_*.json: the
             # event-age p50/p99 (event ts -> sink commit ack through the
             # emit ring) and mean ring residency this run sustained

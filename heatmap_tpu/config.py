@@ -273,6 +273,26 @@ class Config:
                                        # "1" forces partitioned (warns
                                        # and falls back on multi-host),
                                        # "0" forces the shuffle path.
+    audit: bool = False                # HEATMAP_AUDIT: the integrity
+                                       # observatory (obs/audit.py) —
+                                       # observe-only event-conservation
+                                       # ledger at every pipeline
+                                       # boundary plus per-(grid,
+                                       # window) content digests
+                                       # verified across shards, mesh
+                                       # devices, and replicas.  Zero
+                                       # data-path mutation; 0 (the
+                                       # default) disables entirely.
+                                       # Multi-host runs ignore it
+                                       # (lockstep accounting).
+    audit_settle_s: float = 10.0       # HEATMAP_AUDIT_SETTLE_S: how
+                                       # long a non-zero ledger
+                                       # residual must go without
+                                       # draining before /healthz
+                                       # degrades naming the boundary
+                                       # (in-flight pipeline depth is
+                                       # not a leak; a book that stops
+                                       # balancing is)
     shard_oversample: int = 0          # HEATMAP_SHARD_OVERSAMPLE: how
                                        # many feed-batches worth of
                                        # stream rows a shard polls per
@@ -385,6 +405,9 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         shard_res=_int(e, "HEATMAP_SHARD_RES", Config.shard_res),
         shard_oversample=_int(e, "HEATMAP_SHARD_OVERSAMPLE",
                               Config.shard_oversample),
+        audit=e.get("HEATMAP_AUDIT", "0") not in ("0", "false", ""),
+        audit_settle_s=_float(e, "HEATMAP_AUDIT_SETTLE_S",
+                              Config.audit_settle_s),
         mesh_partitioned=e.get("HEATMAP_MESH_PARTITIONED",
                                Config.mesh_partitioned),
     )
@@ -490,4 +513,8 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         raise ValueError(
             f"HEATMAP_SHARD_OVERSAMPLE must be in 0..64, "
             f"got {cfg.shard_oversample}")
+    if cfg.audit_settle_s <= 0:
+        raise ValueError(
+            f"HEATMAP_AUDIT_SETTLE_S must be > 0, "
+            f"got {cfg.audit_settle_s}")
     return cfg

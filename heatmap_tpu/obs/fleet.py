@@ -466,6 +466,141 @@ class FleetAggregator:
             "stale_members": sorted(skipped),
         }
 
+    # -------------------------------------------------------------- audit
+    def audit(self) -> dict:
+        """``/fleet/audit``: member integrity ledgers stitched
+        cross-process (summed counts re-checked against the same
+        conservation identities) + the per-window shard-digest combine
+        against the merged-view digest — see :func:`fleet_audit`."""
+        members, skipped = self.collect()
+        out = fleet_audit(members)
+        out["member_tags"] = sorted(members)
+        out["stale_members"] = sorted(skipped)
+        return out
+
+
+def _hex_digest(v) -> int | None:
+    try:
+        return int(str(v), 16)
+    except (TypeError, ValueError):
+        return None
+
+
+def _member_audit_summary(blk: dict) -> dict:
+    verify = blk.get("verify") or {}
+    res = blk.get("residuals") or {}
+    worst = None
+    numeric = {b: r for b, r in res.items()
+               if isinstance(r, (int, float))}
+    if numeric:
+        b = max(numeric, key=lambda k: abs(numeric[k]))
+        if numeric[b]:
+            worst = {"boundary": b, "residual": numeric[b]}
+    return {
+        "ledger": blk.get("ledger") or {},
+        "residuals": res,
+        "worst_boundary": worst,
+        "verify": verify,
+        "repl": blk.get("repl") or {},
+    }
+
+
+def fleet_audit(members: dict) -> dict:
+    """The cross-process integrity stitch behind ``/fleet/audit``
+    (obs/audit.py is the per-member half): member conservation ledgers
+    are SUMMED and re-checked against the same boundary identities
+    (`residuals_from_counts` — the fleet's books must balance exactly
+    as each member's do), and every member's per-shard window digests
+    are XOR-combined per (grid, windowStart) against the merged-view
+    owner's published view digest (disjoint cell spaces -> the combine
+    must be exact).  A window whose combine mismatches names the
+    member set that contributed — the production form of the 1-vs-N
+    differential test."""
+    from heatmap_tpu.obs.audit import combine_digests, \
+        residuals_from_counts
+
+    per_member: dict = {}
+    totals: dict = {}
+    view_digests: dict = {}   # (grid, ws) -> (hex, owner tag)
+    shard_digests: dict = {}  # (grid, ws) -> [(tag, shard, int)]
+    mismatches = 0
+    has_view = False
+    for tag in sorted(members):
+        blk = members[tag].get("audit")
+        if not isinstance(blk, dict):
+            continue
+        per_member[tag] = _member_audit_summary(blk)
+        for stage, v in (blk.get("ledger") or {}).items():
+            if isinstance(v, (int, float)):
+                totals[stage] = totals.get(stage, 0) + int(v)
+        verify = blk.get("verify") or {}
+        if isinstance(verify.get("mismatches"), (int, float)):
+            mismatches += int(verify["mismatches"])
+        digests = blk.get("digests") or {}
+        view = digests.get("view")
+        if isinstance(view, dict) and view:
+            has_view = True
+            for grid, per_ws in view.items():
+                for ws, d in (per_ws or {}).items():
+                    view_digests[(grid, ws)] = (
+                        (d or {}).get("digest"), tag)
+        for label, table in (digests.get("shard") or {}).items():
+            for grid, per_ws in (table or {}).items():
+                for ws, d in (per_ws or {}).items():
+                    h = _hex_digest((d or {}).get("digest"))
+                    if h is not None:
+                        shard_digests.setdefault(
+                            (grid, ws), []).append((tag, label, h))
+    # per-window combine verdicts, for every window the merged view
+    # holds: XOR over every contributing shard must equal the view —
+    # a shard whose merge was skipped (or double-applied) breaks it
+    combine: list = []
+    combine_mismatches = 0
+    if not shard_digests:
+        view_digests = {}  # no emitting shards on the channel: nothing
+        #                    to combine (serve-only fleets)
+    for (grid, ws), (view_hex, owner) in sorted(view_digests.items()):
+        want = _hex_digest(view_hex)
+        contrib = shard_digests.get((grid, ws), [])
+        if not contrib:
+            # no shard emitted into this window THIS boot (a restart's
+            # store-seeded window, or a pre-boot window) — unverifiable,
+            # NOT a mismatch: flagging it would false-alarm on every
+            # restart against a durable store.  A SKIPPED shard merge
+            # is still caught: its surviving peers' contributions exist
+            # and the XOR below comes up short.
+            combine.append({
+                "grid": grid, "ws": int(ws), "view": view_hex,
+                "ok": None, "skipped": "no emitting shard this boot",
+                "view_owner": owner, "shards": []})
+            continue
+        got = combine_digests(h for _t, _l, h in contrib)
+        ok = want is not None and got == want
+        if not ok:
+            combine_mismatches += 1
+        combine.append({
+            "grid": grid, "ws": int(ws), "view": view_hex,
+            "combined": format(got, "016x"), "ok": ok,
+            "view_owner": owner,
+            "shards": sorted(f"{t}/{lbl}" for t, lbl, _h in contrib),
+        })
+    residuals = residuals_from_counts(totals, has_view=has_view)
+    worst = None
+    if residuals:
+        b = max(residuals, key=lambda k: abs(residuals[k]))
+        if residuals[b]:
+            worst = {"boundary": b, "residual": residuals[b]}
+    return {
+        "members": per_member,
+        "ledger": totals,
+        "residuals": residuals,
+        "worst_boundary": worst,
+        "digest_mismatches": mismatches,
+        "combine": combine,
+        "combine_mismatches": combine_mismatches,
+        "ok": (mismatches == 0 and combine_mismatches == 0),
+    }
+
 
 def fleet_stamp(rate: float | None = None,
                 role: str = "runtime") -> dict:

@@ -209,12 +209,19 @@ def publish_member_snapshot(channel_path: str, tag: str, *, role: str,
                             freshness: dict | None = None,
                             healthz: dict | None = None,
                             lineage: list | None = None,
+                            audit: dict | None = None,
                             left: bool = False) -> None:
     """Atomic write of one member's full observability snapshot:
     Prometheus exposition text of its registry, its freshness summary,
     its /healthz verdict, and a compact lineage tail (lid-keyed stage
     contributions the fleet freshness stitch merges).  Unwritable
     degrades to a warning — telemetry never takes a member down.
+
+    ``audit`` carries the member's integrity-observatory block
+    (obs.audit.AuditState.member_block: ledger counts, residuals,
+    per-shard digests) — /fleet/audit stitches these cross-process
+    exactly as /fleet/freshness stitches lineage; absent when
+    HEATMAP_AUDIT is off, keeping snapshots byte-compatible.
 
     ``left=True`` marks the snapshot a DEPARTURE tombstone: the member
     closed cleanly and is leaving the fleet on purpose.  Readers
@@ -233,6 +240,8 @@ def publish_member_snapshot(channel_path: str, tag: str, *, role: str,
         "lineage": lineage or [],
         "updated_unix": round(time.time(), 3),
     }
+    if audit:
+        payload["audit"] = audit
     if left:
         payload["left"] = True
     try:

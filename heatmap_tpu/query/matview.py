@@ -88,12 +88,21 @@ class _Grid:
 
 class TileMatView:
     def __init__(self, delta_log: int = 4096, pyramid_levels: int = 2,
-                 registry=None, now_fn=None, replica: bool = False):
+                 registry=None, now_fn=None, replica: bool = False,
+                 audit=None):
         self._delta_log = max(1, int(delta_log))
         self._pyramid_levels = max(0, int(pyramid_levels))
         self._now = now_fn or time.time
         self._grids: dict[str, _Grid] = {}
         self._seq = 0
+        # Integrity observatory (obs.audit, HEATMAP_AUDIT=1): an
+        # order-independent per-(grid, windowStart) content digest
+        # maintained incrementally alongside every mutation below.
+        # Observe-only: nothing reads it on the apply path.  The writer
+        # view publishes the post-apply digest of every touched window
+        # inside its repl records (``"dg"``) so replicas can verify
+        # their own applied state per seq advance.
+        self.audit_table = audit
         # Replica mode (query.repl): the view is a seq-exact FOLLOWER of
         # a writer's replication feed.  Local clock-driven eviction of
         # the LATEST window is disabled — the seq advance it implies
@@ -155,6 +164,26 @@ class TileMatView:
                           "replication publisher")
             self._hook = None
 
+    def _dg_of(self, docs) -> dict | None:
+        """{grid: {str(ws): hex-digest}} for every (grid, windowStart)
+        the docs touched, read from the audit table AFTER the applies
+        (callers hold the lock) — the writer's published truth a
+        replica verifies its own recomputation against.  None when
+        auditing is off, so feed bytes are identical to an unaudited
+        run."""
+        if self.audit_table is None:
+            return None
+        out: dict = {}
+        for d in docs:
+            grid = d.get("grid")
+            ws_dt = d.get("windowStart")
+            if not grid or not isinstance(ws_dt, dt.datetime):
+                continue
+            ws = int(ws_dt.timestamp())
+            out.setdefault(grid, {})[str(ws)] = format(
+                self.audit_table.digest(grid, ws) or 0, "016x")
+        return out or None
+
     # ---- write side ----------------------------------------------------
     def apply_packed(self, body, meta) -> int:
         """Apply packed emit BODY rows (engine layout) — the writer-thread
@@ -184,8 +213,12 @@ class TileMatView:
             if changed:
                 self._seq = seq
                 self._cond.notify_all()
-                self._emit({"kind": "apply", "seq": seq,
-                            "docs": changed_docs})
+                rec = {"kind": "apply", "seq": seq,
+                       "docs": changed_docs}
+                dg = self._dg_of(changed_docs)
+                if dg:
+                    rec["dg"] = dg
+                self._emit(rec)
             # evict on the WRITE path too: a grid nobody polls over
             # HTTP (replica behind an LB, secondary grid of a pyramid)
             # would otherwise retain every expired window's cell docs
@@ -229,6 +262,8 @@ class TileMatView:
         if old == doc:
             return 0
         w[cid] = doc
+        if self.audit_table is not None:
+            self.audit_table.update(doc.get("grid"), ws, cid, old, doc)
         if len(g.log) == g.log.maxlen and g.log:
             g.dropped_seq = g.log[0][0]
         g.log.append((seq, ws, cid))
@@ -286,8 +321,12 @@ class TileMatView:
                         if changed:
                             self._seq = seq
                             self._cond.notify_all()
-                            self._emit({"kind": "apply", "seq": seq,
-                                        "docs": applied})
+                            rec = {"kind": "apply", "seq": seq,
+                                   "docs": applied}
+                            dg = self._dg_of(applied)
+                            if dg:
+                                rec["dg"] = dg
+                            self._emit(rec)
         if self._h_apply is not None:
             self._h_apply.observe(time.perf_counter() - t0)
         return changed
@@ -302,25 +341,32 @@ class TileMatView:
         delta clients through mode=full — the one resync sequence every
         replace_grid branch shares (callers hold the lock)."""
         seq = self._advance()
-        self._drop_all_windows(g)
+        self._drop_all_windows(grid, g)
         if ws is not None:
-            self._install_window(g, ws, docs)
+            self._install_window(grid, g, ws, docs)
         g.window_seq = g.mod_seq = seq
         g.log.clear()
         g.dropped_seq = seq
         self._cond.notify_all()
-        self._emit({"kind": "resync", "seq": seq, "grid": grid,
-                    "ws": ws, "docs": list(docs)})
+        rec = {"kind": "resync", "seq": seq, "grid": grid,
+               "ws": ws, "docs": list(docs)}
+        dg = self._dg_of(docs)
+        if dg:
+            rec["dg"] = dg
+        self._emit(rec)
         return max(1, len(docs))
 
-    def _drop_all_windows(self, g: _Grid) -> None:
+    def _drop_all_windows(self, grid: str, g: _Grid) -> None:
         for ws in list(g.windows):
             del g.windows[ws]
             del g.meta[ws]
             if g.pyramid is not None:
                 g.pyramid.drop_window(ws)
+            if self.audit_table is not None:
+                self.audit_table.drop_window(grid, ws)
 
-    def _install_window(self, g: _Grid, ws: int, docs) -> None:
+    def _install_window(self, grid: str, g: _Grid, ws: int,
+                        docs) -> None:
         d0 = docs[0]
         stale = d0.get("staleAt")
         g.meta[ws] = (d0["windowStart"], d0.get("windowEnd"),
@@ -328,6 +374,8 @@ class TileMatView:
         w = g.windows[ws] = {}
         for d in docs:
             w[d["cellId"]] = d
+            if self.audit_table is not None:
+                self.audit_table.update(grid, ws, d["cellId"], None, d)
             if g.pyramid is not None:
                 try:
                     g.pyramid.apply(ws, int(d["cellId"], 16), None, d)
@@ -354,7 +402,11 @@ class TileMatView:
             if applied:
                 self._seq = seq
                 self._cond.notify_all()
-                self._emit({"kind": "apply", "seq": seq, "docs": applied})
+                rec = {"kind": "apply", "seq": seq, "docs": applied}
+                dg = self._dg_of(applied)
+                if dg:
+                    rec["dg"] = dg
+                self._emit(rec)
             return len(applied)
 
     def poison(self) -> None:
@@ -383,7 +435,8 @@ class TileMatView:
                 for doc in rec.get("docs") or []:
                     changed += self._apply_one(doc, seq)
             elif kind == "evict":
-                g = self._grids.get(rec.get("grid") or "")
+                grid = rec.get("grid") or ""
+                g = self._grids.get(grid)
                 if g is not None:
                     for ws in rec.get("ws") or []:
                         if ws in g.windows:
@@ -391,16 +444,18 @@ class TileMatView:
                             del g.meta[ws]
                             if g.pyramid is not None:
                                 g.pyramid.drop_window(ws)
+                            if self.audit_table is not None:
+                                self.audit_table.drop_window(grid, ws)
                     g.window_seq = g.mod_seq = seq
                     changed = 1
             elif kind == "resync":
                 grid = rec.get("grid") or ""
                 g = self._grid(grid)
-                self._drop_all_windows(g)
+                self._drop_all_windows(grid, g)
                 ws = rec.get("ws")
                 docs = rec.get("docs") or []
                 if ws is not None and docs:
-                    self._install_window(g, int(ws), docs)
+                    self._install_window(grid, g, int(ws), docs)
                 g.window_seq = g.mod_seq = seq
                 g.log.clear()
                 g.dropped_seq = seq
@@ -423,6 +478,8 @@ class TileMatView:
         representations."""
         with self._cond:
             self._grids.clear()
+            if self.audit_table is not None:
+                self.audit_table.clear()
             seq = int(state.get("seq", 0))
             for grid, gs in (state.get("grids") or {}).items():
                 g = self._grid(grid)
@@ -442,6 +499,9 @@ class TileMatView:
                             else None)
                     for cid, doc in cells.items():
                         w[cid] = doc
+                        if self.audit_table is not None:
+                            self.audit_table.update(grid, ws, cid,
+                                                    None, doc)
                         if g.pyramid is not None:
                             try:
                                 g.pyramid.apply(ws, int(cid, 16),
@@ -494,6 +554,8 @@ class TileMatView:
             del g.meta[ws]
             if g.pyramid is not None:
                 g.pyramid.drop_window(ws)
+            if self.audit_table is not None:
+                self.audit_table.drop_window(grid, ws)
         if dead and g.latest_ws() != latest_before:
             seq = self._advance()
             g.window_seq = g.mod_seq = seq
@@ -505,6 +567,23 @@ class TileMatView:
     def known_grid(self, grid: str) -> bool:
         with self._lock:
             return grid in self._grids
+
+    def latest_ws_of(self, grid: str) -> int | None:
+        """Epoch-seconds windowStart of the grid's latest window (the
+        serving-visible one digest verification covers); None when the
+        grid is unknown or empty."""
+        with self._lock:
+            g = self._grids.get(grid)
+            return g.latest_ws() if g is not None else None
+
+    def audit_digest(self, grid: str, ws: int) -> int | None:
+        """This view's own content digest for (grid, windowStart) —
+        what a replica compares against the writer's published value
+        (obs.audit.AuditState.verify_record).  None when auditing is
+        off or the window is absent."""
+        if self.audit_table is None:
+            return None
+        return self.audit_table.digest(grid, int(ws))
 
     def etag(self, grid: str, res: int | None = None) -> str:
         """Strong ETag for the grid's current latest-window view (and

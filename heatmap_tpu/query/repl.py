@@ -464,11 +464,17 @@ class ReplicaViewFollower:
     never report ok-but-empty (r9 satellite)."""
 
     def __init__(self, view, source, poll_s: float = 0.2,
-                 registry=None, clock=time.time):
+                 registry=None, clock=time.time, audit=None):
         self.view = view
         self.source = source
         self.poll_s = max(0.01, float(poll_s))
         self.clock = clock
+        # integrity observatory (obs.audit, HEATMAP_AUDIT=1): per
+        # applied record, recompute this replica's own (grid, window)
+        # digest and verify it against the writer's published ``dg`` —
+        # a corrupted segment record or diverged replica is detected
+        # within ONE seq advance, not at the next full resync.
+        self.audit = audit
         self.epoch: str | None = None
         self.applied = 0
         self.synced = False
@@ -650,6 +656,9 @@ class ReplicaViewFollower:
                               "re-bootstrapping from snapshot")
             self.view.replica_apply(rec)
             self.applied = max(self.applied, int(rec.get("seq", 0)))
+            if self.audit is not None:
+                self.audit.add("repl_applied")
+                self.audit.verify_record(self.view, rec)
             t = rec.get("t")
             if isinstance(t, (int, float)):
                 self._last_rec_t = t

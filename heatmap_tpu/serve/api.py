@@ -75,6 +75,17 @@ supervisor channel path — 503 without one):
 - GET /fleet/freshness → the cross-process event-age decomposition:
   member lineage contributions stitched by lineage id (``?n=`` bounds
   the record count), with per-stage p50s and the conservation residual.
+
+Integrity observatory (obs.audit, gated by ``HEATMAP_AUDIT=1``):
+- GET /debug/audit  → this process's conservation ledger (per-stage
+  counts, per-boundary residuals, the worst/leaking boundary) and
+  content-digest state (digests verified / mismatched, last verified
+  seq, last mismatch's grid/window/seq); 503 with auditing off.
+- GET /fleet/audit  → the cross-process stitch: member ledgers summed
+  and re-checked against the same conservation identities, and every
+  (grid, windowStart)'s per-shard digests XOR-combined against the
+  merged-view digest (disjoint cell spaces — the production form of
+  the 1-vs-N differential test); needs the supervisor channel.
 """
 
 from __future__ import annotations
@@ -402,6 +413,18 @@ def healthz_payload(runtime, extra_checks=None) -> tuple[dict, bool]:
                     else f"active ({len(mesh_govs)} mesh shards)"),
                 "ok": ok}
             degraded |= not ok
+        audit = getattr(runtime, "audit", None)
+        if audit is not None:
+            # integrity observatory (obs.audit, HEATMAP_AUDIT=1): a
+            # conservation-ledger residual that stopped draining
+            # degrades NAMING the leaking boundary; any digest
+            # mismatch degrades naming the (grid, window, seq)
+            try:
+                ac, a_deg = audit.healthz_checks()
+                checks.update(ac)
+                degraded |= a_deg
+            except Exception:  # noqa: BLE001 - observe-only, never 500
+                log.exception("audit healthz checks failed")
         if runtime.writer.poisoned:
             checks["sink"] = {"value": "poisoned", "ok": False}
             down = True
@@ -671,9 +694,32 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
     follower = None
     repl_dir = getattr(cfg, "repl_dir", "") if cfg else ""
     repl_feed = getattr(cfg, "repl_feed", "") if cfg else ""
+    # Integrity observatory (obs.audit, HEATMAP_AUDIT=1): with a
+    # runtime attached its AuditState is reused (same registry); a
+    # serve-only worker builds its own — the replica half that
+    # verifies every applied record's published window digest against
+    # its own recomputed state and serves /debug/audit.
+    from heatmap_tpu.obs.audit import audit_enabled as _audit_env
+
+    audit_on = (bool(getattr(cfg, "audit", False)) if cfg is not None
+                else _audit_env())
+    serve_audit = (getattr(runtime, "audit", None)
+                   if runtime is not None else None)
+    if runtime is None and audit_on:
+        from heatmap_tpu.obs.audit import AuditState
+
+        serve_audit = AuditState(
+            serve_reg, tag=f"serve{os.getpid()}",
+            settle_s=getattr(cfg, "audit_settle_s", None) if cfg
+            else None)
     if view is None and (cfg is None or getattr(cfg, "query_view", True)):
         from heatmap_tpu.query import StoreViewRefresher, TileMatView
 
+        view_audit = None
+        if audit_on and runtime is None:
+            from heatmap_tpu.obs.audit import DigestTable
+
+            view_audit = DigestTable()
         # registry unconditionally: a runtime WITHOUT a writer-fed view
         # (multi-host) still lands here, and its operators need the
         # documented view series; registration is idempotent, and when
@@ -683,7 +729,8 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
             pyramid_levels=(getattr(cfg, "pyramid_levels", 2)
                             if cfg else 2),
             registry=serve_reg,
-            replica=bool(repl_feed))
+            replica=bool(repl_feed),
+            audit=view_audit)
         refresher = StoreViewRefresher(
             store, view,
             poll_s=(getattr(cfg, "view_poll_ms", 1000)
@@ -703,8 +750,11 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 view, feed_source(repl_feed),
                 poll_s=(getattr(cfg, "repl_poll_ms", 200)
                         if cfg else 200) / 1e3,
-                registry=serve_reg)
+                registry=serve_reg,
+                audit=serve_audit)
             follower.start()
+    if serve_audit is not None and runtime is None:
+        serve_audit.attach(view=view, follower=follower)
         # NOTE: a serve-only app never PUBLISHES to repl_dir implicitly
         # — only the writer process's runtime creates the publisher.
         # HEATMAP_REPL_DIR on a serve process only re-exposes the feed
@@ -829,6 +879,12 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
             h = refresher.health()
             checks["view_catchup"] = h
             degraded |= not h["ok"]
+        if serve_audit is not None and runtime is None:
+            # serve-only audit verdicts (a runtime-attached process
+            # already merges its AuditState inside healthz_payload)
+            ac, a_degraded = serve_audit.healthz_checks()
+            checks.update(ac)
+            degraded |= a_degraded
         return checks, degraded
 
     healthz = functools.partial(healthz_payload, runtime,
@@ -1195,6 +1251,29 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 n = _qs_int(params, "n", 32, 256)
                 body = json.dumps(agg.freshness(n))
                 ctype = "application/json"
+            elif path == "/fleet/audit":
+                # cross-process integrity stitch (obs.fleet.fleet_audit):
+                # member conservation ledgers summed + re-checked, and
+                # every (grid, window)'s per-shard digests XOR-combined
+                # against the merged-view digest — the production form
+                # of the 1-vs-N differential test
+                agg = _fleet_agg()
+                if agg is None:
+                    return _unavailable(
+                        "fleet surfaces need a supervisor channel "
+                        "(HEATMAP_SUPERVISOR_CHANNEL)")
+                body = json.dumps(agg.audit())
+                ctype = "application/json"
+            elif path == "/debug/audit":
+                # this process's integrity observatory: per-stage
+                # ledger counts, boundary residuals (worst/leaking
+                # named), digest verification state (obs.audit)
+                if serve_audit is None:
+                    return _unavailable(
+                        "the integrity observatory needs "
+                        "HEATMAP_AUDIT=1")
+                body = json.dumps(serve_audit.snapshot())
+                ctype = "application/json"
             elif path == "/metrics.json":
                 body = json.dumps(_metrics_json(runtime))
                 ctype = "application/json"
@@ -1386,6 +1465,11 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
     # lagging replica without scraping it
     app.healthz_fn = healthz
     app.repl_follower = follower
+    # the member snapshot's audit block (ledger/digest state) rides the
+    # same publish cadence so /fleet/audit can stitch it
+    app.audit_fn = (serve_audit.member_block
+                    if serve_audit is not None else None)
+    app.serve_audit = serve_audit
 
     def close_repl():
         if follower is not None:
@@ -1443,7 +1527,8 @@ class ServeFleetMember:
     start this only when ``runtime is None``."""
 
     def __init__(self, serve_registry, channel_path: str,
-                 tag: str | None = None, healthz_fn=None):
+                 tag: str | None = None, healthz_fn=None,
+                 audit_fn=None):
         from heatmap_tpu.obs.xproc import ENV_FLEET_TAG
 
         self.registry = serve_registry
@@ -1451,6 +1536,9 @@ class ServeFleetMember:
         # the app's healthz closure carries the serve-tier checks
         # (replication sync/lag) the bare payload can't see
         self.healthz_fn = healthz_fn or (lambda: healthz_payload(None))
+        # the app's audit closure (obs.audit member block) when
+        # HEATMAP_AUDIT=1 — /fleet/audit stitches it
+        self.audit_fn = audit_fn
         # HEATMAP_FLEET_TAG names the RUNTIME member (stream/runtime.py
         # adopts it verbatim when single-process), so a serve worker
         # composes with it rather than adopting it — otherwise a serve
@@ -1475,7 +1563,8 @@ class ServeFleetMember:
         if not chan_path or reg is None or fleet_publish_s() <= 0:
             return None
         member = cls(reg, chan_path,
-                     healthz_fn=getattr(app, "healthz_fn", None))
+                     healthz_fn=getattr(app, "healthz_fn", None),
+                     audit_fn=getattr(app, "audit_fn", None))
         member.start()
         return member
 
@@ -1500,7 +1589,9 @@ class ServeFleetMember:
             publish_member_snapshot(
                 self.channel_path, self.tag, role="serve",
                 metrics_text=self.registry.expose_text(),
-                healthz=payload, left=left)
+                healthz=payload,
+                audit=self.audit_fn() if self.audit_fn else None,
+                left=left)
         except Exception:  # noqa: BLE001 - telemetry never kills serving
             log.warning("serve fleet snapshot publish failed",
                         exc_info=True)

@@ -28,8 +28,15 @@ log = logging.getLogger(__name__)
 class AsyncWriter:
     def __init__(self, store: Store, max_queue: int = 64,
                  retries: int = 3, backoff_s: float = 0.2, metrics=None,
-                 view=None):
+                 view=None, audit=None):
         self.store = store
+        # integrity-observatory ledger (obs.audit, HEATMAP_AUDIT=1):
+        # this thread stamps the sink-commit and view-apply boundaries
+        # (docs_committed / docs_view_applied) so the conservation
+        # identity closes on the writer side.  Observe-only: counting
+        # arithmetic, never on the write path's failure surface.  The
+        # runtime may also assign the attribute post-construction.
+        self.audit = audit
         # materialized tile view (query.matview): fed on THIS thread
         # right after each tile write returns from the store — i.e.
         # strictly after the rows are durable, so the query tier never
@@ -128,6 +135,8 @@ class AsyncWriter:
                     n = self._apply(kind, docs)
                     if kind.startswith("tiles"):
                         self._written_tiles += n
+                        if self.audit is not None:
+                            self.audit.add("docs_committed", n)
                         if n and self.view is not None \
                                 and not self.view.poisoned:
                             self._feed_view(kind, docs)
@@ -145,11 +154,19 @@ class AsyncWriter:
     def _feed_view(self, kind: str, docs) -> None:
         try:
             if kind == "tiles_packed":
+                # decode once here (apply_packed would decode
+                # internally) so the audit ledger can count the docs
+                # PRESENTED to the view — the same predicate the store
+                # write counted, which is what makes the sink→view
+                # boundary residual meaningful
+                from heatmap_tpu.sink.base import packed_tile_docs
+
                 body, meta = docs
-                self.view.apply_packed(body, meta)
-            else:
-                self.view.apply_docs(docs)
+                docs = packed_tile_docs(body, meta)
+            self.view.apply_docs(docs)
             self.last_view_seq = getattr(self.view, "seq", None)
+            if self.audit is not None:
+                self.audit.add("docs_view_applied", len(docs))
         except Exception:
             log.exception("materialized view apply failed; query tier "
                           "falls back to store renders")

@@ -34,6 +34,31 @@ GAUGE_NAMES = frozenset({
     "uptime_s", "events_per_sec",
 })
 
+# The CLOSED set of event-drop reasons (integrity observatory,
+# obs.audit): every path that discards an event must account it under
+# exactly one of these labels — an untagged drop is a permanent
+# conservation-ledger residual (polled == folded + dropped{reason}).
+#   invalid       parse/validation rejects (stream.events)
+#   late          watermark-late (incl. the clock-skew future-window
+#                 poison drop, which the device fold folds into late)
+#   out_of_shard  rows owned by another H3 shard (stream/shardmap.py)
+#   oversample    the same ownership drop in HEATMAP_SHARD_OVERSAMPLE
+#                 mode, where foreign rows are the EXPECTED majority of
+#                 every poll — labeled apart so partition-skew drops
+#                 don't read as misrouted-topic trouble
+#   exchange      all_to_all lane-skew overflow (parallel.sharded)
+# ``Metrics.drop`` validates against this set (tests pin it closed) and
+# keeps the legacy flat counters in lockstep.
+DROP_REASONS = ("invalid", "late", "out_of_shard", "oversample",
+                "exchange")
+_DROP_LEGACY = {
+    "invalid": "events_invalid",
+    "late": "events_late",
+    "out_of_shard": "events_out_of_shard",
+    "oversample": "events_out_of_shard",
+    "exchange": "events_bucket_dropped",
+}
+
 
 class Metrics:
     def __init__(self):
@@ -75,11 +100,47 @@ class Metrics:
             "ring appends from a batch's own (inclusive) to the flush "
             "that pulled it — how many batches deep it was held",
             buckets=(1, 2, 4, 8, 16, 32, 64))
+        # reason-labeled drop accounting (integrity observatory): one
+        # family every drop path increments via ``drop`` — children
+        # materialized up front so the exposition carries the full
+        # closed reason set from step one
+        self.dropped = self.registry.counter(
+            "heatmap_events_dropped_total",
+            "events discarded per closed drop reason (invalid, late, "
+            "out_of_shard, oversample, exchange) — the conservation "
+            "ledger's dropped{reason} term; an untagged drop path is a "
+            "permanent audit residual",
+            labels=("reason",))
+        for r in DROP_REASONS:
+            self.dropped.labels(reason=r)
+        # integrity-observatory ledger (obs.audit.AuditState), attached
+        # by the runtime when HEATMAP_AUDIT=1; ``drop`` forwards every
+        # tagged drop into it so the conservation identity closes
+        self.audit = None
         # name -> histogram child, in observation order (snapshot() keys)
         self.spans: dict[str, object] = {}
 
     def count(self, name: str, n: int = 1) -> None:
         self.counters[name] += n
+
+    def drop(self, reason: str, n: int = 1, audit: bool = True) -> None:
+        """Account ``n`` discarded events under a CLOSED drop reason:
+        bumps the reason-labeled family, the legacy flat counter, and
+        (when attached, for the primary accounting stream only —
+        ``audit=False`` keeps secondary-pair drops out of the event
+        conservation identity) the audit ledger.  An unknown reason
+        raises — the set stays closed by construction."""
+        legacy = _DROP_LEGACY.get(reason)
+        if legacy is None:
+            raise ValueError(
+                f"unknown drop reason {reason!r}; the closed set is "
+                f"{DROP_REASONS}")
+        if n <= 0:
+            return
+        self.counters[legacy] += n
+        self.dropped.labels(reason=reason).inc(n)
+        if audit and self.audit is not None:
+            self.audit.add(f"dropped_{reason}", n)
 
     def gauge(self, name: str, help_: str = "", fn=None, labels=()):
         """Registry gauge pass-through for the layers this Metrics is

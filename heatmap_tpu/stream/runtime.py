@@ -159,6 +159,16 @@ class MicroBatchRuntime:
         # would expose a partial city; serve processes rebuild the
         # merged city from the shared store instead, or a caller passes
         # ``view=`` to fan several shards into one shared view.
+        # Integrity observatory (obs/audit.py, HEATMAP_AUDIT=1):
+        # observe-only event-conservation ledger + per-window content
+        # digests.  Multi-host runs are not audited — their accounting
+        # is replicated across hosts and a host-local ledger could
+        # never telescope.  (AuditState itself is constructed below,
+        # after the fleet tag it is named by.)
+        self._audit_on = bool(cfg.audit) and jax.process_count() == 1
+        if cfg.audit and not self._audit_on:
+            log.warning("HEATMAP_AUDIT=1 ignored: multi-host runs are "
+                        "not audited (replicated lockstep accounting)")
         self.matview = None
         if view is not None:
             # externally shared view (sharded fan-in): every shard's
@@ -170,6 +180,11 @@ class MicroBatchRuntime:
                 and self.shardmap is None):
             from heatmap_tpu.query import TileMatView
 
+            view_audit = None
+            if self._audit_on:
+                from heatmap_tpu.obs.audit import DigestTable
+
+                view_audit = DigestTable()
             # (no store scan here: runtime construction stays read-only
             # — the serve layer seeds unmaterialized grids lazily from
             # the store on first access, so a restart against a durable
@@ -177,7 +192,8 @@ class MicroBatchRuntime:
             self.matview = TileMatView(
                 delta_log=cfg.delta_log,
                 pyramid_levels=cfg.pyramid_levels,
-                registry=self.metrics.registry)
+                registry=self.metrics.registry,
+                audit=view_audit)
         # Delta-log view replication (query.repl): with HEATMAP_REPL_DIR
         # set, every view mutation the writer thread applies is
         # published to the feed, so serve-only replicas
@@ -226,6 +242,22 @@ class MicroBatchRuntime:
         default_tag = (f"shard{cfg.shard_index}" if self.shardmap is not None
                        else f"p{idx}")
         self._fresh_tag = tag or default_tag
+        # integrity observatory state, named by the fleet tag so the
+        # /fleet/audit stitch can attribute per-member ledgers; the
+        # ledger rides this registry (heatmap_audit_* families), the
+        # writer thread stamps the sink/view boundaries, and every
+        # tagged drop (Metrics.drop) forwards into it
+        self.audit = None
+        if self._audit_on:
+            from heatmap_tpu.obs.audit import AuditState
+
+            self.audit = AuditState(self.metrics.registry,
+                                    tag=self._fresh_tag,
+                                    settle_s=cfg.audit_settle_s)
+            self.audit.attach(view=self.matview,
+                              repl_pub=self.repl_pub)
+            self.writer.audit = self.audit
+            self.metrics.audit = self.audit
         # lineage ids are origin-tagged so the fleet aggregator
         # (obs.fleet) can stitch this shard's stage contributions with
         # other members' (e.g. a serve worker's view_apply) by lid
@@ -254,6 +286,12 @@ class MicroBatchRuntime:
                 "prefetched": len(self._prefetched),
                 "writer_poisoned": self.writer.poisoned,
             })
+            # integrity-observatory enrichment: the conservation
+            # ledger's residuals and digest state ride every dump
+            # (reads self.audit dynamically — it is assigned above
+            # only when HEATMAP_AUDIT=1)
+            fr.add_source("audit", lambda: (self.audit.snapshot()
+                                            if self.audit else None))
             # runtime-introspection enrichment (obs.runtimeinfo /
             # obs.prof): compile counts + memory watermarks + the
             # stack-sample tail ride every dump — crash AND the SLO
@@ -265,6 +303,10 @@ class MicroBatchRuntime:
 
             fr.add_source("stacks", lambda: get_sampler().tail(20))
             self.flightrec = fr
+            if self.audit is not None:
+                # digest-mismatch dumps correlate under the fleet
+                # episode via this recorder (obs.audit._dump_mismatch)
+                self.audit.flightrec = fr
         # pipeline-state gauges: watermark/event-time lag, state slab
         # occupancy vs capacity (the overflow early-warning), and the
         # per-shard device dispatch clock (engine.multi accumulates it;
@@ -1216,7 +1258,12 @@ class MicroBatchRuntime:
                 return None
             cols = parse_events(polled, self._intern_p, self._intern_v)
         if cols.n_dropped:
-            self.metrics.count("events_invalid", cols.n_dropped)
+            self.metrics.drop("invalid", cols.n_dropped)
+        if self.audit is not None and (len(cols) or cols.n_dropped):
+            # conservation ledger: rows polled = rows kept + parse
+            # drops (the ledger's feed-side term; carry drains re-use
+            # rows already counted at their original poll)
+            self.audit.add("polled", len(cols) + cols.n_dropped)
         return cols if len(cols) else None
 
     def _pad(self, arr: np.ndarray, fill=0):
@@ -1301,6 +1348,18 @@ class MicroBatchRuntime:
             (body[:, 8] != 0) & (body[:, 3].view(np.int32) > 0)))
         if n_docs:
             self.writer.submit_tiles_packed(body, self._pack_meta[(res, wmin)])
+            if self.audit is not None:
+                # integrity observatory: the emit-side ledger stamp and
+                # THIS shard's digest table (obs.audit) — decoded with
+                # the same oracle the store/view use, so the table is
+                # exactly the docs downstream will hold for this
+                # shard's (disjoint) cell space.  Audit-on cost only;
+                # observe-only either way.
+                from heatmap_tpu.sink.base import packed_tile_docs
+
+                self.audit.add("docs_emitted", n_docs)
+                self.audit.shard_table(shard).apply_docs(
+                    packed_tile_docs(body, self._pack_meta[(res, wmin)]))
         self.metrics.count("tiles_emitted", n_docs)
         return self._account_stats(res, wmin, stats, epoch, shard=shard)
 
@@ -1491,6 +1550,8 @@ class MicroBatchRuntime:
                 freshness=self.metrics.freshness_summary(),
                 healthz=healthz_payload(self)[0],
                 lineage=compact_lineage(self.lineage.tail(16)),
+                audit=(self.audit.member_block()
+                       if self.audit is not None else None),
                 left=left)
         except Exception:  # noqa: BLE001 - never kill the step loop
             log.warning("fleet member snapshot publish failed",
@@ -1645,7 +1706,11 @@ class MicroBatchRuntime:
                     f"to keep running with the loss surfaced at /metrics")
         dropped = int(getattr(stats, "bucket_dropped", 0))
         if dropped:
-            self.metrics.count("events_bucket_dropped", dropped)
+            # ledger forwarding only for the primary pair: the event
+            # conservation identity counts each event once, and
+            # secondary pairs' exchange drops are a per-pair detail
+            self.metrics.drop("exchange", dropped,
+                              audit=(res, wmin) == self._primary)
             log.error(
                 "EXCHANGE OVERFLOW: %d events dropped by all_to_all lane "
                 "skew for (res=%d, window=%dm); raise bucket_factor",
@@ -1653,7 +1718,12 @@ class MicroBatchRuntime:
             )
         if (res, wmin) == self._primary:
             self.metrics.count("events_valid", int(stats.n_valid))
-            self.metrics.count("events_late", int(stats.n_late))
+            # watermark-late (incl. the future-window poison drop the
+            # device folds into the same mask) — a tagged drop, so the
+            # conservation identity closes: polled == folded + dropped
+            self.metrics.drop("late", int(stats.n_late))
+            if self.audit is not None:
+                self.audit.add("folded", int(stats.n_valid))
         else:
             self.metrics.count(f"events_late_r{res}m{wmin}",
                                int(stats.n_late))
@@ -1913,7 +1983,14 @@ class MicroBatchRuntime:
                 cols, n_foreign, shard_cells = \
                     self.shardmap.filter_columns(cols)
                 if n_foreign:
-                    self.metrics.count("events_out_of_shard", n_foreign)
+                    # closed drop-reason accounting: oversample-mode
+                    # polls EXPECT ~(N-1)/N foreign rows per poll —
+                    # labeled apart from plain out_of_shard so
+                    # partition-skew drops don't read as a misrouted
+                    # topic (stream.metrics.DROP_REASONS)
+                    self.metrics.drop(
+                        "oversample" if self._shard_oversample > 1
+                        else "out_of_shard", n_foreign)
                 spans["shard_filter"] = time.monotonic() - t_f
         if cols is not None and len(cols) > self._feed_batch:
             from heatmap_tpu.stream.events import slice_columns
@@ -2133,6 +2210,11 @@ class MicroBatchRuntime:
             self._ring.append(packed, self.epoch)
         if self.governor is not None:
             self.governor.note_dispatch(n)
+        if self.audit is not None and n:
+            # conservation ledger: rows entering the device fold (the
+            # fold-side counts arrive at flush time, so the in-between
+            # shows as a draining in-flight residual, never a leak)
+            self.audit.add("dispatched", n)
         if lin is not None:
             self.lineage.ring_entered(lin)
             self._lineage_open[self.epoch] = lin

@@ -174,6 +174,13 @@ class ShardMap:
         the 1-vs-N differential byte-identity rests on); a fully-owned
         batch is returned untouched.
 
+        The runtime accounts every filtered row under a CLOSED drop
+        reason (``out_of_shard``, or ``oversample`` in
+        HEATMAP_SHARD_OVERSAMPLE mode where foreign rows are the
+        expected majority of each poll — stream.metrics.DROP_REASONS):
+        an untagged drop here would be a permanent conservation-ledger
+        residual at the feed/fold boundary (obs/audit.py).
+
         ``owned_cells`` are the surviving rows' uint64 H3 cells at
         ``snap_res`` when the NATIVE host snap computed the partition
         key, else None.  The runtime reuses them as the fold's pre-snap

@@ -549,3 +549,64 @@ def test_multichip_failed_run_skipped(tmp_path, capsys):
     _write_multichip(tmp_path, 7, rate=900_000.0, rc=1)
     assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
     assert "skipping multichip r07" in capsys.readouterr().out
+
+
+# ---------------------------------------------------- audit refusals
+def _write_audited(dir_path, rnd, value, max_residual=0, mismatches=0,
+                   enabled=True):
+    p = dir_path / f"BENCH_r{rnd:02d}.json"
+    tail = json.dumps({"metric": "GPS events/sec aggregated",
+                       "value": value, "unit": "events/sec"})
+    p.write_text(json.dumps({
+        "n": rnd, "rc": 0, "tail": tail,
+        "audit": {"enabled": enabled, "max_residual": max_residual,
+                  "digests_verified": 5, "mismatches": mismatches}}))
+    return p
+
+
+def test_audit_stamp_nonzero_residual_refused(tmp_path, capsys):
+    """An artifact whose own conservation ledger reports a leak is not
+    a headline — refused outright, even against a comparable pair."""
+    m = _load()
+    _write_audited(tmp_path, 1, 1_000_000.0)
+    _write_audited(tmp_path, 2, 1_000_000.0, max_residual=3)
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "integrity audit" in capsys.readouterr().err
+
+
+def test_audit_stamp_mismatch_refused_even_solo(tmp_path, capsys):
+    """The refusal needs no pair: a single artifact stamped with a
+    digest mismatch is refused on its own."""
+    m = _load()
+    _write_audited(tmp_path, 1, 1_000_000.0, mismatches=2)
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "mismatches=2" in capsys.readouterr().err
+
+
+def test_audit_stamp_clean_or_absent_passes(tmp_path):
+    m = _load()
+    _write_audited(tmp_path, 1, 1_000_000.0)   # clean stamp
+    _write(tmp_path, 2, 950_000.0)             # unstamped (audit off)
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
+
+
+def test_audit_refusal_covers_multichip(tmp_path, capsys):
+    m = _load()
+    _write_multichip(tmp_path, 6, rate=1_000_000.0)
+    p = _write_multichip(tmp_path, 7, rate=990_000.0)
+    art = json.loads(p.read_text())
+    art["audit"] = {"enabled": True, "max_residual": 0,
+                    "digests_verified": 3, "mismatches": 1}
+    p.write_text(json.dumps(art))
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "multichip r07" in capsys.readouterr().err
+
+
+def test_audit_stamp_refuses_dirty_baseline_too(tmp_path, capsys):
+    """A leak-stamped artifact must not serve as the ratchet BASELINE
+    either — both sides of the pair are gated."""
+    m = _load()
+    _write_audited(tmp_path, 1, 1_000_000.0, max_residual=3)
+    _write_audited(tmp_path, 2, 1_000_000.0)
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "r01" in capsys.readouterr().err

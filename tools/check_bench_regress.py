@@ -48,6 +48,14 @@ post-swing low-phase p50 may not grow and its high-phase consumption
 rate may not drop past the threshold; artifacts banked over different
 ramp schedules are refused outright.
 
+Integrity provenance (ISSUE 12) joins the refusal list: an artifact
+stamped with an ``audit`` block (HEATMAP_AUDIT=1 rounds: obs.audit's
+{max_residual, digests_verified, mismatches}) whose residual or
+mismatch count is NON-ZERO is refused outright — a run whose own
+conservation ledger says it dropped or duplicated data is not a
+headline, whatever its rate; fix the leak, re-run, re-bank.  Unstamped
+artifacts (audit off) are untouched.
+
 Mesh provenance (ISSUE 11) joins the refusal list: ``BENCH_r*`` pairs
 whose ``mesh`` stamps (device count, partitioned-vs-shuffle mode)
 differ are refused, and the ``MULTICHIP_r*.json`` mesh artifacts
@@ -169,6 +177,30 @@ def mesh_stamp(path: str) -> tuple | None:
                                                     "shuffle"):
         return None
     return (devices, mode)
+
+
+def audit_refused(path: str, label: str) -> bool:
+    """True (and prints the FAIL) when the artifact carries an
+    integrity ``audit`` stamp with a non-zero conservation residual or
+    any digest mismatch — such a round must never be banked or
+    ratcheted against.  Unstamped artifacts pass untouched."""
+    v = _stamped(path, "audit", dict)
+    if not isinstance(v, dict) or not v.get("enabled"):
+        return False
+    residual = v.get("max_residual")
+    mismatches = v.get("mismatches")
+    bad = []
+    if isinstance(residual, (int, float)) and residual != 0:
+        bad.append(f"max_residual={residual:g}")
+    if isinstance(mismatches, (int, float)) and mismatches != 0:
+        bad.append(f"digest mismatches={mismatches:g}")
+    if not bad:
+        return False
+    print(f"FAIL: {label} ({os.path.basename(path)}) is stamped with a "
+          f"failed integrity audit ({', '.join(bad)}); a round whose "
+          f"own conservation ledger reports lost or diverged data is "
+          f"not a headline — fix the leak and re-run", file=sys.stderr)
+    return True
 
 
 def newest_pair(dir_path: str) -> list:
@@ -328,6 +360,9 @@ def compare_multichip(dir_path: str, threshold: float) -> int:
               f"nothing to compare")
         return 0
     (r_prev, _pp, m_prev), (r_new, _pn, m_new) = usable[-2], usable[-1]
+    if audit_refused(_pp, f"multichip r{r_prev:02d}") \
+            or audit_refused(_pn, f"multichip r{r_new:02d}"):
+        return 1
     (rate_prev, dev_prev, mode_prev) = m_prev
     (rate_new, dev_new, mode_new) = m_new
     if dev_prev != dev_new:
@@ -476,6 +511,11 @@ def main(argv=None) -> int:
         if v is None:
             print(f"note: skipping r{r:02d} ({os.path.basename(p)}): "
                   f"failed run or no parseable headline")
+    # both sides of the would-be pair: a leak-stamped artifact must
+    # neither be banked NOR serve as the ratchet baseline
+    for rnd, path, _v in usable[-2:]:
+        if audit_refused(path, f"r{rnd:02d}"):
+            return 1
     if len(usable) < 2:
         print(f"OK: {len(usable)} usable artifact(s) — nothing to compare")
         return serve_rc

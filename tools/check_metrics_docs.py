@@ -152,6 +152,20 @@ def _smoke_govern():
     return list(reg._families.values())
 
 
+def _smoke_audit():
+    """CONSTRUCTED integrity-observatory state (obs/audit.py): the
+    ``heatmap_audit_*`` families only register under HEATMAP_AUDIT=1,
+    which no runtime smoke above enables.  Construction alone
+    registers them (the reason-labeled drop family registers
+    unconditionally in stream.metrics and rides the runtime smoke)."""
+    from heatmap_tpu.obs.audit import AuditState
+    from heatmap_tpu.obs.registry import Registry
+
+    reg = Registry()
+    AuditState(reg, tag="docsgate")
+    return list(reg._families.values())
+
+
 def main() -> int:
     os.environ.setdefault("HEATMAP_PLATFORM", "cpu")
     # the mesh smoke needs >= 2 devices; force 2 CPU host devices
@@ -185,6 +199,8 @@ def main() -> int:
     fams += [f for f in _smoke_repl() if f.name not in seen]
     seen = {f.name for f in fams}
     fams += [f for f in _smoke_govern() if f.name not in seen]
+    seen = {f.name for f in fams}
+    fams += [f for f in _smoke_audit() if f.name not in seen]
     for fam in fams:
         if not fam.help.strip():
             failures.append(f"{fam.name}: empty HELP string")

@@ -217,6 +217,12 @@ def render_frame(m: dict, prev: dict | None, dt: float,
             f"last adjust {fmt(age, ' s ago', digits=0)}"
             + (f" ({last})" if last else "")
             + ("   FROZEN" if frozen else ""))
+    # integrity observatory (obs.audit, HEATMAP_AUDIT=1): per-boundary
+    # conservation residuals (worst named), digest verification state,
+    # and the newest verified seq — absent entirely when auditing is off
+    aud = _audit_row(m)
+    if aud is not None:
+        lines.append(aud)
     if health is not None:
         status = health.get("status", "?")
         bad = [k for k, c in health.get("checks", {}).items()
@@ -225,6 +231,32 @@ def render_frame(m: dict, prev: dict | None, dt: float,
         lines.append(f"  SLO       {status.upper()}"
                      + (f"   failing: {', '.join(bad)}" if bad else ""))
     return "\n".join(lines) + "\n"
+
+
+def _audit_row(m: dict) -> str | None:
+    """The audit dashboard row, or None when heatmap_audit_* families
+    are absent (HEATMAP_AUDIT off)."""
+    res = m.get("heatmap_audit_residual")
+    verified = _val(m, "heatmap_audit_digests_verified_total")
+    mism = _val(m, "heatmap_audit_digest_mismatch_total")
+    if res is None and verified is None and mism is None:
+        return None
+    worst_b, worst_v = None, 0.0
+    for labels, v in (res or {}).items():
+        if abs(v) >= abs(worst_v) and (worst_b is None or v):
+            worst_b, worst_v = _label_of(labels, "boundary"), v
+    last_seq = _val(m, "heatmap_audit_last_verified_seq")
+
+    def fmt(v, digits=0):
+        return "--" if v is None else f"{v:,.{digits}f}"
+
+    row = (f"  audit     residual {fmt(worst_v):>12}"
+           + (f" ({worst_b})" if worst_b and worst_v else "")
+           + f"   digests ok {fmt(verified)} / bad {fmt(mism)}"
+           + f"   last seq {fmt(last_seq)}")
+    if mism:
+        row += "   MISMATCH"
+    return row
 
 
 def _last_adjust(m: dict, prev: dict | None) -> str | None:
@@ -472,6 +504,37 @@ def render_fleet_frame(m: dict, prev: dict | None, dt: float,
         if lags:
             lines.append(f"  repl max seq lag {fmt(max(lags), digits=0)}"
                          f"   replicas {len(lags)}")
+    # integrity observatory (obs.audit): one row per audited member —
+    # worst conservation residual (boundary named), digests verified /
+    # mismatched, last verified seq (replicas).  Absent without
+    # HEATMAP_AUDIT=1 anywhere on the channel.
+    aud_res: dict = {}
+    for labels, v in (m.get("heatmap_audit_residual") or {}).items():
+        p = _label_of(labels, "proc")
+        b = _label_of(labels, "boundary")
+        if p is None:
+            continue
+        cur = aud_res.get(p)
+        if cur is None or abs(v) > abs(cur[1]):
+            aud_res[p] = (b, v)
+    aud_mm = _by_proc(m, "heatmap_audit_digest_mismatch_total")
+    aud_ok = _by_proc(m, "heatmap_audit_digests_verified_total")
+    aud_seq = _by_proc(m, "heatmap_audit_last_verified_seq")
+    aud_tags = sorted(set(aud_res) | set(aud_mm) | set(aud_ok))
+    if aud_tags:
+        lines.append("")
+        lines.append(f"  {'audit':<14}{'residual':>10}  "
+                     f"{'boundary':<14}{'ok':>8}{'bad':>6}"
+                     f"{'last seq':>10}")
+        for tag in aud_tags:
+            b, v = aud_res.get(tag, (None, None))
+            lines.append(
+                f"  {tag:<14}{fmt(v, digits=0):>10}  "
+                f"{(b if b and v else '-'):<14}"
+                f"{fmt(aud_ok.get(tag), digits=0):>8}"
+                f"{fmt(aud_mm.get(tag), digits=0):>6}"
+                f"{fmt(aud_seq.get(tag), digits=0):>10}"
+                + ("  MISMATCH" if aud_mm.get(tag) else ""))
     if health is not None:
         status = health.get("status", "?")
         bad = [k for k, c in health.get("checks", {}).items()
