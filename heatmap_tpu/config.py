@@ -293,6 +293,37 @@ class Config:
                                        # (in-flight pipeline depth is
                                        # not a leak; a book that stops
                                        # balancing is)
+    cq: bool = True                    # HEATMAP_CQ: the continuous
+                                       # spatial query engine (query/
+                                       # continuous.py) on view-backed
+                                       # serve surfaces — standing
+                                       # bbox/polygon range
+                                       # subscriptions, regional topk,
+                                       # geofence enter/exit and
+                                       # per-cell threshold alerts over
+                                       # /api/queries.  Costs nothing
+                                       # until the first registration
+                                       # (the view carries no watcher);
+                                       # 0 removes the endpoints.
+    cq_max_queries: int = 1 << 20      # HEATMAP_CQ_MAX_QUERIES:
+                                       # standing queries one worker
+                                       # accepts before POST
+                                       # /api/queries answers 400
+    cq_ttl_s: float = 3600.0           # HEATMAP_CQ_TTL_S: default
+                                       # standing-query TTL (a
+                                       # registration may override via
+                                       # ttl_s; 0 = never expires) —
+                                       # abandoned subscriptions must
+                                       # not accumulate forever
+    cq_events: int = 256               # HEATMAP_CQ_EVENTS: match/alert
+                                       # records buffered per query for
+                                       # /api/queries/stream resume;
+                                       # older events fall off
+    cq_max_cells: int = 4096           # HEATMAP_CQ_MAX_CELLS: compiled
+                                       # cell-set budget per query
+                                       # (coarse parents + boundary
+                                       # sliver); larger regions are
+                                       # refused at registration
     shard_oversample: int = 0          # HEATMAP_SHARD_OVERSAMPLE: how
                                        # many feed-batches worth of
                                        # stream rows a shard polls per
@@ -405,6 +436,13 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         shard_res=_int(e, "HEATMAP_SHARD_RES", Config.shard_res),
         shard_oversample=_int(e, "HEATMAP_SHARD_OVERSAMPLE",
                               Config.shard_oversample),
+        cq=e.get("HEATMAP_CQ", "1") not in ("0", "false", ""),
+        cq_max_queries=_int(e, "HEATMAP_CQ_MAX_QUERIES",
+                            Config.cq_max_queries),
+        cq_ttl_s=_float(e, "HEATMAP_CQ_TTL_S", Config.cq_ttl_s),
+        cq_events=_int(e, "HEATMAP_CQ_EVENTS", Config.cq_events),
+        cq_max_cells=_int(e, "HEATMAP_CQ_MAX_CELLS",
+                          Config.cq_max_cells),
         audit=e.get("HEATMAP_AUDIT", "0") not in ("0", "false", ""),
         audit_settle_s=_float(e, "HEATMAP_AUDIT_SETTLE_S",
                               Config.audit_settle_s),
@@ -513,6 +551,21 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         raise ValueError(
             f"HEATMAP_SHARD_OVERSAMPLE must be in 0..64, "
             f"got {cfg.shard_oversample}")
+    if cfg.cq_max_queries < 1:
+        raise ValueError(
+            f"HEATMAP_CQ_MAX_QUERIES must be >= 1, "
+            f"got {cfg.cq_max_queries}")
+    if cfg.cq_ttl_s < 0:
+        raise ValueError(
+            f"HEATMAP_CQ_TTL_S must be >= 0 (0 = no expiry), "
+            f"got {cfg.cq_ttl_s}")
+    if cfg.cq_events < 1:
+        raise ValueError(
+            f"HEATMAP_CQ_EVENTS must be >= 1, got {cfg.cq_events}")
+    if cfg.cq_max_cells < 1:
+        raise ValueError(
+            f"HEATMAP_CQ_MAX_CELLS must be >= 1, "
+            f"got {cfg.cq_max_cells}")
     if cfg.audit_settle_s <= 0:
         raise ValueError(
             f"HEATMAP_AUDIT_SETTLE_S must be > 0, "

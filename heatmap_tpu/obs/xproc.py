@@ -210,6 +210,7 @@ def publish_member_snapshot(channel_path: str, tag: str, *, role: str,
                             healthz: dict | None = None,
                             lineage: list | None = None,
                             audit: dict | None = None,
+                            cq: dict | None = None,
                             left: bool = False) -> None:
     """Atomic write of one member's full observability snapshot:
     Prometheus exposition text of its registry, its freshness summary,
@@ -222,6 +223,12 @@ def publish_member_snapshot(channel_path: str, tag: str, *, role: str,
     per-shard digests) — /fleet/audit stitches these cross-process
     exactly as /fleet/freshness stitches lineage; absent when
     HEATMAP_AUDIT is off, keeping snapshots byte-compatible.
+
+    ``cq`` carries the member's continuous-query block
+    (query.continuous.ContinuousQueryEngine.member_block: registered
+    standing queries, evaluations, matches, eval lag, index size) —
+    what ``obs_top --fleet`` renders per serve member; absent on
+    members without the engine.
 
     ``left=True`` marks the snapshot a DEPARTURE tombstone: the member
     closed cleanly and is leaving the fleet on purpose.  Readers
@@ -242,6 +249,8 @@ def publish_member_snapshot(channel_path: str, tag: str, *, role: str,
     }
     if audit:
         payload["audit"] = audit
+    if cq:
+        payload["cq"] = cq
     if left:
         payload["left"] = True
     try:

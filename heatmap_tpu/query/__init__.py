@@ -26,6 +26,14 @@ incrementally-maintained spatial query layer; this is ours:
   ``TileMatView`` in any number of serve workers with zero
   steady-state store reads; ``StoreViewRefresher`` is demoted to a
   counted, healthz-warning fallback on replicas.
+- ``geom``     — bbox/polygon → H3 cell-set compilation for standing
+  queries: coarse fully-interior parents + a boundary sliver at snap
+  res, so hot-path membership is one or two set lookups.
+- ``continuous`` — the standing-query engine (GeoFlink-style
+  continuous spatial queries): range/topk subscriptions, geofence
+  enter/exit and threshold alerts, evaluated O(changed) off the
+  view's mutation stream via an inverted cell index — the replica
+  fleet's horizontally-scaling query tier at zero writer cost.
 """
 
 from heatmap_tpu.query.matview import (  # noqa: F401

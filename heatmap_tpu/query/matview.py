@@ -116,6 +116,14 @@ class TileMatView:
         # the view lock with one record per seq-advancing mutation, in
         # seq order — the replication feed is exactly this stream
         self._hook = None
+        # mutation WATCHERS (query.continuous): secondary observers of
+        # the same stream, enqueue-only like the hook, but (1) there can
+        # be several, (2) they additionally see a synthetic
+        # {"kind": "reset"} record when replica_reset replaces the whole
+        # view (the publisher hook must NOT see one — a reset is not a
+        # feed record), so an observer can rebuild derived state without
+        # minting phantom transitions for the bootstrap diff
+        self._watchers: list = []
         # per-boot nonce folded into every ETag: seq counters restart at
         # 0 each process, so without it a post-restart ETag string could
         # equal a pre-restart one while naming DIFFERENT content — and a
@@ -149,20 +157,44 @@ class TileMatView:
         with self._lock:
             self._hook = fn
 
+    def add_watcher(self, fn) -> None:
+        """Attach a secondary mutation observer (continuous-query
+        engine).  Same discipline as the hook — called under the view
+        lock, must only enqueue — plus the synthetic reset record."""
+        with self._lock:
+            if fn not in self._watchers:
+                self._watchers.append(fn)
+
+    def remove_watcher(self, fn) -> None:
+        with self._lock:
+            if fn in self._watchers:
+                self._watchers.remove(fn)
+
     def _emit(self, rec: dict) -> None:
-        """Fire the mutation hook (callers hold the lock).  A hook
-        failure detaches it and is logged — replication trouble must
-        never poison the apply path the sink depends on; the detached
-        publisher's feed goes stale, which is exactly what the
+        """Fire the mutation hook + watchers (callers hold the lock).
+        A hook failure detaches it and is logged — replication trouble
+        must never poison the apply path the sink depends on; the
+        detached publisher's feed goes stale, which is exactly what the
         replicas' staleness handling exists to absorb."""
-        if self._hook is None:
-            return
-        try:
-            self._hook(rec)
-        except Exception:
-            log.exception("view mutation hook failed; detaching "
-                          "replication publisher")
-            self._hook = None
+        if self._hook is not None:
+            try:
+                self._hook(rec)
+            except Exception:
+                log.exception("view mutation hook failed; detaching "
+                              "replication publisher")
+                self._hook = None
+        self._notify_watchers(rec)
+
+    def _notify_watchers(self, rec: dict) -> None:
+        for fn in list(self._watchers):
+            try:
+                fn(rec)
+            except Exception:
+                log.exception("view mutation watcher failed; detaching")
+                try:
+                    self._watchers.remove(fn)
+                except ValueError:
+                    pass
 
     def _dg_of(self, docs) -> dict | None:
         """{grid: {str(ws): hex-digest}} for every (grid, windowStart)
@@ -516,6 +548,10 @@ class TileMatView:
             self._seq = seq
             self._nonce = os.urandom(4).hex()
             self._cond.notify_all()
+            # watchers (not the feed hook): derived state must rebuild
+            # from the replaced view instead of diffing across the
+            # bootstrap — a resync never mints phantom transitions
+            self._notify_watchers({"kind": "reset", "seq": seq})
 
     def export_state(self) -> dict:
         """The publisher's snapshot of the whole view under ONE lock

@@ -25,8 +25,28 @@ Contract parity notes (all against /root/reference/app.py):
   top-k tiles of the latest window by count, optionally bbox-filtered on
   the centroid, served from the view in O(window) with no geometry cost
   for non-returned cells.
+- Continuous spatial queries (query.continuous, HEATMAP_CQ=1; needs
+  the query view — runs on any view-backed worker, but the intended
+  home is the replica fleet, where standing-query load scales
+  horizontally at zero writer cost):
+  - POST /api/queries — register a standing query: JSON body
+    {"type": "range"|"topk"|"geofence"|"threshold", "grid"?, "bbox"?
+    [minLon,minLat,maxLon,maxLat] (minLon>maxLon wraps the
+    antimeridian), "polygon"? [[lon,lat],...], "k"?, "threshold"?,
+    "ttl_s"? (0 = never expires)} → the query description with its
+    ``id``; 400 with the validation error otherwise.
+  - DELETE /api/queries?id= → unregister; GET /api/queries[?id=] →
+    list / detail (detail embeds the current one-shot evaluation).
+  - GET /api/queries/stream?id=&since= → the query's match/alert
+    records pushed as SSE (``event: match``, ``id:`` = the per-query
+    event id ``since`` resumes from), sharing the tiles-stream
+    admission cap, with comment heartbeats every
+    HEATMAP_SSE_HEARTBEAT_S so match-quiet geofence subscribers
+    aren't reaped by proxies; ``event: gone`` when the query expires.
 - GET /            → embedded Leaflet UI (app.py:92-189) — polls the
-  delta endpoint, falling back to full fetches.
+  delta endpoint, falling back to full fetches; draws registered
+  geofence/range regions and flashes cells on live matches from
+  /api/queries/stream.
 - GET /metrics      → Prometheus text exposition (obs.registry): batch /
   span / freshness histograms, watermark + state gauges, sink + source
   counters, supervisor channel, resolved-policy info, and the serve-tier
@@ -753,6 +773,28 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 registry=serve_reg,
                 audit=serve_audit)
             follower.start()
+    # Continuous spatial query engine (query.continuous): standing
+    # bbox/polygon/topk/geofence/threshold subscriptions over the
+    # view's mutation stream.  Created wherever the view exists so the
+    # metric families register and the endpoints answer, but it
+    # attaches its view watcher (and starts its drain thread) only on
+    # the FIRST registration — a worker nobody registered queries on
+    # does zero per-mutation work, which is the writer-cost-zero
+    # contract tools/bench_cq.py asserts by metric.
+    cq_engine = None
+    if view is not None and (cfg is None or getattr(cfg, "cq", True)):
+        from heatmap_tpu.query.continuous import ContinuousQueryEngine
+
+        cq_engine = ContinuousQueryEngine(
+            view, registry=serve_reg,
+            max_queries=(getattr(cfg, "cq_max_queries", 1 << 20)
+                         if cfg else 1 << 20),
+            events_per_query=(getattr(cfg, "cq_events", 256)
+                              if cfg else 256),
+            max_cells=(getattr(cfg, "cq_max_cells", 4096)
+                       if cfg else 4096),
+            default_ttl_s=(getattr(cfg, "cq_ttl_s", 3600.0)
+                           if cfg else 3600.0))
     if serve_audit is not None and runtime is None:
         serve_audit.attach(view=view, follower=follower)
         # NOTE: a serve-only app never PUBLISHES to repl_dir implicitly
@@ -885,6 +927,14 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
             ac, a_degraded = serve_audit.healthz_checks()
             checks.update(ac)
             degraded |= a_degraded
+        if cq_engine is not None and cq_engine.registered:
+            # continuous-query eval lag: standing subscribers being
+            # pushed stale matches is an SLO breach; a query-less
+            # engine has no lag to evaluate and stays silent
+            cc, c_degraded = cq_engine.healthz_checks(
+                _slo("HEATMAP_SLO_CQ_LAG_S", 5.0))
+            checks.update(cc)
+            degraded |= c_degraded
         return checks, degraded
 
     healthz = functools.partial(healthz_payload, runtime,
@@ -1013,10 +1063,86 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
         return _SSEBody(events(),
                         lambda: stats.sse_clients.inc(-1))
 
+    def _cq_sse_response(environ, start_response):
+        """/api/queries/stream?id=&since= — one standing query's
+        match/alert records as SSE.  Shares the tiles-stream admission
+        cap + slot-release hardening, and heartbeats through
+        match-quiet periods so an idle geofence subscriber's proxy
+        never reaps the connection."""
+        params = _qs_params(environ.get("QUERY_STRING", ""))
+        qid = params.get("id", "")
+        if cq_engine is None:
+            start_response("503 Service Unavailable",
+                           [("Content-Type", "application/json")])
+            return [b'{"error": "continuous queries need the query '
+                    b'view (HEATMAP_CQ=1)"}']
+        q = cq_engine.get(qid)
+        if q is None:
+            start_response("404 Not Found",
+                           [("Content-Type", "application/json")])
+            return [b'{"error": "no such query id"}']
+        since = _qs_int(params, "since", 0, 1 << 62)
+        grid = q.grid
+        with sse_admit_lock:
+            if stats.sse_clients.value >= sse_max:
+                start_response("503 Service Unavailable",
+                               [("Content-Type", "application/json")])
+                return [b'{"error": "sse client limit reached"}']
+            stats.sse_clients.inc(1)
+        start_response("200 OK", [
+            ("Content-Type", "text/event-stream"),
+            ("Cache-Control", "no-cache"),
+            ("X-Accel-Buffering", "no"),
+        ])
+
+        def events():
+            yield b"retry: 3000\n\n"
+            last = since
+            last_beat = time.monotonic()
+            while True:
+                # store-fed views only advance when something polls the
+                # refresher (a replica's follower advances it for us)
+                store_polling = (refresher is not None
+                                 and (follower is None
+                                      or not follower.synced))
+                if store_polling:
+                    if follower is not None \
+                            and follower.c_fallback is not None:
+                        follower.c_fallback.inc()
+                    refresher.refresh(grid)
+                    cq_engine.drain()
+                evs = cq_engine.events_since(qid, last)
+                if evs:
+                    for ev in evs:
+                        yield (f"id: {ev['id']}\nevent: match\n"
+                               f"data: {json.dumps(ev)}\n\n"
+                               ).encode("utf-8")
+                    last = evs[-1]["id"]
+                    last_beat = time.monotonic()
+                    continue
+                if cq_engine.get(qid) is None:
+                    # expired (TTL) or deleted: tell the client not to
+                    # reconnect into a 404 loop
+                    yield b"event: gone\ndata: {}\n\n"
+                    return
+                wait_s = (1.0 if store_polling else sse_heartbeat)
+                cq_engine.wait_events(qid, last,
+                                      timeout=min(wait_s, sse_heartbeat))
+                if time.monotonic() - last_beat >= sse_heartbeat:
+                    # comment heartbeat: keeps match-quiet streams open
+                    # through proxies without waking the client parser
+                    yield b": hb\n\n"
+                    last_beat = time.monotonic()
+
+        return _SSEBody(events(),
+                        lambda: stats.sse_clients.inc(-1))
+
     def app(environ, start_response):
         path = environ.get("PATH_INFO", "/")
-        if path == "/api/tiles/stream":
+        if path in ("/api/tiles/stream", "/api/queries/stream"):
             try:
+                if path == "/api/queries/stream":
+                    return _cq_sse_response(environ, start_response)
                 return _sse_response(environ, start_response)
             except Exception:
                 log.exception("request failed: %s", path)
@@ -1140,6 +1266,70 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 body = _features_collection_json(docs)
                 data = body.encode("utf-8")
                 _account_render(endpoint, data)
+                ctype = "application/json"
+            elif path == "/api/queries":
+                endpoint = "queries"
+                if cq_engine is None:
+                    return _unavailable(
+                        "continuous queries need the query view "
+                        "(HEATMAP_CQ=1 + HEATMAP_QUERY_VIEW=1)")
+                method = environ.get("REQUEST_METHOD", "GET")
+                params = _qs_params(environ.get("QUERY_STRING", ""))
+                if method == "POST":
+                    try:
+                        n = int(environ.get("CONTENT_LENGTH") or 0)
+                    except ValueError:
+                        n = 0
+                    if not 0 < n <= 1 << 20:
+                        return _bad_request(
+                            "POST body must be 1..1MB of JSON")
+                    try:
+                        spec = json.loads(
+                            environ["wsgi.input"].read(n)
+                            .decode("utf-8"))
+                    except (ValueError, UnicodeDecodeError):
+                        return _bad_request("body is not valid JSON")
+                    grid = (spec.get("grid") if isinstance(spec, dict)
+                            else None) or default_grid
+                    # make sure the grid's view is warm BEFORE the
+                    # engine seeds the query's edge state (store-fed
+                    # workers only materialize on access)
+                    _tiles_view(grid)
+                    try:
+                        desc = cq_engine.register(spec, default_grid)
+                    except ValueError as e:
+                        return _bad_request(str(e))
+                    body = json.dumps(desc)
+                elif method == "DELETE":
+                    qid = params.get("id")
+                    if not qid:
+                        return _bad_request("DELETE needs ?id=")
+                    if not cq_engine.remove(qid):
+                        start_response("404 Not Found",
+                                       [("Content-Type",
+                                         "application/json")])
+                        return [b'{"error": "no such query id"}']
+                    body = json.dumps({"id": qid, "removed": True})
+                elif method == "GET":
+                    qid = params.get("id")
+                    if qid:
+                        desc = cq_engine.describe(qid)
+                        if desc is None:
+                            start_response("404 Not Found",
+                                           [("Content-Type",
+                                             "application/json")])
+                            return [b'{"error": "no such query id"}']
+                        desc["eval"] = cq_engine.evaluate(qid)
+                        body = json.dumps(desc)
+                    else:
+                        n = _qs_int(params, "n", 100, 1000)
+                        body = json.dumps(cq_engine.list(n))
+                else:
+                    start_response("405 Method Not Allowed",
+                                   [("Allow", "GET, POST, DELETE"),
+                                    ("Content-Type",
+                                     "application/json")])
+                    return [b'{"error": "GET, POST or DELETE"}']
                 ctype = "application/json"
             elif path == "/api/positions/latest":
                 endpoint = "positions"
@@ -1470,8 +1660,15 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
     app.audit_fn = (serve_audit.member_block
                     if serve_audit is not None else None)
     app.serve_audit = serve_audit
+    # the member snapshot's cq block (standing queries / matches / eval
+    # lag) rides the same publish cadence for obs_top --fleet
+    app.cq_fn = (cq_engine.member_block
+                 if cq_engine is not None else None)
+    app.cq_engine = cq_engine
 
     def close_repl():
+        if cq_engine is not None:
+            cq_engine.close()
         if follower is not None:
             follower.stop()
 
@@ -1528,7 +1725,7 @@ class ServeFleetMember:
 
     def __init__(self, serve_registry, channel_path: str,
                  tag: str | None = None, healthz_fn=None,
-                 audit_fn=None):
+                 audit_fn=None, cq_fn=None):
         from heatmap_tpu.obs.xproc import ENV_FLEET_TAG
 
         self.registry = serve_registry
@@ -1539,6 +1736,9 @@ class ServeFleetMember:
         # the app's audit closure (obs.audit member block) when
         # HEATMAP_AUDIT=1 — /fleet/audit stitches it
         self.audit_fn = audit_fn
+        # the app's continuous-query closure (standing queries /
+        # matches / eval lag) — obs_top --fleet renders it
+        self.cq_fn = cq_fn
         # HEATMAP_FLEET_TAG names the RUNTIME member (stream/runtime.py
         # adopts it verbatim when single-process), so a serve worker
         # composes with it rather than adopting it — otherwise a serve
@@ -1564,7 +1764,8 @@ class ServeFleetMember:
             return None
         member = cls(reg, chan_path,
                      healthz_fn=getattr(app, "healthz_fn", None),
-                     audit_fn=getattr(app, "audit_fn", None))
+                     audit_fn=getattr(app, "audit_fn", None),
+                     cq_fn=getattr(app, "cq_fn", None))
         member.start()
         return member
 
@@ -1591,6 +1792,7 @@ class ServeFleetMember:
                 metrics_text=self.registry.expose_text(),
                 healthz=payload,
                 audit=self.audit_fn() if self.audit_fn else None,
+                cq=self.cq_fn() if self.cq_fn else None,
                 left=left)
         except Exception:  # noqa: BLE001 - telemetry never kills serving
             log.warning("serve fleet snapshot publish failed",

@@ -10,7 +10,15 @@ with its last-seen view seq and upserts only the changed hexes (a
 mode="full" response replaces the set).  A delta failure falls back to
 a full ``/api/tiles/latest`` fetch for that tick; only a 404 (older
 server) or 503 (view disabled) latches full-fetch mode for the
-session — transient blips retry delta on the next tick."""
+session — transient blips retry delta on the next tick.
+
+Continuous queries ride along: registered geofence/range regions
+(``/api/queries``) draw as dashed outlines, and up to four of them get
+a live ``EventSource`` on ``/api/queries/stream`` — a pushed match
+flashes the fence outline and, when the matched cell is on the map,
+the cell polygon itself.  Workers without the engine (404/503) skip
+the layer silently; the query list refreshes once a minute so fences
+registered after page load appear."""
 
 from __future__ import annotations
 
@@ -206,8 +214,84 @@ function renderHud(nt, np, m) {
   document.getElementById('hud').innerHTML = line + '<br/>' + sw;
 }
 
+// ---- continuous queries: geofence outlines + live match flashes ----
+const fences = L.layerGroup().addTo(map);   // dashed region outlines
+const fenceLayers = new Map();              // query id -> outline layer
+const fenceStreams = new Map();             // query id -> EventSource
+const MAX_FENCE_STREAMS = 4;
+let cqBroken = false;  // 404/503 => no engine on this worker
+
+function flash(layer, color) {
+  if (!layer || !layer.setStyle) return;
+  const orig = {color: layer.options.color,
+                weight: layer.options.weight,
+                fillOpacity: layer.options.fillOpacity};
+  layer.setStyle({color: color, weight: 3, fillOpacity: 0.85});
+  setTimeout(() => layer.setStyle(orig), 700);
+}
+
+function fenceOutline(q) {
+  const style = {color: q.type === 'geofence' ? '#7b1fa2' : '#1451c4',
+                 weight: 1.5, dashArray: '6 4', fill: false};
+  if (q.bbox) {
+    const [w, s, e, n] = q.bbox;
+    if (w <= e)
+      return L.rectangle([[s, w], [n, e]], style);
+    // antimeridian-wrapping bbox: draw the two straddling boxes
+    return L.layerGroup([L.rectangle([[s, w], [n, 180]], style),
+                         L.rectangle([[s, -180], [n, e]], style)]);
+  }
+  if (q.polygon)
+    return L.polygon(q.polygon.map(([lon, lat]) => [lat, lon]), style);
+  return null;
+}
+
+function subscribeFence(q) {
+  if (fenceStreams.size >= MAX_FENCE_STREAMS ||
+      fenceStreams.has(q.id) || !window.EventSource) return;
+  const es = new EventSource(`/api/queries/stream?id=${q.id}`);
+  fenceStreams.set(q.id, es);
+  es.addEventListener('match', ev => {
+    let m;
+    try { m = JSON.parse(ev.data); } catch (e) { return; }
+    flash(fenceLayers.get(q.id), m.kind === 'exit' ? '#607d8b' : '#e91e63');
+    if (m.cell) flash(cellLayers.get(m.cell), '#e91e63');
+    status(`${q.type} ${m.kind}${m.cell ? ' ' + esc(m.cell) : ''}`);
+  });
+  es.addEventListener('gone', () => { es.close(); });
+  es.onerror = () => { es.close(); fenceStreams.delete(q.id); };
+}
+
+async function refreshQueries() {
+  if (cqBroken) return;
+  try {
+    const r = await fetch('/api/queries');
+    if (!r.ok) { if (r.status === 404 || r.status === 503) cqBroken = true;
+                 return; }
+    const d = await r.json();
+    const seen = new Set();
+    for (const q of (d.queries || [])) {
+      seen.add(q.id);
+      if (!fenceLayers.has(q.id) && (q.bbox || q.polygon)) {
+        const layer = fenceOutline(q);
+        if (layer) { fences.addLayer(layer); fenceLayers.set(q.id, layer); }
+      }
+      if (q.type === 'geofence' || q.type === 'range') subscribeFence(q);
+    }
+    for (const [id, layer] of fenceLayers) {
+      if (!seen.has(id)) {  // expired/deleted: drop outline + stream
+        fences.removeLayer(layer); fenceLayers.delete(id);
+        const es = fenceStreams.get(id);
+        if (es) { es.close(); fenceStreams.delete(id); }
+      }
+    }
+  } catch (err) { console.warn('query list fetch failed', err); }
+}
+
 tick();
 setInterval(tick, REFRESH_MS);
+refreshQueries();
+setInterval(refreshQueries, 60000);
 </script>
 </body>
 </html>"""

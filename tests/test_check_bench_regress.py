@@ -610,3 +610,70 @@ def test_audit_stamp_refuses_dirty_baseline_too(tmp_path, capsys):
     _write_audited(tmp_path, 2, 1_000_000.0)
     assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
     assert "r01" in capsys.readouterr().err
+
+
+# --------------------------------------------------- BENCH_CQ artifacts
+def _write_cq(dir_path, rnd, p99=10.0, cost=50.0, queries=100000,
+              rc=0, **extra):
+    p = dir_path / f"BENCH_CQ_r{rnd:02d}.json"
+    art = {"rc": rc, "kind": "bench_cq", "queries": queries,
+           "match_push_p99_ms": p99, "eval_us_per_record": cost}
+    art.update(extra)
+    p.write_text(json.dumps(art))
+    return p
+
+
+def test_cq_ok_within_threshold(tmp_path, capsys):
+    m = _load()
+    _write_cq(tmp_path, 1, p99=10.0, cost=50.0)
+    _write_cq(tmp_path, 2, p99=12.0, cost=55.0)  # +20% / +10%
+    assert m.compare_cq(str(tmp_path), 0.5) == 0
+    assert "within the 50% threshold" in capsys.readouterr().out
+
+
+def test_cq_p99_regression_fails(tmp_path, capsys):
+    m = _load()
+    _write_cq(tmp_path, 1, p99=10.0)
+    _write_cq(tmp_path, 2, p99=25.0)  # +150%
+    assert m.compare_cq(str(tmp_path), 0.5) == 1
+    assert "match_push_p99_ms" in capsys.readouterr().err
+
+
+def test_cq_eval_cost_regression_fails(tmp_path, capsys):
+    m = _load()
+    _write_cq(tmp_path, 1, cost=40.0)
+    _write_cq(tmp_path, 2, cost=90.0)  # +125%
+    assert m.compare_cq(str(tmp_path), 0.5) == 1
+    assert "eval_us_per_record" in capsys.readouterr().err
+
+
+def test_cq_mixed_query_count_refused(tmp_path, capsys):
+    """A 10k-standing-query round cannot ratchet against a 100k one —
+    both numbers scale with the registered set (the replica-count
+    refusal, applied to query load)."""
+    m = _load()
+    _write_cq(tmp_path, 1, queries=100000)
+    _write_cq(tmp_path, 2, queries=10000, p99=1.0, cost=1.0)
+    assert m.compare_cq(str(tmp_path), 0.5) == 1
+    err = capsys.readouterr().err
+    assert "registered-query-count mismatch" in err
+
+
+def test_cq_failed_or_unparseable_skipped(tmp_path, capsys):
+    m = _load()
+    _write_cq(tmp_path, 1, p99=10.0)
+    _write_cq(tmp_path, 2, rc=1, p99=999.0)        # failed run
+    (tmp_path / "BENCH_CQ_r03.json").write_text("{not json")
+    assert m.compare_cq(str(tmp_path), 0.5) == 0   # one usable artifact
+    out = capsys.readouterr().out
+    assert "skipping cq r02" in out and "skipping cq r03" in out
+
+
+def test_cq_gate_wired_into_main(tmp_path, capsys):
+    """main() runs the cq ratchet next to the serve/govern/multichip
+    ones — a BENCH_CQ regression fails the whole gate."""
+    m = _load()
+    _write_cq(tmp_path, 1, p99=10.0)
+    _write_cq(tmp_path, 2, p99=100.0)
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "cq regression" in capsys.readouterr().err

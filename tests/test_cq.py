@@ -1,0 +1,786 @@
+"""Continuous spatial query engine (query/geom.py + query/continuous.py).
+
+The acceptance property is the DIFFERENTIAL REPLAY INVARIANT: a query
+registered then replayed from seq 0 must produce, at every seq,
+exactly the one-shot evaluation of the same query against the view at
+that seq — across window advance, TTL eviction, writer epoch restart,
+and pruned-horizon resync.  The tests drive it synchronously through
+the real replication path (publisher → file feed → follower →
+engine), then cover the serve surface (register/delete/stream,
+heartbeats, admission), the fleet story (member cq block, obs_top
+rows, SIGKILL chaos + /fleet/healthz naming), and the bench smoke.
+"""
+
+import datetime as dt
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from heatmap_tpu import hexgrid
+from heatmap_tpu.config import load_config
+from heatmap_tpu.query import TileMatView, geom
+from heatmap_tpu.query.continuous import ContinuousQueryEngine
+from heatmap_tpu.sink import MemoryStore
+from heatmap_tpu.sink.base import TileDoc, UTC
+
+
+def _doc(cell, ws, count, grid="h3r8", ttl_minutes=45):
+    return TileDoc("bos", 8, cell, ws, ws + dt.timedelta(minutes=5),
+                   count=count, avg_speed_kmh=30.0, avg_lat=42.3,
+                   avg_lon=-71.05, ttl_minutes=ttl_minutes, grid=grid)
+
+
+def _cells(n, res=8, lat0=42.30):
+    out = []
+    for i in range(n * 4):
+        c = hexgrid.latlng_to_cell(lat0 + i * 7e-3, -71.05, res)
+        if c not in out:
+            out.append(c)
+        if len(out) == n:
+            break
+    assert len(out) == n
+    return out
+
+
+def _bbox_around(cells, pad=2e-3):
+    lats, lons = [], []
+    for c in cells:
+        lat, lon = hexgrid.cell_to_latlng(c)
+        lats.append(lat)
+        lons.append(lon)
+    return [min(lons) - pad, min(lats) - pad,
+            max(lons) + pad, max(lats) + pad]
+
+
+def _now_ws():
+    return dt.datetime.now(UTC).replace(second=0, microsecond=0)
+
+
+# ------------------------------------------------------------- geometry
+def test_geom_zero_area_bbox_is_point_geofence():
+    """A degenerate bbox compiles to exactly the one cell containing
+    the point — the natural point-geofence."""
+    import math
+
+    lat, lon = 42.36, -71.06
+    cs = geom.compile_bbox([lon, lat, lon, lat], 8)
+    want = hexgrid.latlng_to_cell_int(math.radians(lat),
+                                      math.radians(lon), 8)
+    assert set(cs.cells) == {want} and not cs.parents
+    assert cs.contains(want)
+
+
+def test_geom_antimeridian_bbox_wraps():
+    """min_lon > max_lon runs east through ±180: cells land on BOTH
+    sides, and membership covers both."""
+    cs = geom.compile_bbox([179.99, -17.0, -179.99, -16.98], 8)
+    lons = [hexgrid.cell_to_latlng(c)[1] for c in cs.cells]
+    assert any(v > 179 for v in lons) and any(v < -179 for v in lons)
+    for c in cs.cells:
+        assert cs.contains(c)
+
+
+def test_geom_city_bbox_promotes_interior_parents():
+    """A city-scale region compresses: fully-interior coarse parents
+    plus a boundary sliver, with every member cell reachable through
+    the coarse index keys."""
+    from heatmap_tpu.query.pyramid import cell_to_parent
+
+    cs = geom.compile_bbox([-71.2, 42.2, -70.9, 42.5], 8)
+    assert cs.parents, "interior parents should promote"
+    assert cs.cells, "boundary sliver should remain"
+    # a downtown cell is a member via its promoted parent
+    import math
+
+    center = hexgrid.latlng_to_cell_int(math.radians(42.35),
+                                        math.radians(-71.05), 8)
+    assert cs.contains(center)
+    keys = cs.index_keys()
+    assert cell_to_parent(center, cs.coarse_res) in keys
+    for c in cs.cells:
+        assert cell_to_parent(c, cs.coarse_res) in keys
+
+
+def test_geom_outside_region_and_polygon_and_errors():
+    # a bbox far outside the folded city still compiles (membership is
+    # region-driven, not data-driven) — it just never matches anything
+    cs = geom.compile_bbox([10.0, 50.0, 10.02, 50.02], 8)
+    assert cs.size() > 0
+    city = _cells(3)
+    assert not any(cs.contains(int(c, 16)) for c in city)
+    # polygon compiles and contains its vertices' cells
+    import math
+
+    ring = [[-71.06, 42.35], [-71.04, 42.35], [-71.05, 42.37]]
+    ps = geom.compile_polygon(ring, 8)
+    for lon, lat in ring:
+        assert ps.contains(hexgrid.latlng_to_cell_int(
+            math.radians(lat), math.radians(lon), 8))
+    with pytest.raises(ValueError):
+        geom.compile_bbox([0, 10, 1, 5], 8)       # lat inverted
+    with pytest.raises(ValueError):
+        geom.compile_bbox([0, -95, 1, 5], 8)      # lat out of range
+    with pytest.raises(ValueError):
+        geom.compile_polygon([[0, 0], [1, 1]], 8)  # < 3 vertices
+    with pytest.raises(ValueError):                # over the cell budget
+        geom.compile_bbox([-72, 41, -70, 43], 8, max_cells=64)
+
+
+# ------------------------------------------------------------ the engine
+def test_register_validation_errors():
+    eng = ContinuousQueryEngine(TileMatView())
+    for bad in (
+        {"type": "nope"},
+        {"type": "range", "grid": "junk!"},
+        {"type": "range", "bbox": [0, 0, 1, 1],
+         "polygon": [[0, 0], [1, 0], [0, 1]]},
+        {"type": "geofence"},                       # needs a region
+        {"type": "range", "bbox": [0, 0, 1]},       # wrong arity
+        {"type": "topk", "k": 0},
+        {"type": "threshold", "threshold": 0},
+        {"type": "range", "bbox": [0, 0, 1, 1], "ttl_s": -1},
+    ):
+        with pytest.raises(ValueError):
+            eng.register(dict(bad), default_grid="h3r8")
+    assert eng.registered == 0
+
+
+def test_writer_cost_zero_until_first_registration():
+    """The zero-writer-cost contract: constructing the engine attaches
+    NOTHING; the first register() attaches the watcher."""
+    view = TileMatView()
+    eng = ContinuousQueryEngine(view)
+    assert view._watchers == []
+    cells = _cells(1)
+    eng.register({"type": "geofence",
+                  "bbox": _bbox_around(cells), "ttl_s": 0},
+                 default_grid="h3r8")
+    assert len(view._watchers) == 1
+    eng.close()
+    assert view._watchers == []
+
+
+def test_geofence_seed_silent_then_edges():
+    """Registering over an occupied fence is NOT an enter; real
+    occupancy edges (new cell, window advance) are."""
+    cells = _cells(4)
+    view = TileMatView()
+    eng = ContinuousQueryEngine(view)
+    ws1 = _now_ws()
+    view.apply_docs([_doc(cells[0], ws1, 5)])
+    qid = eng.register({"type": "geofence",
+                        "bbox": _bbox_around(cells[:2]), "ttl_s": 0},
+                       default_grid="h3r8")["id"]
+    eng.drain()
+    assert eng.state_of(qid) == [cells[0]]
+    assert eng.events_since(qid, 0) == []       # seeded silently
+    view.apply_docs([_doc(cells[1], ws1, 2),    # in fence -> enter
+                     _doc(cells[3], ws1, 9)])   # outside -> nothing
+    eng.drain()
+    evs = eng.events_since(qid, 0)
+    assert [(e["kind"], e["cell"]) for e in evs] == [("enter", cells[1])]
+    # window advance: occupied set diffs against the new window
+    ws2 = ws1 + dt.timedelta(minutes=5)
+    view.apply_docs([_doc(cells[1], ws2, 1)])
+    eng.drain()
+    kinds = [(e["kind"], e["cell"]) for e in eng.events_since(qid, 0)]
+    assert ("exit", cells[0]) in kinds
+    assert sorted(eng.state_of(qid)) == [cells[1]]
+    eng.close()
+
+
+def test_multi_doc_window_advance_no_phantom_edges():
+    """r13 review finding pinned: a window advance arriving as ONE
+    multi-doc apply record must diff edge state against the COMPLETE
+    new window — a cell occupied in both windows transitions nothing
+    (no exit/enter flap), topk pushes one final list (no truncated
+    intermediates), and range still gets its promised match for every
+    new-window doc."""
+    cells = _cells(3)
+    view = TileMatView()
+    eng = ContinuousQueryEngine(view)
+    bbox = _bbox_around(cells[:2])
+    gf = eng.register({"type": "geofence", "bbox": bbox, "ttl_s": 0},
+                      "h3r8")["id"]
+    rg = eng.register({"type": "range", "bbox": bbox, "ttl_s": 0},
+                      "h3r8")["id"]
+    tk = eng.register({"type": "topk", "k": 3, "ttl_s": 0},
+                      "h3r8")["id"]
+    ws1 = _now_ws()
+    view.apply_docs([_doc(cells[0], ws1, 4), _doc(cells[1], ws1, 6)])
+    eng.drain()
+    gf_before = len(eng.events_since(gf, 0))
+    tk_before = len(eng.events_since(tk, 0))
+    # advance: BOTH fence cells re-present in the new window, in one
+    # multi-doc record
+    ws2 = ws1 + dt.timedelta(minutes=5)
+    view.apply_docs([_doc(cells[0], ws2, 5), _doc(cells[1], ws2, 7),
+                     _doc(cells[2], ws2, 1)])
+    eng.drain()
+    gf_evs = eng.events_since(gf, 0)[gf_before:]
+    assert gf_evs == [], f"phantom geofence transitions: {gf_evs}"
+    assert eng.state_of(gf) == sorted(cells[:2])
+    tk_evs = eng.events_since(tk, 0)[tk_before:]
+    assert len(tk_evs) == 1, tk_evs          # ONE final list, no
+    assert [e["cell"] for e in tk_evs[0]["topk"]] == \
+        [cells[1], cells[0], cells[2]]       # truncated intermediates
+    rg_evs = [e for e in eng.events_since(rg, 0)
+              if e["windowStart"] == int(ws2.timestamp())]
+    assert sorted(e["cell"] for e in rg_evs) == sorted(cells[:2])
+    eng.close()
+
+
+def test_threshold_topk_range_semantics():
+    cells = _cells(3)
+    view = TileMatView()
+    eng = ContinuousQueryEngine(view)
+    bbox = _bbox_around(cells)
+    t = eng.register({"type": "threshold", "threshold": 5,
+                      "bbox": bbox, "ttl_s": 0}, "h3r8")["id"]
+    k = eng.register({"type": "topk", "k": 2, "ttl_s": 0}, "h3r8")["id"]
+    r = eng.register({"type": "range", "bbox": bbox, "ttl_s": 0},
+                     "h3r8")["id"]
+    ws = _now_ws()
+    view.apply_docs([_doc(cells[0], ws, 3)])
+    eng.drain()
+    assert eng.state_of(t) == []                    # below threshold
+    assert eng.events_since(r, 0)[-1]["kind"] == "match"
+    view.apply_docs([_doc(cells[0], ws, 7)])        # crosses up
+    eng.drain()
+    assert eng.state_of(t) == [cells[0]]
+    assert eng.events_since(t, 0)[-1]["kind"] == "above"
+    view.apply_docs([_doc(cells[1], ws, 9), _doc(cells[2], ws, 1)])
+    eng.drain()
+    top = eng.state_of(k)
+    assert [e["cell"] for e in top] == [cells[1], cells[0]]
+    assert eng.evaluate(k)["topk"] == top
+    # an in-region count change that doesn't reorder topk pushes nothing
+    before = len(eng.events_since(k, 0))
+    view.apply_docs([_doc(cells[2], ws, 2)])
+    eng.drain()
+    assert len(eng.events_since(k, 0)) == before
+    eng.close()
+
+
+def test_ttl_expiry_sweeps_query_and_index():
+    fake = [1000.0]
+    view = TileMatView()
+    eng = ContinuousQueryEngine(view, clock=lambda: fake[0])
+    cells = _cells(1)
+    qid = eng.register({"type": "geofence",
+                        "bbox": _bbox_around(cells), "ttl_s": 30},
+                       "h3r8")["id"]
+    assert eng.registered == 1
+    fake[0] += 31
+    eng._sweep_last = 0.0
+    eng._maybe_sweep()
+    assert eng.registered == 0
+    assert eng.describe(qid) is None
+    g = eng._grids["h3r8"]
+    assert not g.index                  # index entries swept with it
+    eng.close()
+
+
+# ------------------------------------- the differential replay invariant
+def _specs(cells):
+    fence = _bbox_around(cells[:3])
+    return {
+        "geofence": {"type": "geofence", "bbox": fence, "ttl_s": 0},
+        "threshold": {"type": "threshold", "threshold": 5,
+                      "bbox": fence, "ttl_s": 0},
+        "topk": {"type": "topk", "k": 3, "ttl_s": 0},
+        "range": {"type": "range", "bbox": fence, "ttl_s": 0},
+    }
+
+
+def _check_invariant(eng, view, qids, norms):
+    """engine state == one-shot evaluation against the replica view,
+    for every registered query, at the CURRENT seq."""
+    docs = view.latest_docs("h3r8")[1]
+    for name, qid in qids.items():
+        want = ContinuousQueryEngine.oneshot(norms[name], docs)
+        ev = eng.evaluate(qid)
+        if name == "topk":
+            assert ev["topk"] == want["topk"], (name, view.seq)
+            assert eng.state_of(qid) == want["topk"], (name, view.seq)
+        else:
+            assert ev["cells"] == want["cells"], (name, view.seq)
+            if name in ("geofence", "threshold"):
+                # the incremental edge state, not just the shadow scan
+                assert eng.state_of(qid) == want["cells"], \
+                    (name, view.seq)
+
+
+def test_differential_replay_invariant(tmp_path):
+    """THE acceptance test: replay the real replication feed one
+    record at a time into a replica + engine; at every applied seq the
+    incremental state equals the one-shot evaluation — across window
+    advance, fake-clock eviction of the latest window, a writer epoch
+    restart, and a pruned-horizon snapshot resync."""
+    from heatmap_tpu.query.repl import (DeltaLogPublisher,
+                                        FileFeedSource,
+                                        ReplicaViewFollower)
+
+    fake = [time.time()]
+    clock = lambda: fake[0]  # noqa: E731
+    feed = tempfile.mkdtemp(dir=str(tmp_path))
+    cells = _cells(6)
+    w_view = TileMatView(now_fn=clock)
+    pub = DeltaLogPublisher(w_view, feed, seg_bytes=4096, segments=2,
+                            start=False)
+
+    r_view = TileMatView(replica=True, now_fn=clock)
+    fol = ReplicaViewFollower(r_view, FileFeedSource(feed))
+    eng = ContinuousQueryEngine(r_view)
+    specs = _specs(cells)
+    norms = {n: eng.validate(dict(s), "h3r8") for n, s in specs.items()}
+    qids = {n: eng.register(dict(s), "h3r8")["id"]
+            for n, s in specs.items()}
+
+    def step_all():
+        pub.flush()
+        while True:
+            n = fol.step(max_n=1)   # ONE record at a time
+            eng.drain()
+            _check_invariant(eng, r_view, qids, norms)
+            if n == 0:
+                break
+
+    ws1 = dt.datetime.fromtimestamp(fake[0], UTC).replace(
+        second=0, microsecond=0)
+    # window 1 builds up, including count updates and a fence crossing
+    w_view.apply_docs([_doc(cells[0], ws1, 3), _doc(cells[4], ws1, 2)])
+    step_all()
+    w_view.apply_docs([_doc(cells[1], ws1, 7)])
+    w_view.apply_docs([_doc(cells[0], ws1, 9)])   # update
+    step_all()
+    # window advance (+ a late event into the old window afterwards)
+    ws2 = ws1 + dt.timedelta(minutes=5)
+    w_view.apply_docs([_doc(cells[2], ws2, 6)])
+    step_all()
+    w_view.apply_docs([_doc(cells[3], ws1, 8)])   # late, not visible
+    step_all()
+    # fake-clock eviction of the LATEST window: everything is stale,
+    # the read-path evict emits the marker the replica must follow
+    fake[0] += 3600 * 2
+    w_view.etag("h3r8")
+    step_all()
+    assert r_view.latest_ws_of("h3r8") is None
+    # fresh content again
+    ws3 = dt.datetime.fromtimestamp(fake[0], UTC).replace(
+        second=0, microsecond=0)
+    w_view.apply_docs([_doc(cells[0], ws3, 4), _doc(cells[1], ws3, 6)])
+    step_all()
+
+    # ---- writer epoch restart: same content re-published by a new
+    # writer; the replica resets, the engine rebuilds SILENTLY
+    pub.close()
+    before = {n: eng.events_since(q, 0) for n, q in qids.items()}
+    w_view2 = TileMatView(now_fn=clock)
+    pub2 = DeltaLogPublisher(w_view2, feed, seg_bytes=4096, segments=2,
+                             start=False)
+    w_view2.apply_docs([_doc(cells[0], ws3, 4), _doc(cells[1], ws3, 6)])
+    pub2.flush()
+    while True:
+        try:
+            n = fol.step(max_n=1)
+        except OSError:
+            continue            # epoch change path re-bootstraps
+        eng.drain()
+        _check_invariant(eng, r_view, qids, norms)
+        if n == 0:
+            break
+    # identical content across the restart -> no phantom transitions
+    after = {n: eng.events_since(q, 0) for n, q in qids.items()}
+    assert after == before, "epoch restart minted phantom transitions"
+
+    # ---- pruned-horizon resync: mutate well past the retained log
+    # while the follower is NOT stepping, then catch up via snapshot
+    for i in range(60):
+        w_view2.apply_docs([_doc(cells[i % 6], ws3, 10 + i)])
+    pub2.flush()
+    meta = json.load(open(os.path.join(feed, "meta.json")))
+    assert meta["min_seq"] > fol.applied + 1, "horizon must be pruned"
+    for _ in range(20):
+        try:
+            n = fol.step()
+        except OSError:
+            continue
+        eng.drain()
+        _check_invariant(eng, r_view, qids, norms)
+        if n == 0:
+            break
+    assert fol.applied == w_view2.seq
+    pub2.close()
+    eng.close()
+
+
+# --------------------------------------------------------- serve surface
+def _post(base, payload, path="/api/queries"):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=10).read())
+
+
+def test_queries_endpoints_over_http():
+    from heatmap_tpu.serve.api import start_background
+
+    store = MemoryStore()
+    cells = _cells(3)
+    ws = _now_ws()
+    store.upsert_tiles([_doc(cells[0], ws, 5)])
+    cfg = load_config({}, serve_port=0, view_poll_ms=50)
+    httpd, _t, port = start_background(store, cfg)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        d = _post(base, {"type": "geofence",
+                         "bbox": _bbox_around(cells[:2])})
+        qid = d["id"]
+        assert d["type"] == "geofence" and d["cells"] >= 1
+        det = json.loads(urllib.request.urlopen(
+            base + f"/api/queries?id={qid}", timeout=10).read())
+        assert det["eval"]["cells"] == [cells[0]]
+        lst = json.loads(urllib.request.urlopen(
+            base + "/api/queries", timeout=10).read())
+        assert lst["registered"] == 1
+        # healthz surfaces the cq lag check once queries exist
+        hz = json.loads(urllib.request.urlopen(
+            base + "/healthz", timeout=10).read())
+        assert hz["checks"]["cq_lag_s"]["ok"] is True
+        # validation errors -> 400 with the message
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, {"type": "geofence"})
+        assert ei.value.code == 400
+        # unknown id -> 404 (GET, DELETE, stream)
+        for url, method in ((base + "/api/queries?id=nope", "GET"),
+                            (base + "/api/queries?id=nope", "DELETE"),
+                            (base + "/api/queries/stream?id=nope",
+                             "GET")):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(urllib.request.Request(
+                    url, method=method), timeout=10)
+            assert ei.value.code == 404
+        # bad method -> 405
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                base + "/api/queries", method="PUT"), timeout=10)
+        assert ei.value.code == 405
+        # delete works and is terminal
+        req = urllib.request.Request(base + f"/api/queries?id={qid}",
+                                     method="DELETE")
+        assert json.loads(urllib.request.urlopen(
+            req, timeout=10).read())["removed"] is True
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        httpd.get_app().close_repl()
+
+
+def test_cq_disabled_removes_endpoints():
+    from heatmap_tpu.serve.api import start_background
+
+    cfg = load_config({"HEATMAP_CQ": "0"}, serve_port=0)
+    httpd, _t, port = start_background(MemoryStore(), cfg)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/queries", timeout=10)
+        assert ei.value.code == 503
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        httpd.get_app().close_repl()
+
+
+def test_quiet_stream_heartbeats_keep_connection_open():
+    """A match-quiet /api/queries/stream must heartbeat through
+    HEATMAP_SSE_HEARTBEAT_S intervals — idle geofence subscribers
+    behind proxies must not be reaped.  The stream stays open past 2
+    heartbeat intervals and the comments actually arrive."""
+    from heatmap_tpu.serve.api import start_background
+
+    store = MemoryStore()
+    cells = _cells(2)
+    store.upsert_tiles([_doc(cells[0], _now_ws(), 5)])
+    cfg = load_config({}, serve_port=0, sse_heartbeat_s=0.25,
+                      view_poll_ms=50)
+    httpd, _t, port = start_background(store, cfg)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        qid = _post(base, {"type": "geofence",
+                           "bbox": _bbox_around(cells)})["id"]
+        r = urllib.request.urlopen(
+            base + f"/api/queries/stream?id={qid}", timeout=5)
+        got = b""
+        deadline = time.monotonic() + 1.2   # ~4.8 heartbeat intervals
+        while time.monotonic() < deadline:
+            got += r.read(1)
+        assert got.count(b": hb") >= 2, got
+        # the slot releases on close (admission hardening intact)
+        app = httpd.get_app()
+        r.close()
+        time.sleep(0.1)
+        assert app.cq_engine is not None
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        httpd.get_app().close_repl()
+
+
+def test_stream_pushes_matches_and_gone_on_expiry():
+    from heatmap_tpu.serve.api import start_background
+
+    store = MemoryStore()
+    cells = _cells(2)
+    ws = _now_ws()
+    store.upsert_tiles([_doc(cells[0], ws, 5)])
+    cfg = load_config({}, serve_port=0, view_poll_ms=30,
+                      sse_heartbeat_s=0.2)
+    httpd, _t, port = start_background(store, cfg)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        qid = _post(base, {"type": "geofence",
+                           "bbox": _bbox_around(cells)})["id"]
+        frames = []
+        done = threading.Event()
+
+        def reader():
+            r = urllib.request.urlopen(
+                base + f"/api/queries/stream?id={qid}", timeout=10)
+            buf = b""
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 8:
+                b1 = r.read(1)
+                if not b1:
+                    break
+                buf += b1
+                while b"\n\n" in buf:
+                    frame, buf = buf.split(b"\n\n", 1)
+                    frames.append(frame.decode())
+                    if any("event: match" in f for f in frames) \
+                            and any("event: gone" in f
+                                    for f in frames):
+                        done.set()
+                        return
+            done.set()
+
+        th = threading.Thread(target=reader, daemon=True)
+        th.start()
+        time.sleep(0.3)
+        store.upsert_tiles([_doc(cells[1], ws, 3)])   # -> enter match
+        time.sleep(0.5)
+        app = httpd.get_app()
+        app.cq_engine.remove(qid)                     # -> gone
+        done.wait(timeout=10)
+        match = [f for f in frames if "event: match" in f]
+        assert match and cells[1] in match[0]
+        assert any("event: gone" in f for f in frames)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        httpd.get_app().close_repl()
+
+
+# ------------------------------------------------------------ fleet story
+def test_member_snapshot_carries_cq_block(tmp_path, monkeypatch):
+    from heatmap_tpu.obs.xproc import ENV_CHANNEL, members_from
+    from heatmap_tpu.serve.api import ServeFleetMember, make_wsgi_app
+
+    chan = str(tmp_path / "chan.json")
+    monkeypatch.setenv(ENV_CHANNEL, chan)
+    store = MemoryStore()
+    cells = _cells(2)
+    store.upsert_tiles([_doc(cells[0], _now_ws(), 5)])
+    cfg = load_config({}, serve_port=0, view_poll_ms=50)
+    app = make_wsgi_app(store, cfg)
+    try:
+        app.cq_engine.register(
+            {"type": "geofence", "bbox": _bbox_around(cells),
+             "ttl_s": 0}, "h3r8")
+        member = ServeFleetMember(app.serve_registry, chan,
+                                  tag="cq0",
+                                  healthz_fn=app.healthz_fn,
+                                  cq_fn=app.cq_fn)
+        member.publish()
+        members, _skipped = members_from(chan, max_age_s=30.0)
+        blk = members["cq0"].get("cq")
+        assert blk and blk["registered"] == 1
+        assert "eval_lag_s" in blk and "index_cells" in blk
+        # and the federated exposition carries the gauge per proc
+        from heatmap_tpu.obs.fleet import FleetAggregator
+
+        text = FleetAggregator(chan, max_age_s=30.0).metrics_text()
+        assert 'heatmap_cq_registered{proc="cq0"} 1' in text
+    finally:
+        app.close_repl()
+
+
+def _load_tool(name):
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        os.pardir))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(repo, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_obs_top_fleet_renders_cq_rows():
+    top = _load_tool("obs_top")
+    text = """\
+heatmap_fleet_members 2
+heatmap_fleet_member_up{proc="serve1",role="serve"} 1
+heatmap_fleet_member_up{proc="serve2",role="serve"} 1
+heatmap_cq_registered{proc="serve1"} 100000
+heatmap_cq_registered{proc="serve2"} 0
+heatmap_cq_matches_total{proc="serve1"} 4211
+heatmap_cq_evaluations_total{proc="serve1"} 99000
+heatmap_cq_eval_lag_seconds{proc="serve1"} 0.02
+heatmap_cq_index_cells{proc="serve1"} 1800
+"""
+    m = top.parse_prom(text)
+    frame = top.render_fleet_frame(m, None, 0.0, {"status": "ok",
+                                                  "checks": {}})
+    assert "cq" in frame
+    assert "100,000" in frame and "4,211" in frame
+    assert "1,800" in frame
+    # a query-less member contributes no cq row
+    assert "cq total registered 100,000 across 1 member(s)" in frame
+
+
+_CHILD = r"""
+import json, os, sys, time
+from heatmap_tpu.config import load_config
+from heatmap_tpu.serve.api import ServeFleetMember, start_background
+from heatmap_tpu.sink import MemoryStore
+
+cfg = load_config({}, serve_port=0, store="memory",
+                  repl_feed=os.environ["CQ_FEED"], repl_poll_ms=50)
+httpd, t, port = start_background(MemoryStore(), cfg)
+member = ServeFleetMember.from_env(httpd.get_app())
+print(json.dumps({"port": port, "pid": os.getpid()}), flush=True)
+time.sleep(300)
+"""
+
+
+def test_sigkill_replica_chaos(tmp_path, monkeypatch):
+    """Chaos tier-1: SIGKILL a replica mid-subscription.  The
+    re-registered query on a surviving replica replays to the
+    IDENTICAL match set, and /fleet/healthz degrades NAMING the dead
+    member."""
+    from heatmap_tpu.obs.fleet import FleetAggregator
+    from heatmap_tpu.obs.xproc import ENV_CHANNEL, ENV_FLEET_TAG
+    from heatmap_tpu.query.repl import (DeltaLogPublisher,
+                                        FileFeedSource,
+                                        ReplicaViewFollower)
+
+    feed = tempfile.mkdtemp(dir=str(tmp_path))
+    chan = str(tmp_path / "chan.json")
+    cells = _cells(4)
+    ws = _now_ws()
+    w_view = TileMatView()
+    pub = DeltaLogPublisher(w_view, feed)   # publisher thread runs
+    w_view.apply_docs([_doc(cells[0], ws, 5), _doc(cells[3], ws, 2)])
+
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        os.pardir))
+    env = dict(os.environ)
+    env.update({"CQ_FEED": feed, ENV_CHANNEL: chan,
+                ENV_FLEET_TAG: "cqchaos",
+                "HEATMAP_FLEET_PUBLISH_S": "0.2",
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": repo + os.pathsep
+                + env.get("PYTHONPATH", "")})
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    proc = subprocess.Popen([sys.executable, str(script)], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        info = json.loads(line)
+        base = f"http://127.0.0.1:{info['port']}"
+        spec = {"type": "geofence", "bbox": _bbox_around(cells[:2]),
+                "ttl_s": 0}
+        # wait for the replica to sync, then register mid-stream
+        deadline = time.monotonic() + 20
+        qid = None
+        while time.monotonic() < deadline:
+            try:
+                d = _post(base, spec)
+                qid = d["id"]
+                det = json.loads(urllib.request.urlopen(
+                    base + f"/api/queries?id={qid}", timeout=5).read())
+                if det["eval"]["cells"] == [cells[0]]:
+                    break
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.2)
+        assert qid is not None
+        # hold an open subscription (mid-subscription kill)
+        stream = urllib.request.urlopen(
+            base + f"/api/queries/stream?id={qid}", timeout=5)
+        stream.read(10)
+        pre_kill_eval = det["eval"]["cells"]
+
+        os.kill(info["pid"], signal.SIGKILL)
+        proc.wait(timeout=10)
+
+        # /fleet/healthz degrades NAMING the dead member once stale
+        monkeypatch.setenv(ENV_CHANNEL, chan)
+        deadline = time.monotonic() + 10
+        named = False
+        while time.monotonic() < deadline:
+            agg = FleetAggregator(chan, max_age_s=0.6)
+            payload, _down = agg.healthz()
+            body = json.dumps(payload)
+            if payload["status"] != "ok" and "cqchaos" in body:
+                named = True
+                break
+            time.sleep(0.3)
+        assert named, "fleet healthz never named the dead replica"
+
+        # survivor: fresh replica + engine, SAME query re-registered,
+        # replays the feed to the IDENTICAL match set
+        r_view = TileMatView(replica=True)
+        fol = ReplicaViewFollower(r_view, FileFeedSource(feed))
+        eng = ContinuousQueryEngine(r_view)
+        qid2 = eng.register(dict(spec), "h3r8")["id"]
+        while fol.step():
+            pass
+        eng.drain()
+        assert eng.state_of(qid2) == pre_kill_eval == [cells[0]]
+        norm = eng.validate(dict(spec), "h3r8")
+        assert ContinuousQueryEngine.oneshot(
+            norm, r_view.latest_docs("h3r8")[1])["cells"] \
+            == pre_kill_eval
+        eng.close()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        pub.close()
+
+
+# ----------------------------------------------------------- bench smoke
+def test_bench_cq_smoke():
+    bench = _load_tool("bench_cq")
+    art = bench.run(queries=150, cells=48, batches=4, batch_docs=24)
+    assert art["rc"] == 0
+    assert art["writer_cost_zero"] is True
+    assert art["writer"] == {"cq_registered": 0, "cq_evaluations": 0,
+                             "view_watchers": 0}
+    assert art["matches"] > 0
+    assert art["match_push_p99_ms"] > 0
+    assert art["eval_us_per_record"] > 0
+    assert art["queries"] == 150

@@ -503,3 +503,74 @@ def test_late_window_writes_do_not_flap_etag():
     view.apply_docs([_doc(cells[2], ws_new, 4, 40.0)])
     assert view.etag("h3r8") != etag
     assert view.changed_since("h3r8", since)
+
+
+# ---------------------------------------------------- bbox edge cases
+# (ISSUE 13 satellite: only the happy path was pinned; the continuous-
+# query geometry compilation leans on exactly these boundaries)
+def test_topk_bbox_zero_area_and_outside_region():
+    """A zero-area bbox through the topk centroid filter matches only
+    a centroid EXACTLY on the point (practically nothing — the
+    point-geofence shape lives in query.geom, which compiles the
+    containing CELL instead); a bbox entirely outside the folded
+    region matches nothing at base res and at every pyramid rollup
+    res."""
+    ws_dt = dt.datetime.now(UTC).replace(second=0, microsecond=0)
+    cells = _cells(4)
+    view = TileMatView(pyramid_levels=2)
+    view.apply_docs([_doc(c, ws_dt, count=i + 1, speed=10.0,
+                          lat=42.30 + i * 0.01, lon=-71.05)
+                     for i, c in enumerate(cells)])
+    # zero-area bbox off any tile centroid: nothing
+    assert view.topk("h3r8", 10, bbox=(-71.049, 42.3012,
+                                       -71.049, 42.3012)) == []
+    # zero-area bbox ON a tile's (count-weighted) centroid: that tile
+    got = view.topk("h3r8", 10, bbox=(-71.05, 42.30, -71.05, 42.30))
+    assert [d["cellId"] for d in got] == [cells[0]]
+    # bbox entirely outside the folded region: empty at base res...
+    far = (10.0, 50.0, 10.5, 50.5)
+    assert view.topk("h3r8", 10, bbox=far) == []
+    # ...and at the pyramid rollup resolutions (same centroid filter
+    # over synthesized parent docs)
+    for res in (7, 6):
+        assert view.topk("h3r8", 10, res=res, bbox=far) == []
+        assert view.topk("h3r8", 10, res=res) != []
+
+
+def test_serve_bbox_parser_rejects_antimeridian_wrap():
+    """The ONE-SHOT ``bbox=`` parser stays strict: a wrapped
+    (min_lon > max_lon) box is a 400, not a silent empty result —
+    standing queries accept the wrap via query.geom.compile_bbox
+    (pinned in tests/test_cq.py), which splits it into the two
+    straddling boxes."""
+    from heatmap_tpu.query import geom
+    from heatmap_tpu.serve.api import _parse_bbox
+
+    bbox, err = _parse_bbox({"bbox": "179.9,-17.0,-179.9,-16.9"})
+    assert bbox is None and "min exceeds max" in err
+    # the standing-query path accepts the same shape
+    cs = geom.compile_bbox([179.9, -17.0, -179.9, -16.9], 8)
+    assert cs.size() > 0
+
+
+def test_pyramid_parent_math_on_antimeridian_cells():
+    """cell_to_parent is pure bit surgery — cells straddling ±180 roll
+    up exactly like any other (the geom compiler's index keys depend
+    on it)."""
+    import math
+
+    from heatmap_tpu.hexgrid import host
+
+    for lon in (179.999, -179.999, 180.0, -180.0):
+        child = host.latlng_to_cell_int(math.radians(-16.99),
+                                        math.radians(lon), 9)
+        base, digits, res = host.unpack(child)
+        for pres in (8, 7, 5):
+            parent = cell_to_parent(child, pres)
+            assert parent == host.pack(base, digits[:pres], pres)
+    # and ±180 name the same meridian, so the same parents
+    a = host.latlng_to_cell_int(math.radians(-16.99),
+                                math.radians(180.0), 9)
+    b = host.latlng_to_cell_int(math.radians(-16.99),
+                                math.radians(-180.0), 9)
+    assert cell_to_parent(a, 7) == cell_to_parent(b, 7)

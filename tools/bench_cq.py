@@ -1,0 +1,252 @@
+#!/usr/bin/env python
+"""Continuous-query bench: N standing geofences on one replica.
+
+ROADMAP item 2's "millions of users" concretely means millions of
+*standing* geofences/viewports, each a few cells of incremental work
+per ``replica_apply``.  This bench banks that claim as numbers
+(``BENCH_CQ_r*.json``, ratcheted by tools/check_bench_regress.py):
+
+- register ``--queries`` tiny geofences (bbox fences centered on the
+  city's cells) on a replica-side ContinuousQueryEngine,
+- drive a writer ``TileMatView`` + ``DeltaLogPublisher`` feed through
+  a ``ReplicaViewFollower`` (the real PR 8 replication path, file
+  transport), mutating random cells in batches,
+- stamp ``eval_us_per_record`` (engine wall time per replication
+  record, off the ``heatmap_cq_eval_seconds`` histogram — the
+  O(changed) incremental cost) and ``match_push_p99_ms`` (wall time
+  from the writer-side view apply to the match record being available
+  for SSE push on the replica, through publish → follow → evaluate),
+- and assert the ZERO-WRITER-COST contract **by metric**: the writer
+  process's ``heatmap_cq_registered`` / ``heatmap_cq_evaluations_total``
+  stay 0 and its view carries no watcher — a violated assertion fails
+  the run (rc 1), the same way a failed conservation audit does.
+
+Usage:
+    python tools/bench_cq.py [--queries 100000] [--cells 2048]
+        [--batches 64] [--batch-docs 256] [--out BENCH_CQ_r01.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime as dt
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+UTC = dt.timezone.utc
+
+
+def _city_cells(n: int, res: int = 8) -> list:
+    """n distinct cells tiling outward from downtown (deterministic)."""
+    from heatmap_tpu import hexgrid
+
+    out: list = []
+    seen: set = set()
+    i = 0
+    # walk a lat/lon lattice at ~cell spacing until n distinct cells
+    while len(out) < n and i < n * 20:
+        row, col = divmod(i, 64)
+        lat = 42.20 + row * 4.5e-3
+        lon = -71.30 + col * 6.0e-3
+        c = hexgrid.latlng_to_cell(lat, lon, res)
+        if c not in seen:
+            seen.add(c)
+            out.append(c)
+        i += 1
+    if len(out) < n:
+        raise SystemExit(f"could not tile {n} distinct cells")
+    return out
+
+
+def _doc(cell: str, ws: dt.datetime, count: int):
+    from heatmap_tpu.sink.base import TileDoc
+
+    return TileDoc("bench", 8, cell, ws, ws + dt.timedelta(minutes=5),
+                   count=count, avg_speed_kmh=30.0, avg_lat=42.3,
+                   avg_lon=-71.05, ttl_minutes=45, grid="h3r8")
+
+
+def run(queries: int, cells: int, batches: int, batch_docs: int,
+        seed: int = 7) -> dict:
+    from heatmap_tpu import hexgrid
+    from heatmap_tpu.obs.registry import Registry
+    from heatmap_tpu.query import TileMatView
+    from heatmap_tpu.query.continuous import ContinuousQueryEngine
+    from heatmap_tpu.query.repl import (DeltaLogPublisher,
+                                        FileFeedSource,
+                                        ReplicaViewFollower)
+
+    rng = random.Random(seed)
+    feed = tempfile.mkdtemp(prefix="bench-cq-feed-")
+
+    # ---- writer side: view + feed publisher + an engine NOBODY
+    # registers on (exactly what a writer-process serve app builds) —
+    # its metrics are the zero-cost assertion
+    w_reg = Registry()
+    w_view = TileMatView(registry=w_reg)
+    w_engine = ContinuousQueryEngine(w_view, registry=w_reg)
+    pub = DeltaLogPublisher(w_view, feed, registry=w_reg, start=False)
+
+    # ---- replica side: follower-driven view + the engine under test
+    r_reg = Registry()
+    r_view = TileMatView(registry=r_reg, replica=True)
+    fol = ReplicaViewFollower(r_view, FileFeedSource(feed),
+                              registry=r_reg)
+    engine = ContinuousQueryEngine(r_view, registry=r_reg,
+                                   max_queries=max(queries, 1 << 20),
+                                   default_ttl_s=0.0)
+
+    city = _city_cells(cells)
+    centroids = [hexgrid.cell_to_latlng(c) for c in city]
+
+    # ---- registration storm: tiny bbox fences centered on cells
+    t0 = time.perf_counter()
+    for i in range(queries):
+        lat, lon = centroids[i % len(city)]
+        r = 0.0015 + 0.0015 * rng.random()
+        engine.register(
+            {"type": "geofence",
+             "bbox": [lon - r, lat - r, lon + r, lat + r],
+             "ttl_s": 0},
+            default_grid="h3r8")
+    reg_s = time.perf_counter() - t0
+
+    # ---- mutation phase: apply → publish → follow → evaluate, timing
+    # each batch end-to-end (the synchronous drive makes the measured
+    # path exactly the production one minus thread wakeup jitter)
+    ws = dt.datetime.now(UTC).replace(second=0, microsecond=0)
+    counts = {c: 0 for c in city}
+    push_lat_s: list = []
+    t_mut0 = time.perf_counter()
+    for b in range(batches):
+        batch_cells = rng.sample(city, min(batch_docs, len(city)))
+        docs = []
+        for c in batch_cells:
+            counts[c] += rng.randint(1, 5)
+            docs.append(_doc(c, ws, counts[c]))
+        t_apply = time.time()
+        w_view.apply_docs(docs)
+        pub.flush()
+        while fol.step():
+            pass
+        engine.drain()
+        # every event emitted for this batch's seq advance carries its
+        # wall-clock emit time; latency = emit - writer apply start
+        for ev_t in _batch_event_times(engine, t_apply):
+            push_lat_s.append(ev_t - t_apply)
+    mut_s = time.perf_counter() - t_mut0
+    pub.close()
+
+    h = engine._h_eval
+    eval_us = (h.sum / h.count * 1e6) if h is not None and h.count else 0.0
+    push_lat_s.sort()
+
+    def pctl(q: float) -> float:
+        if not push_lat_s:
+            return 0.0
+        return push_lat_s[min(len(push_lat_s) - 1,
+                              int(q * len(push_lat_s)))]
+
+    matches = int(engine._c_matches.value
+                  if engine._c_matches is not None else 0)
+    evals = int(engine._c_evals.value
+                if engine._c_evals is not None else 0)
+
+    # ---- the zero-writer-cost metric assertion
+    writer = {
+        "cq_registered": int(w_engine.registered),
+        "cq_evaluations": int(w_engine._c_evals.value
+                              if w_engine._c_evals is not None else 0),
+        "view_watchers": len(w_view._watchers),
+    }
+    writer_zero = all(v == 0 for v in writer.values())
+
+    art = {
+        "rc": 0 if writer_zero else 1,
+        "kind": "bench_cq",
+        "queries": queries,
+        "city_cells": len(city),
+        "batches": batches,
+        "batch_docs": batch_docs,
+        "records": batches,
+        "matches": matches,
+        "evaluations": evals,
+        "registration_s": round(reg_s, 3),
+        "registration_us_per_query": round(reg_s / queries * 1e6, 1),
+        "mutation_phase_s": round(mut_s, 3),
+        "eval_us_per_record": round(eval_us, 2),
+        "match_push_p50_ms": round(pctl(0.5) * 1e3, 3),
+        "match_push_p99_ms": round(pctl(0.99) * 1e3, 3),
+        "index_cells": int(sum(len(g.index) + len(g.pindex)
+                               for g in engine._grids.values())),
+        "writer": writer,
+        "writer_cost_zero": writer_zero,
+        "note": ("match push latency = writer view apply -> match "
+                 "record available for SSE push on the replica, "
+                 "through the file-transport replication feed, driven "
+                 "synchronously"),
+        "banked_unix": round(time.time(), 3),
+    }
+    engine.close()
+    w_engine.close()
+    return art
+
+
+def _batch_event_times(engine, t_after: float) -> list:
+    """Emit wall times of events produced at/after ``t_after`` (bounded
+    per-query deques; the bench's batches are small enough that nothing
+    relevant has fallen off)."""
+    out = []
+    with engine._lock:
+        for q in engine._queries.values():
+            for ev in reversed(q.events):
+                if ev["t"] < t_after - 0.5:
+                    break
+                if ev["t"] >= t_after:
+                    out.append(ev["t"])
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--queries", type=int, default=100000)
+    ap.add_argument("--cells", type=int, default=2048)
+    ap.add_argument("--batches", type=int, default=64)
+    ap.add_argument("--batch-docs", type=int, default=256)
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default: print only)")
+    args = ap.parse_args(argv)
+    if args.queries < 1 or args.cells < 1 or args.batches < 1:
+        print("bench_cq: --queries/--cells/--batches must be >= 1",
+              file=sys.stderr)
+        return 2
+    art = run(args.queries, args.cells, args.batches, args.batch_docs)
+    print(json.dumps({
+        "metric": "cq_match_push_p99_ms",
+        "value": art["match_push_p99_ms"],
+        "queries": art["queries"],
+        "eval_us_per_record": art["eval_us_per_record"],
+        "matches": art["matches"],
+        "writer_cost_zero": art["writer_cost_zero"],
+    }))
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(art, fh, indent=2)
+            fh.write("\n")
+        print(f"banked {args.out}")
+    if not art["writer_cost_zero"]:
+        print("FAIL: writer-side continuous-query cost is not zero "
+              f"({art['writer']})", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -65,6 +65,15 @@ partitioned aggregate must never mask a 2-chip or shuffle-mode
 regression.  The r01-r05 dryrun proofs carry no headline and are
 skipped with a note.
 
+Continuous-query artifacts (ISSUE 13, ``BENCH_CQ_r*.json`` from
+tools/bench_cq.py) are ratcheted on ``match_push_p99_ms`` (end-to-end
+mutation→pushed-match tail) and ``eval_us_per_record`` (per-record
+incremental evaluation cost), both LOWER-is-better; pairs whose
+registered-query counts differ are refused outright — both numbers
+scale with the standing set, so a 10k-query round cannot stand in for
+a 100k one (or mask its regression), the same reasoning as the
+replica-count refusal.
+
 Usage:
     python tools/check_bench_regress.py [--dir REPO] [--threshold 0.5]
 Exit codes: 0 ok / nothing to compare, 1 regression or mixed-backend /
@@ -391,6 +400,95 @@ def compare_multichip(dir_path: str, threshold: float) -> int:
     return 0
 
 
+# ---------------------------------------------------------- cq artifacts
+_CQ_ROUND_RE = re.compile(r"BENCH_CQ_r(\d+)\.json$")
+
+
+def cq_artifact_round(path: str) -> int | None:
+    m = _CQ_ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def cq_metrics(path: str) -> tuple | None:
+    """(match_push_p99_ms, eval_us_per_record, queries) of one
+    BENCH_CQ_r*.json continuous-query artifact (tools/bench_cq.py) —
+    the two numbers a standing-query regression shows up in first:
+    end-to-end match-push latency tail and the per-record incremental
+    evaluation cost, both LOWER-is-better.  None when the run failed
+    or the numbers don't parse."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            art = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(art, dict) or art.get("rc", 0) != 0:
+        return None
+    p99 = art.get("match_push_p99_ms")
+    cost = art.get("eval_us_per_record")
+    queries = art.get("queries")
+    if not isinstance(p99, (int, float)) or p99 <= 0 \
+            or not isinstance(cost, (int, float)) or cost <= 0:
+        return None
+    return (float(p99), float(cost),
+            int(queries) if isinstance(queries, int) else None)
+
+
+def compare_cq(dir_path: str, threshold: float) -> int:
+    """Ratchet the newest two BENCH_CQ_r*.json artifacts: match-push
+    p99 and per-record eval cost may not GROW past ``threshold``;
+    pairs whose registered-query counts differ are REFUSED (exit 1) —
+    100k standing geofences' incremental cost cannot stand in for 10k's
+    (or mask its regression), mirroring the replica-count refusal."""
+    arts = []
+    for p in glob.glob(os.path.join(glob.escape(dir_path),
+                                    "BENCH_CQ_r*.json")):
+        rnd = cq_artifact_round(p)
+        if rnd is None:
+            continue
+        arts.append((rnd, p, cq_metrics(p)))
+    arts.sort()
+    usable = [(r, p, m) for r, p, m in arts if m is not None]
+    for r, p, m in arts:
+        if m is None:
+            print(f"note: skipping cq r{r:02d} "
+                  f"({os.path.basename(p)}): failed run or no "
+                  f"parseable p99/eval cost")
+    if len(usable) < 2:
+        print(f"OK: {len(usable)} usable cq artifact(s) — nothing to "
+              f"compare")
+        return 0
+    (r_prev, p_prev, m_prev), (r_new, p_new, m_new) = \
+        usable[-2], usable[-1]
+    if audit_refused(p_prev, f"cq r{r_prev:02d}") \
+            or audit_refused(p_new, f"cq r{r_new:02d}"):
+        return 1
+    (p99_prev, cost_prev, q_prev) = m_prev
+    (p99_new, cost_new, q_new) = m_new
+    if q_prev is not None and q_new is not None and q_prev != q_new:
+        print(f"FAIL: registered-query-count mismatch — cq "
+              f"r{r_prev:02d} ran {q_prev:,} standing quer(ies) but "
+              f"r{r_new:02d} ran {q_new:,}; per-record eval cost and "
+              f"push latency scale with the registered set, so the "
+              f"pair is not the same experiment (and would mask its "
+              f"regression) — re-run the bench at the same query "
+              f"count", file=sys.stderr)
+        return 1
+    rc = 0
+    for name, prev, new in (("match_push_p99_ms", p99_prev, p99_new),
+                            ("eval_us_per_record", cost_prev,
+                             cost_new)):
+        growth = (new - prev) / prev if prev > 0 else 0.0
+        line = (f"cq r{r_prev:02d} {name} {prev:,.2f} -> "
+                f"r{r_new:02d} {new:,.2f} ({growth:+.1%})")
+        if growth > threshold:
+            print(f"FAIL: cq regression beyond {threshold:.0%}: "
+                  f"{line}", file=sys.stderr)
+            rc = 1
+        else:
+            print(f"OK: {line} within the {threshold:.0%} threshold")
+    return rc
+
+
 # ------------------------------------------------------ govern artifacts
 _GOVERN_ROUND_RE = re.compile(r"BENCH_GOVERN_r(\d+)\.json$")
 
@@ -504,6 +602,7 @@ def main(argv=None) -> int:
     serve_rc = compare_serve(args.dir, args.threshold)
     serve_rc = compare_govern(args.dir, args.threshold) or serve_rc
     serve_rc = compare_multichip(args.dir, args.threshold) or serve_rc
+    serve_rc = compare_cq(args.dir, args.threshold) or serve_rc
 
     arts = newest_pair(args.dir)
     usable = [(r, p, v) for r, p, v in arts if v is not None]

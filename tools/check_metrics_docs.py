@@ -166,6 +166,21 @@ def _smoke_audit():
     return list(reg._families.values())
 
 
+def _smoke_cq():
+    """CONSTRUCTED continuous-query engine (query/continuous.py): the
+    ``heatmap_cq_*`` families register on any view-backed serve app
+    (the runtime smoke covers that path too), but constructing the
+    engine directly keeps them enforced even if the app wiring gains a
+    kill switch.  No watcher attaches, no thread starts."""
+    from heatmap_tpu.obs.registry import Registry
+    from heatmap_tpu.query import TileMatView
+    from heatmap_tpu.query.continuous import ContinuousQueryEngine
+
+    reg = Registry()
+    ContinuousQueryEngine(TileMatView(), registry=reg)
+    return list(reg._families.values())
+
+
 def main() -> int:
     os.environ.setdefault("HEATMAP_PLATFORM", "cpu")
     # the mesh smoke needs >= 2 devices; force 2 CPU host devices
@@ -201,6 +216,8 @@ def main() -> int:
     fams += [f for f in _smoke_govern() if f.name not in seen]
     seen = {f.name for f in fams}
     fams += [f for f in _smoke_audit() if f.name not in seen]
+    seen = {f.name for f in fams}
+    fams += [f for f in _smoke_cq() if f.name not in seen]
     for fam in fams:
         if not fam.help.strip():
             failures.append(f"{fam.name}: empty HELP string")

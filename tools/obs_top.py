@@ -535,6 +535,36 @@ def render_fleet_frame(m: dict, prev: dict | None, dt: float,
                 f"{fmt(aud_mm.get(tag), digits=0):>6}"
                 f"{fmt(aud_seq.get(tag), digits=0):>10}"
                 + ("  MISMATCH" if aud_mm.get(tag) else ""))
+    # continuous-query engine (query.continuous): one row per member
+    # carrying standing queries — registered count, match/eval totals
+    # and rate, eval lag (the HEATMAP_SLO_CQ_LAG_S budget), index
+    # size.  Absent until something registers a query on the channel.
+    cq_reg = _by_proc(m, "heatmap_cq_registered")
+    cq_tags = sorted(t for t, v in cq_reg.items() if v)
+    if cq_tags:
+        cq_match = _by_proc(m, "heatmap_cq_matches_total")
+        cq_match_prev = _by_proc(prev, "heatmap_cq_matches_total")
+        cq_evals = _by_proc(m, "heatmap_cq_evaluations_total")
+        cq_lag = _by_proc(m, "heatmap_cq_eval_lag_seconds")
+        cq_idx = _by_proc(m, "heatmap_cq_index_cells")
+        lines.append("")
+        lines.append(f"  {'cq':<14}{'queries':>9}{'matches':>10}"
+                     f"{'match/s':>9}{'evals':>11}{'lag':>8}"
+                     f"{'index':>8}")
+        for tag in cq_tags:
+            mrate = None
+            if dt > 0 and tag in cq_match and tag in cq_match_prev:
+                mrate = (cq_match[tag] - cq_match_prev[tag]) / dt
+            lines.append(
+                f"  {tag:<14}{fmt(cq_reg.get(tag), digits=0):>9}"
+                f"{fmt(cq_match.get(tag), digits=0):>10}"
+                f"{fmt(mrate, digits=1):>9}"
+                f"{fmt(cq_evals.get(tag), digits=0):>11}"
+                f"{fmt(cq_lag.get(tag), ' s', digits=2):>8}"
+                f"{fmt(cq_idx.get(tag), digits=0):>8}")
+        lines.append(f"  cq total registered "
+                     f"{fmt(sum(cq_reg.values()), digits=0)} across "
+                     f"{len(cq_tags)} member(s)")
     if health is not None:
         status = health.get("status", "?")
         bad = [k for k, c in health.get("checks", {}).items()
