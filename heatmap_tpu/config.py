@@ -168,6 +168,40 @@ class Config:
                                        # comment-ping cadence keeping
                                        # idle connections (and their
                                        # proxies) alive
+    sse_queue: int = 64                # HEATMAP_SSE_QUEUE: bounded
+                                       # per-subscriber send-queue
+                                       # depth (frames) on the
+                                       # coalesced SSE fan-out; a
+                                       # subscriber whose queue
+                                       # overflows is shed with
+                                       # `event: lagged` instead of
+                                       # wedging the shared broadcast
+    sse_send_timeout_s: float = 30.0   # HEATMAP_SSE_SEND_TIMEOUT_S:
+                                       # socket send timeout on SSE
+                                       # connections — a subscriber
+                                       # that stops reading the socket
+                                       # is disconnected (and its
+                                       # admission slot released)
+                                       # after this long instead of
+                                       # parking the writer thread
+                                       # forever; 0 disables
+    serve_max_inflight: int = 256      # HEATMAP_SERVE_MAX_INFLIGHT:
+                                       # bounded in-flight render/
+                                       # encode concurrency on the
+                                       # data endpoints; past it
+                                       # requests shed with 503 +
+                                       # Retry-After (counted in
+                                       # heatmap_serve_shed_total) so
+                                       # overload degrades predictably.
+                                       # 0 disables admission control.
+    serve_workers: int = 1             # HEATMAP_SERVE_WORKERS: serve
+                                       # worker processes `python -m
+                                       # heatmap_tpu.serve` forks, each
+                                       # binding the same port via
+                                       # SO_REUSEPORT, running its own
+                                       # replica follower, and
+                                       # publishing its own fleet
+                                       # member snapshot
     shards: int = 1                    # HEATMAP_SHARDS: total runtime
                                        # shard processes partitioning
                                        # the event stream by H3 parent
@@ -412,6 +446,13 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
                              Config.sse_max_clients),
         sse_heartbeat_s=_float(e, "HEATMAP_SSE_HEARTBEAT_S",
                                Config.sse_heartbeat_s),
+        sse_queue=_int(e, "HEATMAP_SSE_QUEUE", Config.sse_queue),
+        sse_send_timeout_s=_float(e, "HEATMAP_SSE_SEND_TIMEOUT_S",
+                                  Config.sse_send_timeout_s),
+        serve_max_inflight=_int(e, "HEATMAP_SERVE_MAX_INFLIGHT",
+                                Config.serve_max_inflight),
+        serve_workers=_int(e, "HEATMAP_SERVE_WORKERS",
+                           Config.serve_workers),
         repl_dir=e.get("HEATMAP_REPL_DIR", Config.repl_dir),
         repl_feed=e.get("HEATMAP_REPL_FEED", Config.repl_feed),
         repl_seg_bytes=_int(e, "HEATMAP_REPL_SEG_BYTES",
@@ -496,6 +537,21 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         raise ValueError(
             f"HEATMAP_SSE_HEARTBEAT_S must be > 0, "
             f"got {cfg.sse_heartbeat_s}")
+    if cfg.sse_queue < 1:
+        raise ValueError(
+            f"HEATMAP_SSE_QUEUE must be >= 1, got {cfg.sse_queue}")
+    if cfg.sse_send_timeout_s < 0:
+        raise ValueError(
+            f"HEATMAP_SSE_SEND_TIMEOUT_S must be >= 0 (0 = no "
+            f"timeout), got {cfg.sse_send_timeout_s}")
+    if cfg.serve_max_inflight < 0:
+        raise ValueError(
+            f"HEATMAP_SERVE_MAX_INFLIGHT must be >= 0 (0 = "
+            f"unbounded), got {cfg.serve_max_inflight}")
+    if cfg.serve_workers < 1:
+        raise ValueError(
+            f"HEATMAP_SERVE_WORKERS must be >= 1, "
+            f"got {cfg.serve_workers}")
     if cfg.repl_seg_bytes < 4096:
         raise ValueError(
             f"HEATMAP_REPL_SEG_BYTES must be >= 4096, "

@@ -103,6 +103,18 @@ def _load():
             u8p, ctypes.c_int64,
             i64p, ctypes.POINTER(ctypes.c_int64),
         ]
+        lib.enc_wire_cols.restype = ctypes.c_int64
+        lib.enc_wire_cols.argtypes = [
+            u8p, ctypes.c_int64,
+            i64p, i64p,
+            ctypes.c_int32, i64p,
+            ctypes.c_int32, i64p, ctypes.c_int64,
+            ctypes.c_int32, i64p, ctypes.c_int64,
+            i64p, ctypes.c_int64,
+            i64p, ctypes.c_int64,
+            u8p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
         lib.kc_crc32c.restype = ctypes.c_uint32
         lib.kc_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                   ctypes.c_uint32]
@@ -449,6 +461,63 @@ class NativeTileOps:
         out, n = _encode_with_resize(
             call, n_rows * self._DOC_BOUND + 1024, "tile")
         return out[:int(nbytes.value)].tobytes(), offsets[:n].copy(), n
+
+
+class NativeWireOps:
+    """Binary wire-frame column writer (tile_ops.cpp enc_wire_cols) —
+    the serve tier's compact tile/delta frame body.  The caller
+    (serve/wire.py) assembles the header and makes the per-column
+    fixed-point-vs-f64 decision; this writes the varint/zigzag/raw
+    columns at memory speed, byte-identical to the pure-Python writer
+    (differential-tested in tests/test_wire.py)."""
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native wire encoder unavailable: "
+                               f"{_LIB_ERR}")
+        self._lib = lib
+
+    @staticmethod
+    def available() -> bool:
+        return _load() is not None
+
+    def encode_body(self, flags, deltas, counts, s_enc, speeds,
+                    p_enc, p95, d_enc, stddev, wmin,
+                    overrides) -> bytes:
+        n = len(flags)
+        nbytes = ctypes.c_int64(0)
+
+        def call(out, cap):
+            return self._lib.enc_wire_cols(
+                flags, n, deltas, counts,
+                s_enc, speeds,
+                p_enc, p95, len(p95),
+                d_enc, stddev, len(stddev),
+                wmin, len(wmin),
+                overrides, len(overrides),
+                out, cap, ctypes.byref(nbytes))
+
+        # worst case per doc: flag 1B + delta/count varints ≤ 20B +
+        # f64 speed 8B (+ subset columns sized separately)
+        cap = (n * 32 + 8 * (len(p95) + len(stddev) + len(overrides))
+               + 10 * len(wmin) + 64)
+        out, rc = _encode_with_resize(call, cap, "wire")
+        if rc < 0:  # pragma: no cover - resize retried once already
+            raise RuntimeError("native wire encode overflow")
+        return out[:int(nbytes.value)].tobytes()
+
+
+def maybe_wire_ops(logger=None) -> "NativeWireOps | None":
+    """A NativeWireOps when the toolchain allows, else None (callers
+    fall back to the pure-Python column writer)."""
+    try:
+        if NativeWireOps.available():
+            return NativeWireOps()
+    except Exception as e:  # pragma: no cover - toolchain-dependent
+        if logger is not None:
+            logger.info("native wire encoder unavailable (%s)", e)
+    return None
 
 
 def maybe_tile_ops(logger=None) -> "NativeTileOps | None":

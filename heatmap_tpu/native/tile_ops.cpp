@@ -223,3 +223,73 @@ int64_t enc_tile_ops(
 }
 
 }  // extern "C"
+
+// ---- binary wire-frame column writer (serve/wire.py fast path) ----------
+//
+// The serve tier's compact tile/delta frame: the header is assembled in
+// Python (a few dozen bytes); this writes the column section — per-doc
+// flag bytes, zigzag-varint cell-id deltas, varint counts, the three
+// float columns (raw f64 bits or x100 fixed-point zigzag varints — the
+// ENCODING DECISION is made in Python by the same helper the pure-Python
+// writer uses, so both bodies are byte-identical by construction), varint
+// windowMinutes, and raw i64 per-doc window overrides.  Float columns
+// arrive as int64 arrays either way: f64 BITS for enc 0 (memcpy'd
+// little-endian, exactly what struct.pack("<d") emits), scaled ints for
+// enc 1.  Returns 0 and sets *bytes_out, or -needed_bytes on overflow
+// (same resize convention as enc_tile_ops).
+
+namespace {
+
+inline void put_varint(Buf& b, uint64_t u) {
+    while (true) {
+        uint8_t x = (uint8_t)(u & 0x7F);
+        u >>= 7;
+        if (u) b.u8(x | 0x80);
+        else { b.u8(x); return; }
+    }
+}
+
+inline uint64_t zigzag64(int64_t v) {
+    return ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);
+}
+
+inline void put_float_col(Buf& b, int32_t enc, const int64_t* vals,
+                          int64_t n) {
+    b.u8((uint8_t)enc);
+    if (enc == 0) {
+        b.raw(vals, 8 * n);  // little-endian f64 bits
+    } else {
+        for (int64_t i = 0; i < n; i++) put_varint(b, zigzag64(vals[i]));
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t enc_wire_cols(
+    const uint8_t* flags, int64_t n,
+    const int64_t* deltas,
+    const int64_t* counts,
+    int32_t s_enc, const int64_t* speeds,
+    int32_t p_enc, const int64_t* p95, int64_t n_p95,
+    int32_t d_enc, const int64_t* stddev, int64_t n_std,
+    const int64_t* wmin, int64_t n_wmin,
+    const int64_t* overrides, int64_t n_ovr_vals,
+    uint8_t* out, int64_t cap, int64_t* bytes_out) {
+    Buf b{out, cap};
+    b.raw(flags, n);
+    for (int64_t i = 0; i < n; i++) put_varint(b, zigzag64(deltas[i]));
+    for (int64_t i = 0; i < n; i++) put_varint(b, (uint64_t)counts[i]);
+    put_float_col(b, s_enc, speeds, n);
+    put_float_col(b, p_enc, p95, n_p95);
+    put_float_col(b, d_enc, stddev, n_std);
+    for (int64_t i = 0; i < n_wmin; i++)
+        put_varint(b, (uint64_t)wmin[i]);
+    b.raw(overrides, 8 * n_ovr_vals);
+    *bytes_out = b.len;
+    if (b.overflow) return -b.len;
+    return 0;
+}
+
+}  // extern "C"

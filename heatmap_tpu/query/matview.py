@@ -647,22 +647,35 @@ class TileMatView:
         Reading them separately would let a concurrent writer apply
         land between the two, pairing a stale strong ETag with newer
         content (one ETag must never name two representations)."""
+        etag, ws_dt, docs, _seq = self.snapshot_seq(grid, res)
+        return etag, ws_dt, docs
+
+    def snapshot_seq(self, grid: str,
+                     res: int | None = None) -> tuple:
+        """(etag, window_start, docs, view_seq) under ONE lock
+        acquisition — the binary wire frame stamps the view seq into
+        every /latest response (the same seq a delta client would feed
+        back as ``since=``), so it must be captured atomically with
+        the ETag and docs it describes."""
         with self._lock:
             g = self._grids.get(grid)
             if g is None:
                 self._check_res(None, grid, res)
-                return f'"{self._nonce}.{grid}.{res}.none.0"', None, []
+                return (f'"{self._nonce}.{grid}.{res}.none.0"', None,
+                        [], self._seq)
             self._evict(grid, g)
             ws = g.latest_ws()
             self._check_res(g, grid, res)
             etag = (f'"{self._nonce}.{grid}.{res}.'
                     f'{ws}.{g.mod_seq}"')
             if ws is None:
-                return etag, None, []
+                return etag, None, [], self._seq
             ws_dt, we_dt, _ = g.meta[ws]
             if res is None or res == _grid_base_res(grid):
-                return etag, ws_dt, list(g.windows[ws].values())
-            return etag, ws_dt, g.pyramid.docs(res, ws, we_dt, ws_dt)
+                return (etag, ws_dt, list(g.windows[ws].values()),
+                        self._seq)
+            return (etag, ws_dt, g.pyramid.docs(res, ws, we_dt, ws_dt),
+                    self._seq)
 
     def _check_res(self, g: _Grid | None, grid: str,
                    res: int | None) -> None:

@@ -566,6 +566,25 @@ def _parse_bbox(params: dict) -> tuple[tuple | None, str | None]:
     return (lo_lon, lo_lat, hi_lon, hi_lat), None
 
 
+def _negotiate_fmt(environ: dict, params: dict) -> tuple:
+    """Negotiated tile wire format: ``?fmt=bin|json`` wins, else an
+    ``Accept`` header naming the binary media type, else the default
+    JSON path (kept byte-identical — negotiation must never perturb a
+    legacy client).  Returns (fmt, None) or (None, error)."""
+    from heatmap_tpu.serve import wire
+
+    raw = params.get("fmt")
+    if raw is not None:
+        if raw in ("bin", "binary"):
+            return "bin", None
+        if raw == "json":
+            return "json", None
+        return None, f"fmt= must be bin or json, got {raw[:32]!r}"
+    if wire.CONTENT_TYPE in environ.get("HTTP_ACCEPT", ""):
+        return "bin", None
+    return "json", None
+
+
 def _inm_match(environ: dict, etag: str) -> bool:
     """If-None-Match vs a strong ETag (RFC 9110 §13.1.2: weak
     comparison is allowed for If-None-Match, so W/-prefixed client
@@ -649,6 +668,40 @@ class _ServeStats:
         self.sse_clients = reg.gauge(
             "heatmap_serve_sse_clients",
             "open /api/tiles/stream SSE connections")
+        # ---- serve-tier wire path (ISSUE 14) -------------------------
+        self.wire_format = reg.counter(
+            "heatmap_serve_wire_format_total",
+            "responses per negotiated wire format (?fmt=/Accept): the "
+            "compact binary tile frame vs the default GeoJSON path",
+            labels=("endpoint", "fmt"))
+        self.shed = reg.counter(
+            "heatmap_serve_shed_total",
+            "requests answered 503 + Retry-After by admission control "
+            "(HEATMAP_SERVE_MAX_INFLIGHT in-flight renders exceeded) — "
+            "overload degrading predictably instead of collapsing p99",
+            labels=("endpoint",))
+        self.inflight = reg.gauge(
+            "heatmap_serve_inflight",
+            "render/encode requests currently in flight on the "
+            "admission-controlled endpoints (the queue depth admission "
+            "control bounds)")
+        self.sse_encodes = reg.counter(
+            "heatmap_sse_encodes_total",
+            "coalesced SSE frame encodes — one per view seq advance "
+            "per (grid, format) CHANNEL, fanned to every subscriber, "
+            "so the count is O(grids x formats), never O(clients)",
+            labels=("fmt",))
+        self.sse_lagged = reg.counter(
+            "heatmap_sse_lagged_total",
+            "SSE subscribers shed with `event: lagged` because their "
+            "bounded send queue (HEATMAP_SSE_QUEUE) overflowed — a "
+            "slow reader disconnected cleanly instead of wedging the "
+            "shared fan-out")
+        self.sse_queue_hw = reg.gauge(
+            "heatmap_sse_queue_highwater",
+            "high-water mark of any SSE subscriber's bounded send "
+            "queue (frames) since boot — how close the slowest healthy "
+            "reader has come to being shed")
 
 
 class _SSEBody:
@@ -683,6 +736,17 @@ def _delta_body(d: dict, grid: str) -> str:
                        "windowStart": _iso(ws) if ws is not None else None})
     return (head[:-1] + ', "features": ['
             + ", ".join(_feature_json(doc) for doc in d["docs"]) + ']}')
+
+
+# endpoints under admission control (HEATMAP_SERVE_MAX_INFLIGHT): the
+# data-plane render/encode paths whose concurrency must stay bounded;
+# the operator surface is deliberately absent
+_ADMIT_PATHS = {
+    "/api/tiles/latest": "tiles",
+    "/api/tiles/delta": "delta",
+    "/api/tiles/topk": "topk",
+    "/api/positions/latest": "positions",
+}
 
 
 def make_wsgi_app(store: Store, cfg=None, runtime=None):
@@ -806,6 +870,27 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
     sse_max = getattr(cfg, "sse_max_clients", 64) if cfg else 64
     sse_heartbeat = getattr(cfg, "sse_heartbeat_s", 15.0) if cfg else 15.0
     sse_admit_lock = threading.Lock()
+    # ---- serve-tier wire path (ISSUE 14) ------------------------------
+    # Binary tile/delta frames (serve/wire.py) negotiated via ?fmt=/
+    # Accept, encoded through the native column writer when the
+    # toolchain allows; coalesced SSE fan-out (one encode per view seq
+    # advance per (grid, format) channel, fanned to bounded per-client
+    # queues); bounded in-flight render admission.
+    from heatmap_tpu.serve import wire as wiremod
+
+    from heatmap_tpu.native import maybe_wire_ops
+
+    wire_ops = maybe_wire_ops(log)
+    sse_queue = getattr(cfg, "sse_queue", 64) if cfg else 64
+    sse_send_timeout = (getattr(cfg, "sse_send_timeout_s", 30.0)
+                        if cfg else 30.0)
+    fanout = wiremod.FanoutHub(depth=sse_queue,
+                               on_lagged=stats.sse_lagged.inc,
+                               hw_gauge=stats.sse_queue_hw)
+    max_inflight = (getattr(cfg, "serve_max_inflight", 256)
+                    if cfg else 256)
+    admit_sem = (threading.BoundedSemaphore(max_inflight)
+                 if max_inflight > 0 else None)
     # Render cache for the two data endpoints: rendering + gzipping a
     # city-scale FeatureCollection costs ~0.5 s of the one host core
     # PER REQUEST (measured: 6.4k tiles -> 3.7 MB body,
@@ -862,11 +947,16 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
 
     def _view_cached(key, etag, build, endpoint):
         """ETag-keyed render cache for view-backed bodies: exact (the
-        ETag changes with the view), so entries need no TTL."""
+        ETag changes with the view), so entries need no TTL.  Builders
+        may return str (JSON) or bytes (binary wire frames) — the key
+        carries the format, so one ETag never caches two
+        representations."""
         hit = view_cache.get(key)
         if hit is not None and hit[0] == etag:
             return hit[1], hit[2]
-        data = build().encode("utf-8")
+        data = build()
+        if not isinstance(data, bytes):
+            data = data.encode("utf-8")
         _account_render(endpoint, data)
         gz = gzip.compress(data, compresslevel=1) if len(data) >= 1024 \
             else None
@@ -989,9 +1079,128 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 seeded.add(grid)
         return view
 
+    def _store_poll_tick(grid) -> bool:
+        """One store-fed refresh tick shared by the fan-out pumps:
+        True when this worker is store-polling (nothing else advances
+        the view), with the demoted-fallback accounting the replica
+        topology requires."""
+        store_polling = (refresher is not None
+                         and (follower is None or not follower.synced))
+        if store_polling:
+            if follower is not None \
+                    and follower.c_fallback is not None:
+                follower.c_fallback.inc()
+            refresher.refresh(grid)
+        return store_polling
+
+    def _sse_tiles_frame(d: dict, grid: str, fmt: str) -> bytes:
+        """One encoded SSE frame for a delta payload — the shared
+        buffer the fan-out writes to every subscriber socket.  Binary
+        frames ride base64 under ``event: tiles-bin`` (SSE is a text
+        protocol); docs the compact layout cannot represent exactly
+        fall back to the JSON event, which clients listening on both
+        event names handle transparently."""
+        if fmt == "bin":
+            import base64
+
+            try:
+                frame = wiremod.encode(d["mode"], d["seq"], grid,
+                                       d["window_start"], d["docs"],
+                                       native=wire_ops)
+            except ValueError:
+                log.warning("binary SSE frame unrepresentable; "
+                            "falling back to JSON", exc_info=True)
+            else:
+                return (b"event: tiles-bin\ndata: "
+                        + base64.b64encode(frame) + b"\n\n")
+        body = _delta_body(d, grid)
+        return (f"event: tiles\ndata: {body}\n\n").encode("utf-8")
+
+    def _tiles_pump(grid: str, fmt: str, start_seq: int):
+        """The coalesced broadcaster for one (grid, format) channel:
+        encodes each view seq advance EXACTLY ONCE and fans the bytes
+        to every subscriber queue — per-client work is queue appends,
+        never re-encodes, so the encode rate is O(grids x formats).
+        ``start_seq`` is captured in the REQUEST thread before the
+        subscribe: reading view.seq here instead would let an advance
+        landing between the first subscriber's catch-up and this
+        thread's first instruction go broadcast to nobody."""
+        def pump(chan):
+            last = start_seq
+            while True:
+                if chan.try_retire():
+                    return
+                store_polling = _store_poll_tick(grid)
+                if view.poisoned:
+                    chan.finish(b"event: gone\ndata: {}\n\n")
+                    return
+                if view.changed_since(grid, last):
+                    d = view.delta(grid, last)
+                    stats.delta_cells.observe(len(d["docs"]))
+                    frame = _sse_tiles_frame(d, grid, fmt)
+                    stats.sse_encodes.labels(fmt=fmt).inc()
+                    last = d["seq"]
+                    chan.broadcast(frame)
+                    continue
+                # store-polling pumps must keep POLLING (nothing else
+                # advances the view), so their wait slices shorter
+                # (heartbeat-bounded, like the pre-fanout per-client
+                # loops); follower/writer-fed pumps wait event-driven
+                # on the view condvar.  The 1 s ceiling also bounds
+                # how long a subscriber-less pump lingers.
+                wait_s = (min(1.0, sse_heartbeat) if store_polling
+                          else 1.0)
+                view.wait_changed(grid, last, timeout=wait_s)
+        return pump
+
+    def _sse_generator(sub, first_frames):
+        """One subscriber's generator: drains its bounded queue,
+        heartbeats through quiet periods, and turns the LAGGED
+        sentinel into ``event: lagged`` + a clean end-of-stream."""
+        def events():
+            yield b"retry: 3000\n\n"
+            for f in first_frames:
+                yield f
+            last_beat = time.monotonic()
+            while True:
+                item = sub.pop(timeout=max(0.05,
+                                           min(1.0, sse_heartbeat)))
+                if item is None:
+                    if time.monotonic() - last_beat >= sse_heartbeat:
+                        yield b": hb\n\n"
+                        last_beat = time.monotonic()
+                    continue
+                if item is wiremod.LAGGED:
+                    # the bounded send queue overflowed: this reader
+                    # is too slow for the stream — shed it cleanly
+                    # rather than let its back-pressure wedge the
+                    # shared fan-out (it reconnects and resyncs)
+                    yield b"event: lagged\ndata: {}\n\n"
+                    return
+                if item is wiremod.CLOSED:
+                    return
+                yield item
+                last_beat = time.monotonic()
+        return events()
+
+    def _arm_sse_socket(environ) -> None:
+        """Bound the time a blocking SSE write may stall on a client
+        that stopped reading (HEATMAP_SSE_SEND_TIMEOUT_S): the lag
+        sentinel sheds a slow-but-draining reader, but a reader that
+        stops draining the SOCKET parks the writer thread in send() —
+        the timeout unsticks it so the admission slot is released."""
+        sock = environ.get("heatmap.socket")
+        if sock is not None and sse_send_timeout > 0:
+            try:
+                sock.settimeout(sse_send_timeout)
+            except OSError:
+                pass
+
     def _sse_response(environ, start_response):
         params = _qs_params(environ.get("QUERY_STRING", ""))
         grid, err = _parse_grid(params, default_grid)
+        if err is None:
+            fmt, err = _negotiate_fmt(environ, params)
         if err:
             start_response("400 Bad Request",
                            [("Content-Type", "application/json")])
@@ -1012,56 +1221,33 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                                [("Content-Type", "application/json")])
                 return [b'{"error": "sse client limit reached"}']
             stats.sse_clients.inc(1)
+        _arm_sse_socket(environ)
         start_response("200 OK", [
             ("Content-Type", "text/event-stream"),
             ("Cache-Control", "no-cache"),
             ("X-Accel-Buffering", "no"),
         ])
+        stats.wire_format.labels(endpoint="stream", fmt=fmt).inc()
+        # anchor a would-be-new channel BEFORE subscribing, subscribe,
+        # THEN build the per-client catch-up frame: broadcasts cover
+        # (start_seq, ...], the catch-up covers (since, now>=start_seq]
+        # — overlap is idempotent (delta upserts), a gap is not, and
+        # this order can never gap
+        start_seq = view.seq
+        chan, sub = fanout.subscribe(("tiles", grid, fmt),
+                                     _tiles_pump(grid, fmt, start_seq))
+        d = view.delta(grid, since)
+        stats.delta_cells.observe(len(d["docs"]))
+        first = [_sse_tiles_frame(d, grid, fmt)]
 
-        def events():
-            yield b"retry: 3000\n\n"
-            last = since
-            first = True
-            last_beat = time.monotonic()
-            while True:
-                store_polling = (refresher is not None
-                                 and (follower is None
-                                      or not follower.synced))
-                if store_polling:
-                    if follower is not None \
-                            and follower.c_fallback is not None:
-                        follower.c_fallback.inc()
-                    refresher.refresh(grid)
-                if view.poisoned:
-                    yield b"event: gone\ndata: {}\n\n"
-                    return
-                if first or view.changed_since(grid, last):
-                    d = view.delta(grid, last)
-                    stats.delta_cells.observe(len(d["docs"]))
-                    body = _delta_body(d, grid)
-                    yield (f"event: tiles\ndata: {body}\n\n"
-                           ).encode("utf-8")
-                    last = d["seq"]
-                    first = False
-                    last_beat = time.monotonic()
-                    continue
-                # store-polling loops must keep POLLING (nothing else
-                # advances the view), so their wait slices shorter than
-                # the heartbeat; a replica's follower notifies the
-                # view's condvar, so it waits event-driven like the
-                # writer-fed case
-                wait_s = (1.0 if store_polling else sse_heartbeat)
-                view.wait_changed(grid, last,
-                                  timeout=min(wait_s, sse_heartbeat))
-                if time.monotonic() - last_beat >= sse_heartbeat:
-                    yield b": hb\n\n"
-                    last_beat = time.monotonic()
+        def on_close():
+            fanout.unsubscribe(chan, sub)
+            stats.sse_clients.inc(-1)
 
         # the admission slot is released in _SSEBody.close(), which the
         # WSGI server guarantees to call — a bare generator's finally
         # would never run if iteration never starts
-        return _SSEBody(events(),
-                        lambda: stats.sse_clients.inc(-1))
+        return _SSEBody(_sse_generator(sub, first), on_close)
 
     def _cq_sse_response(environ, start_response):
         """/api/queries/stream?id=&since= — one standing query's
@@ -1089,66 +1275,70 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                                [("Content-Type", "application/json")])
                 return [b'{"error": "sse client limit reached"}']
             stats.sse_clients.inc(1)
+        _arm_sse_socket(environ)
         start_response("200 OK", [
             ("Content-Type", "text/event-stream"),
             ("Cache-Control", "no-cache"),
             ("X-Accel-Buffering", "no"),
         ])
 
-        def events():
-            yield b"retry: 3000\n\n"
-            last = since
-            last_beat = time.monotonic()
+        def _cq_frames(evs) -> bytes:
+            return b"".join(
+                (f"id: {ev['id']}\nevent: match\n"
+                 f"data: {json.dumps(ev)}\n\n").encode("utf-8")
+                for ev in evs)
+
+        # anchor the would-be-new channel's cursor in THIS thread (the
+        # same no-gap ordering as the tiles stream): events after
+        # start_id broadcast, the per-client resume frame covers up to
+        # at-least start_id
+        _evs0 = cq_engine.events_since(qid, 0)
+        start_id = _evs0[-1]["id"] if _evs0 else 0
+
+        def pump(chan):
+            # the PR 13 query stream rides the same coalesced fan-out:
+            # N subscribers on one standing query share ONE encode per
+            # new match batch instead of N json.dumps passes
+            last = start_id
             while True:
-                # store-fed views only advance when something polls the
-                # refresher (a replica's follower advances it for us)
-                store_polling = (refresher is not None
-                                 and (follower is None
-                                      or not follower.synced))
+                if chan.try_retire():
+                    return
+                store_polling = _store_poll_tick(grid)
                 if store_polling:
-                    if follower is not None \
-                            and follower.c_fallback is not None:
-                        follower.c_fallback.inc()
-                    refresher.refresh(grid)
                     cq_engine.drain()
                 evs = cq_engine.events_since(qid, last)
                 if evs:
-                    for ev in evs:
-                        yield (f"id: {ev['id']}\nevent: match\n"
-                               f"data: {json.dumps(ev)}\n\n"
-                               ).encode("utf-8")
+                    frame = _cq_frames(evs)
+                    stats.sse_encodes.labels(fmt="cq").inc()
                     last = evs[-1]["id"]
-                    last_beat = time.monotonic()
+                    chan.broadcast(frame)
                     continue
                 if cq_engine.get(qid) is None:
                     # expired (TTL) or deleted: tell the client not to
                     # reconnect into a 404 loop
-                    yield b"event: gone\ndata: {}\n\n"
+                    chan.finish(b"event: gone\ndata: {}\n\n")
                     return
-                wait_s = (1.0 if store_polling else sse_heartbeat)
-                cq_engine.wait_events(qid, last,
-                                      timeout=min(wait_s, sse_heartbeat))
-                if time.monotonic() - last_beat >= sse_heartbeat:
-                    # comment heartbeat: keeps match-quiet streams open
-                    # through proxies without waking the client parser
-                    yield b": hb\n\n"
-                    last_beat = time.monotonic()
+                wait_s = (min(1.0, sse_heartbeat) if store_polling
+                          else 1.0)
+                cq_engine.wait_events(qid, last, timeout=wait_s)
 
-        return _SSEBody(events(),
-                        lambda: stats.sse_clients.inc(-1))
+        # subscribe first, then the per-client resume frame (same
+        # no-gap ordering as the tiles stream; `id:` lines make the
+        # possible overlap visible to resuming clients)
+        chan, sub = fanout.subscribe(("cq", qid), pump)
+        first = []
+        evs = cq_engine.events_since(qid, since)
+        if evs:
+            first.append(_cq_frames(evs))
 
-    def app(environ, start_response):
+        def on_close():
+            fanout.unsubscribe(chan, sub)
+            stats.sse_clients.inc(-1)
+
+        return _SSEBody(_sse_generator(sub, first), on_close)
+
+    def _handle(environ, start_response):
         path = environ.get("PATH_INFO", "/")
-        if path in ("/api/tiles/stream", "/api/queries/stream"):
-            try:
-                if path == "/api/queries/stream":
-                    return _cq_sse_response(environ, start_response)
-                return _sse_response(environ, start_response)
-            except Exception:
-                log.exception("request failed: %s", path)
-                start_response("500 Internal Server Error",
-                               [("Content-Type", "application/json")])
-                return [b'{"error": "internal"}']
         pre_gz = None
         data = None
         status = "200 OK"
@@ -1165,15 +1355,17 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                            [("Content-Type", "application/json")])
             return [json.dumps({"error": msg}).encode()]
 
-        def _not_modified(etag, ep):
+        def _not_modified(etag, ep, vary_accept=False):
             stats.http_304.labels(endpoint=ep).inc()
             if ep in ("tiles", "delta") and runtime is not None:
                 # what the client sees is (still) the current view —
                 # the freshness gauge must keep tracking even when no
                 # bytes move
                 _sample_serve_freshness(runtime)
+            vary = ("Accept-Encoding, Accept" if vary_accept
+                    else "Accept-Encoding")
             start_response("304 Not Modified",
-                           [("ETag", etag), ("Vary", "Accept-Encoding")])
+                           [("ETag", etag), ("Vary", vary)])
             return []
 
         try:
@@ -1189,57 +1381,120 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 res, err = _parse_res(params)
                 if err:
                     return _bad_request(err)
+                fmt, err = _negotiate_fmt(environ, params)
+                if err:
+                    return _bad_request(err)
+                # the representation depends on Accept (binary
+                # negotiation), so EVERY response — JSON 200s and 304s
+                # included — must say so, or a shared cache could
+                # replay the wrong representation (RFC 9110 §12.5.5)
+                extra_headers.append(("Vary", "Accept"))
+                ctype = "application/json"
                 v = _tiles_view(grid)
                 if v is not None:
-                    # etag + docs captured atomically: a writer apply
-                    # landing between them would label newer content
-                    # with a stale strong ETag
+                    # etag + docs + seq captured atomically: a writer
+                    # apply landing between them would label newer
+                    # content with a stale strong ETag (or stamp a
+                    # foreign seq into the binary frame)
                     try:
-                        etag, _ws, docs = v.snapshot(grid, res)
+                        etag0, _ws, docs, vseq = v.snapshot_seq(grid,
+                                                                res)
                     except KeyError:
                         return _bad_request(
                             f"res={res} is not maintained for grid "
                             f"{grid!r} (HEATMAP_PYRAMID_LEVELS)")
+                    # format-keyed strong ETag: the binary and JSON
+                    # representations of one view state must never
+                    # share an ETag, so a JSON If-None-Match against a
+                    # binary request re-renders instead of 304ing
+                    etag = wiremod.format_etag(etag0, fmt)
                     if _inm_match(environ, etag):
-                        return _not_modified(etag, endpoint)
-                    data, pre_gz = _view_cached(
-                        (grid, res), etag,
-                        lambda: _features_collection_json(docs),
-                        endpoint)
+                        stats.wire_format.labels(endpoint=endpoint,
+                                                 fmt=fmt).inc()
+                        return _not_modified(etag, endpoint,
+                                             vary_accept=True)
+                    if fmt == "bin":
+                        try:
+                            data, pre_gz = _view_cached(
+                                (grid, res, "bin"), etag,
+                                lambda: wiremod.encode(
+                                    "full", vseq, grid, _ws, docs,
+                                    native=wire_ops),
+                                endpoint)
+                            ctype = wiremod.CONTENT_TYPE
+                        except ValueError:
+                            # a doc the compact layout cannot encode
+                            # exactly: serve the JSON representation
+                            # (with ITS ETag) rather than bytes that
+                            # would decode differently
+                            log.warning("binary tiles frame "
+                                        "unrepresentable; serving "
+                                        "JSON", exc_info=True)
+                            fmt = "json"
+                            etag = etag0
+                    if fmt == "json":
+                        data, pre_gz = _view_cached(
+                            (grid, res), etag,
+                            lambda: _features_collection_json(docs),
+                            endpoint)
                     extra_headers.append(("ETag", etag))
                 else:
                     if res is not None:
                         return _unavailable(
                             "res= rollups need the query view "
                             "(HEATMAP_QUERY_VIEW=1)")
+                    if fmt == "bin":
+                        return _unavailable(
+                            "binary tiles need the query view "
+                            "(HEATMAP_QUERY_VIEW=1)")
                     data, pre_gz = _cached_json(
                         ("tiles", grid),
                         lambda: tiles_feature_collection_json(store, grid),
                         endpoint)
+                stats.wire_format.labels(endpoint=endpoint,
+                                         fmt=fmt).inc()
                 if runtime is not None:
                     _sample_serve_freshness(runtime)
-                ctype = "application/json"
             elif path == "/api/tiles/delta":
                 endpoint = "delta"
                 params = _qs_params(environ.get("QUERY_STRING", ""))
                 grid, err = _parse_grid(params, default_grid)
                 if err:
                     return _bad_request(err)
+                fmt, err = _negotiate_fmt(environ, params)
+                if err:
+                    return _bad_request(err)
                 since = _qs_int(params, "since", 0, 1 << 62)
+                extra_headers.append(("Vary", "Accept"))
                 v = _tiles_view(grid)
                 if v is None:
                     return _unavailable(
                         "delta needs the query view (HEATMAP_QUERY_VIEW=1)")
                 d = v.delta(grid, since)
                 stats.delta_cells.observe(len(d["docs"]))
-                body = _delta_body(d, grid)
-                data = body.encode("utf-8")
+                ctype = "application/json"
+                if fmt == "bin":
+                    try:
+                        data = wiremod.encode(d["mode"], d["seq"],
+                                              grid, d["window_start"],
+                                              d["docs"],
+                                              native=wire_ops)
+                        ctype = wiremod.CONTENT_TYPE
+                    except ValueError:
+                        log.warning("binary delta frame "
+                                    "unrepresentable; serving JSON",
+                                    exc_info=True)
+                        fmt = "json"
+                if fmt == "json":
+                    body = _delta_body(d, grid)
+                    data = body.encode("utf-8")
                 _account_render(endpoint, data)
+                stats.wire_format.labels(endpoint=endpoint,
+                                         fmt=fmt).inc()
                 if runtime is not None:
                     # the delta-polling UI replaced /latest polls, so
                     # the ingest->serve freshness gauge samples here too
                     _sample_serve_freshness(runtime)
-                ctype = "application/json"
             elif path == "/api/tiles/topk":
                 endpoint = "topk"
                 params = _qs_params(environ.get("QUERY_STRING", ""))
@@ -1590,6 +1845,11 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                     store_grids = []
                 payload = {
                     "enabled": view is not None,
+                    # which worker process answered: the multi-process
+                    # serve fleet shares one SO_REUSEPORT port, so this
+                    # is how an operator (and the worker test) tells
+                    # the members apart over HTTP
+                    "pid": os.getpid(),
                     "mode": (None if view is None else
                              "replica" if follower is not None else
                              "writer-fed" if refresher is None else
@@ -1647,6 +1907,41 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
         start_response(status, headers)
         return [data]
 
+    def app(environ, start_response):
+        path = environ.get("PATH_INFO", "/")
+        if path in ("/api/tiles/stream", "/api/queries/stream"):
+            try:
+                if path == "/api/queries/stream":
+                    return _cq_sse_response(environ, start_response)
+                return _sse_response(environ, start_response)
+            except Exception:
+                log.exception("request failed: %s", path)
+                start_response("500 Internal Server Error",
+                               [("Content-Type", "application/json")])
+                return [b'{"error": "internal"}']
+        # admission control (HEATMAP_SERVE_MAX_INFLIGHT): bound the
+        # render/encode concurrency on the data endpoints so overload
+        # sheds predictably (503 + Retry-After, counted per endpoint)
+        # instead of stacking threads until p99 collapses.  SSE has
+        # its own cap; the operator surface (/metrics, /healthz,
+        # /fleet/*) is never shed — you must be able to observe an
+        # overloaded worker.
+        ep = _ADMIT_PATHS.get(path)
+        if admit_sem is None or ep is None:
+            return _handle(environ, start_response)
+        if not admit_sem.acquire(blocking=False):
+            stats.shed.labels(endpoint=ep).inc()
+            start_response("503 Service Unavailable",
+                           [("Content-Type", "application/json"),
+                            ("Retry-After", "1")])
+            return [b'{"error": "overloaded; retry shortly"}']
+        stats.inflight.inc(1)
+        try:
+            return _handle(environ, start_response)
+        finally:
+            stats.inflight.inc(-1)
+            admit_sem.release()
+
     # the serve-only fleet member publisher (ServeFleetMember) snapshots
     # this registry; with a runtime attached it is the runtime's own
     app.serve_registry = serve_reg
@@ -1697,18 +1992,54 @@ def _accepts_gzip(accept_encoding: str) -> bool:
 
 class _ThreadingWSGIServer(ThreadingMixIn, WSGIServer):
     daemon_threads = True
+    # wsgiref's default listen backlog is 5: under a polling fleet
+    # that opens a connection per request, an accept burst overflows
+    # it and the dropped SYNs come back 1s/3s later (kernel
+    # retransmit) — a latency cliff that reads as a server tail but is
+    # really queue overflow at the socket.  128 rides the kernel's
+    # somaxconn clamp.
+    request_queue_size = 128
+
+
+class _ReusePortWSGIServer(_ThreadingWSGIServer):
+    """SO_REUSEPORT bind: the multi-process serve fleet's workers each
+    bind the SAME port and the kernel balances incoming connections
+    across their accept queues — supervisor-style pre-fork without
+    handing sockets across fork boundaries."""
+
+    def server_bind(self):
+        import socket
+
+        try:
+            self.socket.setsockopt(socket.SOL_SOCKET,
+                                   socket.SO_REUSEPORT, 1)
+        except (AttributeError, OSError) as e:
+            log.warning("SO_REUSEPORT unavailable (%s); worker will "
+                        "bind exclusively", e)
+        super().server_bind()
 
 
 class _QuietHandler(WSGIRequestHandler):
     def log_message(self, fmt, *args):  # route access logs through logging
         log.debug("%s %s", self.address_string(), fmt % args)
 
+    def get_environ(self):
+        # expose the connection socket so the SSE path can arm a send
+        # timeout (HEATMAP_SSE_SEND_TIMEOUT_S): a subscriber that stops
+        # reading the SOCKET parks the writer thread in send() forever
+        # otherwise, leaking its admission slot
+        env = super().get_environ()
+        env["heatmap.socket"] = self.connection
+        return env
 
-def _make_http_server(store, cfg, runtime, host, port):
+
+def _make_http_server(store, cfg, runtime, host, port,
+                      reuse_port: bool = False):
     host = host or (getattr(cfg, "serve_host", None) or "127.0.0.1")
     port = port if port is not None else (getattr(cfg, "serve_port", None) or 5000)
     return make_server(host, port, make_wsgi_app(store, cfg, runtime),
-                       server_class=_ThreadingWSGIServer,
+                       server_class=(_ReusePortWSGIServer if reuse_port
+                                     else _ThreadingWSGIServer),
                        handler_class=_QuietHandler)
 
 
@@ -1816,8 +2147,10 @@ class ServeFleetMember:
 
 
 def serve_forever(store: Store, cfg=None, runtime=None,
-                  host: str | None = None, port: int | None = None):
-    httpd = _make_http_server(store, cfg, runtime, host, port)
+                  host: str | None = None, port: int | None = None,
+                  reuse_port: bool = False):
+    httpd = _make_http_server(store, cfg, runtime, host, port,
+                              reuse_port=reuse_port)
     # serve-only workers join the fleet observatory themselves; a
     # runtime-attached process already publishes on its step loop
     member = (ServeFleetMember.from_env(httpd.get_app())

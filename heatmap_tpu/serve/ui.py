@@ -7,10 +7,18 @@ batch p50) fed by /metrics.json, and a count/speed legend.
 
 Tile refresh rides the query tier: the UI polls ``/api/tiles/delta``
 with its last-seen view seq and upserts only the changed hexes (a
-mode="full" response replaces the set).  A delta failure falls back to
-a full ``/api/tiles/latest`` fetch for that tick; only a 404 (older
-server) or 503 (view disabled) latches full-fetch mode for the
-session — transient blips retry delta on the next tick.
+mode="full" response replaces the set).  It negotiates the BINARY
+columnar frame first (``?fmt=bin``, serve/wire.py — decoded with a
+DataView/BigInt parser, ~10x fewer wire bytes): binary deltas restyle
+known hexes in place (geometry is a pure function of the cellId and
+already on the map), while a resync or an unseen cell falls through to
+one full JSON fetch that restores geometry; any negotiation or decode
+trouble latches the session back to JSON automatically.  The HUD shows
+the negotiated format and the wire bytes the binary path saved.  A
+delta failure falls back to a full ``/api/tiles/latest`` fetch for
+that tick; only a 404 (older server) or 503 (view disabled) latches
+full-fetch mode for the session — transient blips retry delta on the
+next tick.
 
 Continuous queries ride along: registered geofence/range regions
 (``/api/queries``) draw as dashed outlines, and up to four of them get
@@ -122,6 +130,13 @@ let tickSeq = 0;
 // on grid switch (each grid's delta stream is independent)
 let tilesSince = 0;
 let deltaBroken = false;  // one failure -> full fetches for the session
+// binary wire negotiation: try the compact columnar frame first
+// (?fmt=bin, serve/wire.py); any decode/endpoint trouble latches the
+// session back to JSON — the automatic fallback
+let wireFmt = 'bin';
+let wireBytes = 0;      // wire bytes received on binary tile polls
+let wireSaved = 0;      // estimated JSON bytes the binary path avoided
+let jsonPerFeat = 600;  // learned from real full-JSON bodies
 
 function clearHexes() {
   hexes.clearLayers();
@@ -136,8 +151,118 @@ function applyFeatures(features) {
   }
 }
 
+// ---- binary wire frame decoder (serve/wire.py layout, DataView) ----
+function decodeWireFrame(buf) {
+  const dv = new DataView(buf);
+  const u8 = new Uint8Array(buf);
+  if (u8.length < 12 || u8[0] !== 0x48 || u8[1] !== 0x57 || u8[2] !== 1)
+    throw new Error('not a wire frame');
+  const flags = u8[3];
+  const seq = Number(dv.getBigUint64(4, true));
+  const glen = dv.getUint16(12, true);
+  let pos = 14 + glen;
+  if (flags & 2) pos += 16;  // window (ws_us, we_us) — unused by the map
+  function varint() {
+    let shift = 0n, v = 0n;
+    for (;;) {
+      const b = u8[pos++];
+      v |= BigInt(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7n;
+    }
+  }
+  const zz = u => (u >> 1n) ^ -(u & 1n);
+  const n = Number(varint());
+  const dflags = u8.subarray(pos, pos + n); pos += n;
+  const M = (1n << 64n) - 1n;
+  const cells = []; let prev = 0n;
+  for (let i = 0; i < n; i++) {
+    prev = (prev + zz(varint())) & M;
+    cells.push(prev.toString(16));
+  }
+  const counts = [];
+  for (let i = 0; i < n; i++) counts.push(Number(varint()));
+  function fcol(m) {  // one float column: raw f64 or x100 fixed-point
+    if (n === 0) return [];
+    const enc = u8[pos++]; const out = [];
+    if (enc === 0) {
+      for (let i = 0; i < m; i++) { out.push(dv.getFloat64(pos, true)); pos += 8; }
+    } else {
+      for (let i = 0; i < m; i++) out.push(Number(zz(varint())) / 100);
+    }
+    return out;
+  }
+  let np = 0, ns = 0;
+  for (const f of dflags) { if (f & 1) np++; if (f & 2) ns++; }
+  const speeds = fcol(n), p95 = fcol(np); fcol(ns);  // stddev unused
+  const feats = []; let ip = 0;
+  for (let i = 0; i < n; i++) {
+    const f = {cellId: cells[i], count: counts[i], avgSpeedKmh: speeds[i]};
+    if (dflags[i] & 1) f.p95SpeedKmh = p95[ip++];
+    feats.push(f);
+  }
+  return {mode: (flags & 1) ? 'full' : 'delta', seq: seq, features: feats};
+}
+
+function updateCellInPlace(layer, p) {
+  // geometry is a pure function of the cellId and already on the map:
+  // a binary delta only needs to restyle + re-describe the hex
+  layer.setStyle({fillColor: rampColor(p.count)});
+  let html = `<b>${esc(p.cellId)}</b><br/>count: ${Number(p.count)}` +
+             `<br/>avg speed: ${Number(p.avgSpeedKmh).toFixed(1)} km/h`;
+  if (p.p95SpeedKmh !== undefined)
+    html += `<br/>p95 speed: ${Number(p.p95SpeedKmh).toFixed(1)} km/h`;
+  layer.setPopupContent ? layer.setPopupContent(html) : layer.bindPopup(html);
+  if (layer.feature && layer.feature.properties)
+    Object.assign(layer.feature.properties, p);
+}
+
+async function fetchFullJson(gridQS) {
+  const r = await fetch('/api/tiles/latest' + (gridQS ? '?' + gridQS : ''));
+  const text = await r.text();
+  const tiles = JSON.parse(text);
+  if (tiles.features && tiles.features.length)
+    jsonPerFeat = text.length / tiles.features.length;
+  return tiles;
+}
+
 async function fetchTiles(gridQS) {
-  // delta path: changed hexes only, O(changed) per poll
+  // binary delta path first: columnar frame, ~10x fewer wire bytes;
+  // properties-only, so it can restyle KNOWN hexes in place — a full
+  // resync or an unseen cell (its geometry isn't on the map yet)
+  // falls through to one full JSON fetch, which also re-teaches the
+  // bytes-saved estimate
+  if (!deltaBroken && wireFmt === 'bin') {
+    try {
+      const r = await fetch(`/api/tiles/delta?since=${tilesSince}&fmt=bin${gridQS ? '&' + gridQS : ''}`);
+      if (!r.ok) {
+        if (r.status === 404 || r.status === 503) deltaBroken = true;
+        throw new Error(`delta ${r.status}`);
+      }
+      const ct = r.headers.get('Content-Type') || '';
+      if (ct.indexOf('vnd.heatmap.tiles') < 0) {
+        // server negotiated us back to JSON (old server / fallback)
+        wireFmt = 'json';
+        throw new Error('binary not negotiated');
+      }
+      const buf = await r.arrayBuffer();
+      const d = decodeWireFrame(buf);
+      wireBytes += buf.byteLength;
+      const unknown = d.features.some(f => !cellLayers.has(f.cellId));
+      if (d.mode !== 'full' && !unknown) {
+        wireSaved += Math.max(0, d.features.length * jsonPerFeat - buf.byteLength);
+        return {binDelta: d};
+      }
+      // resync / new cells: one JSON full fetch restores geometry,
+      // then binary deltas resume from the frame's seq
+      const tiles = await fetchFullJson(gridQS);
+      return {full: tiles, seq: d.seq};
+    } catch (err) {
+      if (wireFmt === 'bin' && !deltaBroken) wireFmt = 'json';
+      console.warn('binary delta failed; falling back to JSON', err);
+    }
+  }
+  // JSON delta path: changed hexes only, O(changed) per poll
   if (!deltaBroken) {
     try {
       const r = await fetch(`/api/tiles/delta?since=${tilesSince}${gridQS ? '&' + gridQS : ''}`);
@@ -155,8 +280,7 @@ async function fetchTiles(gridQS) {
     }
   }
   // full-fetch fallback: the reference-shaped endpoint
-  const tiles = await fetch('/api/tiles/latest' + (gridQS ? '?' + gridQS : ''))
-    .then(r => r.json());
+  const tiles = await fetchFullJson(gridQS);
   return {full: tiles};
 }
 
@@ -173,13 +297,19 @@ async function tick() {
       fetch('/metrics.json').then(r => r.json()).catch(() => ({})),
     ]);
     if (seq !== tickSeq) return;  // stale response; a fresher one renders
-    if (tiles.delta) {
+    if (tiles.binDelta) {
+      // properties-only binary delta: every cell is already on the map
+      for (const p of tiles.binDelta.features)
+        updateCellInPlace(cellLayers.get(p.cellId), p);
+      tilesSince = tiles.binDelta.seq;
+    } else if (tiles.delta) {
       if (tiles.delta.mode === 'full') clearHexes();
       applyFeatures(tiles.delta.features || []);
       tilesSince = tiles.delta.seq;
     } else {
       clearHexes();
       if (tiles.full.features) applyFeatures(tiles.full.features);
+      if (tiles.seq !== undefined) tilesSince = tiles.seq;
     }
     if (cellLayers.size && !fitted) {
       const b = hexes.getBounds();
@@ -211,6 +341,10 @@ function renderHud(nt, np, m) {
   if (m && m.events_per_sec !== undefined)
     line += ` · ${Number(m.events_per_sec).toLocaleString()} ev/s` +
             ` · p50 ${m.batch_latency_p50_ms} ms`;
+  // negotiated wire format + bytes the binary path saved vs GeoJSON
+  line += ` · wire ${deltaBroken ? 'full-json' : wireFmt}`;
+  if (wireSaved > 0)
+    line += ` (saved ~${(wireSaved / 1024).toFixed(0)} KB)`;
   document.getElementById('hud').innerHTML = line + '<br/>' + sw;
 }
 

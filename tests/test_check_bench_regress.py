@@ -227,12 +227,16 @@ def test_repo_artifacts_parse():
 
 # ------------------------------------------------- serve-tier artifacts
 def _write_serve(dir_path, rnd, p99=100.0, wire=1_000_000, replicas=None,
-                 rc=0, soak=True):
+                 rc=0, soak=True, wire_format=None, serve_workers=None):
     art = {"rc": rc}
     sec = {"p99_ms": p99, "bytes_sent_wire": wire}
     if soak:
         if replicas is not None:
             sec["replicas"] = replicas
+        if wire_format is not None:
+            sec["wire_format"] = wire_format
+        if serve_workers is not None:
+            sec["serve_workers"] = serve_workers
         art["soak"] = sec
     else:
         art["concurrent"] = {"delta": sec}
@@ -677,3 +681,71 @@ def test_cq_gate_wired_into_main(tmp_path, capsys):
     _write_cq(tmp_path, 2, p99=100.0)
     assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
     assert "cq regression" in capsys.readouterr().err
+
+
+# ------------------------------------------- wire-format / worker stamps
+def test_serve_mixed_wire_format_refused(tmp_path, capsys):
+    """ISSUE 14: a binary-frame soak's bytes/latency cannot stand in
+    for a JSON round (or mask its regression) — mixed wire-format
+    pairs are refused outright."""
+    mod = _load()
+    _write_serve(tmp_path, 1, p99=100.0, wire=10_000_000, replicas=2,
+                 wire_format="json")
+    _write_serve(tmp_path, 2, p99=100.0, wire=1_000_000, replicas=2,
+                 wire_format="bin")
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    err = capsys.readouterr().err
+    assert "wire-format mismatch" in err
+    assert "r01" in err and "r02" in err
+
+
+def test_serve_mixed_worker_count_refused(tmp_path, capsys):
+    """ISSUE 14: an 8-worker fleet's latency cannot stand in for a
+    4-worker round — mixed serve-worker pairs are refused outright."""
+    mod = _load()
+    _write_serve(tmp_path, 1, p99=100.0, wire=1_000_000,
+                 wire_format="bin", serve_workers=4)
+    _write_serve(tmp_path, 2, p99=100.0, wire=1_000_000,
+                 wire_format="bin", serve_workers=8)
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    err = capsys.readouterr().err
+    assert "serve-worker-count mismatch" in err
+
+
+def test_serve_matching_wire_stamps_ratchet(tmp_path, capsys):
+    """Matching wire-format + worker-count stamps compare (and ratchet)
+    normally."""
+    mod = _load()
+    _write_serve(tmp_path, 1, p99=100.0, wire=1_000_000,
+                 wire_format="bin", serve_workers=4)
+    _write_serve(tmp_path, 2, p99=110.0, wire=1_050_000,
+                 wire_format="bin", serve_workers=4)
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    assert "serve r01" in capsys.readouterr().out
+
+
+def test_serve_unstamped_prev_comparable_with_stamped_new(tmp_path):
+    """A pre-wire artifact (no stamps, like the banked r01) stays
+    comparable against a stamped fleet round — mirroring the other
+    provenance stamps' None-is-comparable rule."""
+    mod = _load()
+    _write_serve(tmp_path, 1, p99=100.0, wire=1_000_000, replicas=3)
+    _write_serve(tmp_path, 2, p99=90.0, wire=900_000,
+                 wire_format="bin", serve_workers=4)
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_serve_wire_format_from_top_level_wire_block(tmp_path, capsys):
+    """The ``wire`` top-level block's format is honored when the soak
+    block carries no stamp (artifact shape tolerance)."""
+    mod = _load()
+    p1 = _write_serve(tmp_path, 1, p99=100.0, wire=1_000_000)
+    art = json.loads(p1.read_text())
+    art["wire"] = {"format": "json", "reduction_x": 1.0}
+    p1.write_text(json.dumps(art))
+    p2 = _write_serve(tmp_path, 2, p99=100.0, wire=1_000_000)
+    art = json.loads(p2.read_text())
+    art["wire"] = {"format": "bin", "reduction_x": 9.0}
+    p2.write_text(json.dumps(art))
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    assert "wire-format mismatch" in capsys.readouterr().err

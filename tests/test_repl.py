@@ -675,3 +675,48 @@ def test_repl_config_validation():
     assert (cfg.repl_dir, cfg.repl_feed) == ("/tmp/x", "http://h:1")
     assert (cfg.repl_seg_bytes, cfg.repl_segments,
             cfg.repl_poll_ms) == (8192, 3, 100)
+
+
+def test_obs_top_fleet_renders_serve_wire_rows():
+    """obs_top --fleet (ISSUE 14): workers serving the wire path get a
+    serve-wire table — negotiated-format mix, wire/rendered byte
+    rates, admission sheds, SSE send-queue high-water."""
+    top = _load_tool("obs_top")
+    base = """\
+heatmap_fleet_members 2
+heatmap_fleet_member_up{proc="serve1",role="serve"} 1
+heatmap_fleet_member_up{proc="serve2",role="serve"} 1
+heatmap_repl_seq_lag{proc="serve1"} 0
+heatmap_repl_seq_lag{proc="serve2"} 0
+heatmap_serve_sse_clients{proc="serve1"} 5
+heatmap_serve_sse_clients{proc="serve2"} 2
+heatmap_serve_wire_format_total{proc="serve1",endpoint="delta",fmt="bin"} 90
+heatmap_serve_wire_format_total{proc="serve1",endpoint="delta",fmt="json"} 10
+heatmap_serve_wire_format_total{proc="serve2",endpoint="tiles",fmt="json"} 40
+heatmap_serve_shed_total{proc="serve1",endpoint="delta"} 3
+heatmap_sse_queue_highwater{proc="serve1"} 7
+heatmap_serve_sent_bytes_total{proc="serve1",endpoint="delta"} 1000
+heatmap_serve_rendered_bytes_total{proc="serve1",endpoint="delta"} 5000
+"""
+    prev = top.parse_prom(base)
+    cur = top.parse_prom(base.replace(
+        'heatmap_serve_sent_bytes_total{proc="serve1",endpoint="delta"}'
+        ' 1000',
+        'heatmap_serve_sent_bytes_total{proc="serve1",endpoint="delta"}'
+        ' 21000').replace(
+        'heatmap_serve_rendered_bytes_total{proc="serve1",'
+        'endpoint="delta"} 5000',
+        'heatmap_serve_rendered_bytes_total{proc="serve1",'
+        'endpoint="delta"} 105000'))
+    frame = top.render_fleet_frame(cur, prev, 2.0,
+                                   {"status": "ok", "checks": {}})
+    assert "serve wire" in frame
+    # serve1: 90 of 100 responses negotiated binary
+    assert "90 %" in frame
+    # serve2: JSON only
+    assert "0 %" in frame
+    # rates off the 2 s delta: (21000-1000)/2 and (105000-5000)/2
+    assert "10,000" in frame and "50,000" in frame
+    assert "7" in frame   # queue high-water
+    lines = [ln for ln in frame.splitlines() if "serve1" in ln]
+    assert any("3" in ln for ln in lines)  # shed count rendered

@@ -2,6 +2,7 @@
 
 import datetime as dt
 import json
+import os
 import time
 import urllib.request
 
@@ -1283,3 +1284,413 @@ def test_sse_admission_under_client_churn_at_cap(store):
     finally:
         stop.set()
         httpd.shutdown()
+
+
+# =================================================================
+# Serve-tier wire path (ISSUE 14): binary frames, format-keyed ETags,
+# coalesced SSE fan-out, admission control, multi-process workers.
+
+def _get_raw(url, headers=None):
+    """(status, body, headers) tolerating non-2xx."""
+    req = urllib.request.Request(url)
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def test_binary_latest_and_delta_differential_http(server):
+    """decode(binary) == JSON byte-for-byte over real HTTP, for
+    /latest and /delta, on a store-fed worker — plus Content-Type and
+    unknown-fmt rejection."""
+    from heatmap_tpu.serve import wire
+    from heatmap_tpu.serve.api import (_delta_body,
+                                       _features_collection_json)
+
+    st, jbody, jh = _get_raw(server + "/api/tiles/latest")
+    st2, bbody, bh = _get_raw(server + "/api/tiles/latest?fmt=bin")
+    assert st == st2 == 200
+    assert bh["Content-Type"] == wire.CONTENT_TYPE
+    # the representation varies on Accept: BOTH formats must say so
+    # (as a full token, not the Accept-Encoding prefix), or a shared
+    # cache could replay the wrong representation
+    for q in ("", "?fmt=bin"):
+        with urllib.request.urlopen(server + "/api/tiles/latest" + q,
+                                    timeout=10) as r:
+            vary = ",".join(r.headers.get_all("Vary") or [])
+        assert "Accept" in [v.strip() for v in vary.split(",")], vary
+    assert len(bbody) < len(jbody)
+    dec = wire.decode(bbody)
+    assert _features_collection_json(dec["docs"]).encode() == jbody
+    st3, jd, _ = _get_raw(server + "/api/tiles/delta?since=0")
+    st4, bd, _ = _get_raw(server + "/api/tiles/delta?since=0&fmt=bin")
+    d = wire.decode(bd)
+    assert _delta_body(d, "h3r8").encode() == jd
+    assert d["seq"] == json.loads(jd)["seq"]
+    # Accept-header negotiation selects binary too
+    _, _, ah = _get_raw(server + "/api/tiles/latest",
+                        {"Accept": wire.CONTENT_TYPE})
+    assert ah["Content-Type"] == wire.CONTENT_TYPE
+    # unknown fmt is a 400, not a guess
+    st5, body5, _ = _get_raw(server + "/api/tiles/delta?fmt=nope")
+    assert st5 == 400 and b"fmt" in body5
+
+
+def _assert_format_keyed_etags(base):
+    """No cross-format 304: a JSON ETag against a binary request (and
+    vice versa) re-renders; same-format If-None-Match still 304s."""
+    st, _, jh = _get_raw(base + "/api/tiles/latest")
+    st2, _, bh = _get_raw(base + "/api/tiles/latest?fmt=bin")
+    assert st == st2 == 200
+    assert jh["ETag"] != bh["ETag"]
+    assert bh["ETag"].endswith('.bin"')
+    checks = (
+        ("/api/tiles/latest?fmt=bin", jh["ETag"], 200),
+        ("/api/tiles/latest?fmt=bin", bh["ETag"], 304),
+        ("/api/tiles/latest", bh["ETag"], 200),
+        ("/api/tiles/latest", jh["ETag"], 304),
+    )
+    for path, etag, want in checks:
+        got, _, _ = _get_raw(base + path, {"If-None-Match": etag})
+        assert got == want, (path, etag, got, want)
+
+
+def test_format_keyed_etags_store_fed(server):
+    _assert_format_keyed_etags(server)
+
+
+def test_format_keyed_etags_writer_fed(tmp_path):
+    """Same no-cross-format-304 contract on the runtime's writer-fed
+    view."""
+    cfg, st, rt = _mini_runtime(tmp_path)
+    httpd, _t, port = start_background(st, cfg, runtime=rt)
+    try:
+        _assert_format_keyed_etags(f"http://127.0.0.1:{port}")
+    finally:
+        httpd.shutdown()
+        rt.close()
+
+
+def test_format_keyed_etags_and_differential_replica_fed(tmp_path):
+    """The replica topology: a serve worker following the replication
+    feed with an EMPTY store serves format-keyed ETags and the
+    binary==JSON differential like the writer."""
+    from heatmap_tpu.query import TileMatView
+    from heatmap_tpu.query.repl import DeltaLogPublisher
+    from heatmap_tpu.serve import wire
+    from heatmap_tpu.serve.api import _features_collection_json
+
+    feed = str(tmp_path / "feed")
+    view = TileMatView()
+    pub = DeltaLogPublisher(view, feed, flush_s=0.02)
+    now = dt.datetime.now(UTC).replace(microsecond=0)
+    ws = now - dt.timedelta(minutes=2)
+    cells = [hexgrid.latlng_to_cell(42.3 + i * 7e-3, -71.05, 8)
+             for i in range(4)]
+    view.apply_docs([
+        TileDoc("bos", 8, c, ws, ws + dt.timedelta(minutes=5),
+                count=i + 1, avg_speed_kmh=20.0 + i, avg_lat=42.3,
+                avg_lon=-71.05, ttl_minutes=45)
+        for i, c in enumerate(cells)])
+    cfg = load_config({}, store="memory", serve_port=0,
+                      repl_feed=feed, repl_poll_ms=50)
+    httpd, _t, port = start_background(MemoryStore(), cfg)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        fol = httpd.get_app().repl_follower
+        deadline = time.time() + 20
+        while time.time() < deadline and not (fol.synced
+                                              and fol.seq_lag() == 0):
+            time.sleep(0.02)
+        assert fol.synced
+        _assert_format_keyed_etags(base)
+        st1, jbody, _ = _get_raw(base + "/api/tiles/latest")
+        st2, bbody, _ = _get_raw(base + "/api/tiles/latest?fmt=bin")
+        dec = wire.decode(bbody)
+        assert _features_collection_json(dec["docs"]).encode() == jbody
+    finally:
+        httpd.shutdown()
+        httpd.get_app().close_repl()
+        pub.close()
+
+
+def test_sse_coalesced_encodes_o_formats_not_o_clients(store):
+    """The fan-out acceptance metric: with N subscribers on one (grid,
+    format) channel, M view advances cost ~M encodes — never N*M."""
+    import socket
+
+    n_clients = 4
+    cfg = load_config({"HEATMAP_VIEW_POLL_MS": "30",
+                       "HEATMAP_SSE_HEARTBEAT_S": "0.2"}, serve_port=0)
+    httpd, _t, port = start_background(store, cfg, port=0)
+    app = httpd.get_app()
+    enc = None
+    for fam in app.serve_registry._families.values():
+        if fam.name == "heatmap_sse_encodes_total":
+            enc = fam
+    assert enc is not None
+    socks = []
+    try:
+        for _ in range(n_clients):
+            sk = socket.create_connection(("127.0.0.1", port),
+                                          timeout=10)
+            sk.sendall(b"GET /api/tiles/stream?since=0 "
+                       b"HTTP/1.0\r\n\r\n")
+            sk.settimeout(10)
+            socks.append(sk)
+        bufs = [b""] * n_clients
+        for i, sk in enumerate(socks):
+            while bufs[i].count(b"event: tiles") < 1:
+                bufs[i] += sk.recv(65536)
+        base_encodes = enc.labels(fmt="json").value
+        mutations = 5
+        now = dt.datetime.now(UTC).replace(microsecond=0)
+        ws = now - dt.timedelta(minutes=2)
+        for m in range(mutations):
+            cell = hexgrid.latlng_to_cell(42.5 + m * 0.01, -71.2, 8)
+            store.upsert_tiles([
+                TileDoc("bos", 8, cell, ws,
+                        ws + dt.timedelta(minutes=5), count=m + 1,
+                        avg_speed_kmh=10.0, avg_lat=42.5,
+                        avg_lon=-71.2, ttl_minutes=45)])
+            for i, sk in enumerate(socks):
+                while bufs[i].count(b"event: tiles") < m + 2 \
+                        or not bufs[i].endswith(b"\n\n"):
+                    bufs[i] += sk.recv(65536)
+        # every client saw every frame...
+        frames = [[f for f in b.split(b"\n\n") if b"event: tiles" in f]
+                  for b in bufs]
+        assert all(fr == frames[0] for fr in frames)  # SHARED bytes
+        # ...but the encode counter moved once per advance, not once
+        # per (advance x client)
+        encodes = enc.labels(fmt="json").value - base_encodes
+        assert mutations <= encodes <= mutations + 2, encodes
+    finally:
+        for sk in socks:
+            sk.close()
+        httpd.shutdown()
+
+
+def test_sse_slow_client_shed_with_lagged_others_unaffected(store):
+    """ISSUE 14 chaos satellite: a subscriber that stops reading
+    mid-stream is shed with ``event: lagged`` once its bounded queue
+    overflows, its admission slot is released, the client gauge drains
+    to zero, and the OTHER subscribers on the same coalesced buffer
+    see every frame (zero missed seqs)."""
+    import socket
+
+    cfg = load_config({"HEATMAP_VIEW_POLL_MS": "30",
+                       "HEATMAP_SSE_HEARTBEAT_S": "0.1",
+                       "HEATMAP_SSE_QUEUE": "2"}, serve_port=0)
+    httpd, _t, port = start_background(store, cfg, port=0)
+    app = httpd.get_app()
+    gauge = lagged = None
+    for fam in app.serve_registry._families.values():
+        if fam.name == "heatmap_serve_sse_clients":
+            gauge = fam
+        if fam.name == "heatmap_sse_lagged_total":
+            lagged = fam
+
+    def connect(rcvbuf=None):
+        sk = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        if rcvbuf:
+            sk.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, rcvbuf)
+        sk.settimeout(10)
+        sk.connect(("127.0.0.1", port))
+        sk.sendall(b"GET /api/tiles/stream?since=0 HTTP/1.0\r\n\r\n")
+        return sk
+
+    slow = connect(rcvbuf=4096)
+    good = [connect(), connect()]
+    bufs = {id(s): b"" for s in good}
+    try:
+        # everyone reads the first (catch-up) frame
+        sbuf = b""
+        while sbuf.count(b"event: tiles") < 1:
+            sbuf += slow.recv(65536)
+        for s in good:
+            while bufs[id(s)].count(b"event: tiles") < 1:
+                bufs[id(s)] += s.recv(65536)
+        # the slow client STOPS READING; each mutation touches a
+        # ~200-cell batch (a ~120 KB frame), so the stalled
+        # connection's in-flight socket capacity (~1 MB on this
+        # kernel) plus its 2-frame queue overflow within a few
+        # mutations while the good clients keep draining
+        now = dt.datetime.now(UTC).replace(microsecond=0)
+        ws = now - dt.timedelta(minutes=2)
+        batch_cells = sorted({
+            hexgrid.latlng_to_cell(42.6 + (j % 20) * 8e-3,
+                                   -71.3 + (j // 20) * 8e-3, 8)
+            for j in range(200)})
+        mutations = 28
+        for m in range(mutations):
+            store.upsert_tiles([
+                TileDoc("bos", 8, c, ws,
+                        ws + dt.timedelta(minutes=5),
+                        count=m * 100 + j + 1,
+                        avg_speed_kmh=9.0, avg_lat=42.6, avg_lon=-71.3,
+                        ttl_minutes=45)
+                for j, c in enumerate(batch_cells)])
+            for s in good:
+                while bufs[id(s)].count(b"event: tiles") < m + 2 \
+                        or not bufs[id(s)].endswith(b"\n\n"):
+                    bufs[id(s)] += s.recv(65536)
+        # good clients: identical shared frames, all advances seen
+        frames = [[f for f in bufs[id(s)].split(b"\n\n")
+                   if b"event: tiles" in f] for s in good]
+        assert frames[0] == frames[1]
+        assert len(frames[0]) == mutations + 1
+        # the slow client was shed: lagged counter bumped, and when it
+        # finally drains its socket it finds the lagged event + EOF
+        deadline = time.time() + 15
+        while time.time() < deadline and lagged.value < 1:
+            time.sleep(0.05)
+        assert lagged.value >= 1
+        while True:
+            try:
+                chunk = slow.recv(65536)
+            except socket.timeout:
+                raise AssertionError("slow client never saw EOF")
+            if not chunk:
+                break
+            sbuf += chunk
+        assert b"event: lagged" in sbuf
+        # shed + closed clients release every slot: gauge drains to 0
+        for s in good:
+            s.close()
+        slow.close()
+        deadline = time.time() + 15
+        while time.time() < deadline and gauge.value != 0:
+            time.sleep(0.1)
+        assert gauge.value == 0
+    finally:
+        for s in good:
+            s.close()
+        slow.close()
+        httpd.shutdown()
+
+
+def test_admission_control_sheds_with_retry_after(store):
+    """HEATMAP_SERVE_MAX_INFLIGHT=1: with one render parked inside the
+    store, a concurrent data request sheds 503 + Retry-After and bumps
+    the shed counter; the operator surface (/healthz) is never shed."""
+    import threading as _th
+
+    from heatmap_tpu.serve.api import make_wsgi_app
+
+    release = _th.Event()
+    entered = _th.Event()
+
+    class SlowStore(MemoryStore):
+        def latest_window_start(self, grid=None):
+            entered.set()
+            release.wait(10)
+            return super().latest_window_start(grid)
+
+    slow = SlowStore()
+    # same content as the fixture store
+    now = dt.datetime.now(UTC).replace(microsecond=0)
+    ws = now - dt.timedelta(minutes=2)
+    cell = hexgrid.latlng_to_cell(42.3601, -71.0589, 8)
+    slow.upsert_tiles([TileDoc("bos", 8, cell, ws,
+                               ws + dt.timedelta(minutes=5), count=7,
+                               avg_speed_kmh=33.0, avg_lat=42.36,
+                               avg_lon=-71.05, ttl_minutes=45)])
+    cfg = load_config({"HEATMAP_QUERY_VIEW": "0",
+                       "HEATMAP_SERVE_CACHE_MS": "0"},
+                      serve_port=0, serve_max_inflight=1)
+    app = make_wsgi_app(slow, cfg)
+
+    def call(path):
+        out = {}
+
+        def sr(status, headers):
+            out["status"] = status
+            out["headers"] = dict(headers)
+
+        body = b"".join(app({"PATH_INFO": path, "QUERY_STRING": "",
+                             "REQUEST_METHOD": "GET"}, sr))
+        out["body"] = body
+        return out
+
+    slow_result = {}
+    t = _th.Thread(target=lambda: slow_result.update(
+        call("/api/tiles/latest")), daemon=True)
+    t.start()
+    assert entered.wait(10)
+    shed = call("/api/tiles/latest")
+    assert shed["status"].startswith("503")
+    assert shed["headers"].get("Retry-After") == "1"
+    hz = call("/healthz")          # operator surface never shed
+    assert hz["status"].startswith("200")
+    release.set()
+    t.join(timeout=10)
+    assert slow_result["status"].startswith("200")
+    shed_ctr = None
+    for fam in app.serve_registry._families.values():
+        if fam.name == "heatmap_serve_shed_total":
+            shed_ctr = fam
+    assert shed_ctr.labels(endpoint="tiles").value == 1
+
+
+def test_multi_process_serve_workers_reuseport(tmp_path):
+    """``python -m heatmap_tpu.serve --workers 2``: two worker
+    processes answer on ONE port (SO_REUSEPORT), each publishing its
+    own fleet member snapshot, and SIGTERM stops the fleet cleanly."""
+    import signal
+    import socket
+    import subprocess
+    import sys
+
+    from heatmap_tpu.obs.xproc import members_from
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    chan = str(tmp_path / "chan.json")
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "HEATMAP_STORE": "memory",
+                "HEATMAP_SUPERVISOR_CHANNEL": chan,
+                "HEATMAP_FLEET_PUBLISH_S": "0.5"})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "heatmap_tpu.serve", "--workers", "2",
+         "--port", str(port)], env=env)
+    try:
+        pids = set()
+        deadline = time.time() + 90
+        while time.time() < deadline and len(pids) < 2:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/debug/view",
+                        timeout=3) as r:
+                    pids.add(json.loads(r.read())["pid"])
+            except (OSError, ValueError):
+                time.sleep(0.3)
+        assert len(pids) == 2, f"saw worker pids {pids}"
+        # each worker published its own serve member on the channel
+        deadline = time.time() + 30
+        serve_members = {}
+        while time.time() < deadline and len(serve_members) < 2:
+            members, _skipped = members_from(chan, max_age_s=30.0)
+            serve_members = {t: m for t, m in members.items()
+                             if m.get("role") == "serve"}
+            time.sleep(0.3)
+        assert len(serve_members) == 2, sorted(serve_members)
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=20) == 0
+
+
+def test_index_embeds_binary_wire_decoder():
+    """The embedded UI ships the DataView wire-frame parser, negotiates
+    ?fmt=bin on the delta poll, and reports the format on the HUD."""
+    from heatmap_tpu.serve.ui import render_index
+
+    html = render_index()
+    assert "decodeWireFrame" in html
+    assert "fmt=bin" in html
+    assert "wireSaved" in html and "wireFmt" in html

@@ -1,0 +1,409 @@
+"""Serve-tier binary wire protocol (serve/wire.py) + coalesced fan-out.
+
+THE differential contract: decoding a binary tile/delta frame and
+rendering the decoded docs through the serving layer's own
+pre-serialized feature fragments reproduces the JSON representation
+BYTE-FOR-BYTE — for /latest snapshots, delta replay from seq 0, and
+SSE frames, across window advance and latest-window eviction, on the
+writer-fed view AND a replica following the replication feed.  Plus
+the native column writer's byte-identity with the pure-Python writer,
+and the FanoutHub's coalescing/lag-shedding semantics.
+"""
+
+import datetime as dt
+import json
+import random
+import threading
+import time
+
+import pytest
+
+from heatmap_tpu import hexgrid
+from heatmap_tpu.query import TileMatView
+from heatmap_tpu.query.repl import (
+    DeltaLogPublisher,
+    FileFeedSource,
+    ReplicaViewFollower,
+)
+from heatmap_tpu.serve import wire
+from heatmap_tpu.serve.api import _delta_body, _features_collection_json
+from heatmap_tpu.sink.base import TileDoc, UTC
+
+WS = dt.datetime(2026, 8, 4, 12, 0, tzinfo=UTC)
+WE = WS + dt.timedelta(minutes=5)
+
+
+def _mkdocs(n, rng=None, fixed=False, extras=True):
+    rng = rng or random.Random(7)
+    docs = []
+    for i in range(n):
+        speed = rng.uniform(0, 120)
+        d = {"cellId": format(0x882a100000000000
+                              + rng.randrange(1 << 40), "x"),
+             "count": rng.randrange(0, 5000),
+             "avgSpeedKmh": (float(round(speed, 2)) if fixed
+                             else float(speed)),
+             "windowStart": WS, "windowEnd": WE}
+        if extras:
+            if i % 3 == 0:
+                d["p95SpeedKmh"] = float(rng.uniform(0, 200))
+            if i % 4 == 0:
+                d["stddevSpeedKmh"] = float(rng.uniform(0, 30))
+            if i % 5 == 0:
+                d["windowMinutes"] = 5
+            if i % 17 == 0:
+                # per-doc window override (a straggler doc from an
+                # older window riding the same frame)
+                d["windowStart"] = WS - dt.timedelta(minutes=5)
+                d["windowEnd"] = WS
+        docs.append(d)
+    return docs
+
+
+# ------------------------------------------------------------- codec
+def test_roundtrip_exact():
+    docs = _mkdocs(300)
+    buf = wire.encode("delta", 1234, "h3r8", WS, docs)
+    out = wire.decode(buf)
+    assert out["mode"] == "delta"
+    assert out["seq"] == 1234
+    assert out["grid"] == "h3r8"
+    assert out["window_start"] == WS
+    assert out["docs"] == docs
+    assert wire.frame_seq(buf) == 1234
+
+
+def test_roundtrip_empty_and_no_window():
+    buf = wire.encode("full", 0, "g", None, [])
+    assert wire.decode(buf) == {"mode": "full", "seq": 0, "grid": "g",
+                                "window_start": None, "docs": []}
+    # empty delta WITH a window (an idle poll against a live window):
+    # the header must carry the windowStart the JSON body names
+    buf2 = wire.encode("delta", 9, "h3r8", WS, [])
+    out = wire.decode(buf2)
+    assert out["window_start"] == WS and out["docs"] == []
+
+
+def test_roundtrip_naive_datetimes():
+    wsn = WS.replace(tzinfo=None)
+    docs = [{"cellId": "8f2", "count": 1, "avgSpeedKmh": 3.0,
+             "windowStart": wsn, "windowEnd": wsn
+             + dt.timedelta(minutes=5)}]
+    assert wire.decode(wire.encode("delta", 9, "g", wsn,
+                                   docs))["docs"] == docs
+
+
+def test_fixed_point_engages_only_when_exact():
+    exact = _mkdocs(200, fixed=True, extras=False)
+    b_fixed = wire.encode("full", 1, "g", WS, exact)
+    assert wire.decode(b_fixed)["docs"] == exact
+    # one full-entropy value in the column forces raw f64 for ALL —
+    # the decode must stay bit-exact either way
+    mixed = [dict(d) for d in exact]
+    mixed[7]["avgSpeedKmh"] = mixed[7]["avgSpeedKmh"] + 1e-9
+    b_f64 = wire.encode("full", 1, "g", WS, mixed)
+    assert wire.decode(b_f64)["docs"] == mixed
+    assert len(b_fixed) < len(b_f64)
+
+
+def test_encoder_rejects_unrepresentable_docs():
+    base = {"cellId": "8f2", "count": 1, "avgSpeedKmh": 3.0,
+            "windowStart": WS, "windowEnd": WE}
+    with pytest.raises(ValueError):
+        wire.encode("full", 1, "g", WS,
+                    [dict(base, p95SpeedKmh=42)])  # int extra
+    with pytest.raises(ValueError):
+        wire.encode("full", 1, "g", WS,
+                    [dict(base, windowMinutes=2.5)])  # float wmin
+    with pytest.raises(ValueError):
+        wire.encode("full", 1, "g", WS, [dict(base, count=-3)])
+
+
+def test_decode_rejects_garbage():
+    with pytest.raises(ValueError):
+        wire.decode(b"not a frame at all")
+    buf = bytearray(wire.encode("full", 1, "g", WS, _mkdocs(3)))
+    buf[2] = 99  # unsupported version
+    with pytest.raises(ValueError):
+        wire.decode(bytes(buf))
+    with pytest.raises(ValueError):
+        wire.decode(wire.encode("full", 1, "g", WS, _mkdocs(3))[:20])
+
+
+def test_format_keyed_etag():
+    assert wire.format_etag('"abc.1.2"', "json") == '"abc.1.2"'
+    assert wire.format_etag('"abc.1.2"', "bin") == '"abc.1.2.bin"'
+    assert wire.format_etag('"a"', "bin") != wire.format_etag('"a"',
+                                                              "json")
+
+
+def test_native_body_byte_identical_to_python():
+    from heatmap_tpu.native import maybe_wire_ops
+
+    nat = maybe_wire_ops()
+    if nat is None:
+        pytest.skip("no native toolchain")
+    rng = random.Random(3)
+    for fixed in (False, True):
+        for n in (1, 17, 400):
+            docs = _mkdocs(n, rng=rng, fixed=fixed)
+            a = wire.encode("delta", n, "h3r8", WS, docs)
+            b = wire.encode("delta", n, "h3r8", WS, docs, native=nat)
+            assert a == b
+    # empty subset columns + no extras
+    docs = _mkdocs(5, rng=rng, extras=False)
+    assert wire.encode("full", 1, "g", WS, docs) \
+        == wire.encode("full", 1, "g", WS, docs, native=nat)
+
+
+# ------------------------------------- decode == JSON, view level
+def _cells(n, res=8, lat0=42.30):
+    out = []
+    for i in range(n * 3):
+        c = hexgrid.latlng_to_cell(lat0 + i * 7e-3, -71.05, res)
+        if c not in out:
+            out.append(c)
+        if len(out) == n:
+            break
+    assert len(out) == n
+    return out
+
+
+def _doc(cell, ws, count, speed=30.0, ttl_minutes=45):
+    return TileDoc("bos", 8, cell, ws, ws + dt.timedelta(minutes=5),
+                   count=count, avg_speed_kmh=speed, avg_lat=42.3,
+                   avg_lon=-71.05, ttl_minutes=ttl_minutes)
+
+
+def _assert_latest_differential(view, grid="h3r8"):
+    """decode(binary /latest frame) rendered through the shared
+    feature fragments == the JSON /latest body, byte for byte."""
+    etag, ws_dt, docs, seq = view.snapshot_seq(grid)
+    frame = wire.encode("full", seq, grid, ws_dt, docs)
+    dec = wire.decode(frame)
+    assert _features_collection_json(dec["docs"]) \
+        == _features_collection_json(docs)
+    assert dec["seq"] == seq
+
+
+def _assert_delta_differential(view, since, grid="h3r8"):
+    d = view.delta(grid, since)
+    frame = wire.encode(d["mode"], d["seq"], grid, d["window_start"],
+                        d["docs"])
+    dec = wire.decode(frame)
+    assert _delta_body(dec, grid) == _delta_body(d, grid)
+    return d["seq"]
+
+
+def test_view_differential_across_advance_and_eviction():
+    """Writer-fed view: binary==JSON for /latest and delta replay from
+    0, through same-window updates, a window advance, and fake-clock
+    eviction of the latest window."""
+    clock = {"t": 1_900_000_000.0}
+    view = TileMatView(now_fn=lambda: clock["t"])
+    base = dt.datetime.fromtimestamp(clock["t"], UTC)
+    ws1 = base - dt.timedelta(minutes=10)
+    ws2 = base - dt.timedelta(minutes=5)
+    cells = _cells(6)
+    view.apply_docs([_doc(cells[i], ws1, i + 1, ttl_minutes=6)
+                     for i in range(4)])
+    _assert_latest_differential(view)
+    _assert_delta_differential(view, 0)
+    # same-window update -> delta mode
+    s1 = view.seq
+    view.apply_docs([_doc(cells[0], ws1, 99, ttl_minutes=6)])
+    _assert_delta_differential(view, s1)
+    # window advance -> full resync
+    view.apply_docs([_doc(cells[4], ws2, 5, ttl_minutes=6),
+                     _doc(cells[5], ws2, 6, ttl_minutes=6)])
+    _assert_latest_differential(view)
+    _assert_delta_differential(view, s1)
+    _assert_delta_differential(view, 0)
+    # fake-clock eviction of the latest window
+    clock["t"] += 12 * 60
+    _assert_latest_differential(view)
+    _assert_delta_differential(view, 0)
+    assert view.latest_docs("h3r8")[1] == []
+
+
+def test_replica_differential_through_real_feed(tmp_path):
+    """The same contract on a REPLICA: records ride the real
+    publisher -> file feed -> follower path, and the replica's binary
+    frames decode to its (writer-byte-identical) JSON."""
+    clock = {"t": 1_900_000_000.0}
+    w = TileMatView(now_fn=lambda: clock["t"])
+    pub = DeltaLogPublisher(w, str(tmp_path), start=False)
+    r = TileMatView(replica=True, now_fn=lambda: clock["t"])
+    fol = ReplicaViewFollower(r, FileFeedSource(str(tmp_path)))
+    base = dt.datetime.fromtimestamp(clock["t"], UTC)
+    ws1 = base - dt.timedelta(minutes=10)
+    ws2 = base - dt.timedelta(minutes=5)
+    cells = _cells(5)
+
+    def drain():
+        pub.flush()
+        while fol.step():
+            pass
+
+    def check(since):
+        for v in (w, r):
+            _assert_latest_differential(v)
+            _assert_delta_differential(v, since)
+        # and the two views' BINARY frames are interchangeable too
+        ew, wsw, dw, qw = w.snapshot_seq("h3r8")
+        er, wsr, dr, qr = r.snapshot_seq("h3r8")
+        assert wire.encode("full", qw, "h3r8", wsw, dw) \
+            == wire.encode("full", qr, "h3r8", wsr, dr)
+
+    w.apply_docs([_doc(cells[i], ws1, i + 1, ttl_minutes=6)
+                  for i in range(3)])
+    drain()
+    check(0)
+    s = w.seq
+    w.apply_docs([_doc(cells[0], ws1, 50, ttl_minutes=6)])
+    w.apply_docs([_doc(cells[3], ws2, 9, ttl_minutes=6)])
+    drain()
+    check(s)
+    clock["t"] += 12 * 60
+    w.etag("h3r8")  # writer's lazy evict publishes the marker
+    drain()
+    check(0)
+    pub.close()
+
+
+# --------------------------------------------------------- fan-out hub
+def test_fanout_coalesces_and_sheds_laggards():
+    N = 30
+    hub = wire.FanoutHub(depth=4)
+    sent = []
+
+    def pump(chan):
+        for i in range(N):
+            if chan.try_retire():
+                return
+            time.sleep(0.02)
+            chan.broadcast(b"frame-%d" % i)
+            sent.append(i)
+        while not chan.try_retire():
+            time.sleep(0.01)
+
+    chan, fast = hub.subscribe("k", pump)
+    chan2, slow = hub.subscribe("k", pump)
+    assert chan is chan2  # one channel, one pump
+    got = []
+
+    def drain_fast():
+        while len(got) < N and not fast.lagged:
+            item = fast.pop(timeout=0.5)
+            if isinstance(item, bytes):
+                got.append(item)
+            elif item is None and len(sent) >= N:
+                return
+
+    t = threading.Thread(target=drain_fast, daemon=True)
+    t.start()
+    t.join(timeout=20)
+    # the fast subscriber saw EVERY broadcast frame, in order: the
+    # zero-missed-frames property of the shared coalesced buffer —
+    # and the laggard's back-pressure never reached it
+    assert not fast.lagged
+    assert [int(f.rsplit(b"-", 1)[1]) for f in got] == list(range(N))
+    # the slow subscriber never popped: its bounded queue overflowed
+    # and it was shed with the LAGGED sentinel (backlog dropped)
+    assert slow.lagged
+    drained = []
+    while True:
+        item = slow.pop(timeout=0.1)
+        if item is None:
+            break
+        drained.append(item)
+    assert drained[-1] is wire.LAGGED
+    assert len(drained) <= hub.depth + 1
+    hub.unsubscribe(chan, fast)
+    hub.unsubscribe(chan, slow)
+
+
+def test_fanout_channel_retires_and_reforms():
+    hub = wire.FanoutHub(depth=4)
+    lives = []
+
+    def pump(chan):
+        lives.append(chan)
+        while not chan.try_retire():
+            time.sleep(0.005)
+
+    chan, sub = hub.subscribe("k", pump)
+    hub.unsubscribe(chan, sub)
+    deadline = time.monotonic() + 5
+    while chan.alive and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not chan.alive
+    # a new subscriber mints a FRESH channel (never attaches to a pump
+    # that decided to exit)
+    chan2, sub2 = hub.subscribe("k", pump)
+    assert chan2 is not chan and chan2.alive
+    hub.unsubscribe(chan2, sub2)
+
+
+def test_fanout_finish_delivers_terminal_frame():
+    hub = wire.FanoutHub(depth=4)
+    ready = threading.Event()
+
+    def pump(chan):
+        ready.wait(5)
+        chan.finish(b"event: gone\n\n")
+
+    chan, sub = hub.subscribe("k", pump)
+    ready.set()
+    assert sub.pop(timeout=5) == b"event: gone\n\n"
+    assert sub.pop(timeout=5) is wire.CLOSED
+
+
+# ------------------------------------------------- SSE frame encoding
+def test_sse_binary_frame_decodes_to_json_payload():
+    """The SSE differential: the base64 payload of a tiles-bin event
+    decodes to exactly the JSON event's delta body."""
+    import base64
+
+    view = TileMatView()
+    cells = _cells(3)
+    ws = dt.datetime.now(UTC).replace(microsecond=0) \
+        - dt.timedelta(minutes=1)
+    view.apply_docs([_doc(c, ws, i + 1) for i, c in enumerate(cells)])
+    d = view.delta("h3r8", 0)
+    frame = wire.encode(d["mode"], d["seq"], "h3r8",
+                        d["window_start"], d["docs"])
+    sse_bin = (b"event: tiles-bin\ndata: " + base64.b64encode(frame)
+               + b"\n\n")
+    payload = sse_bin.split(b"data: ", 1)[1].rsplit(b"\n\n", 1)[0]
+    dec = wire.decode(base64.b64decode(payload))
+    assert _delta_body(dec, "h3r8") == _delta_body(d, "h3r8")
+
+
+def test_fanout_finish_on_full_queue_sheds_not_evicts():
+    """A subscriber at the queue bound when the channel finishes must
+    be shed as LAGGED — appending the terminal frame would silently
+    evict its oldest PENDING frame through the deque bound."""
+    hub = wire.FanoutHub(depth=2)
+    go = threading.Event()
+
+    def pump(chan):
+        chan.broadcast(b"f1")
+        chan.broadcast(b"f2")   # queue now AT the bound
+        go.wait(5)
+        chan.finish(b"gone")
+
+    chan, sub = hub.subscribe("k", pump)
+    go.set()
+    items = []
+    while True:
+        item = sub.pop(timeout=2)
+        if item is None:
+            break
+        items.append(item)
+        if item is wire.CLOSED or item is wire.LAGGED:
+            break
+    # either shed (LAGGED, backlog dropped) — never a silent eviction
+    # of f1 with a delivered terminal frame
+    assert items[-1] is wire.LAGGED
+    assert b"f1" not in items or b"f2" in items

@@ -24,7 +24,22 @@ heatmap_serve_rendered_bytes_total counters), plus
 bytes — the acceptance number for "a polling client against an idle
 store stops costing renders".
 
-``--soak`` switches to the replicated-fleet soak (ISSUE 9): a writer
+``--soak --serve-workers N`` (ISSUE 14) runs the soak against a REAL
+multi-process serve fleet: ``python -m heatmap_tpu.serve --workers N``
+workers sharing one SO_REUSEPORT port, each following the parent's
+delta-log feed with an empty store, while ``--client-procs`` separate
+client driver processes (pure stdlib — no GIL shared with the
+servers) drive the logical clients.  ``--fmt bin`` negotiates the
+compact binary tile frame (serve/wire.py) and a JSON reference leg at
+the same poll schedule runs afterwards, so the artifact stamps
+``wire_reduction_x`` — wire bytes per poll, JSON / binary.  The soak
+block stamps ``wire_format`` and ``serve_workers`` (both refused
+across mismatched pairs by check_bench_regress) plus the fleet-wide
+audit verdict when HEATMAP_AUDIT=1 (digests verified / mismatches /
+max residual scraped over /fleet/metrics).
+
+``--soak`` without ``--serve-workers`` keeps the in-process
+replicated-fleet soak (ISSUE 9): a writer
 view + delta-log publisher (query.repl) feeds ``--replicas`` serve
 workers that follow it with ZERO store reads (their stores are
 empty), while ``--clients`` logical polling clients — persistent
@@ -380,7 +395,8 @@ def _sse_reader(port: int, deadline: float, out: list, idx: int):
 
 
 def run_soak(n_tiles: int, replicas: int, clients: int, duration_s: float,
-             workers: int, sse_n: int, mutate_ms: float = 500.0) -> dict:
+             workers: int, sse_n: int, mutate_ms: float = 500.0,
+             mutate_n: int = 32) -> dict:
     """The replicated-fleet soak; returns the artifact's ``soak``
     block.  The replicas' stores are EMPTY MemoryStores — every byte
     they serve came through the replication feed, so the fallback/
@@ -438,7 +454,7 @@ def run_soak(n_tiles: int, replicas: int, clients: int, duration_s: float,
             rng = random.Random(11)
             while not stop.wait(mutate_ms / 1e3):
                 batch = []
-                for d in rng.sample(docs, min(32, len(docs))):
+                for d in rng.sample(docs, min(mutate_n, len(docs))):
                     d = dict(d)
                     d["count"] = int(d["count"]) + 1
                     batch.append(d)
@@ -553,6 +569,451 @@ def run_soak(n_tiles: int, replicas: int, clients: int, duration_s: float,
         pub.close()
 
 
+# ------------------------------------------------------- fleet soak (r14)
+# The multi-process form: real serve-worker processes (SO_REUSEPORT,
+# `python -m heatmap_tpu.serve --workers N`) + separate client driver
+# processes, so neither side's GIL shades the other's latency numbers.
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _scrape_series(port: int, names, path: str = "/metrics") -> dict:
+    """{family: [values...]} across ALL label sets (and, on
+    /fleet/metrics, all proc= relabelings) — callers sum or max as the
+    metric's semantics demand."""
+    _, status, _, body, _ = _req(port, path)
+    out = {n: [] for n in names}
+    if status != 200:
+        return out
+    for line in body.decode().splitlines():
+        if not line or line.startswith("#"):
+            continue
+        series, _, val = line.rpartition(" ")
+        name = series.partition("{")[0]
+        if name in out:
+            try:
+                out[name].append(float(val))
+            except ValueError:
+                pass
+    return out
+
+
+def _client_worker_main(spec_path: str) -> None:
+    """One client driver process (pure stdlib — keep it import-light so
+    a fleet of these never touches jax).  Reads the spec JSON, drives
+    its slice of the logical clients until the shared deadline, prints
+    one result JSON line."""
+    import gzip as _gzip
+    import http.client
+    import io as _io
+    import json as _json
+    import struct
+    import threading as _threading
+    import time as _time
+
+    with open(spec_path, encoding="utf-8") as fh:
+        spec = _json.load(fh)
+    ports = spec["ports"]
+    fmt = spec["fmt"]
+    threads_n = spec["threads"]
+    deadline = spec["start_at"] + spec["duration_s"]
+    states = []
+    for i in range(spec["n_states"]):
+        gi = spec["offset"] + i
+        port = ports[gi % len(ports)]
+        seed = spec["seed"][str(port)]
+        # the r9 soak mix: 80% delta pollers / 20% ETag pollers, 95%
+        # warm (cursor seeded at the current view state) + 5% cold
+        cold = gi % 20 == 19
+        states.append({
+            "port": port,
+            "kind": "etag" if gi % 5 == 0 else "delta",
+            "since": 0 if cold else seed["since"],
+            "etag": None if cold else seed["etag"],
+        })
+
+    def req(port, path, headers=None):
+        hdrs = {"Accept-Encoding": "gzip"}
+        hdrs.update(headers or {})
+        t0 = _time.perf_counter()
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            c.request("GET", path, headers=hdrs)
+            r = c.getresponse()
+            body = r.read()
+            etag = r.getheader("ETag")
+            retry_after = r.getheader("Retry-After")
+            status = r.status
+            gz = r.getheader("Content-Encoding") == "gzip"
+        finally:
+            c.close()
+        ms = (_time.perf_counter() - t0) * 1e3
+        raw = len(body)
+        if gz and body:
+            body = _gzip.GzipFile(fileobj=_io.BytesIO(body)).read()
+        return ms, status, raw, body, etag, retry_after
+
+    results = []
+
+    def worker(idx):
+        lat, wire, n304, nreq, errs, shed = [], 0, 0, 0, 0, 0
+        my = range(idx, len(states), threads_n)
+        while _time.time() < deadline:
+            progressed = False
+            for i in my:
+                if _time.time() >= deadline:
+                    break
+                st = states[i]
+                try:
+                    if st["kind"] == "delta":
+                        q = f"/api/tiles/delta?since={st['since']}"
+                        if fmt == "bin":
+                            q += "&fmt=bin"
+                        ms, status, raw, body, _e, ra = req(st["port"], q)
+                        if status == 503 and ra:
+                            shed += 1
+                            continue
+                        if status != 200:
+                            errs += 1
+                            continue
+                        if fmt == "bin":
+                            st["since"] = struct.unpack_from(
+                                "<Q", body, 4)[0]
+                        else:
+                            st["since"] = _json.loads(body)["seq"]
+                    else:
+                        q = "/api/tiles/latest"
+                        if fmt == "bin":
+                            q += "?fmt=bin"
+                        hdrs = ({"If-None-Match": st["etag"]}
+                                if st["etag"] else {})
+                        ms, status, raw, _b, etag, ra = req(
+                            st["port"], q, hdrs)
+                        if status == 503 and ra:
+                            shed += 1
+                            continue
+                        if status not in (200, 304):
+                            errs += 1
+                            continue
+                        if etag:
+                            st["etag"] = etag
+                        n304 += status == 304
+                except Exception:
+                    errs += 1
+                    continue
+                lat.append(ms)
+                wire += raw
+                nreq += 1
+                progressed = True
+            if not progressed:
+                _time.sleep(0.005)
+        results.append((lat, wire, n304, nreq, errs, shed))
+
+    threads = [_threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(threads_n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lat: list = []
+    wire = n304 = nreq = errs = shed = 0
+    for wl, ww, w3, wn, we, ws_ in results:
+        lat.extend(wl)
+        wire += ww
+        n304 += w3
+        nreq += wn
+        errs += we
+        shed += ws_
+    print(json.dumps({"lat": lat, "wire": wire, "n304": n304,
+                      "nreq": nreq, "errors": errs, "shed": shed}))
+
+
+def _drive_clients(ports, clients, duration_s, client_procs, threads,
+                   fmt, seed) -> dict:
+    """Fan the logical clients across ``client_procs`` driver
+    subprocesses; returns the merged result dict."""
+    import subprocess
+    import tempfile
+
+    specs = []
+    per = clients // client_procs
+    start_at = time.time() + 0.2
+    for p in range(client_procs):
+        n = per + (clients % client_procs if p == client_procs - 1
+                   else 0)
+        spec = {"ports": ports, "fmt": fmt, "threads": threads,
+                "n_states": n, "offset": p * per,
+                "duration_s": duration_s, "start_at": start_at,
+                "seed": seed}
+        fd, path = tempfile.mkstemp(prefix="bench-soak-spec-",
+                                    suffix=".json")
+        with os.fdopen(fd, "w", encoding="utf-8") as fh:
+            json.dump(spec, fh)
+        specs.append(path)
+    procs = [subprocess.Popen([sys.executable, __file__,
+                               "--_client-worker", path],
+                              stdout=subprocess.PIPE)
+             for path in specs]
+    merged = {"lat": [], "wire": 0, "n304": 0, "nreq": 0,
+              "errors": 0, "shed": 0}
+    for pr, path in zip(procs, specs):
+        out, _ = pr.communicate(timeout=duration_s + 120)
+        os.unlink(path)
+        if pr.returncode != 0:
+            merged["errors"] += 1
+            continue
+        d = json.loads(out.decode().strip().splitlines()[-1])
+        merged["lat"].extend(d["lat"])
+        for k in ("wire", "n304", "nreq", "errors", "shed"):
+            merged[k] += d[k]
+    return merged
+
+
+def _seed_session(port: int, fmt: str) -> dict:
+    """Warm-session seed for one (port, fmt): the current ETag and
+    delta cursor a client that had been polling all along would hold."""
+    import struct
+
+    q = "?fmt=bin" if fmt == "bin" else ""
+    _ms, _s, _raw, _body, etag = _req(port, "/api/tiles/latest" + q)
+    if fmt == "bin":
+        _ms, _s, _raw, body, _e = _req(port,
+                                       "/api/tiles/delta?since=0&fmt=bin")
+        since = struct.unpack_from("<Q", body, 4)[0]
+    else:
+        _ms, _s, _raw, body, _e = _req(port, "/api/tiles/delta?since=0")
+        since = json.loads(body)["seq"]
+    return {"etag": etag, "since": since}
+
+
+def run_soak_fleet(n_tiles: int, serve_workers: int, clients: int,
+                   duration_s: float, client_procs: int, threads: int,
+                   sse_n: int, mutate_ms: float, fmt: str,
+                   audit: bool = True, json_ref: bool = True,
+                   ref_duration_s: float | None = None,
+                   mutate_n: int = 32) -> dict:
+    """The multi-process soak: subprocess serve workers on one
+    SO_REUSEPORT port follow the parent's delta-log feed; subprocess
+    client drivers poll them.  Returns the artifact dict (soak block +
+    json_reference + wire + audit stamps)."""
+    import subprocess
+    import tempfile
+
+    from heatmap_tpu.query import TileMatView
+    from heatmap_tpu.query.repl import DeltaLogPublisher
+
+    try:
+        slo_p99_ms = float(os.environ.get("HEATMAP_SLO_SERVE_P99_MS", "")
+                           or 1000.0)
+    except ValueError:
+        slo_p99_ms = 1000.0
+    feed = tempfile.mkdtemp(prefix="bench-repl-feed-")
+    chan = os.path.join(tempfile.mkdtemp(prefix="bench-fleet-"),
+                        "chan.json")
+    view_audit = None
+    if audit:
+        from heatmap_tpu.obs.audit import DigestTable
+
+        view_audit = DigestTable()
+    view = TileMatView(audit=view_audit)
+    pub = DeltaLogPublisher(view, feed, flush_s=0.02)
+    docs = _soak_docs(n_tiles)
+    view.apply_docs(docs)
+    port = _free_port()
+    env = os.environ.copy()
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "HEATMAP_STORE": "memory",
+        "HEATMAP_REPL_FEED": feed,
+        "HEATMAP_REPL_POLL_MS": "50",
+        "HEATMAP_SSE_MAX_CLIENTS": str(max(64, sse_n + 8)),
+        "HEATMAP_SUPERVISOR_CHANNEL": chan,
+        "HEATMAP_FLEET_PUBLISH_S": "1",
+        "HEATMAP_AUDIT": "1" if audit else "0",
+    })
+    fleet = subprocess.Popen(
+        [sys.executable, "-m", "heatmap_tpu.serve",
+         "--workers", str(serve_workers), "--port", str(port)],
+        env=env)
+    stop = threading.Event()
+    maxima = {"seq_lag": 0.0, "lag_s": 0.0}
+    try:
+        # every worker must bootstrap from the snapshot before the
+        # clock starts — the soak measures steady state, not boot
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            try:
+                m = _scrape_series(port, ("heatmap_repl_synced",),
+                                   path="/fleet/metrics")
+            except OSError:
+                time.sleep(0.5)
+                continue
+            if sum(m["heatmap_repl_synced"]) >= serve_workers:
+                break
+            time.sleep(0.5)
+        else:
+            raise RuntimeError("serve fleet never synced from the feed")
+
+        def mutator():
+            import random
+
+            rng = random.Random(11)
+            while not stop.wait(mutate_ms / 1e3):
+                batch = []
+                for d in rng.sample(docs, min(mutate_n, len(docs))):
+                    d = dict(d)
+                    d["count"] = int(d["count"]) + 1
+                    batch.append(d)
+                view.apply_docs(batch)
+
+        def lag_sampler():
+            while not stop.wait(0.5):
+                try:
+                    m = _scrape_series(
+                        port, ("heatmap_repl_seq_lag",
+                               "heatmap_repl_lag_seconds"),
+                        path="/fleet/metrics")
+                except OSError:
+                    continue
+                if m["heatmap_repl_seq_lag"]:
+                    maxima["seq_lag"] = max(
+                        maxima["seq_lag"],
+                        max(m["heatmap_repl_seq_lag"]))
+                lags = [v for v in m["heatmap_repl_lag_seconds"]
+                        if v >= 0]
+                if lags:
+                    maxima["lag_s"] = max(maxima["lag_s"], max(lags))
+
+        aux = [threading.Thread(target=mutator, daemon=True),
+               threading.Thread(target=lag_sampler, daemon=True)]
+        for t in aux:
+            t.start()
+        sse_deadline = time.perf_counter() + duration_s
+        sse_counts = [0] * sse_n
+        sse_threads = [
+            threading.Thread(target=_sse_reader,
+                             args=(port, sse_deadline, sse_counts, i),
+                             daemon=True)
+            for i in range(sse_n)]
+        for t in sse_threads:
+            t.start()
+        seed = {str(port): _seed_session(port, fmt)}
+        t0 = time.perf_counter()
+        main_leg = _drive_clients([port], clients, duration_s,
+                                  client_procs, threads, fmt, seed)
+        wall = time.perf_counter() - t0
+        for t in sse_threads:
+            t.join(timeout=10)
+        ref = None
+        if json_ref and fmt != "json":
+            # the JSON reference leg: SAME client mix, schedule and
+            # mutation cadence, negotiating the default JSON path —
+            # wire_reduction_x compares bytes per poll at equal
+            # schedule, so the slower leg's lower request count
+            # cannot flatter either side
+            seed_j = {str(port): _seed_session(port, "json")}
+            ref = _drive_clients(
+                [port], clients,
+                ref_duration_s or duration_s, client_procs, threads,
+                "json", seed_j)
+        stop.set()
+        for t in aux:
+            t.join(timeout=5)
+        fam = _scrape_series(
+            port, ("heatmap_repl_fallback_total",
+                   "heatmap_view_rebuilds_total",
+                   "heatmap_repl_synced",
+                   "heatmap_serve_shed_total",
+                   "heatmap_sse_encodes_total",
+                   "heatmap_sse_lagged_total",
+                   "heatmap_audit_digests_verified_total",
+                   "heatmap_audit_digest_mismatch_total",
+                   "heatmap_audit_residual"),
+            path="/fleet/metrics")
+        lat = main_leg["lat"]
+        lat_ref = (ref or {}).get("lat") or []
+        out_soak = {
+            "serve_workers": serve_workers,
+            "wire_format": fmt,
+            "clients": clients,
+            "client_procs": client_procs,
+            "threads_per_proc": threads,
+            "sse_connections": sse_n,
+            "sse_events": sum(sse_counts),
+            "duration_s": round(wall, 2),
+            "tiles": len(docs),
+            "requests": main_leg["nreq"],
+            "req_per_sec": round(main_leg["nreq"] / max(1e-9, wall), 1),
+            "errors": main_leg["errors"],
+            "shed": main_leg["shed"],
+            "ratio_304": round(main_leg["n304"]
+                               / max(1, main_leg["nreq"]), 4),
+            "bytes_sent_wire": main_leg["wire"],
+            "bytes_per_poll": round(main_leg["wire"]
+                                    / max(1, main_leg["nreq"]), 1),
+            "max_seq_lag": int(maxima["seq_lag"]),
+            "max_repl_lag_s": round(maxima["lag_s"], 3),
+            "store_scan_fallbacks": int(sum(
+                fam["heatmap_repl_fallback_total"])),
+            "view_rebuilds": int(sum(
+                fam["heatmap_view_rebuilds_total"])),
+            "zero_store_reads": (
+                sum(fam["heatmap_repl_fallback_total"]) == 0
+                and sum(fam["heatmap_view_rebuilds_total"]) == 0),
+            "replicas_synced": int(sum(fam["heatmap_repl_synced"])),
+            "sse_encodes": int(sum(fam["heatmap_sse_encodes_total"])),
+            "sse_lagged": int(sum(fam["heatmap_sse_lagged_total"])),
+        }
+        if lat:
+            out_soak.update(_quantiles(lat))
+            out_soak["slo_serve_p99_ms"] = slo_p99_ms
+            out_soak["p99_ok"] = out_soak["p99_ms"] <= slo_p99_ms
+        out = {"soak": out_soak}
+        if ref is not None:
+            bpp_ref = ref["wire"] / max(1, ref["nreq"])
+            bpp_main = main_leg["wire"] / max(1, main_leg["nreq"])
+            ref_block = {
+                "requests": ref["nreq"],
+                "errors": ref["errors"],
+                "bytes_sent_wire": ref["wire"],
+                "bytes_per_poll": round(bpp_ref, 1),
+            }
+            if lat_ref:
+                ref_block.update(_quantiles(lat_ref))
+            out["json_reference"] = ref_block
+            out["wire"] = {
+                "format": fmt,
+                "reduction_x": round(bpp_ref / max(1e-9, bpp_main), 1),
+            }
+        if audit:
+            residuals = [abs(v) for v in fam["heatmap_audit_residual"]]
+            out["audit"] = {
+                "enabled": True,
+                "max_residual": max(residuals) if residuals else 0,
+                "digests_verified": int(sum(
+                    fam["heatmap_audit_digests_verified_total"])),
+                "mismatches": int(sum(
+                    fam["heatmap_audit_digest_mismatch_total"])),
+            }
+        return out
+    finally:
+        stop.set()
+        fleet.terminate()
+        try:
+            fleet.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            fleet.kill()
+        pub.close()
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("n_tiles", nargs="?", type=int, default=20_000)
@@ -572,8 +1033,34 @@ def main() -> None:
                     help="real SSE connections held for the soak")
     ap.add_argument("--mutate-ms", type=float, default=500.0,
                     help="writer mutation cadence during the soak")
+    ap.add_argument("--mutate-n", type=int, default=32,
+                    help="tiles touched per mutation tick (fleet soak)")
+    ap.add_argument("--serve-workers", type=int, default=0,
+                    help="soak against a REAL multi-process serve "
+                         "fleet (python -m heatmap_tpu.serve "
+                         "--workers N on one SO_REUSEPORT port)")
+    ap.add_argument("--fmt", choices=("json", "bin"), default="json",
+                    help="wire format the soak clients negotiate")
+    ap.add_argument("--client-procs", type=int, default=4,
+                    help="client driver subprocesses (fleet soak)")
+    ap.add_argument("--no-json-ref", action="store_true",
+                    help="skip the JSON reference leg of a --fmt bin "
+                         "fleet soak")
+    ap.add_argument("--no-audit", action="store_true",
+                    help="fleet soak: leave HEATMAP_AUDIT off")
     args = ap.parse_args()
 
+    if args.soak and args.serve_workers > 0:
+        clients = args.clients if args.clients is not None else 100_000
+        threads = args.workers or 16
+        out = run_soak_fleet(
+            args.n_tiles, args.serve_workers, clients, args.duration,
+            args.client_procs, threads, args.sse,
+            mutate_ms=args.mutate_ms, fmt=args.fmt,
+            audit=not args.no_audit, json_ref=not args.no_json_ref,
+            mutate_n=args.mutate_n)
+        print(json.dumps(out))
+        return
     if args.soak:
         clients = args.clients if args.clients is not None else 10_000
         # GIL-bound co-located soak: past ~16 workers the extra threads
@@ -669,6 +1156,12 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    # the client driver subprocesses are pure stdlib: dispatch BEFORE
+    # any jax import so a fleet of them never pays (or trips over)
+    # accelerator bring-up
+    if len(sys.argv) >= 3 and sys.argv[1] == "--_client-worker":
+        _client_worker_main(sys.argv[2])
+        sys.exit(0)
     import jax
 
     jax.config.update("jax_platforms", "cpu")

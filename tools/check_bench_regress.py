@@ -234,10 +234,14 @@ def serve_artifact_round(path: str) -> int | None:
 
 
 def serve_metrics(path: str) -> tuple | None:
-    """(p99_ms, bytes_sent_wire, replicas|None) of one bench_serve
-    artifact — the ``soak`` block when present (replicated-fleet
-    rounds), else the concurrent delta mode; None when neither
-    parses (a broken run fails its own gate, not this one)."""
+    """(p99_ms, bytes_sent_wire, replicas|None, wire_format|None,
+    serve_workers|None) of one bench_serve artifact — the ``soak``
+    block when present (replicated-fleet rounds), else the concurrent
+    delta mode; None when neither parses (a broken run fails its own
+    gate, not this one).  ``wire_format`` and ``serve_workers`` are
+    the ISSUE 14 provenance stamps (multi-process fleet soaks);
+    pre-wire artifacts carry neither and stay comparable, like every
+    other stamp."""
     try:
         with open(path, encoding="utf-8") as fh:
             art = json.load(fh)
@@ -256,8 +260,13 @@ def serve_metrics(path: str) -> tuple | None:
         return None
     replicas = (art.get("soak") or {}).get("replicas") \
         or (art.get("repl") or {}).get("replicas")
+    fmt = (art.get("soak") or {}).get("wire_format") \
+        or (art.get("wire") or {}).get("format")
+    workers = (art.get("soak") or {}).get("serve_workers")
     return (float(p99), float(wire),
-            int(replicas) if isinstance(replicas, int) else None)
+            int(replicas) if isinstance(replicas, int) else None,
+            str(fmt) if isinstance(fmt, str) else None,
+            int(workers) if isinstance(workers, int) else None)
 
 
 def compare_serve(dir_path: str, threshold: float) -> int:
@@ -284,8 +293,8 @@ def compare_serve(dir_path: str, threshold: float) -> int:
         return 0
     (r_prev, _p_prev, m_prev), (r_new, _p_new, m_new) = \
         usable[-2], usable[-1]
-    (p99_prev, wire_prev, rep_prev) = m_prev
-    (p99_new, wire_new, rep_new) = m_new
+    (p99_prev, wire_prev, rep_prev, fmt_prev, wrk_prev) = m_prev
+    (p99_new, wire_new, rep_new, fmt_new, wrk_new) = m_new
     if rep_prev is not None and rep_new is not None \
             and rep_prev != rep_new:
         print(f"FAIL: replica-count mismatch — serve r{r_prev:02d} ran "
@@ -293,6 +302,24 @@ def compare_serve(dir_path: str, threshold: float) -> int:
               f"an N-replica fleet's latency/bytes cannot stand in for "
               f"another fleet width (or mask its regression) — re-run "
               f"the soak at the same replica count", file=sys.stderr)
+        return 1
+    if fmt_prev is not None and fmt_new is not None \
+            and fmt_prev != fmt_new:
+        print(f"FAIL: wire-format mismatch — serve r{r_prev:02d} "
+              f"negotiated {fmt_prev!r} but r{r_new:02d} negotiated "
+              f"{fmt_new!r}; the binary frame's bytes/latency cannot "
+              f"stand in for the JSON path's (or mask its regression) "
+              f"— re-run the soak with the same --fmt",
+              file=sys.stderr)
+        return 1
+    if wrk_prev is not None and wrk_new is not None \
+            and wrk_prev != wrk_new:
+        print(f"FAIL: serve-worker-count mismatch — serve "
+              f"r{r_prev:02d} ran {wrk_prev} worker process(es) but "
+              f"r{r_new:02d} ran {wrk_new}; an N-worker fleet's "
+              f"latency cannot stand in for another width (or mask "
+              f"its per-worker regression) — re-run the soak at the "
+              f"same --serve-workers", file=sys.stderr)
         return 1
     rc = 0
     for name, prev, new in (("p99_ms", p99_prev, p99_new),

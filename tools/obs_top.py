@@ -28,7 +28,10 @@ shard-imbalance ratio that makes a skewed partition obvious.  When
 serve-role members (or replication followers, query/repl.py) are on
 the channel, a serve-replica table follows too: replication seq lag,
 open SSE clients, and the 304 ratio per worker, plus the fleet's max
-seq lag.
+seq lag.  Workers serving the binary wire path (serve/wire.py) add a
+serve-wire table: per-worker open clients, negotiated-format mix
+(binary fraction), wire-vs-rendered byte rates, admission-shed count,
+and the SSE fan-out send-queue high-water.
 
 Usage:
     python tools/obs_top.py [--url http://127.0.0.1:5000] [--interval 2]
@@ -504,6 +507,45 @@ def render_fleet_frame(m: dict, prev: dict | None, dt: float,
         if lags:
             lines.append(f"  repl max seq lag {fmt(max(lags), digits=0)}"
                          f"   replicas {len(lags)}")
+        # serve-tier wire path (ISSUE 14): per-worker negotiated-format
+        # mix, wire-vs-rendered byte rates, admission sheds, and the
+        # SSE fan-out send-queue high-water — the row that says the
+        # binary path / coalesced fan-out is actually carrying load
+        wf_all = _by_proc_sum(m, "heatmap_serve_wire_format_total")
+        wf_bin: dict = {}
+        for labels, v in ((m or {}).get(
+                "heatmap_serve_wire_format_total") or {}).items():
+            p = _label_of(labels, "proc")
+            if p is not None and _label_of(labels, "fmt") == "bin":
+                wf_bin[p] = wf_bin.get(p, 0.0) + v
+        sent = _by_proc_sum(m, "heatmap_serve_sent_bytes_total")
+        sent_prev = _by_proc_sum(prev, "heatmap_serve_sent_bytes_total")
+        rend = _by_proc_sum(m, "heatmap_serve_rendered_bytes_total")
+        rend_prev = _by_proc_sum(prev,
+                                 "heatmap_serve_rendered_bytes_total")
+        shed = _by_proc_sum(m, "heatmap_serve_shed_total")
+        qhw = _by_proc(m, "heatmap_sse_queue_highwater")
+        if any(wf_all.get(t) for t in serve_tags):
+            def _rate(cur: dict, prv: dict, tag: str):
+                if prev is None or dt <= 0 or tag not in cur:
+                    return None
+                return max(0.0, cur[tag] - prv.get(tag, 0.0)) / dt
+            lines.append("")
+            lines.append(f"  {'serve wire':<14}{'clients':>8}"
+                         f"{'bin %':>8}{'wire B/s':>12}"
+                         f"{'rend B/s':>12}{'shed':>7}{'q hw':>6}")
+            for tag in serve_tags:
+                if not wf_all.get(tag):
+                    continue
+                binfrac = (wf_bin.get(tag, 0.0) / wf_all[tag]
+                           if wf_all.get(tag) else None)
+                lines.append(
+                    f"  {tag:<14}{fmt(sse.get(tag), digits=0):>8}"
+                    f"{fmt(binfrac, ' %', 100.0, 0):>8}"
+                    f"{fmt(_rate(sent, sent_prev, tag), digits=0):>12}"
+                    f"{fmt(_rate(rend, rend_prev, tag), digits=0):>12}"
+                    f"{fmt(shed.get(tag), digits=0):>7}"
+                    f"{fmt(qhw.get(tag), digits=0):>6}")
     # integrity observatory (obs.audit): one row per audited member —
     # worst conservation residual (boundary named), digests verified /
     # mismatched, last verified seq (replicas).  Absent without
