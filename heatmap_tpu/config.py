@@ -248,6 +248,38 @@ class Config:
                                        # snapshot
     repl_poll_ms: int = 200            # HEATMAP_REPL_POLL_MS: replica
                                        # follower tail-poll cadence
+    hist_dir: str = ""                 # HEATMAP_HIST_DIR: space-time
+                                       # history store (query/
+                                       # history.py).  On the writer:
+                                       # rotated repl segments retire
+                                       # here instead of being deleted
+                                       # and a compactor folds them
+                                       # into immutable (grid, parent
+                                       # cell, time bucket) chunks.
+                                       # On any serve worker: enables
+                                       # /api/tiles/range|at|diff and
+                                       # the /api/hist/* re-export.
+                                       # Empty disables the tier.
+    hist_retention_s: float = 604800.0  # HEATMAP_HIST_RETENTION_S:
+                                       # history retention (7 days).
+                                       # Chunks age out past it; raw
+                                       # segments prune only once
+                                       # digest-verified chunks cover
+                                       # them AND they age past it.
+    hist_bucket_s: int = 3600          # HEATMAP_HIST_BUCKET_S: time-
+                                       # bucket width of one chunk key
+    hist_parent_res: int = 3           # HEATMAP_HIST_PARENT_RES: H3
+                                       # parent resolution of the
+                                       # chunk partition key (clamped
+                                       # per cell to its own res)
+    hist_compact_s: float = 2.0        # HEATMAP_HIST_COMPACT_S:
+                                       # compaction cadence of the
+                                       # writer-side compactor thread
+    hist_backfill: bool = True         # HEATMAP_HIST_BACKFILL: replica
+                                       # cold-start backfill of pre-
+                                       # snapshot windows from history
+                                       # chunks (query/repl.py); 0
+                                       # disables
     govern: bool = False               # HEATMAP_GOVERN: adaptive
                                        # micro-batching (stream/
                                        # govern.py) — a feedback
@@ -461,6 +493,17 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
                            Config.repl_segments),
         repl_poll_ms=_int(e, "HEATMAP_REPL_POLL_MS",
                           Config.repl_poll_ms),
+        hist_dir=e.get("HEATMAP_HIST_DIR", Config.hist_dir),
+        hist_retention_s=_float(e, "HEATMAP_HIST_RETENTION_S",
+                                Config.hist_retention_s),
+        hist_bucket_s=_int(e, "HEATMAP_HIST_BUCKET_S",
+                           Config.hist_bucket_s),
+        hist_parent_res=_int(e, "HEATMAP_HIST_PARENT_RES",
+                             Config.hist_parent_res),
+        hist_compact_s=_float(e, "HEATMAP_HIST_COMPACT_S",
+                              Config.hist_compact_s),
+        hist_backfill=e.get("HEATMAP_HIST_BACKFILL", "1")
+        not in ("0", "false", ""),
         govern=e.get("HEATMAP_GOVERN", "0") not in ("0", "false", ""),
         govern_interval_s=_float(e, "HEATMAP_GOVERN_INTERVAL_S",
                                  Config.govern_interval_s),
@@ -562,6 +605,22 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
     if cfg.repl_poll_ms < 10:
         raise ValueError(
             f"HEATMAP_REPL_POLL_MS must be >= 10, got {cfg.repl_poll_ms}")
+    if cfg.hist_retention_s <= 0:
+        raise ValueError(
+            f"HEATMAP_HIST_RETENTION_S must be > 0, "
+            f"got {cfg.hist_retention_s}")
+    if cfg.hist_bucket_s < 60:
+        raise ValueError(
+            f"HEATMAP_HIST_BUCKET_S must be >= 60, "
+            f"got {cfg.hist_bucket_s}")
+    if not 0 <= cfg.hist_parent_res <= 15:
+        raise ValueError(
+            f"HEATMAP_HIST_PARENT_RES must be in 0..15, "
+            f"got {cfg.hist_parent_res}")
+    if cfg.hist_compact_s <= 0:
+        raise ValueError(
+            f"HEATMAP_HIST_COMPACT_S must be > 0, "
+            f"got {cfg.hist_compact_s}")
     if cfg.shards < 1:
         raise ValueError(f"HEATMAP_SHARDS must be >= 1, got {cfg.shards}")
     if not 0 <= cfg.shard_index < cfg.shards:

@@ -211,6 +211,7 @@ def publish_member_snapshot(channel_path: str, tag: str, *, role: str,
                             lineage: list | None = None,
                             audit: dict | None = None,
                             cq: dict | None = None,
+                            hist: dict | None = None,
                             left: bool = False) -> None:
     """Atomic write of one member's full observability snapshot:
     Prometheus exposition text of its registry, its freshness summary,
@@ -251,6 +252,13 @@ def publish_member_snapshot(channel_path: str, tag: str, *, role: str,
         payload["audit"] = audit
     if cq:
         payload["cq"] = cq
+    if hist:
+        # the member's space-time history block (query/history.py
+        # HistoryCompactor.member_block / serve-side
+        # compaction_status): chunks, covered span, compaction lag,
+        # backfills — absent on members without the tier, keeping
+        # snapshots byte-compatible
+        payload["hist"] = hist
     if left:
         payload["left"] = True
     try:

@@ -553,6 +553,60 @@ class TileMatView:
             # bootstrap — a resync never mints phantom transitions
             self._notify_watchers({"kind": "reset", "seq": seq})
 
+    def backfill_window(self, grid: str, ws: int, docs,
+                        stale_ts: float | None = None) -> bool:
+        """History cold-start backfill (query/history.py): install one
+        PRE-LATEST window's docs without advancing seq, firing the
+        replication hook/watchers, or touching the audit table — the
+        window is historical context, not a new mutation, so the
+        replica's seq/ETag/delta stream stays byte-interchangeable
+        with the writer's.  Refused (False) when the grid is unknown
+        or empty, the window already exists, or ``ws`` would become
+        the latest window (backfill must never change what /latest
+        serves)."""
+        docs = list(docs)
+        if not docs:
+            return False
+        ws = int(ws)
+        with self._cond:
+            g = self._grids.get(grid)
+            if g is None:
+                return False
+            latest = g.latest_ws()
+            if latest is None or ws >= latest or ws in g.windows:
+                return False
+            d0 = docs[0]
+            w = g.windows[ws] = {}
+            g.meta[ws] = (d0.get("windowStart"), d0.get("windowEnd"),
+                          stale_ts)
+            for d in docs:
+                w[d["cellId"]] = d
+                if g.pyramid is not None:
+                    try:
+                        g.pyramid.apply(ws, int(d["cellId"], 16),
+                                        None, d)
+                    except ValueError:
+                        g.pyramid = None
+            return True
+
+    def has_window(self, grid: str, ws: int) -> bool:
+        with self._lock:
+            g = self._grids.get(grid)
+            return g is not None and int(ws) in g.windows
+
+    def window_docs(self, grid: str) -> dict:
+        """{ws: (ws_dt, we_dt, docs)} of the grid's live windows under
+        ONE lock acquisition — the live overlay /api/tiles/range
+        merges over the compacted chunk store (the view is always
+        fresher than any chunk covering the same window)."""
+        with self._lock:
+            g = self._grids.get(grid)
+            if g is None:
+                return {}
+            self._evict(grid, g)
+            return {ws: (g.meta[ws][0], g.meta[ws][1], list(w.values()))
+                    for ws, w in g.windows.items()}
+
     def export_state(self) -> dict:
         """The publisher's snapshot of the whole view under ONE lock
         acquisition (``replica_reset``'s input).  Window dicts are

@@ -114,9 +114,15 @@ class DeltaLogPublisher:
 
     def __init__(self, view, feed_dir: str, seg_bytes: int = 1 << 22,
                  segments: int = 4, flush_s: float = 0.05,
-                 registry=None, start: bool = True):
+                 registry=None, start: bool = True, hist=None):
         self.view = view
         self.dir = feed_dir
+        # space-time history hand-off (query/history.py HistoryLog,
+        # HEATMAP_HIST_DIR): with it, rotated segments are RETIRED into
+        # the durable log instead of deleted, and every snapshot is
+        # adopted as a view-at-seq replay base — the feed becomes the
+        # system's log of record instead of a replication detail
+        self.hist = hist
         self.seg_bytes = max(4096, int(seg_bytes))
         self.segments = max(1, int(segments))
         self.flush_s = flush_s
@@ -144,11 +150,21 @@ class DeltaLogPublisher:
         os.makedirs(feed_dir, exist_ok=True)
         # boot sweep: a restarted writer's stale epoch must be
         # unreachable — followers pin the epoch, and these files would
-        # otherwise accumulate forever
+        # otherwise accumulate forever.  With history attached, the
+        # dead epoch's segments (including its never-rotated live
+        # tail, which a crash left behind) RETIRE into the durable log
+        # instead of vanishing — a writer crash loses no history.
         for p in glob.glob(os.path.join(glob.escape(feed_dir),
-                                        "seg-*.jsonl")) + \
-                glob.glob(os.path.join(glob.escape(feed_dir),
-                                       "snapshot-*.json")):
+                                        "seg-*.jsonl")):
+            if self.hist is not None:
+                self.hist.retire(p)
+            else:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+        for p in glob.glob(os.path.join(glob.escape(feed_dir),
+                                        "snapshot-*.json")):
             try:
                 os.remove(p)
             except OSError:
@@ -177,18 +193,25 @@ class DeltaLogPublisher:
                             f"seg-{self.epoch}-{start_seq:012d}.jsonl")
 
     def _open_segment(self, start_seq: int) -> None:
-        self._fh = open(self._seg_path(start_seq), "a",
-                        encoding="utf-8")
+        self._fh_path = self._seg_path(start_seq)
+        self._fh = open(self._fh_path, "a", encoding="utf-8")
         self._fh_bytes = 0
 
     def _write_snapshot(self) -> None:
         state = self.view.export_state()
         self._snapshot_seq = state["seq"]
         self._last_seq = max(self._last_seq, state["seq"])
+        payload = json.loads(dumps({"epoch": self.epoch,
+                                    "seq": state["seq"],
+                                    "state": state}))
         atomic_write_json(
             os.path.join(self.dir, f"snapshot-{self.epoch}.json"),
-            json.loads(dumps({"epoch": self.epoch, "seq": state["seq"],
-                              "state": state})))
+            payload)
+        if self.hist is not None:
+            # every snapshot (boot + each rotation) is a replay base:
+            # retention can then prune old segments without orphaning
+            # view-at-seq reconstruction of the retained tail
+            self.hist.adopt_snapshot(self.epoch, state["seq"], payload)
 
     def _write_meta(self, closed: bool = False) -> None:
         payload = {
@@ -216,10 +239,17 @@ class DeltaLogPublisher:
         keep = self.segments - 1
         drop = segs if keep == 0 else segs[:-keep]
         for p in drop:
-            try:
-                os.remove(p)
-            except OSError:
-                pass
+            # hand rotated segments to the history tier instead of
+            # deleting them (query/history.py): the chunk compactor
+            # owns their lifetime from here, and prune ordering (chunk
+            # written + digest-verified first) guarantees zero loss
+            if self.hist is not None:
+                self.hist.retire(p)
+            else:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
         segs = segs[len(drop):]
         self._min_seq = (_seg_start(segs[0]) if segs
                          else self._last_seq + 1)
@@ -290,6 +320,18 @@ class DeltaLogPublisher:
                     # teardown finally still has work to do after us
                     log.warning("repl segment close failed: %s", e)
                 self._fh = None
+                if self.hist is not None:
+                    # clean shutdown completes the history: snapshot
+                    # FIRST (so a late follower still catches up
+                    # without the retired tail), then retire the live
+                    # segment into the durable log
+                    try:
+                        self._write_snapshot()
+                        self.hist.retire(self._fh_path)
+                        self._min_seq = self._last_seq + 1
+                    except OSError as e:
+                        log.warning("history tail retire failed: %s",
+                                    e)
             try:
                 self._write_meta(closed=True)
             except OSError as e:
@@ -464,11 +506,22 @@ class ReplicaViewFollower:
     never report ok-but-empty (r9 satellite)."""
 
     def __init__(self, view, source, poll_s: float = 0.2,
-                 registry=None, clock=time.time, audit=None):
+                 registry=None, clock=time.time, audit=None,
+                 hist_source=None):
         self.view = view
         self.source = source
         self.poll_s = max(0.01, float(poll_s))
         self.clock = clock
+        # space-time history cold-start backfill (query/history.py):
+        # after every snapshot bootstrap, pre-snapshot windows still
+        # inside their TTL are restored into the view from the chunk
+        # store — a writer restart that shrank the snapshot no longer
+        # silently narrows this replica's history.  The pending flag
+        # keeps retrying while the bootstrapped view is still empty (a
+        # fresh writer's boot snapshot has no grids to anchor on yet).
+        self.hist_source = hist_source
+        self._backfill_pending = False
+        self._backfill_tries = 0
         # integrity observatory (obs.audit, HEATMAP_AUDIT=1): per
         # applied record, recompute this replica's own (grid, window)
         # digest and verify it against the writer's published ``dg`` —
@@ -492,7 +545,7 @@ class ReplicaViewFollower:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.c_applied = self.c_snapshots = self.c_errors = None
-        self.c_fallback = None
+        self.c_fallback = self.c_backfill = None
         self._g_lag = self._g_lag_s = self._g_synced = None
         if registry is not None:
             self.c_applied = registry.counter(
@@ -527,6 +580,11 @@ class ReplicaViewFollower:
                 "heatmap_repl_synced",
                 "1 once the first snapshot applied (until then the "
                 "replica reports degraded, never ok-but-empty)")
+            self.c_backfill = registry.counter(
+                "heatmap_hist_backfill_total",
+                "pre-snapshot windows cold-start backfilled into this "
+                "replica's view from the space-time history chunks "
+                "(query/history.py) after a snapshot bootstrap")
 
     # ------------------------------------------------------------- state
     def seq_lag(self) -> int:
@@ -626,6 +684,8 @@ class ReplicaViewFollower:
                 self.c_snapshots.inc()
             log.info("replica bootstrapped from snapshot: epoch=%s "
                      "seq=%d", self.epoch, self.applied)
+            self._backfill_pending = self.hist_source is not None
+            self._backfill_tries = 0
         min_seq = int(meta.get("min_seq", 1))
         if self.applied + 1 < min_seq and self._last_seq_seen > self.applied:
             # fell behind the retained log: records we need were
@@ -666,8 +726,94 @@ class ReplicaViewFollower:
             if self.c_applied is not None:
                 self.c_applied.inc()
         self._last_seq_seen = max(self._last_seq_seen, self.applied)
+        if self._backfill_pending:
+            # AFTER the tail applies: additive only (never touches
+            # latest/seq), and a failure must not fail the catch-up
+            # round that just succeeded.  Stays pending until the view
+            # has at least one anchorable grid — a fresh writer's boot
+            # snapshot is empty, and its first windows arrive by tail.
+            try:
+                n_bf, anchored = self._backfill()
+                self._backfill_tries += 1
+                # bounded retries: a chunk store holding only grids
+                # this feed never serves (relabeled resolutions) must
+                # not rescan the full index on every poll forever
+                if anchored or self._backfill_tries >= 20:
+                    self._backfill_pending = False
+                if n_bf:
+                    log.info("replica backfilled %d pre-snapshot "
+                             "window(s) from history chunks", n_bf)
+            except Exception:  # noqa: BLE001 - history is best-effort here
+                # a TRANSIENT index/chunk read failure keeps the
+                # backfill pending (retried next poll, same bounded
+                # tries) — one connection reset at bootstrap must not
+                # silently narrow the replica's history for good
+                self._backfill_tries += 1
+                if self._backfill_tries >= 20:
+                    self._backfill_pending = False
+                log.warning("history backfill attempt failed (retrying"
+                            " up to %d times)",
+                            20 - self._backfill_tries, exc_info=True)
         self._gauges()
         return n
+
+    def _backfill(self) -> tuple[int, bool]:
+        """Install pre-snapshot, still-inside-TTL windows from the
+        history chunk store into the replica view (additive: no seq
+        advance, no hooks, latest window untouched).  Returns (windows
+        installed — counted in ``heatmap_hist_backfill_total`` —,
+        anchored: whether the view had any grid to backfill against)."""
+        if self.hist_source is None:
+            return 0, True
+        from heatmap_tpu.query.history import decode_chunk
+
+        now = self.clock()
+        anchored = False
+        by_gw: dict = {}
+        for meta in self.hist_source.index():
+            grid = meta.get("grid")
+            if not grid:
+                continue
+            for ws_s, wm in (meta.get("windows") or {}).items():
+                try:
+                    ws = int(ws_s)
+                except (TypeError, ValueError):
+                    continue
+                stale = wm.get("stale")
+                if stale is not None and stale <= now:
+                    continue  # would evict on first read anyway
+                by_gw.setdefault((grid, ws), []).append(meta)
+        installed = 0
+        for (grid, ws), metas in sorted(by_gw.items()):
+            latest = self.view.latest_ws_of(grid)
+            if latest is None:
+                continue
+            anchored = True
+            if ws >= latest or self.view.has_window(grid, ws):
+                continue
+            cells: dict = {}
+            stale = None
+            for meta in metas:
+                buf = self.hist_source.chunk_bytes(meta.get("name"))
+                if buf is None:
+                    continue
+                try:
+                    _m, windows = decode_chunk(buf)
+                except ValueError:
+                    continue
+                part = windows.get(ws)
+                if part is not None:
+                    for d in part["docs"]:
+                        cells[d.get("cellId")] = d
+                wm = (meta.get("windows") or {}).get(str(ws)) or {}
+                if wm.get("stale") is not None:
+                    stale = wm["stale"]
+            if cells and self.view.backfill_window(
+                    grid, ws, list(cells.values()), stale_ts=stale):
+                installed += 1
+                if self.c_backfill is not None:
+                    self.c_backfill.inc()
+        return installed, anchored or not by_gw
 
     def _gauges(self) -> None:
         if self._g_lag is not None:

@@ -12,7 +12,26 @@ Contract parity notes (all against /root/reference/app.py):
   answers 304 without invoking the renderer.
 - GET /api/positions/latest → FeatureCollection of Point features,
   properties {provider, vehicleId, ts} (app.py:71-88), with the same
-  ETag/304 handling keyed on the store write-version.
+  ETag/304 handling keyed on the store write-version.  Negotiates the
+  compact binary positions frame via ``?fmt=bin`` / ``Accept``
+  (serve/wire.py encode_positions; format-keyed ETag, ``Vary:
+  Accept``) — decode reproduces the JSON representation byte-for-byte.
+- Space-time history tier (query/history.py, HEATMAP_HIST_DIR — 503
+  without it; replicas following an http feed read the writer's
+  /api/hist/* re-export instead):
+  - GET /api/tiles/range?grid&t0&t1[&res][&fmt=bin] → the per-window
+    series over [t0, t1) from the compacted chunk store (live view
+    windows overlaid) plus a cross-range aggregate; ``res`` rolls each
+    window up via the pyramid math; ``fmt=bin`` ships the series as
+    length-prefixed tile wire frames.  Content-hash strong ETag.
+  - GET /api/tiles/at?seq=[&grid][&epoch] → the latest-window
+    FeatureCollection of the view RECONSTRUCTED at that seq from an
+    adopted snapshot + the sealed log (view_at_seq); 404 when the seq
+    predates retention or overruns the head.
+  - GET /api/tiles/diff?t0&t1[&grid][&res] → per-cell count deltas
+    between the windows anchored at t0 and t1 (day-over-day diffs).
+  - GET /api/hist/index | /api/hist/chunk?name= → the chunk store
+    re-exported for remote replicas (cold-start backfill + range).
 - GET /api/tiles/delta?since=<seq> → changed cells only since view seq
   ``since`` + the next seq: {"mode": "delta"|"full", "seq", "grid",
   "windowStart", "features": [...]}.  mode="full" means REPLACE the
@@ -490,6 +509,22 @@ def _qs_int(params: dict, name: str, default: int, cap: int) -> int:
         return default
 
 
+def _qs_epoch_s(params: dict, name: str) -> tuple[float | None, bool]:
+    """Epoch-seconds param: (value, ok).  Absent -> (None, True);
+    garbage -> (None, False) so the caller can answer 400 instead of
+    silently substituting a time the client did not ask for."""
+    raw = params.get(name)
+    if raw is None:
+        return None, True
+    try:
+        v = float(raw)
+    except (TypeError, ValueError):
+        return None, False
+    if not -1e12 < v < 1e12:
+        return None, False
+    return v, True
+
+
 _FIELD_RE = None  # compiled lazily (re import stays off the hot path)
 
 
@@ -548,6 +583,19 @@ def _parse_res(params: dict) -> tuple[int | None, str | None]:
     return res, None
 
 
+def _hist_res_err(grid: str | None, res: int | None) -> str | None:
+    """Validate a history rollup resolution against the grid's base:
+    history rollups compute on the fly (no pyramid-levels limit), so
+    any resolution AT or COARSER than the base is fine; finer is not."""
+    from heatmap_tpu.query.matview import _grid_base_res
+
+    base = _grid_base_res(grid)
+    if res is not None and res != base and (base is None or res > base):
+        return (f"res={res} must be at or coarser than the grid's "
+                f"base resolution")
+    return None
+
+
 def _parse_bbox(params: dict) -> tuple[tuple | None, str | None]:
     """Optional ``bbox=minLon,minLat,maxLon,maxLat``: (bbox, None) or
     (None, err)."""
@@ -566,11 +614,14 @@ def _parse_bbox(params: dict) -> tuple[tuple | None, str | None]:
     return (lo_lon, lo_lat, hi_lon, hi_lat), None
 
 
-def _negotiate_fmt(environ: dict, params: dict) -> tuple:
-    """Negotiated tile wire format: ``?fmt=bin|json`` wins, else an
-    ``Accept`` header naming the binary media type, else the default
-    JSON path (kept byte-identical — negotiation must never perturb a
-    legacy client).  Returns (fmt, None) or (None, error)."""
+def _negotiate_fmt(environ: dict, params: dict,
+                   ctype: str | None = None) -> tuple:
+    """Negotiated binary wire format: ``?fmt=bin|json`` wins, else an
+    ``Accept`` header naming THIS endpoint's binary media type
+    (``ctype``; default the tile frame — a positions Accept must not
+    negotiate a tile frame it cannot decode, and vice versa), else the
+    default JSON path (kept byte-identical — negotiation must never
+    perturb a legacy client).  Returns (fmt, None) or (None, error)."""
     from heatmap_tpu.serve import wire
 
     raw = params.get("fmt")
@@ -580,7 +631,7 @@ def _negotiate_fmt(environ: dict, params: dict) -> tuple:
         if raw == "json":
             return "json", None
         return None, f"fmt= must be bin or json, got {raw[:32]!r}"
-    if wire.CONTENT_TYPE in environ.get("HTTP_ACCEPT", ""):
+    if (ctype or wire.CONTENT_TYPE) in environ.get("HTTP_ACCEPT", ""):
         return "bin", None
     return "json", None
 
@@ -746,6 +797,9 @@ _ADMIT_PATHS = {
     "/api/tiles/delta": "delta",
     "/api/tiles/topk": "topk",
     "/api/positions/latest": "positions",
+    "/api/tiles/range": "range",
+    "/api/tiles/at": "at",
+    "/api/tiles/diff": "diff",
 }
 
 
@@ -778,6 +832,23 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
     follower = None
     repl_dir = getattr(cfg, "repl_dir", "") if cfg else ""
     repl_feed = getattr(cfg, "repl_feed", "") if cfg else ""
+    # ---- space-time history tier (query/history.py, ISSUE 15) ---------
+    # A local HEATMAP_HIST_DIR serves range/at/diff straight off the
+    # chunk store (and re-exports it at /api/hist/* for remote
+    # replicas); a replica following an http feed reads the writer's
+    # re-export over the same transport.  The source also feeds the
+    # follower's cold-start backfill below.
+    hist_dir = getattr(cfg, "hist_dir", "") if cfg else ""
+    hist_src = None
+    if hist_dir:
+        from heatmap_tpu.query.history import FileHistorySource
+
+        hist_src = FileHistorySource(hist_dir)
+    elif repl_feed.startswith("http://") \
+            or repl_feed.startswith("https://"):
+        from heatmap_tpu.query.history import HttpHistorySource
+
+        hist_src = HttpHistorySource(repl_feed)
     # Integrity observatory (obs.audit, HEATMAP_AUDIT=1): with a
     # runtime attached its AuditState is reused (same registry); a
     # serve-only worker builds its own — the replica half that
@@ -835,7 +906,10 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 poll_s=(getattr(cfg, "repl_poll_ms", 200)
                         if cfg else 200) / 1e3,
                 registry=serve_reg,
-                audit=serve_audit)
+                audit=serve_audit,
+                hist_source=(hist_src
+                             if getattr(cfg, "hist_backfill", True)
+                             else None))
             follower.start()
     # Continuous spatial query engine (query.continuous): standing
     # bbox/polygon/topk/geofence/threshold subscriptions over the
@@ -859,6 +933,15 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                        if cfg else 4096),
             default_ttl_s=(getattr(cfg, "cq_ttl_s", 3600.0)
                            if cfg else 3600.0))
+    hist_reader = None
+    if hist_src is not None:
+        from heatmap_tpu.query.history import HistoryReader
+
+        hist_reader = HistoryReader(hist_src, view=view)
+    # view-at-seq replays are full log reconstructions: memoize the
+    # rendered bodies of the last few (epoch-keyed — a writer restart
+    # invalidates naturally because the epoch changes)
+    hist_at_cache: dict = {}
     if serve_audit is not None and runtime is None:
         serve_audit.attach(view=view, follower=follower)
         # NOTE: a serve-only app never PUBLISHES to repl_dir implicitly
@@ -922,9 +1005,12 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
         stats.rendered_bytes.labels(endpoint=endpoint).inc(len(data))
 
     def _cached_json(key, build, endpoint):
-        # builders return pre-serialized JSON strings
+        # builders return pre-serialized JSON strings (or bytes — the
+        # binary positions frame rides the same cache, keyed by format)
         if cache_ttl_s <= 0:
-            data = build().encode("utf-8")
+            data = build()
+            if not isinstance(data, bytes):
+                data = data.encode("utf-8")
             _account_render(endpoint, data)
             return data, None
         now = time.monotonic()
@@ -932,7 +1018,9 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
         hit = render_cache.get(key)
         if hit is not None and hit[0] == ver and hit[1] > now:
             return hit[2], hit[3]
-        data = build().encode("utf-8")
+        data = build()
+        if not isinstance(data, bytes):
+            data = data.encode("utf-8")
         _account_render(endpoint, data)
         gz = gzip.compress(data, compresslevel=1) if len(data) >= 1024 \
             else None
@@ -991,6 +1079,20 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
             fleet_state["agg"] = FleetAggregator(chan_path)
         return fleet_state["agg"]
 
+    # compaction_status is a chunk/log directory scan; /healthz probes
+    # and the 2 s member-publish cadence must not each pay it — one
+    # short memo serves both
+    _hist_memo: dict = {}
+
+    def _hist_status() -> dict:
+        from heatmap_tpu.query.history import compaction_status
+
+        now = time.monotonic()
+        if not _hist_memo or now - _hist_memo.get("t", 0.0) >= 2.0:
+            _hist_memo["st"] = compaction_status(hist_dir)
+            _hist_memo["t"] = now
+        return _hist_memo["st"]
+
     def _serve_checks() -> tuple[dict, bool]:
         """The serve tier's /healthz contribution (query view state):
         replication sync/lag/staleness on a replica, store catch-up on
@@ -1017,6 +1119,25 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
             ac, a_degraded = serve_audit.healthz_checks()
             checks.update(ac)
             degraded |= a_degraded
+        if hist_dir:
+            # compaction-lag SLO: rotated segments must keep turning
+            # into chunks; a stalled compactor silently narrows the
+            # durable history even though serving looks healthy.  Any
+            # digest mismatch degrades too (and freezes pruning).
+            st = _hist_status()
+            budget = _slo("HEATMAP_SLO_HIST_LAG_S", 120.0)
+            ok = st["lag_s"] <= budget
+            checks["hist_compaction_lag_s"] = {
+                "value": round(st["lag_s"], 3), "budget": budget,
+                "ok": ok, "chunks": st["chunks"],
+                "pending_segments": st["pending_segments"]}
+            degraded |= not ok
+            mm = st.get("mismatches", 0)
+            if mm:
+                checks["hist_digest"] = {
+                    "value": f"{mm} compaction digest mismatch(es)",
+                    "ok": False}
+                degraded = True
         if cq_engine is not None and cq_engine.registered:
             # continuous-query eval lag: standing subscribers being
             # pushed stale matches is an SLO breach; a query-less
@@ -1586,8 +1707,285 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                                      "application/json")])
                     return [b'{"error": "GET, POST or DELETE"}']
                 ctype = "application/json"
+            elif path == "/api/tiles/range":
+                # space-time history (query/history.py): per-window
+                # series + cross-range aggregate over [t0, t1), served
+                # from the compacted chunk store with the live view's
+                # windows overlaid (latest / not-yet-compacted windows
+                # serve without waiting for the compactor)
+                endpoint = "range"
+                params = _qs_params(environ.get("QUERY_STRING", ""))
+                grid, err = _parse_grid(params, default_grid)
+                if err:
+                    return _bad_request(err)
+                res, err = _parse_res(params)
+                if err:
+                    return _bad_request(err)
+                fmt, err = _negotiate_fmt(environ, params)
+                if err:
+                    return _bad_request(err)
+                if hist_reader is None:
+                    return _unavailable(
+                        "the space-time history tier needs "
+                        "HEATMAP_HIST_DIR (or an http replication "
+                        "feed whose writer exposes /api/hist/*)")
+                t0, ok0 = _qs_epoch_s(params, "t0")
+                t1, ok1 = _qs_epoch_s(params, "t1")
+                if not ok0 or not ok1 or t0 is None:
+                    return _bad_request(
+                        "range needs t0= (epoch seconds; t1= defaults "
+                        "to now)")
+                if t1 is None:
+                    t1 = time.time()
+                if t0 >= t1:
+                    return _bad_request("t0 must be before t1")
+                from heatmap_tpu.query.history import (aggregate_range,
+                                                       rollup_window)
+                from heatmap_tpu.query.matview import _grid_base_res
+
+                err = _hist_res_err(grid, res)
+                if err:
+                    return _bad_request(err)
+                base = _grid_base_res(grid)
+                extra_headers.append(("Vary", "Accept"))
+                per_window = hist_reader.windows_in_range(grid, t0, t1)
+                win_out = []
+                for ws in sorted(per_window):
+                    docs = per_window[ws]["docs"]
+                    if not docs:
+                        continue
+                    ws_dt = docs[0].get("windowStart")
+                    we_dt = docs[0].get("windowEnd")
+                    if res is not None and res != base:
+                        docs = sorted(
+                            rollup_window(docs, res, base, ws_dt,
+                                          we_dt),
+                            key=lambda d: d["cellId"])
+                    win_out.append((ws, ws_dt, we_dt, docs))
+                ctype = "application/json"
+                if fmt == "bin":
+                    # the window series as length-prefixed tile wire
+                    # frames (one per window, seq = windowStart epoch
+                    # seconds); the cross-range aggregate is JSON-only
+                    try:
+                        body_b = bytearray()
+                        for ws, ws_dt, _we, docs in win_out:
+                            frame = wiremod.encode("full", ws, grid,
+                                                   ws_dt, docs,
+                                                   native=wire_ops)
+                            body_b += len(frame).to_bytes(4, "little")
+                            body_b += frame
+                        data = bytes(body_b)
+                        ctype = wiremod.CONTENT_TYPE
+                    except ValueError:
+                        log.warning("binary range frame "
+                                    "unrepresentable; serving JSON",
+                                    exc_info=True)
+                        fmt = "json"
+                if fmt == "json":
+                    t0_dt = dt.datetime.fromtimestamp(t0, dt.timezone.utc)
+                    t1_dt = dt.datetime.fromtimestamp(t1, dt.timezone.utc)
+                    agg = aggregate_range(
+                        {ws: {"docs": docs}
+                         for ws, _w, _e, docs in win_out},
+                        t0_dt, t1_dt)
+                    parts = []
+                    for ws, ws_dt, we_dt, docs in win_out:
+                        head_w = json.dumps({
+                            "windowStart": _iso(ws_dt)
+                            if ws_dt is not None else None,
+                            "windowEnd": _iso(we_dt)
+                            if we_dt is not None else None})
+                        parts.append(
+                            head_w[:-1] + ', "features": ['
+                            + ", ".join(_feature_json(d) for d in docs)
+                            + ']}')
+                    head = json.dumps({"grid": grid, "t0": t0,
+                                       "t1": t1, "res": res,
+                                       "windows": len(win_out)})
+                    data = (head[:-1] + ', "series": ['
+                            + ", ".join(parts)
+                            + '], "aggregate": {"features": ['
+                            + ", ".join(_feature_json(d) for d in agg)
+                            + ']}}').encode("utf-8")
+                _account_render(endpoint, data)
+                stats.wire_format.labels(endpoint=endpoint,
+                                         fmt=fmt).inc()
+                import hashlib
+
+                etag = f'"hr.{hashlib.md5(data).hexdigest()[:16]}"'
+                if _inm_match(environ, etag):
+                    return _not_modified(etag, endpoint,
+                                         vary_accept=True)
+                extra_headers.append(("ETag", etag))
+            elif path == "/api/tiles/at":
+                # view-at-seq replay (query/history.py view_at_seq):
+                # the materialized view reconstructed from an adopted
+                # snapshot + the sealed log at one historical seq —
+                # incident forensics next to the flight recorder's
+                # episode dumps
+                endpoint = "at"
+                params = _qs_params(environ.get("QUERY_STRING", ""))
+                grid, err = _parse_grid(params, default_grid)
+                if err:
+                    return _bad_request(err)
+                if not hist_dir:
+                    return _unavailable(
+                        "view-at-seq replay needs a local "
+                        "HEATMAP_HIST_DIR (the sealed log lives "
+                        "there)")
+                seq = _qs_int(params, "seq", 0, 1 << 62)
+                if seq <= 0:
+                    return _bad_request("at needs seq= > 0")
+                from heatmap_tpu.query.history import view_at_seq
+                from heatmap_tpu.query.repl import read_meta
+
+                feed = repl_dir or (
+                    repl_feed if repl_feed
+                    and not repl_feed.startswith("http") else None)
+                epoch = params.get("epoch") or (
+                    read_meta(feed).get("epoch") if feed else None)
+                key = (epoch, seq, grid)
+                data = (hist_at_cache.get(key)
+                        if epoch is not None else None)
+                if data is None:
+                    try:
+                        v_at = view_at_seq(hist_dir, seq,
+                                           feed_dir=feed, epoch=epoch)
+                    except ValueError as e:
+                        start_response("404 Not Found",
+                                       [("Content-Type",
+                                         "application/json")])
+                        return [json.dumps({"error": str(e)}).encode()]
+                    ws_dt, docs = v_at.latest_docs(grid)
+                    head = json.dumps({
+                        "seq": seq, "grid": grid,
+                        "windowStart": _iso(ws_dt)
+                        if ws_dt is not None else None})
+                    data = (head[:-1] + ', "features": ['
+                            + ", ".join(_feature_json(d) for d in docs)
+                            + ']}').encode("utf-8")
+                    if epoch is not None:
+                        if len(hist_at_cache) >= 8:
+                            hist_at_cache.pop(
+                                next(iter(hist_at_cache)))
+                        hist_at_cache[key] = data
+                _account_render(endpoint, data)
+                ctype = "application/json"
+            elif path == "/api/tiles/diff":
+                # day-over-day diff: the window states anchored at t0
+                # and t1 compared per cell (delta = count@t1 -
+                # count@t0; cells present on only one side count 0 on
+                # the other)
+                endpoint = "diff"
+                params = _qs_params(environ.get("QUERY_STRING", ""))
+                grid, err = _parse_grid(params, default_grid)
+                if err:
+                    return _bad_request(err)
+                res, err = _parse_res(params)
+                if err:
+                    return _bad_request(err)
+                if hist_reader is None:
+                    return _unavailable(
+                        "the space-time history tier needs "
+                        "HEATMAP_HIST_DIR (or an http replication "
+                        "feed whose writer exposes /api/hist/*)")
+                t0, ok0 = _qs_epoch_s(params, "t0")
+                t1, ok1 = _qs_epoch_s(params, "t1")
+                if not ok0 or not ok1 or t0 is None or t1 is None:
+                    return _bad_request(
+                        "diff needs t0= and t1= (epoch seconds)")
+                from heatmap_tpu.query.history import rollup_window
+                from heatmap_tpu.query.matview import _grid_base_res
+
+                err = _hist_res_err(grid, res)
+                if err:
+                    return _bad_request(err)
+                base = _grid_base_res(grid)
+                sides = []
+                for t in (t0, t1):
+                    got = hist_reader.window_at(grid, t)
+                    docs = got[1] if got else []
+                    if docs and res is not None and res != base:
+                        docs = rollup_window(
+                            docs, res, base,
+                            docs[0].get("windowStart"),
+                            docs[0].get("windowEnd"))
+                    sides.append((got[0] if got else None,
+                                  {d["cellId"]: d for d in docs}))
+                (ws0, m0), (ws1, m1) = sides
+                feats = []
+                for cid in sorted(set(m0) | set(m1)):
+                    c0 = int((m0.get(cid) or {}).get("count", 0))
+                    c1 = int((m1.get(cid) or {}).get("count", 0))
+                    props = {"cellId": cid, "count": c1,
+                             "prevCount": c0, "delta": c1 - c0}
+                    side = m1.get(cid) or m0.get(cid)
+                    if side is not None and "avgSpeedKmh" in side:
+                        props["avgSpeedKmh"] = float(
+                            side["avgSpeedKmh"])
+                    feats.append(
+                        '{"type": "Feature", "geometry": '
+                        + _cell_geometry_json(cid)
+                        + ', "properties": ' + json.dumps(props) + '}')
+                head = json.dumps({"grid": grid, "t0": t0, "t1": t1,
+                                   "res": res, "window0": ws0,
+                                   "window1": ws1})
+                body = (head[:-1] + ', "features": ['
+                        + ", ".join(feats) + ']}')
+                data = body.encode("utf-8")
+                _account_render(endpoint, data)
+                ctype = "application/json"
+            elif path.startswith("/api/hist/"):
+                # the chunk store re-exported over HTTP: what a remote
+                # replica's cold-start backfill (and range reader)
+                # consumes via HttpHistorySource
+                if not hist_dir:
+                    return _unavailable(
+                        "the history re-export needs HEATMAP_HIST_DIR")
+                from heatmap_tpu.query.history import chunk_name_ok
+
+                params = _qs_params(environ.get("QUERY_STRING", ""))
+                if path == "/api/hist/index":
+                    body = json.dumps({
+                        "chunks": hist_src.index(),
+                        "bucket_s": getattr(cfg, "hist_bucket_s",
+                                            None) if cfg else None,
+                        "parent_res": getattr(cfg, "hist_parent_res",
+                                              None) if cfg else None,
+                        "retention_s": getattr(cfg, "hist_retention_s",
+                                               None) if cfg else None,
+                    })
+                    ctype = "application/json"
+                elif path == "/api/hist/chunk":
+                    name = params.get("name") or ""
+                    if not chunk_name_ok(name):
+                        return _bad_request(
+                            "name= is not a chunk name")
+                    buf = hist_src.chunk_bytes(name)
+                    if buf is None:
+                        start_response("404 Not Found",
+                                       [("Content-Type",
+                                         "application/json")])
+                        return [b'{"error": "no such chunk"}']
+                    data = buf
+                    ctype = "application/octet-stream"
+                else:
+                    start_response("404 Not Found",
+                                   [("Content-Type", "text/plain")])
+                    return [b"not found"]
             elif path == "/api/positions/latest":
                 endpoint = "positions"
+                params = _qs_params(environ.get("QUERY_STRING", ""))
+                fmt, err = _negotiate_fmt(
+                    environ, params, ctype=wiremod.CONTENT_TYPE_POSITIONS)
+                if err:
+                    return _bad_request(err)
+                # the representation depends on Accept now (binary
+                # negotiation, ISSUE 15 satellite) — every response
+                # must say so or a shared cache could replay the wrong
+                # representation
+                extra_headers.append(("Vary", "Accept"))
                 ver = store.version()
                 etag = None
                 if ver is not None and runtime is not None:
@@ -1595,14 +1993,42 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                     # counter as a change signal (MongoStore's counter
                     # sees ONLY this process's writes — a serve-only
                     # deployment over a shared store would 304 forever
-                    # on '"p.0"' while positions change underneath)
-                    etag = f'"p.{boot_nonce}.{ver}"'
+                    # on '"p.0"' while positions change underneath).
+                    # Format-keyed: the binary and JSON representations
+                    # of one store version must never share an ETag.
+                    etag = wiremod.format_etag(
+                        f'"p.{boot_nonce}.{ver}"', fmt)
                     if _inm_match(environ, etag):
-                        return _not_modified(etag, endpoint)
-                data, pre_gz = _cached_json(
-                    ("positions",),
-                    lambda: json.dumps(positions_feature_collection(store)),
-                    endpoint)
+                        stats.wire_format.labels(endpoint=endpoint,
+                                                 fmt=fmt).inc()
+                        return _not_modified(etag, endpoint,
+                                             vary_accept=True)
+                ctype = "application/json"
+                if fmt == "bin":
+                    try:
+                        data, pre_gz = _cached_json(
+                            ("positions", "bin"),
+                            lambda: wiremod.encode_positions(
+                                store.all_positions()),
+                            endpoint)
+                        ctype = wiremod.CONTENT_TYPE_POSITIONS
+                    except ValueError:
+                        # a doc the compact layout cannot represent
+                        # exactly: serve the JSON representation (with
+                        # ITS ETag) rather than bytes that would
+                        # decode differently
+                        log.warning("binary positions frame "
+                                    "unrepresentable; serving JSON",
+                                    exc_info=True)
+                        fmt = "json"
+                        etag = (f'"p.{boot_nonce}.{ver}"'
+                                if etag is not None else None)
+                if fmt == "json":
+                    data, pre_gz = _cached_json(
+                        ("positions",),
+                        lambda: json.dumps(
+                            positions_feature_collection(store)),
+                        endpoint)
                 if etag is not None and store.version() != ver:
                     # a write landed between the version read and the
                     # render: the body may be newer than the version
@@ -1611,14 +2037,20 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 if etag is None:
                     # serve-only: a content-derived strong ETag — the
                     # render still runs (the cache absorbs repeats) but
-                    # a 304 saves the wire bytes and is never wrong
+                    # a 304 saves the wire bytes and is never wrong.
+                    # The hash covers the encoded representation, so
+                    # it is format-keyed by construction.
                     import hashlib
 
                     etag = f'"p.h.{hashlib.md5(data).hexdigest()[:16]}"'
                     if _inm_match(environ, etag):
-                        return _not_modified(etag, endpoint)
+                        stats.wire_format.labels(endpoint=endpoint,
+                                                 fmt=fmt).inc()
+                        return _not_modified(etag, endpoint,
+                                             vary_accept=True)
                 extra_headers.append(("ETag", etag))
-                ctype = "application/json"
+                stats.wire_format.labels(endpoint=endpoint,
+                                         fmt=fmt).inc()
             elif path.startswith("/api/repl/"):
                 # the replication feed over HTTP (query.repl): any
                 # process holding the feed directory re-exposes its
@@ -1961,6 +2393,22 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                  if cq_engine is not None else None)
     app.cq_engine = cq_engine
 
+    # the member snapshot's history block (chunks, covered span,
+    # compaction lag, backfills) — obs_top --fleet renders it per
+    # member; serve workers derive it from the store's files since
+    # they run no compactor of their own
+    def _hist_block():
+        out: dict = {}
+        if hist_dir:
+            out = dict(_hist_status())
+        if follower is not None and follower.c_backfill is not None:
+            out["backfills"] = int(follower.c_backfill.value)
+        return out or None
+
+    app.hist_fn = (_hist_block if hist_dir or follower is not None
+                   else None)
+    app.hist_reader = hist_reader
+
     def close_repl():
         if cq_engine is not None:
             cq_engine.close()
@@ -2056,7 +2504,7 @@ class ServeFleetMember:
 
     def __init__(self, serve_registry, channel_path: str,
                  tag: str | None = None, healthz_fn=None,
-                 audit_fn=None, cq_fn=None):
+                 audit_fn=None, cq_fn=None, hist_fn=None):
         from heatmap_tpu.obs.xproc import ENV_FLEET_TAG
 
         self.registry = serve_registry
@@ -2070,6 +2518,9 @@ class ServeFleetMember:
         # the app's continuous-query closure (standing queries /
         # matches / eval lag) — obs_top --fleet renders it
         self.cq_fn = cq_fn
+        # the app's space-time history closure (chunks / span /
+        # compaction lag / backfills) — obs_top --fleet renders it
+        self.hist_fn = hist_fn
         # HEATMAP_FLEET_TAG names the RUNTIME member (stream/runtime.py
         # adopts it verbatim when single-process), so a serve worker
         # composes with it rather than adopting it — otherwise a serve
@@ -2096,7 +2547,8 @@ class ServeFleetMember:
         member = cls(reg, chan_path,
                      healthz_fn=getattr(app, "healthz_fn", None),
                      audit_fn=getattr(app, "audit_fn", None),
-                     cq_fn=getattr(app, "cq_fn", None))
+                     cq_fn=getattr(app, "cq_fn", None),
+                     hist_fn=getattr(app, "hist_fn", None))
         member.start()
         return member
 
@@ -2124,6 +2576,7 @@ class ServeFleetMember:
                 healthz=payload,
                 audit=self.audit_fn() if self.audit_fn else None,
                 cq=self.cq_fn() if self.cq_fn else None,
+                hist=self.hist_fn() if self.hist_fn else None,
                 left=left)
         except Exception:  # noqa: BLE001 - telemetry never kills serving
             log.warning("serve fleet snapshot publish failed",

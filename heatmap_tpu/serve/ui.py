@@ -53,12 +53,32 @@ _PAGE = """<!doctype html>
     padding: 5px 12px; border-radius: 14px; font: 12px system-ui, sans-serif;
     visibility: hidden;
   }
+  #histbar {
+    position: absolute; bottom: 12px; left: 50%; transform: translateX(-50%);
+    z-index: 1000; background: rgba(255,255,255,.92); border-radius: 8px;
+    padding: 6px 12px; font: 12px system-ui, sans-serif;
+    box-shadow: 0 1px 4px rgba(0,0,0,.3); display: none;
+    white-space: nowrap;
+  }
+  #histbar input[type=range] { width: 280px; vertical-align: middle; }
+  #histbtn {
+    position: absolute; top: 12px; right: 12px; z-index: 1000;
+    background: rgba(255,255,255,.92); border-radius: 8px; border: 0;
+    padding: 6px 10px; font: 12px system-ui, sans-serif; cursor: pointer;
+    box-shadow: 0 1px 4px rgba(0,0,0,.3);
+  }
 </style>
 </head>
 <body>
 <div id="map"></div>
 <div id="status"></div>
 <div class="hud" id="hud">loading…</div>
+<button id="histbtn" title="scrub the space-time history tier">&#x23f1; history</button>
+<div id="histbar">
+  <input type="range" id="histslider" min="0" max="0" value="0"/>
+  <span id="histlabel"></span>
+  <button id="histlive">live</button>
+</div>
 <script src="https://unpkg.com/leaflet@1.9.4/dist/leaflet.js"></script>
 <script>
 "use strict";
@@ -285,6 +305,7 @@ async function fetchTiles(gridQS) {
 }
 
 async function tick() {
+  if (histSeries) return;  // scrubbing history: the live poller pauses
   const seq = ++tickSeq;  // a newer tick invalidates slower in-flight ones
   try {
     const newGrid = gridForZoom(map.getZoom());
@@ -421,6 +442,60 @@ async function refreshQueries() {
     }
   } catch (err) { console.warn('query list fetch failed', err); }
 }
+
+// ---- space-time history slider (/api/tiles/range, query/history.py) ----
+// Enter history mode: fetch the last 6 h of compacted windows for the
+// active grid and scrub them with the slider; live polling pauses
+// until the "live" button (or a 503 on a worker without the tier).
+let histSeries = null;
+const histBar = document.getElementById('histbar');
+const histSlider = document.getElementById('histslider');
+const histLabel = document.getElementById('histlabel');
+
+function showHistWindow(i) {
+  const w = histSeries[i];
+  if (!w) return;
+  clearHexes();
+  applyFeatures(w.features || []);
+  histLabel.textContent =
+    `${esc(w.windowStart || '?')} · ${(w.features || []).length} tiles ` +
+    `(${Number(i) + 1}/${histSeries.length})`;
+}
+
+async function enterHistory() {
+  try {
+    const now = Date.now() / 1000;
+    const gridQS = activeGrid ? `&grid=${encodeURIComponent(activeGrid)}` : '';
+    const r = await fetch(`/api/tiles/range?t0=${now - 21600}&t1=${now}${gridQS}`);
+    if (!r.ok) {
+      status(r.status === 503 ? 'no history tier on this worker'
+                              : `history fetch failed (${r.status})`);
+      return;
+    }
+    const d = await r.json();
+    if (!d.series || !d.series.length) { status('no history yet'); return; }
+    histSeries = d.series;
+    histSlider.max = String(histSeries.length - 1);
+    histSlider.value = String(histSeries.length - 1);
+    histBar.style.display = 'block';
+    showHistWindow(histSeries.length - 1);
+  } catch (err) { console.warn('history fetch failed', err); }
+}
+
+function exitHistory() {
+  histSeries = null;
+  histBar.style.display = 'none';
+  tilesSince = 0;        // the live delta stream resyncs from scratch
+  clearHexes();
+  tick();
+}
+
+document.getElementById('histbtn').addEventListener('click', () => {
+  if (histSeries) exitHistory(); else enterHistory();
+});
+document.getElementById('histlive').addEventListener('click', exitHistory);
+histSlider.addEventListener('input',
+  () => { if (histSeries) showHistWindow(Number(histSlider.value)); });
 
 tick();
 setInterval(tick, REFRESH_MS);

@@ -66,7 +66,9 @@ import struct
 import threading
 
 MAGIC0, MAGIC1, VERSION = 0x48, 0x57, 1  # 'H', 'W'
+MAGIC1_POS = 0x50                        # 'H', 'P': positions frame
 CONTENT_TYPE = "application/vnd.heatmap.tiles"
+CONTENT_TYPE_POSITIONS = "application/vnd.heatmap.positions"
 
 _F_FULL = 0x01
 _F_WINDOW = 0x02
@@ -449,6 +451,121 @@ def _decode(buf: bytes) -> dict:
         docs.append(doc)
     return {"mode": "full" if flags & _F_FULL else "delta", "seq": seq,
             "grid": grid, "window_start": ws_dt, "docs": docs}
+
+
+# ------------------------------------------------------ positions frame
+# The one read endpoint PR 14 left JSON-only.  Same column primitives
+# as the tile frame: 'H' 'P' version flags, varint n, per-doc flag
+# bytes, lon/lat float columns (fixed-point only when exact), ts as
+# zigzag-varint epoch-microseconds for docs that carry a datetime, and
+# per-doc length-prefixed provider/vehicleId strings.  decode
+# reproduces every field positions_feature_collection renders EXACTLY,
+# so the JSON representation rebuilt from the decoded docs is
+# byte-identical (differential-pinned in tests/test_wire.py); docs the
+# layout cannot represent exactly raise ValueError and the serving
+# layer falls back to JSON for that response.
+
+_P_PROVIDER = 0x01
+_P_VEHICLE = 0x02
+_P_TS = 0x04
+_P_TS_NAIVE = 0x08
+
+
+def encode_positions(docs) -> bytes:
+    docs = docs if isinstance(docs, list) else list(docs)
+    head = bytearray((MAGIC0, MAGIC1_POS, VERSION, 0))
+    _put_varint(head, len(docs))
+    flags = bytearray()
+    lons: list = []
+    lats: list = []
+    ts_us: list = []
+    strs = bytearray()
+    for doc in docs:
+        f = 0
+        try:
+            lon, lat = doc["loc"]["coordinates"]
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"position doc has no loc coordinates: "
+                             f"{e}") from e
+        if type(lon) is not float or type(lat) is not float:
+            raise ValueError("position coordinates are not floats")
+        lons.append(lon)
+        lats.append(lat)
+        for key, bit in (("provider", _P_PROVIDER),
+                         ("vehicleId", _P_VEHICLE)):
+            v = doc.get(key)
+            if v is None:
+                continue
+            if type(v) is not str:
+                raise ValueError(f"{key} is not a string")
+            f |= bit
+            b = v.encode("utf-8")
+            _put_varint(strs, len(b))
+            strs += b
+        v = doc.get("ts")
+        if v is not None:
+            if type(v) is not dt.datetime:
+                raise ValueError("ts is not a datetime")
+            f |= _P_TS
+            if v.tzinfo is None:
+                f |= _P_TS_NAIVE
+            ts_us.append(_dt_us(v))
+        flags.append(f)
+    buf = bytearray(head)
+    buf += bytes(flags)
+    _encode_float_column(buf, lons)
+    _encode_float_column(buf, lats)
+    for u in ts_us:
+        _put_varint(buf, _zigzag(u))
+    buf += strs
+    return bytes(buf)
+
+
+def decode_positions(buf: bytes) -> list:
+    """Frame -> position docs with exactly the fields
+    ``positions_feature_collection`` renders; ValueError on anything
+    that is not a complete well-formed positions frame."""
+    try:
+        return _decode_positions(buf)
+    except struct.error as e:
+        raise ValueError(f"positions frame truncated: {e}") from e
+
+
+def _decode_positions(buf: bytes) -> list:
+    mv = memoryview(bytes(buf))
+    if len(mv) < 4 or mv[0] != MAGIC0 or mv[1] != MAGIC1_POS:
+        raise ValueError("not a heatmap positions frame")
+    if mv[2] != VERSION:
+        raise ValueError(f"unsupported positions frame version {mv[2]}")
+    n, pos = _get_varint(mv, 4)
+    dflags = list(mv[pos:pos + n])
+    pos += n
+    if len(dflags) != n:
+        raise ValueError("positions frame truncated in doc flags")
+    lons, pos = _decode_float_column(mv, pos, n)
+    lats, pos = _decode_float_column(mv, pos, n)
+    ts_us = []
+    for f in dflags:
+        if f & _P_TS:
+            u, pos = _get_varint(mv, pos)
+            ts_us.append(_unzigzag(u))
+    docs = []
+    it = 0
+    for i in range(n):
+        f = dflags[i]
+        doc: dict = {"loc": {"type": "Point",
+                             "coordinates": [lons[i], lats[i]]}}
+        for key, bit in (("provider", _P_PROVIDER),
+                         ("vehicleId", _P_VEHICLE)):
+            if f & bit:
+                ln, pos = _get_varint(mv, pos)
+                doc[key] = bytes(mv[pos:pos + ln]).decode("utf-8")
+                pos += ln
+        if f & _P_TS:
+            doc["ts"] = _us_dt(ts_us[it], bool(f & _P_TS_NAIVE))
+            it += 1
+        docs.append(doc)
+    return docs
 
 
 # --------------------------------------------------- coalesced fan-out

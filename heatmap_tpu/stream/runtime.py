@@ -202,14 +202,37 @@ class MicroBatchRuntime:
         # here — an externally shared fan-in view gets one publisher
         # from whoever owns it, never one per shard.
         self.repl_pub = None
+        self.hist_compactor = None
         if self.matview is not None and view is None and cfg.repl_dir:
             from heatmap_tpu.query.repl import DeltaLogPublisher
 
+            # space-time history tier (query/history.py,
+            # HEATMAP_HIST_DIR): the publisher retires rotated
+            # segments into the durable log instead of deleting them,
+            # and a compactor thread folds them into the immutable
+            # chunk store — built BEFORE the publisher so the boot
+            # sweep retires the dead epoch's tail instead of erasing it
+            hist_log = None
+            if cfg.hist_dir:
+                from heatmap_tpu.query.history import (HistoryCompactor,
+                                                       HistoryLog)
+
+                hist_log = HistoryLog(cfg.hist_dir)
             self.repl_pub = DeltaLogPublisher(
                 self.matview, cfg.repl_dir,
                 seg_bytes=cfg.repl_seg_bytes,
                 segments=cfg.repl_segments,
-                registry=self.metrics.registry)
+                registry=self.metrics.registry,
+                hist=hist_log)
+            if hist_log is not None:
+                self.hist_compactor = HistoryCompactor(
+                    cfg.hist_dir, feed_dir=cfg.repl_dir,
+                    bucket_s=cfg.hist_bucket_s,
+                    parent_res=cfg.hist_parent_res,
+                    retention_s=cfg.hist_retention_s,
+                    registry=self.metrics.registry,
+                    interval_s=cfg.hist_compact_s)
+                self.hist_compactor.start()
         self.writer = AsyncWriter(store, metrics=self.metrics,
                                   view=self.matview)
         self.tracer = Tracer()
@@ -1552,6 +1575,8 @@ class MicroBatchRuntime:
                 lineage=compact_lineage(self.lineage.tail(16)),
                 audit=(self.audit.member_block()
                        if self.audit is not None else None),
+                hist=(self.hist_compactor.member_block()
+                      if self.hist_compactor is not None else None),
                 left=left)
         except Exception:  # noqa: BLE001 - never kill the step loop
             log.warning("fleet member snapshot publish failed",
@@ -2784,6 +2809,11 @@ class MicroBatchRuntime:
                     # when the writer close raised (poisoned)
                     if self.repl_pub is not None:
                         self.repl_pub.close()
+                    # AFTER the publisher close: its final flush may
+                    # have rotated one last segment into the history
+                    # log, and the compactor's closing step drains it
+                    if self.hist_compactor is not None:
+                        self.hist_compactor.close()
                     # release the runtime-frozen engine policy globals
                     # (r5 review): standalone merge_batch/bench callers
                     # in this process get the documented live-bank

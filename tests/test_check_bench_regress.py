@@ -749,3 +749,74 @@ def test_serve_wire_format_from_top_level_wire_block(tmp_path, capsys):
     p2.write_text(json.dumps(art))
     assert mod.main(["--dir", str(tmp_path)]) == 1
     assert "wire-format mismatch" in capsys.readouterr().err
+
+
+# ----------------------------------------------- hist artifacts (r15)
+def _write_hist(dir_path, rnd, p99=None, rps=None, rc=0,
+                shape=(3600, 3, 259200.0, 3, 48), audit=None):
+    p = dir_path / f"BENCH_HIST_r{rnd:02d}.json"
+    art = {"rc": rc, "kind": "bench_history",
+           "range_p99_ms": p99, "compact_records_per_s": rps,
+           "bucket_s": shape[0], "parent_res": shape[1],
+           "retention_s": shape[2], "days": shape[3],
+           "windows_per_day": shape[4]}
+    if audit is not None:
+        art["audit"] = audit
+    p.write_text(json.dumps(art))
+    return p
+
+
+def test_hist_ok_within_threshold(tmp_path, capsys):
+    m = _load()
+    _write_hist(tmp_path, 1, p99=10.0, rps=1000.0)
+    _write_hist(tmp_path, 2, p99=12.0, rps=900.0)
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
+    assert "hist r01" in capsys.readouterr().out
+
+
+def test_hist_range_p99_regression_fails(tmp_path, capsys):
+    m = _load()
+    _write_hist(tmp_path, 1, p99=10.0, rps=1000.0)
+    _write_hist(tmp_path, 2, p99=40.0, rps=1000.0)  # +300%
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "range-query regression" in capsys.readouterr().err
+
+
+def test_hist_compaction_regression_fails(tmp_path, capsys):
+    m = _load()
+    _write_hist(tmp_path, 1, p99=10.0, rps=1000.0)
+    _write_hist(tmp_path, 2, p99=10.0, rps=100.0)  # -90%
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "compaction-throughput regression" in capsys.readouterr().err
+
+
+def test_hist_mixed_shape_refused(tmp_path, capsys):
+    m = _load()
+    _write_hist(tmp_path, 1, p99=10.0, rps=1000.0,
+                shape=(3600, 3, 259200.0, 3, 48))
+    _write_hist(tmp_path, 2, p99=10.0, rps=1000.0,
+                shape=(86400, 3, 259200.0, 3, 48))
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "history shape mismatch" in capsys.readouterr().err
+
+
+def test_hist_audit_refusal_composes(tmp_path, capsys):
+    """A leak-stamped hist round is refused outright — the PR 12
+    audit-stamp refusal composes with the BENCH_HIST family."""
+    m = _load()
+    _write_hist(tmp_path, 1, p99=10.0, rps=1000.0,
+                audit={"enabled": True, "max_residual": 0,
+                       "mismatches": 0})
+    _write_hist(tmp_path, 2, p99=10.0, rps=1000.0,
+                audit={"enabled": True, "max_residual": 0,
+                       "mismatches": 3})
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "failed integrity audit" in capsys.readouterr().err
+
+
+def test_hist_failed_run_skipped(tmp_path, capsys):
+    m = _load()
+    _write_hist(tmp_path, 1, p99=10.0, rps=1000.0, rc=1)
+    _write_hist(tmp_path, 2, p99=10.0, rps=1000.0)
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
+    assert "skipping hist r01" in capsys.readouterr().out

@@ -1,0 +1,767 @@
+"""Space-time history tier (query/history.py).
+
+The acceptance properties:
+
+- DIFFERENTIAL: /api/tiles/range over a compacted span equals the
+  live /api/tiles/latest responses captured per window during the run
+  (byte-compared after canonical cellId ordering), and view-at-seq
+  replay from adopted snapshot + sealed log equals the live view at
+  every sampled seq — across window advance, fake-clock eviction,
+  writer epoch restart, and compaction racing the publisher.
+- ZERO-LOSS RETENTION: no raw segment is pruned before a
+  digest-verified chunk covers it; a crash injected between chunk
+  write and state/prune loses nothing on restart.
+- BACKFILL: a replica that bootstraps after the writer restarted (and
+  pruned its horizon) restores pre-snapshot windows from chunks,
+  counted in heatmap_hist_backfill_total.
+"""
+
+import datetime as dt
+import importlib.util
+import json
+import os
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from heatmap_tpu import hexgrid
+from heatmap_tpu.config import load_config
+from heatmap_tpu.obs.audit import DigestTable, doc_hash
+from heatmap_tpu.obs.registry import Registry
+from heatmap_tpu.query import TileMatView
+from heatmap_tpu.query.history import (
+    FileHistorySource,
+    HistoryCompactor,
+    HistoryLog,
+    HistoryReader,
+    HttpHistorySource,
+    compaction_status,
+    decode_chunk,
+    encode_chunk,
+    view_at_seq,
+)
+from heatmap_tpu.query.repl import (
+    DeltaLogPublisher,
+    FileFeedSource,
+    ReplicaViewFollower,
+)
+from heatmap_tpu.serve import start_background
+from heatmap_tpu.serve.api import _features_collection_json
+from heatmap_tpu.sink import MemoryStore
+from heatmap_tpu.sink.base import TileDoc, UTC
+
+
+def _doc(cell, ws, count, speed=30.0, grid="h3r8", ttl_minutes=45):
+    return TileDoc("bos", 8, cell, ws, ws + dt.timedelta(minutes=5),
+                   count=count, avg_speed_kmh=speed, avg_lat=42.3,
+                   avg_lon=-71.05, ttl_minutes=ttl_minutes, grid=grid)
+
+
+def _cells(n, res=8, lat0=42.30):
+    out = []
+    for i in range(n * 3):
+        c = hexgrid.latlng_to_cell(lat0 + i * 7e-3, -71.05, res)
+        if c not in out:
+            out.append(c)
+        if len(out) == n:
+            break
+    assert len(out) == n
+    return out
+
+
+def _render_sorted(docs) -> str:
+    return _features_collection_json(
+        sorted(docs, key=lambda d: d["cellId"]))
+
+
+def _writer(tmp_path, clock, feed=None, hist=None, **pub_kw):
+    feed = feed or tempfile.mkdtemp(dir=str(tmp_path))
+    hist = hist or tempfile.mkdtemp(dir=str(tmp_path))
+    w = TileMatView(now_fn=lambda: clock["t"])
+    w.audit_table = DigestTable()
+    pub = DeltaLogPublisher(w, feed, start=False,
+                            hist=HistoryLog(hist), **pub_kw)
+    return w, pub, feed, hist
+
+
+# --------------------------------------------------------------- chunks
+def test_chunk_roundtrip_exact():
+    ws = dt.datetime(2026, 8, 4, 12, 0, tzinfo=UTC)
+    cells = _cells(5)
+    docs = [_doc(c, ws, i + 1, speed=10.5 + i) for i, c in
+            enumerate(cells)]
+    hashes = {d["cellId"]: doc_hash(d) for d in docs}
+    digest = 0
+    for h in hashes.values():
+        digest ^= h
+    buf = encode_chunk(
+        "h3r8", 0x832A10FFFFFFFFFF, 1754300000, 3600, 3,
+        {int(ws.timestamp()): {"docs": docs, "hashes": hashes,
+                               "digest": digest, "seq": 7,
+                               "stale": 1754312345.0,
+                               "verified": True}})
+    meta, windows = decode_chunk(buf)
+    assert meta["grid"] == "h3r8" and meta["bucket"] == 1754300000
+    wm = meta["windows"][str(int(ws.timestamp()))]
+    assert wm["seq"] == 7 and wm["verified"] is True
+    assert wm["digest"] == format(digest, "016x")
+    out = windows[int(ws.timestamp())]
+    # every serving-visible field round-trips exactly, centroid included
+    for a, b in zip(docs, out["docs"]):
+        assert b["cellId"] == a["cellId"]
+        assert b["count"] == a["count"]
+        assert b["avgSpeedKmh"] == a["avgSpeedKmh"]
+        assert b["windowStart"] == a["windowStart"]
+        assert b["windowEnd"] == a["windowEnd"]
+        assert b["centroid"] == a["centroid"]
+    assert out["hashes"] == hashes
+    # rendering chunk docs == rendering the originals, byte for byte
+    assert _render_sorted(out["docs"]) == _render_sorted(docs)
+
+
+def test_chunk_json_fallback_block():
+    """A doc the wire layout cannot represent exactly rides the JSON
+    block — lossless, never wrong."""
+    ws = dt.datetime(2026, 8, 4, 12, 0, tzinfo=UTC)
+    bad = _doc(_cells(1)[0], ws, 3)
+    bad["p95SpeedKmh"] = "not-a-float"  # wire.encode raises ValueError
+    buf = encode_chunk("h3r8", 0, 0, 3600, 3,
+                       {int(ws.timestamp()):
+                        {"docs": [bad], "hashes": {}, "digest": 0,
+                         "seq": 1, "stale": None, "verified": False}})
+    _meta, windows = decode_chunk(buf)
+    assert windows[int(ws.timestamp())]["docs"][0]["p95SpeedKmh"] \
+        == "not-a-float"
+
+
+# --------------------------------------------------- differential: range
+def _drive_windows(w, pub, clock, cells, n_windows=3,
+                   updates_per_window=6):
+    """Drive several windows of churn through the real publish path,
+    capturing the live /latest render (canonically ordered) per window
+    AFTER its last mutation, and per-seq renders for replay checks."""
+    captures = {}
+    per_seq = {}
+    base = dt.datetime.fromtimestamp(clock["t"], UTC).replace(
+        microsecond=0)
+    for wi in range(n_windows):
+        ws = base + dt.timedelta(minutes=5 * wi)
+        for k in range(updates_per_window):
+            w.apply_docs([_doc(cells[k % len(cells)], ws, wi * 100 + k
+                               + 1)])
+            pub.flush()
+            per_seq[w.seq] = _features_collection_json(
+                w.latest_docs("h3r8")[1])
+        captures[int(ws.timestamp())] = _render_sorted(
+            w.latest_docs("h3r8")[1])
+    return captures, per_seq
+
+
+def test_range_equals_live_latest_union(tmp_path):
+    """ACCEPTANCE: /api/tiles/range over the compacted span equals the
+    union of the live /latest responses captured at each window."""
+    clock = {"t": time.time()}
+    w, pub, feed, hist = _writer(tmp_path, clock, seg_bytes=4096,
+                                 segments=2)
+    cells = _cells(4)
+    captures, _ = _drive_windows(w, pub, clock, cells)
+    pub.close()
+    comp = HistoryCompactor(hist, feed_dir=feed,
+                            clock=lambda: clock["t"])
+    assert comp.step() > 0
+    assert comp.mismatches == 0
+    assert comp.verified > 0  # the dg stamps really were checked
+    reader = HistoryReader(FileHistorySource(hist))  # chunks ALONE
+    got = reader.windows_in_range("h3r8", clock["t"] - 3600,
+                                  clock["t"] + 3600)
+    assert sorted(got) == sorted(captures)
+    for ws, part in got.items():
+        assert _features_collection_json(part["docs"]) == captures[ws]
+
+
+def test_range_overlays_live_view_windows(tmp_path):
+    """Windows still in the live (un-rotated) segment serve through
+    the view overlay — range never waits for the compactor."""
+    clock = {"t": time.time()}
+    w, pub, feed, hist = _writer(tmp_path, clock)
+    cells = _cells(3)
+    captures, _ = _drive_windows(w, pub, clock, cells, n_windows=2)
+    # NO close, NO rotation: everything is still in the live segment
+    comp = HistoryCompactor(hist, feed_dir=feed,
+                            clock=lambda: clock["t"])
+    comp.step()
+    reader = HistoryReader(FileHistorySource(hist), view=w)
+    got = reader.windows_in_range("h3r8", clock["t"] - 3600,
+                                  clock["t"] + 3600)
+    assert sorted(got) == sorted(captures)
+    for ws, part in got.items():
+        assert _render_sorted(part["docs"]) == captures[ws]
+
+
+# ----------------------------------------------- differential: at-seq
+def test_view_at_seq_replay_byte_identical(tmp_path):
+    """ACCEPTANCE: view-at-seq replay from snapshot + log equals the
+    live view at EVERY seq — across window advance and fake-clock
+    eviction of the latest window."""
+    clock = {"t": time.time()}
+    w, pub, feed, hist = _writer(tmp_path, clock, seg_bytes=2048,
+                                 segments=2)
+    cells = _cells(4)
+    _caps, per_seq = _drive_windows(w, pub, clock, cells)
+    # fake-clock eviction: every window ages out; the writer's lazy
+    # evict advances seq and publishes the marker
+    clock["t"] += 3 * 3600
+    w.etag("h3r8")
+    pub.flush()
+    per_seq[w.seq] = _features_collection_json(
+        w.latest_docs("h3r8")[1])
+    pub.close()
+    for seq, want in per_seq.items():
+        v = view_at_seq(hist, seq, feed_dir=feed)
+        assert v.seq == seq
+        assert _features_collection_json(
+            v.latest_docs("h3r8")[1]) == want
+    # beyond the head / before the base: refused, never wrong
+    with pytest.raises(ValueError):
+        view_at_seq(hist, w.seq + 10, feed_dir=feed)
+
+
+def test_view_at_seq_across_epoch_restart(tmp_path):
+    """A writer restart mints a new epoch with restarting seqs; replay
+    stays exact in BOTH epochs (the old one via ?epoch=)."""
+    clock = {"t": time.time()}
+    w1, pub1, feed, hist = _writer(tmp_path, clock)
+    cells = _cells(3)
+    ws = dt.datetime.fromtimestamp(clock["t"], UTC).replace(
+        microsecond=0)
+    w1.apply_docs([_doc(cells[0], ws, 1), _doc(cells[1], ws, 2)])
+    pub1.flush()
+    old_epoch = pub1.epoch
+    old_r1 = _features_collection_json(w1.latest_docs("h3r8")[1])
+    pub1.close()
+    w2, pub2, _f, _h = _writer(tmp_path, clock, feed=feed, hist=hist)
+    w2.apply_docs([_doc(cells[2], ws, 9)])
+    pub2.flush()
+    new_r1 = _features_collection_json(w2.latest_docs("h3r8")[1])
+    pub2.close()
+    assert old_r1 != new_r1  # same seq, different content by design
+    v_new = view_at_seq(hist, 1, feed_dir=feed)
+    assert _features_collection_json(
+        v_new.latest_docs("h3r8")[1]) == new_r1
+    v_old = view_at_seq(hist, 1, feed_dir=feed, epoch=old_epoch)
+    assert _features_collection_json(
+        v_old.latest_docs("h3r8")[1]) == old_r1
+
+
+def test_compaction_racing_publisher(tmp_path):
+    """Compaction interleaved with live publishing (rotated segments
+    read in place, then re-read after retirement) converges to the
+    same digest-verified content as a single post-hoc compaction."""
+    clock = {"t": time.time()}
+    w, pub, feed, hist = _writer(tmp_path, clock, seg_bytes=1024,
+                                 segments=3)
+    cells = _cells(4)
+    comp = HistoryCompactor(hist, feed_dir=feed,
+                            clock=lambda: clock["t"])
+    base = dt.datetime.fromtimestamp(clock["t"], UTC).replace(
+        microsecond=0)
+    captures = {}
+    for wi in range(3):
+        ws = base + dt.timedelta(minutes=5 * wi)
+        for k in range(8):
+            w.apply_docs([_doc(cells[k % len(cells)], ws,
+                               wi * 100 + k + 1)])
+            pub.flush()
+            comp.step()  # racing: mid-stream, mid-rotation
+        captures[int(ws.timestamp())] = _render_sorted(
+            w.latest_docs("h3r8")[1])
+    pub.close()
+    comp.step()
+    assert comp.mismatches == 0
+    reader = HistoryReader(FileHistorySource(hist))
+    got = reader.windows_in_range("h3r8", clock["t"] - 3600,
+                                  clock["t"] + 3600)
+    assert sorted(got) == sorted(captures)
+    for ws, part in got.items():
+        assert _features_collection_json(part["docs"]) == captures[ws]
+
+
+# ------------------------------------------------- zero-loss / chaos
+def test_crash_between_chunk_write_and_state_loses_nothing(tmp_path):
+    """CHAOS: the compactor writes chunks, then dies before persisting
+    its watermark (and before any prune).  A fresh compactor re-ingests
+    the same segments over the chunk-seeded accumulator and converges
+    to identical, digest-verified content."""
+    clock = {"t": time.time()}
+    w, pub, feed, hist = _writer(tmp_path, clock, seg_bytes=1024,
+                                 segments=2)
+    cells = _cells(4)
+    captures, _ = _drive_windows(w, pub, clock, cells)
+    pub.close()
+
+    class _Crash(Exception):
+        pass
+
+    comp = HistoryCompactor(hist, feed_dir=feed,
+                            clock=lambda: clock["t"])
+    comp._save_state = lambda *a, **k: (_ for _ in ()).throw(_Crash())
+    with pytest.raises(_Crash):
+        comp.step()
+    # chunks made it to disk before the crash
+    assert os.listdir(os.path.join(hist, "chunks"))
+    # no watermark was persisted -> nothing was eligible to prune
+    assert not os.path.exists(os.path.join(hist, "hist-state.json"))
+    # restart: a FRESH compactor re-ingests everything
+    comp2 = HistoryCompactor(hist, feed_dir=feed,
+                             clock=lambda: clock["t"])
+    n = comp2.step()
+    assert n > 0 and comp2.mismatches == 0
+    reader = HistoryReader(FileHistorySource(hist))
+    got = reader.windows_in_range("h3r8", clock["t"] - 3600,
+                                  clock["t"] + 3600)
+    assert sorted(got) == sorted(captures)
+    for ws, part in got.items():
+        assert _features_collection_json(part["docs"]) == captures[ws]
+    # idempotence: a third pass ingests nothing and changes nothing
+    assert comp2.step() == 0
+
+
+def test_segment_prune_ordering_invariant(tmp_path):
+    """ZERO-LOSS: sealed segments survive retention aging until their
+    records are below the PERSISTED watermark; a digest mismatch
+    freezes pruning entirely."""
+    clock = {"t": time.time()}
+    w, pub, feed, hist = _writer(tmp_path, clock, seg_bytes=1024,
+                                 segments=2)
+    cells = _cells(3)
+    _drive_windows(w, pub, clock, cells)
+    pub.close()
+    log_dir = os.path.join(hist, "log")
+
+    def segs():
+        return sorted(p for p in os.listdir(log_dir)
+                      if p.startswith("seg-"))
+
+    assert segs()
+    # retention already lapsed, but ingestion is blocked: NOT pruned
+    comp = HistoryCompactor(hist, feed_dir=feed, retention_s=1.0,
+                            clock=lambda: clock["t"] + 3600)
+    import heatmap_tpu.query.history as histmod
+
+    orig = histmod._read_segment
+    histmod._read_segment = lambda path: []
+    try:
+        comp.step()
+        assert segs(), "un-ingested segments must never be pruned"
+    finally:
+        histmod._read_segment = orig
+    # ingested + aged past retention: pruned (chunks cover them)
+    n = comp.step()
+    assert n > 0
+    comp.step()  # prune pass after the watermark persisted
+    assert not segs()
+    assert comp._chunks >= 0
+    # a digest mismatch freezes pruning of anything new
+    w2, pub2, _f, _h = _writer(tmp_path, clock, feed=feed, hist=hist)
+    ws = dt.datetime.fromtimestamp(clock["t"] + 7200, UTC)
+    w2.apply_docs([_doc(cells[0], ws, 5)])
+    pub2.flush()
+    pub2.close()
+    comp.mismatches = 1
+    comp.step()
+    assert segs(), "pruning must freeze while a mismatch is outstanding"
+
+
+# ----------------------------------------------------------- backfill
+def test_replica_backfills_pre_snapshot_windows(tmp_path):
+    """SATELLITE: a replica bootstrapping after a writer restart (whose
+    snapshot lost the older windows) restores them from chunks —
+    counted in heatmap_hist_backfill_total — and serves them through
+    /range via the view overlay."""
+    clock = {"t": time.time()}
+    w, pub, feed, hist = _writer(tmp_path, clock)
+    cells = _cells(4)
+    base = dt.datetime.fromtimestamp(clock["t"], UTC).replace(
+        microsecond=0)
+    ws1 = base - dt.timedelta(minutes=20)
+    ws2 = base - dt.timedelta(minutes=10)
+    w.apply_docs([_doc(cells[0], ws1, 4), _doc(cells[1], ws1, 2)])
+    pub.flush()
+    pub.close()
+    HistoryCompactor(hist, feed_dir=feed,
+                     clock=lambda: clock["t"]).step()
+    # the restarted writer's view only ever sees ws2
+    w2, pub2, _f, _h = _writer(tmp_path, clock, feed=feed, hist=hist)
+    w2.apply_docs([_doc(cells[2], ws2, 9)])
+    pub2.flush()
+    reg = Registry()
+    r = TileMatView(replica=True)
+    fol = ReplicaViewFollower(r, FileFeedSource(feed), registry=reg,
+                              hist_source=FileHistorySource(hist))
+    while fol.step():
+        pass
+    wd = r.window_docs("h3r8")
+    assert int(ws1.timestamp()) in wd, "pre-snapshot window lost"
+    assert int(ws2.timestamp()) in wd
+    # the backfilled window's content is the compacted final state
+    assert _render_sorted(wd[int(ws1.timestamp())][2]) == \
+        _render_sorted([_doc(cells[0], ws1, 4), _doc(cells[1], ws1, 2)])
+    assert "heatmap_hist_backfill_total 1" in reg.expose_text()
+    # /latest is untouched: the replica still serves the writer's seq
+    assert r.seq == w2.seq
+    assert _features_collection_json(r.latest_docs("h3r8")[1]) == \
+        _features_collection_json(w2.latest_docs("h3r8")[1])
+    pub2.close()
+
+
+def test_backfill_never_installs_latest_or_stale(tmp_path):
+    clock = {"t": time.time()}
+    view = TileMatView(replica=True)
+    ws = dt.datetime.fromtimestamp(clock["t"], UTC)
+    # unknown grid: refused
+    assert not view.backfill_window("h3r8", int(ws.timestamp()),
+                                    [_doc(_cells(1)[0], ws, 1)])
+    view.replica_apply({"kind": "apply", "seq": 1,
+                        "docs": [_doc(_cells(1)[0], ws, 1)]})
+    # at/after latest: refused
+    assert not view.backfill_window("h3r8", int(ws.timestamp()),
+                                    [_doc(_cells(1)[0], ws, 2)])
+    later = ws + dt.timedelta(minutes=5)
+    assert not view.backfill_window("h3r8", int(later.timestamp()),
+                                    [_doc(_cells(1)[0], later, 2)])
+    # strictly older: installed, without a seq advance
+    seq0 = view.seq
+    older = ws - dt.timedelta(minutes=5)
+    assert view.backfill_window("h3r8", int(older.timestamp()),
+                                [_doc(_cells(1)[0], older, 2)])
+    assert view.seq == seq0
+
+
+# ------------------------------------------------------ serve surfaces
+def _get(url, hdrs=None):
+    req = urllib.request.Request(url)
+    for k, v in (hdrs or {}).items():
+        req.add_header(k, v)
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def test_serve_history_endpoints(tmp_path):
+    """/api/tiles/range|at|diff + /api/hist/* against a real serve app,
+    including the HTTP chunk source a remote replica would use."""
+    clock = {"t": time.time()}
+    w, pub, feed, hist = _writer(tmp_path, clock)
+    cells = _cells(4)
+    base = dt.datetime.fromtimestamp(clock["t"], UTC).replace(
+        microsecond=0)
+    ws1 = base - dt.timedelta(minutes=20)
+    ws2 = base - dt.timedelta(minutes=10)
+    w.apply_docs([_doc(cells[0], ws1, 4), _doc(cells[1], ws1, 2)])
+    pub.flush()
+    w.apply_docs([_doc(cells[2], ws2, 9)])
+    pub.flush()
+    pub.close()
+    HistoryCompactor(hist, feed_dir=feed,
+                     clock=lambda: clock["t"]).step()
+    cfg = load_config({}, serve_port=0, hist_dir=hist, repl_dir=feed)
+    httpd, _t, port = start_background(MemoryStore(), cfg, port=0)
+    base_url = f"http://127.0.0.1:{port}"
+    t0 = clock["t"] - 3600
+    t1 = clock["t"] + 60
+    try:
+        _s, h, b = _get(f"{base_url}/api/tiles/range?t0={t0}&t1={t1}")
+        d = json.loads(b)
+        assert d["windows"] == 2 and len(d["series"]) == 2
+        assert d["aggregate"]["features"]
+        assert "Accept" in h.get("Vary", "")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base_url}/api/tiles/range?t0={t0}&t1={t1}",
+                 {"If-None-Match": h["ETag"]})
+        assert ei.value.code == 304
+        # binary series: length-prefixed wire frames, one per window
+        from heatmap_tpu.serve import wire
+
+        _s, hb, bb = _get(
+            f"{base_url}/api/tiles/range?t0={t0}&t1={t1}&fmt=bin")
+        assert hb["Content-Type"] == wire.CONTENT_TYPE
+        frames = []
+        pos = 0
+        while pos < len(bb):
+            ln = int.from_bytes(bb[pos:pos + 4], "little")
+            frames.append(wire.decode(bb[pos + 4:pos + 4 + ln]))
+            pos += 4 + ln
+        assert len(frames) == 2
+        assert [f["seq"] for f in frames] == sorted(f["seq"]
+                                                    for f in frames)
+        # the decoded binary series renders the JSON series bytes
+        for f, sj in zip(frames, d["series"]):
+            assert json.loads(_features_collection_json(
+                f["docs"]))["features"] == sj["features"]
+        # rollup: res one coarser than base
+        _s, _h, b = _get(
+            f"{base_url}/api/tiles/range?t0={t0}&t1={t1}&res=7")
+        d7 = json.loads(b)
+        assert d7["windows"] == 2
+        counts = sum(f["properties"]["count"]
+                     for s in d7["series"] for f in s["features"])
+        assert counts == 4 + 2 + 9
+        # at-seq replay over HTTP
+        _s, _h, b = _get(f"{base_url}/api/tiles/at?seq=1")
+        assert len(json.loads(b)["features"]) == 2
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base_url}/api/tiles/at?seq=999")
+        assert ei.value.code == 404
+        # diff between the two windows
+        _s, _h, b = _get(
+            f"{base_url}/api/tiles/diff"
+            f"?t0={ws1.timestamp() + 1}&t1={ws2.timestamp() + 1}")
+        dd = json.loads(b)
+        deltas = {f["properties"]["cellId"]: f["properties"]["delta"]
+                  for f in dd["features"]}
+        assert deltas == {cells[0]: -4, cells[1]: -2, cells[2]: 9}
+        # the HTTP chunk source (what a remote replica backfills from)
+        hsrc = HttpHistorySource(base_url)
+        idx = hsrc.index()
+        assert idx and all("name" in m for m in idx)
+        buf = hsrc.chunk_bytes(idx[0]["name"])
+        assert buf and decode_chunk(buf)
+        # path traversal refused at the name gate
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base_url}/api/hist/chunk?name=../hist-state.json")
+        assert ei.value.code == 400
+        # healthz carries the compaction-lag check
+        _s, _h, b = _get(f"{base_url}/healthz")
+        hz = json.loads(b)
+        assert "hist_compaction_lag_s" in hz["checks"]
+    finally:
+        httpd.shutdown()
+        httpd.get_app().close_repl()
+
+
+def test_history_endpoints_503_without_tier():
+    httpd, _t, port = start_background(
+        MemoryStore(), load_config({}, serve_port=0), port=0)
+    try:
+        for path in ("/api/tiles/range?t0=0&t1=1", "/api/tiles/at?seq=1",
+                     "/api/tiles/diff?t0=0&t1=1", "/api/hist/index"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"http://127.0.0.1:{port}{path}")
+            assert ei.value.code == 503, path
+    finally:
+        httpd.shutdown()
+
+
+# ----------------------------------------------------- status / obs_top
+def _load_tool(name):
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        os.pardir))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(repo, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_compaction_status_reports_lag_and_mismatches(tmp_path):
+    clock = {"t": time.time()}
+    w, pub, feed, hist = _writer(tmp_path, clock, seg_bytes=1024,
+                                 segments=2)
+    _drive_windows(w, pub, clock, _cells(3))
+    pub.close()
+    st = compaction_status(hist, now=clock["t"])
+    assert st["pending_segments"] > 0  # nothing compacted yet
+    comp = HistoryCompactor(hist, feed_dir=feed,
+                            clock=lambda: clock["t"])
+    comp.step()
+    st = compaction_status(hist, now=clock["t"])
+    assert st["chunks"] > 0 and st["mismatches"] == 0
+    assert st["covered_span_s"] > 0
+
+
+def test_obs_top_renders_history_rows():
+    top = _load_tool("obs_top")
+    text = """\
+heatmap_hist_chunks 42
+heatmap_hist_covered_span_seconds 259200
+heatmap_hist_compaction_lag_seconds 1.5
+heatmap_hist_backfill_total 3
+"""
+    m = top.parse_prom(text)
+    frame = top.render_frame(m, None, 0.0, None)
+    assert "history" in frame and "42" in frame
+    assert "72.0 h" in frame  # 259200 s rendered in hours
+    assert "backfills 3" in frame
+
+
+def test_obs_top_fleet_renders_history_table():
+    top = _load_tool("obs_top")
+    text = """\
+heatmap_fleet_members 2
+heatmap_fleet_member_up{proc="p0",role="runtime"} 1
+heatmap_fleet_member_up{proc="serve1",role="serve"} 1
+heatmap_hist_chunks{proc="p0"} 12
+heatmap_hist_covered_span_seconds{proc="p0"} 86400
+heatmap_hist_compaction_lag_seconds{proc="p0"} 0.4
+heatmap_hist_backfill_total{proc="serve1"} 5
+heatmap_repl_seq_lag{proc="serve1"} 0
+"""
+    m = top.parse_prom(text)
+    frame = top.render_fleet_frame(m, None, 0.0,
+                                   {"status": "ok", "checks": {}})
+    assert "history" in frame
+    lines = [ln for ln in frame.splitlines() if ln.strip()
+             .startswith("p0") and "24.0 h" in ln]
+    assert lines, frame
+    assert any("serve1" in ln and "5" in ln
+               for ln in frame.splitlines() if "history" not in ln)
+    assert "hist max compaction lag" in frame
+
+
+# ------------------------------------------------------------- tooling
+def test_bench_history_smoke():
+    bench = _load_tool("bench_history")
+    art = bench.run(days=1, windows_per_day=4, n_cells=24,
+                    range_queries=10)
+    assert art["rc"] == 0
+    assert art["records"] > 0 and art["chunks"] > 0
+    assert art["range_p99_ms"] > 0
+    assert art["compact_records_per_s"] > 0
+    assert art["backfilled_windows"] >= 1
+    assert art["audit"]["enabled"] and art["audit"]["mismatches"] == 0
+    assert art["audit"]["digests_verified"] > 0
+
+
+def test_history_cli_entrypoint(tmp_path):
+    clock = {"t": time.time()}
+    w, pub, feed, hist = _writer(tmp_path, clock)
+    ws = dt.datetime.fromtimestamp(clock["t"], UTC)
+    w.apply_docs([_doc(_cells(1)[0], ws, 1)])
+    pub.flush()
+    pub.close()
+    import heatmap_tpu.query.history as histmod
+
+    assert histmod.main(["--hist", hist, "--feed", feed]) == 0
+    assert compaction_status(hist)["chunks"] == 1
+
+
+# -------------------------------------------------------------- config
+def test_hist_config_validation():
+    with pytest.raises(ValueError):
+        load_config({}, hist_retention_s=0)
+    with pytest.raises(ValueError):
+        load_config({}, hist_bucket_s=10)
+    with pytest.raises(ValueError):
+        load_config({}, hist_parent_res=16)
+    with pytest.raises(ValueError):
+        load_config({}, hist_compact_s=0)
+    cfg = load_config({"HEATMAP_HIST_DIR": "/tmp/h",
+                       "HEATMAP_HIST_RETENTION_S": "3600",
+                       "HEATMAP_HIST_BUCKET_S": "600",
+                       "HEATMAP_HIST_PARENT_RES": "4",
+                       "HEATMAP_HIST_COMPACT_S": "0.5",
+                       "HEATMAP_HIST_BACKFILL": "0"})
+    assert cfg.hist_dir == "/tmp/h"
+    assert (cfg.hist_retention_s, cfg.hist_bucket_s,
+            cfg.hist_parent_res, cfg.hist_compact_s,
+            cfg.hist_backfill) == (3600.0, 600, 4, 0.5, False)
+
+
+def test_resync_drops_stale_parent_chunk_slices(tmp_path):
+    """r15 review finding pinned: a resync that drops every cell under
+    some chunk parent must REWRITE that parent's chunk too — a stale
+    slice would serve forever and re-seed a restarted compactor into a
+    false digest mismatch.  parent_res=8 == cell res, so every cell
+    keys its own chunk."""
+    clock = {"t": time.time()}
+    w, pub, feed, hist = _writer(tmp_path, clock, seg_bytes=4096,
+                                 segments=2)
+    cells = _cells(4)
+    ws = dt.datetime.fromtimestamp(clock["t"], UTC).replace(
+        microsecond=0)
+    comp = HistoryCompactor(hist, feed_dir=feed, parent_res=8,
+                            clock=lambda: clock["t"])
+    # enough churn to rotate at least one segment, so the pre-resync
+    # state is chunk-flushed before the resync arrives
+    for k in range(12):
+        w.apply_docs([_doc(c, ws, k * 10 + i + 1)
+                      for i, c in enumerate(cells)])
+        pub.flush()
+    comp.step()
+    ws_i = int(ws.timestamp())
+    reader = HistoryReader(FileHistorySource(hist))
+    got = reader.windows_in_range("h3r8", ws_i, ws_i + 1)
+    assert len(got[ws_i]["docs"]) == len(cells)
+    # an external store replacement: only cells[0] survives (the view
+    # emits a full resync record)
+    w.replace_grid("h3r8", [_doc(cells[0], ws, 999)])
+    pub.flush()
+    pub.close()
+    comp.step()
+    assert comp.mismatches == 0
+    reader = HistoryReader(FileHistorySource(hist))
+    got = reader.windows_in_range("h3r8", ws_i, ws_i + 1)
+    assert [d["cellId"] for d in got[ws_i]["docs"]] == [cells[0]]
+    assert got[ws_i]["docs"][0]["count"] == 999
+    # a restarted compactor re-seeds clean: no stale slice, no false
+    # mismatch, nothing new to ingest
+    comp2 = HistoryCompactor(hist, feed_dir=feed, parent_res=8,
+                             clock=lambda: clock["t"])
+    assert comp2.step() == 0 and comp2.mismatches == 0
+
+
+def test_evict_replayed_after_restart_closes_window(tmp_path):
+    """r15 second-pass review finding pinned: an evict record replayed
+    by a RESTARTED compactor (empty accumulator) must seed the window
+    from its chunks and close it — otherwise a later re-create merges
+    the stale chunk cells into fresh content and the digest check
+    freezes pruning on a phantom mismatch."""
+    clock = {"t": time.time()}
+    w, pub, feed, hist = _writer(tmp_path, clock, seg_bytes=4096,
+                                 segments=2)
+    cells = _cells(3)
+    ws = dt.datetime.fromtimestamp(clock["t"], UTC).replace(
+        microsecond=0)
+    ws_i = int(ws.timestamp())
+
+    def filler(n0):
+        # churn on a SECOND grid forces rotations without touching
+        # the window under test
+        for k in range(12):
+            w.apply_docs([_doc(cells[2], ws, n0 + k, grid="h3r8m1",
+                               ttl_minutes=100000)])
+            pub.flush()
+
+    w.apply_docs([_doc(cells[0], ws, 1, ttl_minutes=5),
+                  _doc(cells[1], ws, 2, ttl_minutes=5)])
+    pub.flush()
+    filler(10)
+    comp = HistoryCompactor(hist, feed_dir=feed,
+                            clock=lambda: clock["t"])
+    comp.step()  # the window is chunked, watermark persisted
+    got = HistoryReader(FileHistorySource(hist)).windows_in_range(
+        "h3r8", ws_i, ws_i + 1)
+    assert len(got[ws_i]["docs"]) == 2
+    # the window (h3r8's latest) evicts; the marker rotates out
+    clock["t"] += 1200
+    w.etag("h3r8")
+    pub.flush()
+    filler(50)
+    # compactor RESTART: the evict replays over an empty accumulator
+    comp2 = HistoryCompactor(hist, feed_dir=feed,
+                             clock=lambda: clock["t"])
+    comp2.step()
+    # the writer re-creates the window with ONLY cells[1]
+    w.apply_docs([_doc(cells[1], ws, 99, ttl_minutes=100000)])
+    pub.flush()
+    pub.close()
+    comp2.step()
+    assert comp2.mismatches == 0
+    got = HistoryReader(FileHistorySource(hist)).windows_in_range(
+        "h3r8", ws_i, ws_i + 1)
+    docs = got[ws_i]["docs"]
+    assert [d["cellId"] for d in docs] == [cells[1]], \
+        "stale pre-evict cells merged into the re-created window"
+    assert docs[0]["count"] == 99

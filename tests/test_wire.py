@@ -407,3 +407,126 @@ def test_fanout_finish_on_full_queue_sheds_not_evicts():
     # of f1 with a delivered terminal frame
     assert items[-1] is wire.LAGGED
     assert b"f1" not in items or b"f2" in items
+
+
+# ------------------------------------------------- positions frame (r15)
+def _mkpos(n, rng=None):
+    rng = rng or random.Random(11)
+    docs = []
+    for i in range(n):
+        d = {"_id": f"p|v{i}", "provider": "mbta" if i % 2 else "gtfs",
+             "vehicleId": f"v{i}",
+             "ts": WS + dt.timedelta(seconds=i),
+             "loc": {"type": "Point",
+                     "coordinates": [float(rng.uniform(-72, -70)),
+                                     float(rng.uniform(41, 43))]}}
+        if i % 7 == 0:
+            del d["provider"]          # None -> JSON null, exactly
+        if i % 11 == 0:
+            del d["ts"]                # "None" via _iso, exactly
+        if i % 13 == 0:
+            d["ts"] = (WS + dt.timedelta(seconds=i)).replace(tzinfo=None)
+        docs.append(d)
+    return docs
+
+
+def test_positions_roundtrip_reproduces_json_bytes():
+    """THE positions differential: decode(encode(docs)) rendered
+    through positions_feature_collection is byte-identical to the JSON
+    the store docs themselves render."""
+    from heatmap_tpu.serve.api import positions_feature_collection
+
+    docs = _mkpos(100)
+    buf = wire.encode_positions(docs)
+    out = wire.decode_positions(buf)
+
+    class _S:
+        def __init__(self, d):
+            self._d = d
+
+        def all_positions(self):
+            return self._d
+
+    a = json.dumps(positions_feature_collection(_S(docs)))
+    b = json.dumps(positions_feature_collection(_S(out)))
+    assert a == b
+    # and the frame is far smaller than the JSON it replaces
+    assert len(buf) < len(a)
+
+
+def test_positions_encoder_rejects_unrepresentable():
+    base = {"provider": "p", "vehicleId": "v",
+            "ts": WS, "loc": {"type": "Point",
+                              "coordinates": [-71.0, 42.0]}}
+    with pytest.raises(ValueError):   # int coordinates render "42" not "42.0"
+        wire.encode_positions([{**base,
+                                "loc": {"coordinates": [-71, 42]}}])
+    with pytest.raises(ValueError):   # non-datetime ts strs aren't exact
+        wire.encode_positions([{**base, "ts": "2026-01-01"}])
+    with pytest.raises(ValueError):
+        wire.encode_positions([{**base, "provider": 5}])
+    with pytest.raises(ValueError):
+        wire.encode_positions([{"provider": "p"}])  # no loc
+
+
+def test_positions_decode_rejects_garbage():
+    with pytest.raises(ValueError):
+        wire.decode_positions(b"")
+    with pytest.raises(ValueError):
+        wire.decode_positions(b"HW\x01\x00")  # a TILE frame magic
+    buf = wire.encode_positions(_mkpos(10))
+    with pytest.raises(ValueError):
+        wire.decode_positions(buf[:-3])
+
+
+def test_positions_endpoint_negotiates_binary(tmp_path):
+    """/api/positions/latest?fmt=bin serves the positions frame with a
+    format-keyed ETag and Vary: Accept; the JSON path is untouched."""
+    import urllib.request
+
+    from heatmap_tpu.config import load_config
+    from heatmap_tpu.serve import start_background
+    from heatmap_tpu.serve.api import positions_feature_collection
+    from heatmap_tpu.sink import MemoryStore
+    from heatmap_tpu.sink.base import PositionDoc
+
+    st = MemoryStore()
+    st.upsert_positions([PositionDoc("mbta", f"v{i}", WS, 42.0 + i * 1e-3,
+                                     -71.0) for i in range(5)])
+    httpd, _t, port = start_background(
+        st, load_config({}, serve_port=0), port=0)
+    base = f"http://127.0.0.1:{port}"
+
+    def get(path, hdrs=None):
+        req = urllib.request.Request(base + path)
+        for k, v in (hdrs or {}).items():
+            req.add_header(k, v)
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, dict(r.headers), r.read()
+
+    try:
+        _, hj, bj = get("/api/positions/latest")
+        assert "Accept" in hj.get("Vary", "")
+        _, hb, bb = get("/api/positions/latest?fmt=bin")
+        assert hb["Content-Type"] == wire.CONTENT_TYPE_POSITIONS
+        assert hb["ETag"] != hj["ETag"]
+
+        class _S:
+            def all_positions(self):
+                return wire.decode_positions(bb)
+
+        assert json.dumps(
+            positions_feature_collection(_S())).encode() == bj
+        # Accept-header negotiation, no query param
+        _, ha, ba = get("/api/positions/latest",
+                        {"Accept": wire.CONTENT_TYPE_POSITIONS})
+        assert ba == bb
+        # content-hash ETag answers 304 on the binary representation
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get("/api/positions/latest?fmt=bin",
+                {"If-None-Match": hb["ETag"]})
+        assert ei.value.code == 304
+    finally:
+        httpd.shutdown()

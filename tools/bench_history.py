@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Space-time history bench: compaction / range-query / backfill cost.
+
+Banks the history tier's claims as numbers (``BENCH_HIST_r*.json``,
+ratcheted by tools/check_bench_regress.py):
+
+- synthesize ``--days`` of windows (``--windows-per-day`` each, ``--cells``
+  tile docs per window) through a REAL writer ``TileMatView`` +
+  ``DeltaLogPublisher`` feed with history hand-off — every record the
+  compactor sees took the production path (hook → segment → rotation →
+  retire), with per-window digests published (DigestTable attached) so
+  compaction is digest-verified end to end;
+- time :class:`HistoryCompactor` draining the whole log →
+  ``compact_records_per_s``;
+- run ``--range-queries`` random sub-range queries through
+  :class:`HistoryReader` over the chunk store → ``range_p99_ms``;
+- time a replica cold-start backfill (snapshot bootstrap + chunk
+  backfill through ``ReplicaViewFollower``) → ``backfill_ms``;
+- stamp the chunk-shape/retention signature (bucket_s, parent_res,
+  retention_s, days, windows_per_day — check_bench_regress refuses
+  mixed-shape pairs) and the PR 12 integrity ``audit`` block
+  ({enabled, max_residual, digests_verified, mismatches}); any digest
+  mismatch fails the run (rc 1), the same way a failed conservation
+  audit does.
+
+Usage:
+    python tools/bench_history.py [--days 3] [--windows-per-day 48]
+        [--cells 256] [--range-queries 200] [--out BENCH_HIST_r01.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime as dt
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+UTC = dt.timezone.utc
+
+
+def _city_cells(n: int, res: int = 8) -> list:
+    from heatmap_tpu import hexgrid
+
+    out: list = []
+    seen: set = set()
+    i = 0
+    while len(out) < n and i < n * 20:
+        row, col = divmod(i, 64)
+        c = hexgrid.latlng_to_cell(42.20 + row * 4.5e-3,
+                                   -71.30 + col * 6.0e-3, res)
+        if c not in seen:
+            seen.add(c)
+            out.append(c)
+        i += 1
+    return out
+
+
+def run(days: int, windows_per_day: int, n_cells: int,
+        range_queries: int, bucket_s: int = 3600,
+        parent_res: int = 3) -> dict:
+    from heatmap_tpu.obs.audit import DigestTable
+    from heatmap_tpu.query import TileMatView
+    from heatmap_tpu.query.history import (FileHistorySource,
+                                           HistoryCompactor,
+                                           HistoryLog, HistoryReader)
+    from heatmap_tpu.query.repl import (DeltaLogPublisher,
+                                        FileFeedSource,
+                                        ReplicaViewFollower)
+    from heatmap_tpu.sink.base import TileDoc
+
+    rng = random.Random(1234)
+    cells = _city_cells(n_cells)
+    feed = tempfile.mkdtemp(prefix="bench-hist-feed-")
+    hist = tempfile.mkdtemp(prefix="bench-hist-store-")
+    window_s = 86400 // windows_per_day
+    span_s = days * 86400
+    t_end = time.time()
+    t_start = t_end - span_s
+    retention_s = float(span_s + 86400)
+
+    view = TileMatView(pyramid_levels=0)
+    view.audit_table = DigestTable()
+    pub = DeltaLogPublisher(view, feed, seg_bytes=1 << 18, segments=2,
+                            start=False, hist=HistoryLog(hist))
+    # ---- synthesize the windows through the real publish path --------
+    n_windows = days * windows_per_day
+    t_pub0 = time.perf_counter()
+    for wi in range(n_windows):
+        ws_epoch = int(t_start + wi * window_s)
+        ws = dt.datetime.fromtimestamp(ws_epoch, UTC)
+        we = dt.datetime.fromtimestamp(ws_epoch + window_s, UTC)
+        docs = [TileDoc("bos", 8, c, ws, we,
+                        count=rng.randrange(1, 200),
+                        avg_speed_kmh=round(rng.uniform(5, 80), 2),
+                        avg_lat=42.3, avg_lon=-71.05,
+                        ttl_minutes=max(60, span_s // 60), grid="h3r8")
+                for c in cells]
+        # two applies per window: an initial fill + an update wave, so
+        # chunks see genuine upsert churn, not one write per window
+        view.apply_docs(docs)
+        pub.flush()
+        upd = [dict(d, count=int(d["count"]) + 1) for d in
+               rng.sample(docs, max(1, len(docs) // 8))]
+        view.apply_docs(upd)
+        pub.flush()
+    pub.close()
+    publish_s = time.perf_counter() - t_pub0
+
+    # ---- compaction throughput ---------------------------------------
+    comp = HistoryCompactor(hist, feed_dir=feed, bucket_s=bucket_s,
+                            parent_res=parent_res,
+                            retention_s=retention_s)
+    t0 = time.perf_counter()
+    records = 0
+    while True:
+        n = comp.step()
+        records += n
+        if n == 0:
+            break
+    compact_s = time.perf_counter() - t0
+
+    # ---- range-query latency over the compacted span -----------------
+    reader = HistoryReader(FileHistorySource(hist))
+    lat_ms: list = []
+    windows_seen = 0
+    for _ in range(range_queries):
+        a = rng.uniform(t_start, t_end - 2 * window_s)
+        b = min(t_end, a + rng.uniform(window_s, 6 * 3600))
+        q0 = time.perf_counter()
+        got = reader.windows_in_range("h3r8", a, b)
+        lat_ms.append((time.perf_counter() - q0) * 1e3)
+        windows_seen += len(got)
+    lat_ms.sort()
+
+    def pct(q: float) -> float:
+        return lat_ms[min(len(lat_ms) - 1,
+                          int(q * len(lat_ms)))] if lat_ms else 0.0
+
+    # ---- replica cold-start backfill ---------------------------------
+    # a fresh writer epoch whose view only holds the newest window: the
+    # replica bootstraps from its snapshot and backfills the rest of
+    # retention from chunks
+    view2 = TileMatView(pyramid_levels=0)
+    pub2 = DeltaLogPublisher(view2, feed, start=False,
+                             hist=HistoryLog(hist))
+    ws_epoch = int(t_start + (n_windows - 1) * window_s)
+    ws = dt.datetime.fromtimestamp(ws_epoch, UTC)
+    we = dt.datetime.fromtimestamp(ws_epoch + window_s, UTC)
+    view2.apply_docs([TileDoc("bos", 8, cells[0], ws, we, count=1,
+                              avg_speed_kmh=10.0, avg_lat=42.3,
+                              avg_lon=-71.05,
+                              ttl_minutes=max(60, span_s // 60),
+                              grid="h3r8")])
+    pub2.flush()
+    replica = TileMatView(replica=True, pyramid_levels=0)
+    fol = ReplicaViewFollower(replica, FileFeedSource(feed),
+                              hist_source=FileHistorySource(hist))
+    t0 = time.perf_counter()
+    while fol.step():
+        pass
+    backfill_s = time.perf_counter() - t0
+    backfilled = len(replica.window_docs("h3r8")) - 1
+    pub2.close()
+
+    return {
+        "rc": 0 if comp.mismatches == 0 else 1,
+        "kind": "bench_history",
+        "days": days,
+        "windows_per_day": windows_per_day,
+        "cells": n_cells,
+        "bucket_s": bucket_s,
+        "parent_res": parent_res,
+        "retention_s": retention_s,
+        "records": records,
+        "publish_s": round(publish_s, 3),
+        "compact_s": round(compact_s, 3),
+        "compact_records_per_s": round(records / compact_s, 1)
+        if compact_s > 0 else 0.0,
+        "chunks": comp._chunks,
+        "chunk_bytes": comp._chunk_bytes,
+        "range_queries": range_queries,
+        "range_windows_seen": windows_seen,
+        "range_p50_ms": round(pct(0.50), 3),
+        "range_p99_ms": round(pct(0.99), 3),
+        "backfill_ms": round(backfill_s * 1e3, 3),
+        "backfilled_windows": backfilled,
+        "audit": {
+            "enabled": True,
+            "max_residual": 0,
+            "digests_verified": comp.verified,
+            "mismatches": comp.mismatches,
+        },
+        "note": "synthetic windows through the real publish->retire->"
+                "compact path; digests published per record "
+                "(DigestTable) and verified by the compactor",
+        "banked_unix": round(time.time(), 3),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--days", type=int, default=3)
+    ap.add_argument("--windows-per-day", type=int, default=48)
+    ap.add_argument("--cells", type=int, default=256)
+    ap.add_argument("--range-queries", type=int, default=200)
+    ap.add_argument("--bucket-s", type=int, default=3600)
+    ap.add_argument("--parent-res", type=int, default=3)
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default: print only)")
+    args = ap.parse_args(argv)
+    if args.days < 1 or args.windows_per_day < 1 or args.cells < 1 \
+            or args.range_queries < 1:
+        print("bench_history: sizes must be >= 1", file=sys.stderr)
+        return 2
+    art = run(args.days, args.windows_per_day, args.cells,
+              args.range_queries, bucket_s=args.bucket_s,
+              parent_res=args.parent_res)
+    print(json.dumps({
+        "metric": "hist_range_p99_ms",
+        "value": art["range_p99_ms"],
+        "compact_records_per_s": art["compact_records_per_s"],
+        "records": art["records"],
+        "chunks": art["chunks"],
+        "backfill_ms": art["backfill_ms"],
+        "audit": art["audit"],
+    }))
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(art, fh, indent=2)
+            fh.write("\n")
+        print(f"banked {args.out}")
+    if art["audit"]["mismatches"]:
+        print(f"FAIL: {art['audit']['mismatches']} compaction digest "
+              f"mismatch(es) — the run's own books do not balance",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

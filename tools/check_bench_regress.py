@@ -74,6 +74,16 @@ scale with the standing set, so a 10k-query round cannot stand in for
 a 100k one (or mask its regression), the same reasoning as the
 replica-count refusal.
 
+Space-time history artifacts (ISSUE 15, ``BENCH_HIST_r*.json`` from
+tools/bench_history.py) are ratcheted on ``range_p99_ms`` (time-travel
+range-query tail, LOWER-is-better) and ``compact_records_per_s``
+(compaction throughput, HIGHER-is-better); pairs whose
+retention/chunk-shape stamps (bucket_s, parent_res, retention_s, days,
+windows_per_day) differ are refused outright — both numbers scale with
+the chunk shape and retained span, so a 1-hour-bucket round cannot
+stand in for a 1-day-bucket one (or mask its regression).  The
+integrity audit-stamp refusal composes here too.
+
 Usage:
     python tools/check_bench_regress.py [--dir REPO] [--threshold 0.5]
 Exit codes: 0 ok / nothing to compare, 1 regression or mixed-backend /
@@ -516,6 +526,107 @@ def compare_cq(dir_path: str, threshold: float) -> int:
     return rc
 
 
+# -------------------------------------------------------- hist artifacts
+_HIST_ROUND_RE = re.compile(r"BENCH_HIST_r(\d+)\.json$")
+
+
+def hist_artifact_round(path: str) -> int | None:
+    m = _HIST_ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def hist_metrics(path: str) -> tuple | None:
+    """(range_p99_ms, compact_records_per_s, shape) of one
+    BENCH_HIST_r*.json space-time history artifact (tools/
+    bench_history.py) — range-query tail latency (LOWER-is-better),
+    compaction throughput (HIGHER-is-better), and the
+    (bucket_s, parent_res, retention_s, days, windows_per_day)
+    chunk-shape/retention signature that decides comparability.  None
+    when the run failed or the numbers don't parse."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            art = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(art, dict) or art.get("rc", 0) != 0:
+        return None
+    p99 = art.get("range_p99_ms")
+    rps = art.get("compact_records_per_s")
+    if not isinstance(p99, (int, float)) or p99 <= 0 \
+            or not isinstance(rps, (int, float)) or rps <= 0:
+        return None
+    shape = tuple(art.get(k) for k in
+                  ("bucket_s", "parent_res", "retention_s", "days",
+                   "windows_per_day"))
+    return (float(p99), float(rps), shape)
+
+
+def compare_hist(dir_path: str, threshold: float) -> int:
+    """Ratchet the newest two BENCH_HIST_r*.json artifacts: range p99
+    may not GROW and compaction throughput may not DROP past
+    ``threshold``.  Pairs with different retention/chunk-shape stamps
+    are REFUSED (exit 1) — a 1-hour-bucket store's range latency says
+    nothing about a 1-day-bucket one (or masks its regression), the
+    same reasoning as every other provenance refusal.  The integrity
+    audit-stamp refusal composes: a leak-stamped round is never banked
+    or used as the baseline."""
+    arts = []
+    for p in glob.glob(os.path.join(glob.escape(dir_path),
+                                    "BENCH_HIST_r*.json")):
+        rnd = hist_artifact_round(p)
+        if rnd is None:
+            continue
+        arts.append((rnd, p, hist_metrics(p)))
+    arts.sort()
+    usable = [(r, p, m) for r, p, m in arts if m is not None]
+    for r, p, m in arts:
+        if m is None:
+            print(f"note: skipping hist r{r:02d} "
+                  f"({os.path.basename(p)}): failed run or no "
+                  f"parseable p99/throughput")
+    if len(usable) < 2:
+        print(f"OK: {len(usable)} usable hist artifact(s) — nothing "
+              f"to compare")
+        return 0
+    (r_prev, p_prev, m_prev), (r_new, p_new, m_new) = \
+        usable[-2], usable[-1]
+    if audit_refused(p_prev, f"hist r{r_prev:02d}") \
+            or audit_refused(p_new, f"hist r{r_new:02d}"):
+        return 1
+    (p99_prev, rps_prev, shape_prev) = m_prev
+    (p99_new, rps_new, shape_new) = m_new
+    if shape_prev != shape_new:
+        print(f"FAIL: history shape mismatch — hist r{r_prev:02d} ran "
+              f"(bucket_s, parent_res, retention_s, days, "
+              f"windows_per_day) = {shape_prev} but r{r_new:02d} ran "
+              f"{shape_new}; range latency and compaction throughput "
+              f"scale with the chunk shape and retained span, so the "
+              f"pair is not the same experiment (and would mask its "
+              f"regression) — re-run the bench at the previous shape",
+              file=sys.stderr)
+        return 1
+    rc = 0
+    growth = (p99_new - p99_prev) / p99_prev
+    line = (f"hist r{r_prev:02d} range_p99_ms {p99_prev:,.2f} -> "
+            f"r{r_new:02d} {p99_new:,.2f} ({growth:+.1%})")
+    if growth > threshold:
+        print(f"FAIL: hist range-query regression beyond "
+              f"{threshold:.0%}: {line}", file=sys.stderr)
+        rc = 1
+    else:
+        print(f"OK: {line} within the {threshold:.0%} threshold")
+    drop = (rps_prev - rps_new) / rps_prev
+    line = (f"hist r{r_prev:02d} compaction {rps_prev:,.0f} rec/s -> "
+            f"r{r_new:02d} {rps_new:,.0f} rec/s ({-drop:+.1%})")
+    if drop > threshold:
+        print(f"FAIL: hist compaction-throughput regression beyond "
+              f"{threshold:.0%}: {line}", file=sys.stderr)
+        rc = 1
+    else:
+        print(f"OK: {line} within the {threshold:.0%} threshold")
+    return rc
+
+
 # ------------------------------------------------------ govern artifacts
 _GOVERN_ROUND_RE = re.compile(r"BENCH_GOVERN_r(\d+)\.json$")
 
@@ -630,6 +741,7 @@ def main(argv=None) -> int:
     serve_rc = compare_govern(args.dir, args.threshold) or serve_rc
     serve_rc = compare_multichip(args.dir, args.threshold) or serve_rc
     serve_rc = compare_cq(args.dir, args.threshold) or serve_rc
+    serve_rc = compare_hist(args.dir, args.threshold) or serve_rc
 
     arts = newest_pair(args.dir)
     usable = [(r, p, v) for r, p, v in arts if v is not None]

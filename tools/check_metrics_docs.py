@@ -136,6 +136,22 @@ def _smoke_repl():
     return list(reg._families.values())
 
 
+def _smoke_hist():
+    """CONSTRUCTED space-time history compactor (query/history.py):
+    the ``heatmap_hist_*`` families only register under
+    HEATMAP_HIST_DIR, which no runtime smoke above sets.  Construction
+    alone registers them; no compaction thread starts.  The replica
+    backfill counter registers with the follower (covered by
+    _smoke_repl)."""
+    from heatmap_tpu.obs.registry import Registry
+    from heatmap_tpu.query.history import HistoryCompactor
+
+    reg = Registry()
+    HistoryCompactor(tempfile.mkdtemp(prefix="metrics-docs-hist-"),
+                     registry=reg)
+    return list(reg._families.values())
+
+
 def _smoke_govern():
     """CONSTRUCTED adaptive-batching governor (stream/govern.py): its
     metric families only register under HEATMAP_GOVERN=1, which none
@@ -212,6 +228,8 @@ def main() -> int:
                  if f.name not in seen]
     seen = {f.name for f in fams}
     fams += [f for f in _smoke_repl() if f.name not in seen]
+    seen = {f.name for f in fams}
+    fams += [f for f in _smoke_hist() if f.name not in seen]
     seen = {f.name for f in fams}
     fams += [f for f in _smoke_govern() if f.name not in seen]
     seen = {f.name for f in fams}

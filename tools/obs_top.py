@@ -31,7 +31,10 @@ open SSE clients, and the 304 ratio per worker, plus the fleet's max
 seq lag.  Workers serving the binary wire path (serve/wire.py) add a
 serve-wire table: per-worker open clients, negotiated-format mix
 (binary fraction), wire-vs-rendered byte rates, admission-shed count,
-and the SSE fan-out send-queue high-water.
+and the SSE fan-out send-queue high-water.  Members running the
+space-time history tier (query/history.py) add a history row (single
+view) and a per-member history table in ``--fleet``: chunks on disk,
+covered span, compaction lag, replica backfills.
 
 Usage:
     python tools/obs_top.py [--url http://127.0.0.1:5000] [--interval 2]
@@ -220,6 +223,19 @@ def render_frame(m: dict, prev: dict | None, dt: float,
             f"last adjust {fmt(age, ' s ago', digits=0)}"
             + (f" ({last})" if last else "")
             + ("   FROZEN" if frozen else ""))
+    # space-time history tier (query/history.py, HEATMAP_HIST_DIR):
+    # chunks on disk, the wall-clock span they cover, the compaction
+    # lag healthz gates on, and replica backfills — absent entirely
+    # when the tier is off
+    hist_chunks = _val(m, "heatmap_hist_chunks")
+    if hist_chunks is not None:
+        mm = _val(m, "heatmap_hist_digest_mismatch_total")
+        lines.append(
+            f"  history   chunks {fmt(hist_chunks, digits=0):>12}   "
+            f"span {fmt(_val(m, 'heatmap_hist_covered_span_seconds'), ' h', 1 / 3600.0)}   "
+            f"compaction lag {fmt(_val(m, 'heatmap_hist_compaction_lag_seconds'), ' s')}   "
+            f"backfills {fmt(_val(m, 'heatmap_hist_backfill_total'), digits=0)}"
+            + ("   MISMATCH" if mm else ""))
     # integrity observatory (obs.audit, HEATMAP_AUDIT=1): per-boundary
     # conservation residuals (worst named), digest verification state,
     # and the newest verified seq — absent entirely when auditing is off
@@ -546,6 +562,33 @@ def render_fleet_frame(m: dict, prev: dict | None, dt: float,
                     f"{fmt(_rate(rend, rend_prev, tag), digits=0):>12}"
                     f"{fmt(shed.get(tag), digits=0):>7}"
                     f"{fmt(qhw.get(tag), digits=0):>6}")
+    # space-time history tier (query/history.py): one row per member
+    # carrying history state — chunks, covered span, compaction lag,
+    # digest mismatches (writer/compactor members) and cold-start
+    # backfills (replicas).  Absent without HEATMAP_HIST_DIR anywhere
+    # on the channel.
+    h_chunks = _by_proc(m, "heatmap_hist_chunks")
+    h_bf = _by_proc(m, "heatmap_hist_backfill_total")
+    h_tags = sorted(set(h_chunks)
+                    | set(t for t, v in h_bf.items() if v))
+    if h_tags:
+        h_span = _by_proc(m, "heatmap_hist_covered_span_seconds")
+        h_lag = _by_proc(m, "heatmap_hist_compaction_lag_seconds")
+        h_mm = _by_proc(m, "heatmap_hist_digest_mismatch_total")
+        lines.append("")
+        lines.append(f"  {'history':<14}{'chunks':>9}{'span':>10}"
+                     f"{'lag':>9}{'backfills':>11}")
+        for tag in h_tags:
+            lines.append(
+                f"  {tag:<14}{fmt(h_chunks.get(tag), digits=0):>9}"
+                f"{fmt(h_span.get(tag), ' h', 1 / 3600.0):>10}"
+                f"{fmt(h_lag.get(tag), ' s'):>9}"
+                f"{fmt(h_bf.get(tag), digits=0):>11}"
+                + ("  MISMATCH" if h_mm.get(tag) else ""))
+        lags = [v for v in h_lag.values() if v is not None]
+        if lags:
+            lines.append(f"  hist max compaction lag "
+                         f"{fmt(max(lags), ' s')}")
     # integrity observatory (obs.audit): one row per audited member —
     # worst conservation residual (boundary named), digests verified /
     # mismatched, last verified seq (replicas).  Absent without
