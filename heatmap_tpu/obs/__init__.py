@@ -1,6 +1,6 @@
 """obs — pipeline-wide observability substrate.
 
-Seven pieces, all dependency-free:
+Eight pieces, all dependency-free:
 
 - :mod:`registry` — counters / gauges / fixed-bucket histograms with
   Prometheus text exposition (``Registry.expose_text``);
@@ -10,6 +10,10 @@ Seven pieces, all dependency-free:
 - :mod:`lineage` — per-batch freshness lineage (event ts → sink-commit
   ack, staged through poll/prefetch/fold/ring/sink), the substrate of
   ``heatmap_event_age_seconds`` and ``/debug/freshness``;
+- :mod:`delivery` — read-path delivery lineage (publish enqueue →
+  feed transit → replica apply → fan-out → subscriber socket write),
+  the substrate of ``heatmap_delivered_age_seconds``,
+  ``/debug/delivery`` and ``/fleet/delivery`` (``HEATMAP_DELIVERY``);
 - :mod:`flightrec` — crash-time state dump (trace tail, lineage tail,
   metrics snapshot, config) to ``HEATMAP_FLIGHTREC_DIR``;
 - :mod:`runtimeinfo` — compile/retrace tracking on the jitted entry
@@ -28,6 +32,11 @@ stream.metrics.Metrics builds on the registry and keeps its historical
 knobs are documented in ARCHITECTURE.md §Observability.
 """
 
+from heatmap_tpu.obs.delivery import (  # noqa: F401
+    DELIVERY_STAGES,
+    DeliveryTracker,
+    delivery_enabled,
+)
 from heatmap_tpu.obs.flightrec import FlightRecorder  # noqa: F401
 from heatmap_tpu.obs.lineage import LineageTracker  # noqa: F401
 from heatmap_tpu.obs.prof import StackSampler, get_sampler  # noqa: F401

@@ -89,6 +89,12 @@ FAMILIES = (
     ("heatmap_fleet_member_event_age_p99_s", "gauge",
      "each member's recent end-to-end event-age p99, from its "
      "published freshness summary"),
+    ("heatmap_fleet_member_delivered_age_p50_s", "gauge",
+     "each member's recent delivered-age p50 (event occurrence to "
+     "subscriber socket write), from its published delivery block"),
+    ("heatmap_fleet_member_delivered_age_p99_s", "gauge",
+     "each member's recent delivered-age p99, from its published "
+     "delivery block"),
     ("heatmap_fleet_event_age_p50_s", "gauge",
      "fleet-level interpolated event-age p50 over the members' MERGED "
      "cumulative histogram buckets (per-member p50s do not average)"),
@@ -254,8 +260,12 @@ class FleetAggregator:
         latency_buckets: dict = {}  # le -> cum (batch_latency)
         up_lines: list = []
         age_lines: list = []
-        fresh_lines: dict = {"heatmap_fleet_member_event_age_p50_s": [],
-                             "heatmap_fleet_member_event_age_p99_s": []}
+        fresh_lines: dict = {
+            "heatmap_fleet_member_event_age_p50_s": [],
+            "heatmap_fleet_member_event_age_p99_s": [],
+            "heatmap_fleet_member_delivered_age_p50_s": [],
+            "heatmap_fleet_member_delivered_age_p99_s": [],
+        }
         # per-member series regrouped BY FAMILY: the exposition format
         # requires one contiguous block per metric name, and with N
         # members every member contributes samples to the same families
@@ -280,6 +290,18 @@ class FleetAggregator:
                              ("event_age_p99_s",
                               "heatmap_fleet_member_event_age_p99_s")):
                 v = fresh.get(key)
+                if isinstance(v, (int, float)):
+                    fresh_lines[fam].append(
+                        f"{fam}{{{up_lbl}}} {_fmt(v)}")
+            # per-member delivered-age gauges from the published
+            # delivery block — same shape as the freshness pair
+            delv = snap.get("delivery") or {}
+            for key, fam in (
+                    ("age_p50_s",
+                     "heatmap_fleet_member_delivered_age_p50_s"),
+                    ("age_p99_s",
+                     "heatmap_fleet_member_delivered_age_p99_s")):
+                v = delv.get(key)
                 if isinstance(v, (int, float)):
                     fresh_lines[fam].append(
                         f"{fam}{{{up_lbl}}} {_fmt(v)}")
@@ -465,6 +487,65 @@ class FleetAggregator:
             "members": sorted(members),
             "stale_members": sorted(skipped),
         }
+
+    # ----------------------------------------------------------- delivery
+    def delivery(self) -> tuple[dict, bool]:
+        """``/fleet/delivery``: every member's delivery-lineage block
+        (obs.delivery ``member_block``: delivered-age quantiles,
+        per-stage p50s, worst stage, residual bound) rolled up, with
+        the WORST replica named by delivered-age p50 — the row an
+        operator pages on.  A stale/vanished member degrades the
+        surface NAMING it (second return True → the endpoint serves
+        503): a SIGKILLed replica must never read as a healthy delivery
+        fleet, and the active episode (obs.xproc broadcast) rides along
+        so the degradation correlates with the incident's flight
+        recorder dumps."""
+        from heatmap_tpu.obs.delivery import (
+            CROSS_HOST_STAGES,
+            DELIVERY_STAGES,
+        )
+
+        members, skipped = self.collect()
+        per: dict = {}
+        degraded = bool(skipped)
+        worst: tuple | None = None  # (age_p50_s, tag)
+        reporting = 0
+        for tag, reason in sorted(skipped.items()):
+            per[tag] = {"skipped": reason}
+        for tag in sorted(members):
+            block = members[tag].get("delivery")
+            if not isinstance(block, dict) or not block.get("count"):
+                # a member without subscribers (or with the knob off)
+                # is absent, not degraded — delivery is per-replica
+                per[tag] = {"count": 0}
+                continue
+            per[tag] = block
+            reporting += 1
+            v = block.get("age_p50_s")
+            if isinstance(v, (int, float)) and (worst is None
+                                                or v > worst[0]):
+                worst = (float(v), tag)
+        payload = {
+            "ok": not degraded,
+            "members": per,
+            "member_tags": sorted(members),
+            "stale_members": sorted(skipped),
+            "reporting": reporting,
+            "stage_order": list(DELIVERY_STAGES),
+            "cross_host": list(CROSS_HOST_STAGES),
+        }
+        if worst is not None:
+            payload["worst"] = {
+                "proc": worst[1],
+                "age_p50_s": round(worst[0], 6),
+                "worst_stage": (per[worst[1]].get("worst_stage")
+                                if isinstance(per[worst[1]], dict)
+                                else None),
+            }
+        ep = read_episode(self.path)
+        if ep:
+            payload["episode"] = ep
+        return payload, degraded
 
     # -------------------------------------------------------------- audit
     def audit(self) -> dict:

@@ -180,6 +180,19 @@ class LineageTracker:
         with self._lock:
             return self._newest_committed_ts
 
+    def newest_event_age_s(self, now: float | None = None) -> float:
+        """Age of the newest sink-acked event right now — the
+        ``event_age`` leg the delivery lineage (obs.delivery) seeds its
+        telescoping decomposition with.  O(1): one watermark read, no
+        tail scan.  0.0 before any commit (the leg is simply absent,
+        not negative)."""
+        with self._lock:
+            ts = self._newest_committed_ts
+        if ts is None:
+            return 0.0
+        t = self.clock() if now is None else float(now)
+        return max(0.0, t - ts)
+
     def tail(self, n: int = 50) -> list:
         """Newest-first closed records.  Copies are taken UNDER the
         tracker lock, and the nested ``stages``/``age_s`` dicts are

@@ -212,6 +212,7 @@ def publish_member_snapshot(channel_path: str, tag: str, *, role: str,
                             audit: dict | None = None,
                             cq: dict | None = None,
                             hist: dict | None = None,
+                            delivery: dict | None = None,
                             left: bool = False) -> None:
     """Atomic write of one member's full observability snapshot:
     Prometheus exposition text of its registry, its freshness summary,
@@ -259,6 +260,14 @@ def publish_member_snapshot(channel_path: str, tag: str, *, role: str,
         # backfills — absent on members without the tier, keeping
         # snapshots byte-compatible
         payload["hist"] = hist
+    if delivery:
+        # the member's delivery-lineage block (obs.delivery
+        # DeliveryTracker.member_block: delivered-age p50/p99, per-stage
+        # p50s, worst stage, residual bound) — /fleet/delivery rolls
+        # these up and names the worst replica; absent on members
+        # without subscribers or with HEATMAP_DELIVERY off, keeping
+        # snapshots byte-compatible
+        payload["delivery"] = delivery
     if left:
         payload["left"] = True
     try:

@@ -1314,26 +1314,94 @@ def compaction_status(hist_dir: str, now: float | None = None) -> dict:
 
 
 # --------------------------------------------------------------- reader
+# per-request scan accounting: the serve tier calls scan_reset() before
+# a history query and attaches last_scan() to the request span after —
+# thread-local so concurrent workers never mix counts.  The registry
+# counters (heatmap_hist_scan_*) always accrue, reset or not.
+_scan_tls = threading.local()
+
+#: the fields one request's scan accounting carries
+SCAN_FIELDS = ("chunks_opened", "blocks_scanned", "blocks_used",
+               "bytes_decoded", "rows_surfaced")
+
+
+def scan_reset() -> None:
+    """Zero this thread's per-request scan accounting."""
+    _scan_tls.scan = dict.fromkeys(SCAN_FIELDS, 0)
+
+
+def last_scan() -> dict | None:
+    """This thread's accounting since the last :func:`scan_reset`,
+    with the scan-efficiency ratio (blocks the query actually needed /
+    blocks materialized to find them): today's whole-chunk decodes
+    pin it well below 1; ROADMAP item 4's window index must drive it
+    toward 1.  None when never reset on this thread."""
+    s = getattr(_scan_tls, "scan", None)
+    if s is None:
+        return None
+    out = dict(s)
+    out["scan_ratio"] = round(
+        out["blocks_used"] / max(1, out["blocks_scanned"]), 4)
+    return out
+
+
+def _scan_add(field: str, n: int) -> None:
+    s = getattr(_scan_tls, "scan", None)
+    if s is not None:
+        s[field] += n
+
+
 class HistoryReader:
     """Range / at-seq / diff queries over a history source (+ an
     optional live view whose windows overlay the chunks — latest and
     not-yet-compacted windows serve without waiting for the
     compactor).  Decoded chunks are memoized by (name, bytes) bounded
-    at ``cache_chunks``."""
+    at ``cache_chunks``.
 
-    def __init__(self, source, view=None, cache_chunks: int = 64):
+    Every query is scan-accounted: chunks opened, window blocks
+    scanned vs actually used, bytes decoded, rows surfaced — the
+    process counters feed ``heatmap_hist_scan_*`` and the thread-local
+    per-request tally feeds the serve request span."""
+
+    def __init__(self, source, view=None, cache_chunks: int = 64,
+                 registry=None):
         self.source = source
         self.view = view
         self._cache: dict = {}
         self._cache_max = max(4, int(cache_chunks))
+        self._c_chunks = self._c_blocks = None
+        self._c_bytes = self._c_rows = None
+        if registry is not None:
+            self._c_chunks = registry.counter(
+                "heatmap_hist_scan_chunks_total",
+                "history chunks consulted by range/at/diff queries "
+                "(cache hits included — the chunk was still the scan "
+                "unit)")
+            self._c_blocks = registry.counter(
+                "heatmap_hist_scan_blocks_total",
+                "window blocks materialized by history queries; with "
+                "whole-chunk decodes every block in a consulted chunk "
+                "counts, wanted or not — the denominator of the "
+                "scan-efficiency ratio the window index must improve")
+            self._c_bytes = registry.counter(
+                "heatmap_hist_scan_bytes_total",
+                "chunk bytes decoded by history queries (cache misses "
+                "only — what the query actually paid in decode I/O)")
+            self._c_rows = registry.counter(
+                "heatmap_hist_scan_rows_total",
+                "cell documents surfaced to history query responses")
 
     def _chunk_windows(self, meta: dict) -> dict:
         name = meta.get("name")
         # mtime in the key: an atomic rewrite can keep the byte size
         # (varint count bumps, f64 changes) — size alone served stale
         key = (name, meta.get("bytes"), meta.get("mtime_ns"))
+        if self._c_chunks is not None:
+            self._c_chunks.inc()
+        _scan_add("chunks_opened", 1)
         hit = self._cache.get(name)
         if hit is not None and hit[0] == key:
+            self._count_blocks(len(hit[1]))
             return hit[1]
         buf = self.source.chunk_bytes(name)
         if buf is None:
@@ -1342,10 +1410,32 @@ class HistoryReader:
             _meta, windows = decode_chunk(buf)
         except ValueError:
             return {}
+        if self._c_bytes is not None:
+            self._c_bytes.inc(len(buf))
+        _scan_add("bytes_decoded", len(buf))
+        # whole-chunk decode: every window block was materialized to
+        # answer the query, however few it wanted.  Counted on cache
+        # hits too (the decoded form is block-complete either way) so
+        # the efficiency ratio doesn't flatter a warm cache.
+        self._count_blocks(len(windows))
         if len(self._cache) >= self._cache_max:
             self._cache.pop(next(iter(self._cache)))
         self._cache[name] = (key, windows)
         return windows
+
+    def _count_blocks(self, n: int) -> None:
+        if n <= 0:
+            return
+        if self._c_blocks is not None:
+            self._c_blocks.inc(n)
+        _scan_add("blocks_scanned", n)
+
+    def _count_rows(self, n: int) -> None:
+        if n <= 0:
+            return
+        if self._c_rows is not None:
+            self._c_rows.inc(n)
+        _scan_add("rows_surfaced", n)
 
     def windows_in_range(self, grid: str, t0: float,
                          t1: float) -> dict:
@@ -1361,13 +1451,16 @@ class HistoryReader:
             if not wanted:
                 continue
             windows = self._chunk_windows(meta)
+            used = 0
             for ws in wanted:
                 part = windows.get(ws)
                 if part is None:
                     continue
+                used += 1
                 cells = out.setdefault(ws, {})
                 for d in part["docs"]:
                     cells[d.get("cellId")] = d
+            _scan_add("blocks_used", used)
         if self.view is not None:
             try:
                 live = self.view.window_docs(grid)
@@ -1376,6 +1469,7 @@ class HistoryReader:
             for ws, (_ws_dt, _we_dt, docs) in live.items():
                 if t0 <= ws < t1:
                     out[ws] = {d.get("cellId"): d for d in docs}
+        self._count_rows(sum(len(c) for c in out.values()))
         return {ws: {"docs": [cells[c] for c in sorted(cells)]}
                 for ws, cells in out.items()}
 

@@ -129,6 +129,7 @@ Integrity observatory (obs.audit, gated by ``HEATMAP_AUDIT=1``):
 
 from __future__ import annotations
 
+import collections
 import datetime as dt
 import functools
 import gzip
@@ -753,6 +754,13 @@ class _ServeStats:
             "high-water mark of any SSE subscriber's bounded send "
             "queue (frames) since boot — how close the slowest healthy "
             "reader has come to being shed")
+        # ---- delivery observatory (ISSUE 16) -------------------------
+        self.slow_requests = reg.counter(
+            "heatmap_serve_slow_requests_total",
+            "requests whose total handling time crossed "
+            "HEATMAP_SLOWREQ_MS and were captured (full per-stage "
+            "span) into the slow-request ring at /debug/requests",
+            labels=("endpoint",))
 
 
 class _SSEBody:
@@ -777,6 +785,122 @@ class _SSEBody:
             self._gen.close()
         finally:
             self._on_close()
+
+
+# ------------------------------------------------- serve request spans
+class _Span:
+    """One request's per-stage timing: ``mark(stage)`` accrues the time
+    since the previous mark, so the stage sum telescopes to the total
+    by construction — the same conservation rule as the lineage tiers.
+    Stages on the data plane: admission (semaphore wait), parse
+    (routing + query-string handling), lookup (view/store/history data
+    production), encode (serialize + gzip + headers), write (the WSGI
+    server draining the body to the socket, stamped by _SpanBody)."""
+
+    __slots__ = ("endpoint", "status", "bytes_in", "bytes_out",
+                 "view_seq", "stages", "scan", "t_unix", "_t0", "_last")
+
+    def __init__(self, endpoint: str = "?"):
+        self.endpoint = endpoint
+        self.status = 0
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self.view_seq = None
+        self.stages: dict = {}
+        self.scan = None
+        self.t_unix = time.time()
+        self._t0 = self._last = time.perf_counter()
+
+    def mark(self, stage: str) -> None:
+        now = time.perf_counter()
+        self.stages[stage] = (self.stages.get(stage, 0.0)
+                              + (now - self._last))
+        self._last = now
+
+    def total_ms(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e3
+
+    def to_dict(self) -> dict:
+        d = {"endpoint": self.endpoint, "status": self.status,
+             "bytes_in": self.bytes_in, "bytes_out": self.bytes_out,
+             "total_ms": round(self.total_ms(), 3),
+             "stages_ms": {k: round(v * 1e3, 3)
+                           for k, v in self.stages.items()},
+             "t": round(self.t_unix, 3)}
+        if self.view_seq is not None:
+            d["view_seq"] = self.view_seq
+        if self.scan:
+            d["scan"] = self.scan
+        return d
+
+
+class _RequestRing:
+    """Bounded newest-first span ring with optional JSONL persistence
+    (the slow-request capture): append-only, flushed per record,
+    dead-latched on the first write error so a bad path degrades to
+    in-memory-only instead of failing requests."""
+
+    def __init__(self, capacity: int = 256,
+                 jsonl_path: str | None = None):
+        self._ring: collections.deque = collections.deque(
+            maxlen=max(1, int(capacity)))
+        self._lock = threading.Lock()
+        self._jsonl_path = jsonl_path
+        self._jsonl_fh = None
+        self._jsonl_dead = False
+
+    def record(self, rec: dict) -> None:
+        with self._lock:
+            self._ring.append(rec)
+            if self._jsonl_path is None or self._jsonl_dead:
+                return
+            try:
+                if self._jsonl_fh is None:
+                    self._jsonl_fh = open(self._jsonl_path, "a",
+                                          encoding="utf-8")
+                self._jsonl_fh.write(
+                    json.dumps(rec, separators=(",", ":")) + "\n")
+                self._jsonl_fh.flush()
+            except (OSError, TypeError, ValueError) as e:
+                self._jsonl_dead = True
+                log.warning("slow-request JSONL write failed "
+                            "(capture disabled): %s", e)
+
+    def recent(self, n: int = 50) -> list:
+        with self._lock:
+            items = list(self._ring)
+        return items[::-1][: max(0, int(n))]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+class _SpanBody:
+    """Response-body wrapper that closes the request span when the WSGI
+    server has DRAINED the body — the write stage is the real socket
+    drain, not the handler's return.  ``commit`` runs exactly once
+    (wsgiref calls close() even on client disconnect)."""
+
+    def __init__(self, chunks, span, commit):
+        self._chunks = chunks
+        self._span = span
+        self._commit = commit
+        self._done = False
+
+    def __iter__(self):
+        for c in self._chunks:
+            yield c
+
+    def close(self):
+        if self._done:
+            return
+        self._done = True
+        self._span.mark("write")
+        try:
+            self._commit(self._span)
+        except Exception:  # noqa: BLE001 - span accounting must not 500
+            log.exception("request-span commit failed")
 
 
 def _delta_body(d: dict, grid: str) -> str:
@@ -827,6 +951,16 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
     serve_reg = (runtime.metrics.registry if runtime is not None
                  else Registry())
     stats = _ServeStats(serve_reg)
+    # ---- delivery observatory (ISSUE 16) ------------------------------
+    # Read-path lineage to the subscriber socket: the follower installs
+    # each applied record's writer stamps + local receipt/apply, the SSE
+    # pumps stamp encode, and the subscriber generators complete
+    # end-to-end delivered samples (obs.delivery — /debug/delivery,
+    # /fleet/delivery, heatmap_delivered_age_seconds{bound=}).
+    from heatmap_tpu.obs.delivery import (DeliveryTracker,
+                                          ENV_SLO_DELIVERED_P50_MS)
+
+    delivery = DeliveryTracker(registry=serve_reg)
     view = getattr(runtime, "matview", None) if runtime is not None else None
     refresher = None
     follower = None
@@ -839,6 +973,10 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
     # re-export over the same transport.  The source also feeds the
     # follower's cold-start backfill below.
     hist_dir = getattr(cfg, "hist_dir", "") if cfg else ""
+    # scan accounting (ISSUE 16): the history endpoints reset the
+    # thread-local tally before each query and attach it to the span
+    from heatmap_tpu.query import history as histmod
+
     hist_src = None
     if hist_dir:
         from heatmap_tpu.query.history import FileHistorySource
@@ -909,7 +1047,8 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 audit=serve_audit,
                 hist_source=(hist_src
                              if getattr(cfg, "hist_backfill", True)
-                             else None))
+                             else None),
+                delivery=delivery)
             follower.start()
     # Continuous spatial query engine (query.continuous): standing
     # bbox/polygon/topk/geofence/threshold subscriptions over the
@@ -937,7 +1076,8 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
     if hist_src is not None:
         from heatmap_tpu.query.history import HistoryReader
 
-        hist_reader = HistoryReader(hist_src, view=view)
+        hist_reader = HistoryReader(hist_src, view=view,
+                                    registry=serve_reg)
     # view-at-seq replays are full log reconstructions: memoize the
     # rendered bodies of the last few (epoch-keyed — a writer restart
     # invalidates naturally because the epoch changes)
@@ -970,6 +1110,46 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
     fanout = wiremod.FanoutHub(depth=sse_queue,
                                on_lagged=stats.sse_lagged.inc,
                                hw_gauge=stats.sse_queue_hw)
+    # write-stall surface (ISSUE 16 fan-out fix): a wedged client used
+    # to be invisible until lag-shedding fired; this gauge exposes the
+    # worst in-flight socket-write age across subscribers continuously
+    serve_reg.gauge(
+        "heatmap_sse_write_stall_seconds",
+        "age of the oldest in-flight (un-returned) SSE socket write "
+        "across all subscribers — a wedged client shows here for the "
+        "whole send-timeout window BEFORE it is shed as lagged",
+        fn=fanout.max_write_stall_s)
+    # ---- serve request spans (ISSUE 16) -------------------------------
+    # Every admission-controlled request carries a _Span; completed
+    # spans land in a bounded ring at /debug/requests, and spans slower
+    # than HEATMAP_SLOWREQ_MS are captured to a second ring persisted
+    # as flight-recorder-style JSONL (HEATMAP_SLOWREQ_JSONL).
+    span_ring = _RequestRing(capacity=256)
+    slowreq_ms = _slo("HEATMAP_SLOWREQ_MS", 0.0)
+    slow_ring = _RequestRing(
+        capacity=64,
+        jsonl_path=os.environ.get("HEATMAP_SLOWREQ_JSONL") or None)
+    # one flight-record dump on the FIRST slow request per process
+    # (FlightRecorder's once-only dump contract bounds the cost): the
+    # full observability state around the first pathological request
+    # is usually the diagnostic one
+    from heatmap_tpu.obs import flightrec as flightrec_mod
+
+    flightrec = flightrec_mod.from_env()
+    if flightrec is not None:
+        flightrec.add_source("delivery", delivery.snapshot)
+        flightrec.add_source("requests",
+                             lambda: span_ring.recent(64))
+
+    def _commit_span(span: _Span) -> None:
+        rec = span.to_dict()
+        span_ring.record(rec)
+        if slowreq_ms > 0 and rec["total_ms"] >= slowreq_ms:
+            stats.slow_requests.labels(endpoint=span.endpoint).inc()
+            slow_ring.record(rec)
+            if flightrec is not None:
+                flightrec.dump(f"slow request {span.endpoint} "
+                               f"{rec['total_ms']:.0f}ms")
     max_inflight = (getattr(cfg, "serve_max_inflight", 256)
                     if cfg else 256)
     admit_sem = (threading.BoundedSemaphore(max_inflight)
@@ -1146,6 +1326,21 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 _slo("HEATMAP_SLO_CQ_LAG_S", 5.0))
             checks.update(cc)
             degraded |= c_degraded
+        if follower is not None:
+            # delivered-freshness SLO (ISSUE 16): the age a subscriber
+            # socket actually receives, not just request latency.
+            # Evaluated only where samples exist — a replica with no
+            # SSE subscribers has no delivered age to breach.
+            dsum = delivery.summary()
+            if dsum.get("count"):
+                budget_ms = _slo(ENV_SLO_DELIVERED_P50_MS, 2000.0)
+                p50_ms = dsum["age_p50_s"] * 1e3
+                ok = p50_ms <= budget_ms
+                checks["delivered_age_p50_ms"] = {
+                    "value": round(p50_ms, 1), "budget": budget_ms,
+                    "ok": ok,
+                    "worst_stage": dsum.get("worst_stage")}
+                degraded |= not ok
         return checks, degraded
 
     healthz = functools.partial(healthz_payload, runtime,
@@ -1261,7 +1456,12 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                     frame = _sse_tiles_frame(d, grid, fmt)
                     stats.sse_encodes.labels(fmt=fmt).inc()
                     last = d["seq"]
-                    chan.broadcast(frame)
+                    # delivery lineage: one encode stamp per (channel,
+                    # seq) — None when no upstream stamps cover the seq
+                    # (knob off / writer-fed), and then the frame goes
+                    # out untagged, byte-identical to pre-lineage runs
+                    meta = delivery.encoded(d["seq"])
+                    chan.broadcast(frame, meta=meta)
                     continue
                 # store-polling pumps must keep POLLING (nothing else
                 # advances the view), so their wait slices shorter
@@ -1300,7 +1500,27 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                     return
                 if item is wiremod.CLOSED:
                     return
+                # delivery lineage: a Tagged frame carries the encode
+                # stamp sidecar — yield the SAME bytes object (wire
+                # unchanged) and bracket the blocking socket write so
+                # the sample completes at the subscriber boundary.
+                # The stall stamps (monotonic, on the sub) make a
+                # wedged client visible the whole time the yield below
+                # is parked in send().
+                meta = None
+                if isinstance(item, wiremod.Tagged):
+                    meta = item.meta
+                    item = item.data
+                wb = delivery.clock()
+                with sub.cond:
+                    sub.write_begin_mono = time.monotonic()
                 yield item
+                with sub.cond:
+                    sub.write_begin_mono = None
+                    sub.last_write_mono = time.monotonic()
+                    sub.writes += 1
+                if meta is not None:
+                    delivery.delivered(meta, wb, delivery.clock())
                 last_beat = time.monotonic()
         return events()
 
@@ -1432,7 +1652,13 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                     frame = _cq_frames(evs)
                     stats.sse_encodes.labels(fmt="cq").inc()
                     last = evs[-1]["id"]
-                    chan.broadcast(frame)
+                    # CQ match pushes ride the same delivery stamps as
+                    # tile frames: the newest match's view seq anchors
+                    # the lineage, so alert-delivery lag is measured
+                    seqs = [ev.get("seq") for ev in evs
+                            if isinstance(ev.get("seq"), int)]
+                    meta = delivery.encoded(max(seqs)) if seqs else None
+                    chan.broadcast(frame, meta=meta)
                     continue
                 if cq_engine.get(qid) is None:
                     # expired (TTL) or deleted: tell the client not to
@@ -1465,6 +1691,14 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
         status = "200 OK"
         endpoint = None          # sent-bytes accounting label
         extra_headers: list = []
+        # request span (ISSUE 16): installed by app() on the admitted
+        # data endpoints; marks accrue time since the previous mark, so
+        # the stages telescope to the total
+        span = environ.get("heatmap.span")
+
+        def _mk(stage):
+            if span is not None:
+                span.mark(stage)
 
         def _bad_request(msg):
             start_response("400 Bad Request",
@@ -1511,6 +1745,7 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 # replay the wrong representation (RFC 9110 §12.5.5)
                 extra_headers.append(("Vary", "Accept"))
                 ctype = "application/json"
+                _mk("parse")
                 v = _tiles_view(grid)
                 if v is not None:
                     # etag + docs + seq captured atomically: a writer
@@ -1574,6 +1809,7 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                         endpoint)
                 stats.wire_format.labels(endpoint=endpoint,
                                          fmt=fmt).inc()
+                _mk("lookup")
                 if runtime is not None:
                     _sample_serve_freshness(runtime)
             elif path == "/api/tiles/delta":
@@ -1587,6 +1823,7 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                     return _bad_request(err)
                 since = _qs_int(params, "since", 0, 1 << 62)
                 extra_headers.append(("Vary", "Accept"))
+                _mk("parse")
                 v = _tiles_view(grid)
                 if v is None:
                     return _unavailable(
@@ -1612,6 +1849,7 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 _account_render(endpoint, data)
                 stats.wire_format.labels(endpoint=endpoint,
                                          fmt=fmt).inc()
+                _mk("lookup")
                 if runtime is not None:
                     # the delta-polling UI replaced /latest polls, so
                     # the ingest->serve freshness gauge samples here too
@@ -1629,6 +1867,7 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 bbox, err = _parse_bbox(params)
                 if err:
                     return _bad_request(err)
+                _mk("parse")
                 v = _tiles_view(grid)
                 if v is None:
                     return _unavailable(
@@ -1642,6 +1881,7 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 body = _features_collection_json(docs)
                 data = body.encode("utf-8")
                 _account_render(endpoint, data)
+                _mk("lookup")
                 ctype = "application/json"
             elif path == "/api/queries":
                 endpoint = "queries"
@@ -1748,6 +1988,8 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                     return _bad_request(err)
                 base = _grid_base_res(grid)
                 extra_headers.append(("Vary", "Accept"))
+                _mk("parse")
+                histmod.scan_reset()
                 per_window = hist_reader.windows_in_range(grid, t0, t1)
                 win_out = []
                 for ws in sorted(per_window):
@@ -1811,6 +2053,9 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 _account_render(endpoint, data)
                 stats.wire_format.labels(endpoint=endpoint,
                                          fmt=fmt).inc()
+                _mk("lookup")
+                if span is not None:
+                    span.scan = histmod.last_scan()
                 import hashlib
 
                 etag = f'"hr.{hashlib.md5(data).hexdigest()[:16]}"'
@@ -1837,6 +2082,7 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 seq = _qs_int(params, "seq", 0, 1 << 62)
                 if seq <= 0:
                     return _bad_request("at needs seq= > 0")
+                _mk("parse")
                 from heatmap_tpu.query.history import view_at_seq
                 from heatmap_tpu.query.repl import read_meta
 
@@ -1871,6 +2117,7 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                                 next(iter(hist_at_cache)))
                         hist_at_cache[key] = data
                 _account_render(endpoint, data)
+                _mk("lookup")
                 ctype = "application/json"
             elif path == "/api/tiles/diff":
                 # day-over-day diff: the window states anchored at t0
@@ -1902,6 +2149,8 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 if err:
                     return _bad_request(err)
                 base = _grid_base_res(grid)
+                _mk("parse")
+                histmod.scan_reset()
                 sides = []
                 for t in (t0, t1):
                     got = hist_reader.window_at(grid, t)
@@ -1935,6 +2184,9 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                         + ", ".join(feats) + ']}')
                 data = body.encode("utf-8")
                 _account_render(endpoint, data)
+                _mk("lookup")
+                if span is not None:
+                    span.scan = histmod.last_scan()
                 ctype = "application/json"
             elif path.startswith("/api/hist/"):
                 # the chunk store re-exported over HTTP: what a remote
@@ -1986,6 +2238,7 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 # must say so or a shared cache could replay the wrong
                 # representation
                 extra_headers.append(("Vary", "Accept"))
+                _mk("parse")
                 ver = store.version()
                 etag = None
                 if ver is not None and runtime is not None:
@@ -2051,6 +2304,7 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 extra_headers.append(("ETag", etag))
                 stats.wire_format.labels(endpoint=endpoint,
                                          fmt=fmt).inc()
+                _mk("lookup")
             elif path.startswith("/api/repl/"):
                 # the replication feed over HTTP (query.repl): any
                 # process holding the feed directory re-exposes its
@@ -2128,6 +2382,20 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 n = _qs_int(params, "n", 32, 256)
                 body = json.dumps(agg.freshness(n))
                 ctype = "application/json"
+            elif path == "/fleet/delivery":
+                # fleet-wide delivered freshness (obs.fleet): each
+                # member's delivery block stitched, worst replica
+                # named, degraded on skipped/vanished members
+                agg = _fleet_agg()
+                if agg is None:
+                    return _unavailable(
+                        "fleet surfaces need a supervisor channel "
+                        "(HEATMAP_SUPERVISOR_CHANNEL)")
+                payload, down = agg.delivery()
+                if down:
+                    status = "503 Service Unavailable"
+                body = json.dumps(payload)
+                ctype = "application/json"
             elif path == "/fleet/audit":
                 # cross-process integrity stitch (obs.fleet.fleet_audit):
                 # member conservation ledgers summed + re-checked, and
@@ -2186,6 +2454,32 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                     "stage_order": list(STAGES),
                 }
                 body = json.dumps(payload)
+                ctype = "application/json"
+            elif path == "/debug/delivery":
+                # this replica's delivery lineage: the telescoping
+                # delivered-age decomposition (stage order + cross-host
+                # legs flagged), recent end-to-end samples, and the
+                # stalled-feed estimate — plus every subscriber's
+                # write-stall state from the fan-out hub
+                params = _qs_params(environ.get("QUERY_STRING", ""))
+                n = _qs_int(params, "n", 32, 256)
+                payload = delivery.snapshot(n)
+                payload["subscribers"] = fanout.sub_stats()
+                body = json.dumps(payload)
+                ctype = "application/json"
+            elif path == "/debug/requests":
+                # per-worker request spans: recent completed spans
+                # (per-stage timings, bytes, view seq, scan accounting)
+                # and the slow-request capture ring
+                params = _qs_params(environ.get("QUERY_STRING", ""))
+                n = _qs_int(params, "n", 50, 256)
+                body = json.dumps({
+                    "count": len(span_ring),
+                    "slowreq_ms": slowreq_ms,
+                    "slow_count": len(slow_ring),
+                    "recent": span_ring.recent(n),
+                    "slow": slow_ring.recent(min(n, 64)),
+                })
                 ctype = "application/json"
             elif path == "/debug/profile":
                 # on-demand jax.profiler window capture: POST arms the
@@ -2336,6 +2630,11 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
         headers.append(("Content-Length", str(len(data))))
         if endpoint is not None:
             stats.sent_bytes.labels(endpoint=endpoint).inc(len(data))
+        if span is not None:
+            span.mark("encode")
+            span.bytes_out = len(data)
+            if view is not None and not view.poisoned:
+                span.view_seq = view.seq
         start_response(status, headers)
         return [data]
 
@@ -2359,17 +2658,45 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
         # /fleet/*) is never shed — you must be able to observe an
         # overloaded worker.
         ep = _ADMIT_PATHS.get(path)
-        if admit_sem is None or ep is None:
+        if ep is None:
             return _handle(environ, start_response)
+        # request span (ISSUE 16): stamped per stage through _handle,
+        # closed by _SpanBody when the server has drained the body —
+        # every admitted request lands in /debug/requests, and any
+        # crossing HEATMAP_SLOWREQ_MS is captured to the slow ring
+        span = _Span(ep)
+        try:
+            span.bytes_in = int(environ.get("CONTENT_LENGTH") or 0)
+        except ValueError:
+            pass
+        environ["heatmap.span"] = span
+
+        def _sr(status_line, headers, exc_info=None):
+            try:
+                span.status = int(status_line[:3])
+            except ValueError:
+                pass
+            # pass exc_info through only when set: PEP 3333 callables
+            # may bind start_response(status, headers) positionally
+            if exc_info is None:
+                return start_response(status_line, headers)
+            return start_response(status_line, headers, exc_info)
+
+        if admit_sem is None:
+            return _SpanBody(_handle(environ, _sr), span, _commit_span)
         if not admit_sem.acquire(blocking=False):
             stats.shed.labels(endpoint=ep).inc()
+            span.mark("admission")
+            span.status = 503
             start_response("503 Service Unavailable",
                            [("Content-Type", "application/json"),
                             ("Retry-After", "1")])
-            return [b'{"error": "overloaded; retry shortly"}']
+            return _SpanBody([b'{"error": "overloaded; retry '
+                              b'shortly"}'], span, _commit_span)
+        span.mark("admission")
         stats.inflight.inc(1)
         try:
-            return _handle(environ, start_response)
+            return _SpanBody(_handle(environ, _sr), span, _commit_span)
         finally:
             stats.inflight.inc(-1)
             admit_sem.release()
@@ -2408,6 +2735,13 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
     app.hist_fn = (_hist_block if hist_dir or follower is not None
                    else None)
     app.hist_reader = hist_reader
+    # the member snapshot's delivery block (delivered-age summary +
+    # worst stage) rides the same publish cadence — /fleet/delivery
+    # and obs_top --fleet stitch it per replica
+    app.delivery_fn = delivery.member_block
+    app.delivery = delivery
+    app.span_ring = span_ring
+    app.fanout = fanout
 
     def close_repl():
         if cq_engine is not None:
@@ -2504,7 +2838,8 @@ class ServeFleetMember:
 
     def __init__(self, serve_registry, channel_path: str,
                  tag: str | None = None, healthz_fn=None,
-                 audit_fn=None, cq_fn=None, hist_fn=None):
+                 audit_fn=None, cq_fn=None, hist_fn=None,
+                 delivery_fn=None):
         from heatmap_tpu.obs.xproc import ENV_FLEET_TAG
 
         self.registry = serve_registry
@@ -2521,6 +2856,10 @@ class ServeFleetMember:
         # the app's space-time history closure (chunks / span /
         # compaction lag / backfills) — obs_top --fleet renders it
         self.hist_fn = hist_fn
+        # the app's delivery-lineage closure (obs.delivery member
+        # block: delivered-age quantiles, per-stage p50s, worst stage)
+        # — /fleet/delivery names the worst replica from these
+        self.delivery_fn = delivery_fn
         # HEATMAP_FLEET_TAG names the RUNTIME member (stream/runtime.py
         # adopts it verbatim when single-process), so a serve worker
         # composes with it rather than adopting it — otherwise a serve
@@ -2548,7 +2887,8 @@ class ServeFleetMember:
                      healthz_fn=getattr(app, "healthz_fn", None),
                      audit_fn=getattr(app, "audit_fn", None),
                      cq_fn=getattr(app, "cq_fn", None),
-                     hist_fn=getattr(app, "hist_fn", None))
+                     hist_fn=getattr(app, "hist_fn", None),
+                     delivery_fn=getattr(app, "delivery_fn", None))
         member.start()
         return member
 
@@ -2577,6 +2917,7 @@ class ServeFleetMember:
                 audit=self.audit_fn() if self.audit_fn else None,
                 cq=self.cq_fn() if self.cq_fn else None,
                 hist=self.hist_fn() if self.hist_fn else None,
+                delivery=self.delivery_fn() if self.delivery_fn else None,
                 left=left)
         except Exception:  # noqa: BLE001 - telemetry never kills serving
             log.warning("serve fleet snapshot publish failed",

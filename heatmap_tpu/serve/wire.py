@@ -64,6 +64,7 @@ import collections
 import datetime as dt
 import struct
 import threading
+import time
 
 MAGIC0, MAGIC1, VERSION = 0x48, 0x57, 1  # 'H', 'W'
 MAGIC1_POS = 0x50                        # 'H', 'P': positions frame
@@ -581,14 +582,37 @@ LAGGED = Lagged()
 CLOSED = Closed()
 
 
+class Tagged:
+    """A frame with a delivery-lineage sidecar: ``data`` is the exact
+    bytes a plain broadcast would carry (the subscriber generator
+    yields the SAME object, so the wire is byte-identical), ``meta``
+    is the per-(channel, seq) encode stamp the generator completes
+    into an end-to-end delivered sample (obs.delivery)."""
+
+    __slots__ = ("data", "meta")
+
+    def __init__(self, data: bytes, meta):
+        self.data = data
+        self.meta = meta
+
+
 class _Sub:
-    __slots__ = ("cond", "q", "lagged", "closed")
+    __slots__ = ("cond", "q", "lagged", "closed",
+                 "write_begin_mono", "last_write_mono", "writes")
 
     def __init__(self, depth: int):
         self.cond = threading.Condition()
         self.q: collections.deque = collections.deque(maxlen=depth + 1)
         self.lagged = False
         self.closed = False
+        # write-stall surface: the generator stamps monotonic time
+        # around each blocking socket write.  A begin without a
+        # matching completion is a write IN FLIGHT — its age is the
+        # stall a wedged client causes, visible long before the queue
+        # fills and the subscriber is shed as lagged.
+        self.write_begin_mono: float | None = None
+        self.last_write_mono: float | None = None
+        self.writes = 0
 
     def pop(self, timeout: float):
         """Next frame bytes, or LAGGED/CLOSED, or None on timeout."""
@@ -598,6 +622,11 @@ class _Sub:
             if not self.q:
                 return None
             return self.q.popleft()
+
+    def write_stall_s(self, now_mono: float) -> float:
+        """Age of the oldest un-returned socket write (0 when idle)."""
+        b = self.write_begin_mono
+        return max(0.0, now_mono - b) if b is not None else 0.0
 
 
 class Channel:
@@ -628,11 +657,15 @@ class Channel:
                 self.hub._channels.pop(self.key)
             return True
 
-    def broadcast(self, data: bytes) -> None:
+    def broadcast(self, data: bytes, meta=None) -> None:
         """Push one encoded frame to every subscriber.  A full queue
         means the subscriber stopped draining: it is marked lagged,
         its backlog dropped, and a LAGGED sentinel queued — the
-        broadcaster itself NEVER blocks on a slow client."""
+        broadcaster itself NEVER blocks on a slow client.  With
+        ``meta`` (a delivery-lineage encode stamp), the frame rides as
+        a :class:`Tagged` wrapper around the SAME bytes object — the
+        subscriber generator unwraps it, so wire bytes are unchanged."""
+        item = Tagged(data, meta) if meta is not None else data
         with self.hub._lock:
             subs = list(self.subs)
         depth = self.hub.depth
@@ -648,7 +681,7 @@ class Channel:
                     if self.hub.on_lagged is not None:
                         self.hub.on_lagged()
                 else:
-                    s.q.append(data)
+                    s.q.append(item)
                     hw = max(hw, len(s.q))
                 s.cond.notify()
         if self.hub.hw_gauge is not None and hw > self.hub.hw_gauge.value:
@@ -709,6 +742,45 @@ class FanoutHub:
             else:
                 chan.subs.append(sub)
         return chan, sub
+
+    def sub_stats(self, now_mono: float | None = None) -> list:
+        """Per-subscriber delivery state across every live channel:
+        queue depth, lag flag, completed write count, and the current
+        write-stall age — how long the subscriber's in-flight socket
+        write has been blocked (0 when none is in flight).  The wedged
+        client's tell: its stall age climbs for the full send-timeout
+        window while everyone else's stays ~0, BEFORE lag shedding
+        fires."""
+        if now_mono is None:
+            now_mono = time.monotonic()
+        out = []
+        with self._lock:
+            chans = [(k, list(c.subs)) for k, c in
+                     self._channels.items()]
+        for key, subs in chans:
+            for s in subs:
+                with s.cond:
+                    out.append({
+                        "key": list(key) if isinstance(key, tuple)
+                        else key,
+                        "queue": len(s.q),
+                        "lagged": s.lagged,
+                        "writes": s.writes,
+                        "stall_s": round(s.write_stall_s(now_mono), 6),
+                    })
+        return out
+
+    def max_write_stall_s(self) -> float:
+        """The worst current write-stall age across all subscribers —
+        the ``heatmap_sse_write_stall_seconds`` gauge."""
+        now = time.monotonic()
+        worst = 0.0
+        with self._lock:
+            subs = [s for c in self._channels.values()
+                    for s in c.subs]
+        for s in subs:
+            worst = max(worst, s.write_stall_s(now))
+        return round(worst, 6)
 
     def unsubscribe(self, chan: Channel, sub: _Sub) -> None:
         with self._lock:

@@ -218,12 +218,19 @@ class MicroBatchRuntime:
                                                        HistoryLog)
 
                 hist_log = HistoryLog(cfg.hist_dir)
+            # the delivery-lineage event_age leg (obs.delivery): the
+            # publisher stamps the newest sink-acked event's age into
+            # each record at hook-enqueue when HEATMAP_DELIVERY=1.
+            # Late-bound — the lineage tracker is constructed below,
+            # and the hook only fires once the step loop mutates the
+            # view, long after __init__ completes.
             self.repl_pub = DeltaLogPublisher(
                 self.matview, cfg.repl_dir,
                 seg_bytes=cfg.repl_seg_bytes,
                 segments=cfg.repl_segments,
                 registry=self.metrics.registry,
-                hist=hist_log)
+                hist=hist_log,
+                event_age_fn=lambda: self.lineage.newest_event_age_s())
             if hist_log is not None:
                 self.hist_compactor = HistoryCompactor(
                     cfg.hist_dir, feed_dir=cfg.repl_dir,

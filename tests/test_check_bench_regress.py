@@ -227,8 +227,11 @@ def test_repo_artifacts_parse():
 
 # ------------------------------------------------- serve-tier artifacts
 def _write_serve(dir_path, rnd, p99=100.0, wire=1_000_000, replicas=None,
-                 rc=0, soak=True, wire_format=None, serve_workers=None):
+                 rc=0, soak=True, wire_format=None, serve_workers=None,
+                 delivery=None):
     art = {"rc": rc}
+    if delivery is not None:
+        art["delivery"] = delivery
     sec = {"p99_ms": p99, "bytes_sent_wire": wire}
     if soak:
         if replicas is not None:
@@ -753,7 +756,8 @@ def test_serve_wire_format_from_top_level_wire_block(tmp_path, capsys):
 
 # ----------------------------------------------- hist artifacts (r15)
 def _write_hist(dir_path, rnd, p99=None, rps=None, rc=0,
-                shape=(3600, 3, 259200.0, 3, 48), audit=None):
+                shape=(3600, 3, 259200.0, 3, 48), audit=None,
+                scan=None):
     p = dir_path / f"BENCH_HIST_r{rnd:02d}.json"
     art = {"rc": rc, "kind": "bench_history",
            "range_p99_ms": p99, "compact_records_per_s": rps,
@@ -762,6 +766,8 @@ def _write_hist(dir_path, rnd, p99=None, rps=None, rc=0,
            "windows_per_day": shape[4]}
     if audit is not None:
         art["audit"] = audit
+    if scan is not None:
+        art["scan"] = scan
     p.write_text(json.dumps(art))
     return p
 
@@ -820,3 +826,92 @@ def test_hist_failed_run_skipped(tmp_path, capsys):
     _write_hist(tmp_path, 2, p99=10.0, rps=1000.0)
     assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
     assert "skipping hist r01" in capsys.readouterr().out
+
+
+# --------------------------------- delivery / scan stamps (ISSUE 16)
+def _delv(enabled, p99=None):
+    d = {"enabled": enabled, "samples": 40 if enabled else 0}
+    if p99 is not None:
+        d["age_p50_ms"] = p99 / 3.0
+        d["age_p99_ms"] = p99
+        d["worst_stage"] = "feed_transit"
+    return d
+
+
+def test_serve_delivery_knob_state_mismatch_refused(tmp_path, capsys):
+    """A delivery-stamped soak measures delivered age to the socket;
+    an unstamped one doesn't — the pair is not the same experiment."""
+    mod = _load()
+    _write_serve(tmp_path, 1, p99=100.0, wire=1_000_000, replicas=2,
+                 delivery=_delv(True, p99=120.0))
+    _write_serve(tmp_path, 2, p99=100.0, wire=1_000_000, replicas=2,
+                 delivery=_delv(False))
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    err = capsys.readouterr().err
+    assert "delivery knob-state mismatch" in err
+    assert "r01" in err and "r02" in err
+
+
+def test_serve_delivered_age_ratchet_fails(tmp_path, capsys):
+    """Both rounds stamped on: the delivered-age p99 headline may not
+    grow past the threshold — the serve tier's freshness ratchet."""
+    mod = _load()
+    _write_serve(tmp_path, 1, p99=100.0, wire=1_000_000, replicas=2,
+                 delivery=_delv(True, p99=50.0))
+    _write_serve(tmp_path, 2, p99=100.0, wire=1_000_000, replicas=2,
+                 delivery=_delv(True, p99=200.0))
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    assert "delivered-age regression beyond" in capsys.readouterr().err
+
+
+def test_serve_delivered_age_within_threshold_ok(tmp_path, capsys):
+    mod = _load()
+    _write_serve(tmp_path, 1, p99=100.0, wire=1_000_000, replicas=2,
+                 delivery=_delv(True, p99=50.0))
+    _write_serve(tmp_path, 2, p99=100.0, wire=1_000_000, replicas=2,
+                 delivery=_delv(True, p99=55.0))
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    assert "delivered age_p99_ms" in capsys.readouterr().out
+
+
+def test_serve_pre_delivery_artifact_comparable(tmp_path):
+    """Artifacts banked before the delivery stamp existed (no
+    ``delivery`` key) stay comparable — same tolerance as pre-replica
+    and pre-wire artifacts."""
+    mod = _load()
+    _write_serve(tmp_path, 1, p99=100.0, wire=1_000_000, replicas=2)
+    _write_serve(tmp_path, 2, p99=100.0, wire=1_000_000, replicas=2,
+                 delivery=_delv(True, p99=80.0))
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+
+
+def _scan(ratio):
+    return {"chunks_opened": 6, "blocks_scanned": 100,
+            "blocks_used": int(100 * ratio), "bytes_decoded": 500_000,
+            "rows_surfaced": 4_000, "scan_ratio": ratio}
+
+
+def test_hist_scan_efficiency_regression_fails(tmp_path, capsys):
+    """The reader's pruning ratio (blocks used / blocks scanned,
+    higher is better) may not DROP past the threshold."""
+    m = _load()
+    _write_hist(tmp_path, 1, p99=10.0, rps=1000.0, scan=_scan(0.8))
+    _write_hist(tmp_path, 2, p99=10.0, rps=1000.0, scan=_scan(0.2))
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 1
+    assert "scan-efficiency regression" in capsys.readouterr().err
+
+
+def test_hist_scan_efficiency_within_threshold_ok(tmp_path, capsys):
+    m = _load()
+    _write_hist(tmp_path, 1, p99=10.0, rps=1000.0, scan=_scan(0.8))
+    _write_hist(tmp_path, 2, p99=10.0, rps=1000.0, scan=_scan(0.72))
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0
+    assert "scan_ratio" in capsys.readouterr().out
+
+
+def test_hist_pre_scan_artifact_comparable(tmp_path):
+    """Rounds banked before the scan stamp stay comparable."""
+    m = _load()
+    _write_hist(tmp_path, 1, p99=10.0, rps=1000.0)
+    _write_hist(tmp_path, 2, p99=10.0, rps=1000.0, scan=_scan(0.9))
+    assert m.main(["--dir", str(tmp_path), "--threshold", "0.5"]) == 0

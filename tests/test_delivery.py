@@ -1,0 +1,647 @@
+"""Delivery observatory (ISSUE 16): conservation-exact read-path
+lineage to the subscriber socket, serve request spans, history scan
+accounting.
+
+Acceptance pins:
+
+- the six-stage delivered-age decomposition telescopes EXACTLY
+  (residual == 0) under synthetic clocks, including ACROSS PROCESSES
+  with a writer clock minutes apart from the replica's — feed_transit
+  is the only cross-host leg and absorbs the whole skew;
+- with HEATMAP_DELIVERY off the feed bytes are byte-identical to an
+  uninstrumented build (the hook is the deque's bare append) and SSE
+  frames go out untagged;
+- a write-stalled SSE subscriber shows a non-zero stall age on the
+  fan-out hub BEFORE being shed as lagged, and the stall drains when
+  the socket closes;
+- a SIGKILLed replica degrades /fleet/delivery naming it, under one
+  correlated episode, while the surviving replica keeps reporting;
+- a stalled feed shows a RISING feed_transit_current_s even though no
+  completed sample moves;
+- history queries account chunks/blocks/bytes/rows, and the
+  scan-efficiency ratio (blocks used / blocks scanned) is surfaced.
+"""
+
+import datetime as dt
+import glob
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+from heatmap_tpu import hexgrid
+from heatmap_tpu.config import load_config
+from heatmap_tpu.obs.delivery import (CROSS_HOST_STAGES, DELIVERY_STAGES,
+                                      DeliveryTracker)
+from heatmap_tpu.query import TileMatView
+from heatmap_tpu.query.repl import (DeltaLogPublisher, FileFeedSource,
+                                    ReplicaViewFollower)
+from heatmap_tpu.query import repl as replmod
+from heatmap_tpu.serve import start_background
+from heatmap_tpu.sink import MemoryStore
+from heatmap_tpu.sink.base import TileDoc
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+UTC = dt.timezone.utc
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def advance(self, dt_s: float) -> None:
+        self.t += dt_s
+
+    def __call__(self) -> float:
+        return self.t
+
+
+_WS = dt.datetime(2026, 8, 6, 12, 0, tzinfo=UTC)
+
+
+def _docs(n=3, count0=1, ws=None):
+    ws = ws or _WS
+    cells = []
+    i = 0
+    while len(cells) < n:
+        c = hexgrid.latlng_to_cell(42.30 + i * 7e-3, -71.05, 8)
+        if c not in cells:
+            cells.append(c)
+        i += 1
+    return [TileDoc("bos", 8, c, ws, ws + dt.timedelta(minutes=5),
+                    count=count0 + j, avg_speed_kmh=20.0 + j,
+                    avg_lat=42.3, avg_lon=-71.05, ttl_minutes=45)
+            for j, c in enumerate(cells)]
+
+
+# ------------------------------------------------- tracker unit level
+def test_tracker_telescopes_exactly_with_skewed_clocks():
+    """The decomposition telescopes EXACTLY: delivered age ==
+    event_age + publish_queue + feed_transit + replica_apply +
+    fanout_queue + socket_write, residual identically 0, with the
+    replica clock ~5 synthetic minutes ahead of the writer's (all
+    stamps binary-exact, so any nonzero residual is a stamping bug)."""
+    rclk = FakeClock(100300.0)
+    tr = DeliveryTracker(clock=rclk)
+    # writer-clock stamps: hook-enqueued at 100000.0, published 0.5 s
+    # later, 2.0 s of event age already on the batch
+    rx = rclk()
+    rclk.advance(0.25)
+    tr.record_applied(7, [100000.0, 100000.5, 2.0], rx, rclk())
+    rclk.advance(0.125)
+    meta = tr.encoded(7)
+    assert meta is not None and meta["rec"]["seq"] == 7
+    rclk.advance(0.0625)
+    wb = rclk()
+    rclk.advance(0.5)
+    tr.delivered(meta, wb, rclk())
+
+    snap = tr.snapshot()
+    (s,) = snap["recent"]
+    st = s["stages"]
+    assert st["event_age"] == 2.0
+    assert st["publish_queue"] == 0.5
+    assert st["feed_transit"] == 100300.0 - 100000.5  # absorbs the skew
+    assert st["replica_apply"] == 0.25
+    assert st["fanout_queue"] == 0.125 + 0.0625
+    assert st["socket_write"] == 0.5
+    assert s["residual_s"] == 0.0                     # conservation
+    assert s["age_s"] == sum(st.values())
+    summ = snap["summary"]
+    assert summ["count"] == 1
+    assert summ["worst_stage"] == "feed_transit"
+    assert summ["max_abs_residual_s"] == 0.0
+    assert snap["stage_order"] == list(DELIVERY_STAGES)
+    assert snap["cross_host"] == list(CROSS_HOST_STAGES) \
+        == ["feed_transit"]
+    # coalesced frames: the newest stamped record AT OR BELOW the
+    # frame's seq is what ages; nothing below the oldest stamp
+    assert tr.encoded(9)["rec"]["seq"] == 7
+    assert tr.encoded(6) is None
+
+
+def test_stalled_feed_transit_rises_without_new_samples():
+    """Chaos satellite: a wedged writer publishes nothing — the
+    stalled-feed estimate keeps RISING with the replica clock even
+    though no completed sample moves (count stays 0)."""
+    clk = FakeClock(100300.0)
+    tr = DeliveryTracker(clock=clk)
+    tr.record_applied(1, [100000.0, 100000.5, 0.0], clk(), clk())
+    s0 = tr.summary()
+    assert s0["feed_transit_current_s"] == 299.5
+    assert s0["since_last_receipt_s"] == 0.0
+    clk.advance(30.0)
+    s1 = tr.summary()
+    assert s1["feed_transit_current_s"] == 329.5
+    assert s1["since_last_receipt_s"] == 30.0
+    assert s1["count"] == 0  # no subscriber sample ever completed
+    # the member block publishes the stall even with zero samples, so
+    # /fleet/delivery sees a wedged-writer replica
+    assert tr.member_block()["feed_transit_current_s"] == 329.5
+
+
+# --------------------------------------------- writer stamp -> follower
+def test_feed_stamps_roundtrip_writer_to_follower(tmp_path, monkeypatch):
+    """The knob-gated pt=[eq, pub, ea] triple survives the feed's JSON
+    round-trip bit-exact and lands in the follower's tracker."""
+    monkeypatch.setenv("HEATMAP_DELIVERY", "1")
+    wclk = FakeClock(100000.0)
+    view = TileMatView()
+    pub = DeltaLogPublisher(view, str(tmp_path / "feed"), start=False,
+                            clock=wclk, event_age_fn=lambda: 2.0)
+    view.apply_docs(_docs())
+    wclk.advance(0.5)
+    pub.flush()
+    pub.close()
+
+    rclk = FakeClock(100300.0)
+    tr = DeliveryTracker(clock=rclk)
+    replica = TileMatView(replica=True)
+    fol = ReplicaViewFollower(replica, FileFeedSource(str(tmp_path /
+                                                         "feed")),
+                              clock=rclk, delivery=tr)
+    while fol.step():
+        rclk.advance(0.25)
+    assert fol.synced and replica.seq == view.seq
+    assert tr._recs, "no stamped record reached the tracker"
+    for rec in tr._recs.values():
+        assert rec["eq"] == 100000.0
+        assert rec["pub"] == 100000.5
+        assert rec["ea"] == 2.0
+
+
+_REPLICA_CHILD = """
+import json, os, sys
+sys.path.insert(0, os.environ["REPO_ROOT"])
+from heatmap_tpu.obs.delivery import DeliveryTracker
+from heatmap_tpu.query import TileMatView
+from heatmap_tpu.query.repl import FileFeedSource, ReplicaViewFollower
+
+class FakeClock:
+    def __init__(self, t):
+        self.t = t
+    def advance(self, dt_s):
+        self.t += dt_s
+    def __call__(self):
+        return self.t
+
+clk = FakeClock(float(os.environ["RCLK_T0"]))
+tr = DeliveryTracker(clock=clk)
+view = TileMatView(replica=True)
+fol = ReplicaViewFollower(view, FileFeedSource(os.environ["FEED"]),
+                          clock=clk, delivery=tr)
+while fol.step():
+    clk.advance(0.25)
+# complete one end-to-end sample per stamped record, exactly like the
+# SSE subscriber generator: encode, write begin, write end
+for seq in sorted(tr._recs):
+    meta = tr.encoded(seq)
+    clk.advance(0.125)
+    wb = clk()
+    clk.advance(0.5)
+    tr.delivered(meta, wb, clk())
+print(json.dumps(tr.snapshot(256)))
+"""
+
+
+def test_cross_process_residual_exactly_zero(tmp_path, monkeypatch):
+    """ACCEPTANCE: the synthetic-clock CROSS-PROCESS pin, exactly like
+    PR 3's — the writer stamps on one synthetic clock, a subprocess
+    replica applies and delivers on another, 5 minutes apart, and every
+    sample's residual is EXACTLY 0: feed_transit alone absorbs the
+    skew, no leg is lost, double-counted, or rounded through the feed's
+    JSON round-trip."""
+    monkeypatch.setenv("HEATMAP_DELIVERY", "1")
+    feed = str(tmp_path / "feed")
+    wclk = FakeClock(100000.0)
+    view = TileMatView()
+    pub = DeltaLogPublisher(view, feed, start=False, clock=wclk,
+                            event_age_fn=lambda: 2.0)
+    docs = _docs(4)
+    for i in range(3):
+        view.apply_docs([dict(d, count=int(d["count"]) + i)
+                         for d in docs])
+        wclk.advance(0.5)
+        pub.flush()
+        wclk.advance(0.25)
+    pub.close()
+
+    env = {**os.environ, "REPO_ROOT": REPO, "FEED": feed,
+           "RCLK_T0": "100300.0", "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": ""}
+    out = subprocess.run([sys.executable, "-c", _REPLICA_CHILD],
+                         env=env, cwd=REPO, capture_output=True,
+                         text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    snap = json.loads(out.stdout.strip().splitlines()[-1])
+    summ = snap["summary"]
+    assert summ["count"] >= 3
+    assert summ["max_abs_residual_s"] == 0.0
+    assert summ["worst_stage"] == "feed_transit"
+    for s in snap["recent"]:
+        assert s["residual_s"] == 0.0
+        assert s["age_s"] == sum(s["stages"].values())
+        assert s["stages"]["event_age"] == 2.0
+        # the cross-host leg absorbed the ~5-minute synthetic skew
+        assert 295.0 < s["stages"]["feed_transit"] < 302.0
+        assert all(st in s["stages"] for st in DELIVERY_STAGES)
+
+
+# --------------------------------------------- knob-off byte identity
+def test_knob_off_feed_bytes_identical(tmp_path, monkeypatch):
+    """ACCEPTANCE: with HEATMAP_DELIVERY off the hook is the deque's
+    bare append and the feed bytes are byte-identical to an
+    uninstrumented build; the knob adds EXACTLY the pt field and
+    nothing else."""
+    monkeypatch.setattr(replmod.time, "time", lambda: 1234.5)
+
+    def feed_lines(d):
+        view = TileMatView()
+        pub = DeltaLogPublisher(view, str(d), start=False,
+                                clock=FakeClock(2000.0),
+                                event_age_fn=lambda: 1.5)
+        bare = pub._q.append
+        hook_is_bare = view._hook == bare
+        view.apply_docs(_docs())
+        pub.flush()
+        pub.close()
+        lines = []
+        for p in sorted(glob.glob(os.path.join(str(d), "seg-*.jsonl"))):
+            with open(p, encoding="utf-8") as fh:
+                lines += fh.readlines()
+        return lines, hook_is_bare
+
+    monkeypatch.delenv("HEATMAP_DELIVERY", raising=False)
+    a, a_bare = feed_lines(tmp_path / "a")
+    b, _ = feed_lines(tmp_path / "b")
+    assert a and a == b            # knob-off feed is deterministic
+    assert a_bare                  # zero instrumentation on the hook
+    assert all('"pt"' not in ln and '"_eq"' not in ln for ln in a)
+
+    monkeypatch.setenv("HEATMAP_DELIVERY", "1")
+    c, c_bare = feed_lines(tmp_path / "c")
+    assert not c_bare              # knob on: the stamping hook
+    assert len(c) == len(a)
+    for on_line, off_line in zip(c, a):
+        rec = replmod.loads(on_line)
+        assert isinstance(rec.get("pt"), list) and len(rec["pt"]) == 3
+        rec.pop("pt")
+        # stripping pt yields the knob-off line byte-for-byte
+        assert replmod.dumps(rec) == off_line.rstrip("\n")
+
+
+def _connect_sse(port, rcvbuf=None):
+    sk = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    if rcvbuf:
+        sk.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, rcvbuf)
+    sk.settimeout(15)
+    sk.connect(("127.0.0.1", port))
+    sk.sendall(b"GET /api/tiles/stream?since=0 HTTP/1.0\r\n\r\n")
+    return sk
+
+
+def _sse_run(tmp_path, tag, knob, monkeypatch, ws):
+    """One replica-fed serve worker + one SSE subscriber: returns
+    (tile frames, delivery summary, requests payload, delivery payload
+    status+body)."""
+    if knob:
+        monkeypatch.setenv("HEATMAP_DELIVERY", "1")
+    else:
+        monkeypatch.delenv("HEATMAP_DELIVERY", raising=False)
+    feed = str(tmp_path / f"feed-{tag}")
+    view = TileMatView()
+    pub = DeltaLogPublisher(view, feed, flush_s=0.02)
+    view.apply_docs(_docs(4, ws=ws))
+    cfg = load_config({}, store="memory", serve_port=0, repl_feed=feed,
+                      repl_poll_ms=50)
+    httpd, _t, port = start_background(MemoryStore(), cfg, port=0)
+    app = httpd.get_app()
+    try:
+        fol = app.repl_follower
+        deadline = time.time() + 30
+        while time.time() < deadline and not (
+                fol.synced and fol.view.seq >= 1
+                and fol.seq_lag() == 0):
+            time.sleep(0.02)
+        assert fol.synced and fol.view.seq >= 1
+        # a data-plane request so /debug/requests has a span
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/tiles/latest",
+                timeout=10) as r:
+            r.read()
+        sk = _connect_sse(port)
+        buf = b""
+        while buf.count(b"event: tiles") < 1:
+            buf += sk.recv(65536)
+        # a post-subscribe mutation rides the coalescing pump — with
+        # the knob on, its frame is Tagged and completes a sample
+        view.apply_docs(_docs(4, count0=100, ws=ws))
+        while buf.count(b"event: tiles") < 2:
+            buf += sk.recv(65536)
+        if knob:
+            deadline = time.time() + 15
+            while time.time() < deadline \
+                    and not app.delivery.summary().get("count"):
+                time.sleep(0.05)
+        summ = app.delivery.summary()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/requests",
+                timeout=10) as r:
+            requests = json.loads(r.read())
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/delivery",
+                    timeout=10) as r:
+                dstatus, dbody = r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            dstatus, dbody = e.code, None
+        sk.close()
+        frames = [f for f in buf.split(b"\n\n") if b"event: tiles" in f]
+        return frames, summ, requests, (dstatus, dbody)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.close_repl()
+        pub.close()
+
+
+def test_sse_delivered_end_to_end_and_knob_off_frames_identical(
+        tmp_path, monkeypatch):
+    """ACCEPTANCE: the replica-fed SSE path completes end-to-end
+    delivered samples with residual exactly 0 (real clocks, one shared
+    tracker clock per process), /debug/delivery and /debug/requests
+    serve them — and the SAME topology with the knob off produces
+    byte-identical SSE frames (the wire never changes) with zero
+    delivery samples."""
+    # one RECENT fixed window shared by both runs (so the frames are
+    # comparable) that the 45-minute TTL won't prune mid-test
+    ws = dt.datetime.now(UTC).replace(second=0, microsecond=0)
+    on_frames, on_summ, on_reqs, (on_st, on_body) = _sse_run(
+        tmp_path, "on", True, monkeypatch, ws)
+    assert on_summ.get("count", 0) >= 1
+    assert on_summ["max_abs_residual_s"] == 0.0
+    assert set(on_summ["stages_p50_s"]) == set(DELIVERY_STAGES)
+    assert on_st == 200
+    assert on_body["cross_host"] == ["feed_transit"]
+    assert on_body["summary"]["count"] >= 1
+    assert on_body["subscribers"]
+    # request spans: the data-plane GET landed with telescoping stages
+    spans = [sp for sp in on_reqs["recent"]
+             if sp["endpoint"] == "tiles" and sp["status"] == 200]
+    assert spans
+    assert {"parse", "lookup", "encode", "write"} <= set(
+        spans[0]["stages_ms"])
+
+    off_frames, off_summ, _off_reqs, _ = _sse_run(
+        tmp_path, "off", False, monkeypatch, ws)
+    assert not off_summ.get("count")   # nothing stamped, nothing aged
+    # the wire is byte-identical with the knob off vs on: same docs,
+    # same seqs, same frames
+    assert off_frames == on_frames
+
+
+# ------------------------------------------------------- write stall
+def test_write_stall_visible_then_shed():
+    """Satellite (c): a subscriber whose socket stops draining shows a
+    non-zero write-stall age on the fan-out hub (and the
+    heatmap_sse_write_stall_seconds gauge) BEFORE the bounded queue
+    sheds it as lagged; closing the socket drains the stall to 0."""
+    store = MemoryStore()
+    ws = dt.datetime.now(UTC).replace(microsecond=0) - dt.timedelta(
+        minutes=2)
+    cells = sorted({hexgrid.latlng_to_cell(42.6 + (j % 20) * 8e-3,
+                                           -71.3 + (j // 20) * 8e-3, 8)
+                    for j in range(200)})
+
+    def mutate(m):
+        store.upsert_tiles([
+            TileDoc("bos", 8, c, ws, ws + dt.timedelta(minutes=5),
+                    count=m * 100 + j + 1, avg_speed_kmh=9.0,
+                    avg_lat=42.6, avg_lon=-71.3, ttl_minutes=45)
+            for j, c in enumerate(cells)])
+
+    mutate(0)
+    cfg = load_config({"HEATMAP_VIEW_POLL_MS": "30",
+                       "HEATMAP_SSE_HEARTBEAT_S": "0.1",
+                       "HEATMAP_SSE_QUEUE": "4"}, serve_port=0)
+    httpd, _t, port = start_background(store, cfg, port=0)
+    # accepted sockets inherit the listener's send buffer: shrink it
+    # so a ~120 KB frame CANNOT be absorbed by the kernel and the
+    # writer genuinely parks in send() on a non-draining client
+    httpd.socket.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 8192)
+    app = httpd.get_app()
+    lagged = None
+    for fam in app.serve_registry._families.values():
+        if fam.name == "heatmap_sse_lagged_total":
+            lagged = fam
+    slow = _connect_sse(port, rcvbuf=4096)
+    try:
+        buf = b""
+        while buf.count(b"event: tiles") < 1:
+            buf += slow.recv(65536)
+        # drain the catch-up COMPLETELY (stopping mid-frame would park
+        # the un-bracketed catch-up yield instead of a queue write) —
+        # bounded by wall clock, not by quiet, because 0.1 s heartbeats
+        # never leave the socket quiet for long...
+        slow.settimeout(0.2)
+        t_end = time.monotonic() + 1.5
+        while time.monotonic() < t_end:
+            try:
+                buf += slow.recv(65536)
+            except socket.timeout:
+                pass
+        # ...then STOP READING: the next queued frame overruns the
+        # tiny kernel buffers, the writer parks in send() (the stall
+        # age becomes visible), and the pump keeps filling the bounded
+        # queue behind the parked write until overflow sheds the sub
+        stall_seen = 0.0
+        deadline = time.time() + 30
+        m = 0
+        while time.time() < deadline and (stall_seen == 0.0
+                                          or lagged.value < 1):
+            m += 1
+            mutate(m)
+            stall_seen = max(stall_seen, app.fanout.max_write_stall_s())
+            time.sleep(0.03)
+        assert stall_seen > 0.0, "blocked socket never showed a stall"
+        assert lagged.value >= 1, "stalled subscriber never shed"
+        stats = app.fanout.sub_stats()
+        assert stats, "subscriber vanished before being observed"
+        # the hub-level gauge rides /metrics
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            assert b"heatmap_sse_write_stall_seconds" in r.read()
+        # drain + close: the parked write returns, the stall drains
+        slow.settimeout(10)
+        while True:
+            try:
+                if not slow.recv(65536):
+                    break
+            except socket.timeout:
+                break
+        slow.close()
+        deadline = time.time() + 15
+        while time.time() < deadline \
+                and app.fanout.max_write_stall_s() > 0.0:
+            time.sleep(0.1)
+        assert app.fanout.max_write_stall_s() == 0.0
+    finally:
+        slow.close()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# ------------------------------------------------- fleet chaos tier-1
+_MEMBER_CHILD = """
+import json, os, sys, time
+sys.path.insert(0, os.environ["REPO_ROOT"])
+from heatmap_tpu.obs.xproc import publish_member_snapshot
+
+chan = os.environ["CHAN"]
+tag = os.environ["TAG"]
+p50 = float(os.environ["P50"])
+delivery = {"count": 40, "age_p50_s": p50, "age_p99_s": p50 * 3,
+            "stages_p50_s": {"event_age": 0.0, "publish_queue": 0.01,
+                             "feed_transit": p50 / 2,
+                             "replica_apply": 0.01,
+                             "fanout_queue": p50 / 4,
+                             "socket_write": 0.01},
+            "worst_stage": "feed_transit",
+            "max_abs_residual_s": 0.0}
+while True:
+    publish_member_snapshot(chan, tag, role="serve", delivery=delivery,
+                            healthz={"status": "ok", "checks": {}})
+    time.sleep(0.1)
+"""
+
+
+def test_fleet_delivery_names_sigkilled_replica_under_episode(tmp_path):
+    """Chaos tier-1 (satellite e): two live replica members publish
+    delivery blocks; /fleet/delivery names the worst by delivered-age
+    p50.  SIGKILL one mid-flight: the rollup degrades NAMING it, under
+    one correlated episode, while the survivor keeps reporting."""
+    from heatmap_tpu.obs.fleet import FleetAggregator
+    from heatmap_tpu.obs.xproc import broadcast_episode
+
+    chan = str(tmp_path / "chan")
+
+    def env(tag, p50):
+        return {**os.environ, "REPO_ROOT": REPO, "CHAN": chan,
+                "TAG": tag, "P50": p50, "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": ""}
+
+    p_a = subprocess.Popen([sys.executable, "-c", _MEMBER_CHILD],
+                           env=env("replica-a", "0.05"), cwd=REPO)
+    p_b = subprocess.Popen([sys.executable, "-c", _MEMBER_CHILD],
+                           env=env("replica-b", "0.4"), cwd=REPO)
+    try:
+        agg = FleetAggregator(chan, max_age_s=2.0)
+        payload = {}
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            payload, down = agg.delivery()
+            if payload.get("reporting", 0) == 2:
+                break
+            time.sleep(0.1)
+        assert payload.get("reporting") == 2, payload
+        assert payload["ok"] and not down
+        assert payload["worst"]["proc"] == "replica-b"
+        assert payload["worst"]["age_p50_s"] == 0.4
+        assert payload["worst"]["worst_stage"] == "feed_transit"
+        assert payload["stage_order"] == list(DELIVERY_STAGES)
+        assert payload["cross_host"] == ["feed_transit"]
+        # the per-member delivered-age gauges ride /fleet/metrics
+        txt = agg.metrics_text()
+        assert 'heatmap_fleet_member_delivered_age_p50_s' \
+               '{proc="replica-a"}' in txt
+        assert 'heatmap_fleet_member_delivered_age_p99_s' \
+               '{proc="replica-b"}' in txt
+
+        # SIGKILL the worst replica mid-publish; the watchdog that
+        # sees the death claims the fleet episode
+        p_b.kill()
+        p_b.wait(timeout=30)
+        eid = broadcast_episode(chan, "supervisor",
+                                "replica-b SIGKILLed mid-SSE")
+        assert eid
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            payload, down = agg.delivery()
+            if not payload["ok"]:
+                break
+            time.sleep(0.1)
+        assert not payload["ok"] and down
+        assert "replica-b" in payload["stale_members"]
+        assert "skipped" in payload["members"]["replica-b"]
+        assert payload["episode"]["episode_id"] == eid
+        # one incident, one degradation: the survivor still reports
+        assert payload["members"]["replica-a"]["age_p50_s"] == 0.05
+    finally:
+        for p in (p_a, p_b):
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+
+# --------------------------------------------- history scan accounting
+def test_history_scan_accounting_and_ratio(tmp_path):
+    """Satellite: range queries account chunks opened, blocks scanned
+    vs used, bytes decoded, and rows surfaced — per-query via
+    last_scan() (with the pruning ratio) and cumulatively in the
+    reader's registry counters."""
+    from heatmap_tpu.obs.audit import DigestTable
+    from heatmap_tpu.obs.registry import Registry
+    from heatmap_tpu.query.history import (FileHistorySource,
+                                           HistoryCompactor, HistoryLog,
+                                           HistoryReader, last_scan,
+                                           scan_reset)
+
+    clock = {"t": time.time()}
+    feed = str(tmp_path / "feed")
+    hist = str(tmp_path / "hist")
+    w = TileMatView(now_fn=lambda: clock["t"])
+    w.audit_table = DigestTable()
+    pub = DeltaLogPublisher(w, feed, start=False, hist=HistoryLog(hist))
+    base = dt.datetime.fromtimestamp(clock["t"], UTC).replace(
+        microsecond=0)
+    for wi in range(3):
+        ws = base + dt.timedelta(minutes=5 * wi)
+        w.apply_docs(_docs(4, count0=wi * 10 + 1, ws=ws))
+        pub.flush()
+    pub.close()
+    comp = HistoryCompactor(hist, feed_dir=feed,
+                            clock=lambda: clock["t"])
+    assert comp.step() > 0 and comp.mismatches == 0
+
+    reg = Registry()
+    reader = HistoryReader(FileHistorySource(hist), registry=reg)
+    scan_reset()
+    got = reader.windows_in_range("h3r8", clock["t"] - 3600,
+                                  clock["t"] + 3600)
+    assert got
+    sc = last_scan()
+    assert sc["chunks_opened"] >= 1
+    assert sc["blocks_scanned"] >= sc["blocks_used"] >= 1
+    assert sc["bytes_decoded"] > 0
+    assert sc["rows_surfaced"] >= sum(len(p["docs"])
+                                      for p in got.values())
+    assert 0.0 < sc["scan_ratio"] <= 1.0
+    # a narrower query scans a subset; the thread-local resets per query
+    scan_reset()
+    ws0 = min(got)
+    narrow = reader.windows_in_range("h3r8", ws0, ws0 + 1)
+    sc2 = last_scan()
+    assert sc2["rows_surfaced"] == sum(len(p["docs"])
+                                       for p in narrow.values())
+    assert sc2["blocks_used"] <= sc["blocks_used"]
+    # the process counters accrued across both queries
+    fams = {f.name: f for f in reg._families.values()}
+    assert fams["heatmap_hist_scan_chunks_total"].value >= 2
+    assert fams["heatmap_hist_scan_rows_total"].value \
+        >= sc["rows_surfaced"] + sc2["rows_surfaced"]
+    assert fams["heatmap_hist_scan_bytes_total"].value > 0
+    assert fams["heatmap_hist_scan_blocks_total"].value \
+        >= sc["blocks_scanned"] + sc2["blocks_scanned"]

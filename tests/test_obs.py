@@ -522,3 +522,56 @@ def test_obs_top_runtime_introspection_rows():
         'heatmap_device_bytes_in_use{device="0"} 8000000\n')
     frame2 = top.render_frame(m2, None, 0.0, None)
     assert "watermark 9.0 MB" in frame2 and "8.0 MB" in frame2
+
+
+def test_obs_top_delivery_row_and_fleet_table():
+    """The delivery observatory rows (ISSUE 16): the single-process
+    dashboard grows a delivery row once socket-bound delivered-age
+    samples exist, and the fleet view grows a per-replica delivery
+    table naming the worst replica."""
+    top = _load_obs_top()
+    text = (
+        "# TYPE heatmap_delivered_age_seconds histogram\n"
+        'heatmap_delivered_age_seconds_bucket{bound="socket",le="0.1"} 4\n'
+        'heatmap_delivered_age_seconds_bucket{bound="socket",le="1"} 8\n'
+        'heatmap_delivered_age_seconds_bucket{bound="socket",le="+Inf"} 8\n'
+        'heatmap_delivered_age_seconds_bucket{bound="apply",le="+Inf"} 9\n'
+        'heatmap_delivery_stage_seconds{stage="feed_transit"} 0.4\n'
+        'heatmap_delivery_stage_seconds{stage="socket_write"} 0.01\n'
+        'heatmap_serve_slow_requests_total{endpoint="tiles"} 3\n'
+        "heatmap_sse_write_stall_seconds 1.5\n")
+    m = top.parse_prom(text)
+    frame = top.render_frame(m, None, 0.0, None)
+    assert "delivery" in frame
+    assert "worst feed_transit" in frame
+    assert "slow reqs 3" in frame
+    assert "stall 1.5 s" in frame
+    # apply-bound samples alone must NOT raise the row: the dashboard
+    # reports what reached a subscriber socket, not the replica
+    m_apply = top.parse_prom(
+        'heatmap_delivered_age_seconds_bucket{bound="apply",le="+Inf"} 9\n')
+    assert "delivery" not in top.render_frame(m_apply, None, 0.0, None)
+
+    fleet = top.parse_prom(
+        'heatmap_fleet_member_up{proc="r1",role="serve"} 1\n'
+        'heatmap_fleet_member_up{proc="r2",role="serve"} 1\n'
+        'heatmap_fleet_member_delivered_age_p50_s{proc="r1"} 0.80\n'
+        'heatmap_fleet_member_delivered_age_p99_s{proc="r1"} 2.40\n'
+        'heatmap_fleet_member_delivered_age_p50_s{proc="r2"} 0.05\n'
+        'heatmap_fleet_member_delivered_age_p99_s{proc="r2"} 0.20\n'
+        'heatmap_delivery_stage_seconds{proc="r1",stage="fanout_queue"} 0.6\n'
+        'heatmap_delivery_stage_seconds{proc="r1",stage="socket_write"} 0.1\n'
+        'heatmap_delivery_stage_seconds{proc="r2",stage="feed_transit"} 0.03\n'
+        'heatmap_serve_slow_requests_total{proc="r1",endpoint="tiles"} 7\n'
+        'heatmap_sse_write_stall_seconds{proc="r1"} 2.5\n')
+    ff = top.render_fleet_frame(fleet, None, 0.0, None)
+    assert "delivery" in ff and "worst stage" in ff
+    assert "fanout_queue" in ff      # r1's worst stage by gauge value
+    assert "delivery worst replica r1 (p50 0.80 s)" in ff
+    # both replicas get a row; the healthy one keeps its own numbers
+    assert "0.05 s" in ff and "0.20 s" in ff
+    # without delivered-age member gauges the table is absent
+    ff2 = top.render_fleet_frame(
+        top.parse_prom('heatmap_fleet_member_up{proc="r1",role="serve"} 1\n'),
+        None, 0.0, None)
+    assert "delivery worst replica" not in ff2

@@ -127,16 +127,29 @@ def run(days: int, windows_per_day: int, n_cells: int,
     compact_s = time.perf_counter() - t0
 
     # ---- range-query latency over the compacted span -----------------
+    from heatmap_tpu.query.history import last_scan, scan_reset
+
     reader = HistoryReader(FileHistorySource(hist))
     lat_ms: list = []
     windows_seen = 0
+    # scan accounting aggregated over every range query: the
+    # scan-efficiency ratio (blocks used / blocks scanned) is the
+    # artifact's proof the reader prunes, banked and ratcheted by
+    # check_bench_regress like a latency
+    scan_tot = {"chunks_opened": 0, "blocks_scanned": 0,
+                "blocks_used": 0, "bytes_decoded": 0,
+                "rows_surfaced": 0}
     for _ in range(range_queries):
         a = rng.uniform(t_start, t_end - 2 * window_s)
         b = min(t_end, a + rng.uniform(window_s, 6 * 3600))
+        scan_reset()
         q0 = time.perf_counter()
         got = reader.windows_in_range("h3r8", a, b)
         lat_ms.append((time.perf_counter() - q0) * 1e3)
         windows_seen += len(got)
+        sc = last_scan() or {}
+        for k in scan_tot:
+            scan_tot[k] += int(sc.get(k, 0))
     lat_ms.sort()
 
     def pct(q: float) -> float:
@@ -191,6 +204,12 @@ def run(days: int, windows_per_day: int, n_cells: int,
         "range_p99_ms": round(pct(0.99), 3),
         "backfill_ms": round(backfill_s * 1e3, 3),
         "backfilled_windows": backfilled,
+        "scan": {
+            **scan_tot,
+            "scan_ratio": round(
+                scan_tot["blocks_used"]
+                / max(1, scan_tot["blocks_scanned"]), 4),
+        },
         "audit": {
             "enabled": True,
             "max_residual": 0,
@@ -229,6 +248,7 @@ def main(argv=None) -> int:
         "records": art["records"],
         "chunks": art["chunks"],
         "backfill_ms": art["backfill_ms"],
+        "scan": art["scan"],
         "audit": art["audit"],
     }))
     if args.out:

@@ -68,6 +68,7 @@ import gzip
 import io
 import json
 import os
+import re as _re
 import sys
 import threading
 import time
@@ -305,6 +306,74 @@ def _scrape_family(port: int, names) -> dict:
     return out
 
 
+_LBL_RE = _re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _scrape_labeled(port: int, family: str,
+                    path: str = "/metrics") -> list:
+    """[(series_name, labels_dict, value)] for one family's samples
+    (histogram suffixes included) — the label-aware complement of
+    _scrape_series, which drops labels."""
+    _, status, _, body, _ = _req(port, path)
+    out: list = []
+    if status != 200:
+        return out
+    suffixes = (family, family + "_bucket", family + "_sum",
+                family + "_count")
+    for line in body.decode().splitlines():
+        if not line.startswith(family):
+            continue
+        series, _, val = line.rpartition(" ")
+        name, _, lbl = series.partition("{")
+        if name not in suffixes:
+            continue
+        try:
+            v = float(val)
+        except ValueError:
+            continue
+        out.append((name, dict(_LBL_RE.findall(lbl.rstrip("}"))), v))
+    return out
+
+
+def _delivery_block(ports: list, path: str = "/metrics") -> dict:
+    """The artifact's ``delivery`` stamp: delivered-age p50/p99 over
+    the MERGED socket-bound histogram buckets across the fleet
+    (per-replica quantiles don't average; summed cumulative buckets
+    interpolate — the fleet aggregator's rule), plus the worst stage
+    by max per-replica stage-mean gauge."""
+    from heatmap_tpu.obs.fleet import interp_quantile
+
+    buckets: dict = {}
+    stages: dict = {}
+    for port in ports:
+        for name, lbl, v in _scrape_labeled(
+                port, "heatmap_delivered_age_seconds", path):
+            if (name != "heatmap_delivered_age_seconds_bucket"
+                    or lbl.get("bound") != "socket"):
+                continue
+            le_raw = lbl.get("le")
+            if le_raw is None:
+                continue
+            le = float("inf") if le_raw == "+Inf" else float(le_raw)
+            buckets[le] = buckets.get(le, 0.0) + v
+        for _name, lbl, v in _scrape_labeled(
+                port, "heatmap_delivery_stage_seconds", path):
+            st = lbl.get("stage")
+            if st:
+                stages[st] = max(stages.get(st, float("-inf")), v)
+    p50 = interp_quantile(buckets, 0.5)
+    p99 = interp_quantile(buckets, 0.99)
+    return {
+        "enabled": True,
+        "samples": int(buckets.get(float("inf"), 0.0)),
+        "age_p50_ms": (round(p50 * 1e3, 3) if p50 is not None
+                       else None),
+        "age_p99_ms": (round(p99 * 1e3, 3) if p99 is not None
+                       else None),
+        "worst_stage": max(stages, key=stages.get) if stages else None,
+    }
+
+
 def _soak_clients(ports: list, states: list, deadline: float,
                   workers: int):
     """Drive the logical clients until the deadline; returns merged
@@ -420,6 +489,12 @@ def run_soak(n_tiles: int, replicas: int, clients: int, duration_s: float,
     except ValueError:
         slo_p99_ms = 1000.0
     feed = tempfile.mkdtemp(prefix="bench-repl-feed-")
+    # delivery lineage ON for the soak: the publisher stamps publish
+    # times (checked at construction), the replicas' SSE fan-out closes
+    # the loop at the subscriber socket — the artifact's delivered-age
+    # headline comes from these stamps
+    prev_delivery = os.environ.get("HEATMAP_DELIVERY")
+    os.environ["HEATMAP_DELIVERY"] = "1"
     view = TileMatView()
     pub = DeltaLogPublisher(view, feed, flush_s=0.02)
     docs = _soak_docs(n_tiles)
@@ -557,12 +632,17 @@ def run_soak(n_tiles: int, replicas: int, clients: int, duration_s: float,
             "zero_store_reads": fallbacks == 0 and rebuilds == 0,
             "replicas_synced": int(synced),
         }
+        out["delivery"] = _delivery_block(ports)
         if lat:
             out.update(_quantiles(lat))
             out["slo_serve_p99_ms"] = slo_p99_ms
             out["p99_ok"] = out["p99_ms"] <= slo_p99_ms
         return out
     finally:
+        if prev_delivery is None:
+            os.environ.pop("HEATMAP_DELIVERY", None)
+        else:
+            os.environ["HEATMAP_DELIVERY"] = prev_delivery
         for httpd, _p in fleet:
             httpd.shutdown()
             httpd.get_app().close_repl()
@@ -824,6 +904,11 @@ def run_soak_fleet(n_tiles: int, serve_workers: int, clients: int,
 
         view_audit = DigestTable()
     view = TileMatView(audit=view_audit)
+    # delivery lineage ON: the parent's publisher stamps publish times
+    # (knob checked at construction), the worker processes inherit the
+    # env and close the loop at their subscriber sockets
+    prev_delivery = os.environ.get("HEATMAP_DELIVERY")
+    os.environ["HEATMAP_DELIVERY"] = "1"
     pub = DeltaLogPublisher(view, feed, flush_s=0.02)
     docs = _soak_docs(n_tiles)
     view.apply_docs(docs)
@@ -837,6 +922,7 @@ def run_soak_fleet(n_tiles: int, serve_workers: int, clients: int,
         "HEATMAP_SSE_MAX_CLIENTS": str(max(64, sse_n + 8)),
         "HEATMAP_SUPERVISOR_CHANNEL": chan,
         "HEATMAP_FLEET_PUBLISH_S": "1",
+        "HEATMAP_DELIVERY": "1",
         "HEATMAP_AUDIT": "1" if audit else "0",
     })
     fleet = subprocess.Popen(
@@ -938,6 +1024,9 @@ def run_soak_fleet(n_tiles: int, serve_workers: int, clients: int,
                    "heatmap_audit_digest_mismatch_total",
                    "heatmap_audit_residual"),
             path="/fleet/metrics")
+        # delivered-age headline over the fleet: the workers' socket-
+        # bound buckets re-surface at /fleet/metrics with proc labels
+        delv = _delivery_block([port], path="/fleet/metrics")
         lat = main_leg["lat"]
         lat_ref = (ref or {}).get("lat") or []
         out_soak = {
@@ -976,7 +1065,7 @@ def run_soak_fleet(n_tiles: int, serve_workers: int, clients: int,
             out_soak.update(_quantiles(lat))
             out_soak["slo_serve_p99_ms"] = slo_p99_ms
             out_soak["p99_ok"] = out_soak["p99_ms"] <= slo_p99_ms
-        out = {"soak": out_soak}
+        out = {"soak": out_soak, "delivery": delv}
         if ref is not None:
             bpp_ref = ref["wire"] / max(1, ref["nreq"])
             bpp_main = main_leg["wire"] / max(1, main_leg["nreq"])
@@ -1005,6 +1094,10 @@ def run_soak_fleet(n_tiles: int, serve_workers: int, clients: int,
             }
         return out
     finally:
+        if prev_delivery is None:
+            os.environ.pop("HEATMAP_DELIVERY", None)
+        else:
+            os.environ["HEATMAP_DELIVERY"] = prev_delivery
         stop.set()
         fleet.terminate()
         try:
@@ -1072,7 +1165,8 @@ def main() -> None:
         out = {"soak": soak,
                "repl": {"replicas": soak["replicas"],
                         "max_seq_lag": soak["max_seq_lag"],
-                        "max_repl_lag_s": soak["max_repl_lag_s"]}}
+                        "max_repl_lag_s": soak["max_repl_lag_s"]},
+               "delivery": soak.pop("delivery", None)}
         print(json.dumps(out))
         return
     args.clients = (args.clients if args.clients is not None

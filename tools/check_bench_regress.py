@@ -84,6 +84,19 @@ the chunk shape and retained span, so a 1-hour-bucket round cannot
 stand in for a 1-day-bucket one (or mask its regression).  The
 integrity audit-stamp refusal composes here too.
 
+Delivery provenance (ISSUE 16) extends both serve and history rounds:
+serve artifacts stamped with a ``delivery`` block (HEATMAP_DELIVERY=1
+soaks: delivered-age p50/p99 to the subscriber socket, worst stage)
+are ratcheted on ``age_p99_ms`` (LOWER-is-better), and a
+delivery-stamped round is refused against one whose stamp says the
+knob was off — stamping changes what the soak measures, so the pair
+is not the same experiment; pre-stamp artifacts (no ``delivery`` key)
+stay comparable like every other stamp.  History artifacts carry a
+``scan`` block ({chunks_opened, blocks_scanned, blocks_used,
+bytes_decoded, rows_surfaced, scan_ratio}); ``scan_ratio`` (blocks
+used / blocks scanned, HIGHER-is-better — the reader's pruning
+efficiency) may not DROP past the threshold.
+
 Usage:
     python tools/check_bench_regress.py [--dir REPO] [--threshold 0.5]
 Exit codes: 0 ok / nothing to compare, 1 regression or mixed-backend /
@@ -245,13 +258,15 @@ def serve_artifact_round(path: str) -> int | None:
 
 def serve_metrics(path: str) -> tuple | None:
     """(p99_ms, bytes_sent_wire, replicas|None, wire_format|None,
-    serve_workers|None) of one bench_serve artifact — the ``soak``
-    block when present (replicated-fleet rounds), else the concurrent
-    delta mode; None when neither parses (a broken run fails its own
-    gate, not this one).  ``wire_format`` and ``serve_workers`` are
-    the ISSUE 14 provenance stamps (multi-process fleet soaks);
-    pre-wire artifacts carry neither and stay comparable, like every
-    other stamp."""
+    serve_workers|None, delivery|None) of one bench_serve artifact —
+    the ``soak`` block when present (replicated-fleet rounds), else
+    the concurrent delta mode; None when neither parses (a broken run
+    fails its own gate, not this one).  ``wire_format`` and
+    ``serve_workers`` are the ISSUE 14 provenance stamps (multi-process
+    fleet soaks); ``delivery`` is the ISSUE 16 delivered-age stamp
+    ({enabled, age_p50_ms, age_p99_ms, worst_stage}); pre-stamp
+    artifacts carry none of them and stay comparable, like every other
+    stamp."""
     try:
         with open(path, encoding="utf-8") as fh:
             art = json.load(fh)
@@ -273,10 +288,14 @@ def serve_metrics(path: str) -> tuple | None:
     fmt = (art.get("soak") or {}).get("wire_format") \
         or (art.get("wire") or {}).get("format")
     workers = (art.get("soak") or {}).get("serve_workers")
+    delivery = art.get("delivery")
+    if not isinstance(delivery, dict) or "enabled" not in delivery:
+        delivery = None
     return (float(p99), float(wire),
             int(replicas) if isinstance(replicas, int) else None,
             str(fmt) if isinstance(fmt, str) else None,
-            int(workers) if isinstance(workers, int) else None)
+            int(workers) if isinstance(workers, int) else None,
+            delivery)
 
 
 def compare_serve(dir_path: str, threshold: float) -> int:
@@ -303,8 +322,9 @@ def compare_serve(dir_path: str, threshold: float) -> int:
         return 0
     (r_prev, _p_prev, m_prev), (r_new, _p_new, m_new) = \
         usable[-2], usable[-1]
-    (p99_prev, wire_prev, rep_prev, fmt_prev, wrk_prev) = m_prev
-    (p99_new, wire_new, rep_new, fmt_new, wrk_new) = m_new
+    (p99_prev, wire_prev, rep_prev, fmt_prev, wrk_prev,
+     delv_prev) = m_prev
+    (p99_new, wire_new, rep_new, fmt_new, wrk_new, delv_new) = m_new
     if rep_prev is not None and rep_new is not None \
             and rep_prev != rep_new:
         print(f"FAIL: replica-count mismatch — serve r{r_prev:02d} ran "
@@ -331,6 +351,19 @@ def compare_serve(dir_path: str, threshold: float) -> int:
               f"its per-worker regression) — re-run the soak at the "
               f"same --serve-workers", file=sys.stderr)
         return 1
+    if delv_prev is not None and delv_new is not None \
+            and bool(delv_prev.get("enabled")) \
+            != bool(delv_new.get("enabled")):
+        print(f"FAIL: delivery knob-state mismatch — serve "
+              f"r{r_prev:02d} ran with HEATMAP_DELIVERY "
+              f"{'on' if delv_prev.get('enabled') else 'off'} but "
+              f"r{r_new:02d} ran with it "
+              f"{'on' if delv_new.get('enabled') else 'off'}; the "
+              f"stamped soak measures delivered age to the socket and "
+              f"the unstamped one doesn't, so the pair is not the same "
+              f"experiment — re-run with the same knob state",
+              file=sys.stderr)
+        return 1
     rc = 0
     for name, prev, new in (("p99_ms", p99_prev, p99_new),
                             ("bytes_sent_wire", wire_prev, wire_new)):
@@ -340,6 +373,23 @@ def compare_serve(dir_path: str, threshold: float) -> int:
         if growth > threshold:
             print(f"FAIL: serve regression beyond {threshold:.0%}: "
                   f"{line}", file=sys.stderr)
+            rc = 1
+        else:
+            print(f"OK: {line} within the {threshold:.0%} threshold")
+    # delivered-age ratchet: only when both rounds stamped it on —
+    # the age to the subscriber socket is the serve tier's end-to-end
+    # freshness headline and may not grow past the threshold
+    dl_prev = (delv_prev or {}).get("age_p99_ms")
+    dl_new = (delv_new or {}).get("age_p99_ms")
+    if isinstance(dl_prev, (int, float)) and dl_prev > 0 \
+            and isinstance(dl_new, (int, float)):
+        growth = (dl_new - dl_prev) / dl_prev
+        line = (f"serve r{r_prev:02d} delivered age_p99_ms "
+                f"{dl_prev:,.1f} -> r{r_new:02d} {dl_new:,.1f} "
+                f"({growth:+.1%})")
+        if growth > threshold:
+            print(f"FAIL: delivered-age regression beyond "
+                  f"{threshold:.0%}: {line}", file=sys.stderr)
             rc = 1
         else:
             print(f"OK: {line} within the {threshold:.0%} threshold")
@@ -558,7 +608,10 @@ def hist_metrics(path: str) -> tuple | None:
     shape = tuple(art.get(k) for k in
                   ("bucket_s", "parent_res", "retention_s", "days",
                    "windows_per_day"))
-    return (float(p99), float(rps), shape)
+    scan = art.get("scan")
+    if not isinstance(scan, dict):
+        scan = None
+    return (float(p99), float(rps), shape, scan)
 
 
 def compare_hist(dir_path: str, threshold: float) -> int:
@@ -593,8 +646,8 @@ def compare_hist(dir_path: str, threshold: float) -> int:
     if audit_refused(p_prev, f"hist r{r_prev:02d}") \
             or audit_refused(p_new, f"hist r{r_new:02d}"):
         return 1
-    (p99_prev, rps_prev, shape_prev) = m_prev
-    (p99_new, rps_new, shape_new) = m_new
+    (p99_prev, rps_prev, shape_prev, scan_prev) = m_prev
+    (p99_new, rps_new, shape_new, scan_new) = m_new
     if shape_prev != shape_new:
         print(f"FAIL: history shape mismatch — hist r{r_prev:02d} ran "
               f"(bucket_s, parent_res, retention_s, days, "
@@ -624,6 +677,23 @@ def compare_hist(dir_path: str, threshold: float) -> int:
         rc = 1
     else:
         print(f"OK: {line} within the {threshold:.0%} threshold")
+    # scan-efficiency ratchet: only when both rounds carry the ISSUE 16
+    # scan stamp — the reader's pruning ratio (blocks used / blocks
+    # scanned) may not DROP past the threshold; pre-stamp rounds stay
+    # comparable on the latency/throughput numbers alone
+    sr_prev = (scan_prev or {}).get("scan_ratio")
+    sr_new = (scan_new or {}).get("scan_ratio")
+    if isinstance(sr_prev, (int, float)) and sr_prev > 0 \
+            and isinstance(sr_new, (int, float)):
+        drop = (sr_prev - sr_new) / sr_prev
+        line = (f"hist r{r_prev:02d} scan_ratio {sr_prev:.4f} -> "
+                f"r{r_new:02d} {sr_new:.4f} ({-drop:+.1%})")
+        if drop > threshold:
+            print(f"FAIL: hist scan-efficiency regression beyond "
+                  f"{threshold:.0%}: {line}", file=sys.stderr)
+            rc = 1
+        else:
+            print(f"OK: {line} within the {threshold:.0%} threshold")
     return rc
 
 

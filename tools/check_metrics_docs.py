@@ -137,18 +137,23 @@ def _smoke_repl():
 
 
 def _smoke_hist():
-    """CONSTRUCTED space-time history compactor (query/history.py):
-    the ``heatmap_hist_*`` families only register under
-    HEATMAP_HIST_DIR, which no runtime smoke above sets.  Construction
-    alone registers them; no compaction thread starts.  The replica
-    backfill counter registers with the follower (covered by
-    _smoke_repl)."""
+    """CONSTRUCTED space-time history compactor + reader
+    (query/history.py): the ``heatmap_hist_*`` families only register
+    under HEATMAP_HIST_DIR, which no runtime smoke above sets.
+    Construction alone registers them; no compaction thread starts.
+    The reader contributes the ``heatmap_hist_scan_*`` accounting
+    counters (chunks opened / blocks scanned / bytes decoded / rows
+    surfaced).  The replica backfill counter registers with the
+    follower (covered by _smoke_repl)."""
     from heatmap_tpu.obs.registry import Registry
-    from heatmap_tpu.query.history import HistoryCompactor
+    from heatmap_tpu.query.history import (FileHistorySource,
+                                           HistoryCompactor,
+                                           HistoryReader)
 
     reg = Registry()
-    HistoryCompactor(tempfile.mkdtemp(prefix="metrics-docs-hist-"),
-                     registry=reg)
+    hist_dir = tempfile.mkdtemp(prefix="metrics-docs-hist-")
+    HistoryCompactor(hist_dir, registry=reg)
+    HistoryReader(FileHistorySource(hist_dir), registry=reg)
     return list(reg._families.values())
 
 

@@ -34,7 +34,11 @@ serve-wire table: per-worker open clients, negotiated-format mix
 and the SSE fan-out send-queue high-water.  Members running the
 space-time history tier (query/history.py) add a history row (single
 view) and a per-member history table in ``--fleet``: chunks on disk,
-covered span, compaction lag, replica backfills.
+covered span, compaction lag, replica backfills.  With delivery
+lineage on (HEATMAP_DELIVERY=1, obs.delivery) a delivery row joins the
+single view — delivered-age p50/p99 to the subscriber socket, worst
+stage, slow-request count, worst SSE write stall — and ``--fleet``
+adds a per-replica delivery table naming the worst replica.
 
 Usage:
     python tools/obs_top.py [--url http://127.0.0.1:5000] [--interval 2]
@@ -236,6 +240,14 @@ def render_frame(m: dict, prev: dict | None, dt: float,
             f"compaction lag {fmt(_val(m, 'heatmap_hist_compaction_lag_seconds'), ' s')}   "
             f"backfills {fmt(_val(m, 'heatmap_hist_backfill_total'), digits=0)}"
             + ("   MISMATCH" if mm else ""))
+    # delivery observatory (obs.delivery, HEATMAP_DELIVERY=1): the
+    # delivered-age quantiles to the subscriber socket (last interval),
+    # the worst stage of the telescoping decomposition, slow-request
+    # captures, and the worst write-stalled SSE subscriber — absent
+    # entirely when no stamped frame has been delivered
+    drow = _delivery_row(m, prev)
+    if drow is not None:
+        lines.append(drow)
     # integrity observatory (obs.audit, HEATMAP_AUDIT=1): per-boundary
     # conservation residuals (worst named), digest verification state,
     # and the newest verified seq — absent entirely when auditing is off
@@ -250,6 +262,40 @@ def render_frame(m: dict, prev: dict | None, dt: float,
         lines.append(f"  SLO       {status.upper()}"
                      + (f"   failing: {', '.join(bad)}" if bad else ""))
     return "\n".join(lines) + "\n"
+
+
+def _delivery_row(m: dict, prev: dict | None) -> str | None:
+    """The delivery dashboard row, or None when no socket-bound
+    delivered-age sample exists (HEATMAP_DELIVERY off, or no
+    subscriber has received a stamped frame yet)."""
+    def sock(d):
+        return {k: v for k, v in
+                (d or {}).get("heatmap_delivered_age_seconds_bucket",
+                              {}).items() if 'bound="socket"' in k}
+
+    cur = sock(m)
+    if not cur:
+        return None
+    p50 = hist_quantile(cur, sock(prev) or None, 0.5)
+    p99 = hist_quantile(cur, sock(prev) or None, 0.99)
+    stages: dict = {}
+    for labels, v in (m.get("heatmap_delivery_stage_seconds")
+                      or {}).items():
+        st = _label_of(labels, "stage")
+        if st:
+            stages[st] = v
+    worst = max(stages, key=stages.get) if stages else None
+    slow = _sum(m, "heatmap_serve_slow_requests_total")
+    stall = _val(m, "heatmap_sse_write_stall_seconds")
+
+    def fmt(v, unit="", digits=2):
+        return "--" if v is None else f"{v:,.{digits}f}{unit}"
+
+    return (f"  delivery  p50 {fmt(p50, ' s'):>10}   "
+            f"p99 {fmt(p99, ' s')}"
+            + (f"   worst {worst}" if worst else "")
+            + f"   slow reqs {fmt(slow, digits=0)}"
+            + (f"   stall {fmt(stall, ' s', 1)}" if stall else ""))
 
 
 def _audit_row(m: dict) -> str | None:
@@ -589,6 +635,42 @@ def render_fleet_frame(m: dict, prev: dict | None, dt: float,
         if lags:
             lines.append(f"  hist max compaction lag "
                          f"{fmt(max(lags), ' s')}")
+    # delivery observatory (obs.delivery, HEATMAP_DELIVERY=1): one row
+    # per replica delivering stamped frames — delivered-age p50/p99 to
+    # the subscriber socket (the member-published delivery block), the
+    # worst stage of its telescoping decomposition, slow-request
+    # captures, and the worst write-stalled subscriber.  Absent until
+    # a stamped frame reaches a subscriber anywhere on the channel.
+    d_p50 = _by_proc(m, "heatmap_fleet_member_delivered_age_p50_s")
+    d_tags = sorted(d_p50)
+    if d_tags:
+        d_p99 = _by_proc(m, "heatmap_fleet_member_delivered_age_p99_s")
+        d_stall = _by_proc(m, "heatmap_sse_write_stall_seconds")
+        d_slow = _by_proc_sum(m, "heatmap_serve_slow_requests_total")
+        d_stage: dict = {}
+        for labels, v in (m.get("heatmap_delivery_stage_seconds")
+                          or {}).items():
+            p = _label_of(labels, "proc")
+            st = _label_of(labels, "stage")
+            if p is None or st is None:
+                continue
+            cur = d_stage.get(p)
+            if cur is None or v > cur[1]:
+                d_stage[p] = (st, v)
+        lines.append("")
+        lines.append(f"  {'delivery':<14}{'p50':>9}{'p99':>9}  "
+                     f"{'worst stage':<14}{'slow':>6}{'stall':>8}")
+        for tag in d_tags:
+            st, _v = d_stage.get(tag, (None, None))
+            lines.append(
+                f"  {tag:<14}{fmt(d_p50.get(tag), ' s', digits=2):>9}"
+                f"{fmt(d_p99.get(tag), ' s', digits=2):>9}  "
+                f"{(st or '-'):<14}"
+                f"{fmt(d_slow.get(tag), digits=0):>6}"
+                f"{fmt(d_stall.get(tag), ' s', digits=1):>8}")
+        worst_tag = max(d_tags, key=lambda t: d_p50.get(t) or 0.0)
+        lines.append(f"  delivery worst replica {worst_tag} "
+                     f"(p50 {fmt(d_p50.get(worst_tag), ' s', digits=2)})")
     # integrity observatory (obs.audit): one row per audited member —
     # worst conservation residual (boundary named), digests verified /
     # mismatched, last verified seq (replicas).  Absent without
