@@ -202,6 +202,20 @@ class Config:
                                        # replica follower, and
                                        # publishing its own fleet
                                        # member snapshot
+    serve_core: str = "thread"         # HEATMAP_SERVE_CORE: which HTTP
+                                       # core hosts the serve app —
+                                       # "thread" (wsgiref, a thread
+                                       # per request + per SSE
+                                       # subscriber) or "epoll" (the
+                                       # selectors event loop with
+                                       # zero-copy SSE fan-out,
+                                       # serve/evloop.py)
+    serve_loop_handlers: int = 8       # HEATMAP_SERVE_LOOP_HANDLERS:
+                                       # WSGI handler threads behind
+                                       # the epoll core's loop — app
+                                       # calls (store reads, history
+                                       # scans) run here so blocking
+                                       # work never stalls the loop
     shards: int = 1                    # HEATMAP_SHARDS: total runtime
                                        # shard processes partitioning
                                        # the event stream by H3 parent
@@ -485,6 +499,9 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
                                 Config.serve_max_inflight),
         serve_workers=_int(e, "HEATMAP_SERVE_WORKERS",
                            Config.serve_workers),
+        serve_core=e.get("HEATMAP_SERVE_CORE", Config.serve_core),
+        serve_loop_handlers=_int(e, "HEATMAP_SERVE_LOOP_HANDLERS",
+                                 Config.serve_loop_handlers),
         repl_dir=e.get("HEATMAP_REPL_DIR", Config.repl_dir),
         repl_feed=e.get("HEATMAP_REPL_FEED", Config.repl_feed),
         repl_seg_bytes=_int(e, "HEATMAP_REPL_SEG_BYTES",
@@ -595,6 +612,14 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         raise ValueError(
             f"HEATMAP_SERVE_WORKERS must be >= 1, "
             f"got {cfg.serve_workers}")
+    if cfg.serve_core not in ("thread", "epoll"):
+        raise ValueError(
+            f"HEATMAP_SERVE_CORE must be 'thread' or 'epoll', "
+            f"got {cfg.serve_core!r}")
+    if cfg.serve_loop_handlers < 1:
+        raise ValueError(
+            f"HEATMAP_SERVE_LOOP_HANDLERS must be >= 1, "
+            f"got {cfg.serve_loop_handlers}")
     if cfg.repl_seg_bytes < 4096:
         raise ValueError(
             f"HEATMAP_REPL_SEG_BYTES must be >= 4096, "

@@ -171,7 +171,13 @@ class DeliveryTracker:
 
     def delivered(self, meta: dict, wb: float, we: float) -> None:
         """Complete one subscriber's end-to-end sample: ``wb``/``we``
-        bracket the blocking socket write of the tagged frame."""
+        bracket the tagged frame's socket write.  Both serve cores
+        call this with the same contract — the thread core around the
+        blocking ``send()`` in its subscriber generator, the epoll
+        core from ``wb`` = the loop staging the frame to ``we`` = the
+        loop completing the (possibly multi-``send``, offset-resumed)
+        drain — so fanout_queue + socket_write still telescope and
+        the residual stays identically 0 on either core."""
         rec = meta.get("rec")
         if not isinstance(rec, dict):
             return

@@ -478,7 +478,11 @@ class FileFeedSource:
 class HttpFeedSource:
     """Remote transport: the writer's serve app re-exposes the feed at
     /api/repl/* (serve/api.py); this polls it over plain TCP.  Errors
-    raise to the follower, which counts them and backs off."""
+    raise to the follower, which counts them and backs off.  Each poll
+    is one urllib request — a fresh connection per call — so the feed
+    endpoints work identically behind either serve core (the epoll
+    core, like wsgiref, answers HTTP/1.0 close-per-request; nothing
+    here assumes keep-alive)."""
 
     def __init__(self, base_url: str, timeout_s: float = 10.0):
         self.base = base_url.rstrip("/")

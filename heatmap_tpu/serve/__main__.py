@@ -54,12 +54,13 @@ def _spawn_worker(host: str, port: int) -> subprocess.Popen:
         env=env)
 
 
-def supervise(workers: int, host: str, port: int) -> int:
+def supervise(workers: int, host: str, port: int,
+              core: str = "thread") -> int:
     holder = None
     if port == 0:
         holder, port = _hold_port(host)
-    log.info("serve fleet: %d workers on http://%s:%d/ (SO_REUSEPORT)",
-             workers, host, port)
+    log.info("serve fleet: %d workers on http://%s:%d/ "
+             "(SO_REUSEPORT, %s core)", workers, host, port, core)
     procs = [_spawn_worker(host, port) for _ in range(workers)]
     stopping = {"flag": False}
 
@@ -131,10 +132,15 @@ def main(argv=None) -> int:
     host = args.host or cfg.serve_host
     port = args.port if args.port is not None else cfg.serve_port
     if workers > 1:
-        return supervise(workers, host, port)
+        # children inherit HEATMAP_SERVE_CORE through the environment;
+        # naming the core here makes a mixed-core fleet (a config bug)
+        # visible in the supervisor log
+        return supervise(workers, host, port, core=cfg.serve_core)
 
     from heatmap_tpu.serve.api import serve_forever
     from heatmap_tpu.sink import make_store
+
+    log.info("serve core: %s", cfg.serve_core)
 
     # read-side: under a sharded jsonl config, load the union of every
     # shard's log — a serve worker must present the whole city, never

@@ -761,6 +761,28 @@ class _ServeStats:
             "HEATMAP_SLOWREQ_MS and were captured (full per-stage "
             "span) into the slow-request ring at /debug/requests",
             labels=("endpoint",))
+        # ---- async serve core (ISSUE 17) -----------------------------
+        self.core = reg.gauge(
+            "heatmap_serve_core",
+            "which HTTP core hosts this serve process "
+            "(HEATMAP_SERVE_CORE) — 1 on the active core's label, "
+            "thread = wsgiref, epoll = the selectors event loop",
+            labels=("core",))
+        self.open_connections = reg.gauge(
+            "heatmap_serve_open_connections",
+            "TCP connections currently open on the epoll serve core "
+            "(parsing, handling, draining, or streaming SSE)")
+        self.write_backlog = reg.gauge(
+            "heatmap_serve_write_backlog",
+            "epoll-core connections currently holding write interest "
+            "— bytes staged but not yet accepted by the socket; the "
+            "slow-client pressure gauge")
+        self.loop_iter = reg.histogram(
+            "heatmap_serve_loop_iteration_seconds",
+            "busy time of one epoll event-loop iteration (dispatch + "
+            "writes + ticks, excluding the idle select() wait) — the "
+            "loop's own latency floor under fan-out load",
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0))
 
 
 class _SSEBody:
@@ -1100,6 +1122,7 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
     # advance per (grid, format) channel, fanned to bounded per-client
     # queues); bounded in-flight render admission.
     from heatmap_tpu.serve import wire as wiremod
+    from heatmap_tpu.serve import evloop as evloopmod
 
     from heatmap_tpu.native import maybe_wire_ops
 
@@ -1119,6 +1142,17 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
         "across all subscribers — a wedged client shows here for the "
         "whole send-timeout window BEFORE it is shed as lagged",
         fn=fanout.max_write_stall_s)
+    # the O(channels) invariant, observable: total frames retained in
+    # the shared per-channel rings — flat in subscriber count, because
+    # an event-loop subscriber holds only a (cursor, offset) pair into
+    # the ring, never copies of frames
+    serve_reg.gauge(
+        "heatmap_sse_fanout_retained_frames",
+        "frames currently retained across all SSE fan-out channel "
+        "rings (the epoll core's entire fan-out buffer memory) — "
+        "bounded by channels x HEATMAP_SSE_QUEUE regardless of "
+        "subscriber count",
+        fn=fanout.retained_frames)
     # ---- serve request spans (ISSUE 16) -------------------------------
     # Every admission-controlled request carries a _Span; completed
     # spans land in a bounded ring at /debug/requests, and spans slower
@@ -1575,8 +1609,17 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
         # — overlap is idempotent (delta upserts), a gap is not, and
         # this order can never gap
         start_seq = view.seq
-        chan, sub = fanout.subscribe(("tiles", grid, fmt),
-                                     _tiles_pump(grid, fmt, start_seq))
+        pump = _tiles_pump(grid, fmt, start_seq)
+        key = ("tiles", grid, fmt)
+        # event-loop core: same pump, same channel key, but the
+        # subscriber is a (cursor, offset) pair into the channel's
+        # shared frame ring (no per-subscriber queue, no writer
+        # thread) and the loop drains it — wire bytes identical
+        evloop = bool(environ.get("heatmap.evloop"))
+        if evloop:
+            chan, sub = fanout.subscribe_ev(key, pump)
+        else:
+            chan, sub = fanout.subscribe(key, pump)
         d = view.delta(grid, since)
         stats.delta_cells.observe(len(d["docs"]))
         first = [_sse_tiles_frame(d, grid, fmt)]
@@ -1585,6 +1628,10 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
             fanout.unsubscribe(chan, sub)
             stats.sse_clients.inc(-1)
 
+        if evloop:
+            return evloopmod.EvloopStream(
+                chan, sub, [b"retry: 3000\n\n"] + first, on_close,
+                sse_heartbeat, sse_send_timeout, delivery)
         # the admission slot is released in _SSEBody.close(), which the
         # WSGI server guarantees to call — a bare generator's finally
         # would never run if iteration never starts
@@ -1672,7 +1719,11 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
         # subscribe first, then the per-client resume frame (same
         # no-gap ordering as the tiles stream; `id:` lines make the
         # possible overlap visible to resuming clients)
-        chan, sub = fanout.subscribe(("cq", qid), pump)
+        evloop = bool(environ.get("heatmap.evloop"))
+        if evloop:
+            chan, sub = fanout.subscribe_ev(("cq", qid), pump)
+        else:
+            chan, sub = fanout.subscribe(("cq", qid), pump)
         first = []
         evs = cq_engine.events_since(qid, since)
         if evs:
@@ -1682,6 +1733,10 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
             fanout.unsubscribe(chan, sub)
             stats.sse_clients.inc(-1)
 
+        if evloop:
+            return evloopmod.EvloopStream(
+                chan, sub, [b"retry: 3000\n\n"] + first, on_close,
+                sse_heartbeat, sse_send_timeout, delivery)
         return _SSEBody(_sse_generator(sub, first), on_close)
 
     def _handle(environ, start_response):
@@ -2742,6 +2797,8 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
     app.delivery = delivery
     app.span_ring = span_ring
     app.fanout = fanout
+    # the event-loop core reads these (loop metrics + fan-out wake)
+    app.serve_stats = stats
 
     def close_repl():
         if cq_engine is not None:
@@ -2819,10 +2876,21 @@ def _make_http_server(store, cfg, runtime, host, port,
                       reuse_port: bool = False):
     host = host or (getattr(cfg, "serve_host", None) or "127.0.0.1")
     port = port if port is not None else (getattr(cfg, "serve_port", None) or 5000)
-    return make_server(host, port, make_wsgi_app(store, cfg, runtime),
-                       server_class=(_ReusePortWSGIServer if reuse_port
-                                     else _ThreadingWSGIServer),
-                       handler_class=_QuietHandler)
+    app = make_wsgi_app(store, cfg, runtime)
+    core = getattr(cfg, "serve_core", None) or "thread"
+    if core == "epoll":
+        from heatmap_tpu.serve.evloop import EventLoopServer
+
+        handlers = getattr(cfg, "serve_loop_handlers", None) or 8
+        srv = EventLoopServer(host, port, app, reuse_port=reuse_port,
+                              handlers=handlers)
+    else:
+        srv = make_server(host, port, app,
+                          server_class=(_ReusePortWSGIServer if reuse_port
+                                        else _ThreadingWSGIServer),
+                          handler_class=_QuietHandler)
+    app.serve_stats.core.labels(core=core).set(1)
+    return srv
 
 
 class ServeFleetMember:

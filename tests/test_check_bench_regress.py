@@ -228,10 +228,12 @@ def test_repo_artifacts_parse():
 # ------------------------------------------------- serve-tier artifacts
 def _write_serve(dir_path, rnd, p99=100.0, wire=1_000_000, replicas=None,
                  rc=0, soak=True, wire_format=None, serve_workers=None,
-                 delivery=None):
+                 delivery=None, serve_core=None, thread_ref=None):
     art = {"rc": rc}
     if delivery is not None:
         art["delivery"] = delivery
+    if thread_ref is not None:
+        art["thread_reference"] = thread_ref
     sec = {"p99_ms": p99, "bytes_sent_wire": wire}
     if soak:
         if replicas is not None:
@@ -240,6 +242,8 @@ def _write_serve(dir_path, rnd, p99=100.0, wire=1_000_000, replicas=None,
             sec["wire_format"] = wire_format
         if serve_workers is not None:
             sec["serve_workers"] = serve_workers
+        if serve_core is not None:
+            sec["serve_core"] = serve_core
         art["soak"] = sec
     else:
         art["concurrent"] = {"delta": sec}
@@ -304,6 +308,84 @@ def test_serve_failed_run_skipped(tmp_path, capsys):
                  rc=1)  # broken run: fails its own gate, not this one
     assert mod.main(["--dir", str(tmp_path)]) == 0
     assert "skipping serve r02" in capsys.readouterr().out
+
+
+def test_serve_core_mismatch_refused_without_reference(tmp_path, capsys):
+    """ISSUE 17: an epoll soak's p99 cannot ratchet against a
+    thread-core baseline — the pair is refused when the newer artifact
+    banked no thread_reference leg."""
+    mod = _load()
+    _write_serve(tmp_path, 3, p99=100.0, wire=1_000_000, replicas=None,
+                 serve_workers=4, serve_core="thread")
+    _write_serve(tmp_path, 4, p99=50.0, wire=1_000_000, replicas=None,
+                 serve_workers=4, serve_core="epoll")
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    err = capsys.readouterr().err
+    assert "serve-core mismatch" in err
+    assert "thread_reference" in err
+
+
+def test_serve_core_missing_stamp_means_thread(tmp_path, capsys):
+    """Pre-ISSUE-17 artifacts carry no serve_core stamp but all ran
+    wsgiref: missing is read as 'thread', so an unstamped baseline vs
+    an explicit thread-core round stays comparable..."""
+    mod = _load()
+    _write_serve(tmp_path, 3, p99=100.0, wire=1_000_000,
+                 serve_workers=4)  # pre-stamp round
+    _write_serve(tmp_path, 4, p99=105.0, wire=1_000_000,
+                 serve_workers=4, serve_core="thread")
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    # ...while an unstamped baseline vs an epoll round (no reference
+    # leg) is a cross-core pair and is refused
+    _write_serve(tmp_path, 5, p99=50.0, wire=1_000_000,
+                 serve_workers=4, serve_core="epoll")
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    assert "serve-core mismatch" in capsys.readouterr().err
+
+
+def test_serve_core_mismatch_falls_back_to_thread_reference(
+        tmp_path, capsys):
+    """A cross-core pair ratchets thread-vs-thread via the newer
+    artifact's same-schedule thread_reference leg when banked."""
+    mod = _load()
+    _write_serve(tmp_path, 3, p99=100.0, wire=1_000_000,
+                 serve_workers=4, serve_core="thread")
+    _write_serve(tmp_path, 4, p99=50.0, wire=1_000_000,
+                 serve_workers=4, serve_core="epoll",
+                 thread_ref={"serve_core": "thread", "p99_ms": 110.0,
+                             "bytes_sent_wire": 1_050_000})
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "falling back" in out and "thread_reference" in out
+    assert "110" in out  # the reference p99 is what ratchets
+
+
+def test_serve_core_reference_leg_regression_still_fails(tmp_path,
+                                                         capsys):
+    """The fallback is not an amnesty: a regressed thread_reference
+    leg fails the ratchet even when the epoll headline improved."""
+    mod = _load()
+    _write_serve(tmp_path, 3, p99=100.0, wire=1_000_000,
+                 serve_workers=4, serve_core="thread")
+    _write_serve(tmp_path, 4, p99=40.0, wire=1_000_000,
+                 serve_workers=4, serve_core="epoll",
+                 thread_ref={"serve_core": "thread", "p99_ms": 400.0,
+                             "bytes_sent_wire": 1_000_000})
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    assert "p99_ms" in capsys.readouterr().err
+
+
+def test_serve_matching_epoll_pair_compares_directly(tmp_path, capsys):
+    """Two epoll-core rounds are a matching pair — no refusal, no
+    fallback, the headline numbers ratchet directly."""
+    mod = _load()
+    _write_serve(tmp_path, 4, p99=100.0, wire=1_000_000,
+                 serve_workers=4, serve_core="epoll")
+    _write_serve(tmp_path, 5, p99=110.0, wire=1_000_000,
+                 serve_workers=4, serve_core="epoll")
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "falling back" not in out
 
 
 def test_serve_and_bench_gates_compose(tmp_path, capsys):

@@ -769,3 +769,62 @@ def test_fleet_stamp_standalone_defaults(monkeypatch):
     st = fleet_stamp(100.0)
     assert st == {"fleet": {"members": 1, "per_member_rate": 100.0}}
     assert fleet_stamp() == {"fleet": {"members": 1}}
+
+
+# ----------------------------------------------- serve-core rows (ISSUE 17)
+_CORE_TEXT = """\
+# TYPE heatmap_serve_core gauge
+heatmap_serve_core{{core="{core}"}} 1
+# TYPE heatmap_serve_open_connections gauge
+heatmap_serve_open_connections {conns}
+# TYPE heatmap_serve_write_backlog gauge
+heatmap_serve_write_backlog {backlog}
+# TYPE heatmap_serve_loop_iteration_seconds histogram
+heatmap_serve_loop_iteration_seconds_bucket{{le="0.001"}} 90
+heatmap_serve_loop_iteration_seconds_bucket{{le="0.05"}} 99
+heatmap_serve_loop_iteration_seconds_bucket{{le="+Inf"}} 100
+heatmap_serve_loop_iteration_seconds_sum 0.5
+heatmap_serve_loop_iteration_seconds_count 100
+"""
+
+
+def test_obs_top_serve_core_row_single_view():
+    """The single-process view renders the ISSUE 17 serve-core row —
+    which loop the process runs, open connections, write backlog, and
+    the loop-iteration p99 — and omits it entirely on a scrape
+    without the core gauge."""
+    top = _load_obs_top()
+    m = top.parse_prom(_CORE_TEXT.format(core="epoll", conns=42,
+                                         backlog=7))
+    frame = top.render_frame(m, None, 0.0, None)
+    assert "core" in frame and "epoll" in frame
+    assert "conns 42" in frame
+    assert "backlog 7" in frame
+    # p99 lands in the (0.001, 0.05] bucket: interpolated ms, nonzero
+    assert "loop p99" in frame and "loop p99 --" not in frame
+    # absent without the family (pre-ISSUE-17 scrape)
+    assert "core" not in top.render_frame({}, None, 0.0, None)
+
+
+def test_obs_top_fleet_frame_renders_core_column(tmp_path):
+    """--fleet's serve table carries a core column: one member per
+    serve core, each labeled with the loop it runs."""
+    top = _load_obs_top()
+    chan = _chan(tmp_path)
+    publish_member_snapshot(
+        chan, "w-epoll", role="serve",
+        metrics_text=_CORE_TEXT.format(core="epoll", conns=10,
+                                       backlog=0),
+        healthz={"status": "ok", "checks": {}})
+    publish_member_snapshot(
+        chan, "w-thread", role="serve",
+        metrics_text=_CORE_TEXT.format(core="thread", conns=3,
+                                       backlog=0),
+        healthz={"status": "ok", "checks": {}})
+    m = top.parse_prom(FleetAggregator(chan).metrics_text())
+    frame = top.render_fleet_frame(m, None, 0.0, None)
+    assert "core" in frame
+    epoll_row = next(l for l in frame.splitlines() if "w-epoll" in l)
+    thread_row = next(l for l in frame.splitlines() if "w-thread" in l)
+    assert "epoll" in epoll_row
+    assert "thread" in thread_row

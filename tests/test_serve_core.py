@@ -1,0 +1,657 @@
+"""PR 17 — the async (epoll) serve core vs the wsgiref thread core.
+
+DIFFERENTIAL: with HEATMAP_SERVE_CORE=epoll, every response is
+byte-identical to the thread core's — status, headers (modulo the Date
+stamp and the per-process ETag boot nonce), body — across JSON and
+binary formats, on store-fed, writer-fed, and replica views, including
+SSE frame streams (preamble, catch-up, pushes, heartbeats, `lagged`,
+`gone`).
+
+CHAOS (epoll-only): slow-reader shed with the write stall visible
+first, mid-write disconnect releasing the admission slot + fan-out
+registration, partial-frame writes resuming at the saved offset.
+
+MEMORY: fan-out state is O(channels) — N subscribers on one channel
+share ONE frame ring; each subscriber's pending state is a
+(cursor, offset) integer pair.
+"""
+
+import datetime as dt
+import http.client
+import json
+import re
+import socket
+import threading
+import time
+
+import pytest
+
+from heatmap_tpu import hexgrid
+from heatmap_tpu.config import load_config
+from heatmap_tpu.serve.api import start_background
+from heatmap_tpu.sink import MemoryStore
+from heatmap_tpu.sink.base import PositionDoc, TileDoc, UTC
+
+# the per-app boot nonce embedded in each ETag is process-random by
+# design (restart safety); the differential normalizes those 8-hex
+# segments — any real content divergence still fails on body bytes
+_NONCE = re.compile(r'(?<=["."])[0-9a-f]{8}(?=\.)')
+
+
+def _mk_store(n=6):
+    s = MemoryStore()
+    now = dt.datetime.now(UTC).replace(microsecond=0)
+    ws = now - dt.timedelta(minutes=2)
+    cells = []
+    for i in range(n * 3):
+        c = hexgrid.latlng_to_cell(42.30 + i * 7e-3, -71.05, 8)
+        if c not in cells:
+            cells.append(c)
+        if len(cells) == n:
+            break
+    s.upsert_tiles([
+        TileDoc("bos", 8, c, ws, ws + dt.timedelta(minutes=5),
+                count=i + 1, avg_speed_kmh=20.0 + i, avg_lat=42.3,
+                avg_lon=-71.05, ttl_minutes=45,
+                extra={"p95SpeedKmh": 50.0 + i})
+        for i, c in enumerate(cells)])
+    s.upsert_positions([
+        PositionDoc("mbta", f"veh-{i}", now, 42.3 + i * 1e-3, -71.05)
+        for i in range(3)])
+    return s
+
+
+def _get(port, path, headers=None):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+    c.request("GET", path, headers=headers or {})
+    r = c.getresponse()
+    body = r.read()
+    hdrs = r.getheaders()
+    c.close()
+    return r.status, hdrs, body
+
+
+def _norm(hdrs):
+    out = []
+    for k, v in hdrs:
+        if k.lower() == "date":
+            continue
+        if k.lower() == "etag":
+            v = _NONCE.sub('NONCE', v)
+        out.append((k, v))
+    return out
+
+
+def _pair(store, env=None, runtime=None, **cfg_over):
+    """(thread_server, epoll_server) on ONE store/runtime — the only
+    per-process difference left is the ETag boot nonce."""
+    servers = []
+    for core in ("thread", "epoll"):
+        e = dict(env or {})
+        e["HEATMAP_SERVE_CORE"] = core
+        cfg = load_config(e, serve_port=0, **cfg_over)
+        httpd, _t, port = start_background(store, cfg, runtime=runtime,
+                                           port=0)
+        servers.append((httpd, port))
+    return servers
+
+
+def _shutdown(servers):
+    for httpd, _port in servers:
+        close_repl = getattr(httpd.get_app(), "close_repl", None)
+        httpd.shutdown()
+        if close_repl is not None:
+            close_repl()
+
+
+def _assert_identical(tp, ep, path, headers=None):
+    s1, h1, b1 = _get(tp, path, headers)
+    s2, h2, b2 = _get(ep, path, headers)
+    assert s1 == s2, f"{path}: status {s1} != {s2}"
+    assert _norm(h1) == _norm(h2), (
+        f"{path}: headers differ\n thread={_norm(h1)}\n "
+        f"epoll={_norm(h2)}")
+    assert b1 == b2, f"{path}: body differs"
+    return s1, h1, b1
+
+
+# ----------------------------------------------------------- store-fed
+def test_differential_store_fed_all_endpoints():
+    store = _mk_store()
+    servers = _pair(store)
+    (t_httpd, tp), (e_httpd, ep) = servers
+    try:
+        for path in (
+                "/api/tiles/latest",
+                "/api/tiles/latest?fmt=bin",
+                "/api/tiles/delta?since=0",
+                "/api/tiles/delta?since=0&fmt=bin",
+                "/api/tiles/delta?since=1",
+                "/api/tiles/topk?k=3",
+                "/api/positions/latest",
+                "/api/positions/latest?fmt=bin",
+                "/api/tiles/latest?grid=nope",     # 400 path
+                "/api/definitely/not",             # 404 path
+                "/healthz",
+                "/",
+        ):
+            _assert_identical(tp, ep, path)
+        # gzip negotiation: same encoded bytes, same Vary
+        s, h, _b = _assert_identical(tp, ep, "/api/tiles/latest",
+                                     {"Accept-Encoding": "gzip"})
+        assert dict(h).get("Content-Encoding") == "gzip"
+        # conditional requests answer 304 with each core's OWN etag
+        for port in (tp, ep):
+            et = dict(_get(port, "/api/tiles/latest")[1])["ETag"]
+            s, h, b = _get(port, "/api/tiles/latest",
+                           {"If-None-Match": et})
+            assert s == 304 and b == b""
+        et_t = dict(_get(tp, "/api/tiles/latest")[1])["ETag"]
+        et_e = dict(_get(ep, "/api/tiles/latest")[1])["ETag"]
+        assert _NONCE.sub('NONCE', et_t) == _NONCE.sub('NONCE', et_e)
+    finally:
+        _shutdown(servers)
+
+
+def test_differential_writer_fed():
+    """Both cores over the SAME live runtime (metrics + query view)."""
+    import tempfile
+
+    from heatmap_tpu.stream import MicroBatchRuntime
+    from heatmap_tpu.stream.source import MemorySource
+
+    t0 = int(time.time()) - 5
+    evs = [{"provider": "p", "vehicleId": f"v{i}",
+            "lat": 42.0 + i * 1e-4, "lon": -71.0, "speedKmh": 1.0,
+            "ts": t0} for i in range(32)]
+    with tempfile.TemporaryDirectory() as td:
+        cfg0 = load_config({}, batch_size=16, state_capacity_log2=8,
+                           speed_hist_bins=4, store="memory",
+                           serve_port=0, checkpoint_dir=td)
+        src = MemorySource(evs)
+        src.finish()
+        st = MemoryStore()
+        rt = MicroBatchRuntime(cfg0, src, st, checkpoint_every=0)
+        rt.run()
+        servers = _pair(st, runtime=rt)
+        (_t, tp), (_e, ep) = servers
+        try:
+            for path in ("/api/tiles/latest",
+                         "/api/tiles/latest?fmt=bin",
+                         "/api/tiles/delta?since=0&fmt=bin",
+                         "/api/positions/latest"):
+                _assert_identical(tp, ep, path)
+        finally:
+            _shutdown(servers)
+            rt.close()
+
+
+def test_differential_replica_fed(tmp_path):
+    """Both cores as replica followers of ONE feed: the replicated
+    view AND the re-served /api/repl/* feed endpoints byte-match."""
+    from heatmap_tpu.query import TileMatView
+    from heatmap_tpu.query.repl import DeltaLogPublisher
+
+    feed = str(tmp_path / "feed")
+    view = TileMatView()
+    pub = DeltaLogPublisher(view, feed, flush_s=0.02)
+    now = dt.datetime.now(UTC).replace(microsecond=0)
+    ws = now - dt.timedelta(minutes=2)
+    cells = [hexgrid.latlng_to_cell(42.3 + i * 7e-3, -71.05, 8)
+             for i in range(4)]
+    view.apply_docs([
+        TileDoc("bos", 8, c, ws, ws + dt.timedelta(minutes=5),
+                count=i + 1, avg_speed_kmh=20.0 + i, avg_lat=42.3,
+                avg_lon=-71.05, ttl_minutes=45)
+        for i, c in enumerate(cells)])
+    servers = _pair(MemoryStore(), repl_feed=feed, repl_poll_ms=50)
+    (_t, tp), (_e, ep) = servers
+    try:
+        for httpd, _p in servers:
+            fol = httpd.get_app().repl_follower
+            deadline = time.time() + 20
+            while time.time() < deadline and not (
+                    fol.synced and fol.seq_lag() == 0):
+                time.sleep(0.02)
+            assert fol.synced
+        for path in ("/api/tiles/latest",
+                     "/api/tiles/latest?fmt=bin",
+                     "/api/tiles/delta?since=0",
+                     "/api/repl/meta",
+                     "/api/repl/feed?since=0"):
+            _assert_identical(tp, ep, path)
+    finally:
+        _shutdown(servers)
+        pub.close()
+
+
+def test_differential_history_endpoints(tmp_path):
+    """range/at/diff + /api/hist/* over one compacted history dir."""
+    import tempfile
+
+    from heatmap_tpu.query import TileMatView
+    from heatmap_tpu.query.repl import DeltaLogPublisher
+    from heatmap_tpu.query.history import HistoryCompactor, HistoryLog
+
+    clock = {"t": time.time()}
+    feed = tempfile.mkdtemp(dir=str(tmp_path))
+    hist = tempfile.mkdtemp(dir=str(tmp_path))
+    w = TileMatView(now_fn=lambda: clock["t"])
+    pub = DeltaLogPublisher(w, feed, start=False,
+                            hist=HistoryLog(hist))
+    base = dt.datetime.fromtimestamp(clock["t"], UTC).replace(
+        microsecond=0)
+    cells = [hexgrid.latlng_to_cell(42.3 + i * 7e-3, -71.05, 8)
+             for i in range(3)]
+    for k, ws in enumerate((base - dt.timedelta(minutes=20),
+                            base - dt.timedelta(minutes=10))):
+        w.apply_docs([
+            TileDoc("bos", 8, c, ws, ws + dt.timedelta(minutes=5),
+                    count=k * 3 + i + 1, avg_speed_kmh=20.0,
+                    avg_lat=42.3, avg_lon=-71.05, ttl_minutes=45)
+            for i, c in enumerate(cells)])
+        pub.flush()
+    pub.close()
+    HistoryCompactor(hist, feed_dir=feed,
+                     clock=lambda: clock["t"]).step()
+    servers = _pair(MemoryStore(), hist_dir=hist, repl_dir=feed)
+    (_t, tp), (_e, ep) = servers
+    t0 = clock["t"] - 3600
+    t1 = clock["t"] + 60
+    try:
+        for path in (f"/api/tiles/range?t0={t0}&t1={t1}",
+                     f"/api/tiles/range?t0={t0}&t1={t1}&fmt=bin",
+                     f"/api/tiles/range?t0={t0}&t1={t1}&res=7",
+                     "/api/tiles/at?seq=1",
+                     "/api/tiles/diff?a=1&b=2",
+                     "/api/hist/index"):
+            _assert_identical(tp, ep, path)
+    finally:
+        _shutdown(servers)
+
+
+# ------------------------------------------------------------------ SSE
+def _sse_connect(port, path="/api/tiles/stream?since=0", rcvbuf=None):
+    sk = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    if rcvbuf:
+        sk.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, rcvbuf)
+    sk.settimeout(15)
+    sk.connect(("127.0.0.1", port))
+    sk.sendall(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+    return sk
+
+
+def _read_until(sk, pred, timeout=15):
+    buf = b""
+    deadline = time.time() + timeout
+    while not pred(buf):
+        if time.time() > deadline:
+            raise AssertionError(f"timeout; got {buf[-400:]!r}")
+        chunk = sk.recv(65536)
+        if not chunk:
+            return buf
+        buf += chunk
+    return buf
+
+
+def test_differential_sse_stream_push_and_heartbeat():
+    """Preamble headers, catch-up frame, pushed frames, and heartbeat
+    bytes identical across cores — JSON and binary."""
+    store = _mk_store()
+    servers = _pair(store, env={"HEATMAP_VIEW_POLL_MS": "30",
+                                "HEATMAP_SSE_HEARTBEAT_S": "0.3"})
+    (_t, tp), (_e, ep) = servers
+    socks = []
+    try:
+        streams = {}
+        for fmt_q in ("", "&fmt=bin"):
+            got = {}
+            for name, port in (("thread", tp), ("epoll", ep)):
+                sk = _sse_connect(
+                    port, f"/api/tiles/stream?since=0{fmt_q}")
+                socks.append(sk)
+                buf = _read_until(
+                    sk, lambda b: b.count(b"\n\n") >= 3)
+                head, _, rest = buf.partition(b"\r\n\r\n")
+                head_lines = [ln for ln in head.split(b"\r\n")
+                              if not ln.startswith(b"Date:")]
+                got[name] = (head_lines, rest)
+                streams[(name, fmt_q)] = sk
+            assert got["thread"][0] == got["epoll"][0]
+            # retry + catch-up frame bytes identical
+            assert got["thread"][1][:40] == got["epoll"][1][:40]
+            assert got["thread"][1].startswith(b"retry: 3000\n\n")
+        # one mutation -> one pushed frame, same bytes on both cores
+        now = dt.datetime.now(UTC).replace(microsecond=0)
+        ws = now - dt.timedelta(minutes=2)
+        newcell = hexgrid.latlng_to_cell(42.75, -71.4, 8)
+        store.upsert_tiles([
+            TileDoc("bos", 8, newcell, ws,
+                    ws + dt.timedelta(minutes=5), count=99,
+                    avg_speed_kmh=10.0, avg_lat=42.75, avg_lon=-71.4,
+                    ttl_minutes=45)])
+        pushed = {}
+        for name in ("thread", "epoll"):
+            sk = streams[(name, "")]
+            buf = _read_until(
+                sk, lambda b: b.count(b"event: tiles") >= 1)
+            frames = [f for f in buf.split(b"\n\n")
+                      if f.startswith(b"event: tiles")]
+            pushed[name] = frames[0]
+        assert pushed["thread"] == pushed["epoll"]
+        assert b'"count": 99' in pushed["thread"]
+        # heartbeats through the quiet period, same bytes
+        for name in ("thread", "epoll"):
+            buf = _read_until(streams[(name, "")],
+                              lambda b: b": hb\n\n" in b)
+            assert b": hb\n\n" in buf
+    finally:
+        for sk in socks:
+            sk.close()
+        _shutdown(servers)
+
+
+def test_differential_sse_admission_limit_503():
+    store = _mk_store()
+    servers = _pair(store, env={"HEATMAP_SSE_MAX_CLIENTS": "1"})
+    (_t, tp), (_e, ep) = servers
+    socks = []
+    try:
+        bodies = {}
+        for name, port in (("thread", tp), ("epoll", ep)):
+            sk = _sse_connect(port)
+            socks.append(sk)
+            _read_until(sk, lambda b: b"event: tiles" in b)
+            s, h, b = _get(port, "/api/tiles/stream?since=0")
+            bodies[name] = (s, _norm(h), b)
+        assert bodies["thread"] == bodies["epoll"]
+        assert bodies["thread"][0] == 503
+        assert b"sse client limit" in bodies["thread"][2]
+    finally:
+        for sk in socks:
+            sk.close()
+        _shutdown(servers)
+
+
+def test_differential_cq_stream_gone():
+    """/api/queries/stream on both cores: removing the standing query
+    ends the stream with the identical `gone` frame bytes."""
+    import urllib.request
+
+    store = _mk_store(3)
+    servers = _pair(store, env={"HEATMAP_CQ": "1",
+                                "HEATMAP_VIEW_POLL_MS": "30"},
+                    view_poll_ms=30)
+    (_t, tp), (_e, ep) = servers
+    socks = []
+    try:
+        tails = {}
+        for name, (httpd, port) in (("thread", servers[0]),
+                                    ("epoll", servers[1])):
+            lat, lon = hexgrid.cell_to_latlng(
+                hexgrid.latlng_to_cell(42.3, -71.05, 8))
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/queries",
+                data=json.dumps({
+                    "type": "geofence",
+                    "bbox": [lon - 5e-3, lat - 5e-3,
+                             lon + 5e-3, lat + 5e-3]}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            qid = json.loads(
+                urllib.request.urlopen(req, timeout=10).read())["id"]
+            sk = _sse_connect(port, f"/api/queries/stream?id={qid}")
+            socks.append(sk)
+            _read_until(sk, lambda b: b"retry: 3000" in b)
+            httpd.get_app().cq_engine.remove(qid)
+            buf = _read_until(sk, lambda b: b"event: gone" in b)
+            frames = [f for f in buf.split(b"\n\n") if f]
+            tails[name] = frames[-1]
+        assert tails["thread"] == tails["epoll"]
+        assert tails["thread"] == b"event: gone\ndata: {}"
+    finally:
+        for sk in socks:
+            sk.close()
+        _shutdown(servers)
+
+
+# ----------------------------------------------------------- chaos/edge
+def _epoll_server(store, env=None, **cfg_over):
+    e = dict(env or {})
+    e["HEATMAP_SERVE_CORE"] = "epoll"
+    cfg = load_config(e, serve_port=0, **cfg_over)
+    return start_background(store, cfg, port=0)
+
+
+def _fam(app, name):
+    for fam in app.serve_registry._families.values():
+        if fam.name == name:
+            return fam
+    raise AssertionError(f"no family {name}")
+
+
+def test_epoll_slow_reader_stall_visible_then_lagged_shed():
+    """A wedged subscriber's write stall climbs on
+    heatmap_sse_write_stall_seconds BEFORE the ring passes it and it
+    is shed with `event: lagged`; healthy peers see every frame."""
+    store = _mk_store()
+    httpd, _t, port = _epoll_server(
+        store, env={"HEATMAP_VIEW_POLL_MS": "30",
+                    "HEATMAP_SSE_QUEUE": "2",
+                    "HEATMAP_SSE_HEARTBEAT_S": "5",
+                    # long send timeout: the LAG shed must fire first
+                    "HEATMAP_SSE_SEND_TIMEOUT_S": "60"})
+    app = httpd.get_app()
+    lagged = _fam(app, "heatmap_sse_lagged_total")
+    slow = _sse_connect(port, rcvbuf=4096)
+    good = _sse_connect(port)
+    gbuf = b""
+    try:
+        _read_until(slow, lambda b: b.count(b"event: tiles") >= 1)
+        gbuf = _read_until(good, lambda b: b.count(b"event: tiles") >= 1)
+        # the slow client stops reading; big mutations wedge its
+        # socket, then overflow its ring window
+        now = dt.datetime.now(UTC).replace(microsecond=0)
+        ws = now - dt.timedelta(minutes=2)
+        batch = sorted({hexgrid.latlng_to_cell(
+            42.6 + (j % 20) * 8e-3, -71.3 + (j // 20) * 8e-3, 8)
+            for j in range(400)})
+        # enough big frames to overflow the wedged connection's
+        # in-flight socket capacity (~3 MB on this kernel) plus its
+        # ring window
+        stall_seen = 0.0
+        for m in range(30):
+            store.upsert_tiles([
+                TileDoc("bos", 8, c, ws, ws + dt.timedelta(minutes=5),
+                        count=m * 300 + j + 1, avg_speed_kmh=9.0,
+                        avg_lat=42.6, avg_lon=-71.3, ttl_minutes=45)
+                for j, c in enumerate(batch)])
+            gbuf += _read_until(
+                good, lambda b: b.count(b"event: tiles") >= 1)
+            stall_seen = max(stall_seen, app.fanout.max_write_stall_s())
+            if lagged.value >= 1 and stall_seen > 0:
+                break
+        deadline = time.time() + 15
+        while time.time() < deadline and lagged.value < 1:
+            stall_seen = max(stall_seen, app.fanout.max_write_stall_s())
+            time.sleep(0.05)
+        assert lagged.value >= 1
+        # PR 16 semantics preserved: the wedge was VISIBLE as an
+        # in-flight write stall before the shed fired
+        assert stall_seen > 0.0
+        sbuf = b""
+        slow.settimeout(15)
+        while True:
+            chunk = slow.recv(65536)
+            if not chunk:
+                break
+            sbuf += chunk
+        assert sbuf.rstrip().endswith(b"event: lagged\ndata: {}")
+    finally:
+        slow.close()
+        good.close()
+        httpd.shutdown()
+
+
+def test_epoll_midwrite_disconnect_releases_slot_and_registration():
+    """An abrupt client RST mid-stream releases the admission slot and
+    the fan-out registration (no leaked cursor, gauge back to 0)."""
+    import struct
+
+    store = _mk_store()
+    httpd, _t, port = _epoll_server(
+        store, env={"HEATMAP_VIEW_POLL_MS": "30",
+                    "HEATMAP_SSE_HEARTBEAT_S": "0.2"})
+    app = httpd.get_app()
+    gauge = _fam(app, "heatmap_serve_sse_clients")
+    sk = _sse_connect(port)
+    try:
+        _read_until(sk, lambda b: b"event: tiles" in b)
+        assert gauge.value == 1
+        assert len(app.fanout.sub_stats()) == 1
+        # RST instead of FIN: the hard-kill disconnect
+        sk.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                      struct.pack("ii", 1, 0))
+    finally:
+        sk.close()
+    deadline = time.time() + 15
+    while time.time() < deadline and (gauge.value != 0
+                                      or app.fanout.sub_stats()):
+        time.sleep(0.05)
+    try:
+        assert gauge.value == 0
+        assert app.fanout.sub_stats() == []
+    finally:
+        httpd.shutdown()
+
+
+def test_epoll_partial_frame_write_resumes_no_splice():
+    """A frame larger than the socket buffers drains across many
+    partial writes interleaved with heartbeat opportunities — the
+    reassembled stream parses as clean, unspliced SSE frames."""
+    store = _mk_store()
+    httpd, _t, port = _epoll_server(
+        store, env={"HEATMAP_VIEW_POLL_MS": "30",
+                    "HEATMAP_SSE_HEARTBEAT_S": "0.1"})
+    sk = _sse_connect(port, rcvbuf=4096)
+    try:
+        _read_until(sk, lambda b: b.count(b"event: tiles") >= 1)
+        now = dt.datetime.now(UTC).replace(microsecond=0)
+        ws = now - dt.timedelta(minutes=2)
+        batch = sorted({hexgrid.latlng_to_cell(
+            42.6 + (j % 25) * 8e-3, -71.3 + (j // 25) * 8e-3, 8)
+            for j in range(300)})
+        store.upsert_tiles([
+            TileDoc("bos", 8, c, ws, ws + dt.timedelta(minutes=5),
+                    count=j + 1, avg_speed_kmh=9.0, avg_lat=42.6,
+                    avg_lon=-71.3, ttl_minutes=45)
+            for j, c in enumerate(batch)])
+        # drain SLOWLY in small chunks so the loop takes many
+        # EVENT_WRITE rounds (partial sends) to push the big frame
+        buf = b""
+        deadline = time.time() + 30
+        while ((buf.count(b"event: tiles") < 1
+                or not buf.endswith(b"\n\n"))
+               and time.time() < deadline):
+            chunk = sk.recv(2048)
+            if not chunk:
+                break
+            buf += chunk
+            time.sleep(0.002)
+        frames = [f for f in buf.split(b"\n\n")
+                  if f.startswith(b"event: tiles")]
+        assert len(frames) >= 1
+        big = max(frames, key=len)
+        assert len(big) > 20000  # really crossed buffer boundaries
+        # an offset bug would splice heartbeat/next-frame bytes into
+        # the JSON payload: it must still parse, with every cell
+        payload = json.loads(
+            big.split(b"data: ", 1)[1].decode("utf-8"))
+        assert len(payload["features"]) == len(batch)
+    finally:
+        sk.close()
+        httpd.shutdown()
+
+
+def test_epoll_fanout_memory_o_channels_not_o_subscribers():
+    """ISSUE 17 acceptance: N subscribers on ONE channel share one
+    frame ring — retained frames stay <= HEATMAP_SSE_QUEUE while each
+    subscriber's pending state is a (cursor, offset) pair, not a
+    frame-copy queue."""
+    n_subs = 12
+    depth = 4
+    store = _mk_store()
+    httpd, _t, port = _epoll_server(
+        store, env={"HEATMAP_VIEW_POLL_MS": "30",
+                    "HEATMAP_SSE_QUEUE": str(depth),
+                    "HEATMAP_SSE_HEARTBEAT_S": "5",
+                    "HEATMAP_SSE_MAX_CLIENTS": "64"})
+    app = httpd.get_app()
+    retained = _fam(app, "heatmap_sse_fanout_retained_frames")
+    socks = []
+    try:
+        for _ in range(n_subs):
+            sk = _sse_connect(port)
+            socks.append(sk)
+            _read_until(sk, lambda b: b.count(b"event: tiles") >= 1)
+        now = dt.datetime.now(UTC).replace(microsecond=0)
+        ws = now - dt.timedelta(minutes=2)
+        c0 = hexgrid.latlng_to_cell(42.9, -71.6, 8)
+        for m in range(depth * 3):
+            store.upsert_tiles([
+                TileDoc("bos", 8, c0, ws, ws + dt.timedelta(minutes=5),
+                        count=m + 1, avg_speed_kmh=9.0, avg_lat=42.9,
+                        avg_lon=-71.6, ttl_minutes=45)])
+            for sk in socks:
+                _read_until(sk,
+                            lambda b: b.count(b"event: tiles") >= 1)
+        # ONE channel, N cursors: the ring never holds more than depth
+        # frames no matter the subscriber count or broadcast count
+        assert retained.value <= depth
+        chans = list(app.fanout._channels.values())
+        assert len(chans) == 1
+        subs = chans[0].ev_subs
+        assert len(subs) == n_subs
+        for sub in subs:
+            assert not hasattr(sub, "q")  # no per-subscriber queue
+            assert isinstance(sub.cursor, int)
+            assert isinstance(sub.offset, int)
+        # all cursors share the SAME ring frame objects (zero-copy):
+        # every subscriber fully drained, so pending is 0 for each
+        head = chans[0].next_idx
+        for sub in subs:
+            assert head - sub.cursor <= depth
+    finally:
+        for sk in socks:
+            sk.close()
+        httpd.shutdown()
+
+
+def test_serve_core_config_validation():
+    with pytest.raises(ValueError):
+        load_config({"HEATMAP_SERVE_CORE": "gevent"})
+    with pytest.raises(ValueError):
+        load_config({"HEATMAP_SERVE_LOOP_HANDLERS": "0"})
+    cfg = load_config({"HEATMAP_SERVE_CORE": "epoll",
+                       "HEATMAP_SERVE_LOOP_HANDLERS": "3"})
+    assert cfg.serve_core == "epoll"
+    assert cfg.serve_loop_handlers == 3
+
+
+def test_epoll_core_gauge_and_loop_metrics():
+    store = _mk_store()
+    httpd, _t, port = _epoll_server(store)
+    app = httpd.get_app()
+    try:
+        _get(port, "/api/tiles/latest")
+        fam = _fam(app, "heatmap_serve_core")
+        assert fam.labels(core="epoll").value == 1
+        conns = _fam(app, "heatmap_serve_open_connections")
+        assert conns.value >= 0
+        li = _fam(app, "heatmap_serve_loop_iteration_seconds")
+        deadline = time.time() + 5
+        while time.time() < deadline and li.count == 0:
+            time.sleep(0.05)
+        assert li.count > 0
+    finally:
+        httpd.shutdown()

@@ -879,11 +879,14 @@ def run_soak_fleet(n_tiles: int, serve_workers: int, clients: int,
                    sse_n: int, mutate_ms: float, fmt: str,
                    audit: bool = True, json_ref: bool = True,
                    ref_duration_s: float | None = None,
-                   mutate_n: int = 32) -> dict:
+                   mutate_n: int = 32,
+                   serve_core: str = "thread") -> dict:
     """The multi-process soak: subprocess serve workers on one
     SO_REUSEPORT port follow the parent's delta-log feed; subprocess
     client drivers poll them.  Returns the artifact dict (soak block +
-    json_reference + wire + audit stamps)."""
+    json_reference + wire + audit stamps).  ``serve_core`` selects the
+    workers' serve loop (HEATMAP_SERVE_CORE) and is stamped into the
+    soak block so check_bench_regress refuses cross-core pairs."""
     import subprocess
     import tempfile
 
@@ -924,6 +927,7 @@ def run_soak_fleet(n_tiles: int, serve_workers: int, clients: int,
         "HEATMAP_FLEET_PUBLISH_S": "1",
         "HEATMAP_DELIVERY": "1",
         "HEATMAP_AUDIT": "1" if audit else "0",
+        "HEATMAP_SERVE_CORE": serve_core,
     })
     fleet = subprocess.Popen(
         [sys.executable, "-m", "heatmap_tpu.serve",
@@ -1031,6 +1035,7 @@ def run_soak_fleet(n_tiles: int, serve_workers: int, clients: int,
         lat_ref = (ref or {}).get("lat") or []
         out_soak = {
             "serve_workers": serve_workers,
+            "serve_core": serve_core,
             "wire_format": fmt,
             "clients": clients,
             "client_procs": client_procs,
@@ -1134,6 +1139,16 @@ def main() -> None:
                          "--workers N on one SO_REUSEPORT port)")
     ap.add_argument("--fmt", choices=("json", "bin"), default="json",
                     help="wire format the soak clients negotiate")
+    ap.add_argument("--serve-core", choices=("thread", "epoll"),
+                    default=os.environ.get("HEATMAP_SERVE_CORE",
+                                           "thread"),
+                    help="serve loop core the fleet workers run "
+                         "(HEATMAP_SERVE_CORE); stamped into the "
+                         "artifact so regression gates refuse "
+                         "cross-core pairs")
+    ap.add_argument("--no-thread-ref", action="store_true",
+                    help="skip the thread-core reference leg of a "
+                         "--serve-core epoll fleet soak")
     ap.add_argument("--client-procs", type=int, default=4,
                     help="client driver subprocesses (fleet soak)")
     ap.add_argument("--no-json-ref", action="store_true",
@@ -1151,7 +1166,28 @@ def main() -> None:
             args.client_procs, threads, args.sse,
             mutate_ms=args.mutate_ms, fmt=args.fmt,
             audit=not args.no_audit, json_ref=not args.no_json_ref,
-            mutate_n=args.mutate_n)
+            mutate_n=args.mutate_n, serve_core=args.serve_core)
+        if args.serve_core != "thread" and not args.no_thread_ref:
+            # the thread-core reference leg: SAME schedule (clients,
+            # procs, threads, fmt, mutation cadence, duration) against
+            # a wsgiref-core fleet, so the artifact carries its own
+            # same-host apples-to-apples pair AND regression gates can
+            # fall back to it when the banked baseline ran the other
+            # core.  Settle first: the main leg just tore down tens of
+            # thousands of close-per-request connections, and the
+            # reference leg must measure the thread core, not the
+            # TIME_WAIT port-table pressure the prior leg left behind
+            # (measured: back-to-back legs more than doubled the
+            # reference p99 on a 1-core host; settled legs reproduce
+            # the standalone number)
+            time.sleep(60.0)
+            ref = run_soak_fleet(
+                args.n_tiles, args.serve_workers, clients,
+                args.duration, args.client_procs, threads, args.sse,
+                mutate_ms=args.mutate_ms, fmt=args.fmt,
+                audit=False, json_ref=False,
+                mutate_n=args.mutate_n, serve_core="thread")
+            out["thread_reference"] = ref["soak"]
         print(json.dumps(out))
         return
     if args.soak:

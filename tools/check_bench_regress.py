@@ -266,7 +266,11 @@ def serve_metrics(path: str) -> tuple | None:
     fleet soaks); ``delivery`` is the ISSUE 16 delivered-age stamp
     ({enabled, age_p50_ms, age_p99_ms, worst_stage}); pre-stamp
     artifacts carry none of them and stay comparable, like every other
-    stamp."""
+    stamp.  The ISSUE 17 extras ride at the end: ``serve_core`` (the
+    soak's HEATMAP_SERVE_CORE stamp — every pre-stamp artifact ran
+    wsgiref, so missing means ``"thread"``) and the artifact's
+    ``thread_reference`` leg (same-schedule wsgiref run banked beside
+    an epoll soak) for the cross-core fallback."""
     try:
         with open(path, encoding="utf-8") as fh:
             art = json.load(fh)
@@ -291,11 +295,17 @@ def serve_metrics(path: str) -> tuple | None:
     delivery = art.get("delivery")
     if not isinstance(delivery, dict) or "enabled" not in delivery:
         delivery = None
+    core = (art.get("soak") or {}).get("serve_core")
+    thread_ref = art.get("thread_reference")
+    if not isinstance(thread_ref, dict):
+        thread_ref = None
     return (float(p99), float(wire),
             int(replicas) if isinstance(replicas, int) else None,
             str(fmt) if isinstance(fmt, str) else None,
             int(workers) if isinstance(workers, int) else None,
-            delivery)
+            delivery,
+            str(core) if isinstance(core, str) else "thread",
+            thread_ref)
 
 
 def compare_serve(dir_path: str, threshold: float) -> int:
@@ -323,8 +333,9 @@ def compare_serve(dir_path: str, threshold: float) -> int:
     (r_prev, _p_prev, m_prev), (r_new, _p_new, m_new) = \
         usable[-2], usable[-1]
     (p99_prev, wire_prev, rep_prev, fmt_prev, wrk_prev,
-     delv_prev) = m_prev
-    (p99_new, wire_new, rep_new, fmt_new, wrk_new, delv_new) = m_new
+     delv_prev, core_prev, _tref_prev) = m_prev
+    (p99_new, wire_new, rep_new, fmt_new, wrk_new, delv_new,
+     core_new, tref_new) = m_new
     if rep_prev is not None and rep_new is not None \
             and rep_prev != rep_new:
         print(f"FAIL: replica-count mismatch — serve r{r_prev:02d} ran "
@@ -351,6 +362,36 @@ def compare_serve(dir_path: str, threshold: float) -> int:
               f"its per-worker regression) — re-run the soak at the "
               f"same --serve-workers", file=sys.stderr)
         return 1
+    if core_prev != core_new:
+        # an epoll soak's p99 cannot ratchet against a wsgiref
+        # baseline (or vice versa) — different loop, different
+        # experiment.  The escape hatch is the newer artifact's
+        # same-schedule thread_reference leg: when the baseline is
+        # thread-core and the new artifact banked one, ratchet
+        # thread-vs-thread instead of refusing.
+        tr = tref_new if core_prev == "thread" else None
+        tr_p99 = (tr or {}).get("p99_ms")
+        tr_wire = (tr or {}).get("bytes_sent_wire")
+        if isinstance(tr_p99, (int, float)) and tr_p99 > 0 \
+                and isinstance(tr_wire, (int, float)):
+            print(f"note: serve-core mismatch (r{r_prev:02d} ran "
+                  f"{core_prev!r}, r{r_new:02d} ran {core_new!r}) — "
+                  f"falling back to r{r_new:02d}'s thread_reference "
+                  f"leg for a matching-core pair")
+            p99_new, wire_new = float(tr_p99), float(tr_wire)
+            # the reference leg carries no delivery stamp: skip the
+            # delivered-age ratchet rather than compare across cores
+            delv_new = None
+        else:
+            print(f"FAIL: serve-core mismatch — serve r{r_prev:02d} "
+                  f"ran the {core_prev!r} core but r{r_new:02d} ran "
+                  f"{core_new!r}, and r{r_new:02d} carries no "
+                  f"thread_reference leg to fall back to; an event-"
+                  f"loop core's latency cannot stand in for the "
+                  f"thread core's (or mask its regression) — re-run "
+                  f"with the same --serve-core or bank the reference "
+                  f"leg", file=sys.stderr)
+            return 1
     if delv_prev is not None and delv_new is not None \
             and bool(delv_prev.get("enabled")) \
             != bool(delv_new.get("enabled")):

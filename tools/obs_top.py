@@ -179,6 +179,14 @@ def render_frame(m: dict, prev: dict | None, dt: float,
         f"p99 {fmt(p99, ' s', digits=2)}   (event ts -> sink ack)")
     lines.append(
         f"  serve     {fmt(_val(m, 'heatmap_serve_freshness_seconds'), ' s', digits=2)} behind at last /tiles render")
+    # async serve core (ISSUE 17, serve/evloop.py): which loop the
+    # process runs (HEATMAP_SERVE_CORE), open event-loop connections,
+    # the write backlog the loop is draining, and the loop-iteration
+    # p99 — the row that says the single-thread core is keeping up.
+    # Absent entirely on builds without the core gauge.
+    crow = _serve_core_row(m, prev)
+    if crow is not None:
+        lines.append(crow)
     lines.append(
         f"  ring      depth {fmt(_val(m, 'heatmap_emit_ring_pending'), digits=0)}   "
         f"residency p50 {fmt(hq('heatmap_emit_ring_residency_seconds', .5), ' ms', 1e3)}")
@@ -262,6 +270,39 @@ def render_frame(m: dict, prev: dict | None, dt: float,
         lines.append(f"  SLO       {status.upper()}"
                      + (f"   failing: {', '.join(bad)}" if bad else ""))
     return "\n".join(lines) + "\n"
+
+
+def _serve_core(m: dict | None) -> str | None:
+    """The serve loop this process runs: the ``core=`` label of the
+    set ``heatmap_serve_core`` sample ("thread" | "epoll"); None when
+    the family is absent (pre-ISSUE-17 build or no serve tier)."""
+    for labels, v in ((m or {}).get("heatmap_serve_core") or {}).items():
+        if v:
+            return _label_of(labels, "core")
+    return None
+
+
+def _serve_core_row(m: dict, prev: dict | None) -> str | None:
+    """The serve-core dashboard row, or None when no core gauge is
+    exported."""
+    core = _serve_core(m)
+    if core is None:
+        return None
+    cur = m.get("heatmap_serve_loop_iteration_seconds_bucket")
+    p99 = None
+    if cur:
+        pb = (prev or {}).get(
+            "heatmap_serve_loop_iteration_seconds_bucket")
+        p99 = hist_quantile(cur, pb, 0.99)
+
+    def fmt(v, unit="", scale=1.0, digits=0):
+        return "--" if v is None else f"{v * scale:,.{digits}f}{unit}"
+
+    return (f"  core      {core:<12}"
+            f"conns {fmt(_val(m, 'heatmap_serve_open_connections'))}   "
+            f"backlog "
+            f"{fmt(_val(m, 'heatmap_serve_write_backlog'))}   "
+            f"loop p99 {fmt(p99, ' ms', 1e3, 1)}")
 
 
 def _delivery_row(m: dict, prev: dict | None) -> str | None:
@@ -551,9 +592,17 @@ def render_fleet_frame(m: dict, prev: dict | None, dt: float,
         sse = _by_proc(m, "heatmap_serve_sse_clients")
         n304 = _by_proc_sum(m, "heatmap_serve_304_total")
         renders = _by_proc_sum(m, "heatmap_serve_renders_total")
+        # per-member serve core (ISSUE 17): the core= label of each
+        # member's set heatmap_serve_core sample
+        cores: dict = {}
+        for labels, v in ((m or {}).get("heatmap_serve_core")
+                          or {}).items():
+            p = _label_of(labels, "proc")
+            if p is not None and v:
+                cores[p] = _label_of(labels, "core") or "?"
         lines.append("")
-        lines.append(f"  {'serve':<14}{'role':<8}{'seq lag':>9}"
-                     f"{'sse':>6}{'304 %':>9}  state")
+        lines.append(f"  {'serve':<14}{'role':<8}{'core':>8}"
+                     f"{'seq lag':>9}{'sse':>6}{'304 %':>9}  state")
         for tag in serve_tags:
             r304 = None
             total = n304.get(tag, 0.0) + renders.get(tag, 0.0)
@@ -561,6 +610,7 @@ def render_fleet_frame(m: dict, prev: dict | None, dt: float,
                 r304 = n304.get(tag, 0.0) / total
             lines.append(
                 f"  {tag:<14}{roles.get(tag, '?'):<8}"
+                f"{cores.get(tag, '--'):>8}"
                 f"{fmt(seq_lag.get(tag), digits=0):>9}"
                 f"{fmt(sse.get(tag), digits=0):>6}"
                 f"{fmt(r304, ' %', 100.0):>9}"
