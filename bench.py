@@ -775,9 +775,15 @@ def main() -> dict:
     # repl block (replica count + max seq lag) rides along when a
     # replicated serve fleet is attached to the channel
     from heatmap_tpu.obs.fleet import fleet_stamp, repl_stamp
+    from heatmap_tpu.obs.slo import slo_stamp
 
     result.update(fleet_stamp(eps))
     result.update(repl_stamp())
+    # telemetry-history provenance (obs.slo): budget consumed, worst
+    # burn-rate multiple, alerts fired during the round.  A number
+    # earned while the pipeline was violating its own SLOs must never
+    # become the bar — check_bench_regress refuses such artifacts.
+    result.update(slo_stamp())
     if dev.platform == "cpu":
         result.update(_cpu_headline_bank(
             eps, info, res=res, pipeline=pipeline, impl=impl, h3=h3,

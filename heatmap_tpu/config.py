@@ -404,6 +404,50 @@ class Config:
                                        # (coarse parents + boundary
                                        # sliver); larger regions are
                                        # refused at registration
+    tsdb: bool = False                 # HEATMAP_TSDB: the telemetry
+                                       # time machine (obs/tsdb.py) —
+                                       # a sampler thread records this
+                                       # member's /metrics exposition +
+                                       # /healthz verdict into fixed-
+                                       # step history rings, persisted
+                                       # as append-only blocks under
+                                       # HEATMAP_TSDB_DIR, and the SLO
+                                       # error-budget burn-rate engine
+                                       # (obs/slo.py) evaluates on each
+                                       # scrape.  0 (the default)
+                                       # disables: no thread, no
+                                       # families, no behavior change.
+    tsdb_dir: str = ""                 # HEATMAP_TSDB_DIR: per-member
+                                       # telemetry-history directory
+                                       # (shared across the fleet so
+                                       # /fleet/timeline can stitch
+                                       # members).  Empty with tsdb=1:
+                                       # rings + SLO engine run, but
+                                       # nothing persists and the
+                                       # retrospective endpoints 503.
+    tsdb_scrape_s: float = 5.0         # HEATMAP_TSDB_SCRAPE_S:
+                                       # history scrape cadence — also
+                                       # the SLO engine's evaluation
+                                       # tick and budget-spend unit
+    tsdb_retain_s: float = 259200.0    # HEATMAP_TSDB_RETAIN_S: history
+                                       # retention (3 days); blocks
+                                       # past it are deleted
+    tsdb_hot_s: float = 3600.0         # HEATMAP_TSDB_HOT_S: raw-
+                                       # resolution span; older blocks
+                                       # are merged into a coarser
+                                       # downsampled tier
+    tsdb_flush_s: float = 60.0         # HEATMAP_TSDB_FLUSH_S: block
+                                       # persistence cadence (an SLO
+                                       # alert flushes immediately)
+    slo_budget_frac: float = 0.01      # HEATMAP_SLO_BUDGET_FRAC:
+                                       # error-budget fraction — the
+                                       # share of scrape ticks allowed
+                                       # to breach an SLO threshold
+                                       # inside the budget window
+    slo_budget_window_s: float = 86400.0  # HEATMAP_SLO_BUDGET_WINDOW_S:
+                                       # rolling error-budget window;
+                                       # the canonical 30-day burn-rate
+                                       # alert windows scale to it
     shard_oversample: int = 0          # HEATMAP_SHARD_OVERSAMPLE: how
                                        # many feed-batches worth of
                                        # stream rows a shard polls per
@@ -521,6 +565,19 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
                               Config.hist_compact_s),
         hist_backfill=e.get("HEATMAP_HIST_BACKFILL", "1")
         not in ("0", "false", ""),
+        tsdb=e.get("HEATMAP_TSDB", "0") not in ("0", "false", ""),
+        tsdb_dir=e.get("HEATMAP_TSDB_DIR", Config.tsdb_dir),
+        tsdb_scrape_s=_float(e, "HEATMAP_TSDB_SCRAPE_S",
+                             Config.tsdb_scrape_s),
+        tsdb_retain_s=_float(e, "HEATMAP_TSDB_RETAIN_S",
+                             Config.tsdb_retain_s),
+        tsdb_hot_s=_float(e, "HEATMAP_TSDB_HOT_S", Config.tsdb_hot_s),
+        tsdb_flush_s=_float(e, "HEATMAP_TSDB_FLUSH_S",
+                            Config.tsdb_flush_s),
+        slo_budget_frac=_float(e, "HEATMAP_SLO_BUDGET_FRAC",
+                               Config.slo_budget_frac),
+        slo_budget_window_s=_float(e, "HEATMAP_SLO_BUDGET_WINDOW_S",
+                                   Config.slo_budget_window_s),
         govern=e.get("HEATMAP_GOVERN", "0") not in ("0", "false", ""),
         govern_interval_s=_float(e, "HEATMAP_GOVERN_INTERVAL_S",
                                  Config.govern_interval_s),
@@ -710,4 +767,25 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         raise ValueError(
             f"HEATMAP_AUDIT_SETTLE_S must be > 0, "
             f"got {cfg.audit_settle_s}")
+    if cfg.tsdb_scrape_s <= 0:
+        raise ValueError(
+            f"HEATMAP_TSDB_SCRAPE_S must be > 0, "
+            f"got {cfg.tsdb_scrape_s}")
+    if cfg.tsdb_flush_s < 0:
+        raise ValueError(
+            f"HEATMAP_TSDB_FLUSH_S must be >= 0, "
+            f"got {cfg.tsdb_flush_s}")
+    if cfg.tsdb_retain_s < cfg.tsdb_hot_s:
+        raise ValueError(
+            f"HEATMAP_TSDB_RETAIN_S ({cfg.tsdb_retain_s}) below "
+            f"HEATMAP_TSDB_HOT_S ({cfg.tsdb_hot_s}) — retention "
+            f"cannot be shorter than the raw tier it feeds")
+    if not 0 < cfg.slo_budget_frac <= 1:
+        raise ValueError(
+            f"HEATMAP_SLO_BUDGET_FRAC must be in (0, 1], "
+            f"got {cfg.slo_budget_frac}")
+    if cfg.slo_budget_window_s <= 0:
+        raise ValueError(
+            f"HEATMAP_SLO_BUDGET_WINDOW_S must be > 0, "
+            f"got {cfg.slo_budget_window_s}")
     return cfg

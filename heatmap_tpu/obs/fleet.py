@@ -206,6 +206,28 @@ class FleetAggregator:
         self.clock = clock
         self._lock = threading.Lock()
         self._seen: set = set()
+        # per-(member, series, labels) monotonic-counter state: a member
+        # restart resets its cumulative counters to zero, and a naive
+        # fleet sum would DROP by the lost total — poisoning every rate
+        # computed off the rollup.  (last_raw, carried_base): the rollup
+        # reports base + raw, and a reset folds the pre-restart total
+        # into the base so the fleet sum never goes backwards.
+        self._ctr_state: dict = {}
+
+    def _monotonic(self, tag: str, series: str, labels: str,
+                   v: float) -> float:
+        """Reset-aware cumulative value for one member counter series:
+        identity while the counter grows, resumes from the reset point
+        (prior total carried forward) after a member restart."""
+        k = (tag, series, labels)
+        with self._lock:
+            prev, base = self._ctr_state.get(k, (v, 0.0))
+            if v < prev:  # member restarted: counter came back at ~0
+                base += prev
+            self._ctr_state[k] = (v, base)
+            if len(self._ctr_state) > 65536:  # bounded against churn
+                self._ctr_state.pop(next(iter(self._ctr_state)))
+        return base + v
 
     # ------------------------------------------------------------ collect
     def collect(self) -> tuple[dict, dict]:
@@ -315,7 +337,9 @@ class FleetAggregator:
                 # ---- rollups ----------------------------------------
                 key = (fam, labels)
                 if ftype == "counter":
-                    counter_sums[key] = counter_sums.get(key, 0.0) + v
+                    counter_sums[key] = (counter_sums.get(key, 0.0)
+                                         + self._monotonic(
+                                             tag, series, labels, v))
                 elif ftype == "gauge":
                     if fam in _SUM_GAUGES:
                         gauge_sums[key] = gauge_sums.get(key, 0.0) + v

@@ -384,6 +384,18 @@ def healthz_payload(runtime, extra_checks=None) -> tuple[dict, bool]:
             degraded |= ec_degraded
         except Exception:  # noqa: BLE001 - a probe bug must not 500 /healthz
             log.exception("serve-tier healthz checks failed")
+    # SLO burn-rate engine (obs.slo, HEATMAP_TSDB=1): a firing alert
+    # degrades as "error budget burning fast"; a bad latest sample
+    # without a tripped rule surfaces as a warn ("momentary blip") —
+    # the duration distinction the instant thresholds below cannot make
+    slo_eng = getattr(runtime, "slo_engine", None)
+    if slo_eng is not None:
+        try:
+            for name, check in slo_eng.healthz_checks().items():
+                checks[name] = check
+                degraded |= not check.get("ok", True)
+        except Exception:  # noqa: BLE001 - never 500 /healthz
+            log.exception("slo engine healthz checks failed")
     if runtime is not None:
         m = runtime.metrics
         if m.batch_latency.count:
@@ -1360,6 +1372,13 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 _slo("HEATMAP_SLO_CQ_LAG_S", 5.0))
             checks.update(cc)
             degraded |= c_degraded
+        if serve_slo is not None and runtime is None:
+            # serve-only SLO burn-rate checks (a runtime-attached
+            # process merges its engine inside healthz_payload): a
+            # firing burn alert degrades, a blip only warns
+            for name, check in serve_slo.healthz_checks().items():
+                checks[name] = check
+                degraded |= not check.get("ok", True)
         if follower is not None:
             # delivered-freshness SLO (ISSUE 16): the age a subscriber
             # socket actually receives, not just request latency.
@@ -1379,6 +1398,53 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
 
     healthz = functools.partial(healthz_payload, runtime,
                                 extra_checks=_serve_checks)
+
+    # ---- telemetry time machine (obs.tsdb / obs.slo, ISSUE 18) --------
+    # A runtime-attached app rides the runtime's recorder; a serve-only
+    # worker under HEATMAP_TSDB=1 runs its own (scraping the SAME text
+    # /metrics serves, tagged serve<pid>) so replicas leave retained
+    # series + SLO state behind for the fleet timeline too.  The
+    # timeline endpoints below only need the shared directory — they
+    # answer from retained blocks even for members that are gone.
+    from heatmap_tpu.obs import tsdb as tsdbmod
+
+    tsdb_on = (bool(getattr(cfg, "tsdb", False)) if cfg is not None
+               else tsdbmod.tsdb_enabled())
+    tsdb_dir = (getattr(cfg, "tsdb_dir", "") if cfg is not None
+                else os.environ.get(tsdbmod.ENV_DIR, ""))
+    serve_tsdb = None
+    serve_slo = None
+    if tsdb_on and runtime is None:
+        from heatmap_tpu.obs import ENV_CHANNEL as _ENV_CHAN
+        from heatmap_tpu.obs.slo import SloEngine
+        from heatmap_tpu.obs.xproc import ENV_FLEET_TAG
+
+        _tsdb_tag = (os.environ.get(ENV_FLEET_TAG)
+                     or f"serve{os.getpid()}")
+        serve_tsdb = tsdbmod.TsdbRecorder(
+            lambda: _metrics_text(None, serve_registry=serve_reg),
+            tag=_tsdb_tag, dir_path=tsdb_dir or None,
+            healthz_fn=lambda: healthz()[0],
+            registry=serve_reg,
+            scrape_s=getattr(cfg, "tsdb_scrape_s", None) if cfg
+            else None,
+            retain_s=getattr(cfg, "tsdb_retain_s", None) if cfg
+            else None,
+            hot_s=getattr(cfg, "tsdb_hot_s", None) if cfg else None,
+            flush_s=getattr(cfg, "tsdb_flush_s", None) if cfg
+            else None)
+        serve_slo = SloEngine(
+            serve_tsdb, registry=serve_reg, tag=_tsdb_tag,
+            budget_frac=getattr(cfg, "slo_budget_frac", None) if cfg
+            else None,
+            budget_window_s=(getattr(cfg, "slo_budget_window_s", None)
+                             if cfg else None),
+            channel_path=os.environ.get(_ENV_CHAN),
+            flightrec=flightrec)
+        serve_tsdb.start()
+    elif runtime is not None:
+        serve_tsdb = getattr(runtime, "tsdb", None)
+        serve_slo = getattr(runtime, "slo_engine", None)
 
     def _tiles_view(grid: str | None):
         """The view to serve tile reads from, refreshed for serve-only
@@ -2464,6 +2530,52 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                         "(HEATMAP_SUPERVISOR_CHANNEL)")
                 body = json.dumps(agg.audit())
                 ctype = "application/json"
+            elif path == "/debug/timeline":
+                # retrospective incident timeline (obs.tsdb): healthz
+                # transitions, SLO alerts, governor adjustments, audit
+                # mismatches, shed/lagged bursts, retraces and flight
+                # records merged in time order, reconstructed from
+                # this member's retained telemetry-history blocks
+                if not (tsdb_on and tsdb_dir):
+                    return _unavailable(
+                        "the telemetry time machine needs "
+                        "HEATMAP_TSDB=1 and HEATMAP_TSDB_DIR")
+                params = _qs_params(environ.get("QUERY_STRING", ""))
+                since_s = _qs_int(params, "since", 3600, 7 * 86400)
+                now = time.time()
+                tag = (serve_tsdb.tag if serve_tsdb is not None
+                       else None)
+                reader = tsdbmod.TsdbReader(tsdb_dir)
+                if tag is None or tag not in reader.members():
+                    members = reader.members()
+                    tag = members[0] if members else None
+                entries = (tsdbmod.member_timeline(
+                    reader, tag, since=now - since_s,
+                    flightrec_dir=(getattr(cfg, "flightrec_dir", "")
+                                   if cfg else "") or None)
+                    if tag is not None else [])
+                body = json.dumps({"member": tag, "since_s": since_s,
+                                   "entries": entries})
+                ctype = "application/json"
+            elif path == "/fleet/timeline":
+                # every member's timeline stitched (obs.tsdb), naming
+                # which member degraded FIRST — answered from retained
+                # blocks, so it reconstructs incidents for members that
+                # are already gone (the SIGKILL chaos contract)
+                if not (tsdb_on and tsdb_dir):
+                    return _unavailable(
+                        "the telemetry time machine needs "
+                        "HEATMAP_TSDB=1 and HEATMAP_TSDB_DIR")
+                params = _qs_params(environ.get("QUERY_STRING", ""))
+                since_s = _qs_int(params, "since", 3600, 7 * 86400)
+                payload = tsdbmod.fleet_timeline(
+                    tsdbmod.TsdbReader(tsdb_dir),
+                    since=time.time() - since_s,
+                    flightrec_dir=(getattr(cfg, "flightrec_dir", "")
+                                   if cfg else "") or None)
+                payload["since_s"] = since_s
+                body = json.dumps(payload)
+                ctype = "application/json"
             elif path == "/debug/audit":
                 # this process's integrity observatory: per-stage
                 # ledger counts, boundary residuals (worst/leaking
@@ -2797,6 +2909,10 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
     app.delivery = delivery
     app.span_ring = span_ring
     app.fanout = fanout
+    # telemetry time machine handles (tests + ServeFleetMember): the
+    # recorder/engine this worker runs (or the runtime's, attached)
+    app.tsdb = serve_tsdb
+    app.slo_engine = serve_slo
     # the event-loop core reads these (loop metrics + fan-out wake)
     app.serve_stats = stats
 
@@ -2805,6 +2921,15 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
             cq_engine.close()
         if follower is not None:
             follower.stop()
+        if serve_tsdb is not None and runtime is None:
+            # serve-only recorder: final scrape + flush so the last
+            # window reaches the retained blocks (a runtime-attached
+            # recorder is stopped by the runtime's own close())
+            try:
+                serve_tsdb.scrape_once()
+            except Exception:  # noqa: BLE001
+                pass
+            serve_tsdb.stop()
 
     app.close_repl = close_repl
     return app

@@ -928,6 +928,48 @@ class MicroBatchRuntime:
             # the incident correlates; the tag names this member
             self.slo_watchdog = SloWatchdog(self, tag=self._fresh_tag)
             self.slo_watchdog.start()
+        # Telemetry time machine (obs.tsdb) + SLO burn-rate engine
+        # (obs.slo): a sampler thread records this member's exposition
+        # and /healthz verdict into history rings (persisted under
+        # HEATMAP_TSDB_DIR) and evaluates error-budget burn on every
+        # scrape.  Knob-off, neither module is imported and no family
+        # registers (the differential test pins the exposition
+        # byte-identical).
+        self.tsdb = None
+        self.slo_engine = None
+        if cfg.tsdb:
+            from heatmap_tpu.obs import ENV_CHANNEL
+            from heatmap_tpu.obs.slo import SloEngine
+            from heatmap_tpu.obs.tsdb import TsdbRecorder
+
+            def _tsdb_scrape() -> str:
+                extra = dict(self.writer.counters)
+                extra.pop("sink_retries", None)
+                extra.update(getattr(self.source, "counters", None)
+                             or {})
+                return self.metrics.expose_text(extra_counters=extra)
+
+            def _tsdb_healthz() -> dict:
+                from heatmap_tpu.serve.api import healthz_payload
+
+                return healthz_payload(self)[0]
+
+            self.tsdb = TsdbRecorder(
+                _tsdb_scrape, tag=self._fresh_tag,
+                dir_path=cfg.tsdb_dir or None,
+                healthz_fn=_tsdb_healthz,
+                registry=self.metrics.registry,
+                scrape_s=cfg.tsdb_scrape_s,
+                retain_s=cfg.tsdb_retain_s, hot_s=cfg.tsdb_hot_s,
+                flush_s=cfg.tsdb_flush_s)
+            self.slo_engine = SloEngine(
+                self.tsdb, registry=self.metrics.registry,
+                tag=self._fresh_tag,
+                budget_frac=cfg.slo_budget_frac,
+                budget_window_s=cfg.slo_budget_window_s,
+                channel_path=os.environ.get(ENV_CHANNEL),
+                flightrec=self.flightrec)
+            self.tsdb.start()
 
     # ------------------------------------------------------------------
     def _maybe_resume(self) -> None:
@@ -2733,6 +2775,16 @@ class MicroBatchRuntime:
             # first: a watchdog tick must not evaluate healthz (or
             # spawn a capture) against a runtime mid-teardown
             self.slo_watchdog.stop()
+        if getattr(self, "tsdb", None) is not None:
+            # one last scrape (final counters + healthz verdict) and a
+            # forced flush, THEN stop — the retrospective timeline must
+            # cover the run's final window; same not-mid-teardown
+            # ordering as the watchdog above
+            try:
+                self.tsdb.scrape_once()
+            except Exception:  # noqa: BLE001 - telemetry never blocks
+                pass           # the teardown
+            self.tsdb.stop()
         # Abnormal = fatal overflow, a poisoned sink, or an exception
         # unwinding through run()'s finally into this close
         # (sys.exc_info() sees it) — incl. the SystemExit

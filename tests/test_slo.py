@@ -1,0 +1,302 @@
+"""SLO error-budget burn-rate engine (obs/slo.py, ISSUE 18).
+
+The headline test scripts a synthetic clock through a known error rate
+and pins the alert to the EXACT predicted tick — scrape 1 s, budget
+5 %×... no: budget_frac 0.2 over a 100 s window (budget 20 s), one rule
+(short 4 s / long 20 s / 2.5× burn), badness starting at t=100:
+
+- long window at t: ticks in (t-20, t]; frac crosses 2.5×·0.2 = 0.5
+  when 10 of 20 ticks are bad → first true at t=109, NOT at t=108
+  (9/20 = 0.45 → 2.25×);
+- short window is already saturated (4/4 bad → 5×) by then;
+- recovery from t=110: short window frac falls to 1/4 (1.25×) at
+  t=112 → resolve, exactly two ticks after the last good-burn tick.
+
+The budget ledger at fire time is hand-computable: 10 bad ticks × 1 s
+= 10 s consumed of 20 s → remaining_frac 0.5.
+"""
+
+import json
+import os
+
+from heatmap_tpu.obs.slo import (BurnRule, SloEngine, SloSpec,
+                                 default_rules, default_specs,
+                                 slo_stamp)
+from heatmap_tpu.obs.tsdb import TsdbRecorder
+from heatmap_tpu.obs.xproc import episode_path
+
+
+def _gauge_engine(tmp_path=None, channel=None):
+    """Recorder+engine over one synthetic gauge, synthetic clock."""
+    state = {"v": 0.0}
+
+    def expo():
+        return ("# TYPE heatmap_repl_lag_seconds gauge\n"
+                f"heatmap_repl_lag_seconds {state['v']}\n")
+
+    clk = [0.0]
+    rec = TsdbRecorder(expo, tag="m0",
+                      dir_path=str(tmp_path) if tmp_path else None,
+                      scrape_s=1.0, flush_s=1e9, clock=lambda: clk[0])
+    eng = SloEngine(
+        rec, tag="m0",
+        specs=(SloSpec("repl_lag", "gauge",
+                       "heatmap_repl_lag_seconds", 10.0),),
+        rules=(BurnRule("r", 4.0, 20.0, 2.5),),
+        budget_frac=0.2, budget_window_s=100.0,
+        channel_path=channel)
+    return rec, eng, state, clk
+
+
+def _tick(rec, state, clk, t, v):
+    clk[0] = float(t)
+    state["v"] = float(v)
+    rec.scrape_once()
+
+
+def test_burn_rate_fires_at_predicted_tick_exactly(tmp_path):
+    chan = str(tmp_path / "chan.json")
+    rec, eng, state, clk = _gauge_engine(channel=chan)
+    st = eng._state["repl_lag"]
+    for t in range(1, 100):
+        _tick(rec, state, clk, t, 0.0)          # good
+    assert st.firing is None and st.alerts_total == 0
+
+    for t in range(100, 109):                   # bad t=100..108
+        _tick(rec, state, clk, t, 99.0)
+        assert st.firing is None, f"fired EARLY at t={t}"
+    assert st.alerts_total == 0
+
+    _tick(rec, state, clk, 109, 99.0)           # the predicted tick
+    assert st.firing == "r" and st.severity == "page"
+    assert st.alerts_total == 1
+    # the ledger matches the hand computation
+    assert eng.budget("repl_lag") == {
+        "window_s": 100.0, "budget_frac": 0.2, "budget_s": 20.0,
+        "consumed_s": 10.0, "remaining_s": 10.0,
+        "remaining_frac": 0.5}
+    # the durable event carries the burn multiples and the episode
+    ev = list(rec._events)[-1]
+    assert ev["kind"] == "slo_alert" and ev["slo"] == "repl_lag"
+    assert ev["burn_short"] == 5.0 and ev["burn_long"] == 2.5
+    assert ev["budget"]["consumed_s"] == 10.0
+    # a firing alert claims ONE fleet episode (obs.xproc)
+    assert st.episode and st.episode_claimed
+    assert ev["episode"] == st.episode
+    assert os.path.exists(episode_path(chan))
+
+    # recovery: good from t=110; both windows stay tripped through
+    # t=111 (10/20 long = 2.5x), resolve exactly at t=112
+    for t in (110, 111):
+        _tick(rec, state, clk, t, 0.0)
+        assert st.firing == "r", f"resolved EARLY at t={t}"
+    _tick(rec, state, clk, 112, 0.0)
+    assert st.firing is None and st.episode is None
+    ev = list(rec._events)[-1]
+    assert ev["kind"] == "slo_resolve" and ev["episode"]
+    # the claimed episode was released on resolve
+    assert not os.path.exists(episode_path(chan))
+    # alert count is edge-triggered, not re-fired per bad tick
+    assert st.alerts_total == 1
+
+
+def test_blip_warns_burn_degrades():
+    rec, eng, state, clk = _gauge_engine()
+    for t in range(1, 60):
+        _tick(rec, state, clk, t, 0.0)
+    _tick(rec, state, clk, 60, 99.0)            # ONE bad tick
+    check = eng.healthz_checks()["slo_repl_lag"]
+    assert check["ok"] is True                  # a blip never degrades
+    assert check.get("warn") is True
+    assert "momentary blip" in check["detail"]
+
+    for t in range(61, 75):                     # sustained burn
+        _tick(rec, state, clk, t, 99.0)
+    check = eng.healthz_checks()["slo_repl_lag"]
+    assert check["ok"] is False
+    assert "error budget burning fast" in check["detail"]
+    assert "rule=r" in check["detail"]
+
+
+def test_counter_spec_reset_aware():
+    state = {"v": 5.0}
+
+    def expo():
+        return ("# TYPE heatmap_audit_digest_mismatch_total counter\n"
+                "heatmap_audit_digest_mismatch_total "
+                f"{state['v']}\n")
+
+    clk = [0.0]
+    rec = TsdbRecorder(expo, tag="m0", scrape_s=1.0,
+                      clock=lambda: clk[0])
+    eng = SloEngine(
+        rec, tag="m0",
+        specs=(SloSpec("mism", "counter",
+                       "heatmap_audit_digest_mismatch_total", 0.0),),
+        rules=(BurnRule("r", 4.0, 20.0, 1e9),),
+        budget_frac=0.2, budget_window_s=100.0)
+    st = eng._state["mism"]
+    for t, v in [(1, 5.0), (2, 7.0), (3, 1.0), (4, 1.0)]:
+        clk[0] = float(t)
+        state["v"] = v
+        rec.scrape_once()
+    # first tick seeds the baseline (good); +2 bad; reset -> the new
+    # total (1) IS the increase (bad); flat -> good
+    assert list(st.samples) == [(1.0, 0), (2.0, 1), (3.0, 1), (4.0, 0)]
+
+
+def test_quantile_spec_no_traffic_is_no_sample():
+    state = {"n": 5.0}
+
+    def expo():
+        return (
+            "# TYPE heatmap_event_age_seconds histogram\n"
+            f'heatmap_event_age_seconds_bucket{{le="0.1"}} {state["n"]}\n'
+            f'heatmap_event_age_seconds_bucket{{le="+Inf"}} {state["n"]}\n')
+
+    clk = [1.0]
+    rec = TsdbRecorder(expo, tag="m0", scrape_s=1.0,
+                      clock=lambda: clk[0])
+    eng = SloEngine(
+        rec, tag="m0",
+        specs=(SloSpec("fresh", "quantile", "heatmap_event_age_seconds",
+                       10.0, q=0.5),),
+        rules=(BurnRule("r", 4.0, 20.0, 1e9),),
+        budget_frac=0.2, budget_window_s=100.0)
+    st = eng._state["fresh"]
+    rec.scrape_once()                           # 5 obs since baseline 0
+    assert len(st.samples) == 1 and st.last_bad is False
+    clk[0] = 2.0
+    rec.scrape_once()                           # same totals: no traffic
+    assert len(st.samples) == 1                 # no data ≠ good or bad
+
+
+def test_default_specs_and_rules_shape():
+    specs = {s.name: s for s in default_specs({})}
+    assert specs["freshness_p50"].threshold == 10.0
+    assert specs["delivered_p99"].q == 0.99
+    assert specs["audit_mismatch"].kind == "counter"
+    over = default_specs({"HEATMAP_SLO_REPL_LAG_S": "3"})
+    assert {s.name: s for s in over}["repl_lag"].threshold == 3.0
+    # canonical 30d window pairs scale linearly; tiny windows clamp to
+    # two scrape ticks so a rule can always distinguish blip from burn
+    fast, slow = default_rules(30.0 * 86400.0, 5.0)
+    assert (fast.short_s, fast.long_s, fast.burn) == (300.0, 3600.0,
+                                                      14.4)
+    assert slow.severity == "ticket"
+    fast, _slow = default_rules(20.0, 0.1)
+    assert fast.short_s == 0.2 and fast.long_s == 0.2
+
+
+def test_state_persisted_for_cross_process_stamp(tmp_path):
+    rec, eng, state, clk = _gauge_engine(tmp_path=tmp_path)
+    for t in range(1, 30):
+        _tick(rec, state, clk, t, 99.0)
+    p = tmp_path / "m0" / "slo-state.json"
+    st = json.loads(p.read_text())
+    assert st["tag"] == "m0"
+    assert st["alerts_fired_total"] == 1
+    assert st["worst_burn"] >= 2.5
+    assert st["specs"]["repl_lag"]["firing"] == "r"
+    assert st["specs"]["repl_lag"]["consumed_s"] > 0
+
+
+def test_slo_stamp_aggregates_members(tmp_path):
+    for tag, alerts, burn, frac in (("a", 2, 14.5, 0.8),
+                                    ("b", 0, 1.2, 0.1)):
+        mdir = tmp_path / tag
+        mdir.mkdir()
+        (mdir / "slo-state.json").write_text(json.dumps({
+            "tag": tag, "alerts_fired_total": alerts,
+            "worst_burn": burn, "budget_consumed_frac": frac,
+            "specs": {}}))
+    out = slo_stamp(dir_path=str(tmp_path), env={"HEATMAP_TSDB": "1"})
+    assert out == {"slo": {"enabled": True, "alerts_fired": 2,
+                           "worst_burn": 14.5,
+                           "budget_consumed_frac": 0.8, "members": 2}}
+    # knob-off: NO stamp at all — artifacts stay byte-compatible with
+    # pre-tsdb rounds
+    assert slo_stamp(dir_path=str(tmp_path), env={}) == {}
+    assert slo_stamp(dir_path=str(tmp_path),
+                     env={"HEATMAP_TSDB": "0"}) == {}
+
+
+# ------------------------- bench refusal provenance (satellite, tools)
+def _load_regress():
+    import importlib.util
+
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        os.pardir))
+    spec = importlib.util.spec_from_file_location(
+        "check_bench_regress",
+        os.path.join(repo, "tools", "check_bench_regress.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _art(dir_path, rnd, value=1_000_000.0, slo=None):
+    tail = ("noise\n"
+            + json.dumps({"metric": "GPS events/sec aggregated",
+                          "value": value, "unit": "events/sec"}))
+    art = {"n": rnd, "rc": 0, "tail": tail}
+    if slo is not None:
+        art["slo"] = slo
+    p = dir_path / f"BENCH_r{rnd:02d}.json"
+    p.write_text(json.dumps(art))
+    return p
+
+
+def test_regress_refuses_alert_firing_artifact(tmp_path, capsys):
+    m = _load_regress()
+    p = _art(tmp_path, 1, slo={"enabled": True, "alerts_fired": 2,
+                               "worst_burn": 14.5,
+                               "budget_consumed_frac": 0.9,
+                               "members": 1})
+    assert m.slo_refused(str(p), "candidate") is True
+    err = capsys.readouterr().err
+    assert "burn-rate alert" in err and "14.5x" in err
+    clean = _art(tmp_path, 2, slo={"enabled": True, "alerts_fired": 0,
+                                   "worst_burn": 0.4,
+                                   "budget_consumed_frac": 0.0,
+                                   "members": 1})
+    assert m.slo_refused(str(clean), "candidate") is False
+    unstamped = _art(tmp_path, 3)
+    assert m.slo_refused(str(unstamped), "candidate") is False
+
+
+def test_regress_refuses_mixed_knob_pair(tmp_path, capsys):
+    m = _load_regress()
+    on = _art(tmp_path, 1, slo={"enabled": True, "alerts_fired": 0,
+                                "worst_burn": 0.0,
+                                "budget_consumed_frac": 0.0,
+                                "members": 1})
+    off = _art(tmp_path, 2)
+    assert m.slo_mixed_refused(str(on), str(off), "prev", "new") is True
+    assert "knob-state mismatch" in capsys.readouterr().err
+    on2 = _art(tmp_path, 3, slo={"enabled": True, "alerts_fired": 0,
+                                 "worst_burn": 0.1,
+                                 "budget_consumed_frac": 0.0,
+                                 "members": 1})
+    assert m.slo_mixed_refused(str(on), str(on2), "prev", "new") is False
+    assert m.slo_mixed_refused(str(off), str(off), "prev",
+                               "new") is False
+
+
+def test_regress_main_gates_on_slo_provenance(tmp_path, capsys):
+    m = _load_regress()
+    clean = {"enabled": True, "alerts_fired": 0, "worst_burn": 0.2,
+             "budget_consumed_frac": 0.01, "members": 1}
+    _art(tmp_path, 1, 1_000_000.0, slo=clean)
+    _art(tmp_path, 2, 990_000.0, slo=clean)
+    assert m.main(["--dir", str(tmp_path)]) == 0
+    capsys.readouterr()
+    # a burn-firing newest round is refused end to end
+    _art(tmp_path, 3, 1_500_000.0,
+         slo=dict(clean, alerts_fired=1, worst_burn=20.0))
+    assert m.main(["--dir", str(tmp_path)]) == 1
+    assert "burn-rate alert" in capsys.readouterr().err
+    # a mixed-knob newest pair is refused even when both are clean
+    _art(tmp_path, 3, 1_000_000.0)  # overwrite: knob-off round
+    assert m.main(["--dir", str(tmp_path)]) == 1
+    assert "knob-state mismatch" in capsys.readouterr().err

@@ -803,6 +803,13 @@ def test_stream_cli_entrypoint(tmp_path):
            "BATCH_SIZE": "2048",
            "STATE_CAPACITY_LOG2": "12",
            "CHECKPOINT": str(tmp_path / "ckpt")}
+    # the harness forces 8 virtual CPU devices (conftest); inherited by
+    # the subprocess it triggers a partitioned-mesh compile that takes
+    # minutes on CPU.  An operator's environment has no such flag — the
+    # entrypoint under test probes the real (single) device.
+    env["XLA_FLAGS"] = " ".join(
+        tok for tok in env.get("XLA_FLAGS", "").split()
+        if not tok.startswith("--xla_force_host_platform_device_count"))
     p = subprocess.run(
         [sys.executable, "-m", "heatmap_tpu.stream", "synthetic_backfill",
          "--max-batches", "3"],

@@ -1,0 +1,548 @@
+"""Telemetry time machine (obs/tsdb.py, ISSUE 18): recorder rings +
+append-only blocks, cross-process reader, downsample/retention tiers,
+retrospective timelines, the reset-aware counter fix (obs_top + fleet
+aggregator satellite), the obs_top --since/--replay history view, the
+knob-off differential (HEATMAP_TSDB=0 leaves the exposition untouched),
+the in-suite scrape-overhead budget, and the SIGKILL chaos contract
+(the fleet timeline reconstructs a dead member's incident from its
+retained blocks alone)."""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+from heatmap_tpu.obs.tsdb import (TsdbReader, TsdbRecorder,
+                                  counter_increases, fleet_timeline,
+                                  member_timeline, series_key,
+                                  tsdb_enabled)
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --------------------------------------------------------------- helpers
+def _canned_recorder(dir_path, tag="m0", n=60, degrade_from=40,
+                     scrape_s=1.0, t_base=1_000_000.0, **kw):
+    """A deterministic member history: a counter climbing 10/tick (with
+    one mid-run reset), a sawtooth gauge, healthz flipping ok→degraded
+    at ``degrade_from``; one flush at the end."""
+    state = {"valid": 0.0, "q": 0.0, "hz": "ok"}
+
+    def expo():
+        return (
+            "# TYPE heatmap_events_valid_total counter\n"
+            f"heatmap_events_valid_total {state['valid']}\n"
+            "# TYPE heatmap_sink_queue_depth gauge\n"
+            f"heatmap_sink_queue_depth {state['q']}\n")
+
+    def hz():
+        ok = state["hz"] == "ok"
+        return {"status": state["hz"],
+                "checks": {"freshness": {"ok": ok}}}
+
+    clk = [t_base]
+    rec = TsdbRecorder(expo, tag=tag, dir_path=str(dir_path),
+                      healthz_fn=hz, scrape_s=scrape_s, flush_s=1e9,
+                      clock=lambda: clk[0], **kw)
+    for i in range(n):
+        clk[0] = t_base + i * scrape_s
+        state["valid"] = (i % 30) * 10.0   # resets at tick 30
+        state["q"] = float(i % 5)
+        state["hz"] = "degraded" if i >= degrade_from else "ok"
+        rec.scrape_once()
+    rec.flush(clk[0])
+    return rec, clk
+
+
+# ---------------------------------------------------------------- units
+def test_counter_increases_reset_aware():
+    pts = [(1, 5.0), (2, 7.0), (3, 2.0), (4, 2.0), (5, 6.0)]
+    # reset at t=3: the new total IS the increase; flat ticks drop out
+    assert counter_increases(pts) == [(2, 2.0), (3, 2.0), (5, 4.0)]
+    assert counter_increases([]) == []
+    assert counter_increases([(1, 9.0)]) == []
+
+
+def test_series_key_sorts_labels():
+    assert series_key("x", None) == "x"
+    assert series_key("x", {"b": "2", "a": "1"}) == 'x{a="1",b="2"}'
+
+
+def test_tsdb_enabled_knob():
+    assert not tsdb_enabled({})
+    assert not tsdb_enabled({"HEATMAP_TSDB": "0"})
+    assert not tsdb_enabled({"HEATMAP_TSDB": "false"})
+    assert tsdb_enabled({"HEATMAP_TSDB": "1"})
+
+
+# ------------------------------------------------------- recorder rings
+def test_recorder_rings_window_match_parsed():
+    state = {"v": 1.0}
+
+    def expo():
+        return ("heatmap_x_total 5\n"
+                f'heatmap_g{{proc="a",shard="0"}} {state["v"]}\n')
+
+    clk = [100.0]
+    rec = TsdbRecorder(expo, tag="t", scrape_s=1.0,
+                      clock=lambda: clk[0])
+    rec.scrape_once()
+    clk[0] = 101.0
+    state["v"] = 2.0
+    rec.scrape_once()
+    assert rec.latest("heatmap_x_total") == (101.0, 5.0)
+    key = 'heatmap_g{proc="a",shard="0"}'
+    assert rec.window(key, 0.0) == [(100.0, 1.0), (101.0, 2.0)]
+    # since is exclusive
+    assert rec.window(key, 100.0) == [(101.0, 2.0)]
+    assert rec.match("heatmap_g", {"proc": "a"}) == [key]
+    assert rec.match("heatmap_g", {"proc": "zzz"}) == []
+    assert rec.parsed(key) == ("heatmap_g", {"proc": "a", "shard": "0"})
+
+
+def test_flush_cadence_first_call_arms(tmp_path):
+    rec = TsdbRecorder(lambda: "heatmap_x_total 1\n", tag="t",
+                      dir_path=str(tmp_path), scrape_s=1.0,
+                      flush_s=10.0, clock=lambda: 100.0)
+    # first due-check only arms the flush clock — no block yet
+    rec.scrape_once()
+    assert not list(tmp_path.glob("t/block-*.json"))
+    rec.clock = lambda: 111.0
+    rec.scrape_once()
+    assert len(list(tmp_path.glob("t/block-*.json"))) == 1
+
+
+# ------------------------------------------------- block/reader roundtrip
+def test_block_flush_and_reader_roundtrip(tmp_path):
+    rec, clk = _canned_recorder(tmp_path, tag="m0", n=5, degrade_from=3)
+    rec.record_event({"t": clk[0], "kind": "slo_alert", "slo": "x"})
+    path = rec.flush(clk[0])
+    assert path and os.path.basename(path).startswith("block-")
+    reader = TsdbReader(str(tmp_path))
+    assert reader.members() == ["m0"]
+    meta = reader.meta("m0")
+    assert meta["tag"] == "m0" and meta["scrape_s"] == 1.0
+
+    series = reader.series("m0", names=["heatmap_events_valid_total"])
+    assert list(series) == ["heatmap_events_valid_total"]
+    pts = series["heatmap_events_valid_total"]
+    assert [v for _t, v in pts] == [0.0, 10.0, 20.0, 30.0, 40.0]
+    # since excludes t <= since
+    t0 = pts[0][0]
+    later = reader.series("m0", names=["heatmap_events_valid_total"],
+                          since=t0)["heatmap_events_valid_total"]
+    assert len(later) == 4
+
+    hz = reader.healthz("m0")
+    assert [s for _t, s, _f in hz] == [0, 0, 0, 1, 1]
+    assert hz[3][2] == ["freshness"]
+
+    evs = reader.events("m0")
+    assert [e["kind"] for e in evs] == ["slo_alert"]
+    assert evs[0]["member"] == "m0"   # defaulted by record_event
+
+
+def test_downsample_and_retention_tiers(tmp_path):
+    clk = [1000.0]
+    rec = TsdbRecorder(lambda: f"heatmap_x_total {clk[0] - 1000.0}\n",
+                      tag="m0", dir_path=str(tmp_path), scrape_s=1.0,
+                      flush_s=1e9, hot_s=500.0, retain_s=3000.0,
+                      clock=lambda: clk[0])
+    rec.scrape_once()
+    rec.flush(clk[0])                       # raw block A @ t=1000
+    clk[0] = 2000.0
+    rec.scrape_once()
+    rec.flush(clk[0])                       # A is cold -> tier1, B raw
+    mdir = tmp_path / "m0"
+    assert len(list(mdir.glob("tier1-*.json"))) == 1
+    raws = list(mdir.glob("block-*.json"))
+    assert len(raws) == 1                   # A was merged + removed
+    # the downsampled tier still answers reads: the reader merges both
+    reader = TsdbReader(str(tmp_path))
+    pts = reader.series("m0")["heatmap_x_total"]
+    assert [v for _t, v in pts] == [0.0, 1000.0]
+    # past retention the tier-1 block is dropped too
+    clk[0] = 6000.0
+    rec.scrape_once()
+    rec.flush(clk[0])
+    assert not list(mdir.glob("tier1-00000000100*"))
+    pts = TsdbReader(str(tmp_path)).series("m0")["heatmap_x_total"]
+    assert 0.0 not in [v for _t, v in pts]
+
+
+# ------------------------------------------------------------ timelines
+def test_member_timeline_entries(tmp_path):
+    state = {"shed": 0.0, "hz": "ok"}
+
+    def expo():
+        return ("# TYPE heatmap_serve_shed_total counter\n"
+                f'heatmap_serve_shed_total{{endpoint="tiles"}} '
+                f"{state['shed']}\n")
+
+    clk = [500.0]
+    rec = TsdbRecorder(
+        expo, tag="m0", dir_path=str(tmp_path),
+        healthz_fn=lambda: {"status": state["hz"],
+                            "checks": {"c": {"ok": state["hz"] == "ok"}}},
+        scrape_s=1.0, flush_s=1e9, clock=lambda: clk[0])
+    # shed totals 0, 4, 1 (reset), healthz flips at t=502
+    for i, (shed, hzs) in enumerate([(0.0, "ok"), (4.0, "ok"),
+                                     (1.0, "degraded")]):
+        clk[0] = 500.0 + i
+        state["shed"], state["hz"] = shed, hzs
+        rec.scrape_once()
+    rec.record_event({"t": 502.5, "kind": "slo_alert", "slo": "x",
+                      "episode": "ep-1"})
+    rec.flush(clk[0])
+
+    entries = member_timeline(TsdbReader(str(tmp_path)), "m0")
+    kinds = [e["kind"] for e in entries]
+    assert kinds == ["shed", "healthz", "shed", "slo_alert"]
+    sheds = [e for e in entries if e["kind"] == "shed"]
+    assert [e["n"] for e in sheds] == [4.0, 1.0]   # reset-aware
+    hz = [e for e in entries if e["kind"] == "healthz"][0]
+    assert (hz["from"], hz["to"], hz["failing"]) == ("ok", "degraded",
+                                                     ["c"])
+    assert entries[-1]["episode"] == "ep-1"
+
+
+def test_fleet_timeline_names_first_degraded(tmp_path):
+    _canned_recorder(tmp_path, tag="steady", n=10, degrade_from=99,
+                     t_base=2_000_000.0)
+    _canned_recorder(tmp_path, tag="victim", n=10, degrade_from=4,
+                     t_base=2_000_000.0)
+    out = fleet_timeline(TsdbReader(str(tmp_path)))
+    assert out["members"] == ["steady", "victim"]
+    assert out["first_degraded"]["member"] == "victim"
+    assert out["first_degraded"]["to"] == "degraded"
+    assert out["first_degraded"]["t"] == 2_000_004.0
+
+
+# ------------------------------- satellite: reset-aware rates in obs_top
+def test_obs_top_counter_increase_helpers():
+    top = _load_tool("obs_top")
+    assert top.counter_increase(7.0, 5.0) == 2.0
+    assert top.counter_increase(5.0, 7.0) == 5.0   # reset: new total
+    assert top.counter_increase(None, 5.0) is None
+    assert top.counter_increase(5.0, None) is None
+    cur = top.parse_prom('heatmap_c{p="a"} 3\nheatmap_c{p="b"} 10\n')
+    was = top.parse_prom('heatmap_c{p="a"} 9\nheatmap_c{p="b"} 4\n')
+    # per-labelset: a restarted (3 < 9) and b advanced (10 - 4)
+    assert top._sum_increase(cur, was, "heatmap_c") == 9.0
+    assert top._sum_increase(cur, None, "heatmap_c") is None
+
+
+def test_obs_top_frame_rate_never_negative_on_restart():
+    top = _load_tool("obs_top")
+    prev = top.parse_prom("heatmap_events_valid_total 100000\n"
+                          "heatmap_events_seen_total 100000\n")
+    cur = top.parse_prom("heatmap_events_valid_total 50\n"
+                         "heatmap_events_seen_total 50\n")
+    frame = top.render_frame(cur, prev, 1.0, {"status": "ok",
+                                              "checks": {}})
+    ingest = frame.split("ingest")[1].splitlines()[0]
+    # post-restart the rate resumes from the reset point (50 ev/s), it
+    # does not go hugely negative (-99,950 ev/s) for one frame
+    assert "50 ev/s" in ingest
+    assert "-99" not in ingest
+
+
+def test_fleet_aggregator_monotonic_across_restart(tmp_path):
+    from heatmap_tpu.obs.fleet import FleetAggregator
+
+    agg = FleetAggregator(str(tmp_path / "chan.json"))
+    seq = [agg._monotonic("m0", "heatmap_c", "", v)
+           for v in (100.0, 150.0, 30.0, 40.0)]
+    # the restart (150 -> 30) resumes from the reset point
+    assert seq == [100.0, 150.0, 180.0, 190.0]
+    assert seq == sorted(seq)
+
+
+# ------------------------------ satellite: obs_top --since / --replay
+def test_obs_top_history_render(tmp_path):
+    top = _load_tool("obs_top")
+    from heatmap_tpu.obs import tsdb as tsdbmod
+
+    rec, clk = _canned_recorder(tmp_path, tag="m0")
+    rec.record_event({"t": clk[0] - 10.0, "kind": "slo_alert",
+                      "slo": "freshness_p50", "rule": "fast",
+                      "severity": "page"})
+    rec.flush(clk[0])
+    (tmp_path / "m0" / "slo-state.json").write_text(json.dumps({
+        "tag": "m0", "alerts_fired_total": 1, "worst_burn": 14.5,
+        "budget_consumed_frac": 0.25,
+        "specs": {"freshness_p50": {"firing": "fast",
+                                    "worst_burn": 14.5,
+                                    "remaining_frac": 0.75}}}))
+    out = top.render_history(tsdbmod, str(tmp_path), "m0", 60.0)
+    assert "member m0" in out
+    assert "ingest ev/s" in out and "sink queue" in out
+    hz_line = [ln for ln in out.splitlines() if "healthz" in ln
+               and "|" in ln][0]
+    assert "." in hz_line and "▲" in hz_line
+    assert "SLO budget" in out and "worst burn 14.5x" in out
+    assert "FIRING (fast)" in out
+    assert "healthz ok → degraded (freshness)" in out
+    assert "slo_alert slo=freshness_p50 rule=fast" in out
+    # deterministic: anchored on the data, not the wall clock
+    assert out == top.render_history(tsdbmod, str(tmp_path), "m0", 60.0)
+
+
+def test_obs_top_history_main_and_replay(tmp_path, capsys):
+    top = _load_tool("obs_top")
+    _canned_recorder(tmp_path, tag="m0")
+    assert top.main(["--since", "60", "--tsdb-dir",
+                     str(tmp_path)]) == 0
+    assert "member m0" in capsys.readouterr().out
+    assert top.main(["--replay", "--since", "60", "--frames", "3",
+                     "--no-clear", "--tsdb-dir", str(tmp_path)]) == 0
+    assert capsys.readouterr().out.count("---\n") == 2
+    # rc contract: no dir = 2, no members / unknown member = 1
+    assert top.main(["--since", "60", "--tsdb-dir",
+                     str(tmp_path / "nope")]) == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert top.main(["--since", "60", "--tsdb-dir", str(empty)]) == 1
+    assert top.main(["--since", "60", "--tsdb-dir", str(tmp_path),
+                     "--member", "ghost"]) == 1
+    capsys.readouterr()
+
+
+# ----------------------------------------------- knob-off differential
+def _tiny_runtime(tmp_path, extra_env):
+    from heatmap_tpu.config import load_config
+    from heatmap_tpu.sink import MemoryStore
+    from heatmap_tpu.stream import MicroBatchRuntime
+    from heatmap_tpu.stream.source import MemorySource
+
+    t0 = int(time.time()) - 5
+    evs = [{"provider": "p", "vehicleId": f"v{i}",
+            "lat": 42.0 + i * 1e-4, "lon": -71.0, "speedKmh": 1.0,
+            "ts": t0} for i in range(32)]
+    cfg = load_config(dict(extra_env), batch_size=16,
+                      state_capacity_log2=8, speed_hist_bins=4,
+                      store="memory", serve_port=0,
+                      checkpoint_dir=tempfile.mkdtemp(
+                          dir=str(tmp_path)))
+    src = MemorySource(evs)
+    src.finish()
+    store = MemoryStore()
+    rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=0)
+    rt.run()
+    return rt, store
+
+
+def _tile_counts(store):
+    import datetime as dt
+
+    old = dt.datetime(1970, 1, 1, tzinfo=dt.timezone.utc)
+    return sorted((d["_id"], d["count"])
+                  for d in store.tiles_in_window(old))
+
+
+def test_knob_off_is_byte_identical(tmp_path, monkeypatch):
+    """HEATMAP_TSDB=0: no recorder, no tsdb/slo families in the
+    exposition, nothing on disk, identical tile frames — the knob-on
+    run differs ONLY by the additional telemetry families."""
+    for k in ("HEATMAP_TSDB", "HEATMAP_TSDB_DIR",
+              "HEATMAP_SUPERVISOR_CHANNEL", "HEATMAP_FLEET_TAG"):
+        monkeypatch.delenv(k, raising=False)
+    rt_off, store_off = _tiny_runtime(tmp_path, {})
+    d = tmp_path / "tsdb"
+    rt_on, store_on = _tiny_runtime(tmp_path, {
+        "HEATMAP_TSDB": "1", "HEATMAP_TSDB_DIR": str(d),
+        "HEATMAP_TSDB_SCRAPE_S": "600"})
+
+    assert rt_off.tsdb is None and rt_off.slo_engine is None
+    text_off = rt_off.metrics.expose_text()
+    assert "heatmap_tsdb_" not in text_off
+    assert "heatmap_slo_" not in text_off
+    assert not list(tmp_path.glob("tsdb-*"))
+
+    assert rt_on.tsdb is not None and rt_on.slo_engine is not None
+    text_on = rt_on.metrics.expose_text()
+    assert "heatmap_tsdb_scrapes_total" in text_on
+    assert "heatmap_slo_budget_remaining_frac" in text_on
+
+    # identical pipeline output: same tiles, same counts, byte-equal
+    assert json.dumps(_tile_counts(store_off)) \
+        == json.dumps(_tile_counts(store_on))
+    # identical contract surface: the HELP/TYPE header set differs by
+    # exactly the tsdb/slo families
+    def headers(text):
+        return {ln for ln in text.splitlines()
+                if ln.startswith(("# HELP", "# TYPE"))}
+
+    extra = {ln for ln in headers(text_on) - headers(text_off)}
+    assert extra and all(" heatmap_tsdb_" in ln or " heatmap_slo_" in ln
+                         for ln in extra)
+    assert not headers(text_off) - headers(text_on)
+    # the knob-on run's close() left a readable member history behind
+    reader = TsdbReader(str(d))
+    assert reader.members() == [rt_on.tsdb.tag]
+    assert "heatmap_events_valid_total" in reader.series(
+        rt_on.tsdb.tag)
+
+
+# -------------------------------------------------- overhead assertion
+def test_scrape_overhead_within_budget():
+    """The recorder's self-reported cost (heatmap_tsdb_scrape_seconds)
+    stays bounded on a realistic exposition — asserted through the
+    metric itself, so the budget claim and the measurement share one
+    code path."""
+    from heatmap_tpu.obs.fleet import parse_exposition
+    from heatmap_tpu.obs.registry import Registry
+
+    lines = []
+    for i in range(300):
+        lines.append(f'heatmap_series_{i % 30}_total{{p="{i}"}} {i}')
+    text = "\n".join(lines) + "\n"
+    reg = Registry()
+    rec = TsdbRecorder(lambda: text, tag="bench", registry=reg,
+                      scrape_s=1.0, clock=time.time)
+    for _ in range(30):
+        rec.scrape_once()
+    _types, samples = parse_exposition(reg.expose_text())
+    vals = {name: v for name, _lbl, v in samples}
+    count = vals["heatmap_tsdb_scrapes_total"]
+    total = vals["heatmap_tsdb_scrape_seconds_sum"]
+    assert count == 30.0
+    # ~1 ms typical for 300 series; 50 ms mean is CI-loaded-host safe
+    assert total / count < 0.05, \
+        f"mean scrape {total / count * 1e3:.1f} ms over budget"
+
+
+# ------------------------------------------------------- SIGKILL chaos
+_CHILD = r"""
+import json, os, sys, time
+from heatmap_tpu.obs import ENV_CHANNEL
+from heatmap_tpu.obs.registry import Registry
+from heatmap_tpu.obs.slo import BurnRule, SloEngine, SloSpec
+from heatmap_tpu.obs.tsdb import TsdbRecorder
+
+def scrape():
+    return ("# TYPE heatmap_repl_lag_seconds gauge\n"
+            "heatmap_repl_lag_seconds 99\n")
+
+eng = None
+
+def hz():
+    checks = eng.healthz_checks() if eng is not None else {}
+    bad = any(not c.get("ok", True) for c in checks.values())
+    return {"status": "degraded" if bad else "ok", "checks": checks}
+
+rec = TsdbRecorder(scrape, tag="victim",
+                  dir_path=os.environ["TSDB_DIR"], healthz_fn=hz,
+                  registry=Registry(), scrape_s=0.05, flush_s=0.05)
+eng = SloEngine(
+    rec, tag="victim",
+    specs=(SloSpec("repl_lag", "gauge", "heatmap_repl_lag_seconds",
+                   10.0),),
+    budget_frac=0.05, budget_window_s=20.0,
+    channel_path=os.environ[ENV_CHANNEL])
+rec.start()
+deadline = time.time() + 15
+while time.time() < deadline:
+    if eng._state["repl_lag"].firing:
+        break
+    time.sleep(0.05)
+time.sleep(0.6)   # a few more ticks: the degraded verdict hits disk
+print(json.dumps({"pid": os.getpid(),
+                  "firing": eng._state["repl_lag"].firing,
+                  "episode": eng._state["repl_lag"].episode}),
+      flush=True)
+time.sleep(300)
+"""
+
+
+def test_sigkill_chaos_fleet_timeline(tmp_path, monkeypatch):
+    """Chaos tier-1: SIGKILL a member mid-incident (burn-rate alert
+    firing, episode claimed).  A surviving serve-only process answers
+    /fleet/timeline from the victim's retained blocks: the degradation
+    transition, the slo_alert event with its episode id, and
+    first_degraded naming the dead member."""
+    from heatmap_tpu.obs import ENV_CHANNEL
+    from heatmap_tpu.obs.xproc import ENV_FLEET_TAG
+    from heatmap_tpu.serve.api import make_wsgi_app
+    from heatmap_tpu.sink import MemoryStore
+
+    d = tmp_path / "tsdb"
+    d.mkdir()
+    chan = str(tmp_path / "chan.json")
+    env = dict(os.environ)
+    env.update({"TSDB_DIR": str(d), ENV_CHANNEL: chan,
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO + os.pathsep
+                + env.get("PYTHONPATH", "")})
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    proc = subprocess.Popen([sys.executable, str(script)], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    app = None
+    try:
+        line = proc.stdout.readline()
+        if not line:
+            pytest.fail("chaos child died early: "
+                        + proc.stderr.read()[-2000:])
+        info = json.loads(line)
+        assert info["firing"], "child never fired its burn-rate alert"
+        assert info["episode"], "firing alert claimed no episode"
+        os.kill(info["pid"], signal.SIGKILL)
+        proc.wait(timeout=10)
+
+        # survivor: a fresh serve-only worker over the SAME directory
+        monkeypatch.setenv("HEATMAP_TSDB", "1")
+        monkeypatch.setenv("HEATMAP_TSDB_DIR", str(d))
+        monkeypatch.setenv("HEATMAP_TSDB_SCRAPE_S", "600")
+        monkeypatch.setenv(ENV_FLEET_TAG, "survivor1")
+        monkeypatch.delenv(ENV_CHANNEL, raising=False)
+        app = make_wsgi_app(MemoryStore())
+        cap = {}
+
+        def sr(status, headers):
+            cap["status"] = status
+
+        body = b"".join(app({"PATH_INFO": "/fleet/timeline",
+                             "QUERY_STRING": "since=86400",
+                             "REQUEST_METHOD": "GET"}, sr))
+        assert cap["status"].startswith("200")
+        payload = json.loads(body)
+        assert "victim" in payload["members"]
+        assert payload["first_degraded"]["member"] == "victim"
+        assert payload["first_degraded"]["to"] == "degraded"
+        alerts = [e for e in payload["entries"]
+                  if e.get("kind") == "slo_alert"]
+        assert alerts and alerts[0]["member"] == "victim"
+        assert alerts[0]["slo"] == "repl_lag"
+        assert alerts[0]["episode"] == info["episode"]
+        hz = [e for e in payload["entries"]
+              if e.get("kind") == "healthz"]
+        assert hz and hz[0]["from"] == "ok" and hz[0]["to"] == "degraded"
+
+        # the per-member endpoint reconstructs the same incident
+        body = b"".join(app({"PATH_INFO": "/debug/timeline",
+                             "QUERY_STRING": "since=86400",
+                             "REQUEST_METHOD": "GET"}, sr))
+        one = json.loads(body)
+        assert one["member"] == "victim"
+        assert any(e.get("kind") == "slo_alert" for e in one["entries"])
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        if app is not None:
+            app.close_repl()

@@ -182,7 +182,7 @@ def run(days: int, windows_per_day: int, n_cells: int,
     backfilled = len(replica.window_docs("h3r8")) - 1
     pub2.close()
 
-    return {
+    art = {
         "rc": 0 if comp.mismatches == 0 else 1,
         "kind": "bench_history",
         "days": days,
@@ -221,6 +221,13 @@ def run(days: int, windows_per_day: int, n_cells: int,
                 "(DigestTable) and verified by the compactor",
         "banked_unix": round(time.time(), 3),
     }
+    # telemetry-history provenance (obs.slo): rides along when the run
+    # had HEATMAP_TSDB on, so check_bench_regress can refuse numbers
+    # earned while a burn-rate alert was firing
+    from heatmap_tpu.obs.slo import slo_stamp
+
+    art.update(slo_stamp())
+    return art
 
 
 def main(argv=None) -> int:

@@ -1282,6 +1282,12 @@ def main() -> None:
     # replicated-fleet provenance: replica count + max seq lag off the
     # fleet channel, when a replicated serve fleet is attached
     out.update(repl_stamp())
+    # telemetry-history provenance (obs.slo): budget/burn/alerts during
+    # the round — check_bench_regress refuses artifacts whose run fired
+    # a burn-rate alert, and refuses mixed tsdb-knob pairs
+    from heatmap_tpu.obs.slo import slo_stamp
+
+    out.update(slo_stamp())
     print(json.dumps(out))
 
 

@@ -97,6 +97,17 @@ bytes_decoded, rows_surfaced, scan_ratio}); ``scan_ratio`` (blocks
 used / blocks scanned, HIGHER-is-better — the reader's pruning
 efficiency) may not DROP past the threshold.
 
+SLO provenance (ISSUE 18) joins the refusal list: an artifact stamped
+with an ``slo`` block (HEATMAP_TSDB=1 rounds: obs.slo's {alerts_fired,
+worst_burn, budget_consumed_frac}) whose run FIRED a burn-rate alert
+is refused outright — a number earned while the pipeline was violating
+its own SLOs must never become the bar; fix the burn, re-run, re-bank.
+And mixed tsdb-knob pairs are refused: the recorder's scrape thread is
+part of what a stamped round measures, so a knob-on round is not the
+same experiment as a knob-off (or pre-tsdb) one.  Applies to the
+headline, serve, and history families — the three whose tools stamp
+the block.
+
 Usage:
     python tools/check_bench_regress.py [--dir REPO] [--threshold 0.5]
 Exit codes: 0 ok / nothing to compare, 1 regression or mixed-backend /
@@ -235,6 +246,55 @@ def audit_refused(path: str, label: str) -> bool:
     return True
 
 
+def slo_stamp_of(path: str) -> dict | None:
+    """The artifact's telemetry-history provenance (``"slo"`` stamp,
+    ISSUE 18 — obs.slo.slo_stamp); None on knob-off or pre-tsdb
+    artifacts."""
+    v = _stamped(path, "slo", dict)
+    return v if isinstance(v, dict) else None
+
+
+def slo_refused(path: str, label: str) -> bool:
+    """True (and prints the FAIL) when the artifact's ``slo`` stamp
+    says the run FIRED a burn-rate alert — a number earned while the
+    pipeline was violating its own SLOs must never be banked or
+    ratcheted against.  Unstamped / knob-off artifacts pass
+    untouched."""
+    v = slo_stamp_of(path)
+    if not isinstance(v, dict) or not v.get("enabled"):
+        return False
+    alerts = v.get("alerts_fired")
+    if not isinstance(alerts, (int, float)) or alerts <= 0:
+        return False
+    worst = v.get("worst_burn", 0.0)
+    print(f"FAIL: {label} ({os.path.basename(path)}) fired "
+          f"{alerts:g} SLO burn-rate alert(s) during the run "
+          f"(worst burn {worst:g}x budget) — a number earned while the "
+          f"pipeline was violating its own SLOs must never become the "
+          f"bar; fix the burn, re-run, re-bank", file=sys.stderr)
+    return True
+
+
+def slo_mixed_refused(p_prev: str, p_new: str, lbl_prev: str,
+                      lbl_new: str) -> bool:
+    """True (and prints the FAIL) when exactly one side of the pair ran
+    with the telemetry recorder on (``slo.enabled``) — the scrape
+    thread is part of what a stamped round measures, so a knob-on
+    round and a knob-off (or pre-tsdb) one are different
+    experiments."""
+    on_prev = bool((slo_stamp_of(p_prev) or {}).get("enabled"))
+    on_new = bool((slo_stamp_of(p_new) or {}).get("enabled"))
+    if on_prev == on_new:
+        return False
+    print(f"FAIL: tsdb knob-state mismatch — {lbl_prev} ran with "
+          f"HEATMAP_TSDB {'on' if on_prev else 'off'} but {lbl_new} "
+          f"ran with it {'on' if on_new else 'off'}; the recorder's "
+          f"scrape overhead is part of what a stamped round measures, "
+          f"so the pair is not the same experiment — re-run with the "
+          f"same knob state", file=sys.stderr)
+    return True
+
+
 def newest_pair(dir_path: str) -> list:
     """[(round, path, rate)] for every parseable artifact, round-sorted."""
     out = []
@@ -332,6 +392,12 @@ def compare_serve(dir_path: str, threshold: float) -> int:
         return 0
     (r_prev, _p_prev, m_prev), (r_new, _p_new, m_new) = \
         usable[-2], usable[-1]
+    if slo_refused(_p_prev, f"serve r{r_prev:02d}") \
+            or slo_refused(_p_new, f"serve r{r_new:02d}") \
+            or slo_mixed_refused(_p_prev, _p_new,
+                                 f"serve r{r_prev:02d}",
+                                 f"serve r{r_new:02d}"):
+        return 1
     (p99_prev, wire_prev, rep_prev, fmt_prev, wrk_prev,
      delv_prev, core_prev, _tref_prev) = m_prev
     (p99_new, wire_new, rep_new, fmt_new, wrk_new, delv_new,
@@ -687,6 +753,11 @@ def compare_hist(dir_path: str, threshold: float) -> int:
     if audit_refused(p_prev, f"hist r{r_prev:02d}") \
             or audit_refused(p_new, f"hist r{r_new:02d}"):
         return 1
+    if slo_refused(p_prev, f"hist r{r_prev:02d}") \
+            or slo_refused(p_new, f"hist r{r_new:02d}") \
+            or slo_mixed_refused(p_prev, p_new, f"hist r{r_prev:02d}",
+                                 f"hist r{r_new:02d}"):
+        return 1
     (p99_prev, rps_prev, shape_prev, scan_prev) = m_prev
     (p99_new, rps_new, shape_new, scan_new) = m_new
     if shape_prev != shape_new:
@@ -863,12 +934,16 @@ def main(argv=None) -> int:
     # both sides of the would-be pair: a leak-stamped artifact must
     # neither be banked NOR serve as the ratchet baseline
     for rnd, path, _v in usable[-2:]:
-        if audit_refused(path, f"r{rnd:02d}"):
+        if audit_refused(path, f"r{rnd:02d}") \
+                or slo_refused(path, f"r{rnd:02d}"):
             return 1
     if len(usable) < 2:
         print(f"OK: {len(usable)} usable artifact(s) — nothing to compare")
         return serve_rc
     (r_prev, p_prev, prev), (r_new, p_new, new) = usable[-2], usable[-1]
+    if slo_mixed_refused(p_prev, p_new, f"r{r_prev:02d}",
+                         f"r{r_new:02d}"):
+        return 1
     bp_prev, bp_new = backend_path(p_prev), backend_path(p_new)
     if bp_prev and bp_new and bp_prev != bp_new:
         print(f"FAIL: backend_path mismatch — r{r_prev:02d} ran on "

@@ -202,6 +202,23 @@ def _smoke_cq():
     return list(reg._families.values())
 
 
+def _smoke_tsdb():
+    """CONSTRUCTED telemetry-history recorder + SLO engine (obs/tsdb.py
+    + obs/slo.py): the ``heatmap_tsdb_*`` and ``heatmap_slo_*``
+    families only register under HEATMAP_TSDB=1, which no runtime smoke
+    above enables.  Construction alone registers them — no sampler
+    thread starts, nothing touches disk (no dir_path)."""
+    from heatmap_tpu.obs.registry import Registry
+    from heatmap_tpu.obs.slo import SloEngine
+    from heatmap_tpu.obs.tsdb import TsdbRecorder
+
+    reg = Registry()
+    rec = TsdbRecorder(lambda: "", tag="docsgate", registry=reg,
+                       scrape_s=1.0)
+    SloEngine(rec, registry=reg, tag="docsgate")
+    return list(reg._families.values())
+
+
 def main() -> int:
     os.environ.setdefault("HEATMAP_PLATFORM", "cpu")
     # the mesh smoke needs >= 2 devices; force 2 CPU host devices
@@ -241,6 +258,8 @@ def main() -> int:
     fams += [f for f in _smoke_audit() if f.name not in seen]
     seen = {f.name for f in fams}
     fams += [f for f in _smoke_cq() if f.name not in seen]
+    seen = {f.name for f in fams}
+    fams += [f for f in _smoke_tsdb() if f.name not in seen]
     for fam in fams:
         if not fam.help.strip():
             failures.append(f"{fam.name}: empty HELP string")

@@ -40,16 +40,31 @@ single view — delivered-age p50/p99 to the subscriber socket, worst
 stage, slow-request count, worst SSE write stall — and ``--fleet``
 adds a per-replica delivery table naming the worst replica.
 
+With the telemetry history recorder on (HEATMAP_TSDB=1, obs.tsdb)
+``--since <seconds>`` switches to the TIME-MACHINE view: no live
+endpoint needed — the frame is rendered from the retained on-disk
+series alone.  One sparkline row per headline family (ingest rate,
+tiles rate, ring/sink depth, repl lag, sheds), a healthz strip showing
+ok/degraded/down per time slot, the member's SLO error-budget ledger
+(remaining %, worst burn-rate multiple, alerts fired — obs.slo), and
+the incident-timeline tail.  ``--replay`` animates the same window as
+a growing sequence of frames — watching an incident unfold after the
+fact.  Point it with ``--tsdb-dir`` (or HEATMAP_TSDB_DIR) and pick a
+member with ``--member``.
+
 Usage:
     python tools/obs_top.py [--url http://127.0.0.1:5000] [--interval 2]
     python tools/obs_top.py --once          # single frame (no clear)
     python tools/obs_top.py --fleet         # per-member fleet rows
+    python tools/obs_top.py --since 3600 --tsdb-dir /var/lib/heatmap/tsdb
+    python tools/obs_top.py --replay --since 600 --tsdb-dir ...
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 import urllib.error
@@ -130,6 +145,33 @@ def _val(m: dict, name: str, labels: str = "") -> float | None:
     return m.get(name, {}).get(labels)
 
 
+def counter_increase(cur: float | None,
+                     was: float | None) -> float | None:
+    """Increase of a cumulative counter between two scrapes, reset-
+    aware: a process restart drops the total back toward zero, so a
+    current value BELOW the previous one means the run restarted and
+    the post-reset total IS the whole increase — the rate resumes from
+    the reset point instead of going hugely negative for one frame."""
+    if cur is None or was is None:
+        return None
+    return cur if cur < was else cur - was
+
+
+def _sum_increase(m: dict, prev: dict | None, name: str) -> float | None:
+    """Reset-aware increase of a family summed across its labelsets —
+    each labelset's delta computed independently so one restarted
+    member cannot drag the summed delta negative."""
+    cur = m.get(name)
+    if cur is None or prev is None:
+        return None
+    prv = prev.get(name) or {}
+    total = 0.0
+    for labels, v in cur.items():
+        was = prv.get(labels)
+        total += v if was is None else (counter_increase(v, was) or 0.0)
+    return total
+
+
 def _sum(m: dict, name: str) -> float | None:
     """Sum a family across its labelsets (e.g. per-fn compile counters
     folded into one number an operator can watch)."""
@@ -145,8 +187,8 @@ def render_frame(m: dict, prev: dict | None, dt: float,
         cur = _val(m, name)
         if cur is None or prev is None or dt <= 0:
             return None
-        was = _val(prev, name)
-        return (cur - was) / dt if was is not None else None
+        d = counter_increase(cur, _val(prev, name))
+        return None if d is None else d / dt
 
     def fmt(v, unit="", scale=1.0, digits=1):
         return "--" if v is None else f"{v * scale:,.{digits}f}{unit}"
@@ -198,10 +240,7 @@ def render_frame(m: dict, prev: dict | None, dt: float,
     # DELTA between scrapes (a nonzero steady-state compile rate IS the
     # retrace incident), retraces + high-water marks as lifetime values
     compiles = _sum(m, "heatmap_compile_total")
-    d_compiles = None
-    if compiles is not None and prev is not None:
-        was = _sum(prev, "heatmap_compile_total")
-        d_compiles = compiles - was if was is not None else None
+    d_compiles = _sum_increase(m, prev, "heatmap_compile_total")
     retraces = _sum(m, "heatmap_retrace_after_warmup_total")
     lines.append(
         f"  compile   Δ {fmt(d_compiles, digits=0):>12}   "
@@ -373,7 +412,7 @@ def _last_adjust(m: dict, prev: dict | None) -> str | None:
     cur = m.get("heatmap_govern_adjust_total") or {}
     was = (prev or {}).get("heatmap_govern_adjust_total") or {}
     for labels, v in cur.items():
-        if v > was.get(labels, 0.0):
+        if (counter_increase(v, was.get(labels, 0.0)) or 0.0) > 0:
             d = _label_of(labels, "dir") or "?"
             r = _label_of(labels, "reason") or "?"
             return f"{d}/{r}"
@@ -457,7 +496,8 @@ def render_fleet_frame(m: dict, prev: dict | None, dt: float,
         # scrapes; first frame falls back to the member's own lifetime
         # events_per_sec gauge
         if dt > 0 and tag in valid and tag in valid_prev:
-            return (valid[tag] - valid_prev[tag]) / dt
+            d = counter_increase(valid[tag], valid_prev[tag])
+            return None if d is None else d / dt
         return rate_gauge.get(tag)
 
     lines = ["heatmap obs_top --fleet — " + time.strftime("%H:%M:%S"), ""]
@@ -641,7 +681,8 @@ def render_fleet_frame(m: dict, prev: dict | None, dt: float,
             def _rate(cur: dict, prv: dict, tag: str):
                 if prev is None or dt <= 0 or tag not in cur:
                     return None
-                return max(0.0, cur[tag] - prv.get(tag, 0.0)) / dt
+                d = counter_increase(cur[tag], prv.get(tag, 0.0))
+                return None if d is None else d / dt
             lines.append("")
             lines.append(f"  {'serve wire':<14}{'clients':>8}"
                          f"{'bin %':>8}{'wire B/s':>12}"
@@ -771,7 +812,8 @@ def render_fleet_frame(m: dict, prev: dict | None, dt: float,
         for tag in cq_tags:
             mrate = None
             if dt > 0 and tag in cq_match and tag in cq_match_prev:
-                mrate = (cq_match[tag] - cq_match_prev[tag]) / dt
+                d = counter_increase(cq_match[tag], cq_match_prev[tag])
+                mrate = None if d is None else d / dt
             lines.append(
                 f"  {tag:<14}{fmt(cq_reg.get(tag), digits=0):>9}"
                 f"{fmt(cq_match.get(tag), digits=0):>10}"
@@ -796,6 +838,251 @@ def render_fleet_frame(m: dict, prev: dict | None, dt: float,
     return "\n".join(lines) + "\n"
 
 
+# ------------------------------------------------------------ time machine
+# Historical rendering off the obs.tsdb on-disk series (--since /
+# --replay): everything below reads the retained blocks, never HTTP.
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+# (row label, family base name, "rate" | "gauge"): the headline rows
+# the historical view sparklines.  Counters render as per-slot
+# increases (reset-aware); gauges as the slot's last value.  Rows whose
+# family never appears in the window are dropped, so a build without
+# e.g. the repl tier just shows fewer rows.
+_HISTORY_ROWS = (
+    ("ingest ev/s", "heatmap_events_valid_total", "rate"),
+    ("tiles/s", "heatmap_tiles_emitted_total", "rate"),
+    ("ring depth", "heatmap_emit_ring_pending", "gauge"),
+    ("sink queue", "heatmap_sink_queue_depth", "gauge"),
+    ("repl lag s", "heatmap_repl_lag_seconds", "gauge"),
+    ("shed/s", "heatmap_serve_shed_total", "rate"),
+    ("retraces", "heatmap_retrace_after_warmup_total", "rate"),
+)
+
+_HZ_CHARS = {0: ".", 1: "▲", 2: "█"}  # ok / degraded / down
+
+
+def _tsdb_import():
+    """obs.tsdb, with a repo-root sys.path fallback so the tool also
+    runs as a plain script from a checkout."""
+    try:
+        from heatmap_tpu.obs import tsdb as tsdbmod
+    except ImportError:
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from heatmap_tpu.obs import tsdb as tsdbmod
+    return tsdbmod
+
+
+def sparkline(values: list, width: int) -> str:
+    """``values`` (None = no sample in that slot) as a fixed-width
+    block-character strip.  A flat non-zero series renders mid-scale;
+    all-None renders as spaces."""
+    known = [v for v in values if v is not None]
+    if not known:
+        return " " * width
+    lo, hi = min(known), max(known)
+    span = hi - lo
+    out = []
+    for v in values[:width]:
+        if v is None:
+            out.append(" ")
+        elif span <= 0:
+            out.append(_SPARK_BLOCKS[4] if hi else _SPARK_BLOCKS[0])
+        else:
+            idx = int((v - lo) / span * (len(_SPARK_BLOCKS) - 1))
+            out.append(_SPARK_BLOCKS[max(0, min(idx,
+                                                len(_SPARK_BLOCKS) - 1))])
+    return "".join(out).ljust(width)
+
+
+def _slot(values_ts: list, t0: float, t1: float, width: int,
+          mode: str) -> list:
+    """Resample [(t, v)] into ``width`` equal time slots over
+    [t0, t1]: rate-mode sums per-slot increases / slot seconds,
+    gauge-mode keeps the slot's last value; empty slots are None."""
+    if t1 <= t0 or width <= 0:
+        return []
+    step = (t1 - t0) / width
+    slots: list = [None] * width
+
+    def idx(t):
+        return max(0, min(width - 1, int((t - t0) / step)))
+
+    if mode == "rate":
+        prev = None
+        for t, v in values_ts:
+            if prev is not None:
+                d = v if v < prev else v - prev  # reset-aware
+                if d > 0 and t0 <= t <= t1 + step:
+                    i = idx(t)
+                    slots[i] = (slots[i] or 0.0) + d
+            prev = v
+        return [None if s is None else s / step for s in slots]
+    for t, v in values_ts:
+        if t0 <= t <= t1 + step:
+            slots[idx(t)] = v
+    return slots
+
+
+def _family_points(series: dict, name: str) -> list:
+    """All samples of one family merged across labelsets, time-sorted —
+    multi-labelset counters (e.g. per-endpoint sheds) fold into one
+    strip per row."""
+    merged: dict = {}
+    for key, pts in series.items():
+        if key.split("{", 1)[0] != name:
+            continue
+        for t, v in pts:
+            merged[t] = merged.get(t, 0.0) + v
+    return sorted(merged.items())
+
+
+def _fmt_clock(t: float) -> str:
+    return time.strftime("%H:%M:%S", time.localtime(t))
+
+
+def render_history(tsdbmod, dir_path: str, tag: str, since_s: float,
+                   until: float | None = None, width: int = 48) -> str:
+    """One time-machine frame for one member: sparkline rows, healthz
+    strip, SLO budget ledger, timeline tail.  ``until`` defaults to the
+    newest retained sample so a canned directory replays identically
+    whenever it is read."""
+    reader = tsdbmod.TsdbReader(dir_path)
+    series = reader.series(tag)
+    hz = reader.healthz(tag)
+    newest = 0.0
+    for pts in series.values():
+        if pts:
+            newest = max(newest, pts[-1][0])
+    if hz:
+        newest = max(newest, hz[-1][0])
+    t1 = until if until is not None else newest
+    if t1 <= 0:
+        return (f"heatmap obs_top --since — member {tag}: "
+                f"no retained samples\n")
+    t0 = t1 - since_s
+    lines = [f"heatmap obs_top --since — member {tag}   "
+             f"window {_fmt_clock(t0)} → {_fmt_clock(t1)} "
+             f"({since_s:,.0f} s)", ""]
+    for label, fam, mode in _HISTORY_ROWS:
+        pts = _family_points(series, fam)
+        if not pts:
+            continue
+        slots = _slot(pts, t0, t1, width, mode)
+        known = [v for v in slots if v is not None]
+        if not known:
+            continue
+        lines.append(f"  {label:<12}|{sparkline(slots, width)}| "
+                     f"min {min(known):,.1f}  max {max(known):,.1f}")
+    # healthz strip: worst status per slot (ok/degraded/down), the
+    # at-a-glance shape of the incident
+    if hz and t1 > t0:
+        step = (t1 - t0) / width
+        strip = [None] * width
+        for t, status, _failing in hz:
+            if t0 <= t <= t1 + step:
+                i = max(0, min(width - 1, int((t - t0) / step)))
+                strip[i] = max(strip[i] or 0, int(status))
+        lines.append("  {:<12}|{}|".format("healthz", "".join(
+            " " if s is None else _HZ_CHARS.get(s, "?")
+            for s in strip)))
+    # SLO error-budget ledger (obs.slo slo-state.json): the budget
+    # column — remaining %, worst burn multiple, alerts fired
+    state = None
+    try:
+        with open(os.path.join(dir_path, tag, "slo-state.json"),
+                  "r", encoding="utf-8") as fh:
+            state = json.load(fh)
+    except (OSError, ValueError):
+        pass
+    if isinstance(state, dict):
+        lines.append("")
+        lines.append(f"  SLO budget  worst burn "
+                     f"{state.get('worst_burn', 0.0):,.1f}x   alerts "
+                     f"{state.get('alerts_fired_total', 0)}   consumed "
+                     f"{100.0 * state.get('budget_consumed_frac', 0.0):,.1f}%")
+        for name, sp in sorted((state.get("specs") or {}).items()):
+            firing = sp.get("firing")
+            lines.append(
+                f"    {name:<18}remaining "
+                f"{100.0 * sp.get('remaining_frac', 0.0):>5,.1f}%   "
+                f"burn {sp.get('worst_burn', 0.0):,.1f}x"
+                + (f"   FIRING ({firing})" if firing else ""))
+    # timeline tail: the last few reconstructed incident entries
+    entries = [e for e in tsdbmod.member_timeline(reader, tag, since=t0)
+               if e.get("t", 0) <= t1 + 1.0]
+    if entries:
+        lines.append("")
+        lines.append("  timeline")
+        for e in entries[-8:]:
+            kind = e.get("kind", "?")
+            if kind == "healthz":
+                what = (f"healthz {e.get('from')} → {e.get('to')}"
+                        + (f" ({', '.join(e.get('failing') or [])})"
+                           if e.get("failing") else ""))
+            elif kind == "flightrec":
+                what = f"flight record: {e.get('reason', '?')}"
+            else:
+                what = kind + "".join(
+                    f" {k}={e[k]}" for k in ("slo", "rule", "severity",
+                                             "reason", "episode")
+                    if e.get(k))
+            lines.append(f"    {_fmt_clock(e.get('t', 0))}  {what}")
+    return "\n".join(lines) + "\n"
+
+
+def _history_main(args) -> int:
+    tsdbmod = _tsdb_import()
+    d = args.tsdb_dir or os.environ.get(tsdbmod.ENV_DIR, "")
+    if not d or not os.path.isdir(d):
+        print("obs_top: --since/--replay read the on-disk telemetry "
+              "history — pass --tsdb-dir (or set HEATMAP_TSDB_DIR)",
+              file=sys.stderr)
+        return 2
+    reader = tsdbmod.TsdbReader(d)
+    members = reader.members()
+    if not members:
+        print(f"obs_top: no tsdb members under {d}", file=sys.stderr)
+        return 1
+    tag = args.member or members[0]
+    if tag not in members:
+        print(f"obs_top: member {tag!r} not in {members}",
+              file=sys.stderr)
+        return 1
+    since_s = args.since if args.since is not None else 3600.0
+    if not args.replay:
+        sys.stdout.write(render_history(tsdbmod, d, tag, since_s))
+        return 0
+    # replay: the same window as a growing sequence of frames — the
+    # incident unfolding.  Frame times anchor on the DATA's newest
+    # sample, so a canned directory replays identically.
+    series = reader.series(tag)
+    newest = max((pts[-1][0] for pts in series.values() if pts),
+                 default=0.0)
+    for t, _s, _f in reader.healthz(tag):
+        newest = max(newest, t)
+    if newest <= 0:
+        print(f"obs_top: member {tag!r} has no retained samples",
+              file=sys.stderr)
+        return 1
+    steps = max(2, min(12, int(args.frames)))
+    t_start = newest - since_s
+    for i in range(1, steps + 1):
+        t1 = t_start + since_s * i / steps
+        frame = render_history(tsdbmod, d, tag, t1 - t_start, until=t1)
+        if not args.no_clear and sys.stdout.isatty():
+            sys.stdout.write("\x1b[2J\x1b[H")
+        sys.stdout.write(frame)
+        if i < steps:
+            sys.stdout.write("---\n")
+            sys.stdout.flush()
+            time.sleep(max(0.0, args.interval
+                           if sys.stdout.isatty() else 0.0))
+    sys.stdout.flush()
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--url", default="http://127.0.0.1:5000")
@@ -806,7 +1093,26 @@ def main(argv=None) -> int:
     ap.add_argument("--fleet", action="store_true",
                     help="per-member fleet view off /fleet/metrics "
                          "(needs a supervisor channel)")
+    ap.add_argument("--since", type=float, default=None,
+                    help="time-machine view: render the last SINCE "
+                         "seconds from the on-disk telemetry history "
+                         "(obs.tsdb) instead of polling a live "
+                         "endpoint")
+    ap.add_argument("--replay", action="store_true",
+                    help="animate the --since window as a growing "
+                         "sequence of frames (default window 3600 s)")
+    ap.add_argument("--tsdb-dir", default="",
+                    help="telemetry history directory (default "
+                         "$HEATMAP_TSDB_DIR)")
+    ap.add_argument("--member", default="",
+                    help="history member tag (default: first member "
+                         "found)")
+    ap.add_argument("--frames", type=int, default=8,
+                    help="--replay frame count (2..12)")
     args = ap.parse_args(argv)
+
+    if args.since is not None or args.replay:
+        return _history_main(args)
 
     metrics_path = "/fleet/metrics" if args.fleet else "/metrics"
     health_path = "/fleet/healthz" if args.fleet else "/healthz"
