@@ -759,6 +759,12 @@ def main() -> dict:
         # config.load_config (one truthiness rule for the knob), not
         # re-implemented here.
         "govern": {"enabled": env_cfg.govern},
+        # reducer-set provenance (ISSUE 19): which fold reducers the
+        # round's env enabled (HEATMAP_REDUCERS, inherited by the e2e
+        # attach).  kalman pays per-entity Kalman work a count-only
+        # round never sees, so check_bench_regress refuses to compare
+        # artifacts whose sets differ.
+        "reducers": {"set": list(env_cfg.reducers)},
         # EFFECTIVE knob provenance: the values this round actually ran
         # with.  BENCH_r02-r05 banked CPU-fallback rounds with nothing
         # in the artifact saying which flush-K/prefetch the e2e attach
@@ -1069,6 +1075,13 @@ def _e2e_runtime_attach() -> dict:
             # silently carrying default provenance
             "e2e_runtime_knobs": e2e.get("effective"),
             "e2e_runtime_govern": e2e.get("govern"),
+            # reducer-set + entity-table outcome of the attach run
+            # (ISSUE 19) — which reducers the e2e rate actually paid
+            # for, and how much tracking state the kalman reducer held
+            **({"e2e_runtime_reducers": e2e["reducers"]}
+               if isinstance(e2e.get("reducers"), dict) else {}),
+            **({"e2e_runtime_infer": e2e["infer"]}
+               if isinstance(e2e.get("infer"), dict) else {}),
             # integrity provenance (obs.audit): stamped top-level as
             # ``audit`` too (below) so check_bench_regress can refuse
             # a round whose conservation ledger reported a leak or a

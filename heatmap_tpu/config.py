@@ -457,6 +457,36 @@ class Config:
                                        # fold stays full; 1 = poll
                                        # exactly one feed shape — the
                                        # byte-exact differential mode)
+    reducers: tuple[str, ...] = ("count",)  # HEATMAP_REDUCERS: the
+                                       # per-step reducer set riding the
+                                       # dispatched columnar batches
+                                       # (infer/reducer.py); "count" is
+                                       # the fused device fold itself
+                                       # and is always a member —
+                                       # default leaves the hot path
+                                       # byte-identical to pre-reducer
+                                       # runtimes
+    entity_capacity: int = 1 << 17     # HEATMAP_ENTITY_CAPACITY:
+                                       # per-shard entity slot-table
+                                       # bound (infer/entities.py);
+                                       # TTL then exact-LRU eviction
+                                       # past it
+    entity_ttl_s: float = 900.0        # HEATMAP_ENTITY_TTL_S: entity
+                                       # silent past this (event time)
+                                       # is evicted; also the dt clamp
+                                       # on filter transitions
+    entity_shards: int = 0             # HEATMAP_ENTITY_SHARDS: logical
+                                       # entity-partition shard count
+                                       # for handoff re-seeds (0 = the
+                                       # runtime's HEATMAP_SHARDS); set
+                                       # N on a 1-process run to apply
+                                       # the exact re-seed decisions an
+                                       # N-shard fleet would
+    entity_stop_s: float = 120.0       # HEATMAP_ENTITY_STOP_S: filtered
+                                       # speed below the stop gate for
+                                       # this long (after having moved)
+                                       # raises the stopped-vehicle
+                                       # anomaly
 
     @property
     def tile_seconds(self) -> int:
@@ -594,6 +624,17 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         shard_res=_int(e, "HEATMAP_SHARD_RES", Config.shard_res),
         shard_oversample=_int(e, "HEATMAP_SHARD_OVERSAMPLE",
                               Config.shard_oversample),
+        reducers=tuple(
+            s.strip() for s in e.get("HEATMAP_REDUCERS", "count").split(",")
+            if s.strip()),
+        entity_capacity=_int(e, "HEATMAP_ENTITY_CAPACITY",
+                             Config.entity_capacity),
+        entity_ttl_s=_float(e, "HEATMAP_ENTITY_TTL_S",
+                            Config.entity_ttl_s),
+        entity_shards=_int(e, "HEATMAP_ENTITY_SHARDS",
+                           Config.entity_shards),
+        entity_stop_s=_float(e, "HEATMAP_ENTITY_STOP_S",
+                             Config.entity_stop_s),
         cq=e.get("HEATMAP_CQ", "1") not in ("0", "false", ""),
         cq_max_queries=_int(e, "HEATMAP_CQ_MAX_QUERIES",
                             Config.cq_max_queries),
@@ -748,6 +789,28 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         raise ValueError(
             f"HEATMAP_SHARD_OVERSAMPLE must be in 0..64, "
             f"got {cfg.shard_oversample}")
+    # reducer-set validation lives with the protocol (canonical order,
+    # closed name set, mandatory count member)
+    from heatmap_tpu.infer.reducer import parse_reducers
+
+    object.__setattr__(cfg, "reducers", parse_reducers(
+        ",".join(cfg.reducers) if isinstance(cfg.reducers, (tuple, list))
+        else cfg.reducers))
+    if cfg.entity_capacity < 8:
+        raise ValueError(
+            f"HEATMAP_ENTITY_CAPACITY must be >= 8, "
+            f"got {cfg.entity_capacity}")
+    if cfg.entity_ttl_s <= 0:
+        raise ValueError(
+            f"HEATMAP_ENTITY_TTL_S must be > 0, got {cfg.entity_ttl_s}")
+    if cfg.entity_shards < 0:
+        raise ValueError(
+            f"HEATMAP_ENTITY_SHARDS must be >= 0 (0 = HEATMAP_SHARDS), "
+            f"got {cfg.entity_shards}")
+    if cfg.entity_stop_s <= 0:
+        raise ValueError(
+            f"HEATMAP_ENTITY_STOP_S must be > 0, "
+            f"got {cfg.entity_stop_s}")
     if cfg.cq_max_queries < 1:
         raise ValueError(
             f"HEATMAP_CQ_MAX_QUERIES must be >= 1, "

@@ -1,0 +1,46 @@
+"""Streaming inference engine: pluggable reducers over the columnar fold.
+
+Every analytic before this package was a counting fold (count/avg/p95,
+engine/step.py).  This package generalizes the per-batch consumption
+into a :class:`~heatmap_tpu.infer.reducer.Reducer` set selected by
+``HEATMAP_REDUCERS`` (default ``count`` — the fused device fold itself,
+byte-identical to the pre-reducer runtime by construction), and adds
+the first non-counting reducer: a vmapped constant-velocity Kalman
+filter over a bounded per-entity slot table (PAPERS.md "Large Scale
+Estimation in Cyberphysical Systems using Streaming Data"), producing
+
+- a count-weighted per-cell velocity field (optional tile-doc columns
+  riding serve/wire.py's exact-only fixed-point rule),
+- short-horizon occupancy forecasts (``/api/tiles/forecast?h=``,
+  scored retroactively against the history tier by
+  tools/score_forecast.py), and
+- reason-tagged per-entity anomaly events (stopped / teleport /
+  deviation) delivered through the view's replication feed and the
+  ``anomaly`` continuous-query type (query/continuous.py).
+
+All of it rides the SAME dispatched batches the fused fold consumes —
+host-resident EventColumns, zero extra device pulls.
+"""
+
+from heatmap_tpu.infer.engine import ANOMALY_REASONS, InferenceEngine
+from heatmap_tpu.infer.entities import EntityTable
+from heatmap_tpu.infer.reducer import (
+    KNOWN_REDUCERS,
+    CountReducer,
+    KalmanReducer,
+    Reducer,
+    build_reducers,
+    parse_reducers,
+)
+
+__all__ = [
+    "ANOMALY_REASONS",
+    "CountReducer",
+    "EntityTable",
+    "InferenceEngine",
+    "KNOWN_REDUCERS",
+    "KalmanReducer",
+    "Reducer",
+    "build_reducers",
+    "parse_reducers",
+]

@@ -1,0 +1,127 @@
+"""The Reducer protocol: what one per-step analytic over the fold is.
+
+A reducer consumes the SAME packed columnar batches the fused device
+fold dispatches (stream.events.EventColumns, host-resident on every
+batch) and owns three lifecycle points:
+
+- ``fold(cols, ts_wall)``  — one dispatched batch, in dispatch order;
+- ``emit()``               — drain whatever the reducer produced since
+  the last drain (reducer-shaped: anomaly events, velocity fields);
+- ``snapshot()/restore()`` — checkpointed alongside the window state,
+  so replay-from-checkpoint equals the uninterrupted run.
+
+``HEATMAP_REDUCERS`` selects the set.  ``count`` names the fused
+device histogram fold itself — it is ALWAYS a member (the runtime's
+device dispatch is its implementation; :class:`CountReducer` is the
+protocol-shaped handle benches and the composed-overhead accounting
+hold).  With only ``count`` enabled the runtime constructs nothing
+from this package on the hot path, which is what makes the count
+path's byte-identity pin hold by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+KNOWN_REDUCERS = ("count", "kalman")
+
+
+@runtime_checkable
+class Reducer(Protocol):
+    """One per-step analytic riding the dispatched columnar batches."""
+
+    name: str
+
+    def fold(self, cols, ts_wall: float) -> None:
+        """Consume one dispatched batch (host EventColumns)."""
+
+    def emit(self) -> dict:
+        """Drain outputs produced since the last emit()."""
+
+    def snapshot(self) -> dict:
+        """Checkpoint payload (str -> numpy array)."""
+
+    def restore(self, data: dict) -> None:
+        """Restore from a :meth:`snapshot` payload."""
+
+
+class CountReducer:
+    """The fused device histogram fold, as a protocol-shaped handle.
+
+    The actual fold runs on the device (engine/step.py merge_batch) —
+    this object folds nothing and checkpoints nothing (TileState
+    already is the count reducer's checkpoint).  It exists so reducer
+    selection, bench accounting, and the composed-overhead stamp treat
+    the count path uniformly with every later reducer."""
+
+    name = "count"
+
+    def fold(self, cols, ts_wall: float) -> None:  # device-side; no-op
+        return None
+
+    def emit(self) -> dict:
+        return {}
+
+    def snapshot(self) -> dict:
+        return {}
+
+    def restore(self, data: dict) -> None:
+        return None
+
+
+class KalmanReducer:
+    """Per-entity constant-velocity Kalman filtering (infer.engine)."""
+
+    name = "kalman"
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def fold(self, cols, ts_wall: float) -> None:
+        self.engine.fold_batch(cols, ts_wall=ts_wall)
+
+    def emit(self) -> dict:
+        return {"anomalies": self.engine.drain_anomalies()}
+
+    def snapshot(self) -> dict:
+        return self.engine.snapshot()
+
+    def restore(self, data: dict) -> None:
+        self.engine.restore(data)
+
+
+def parse_reducers(spec: str) -> tuple:
+    """Normalize a ``HEATMAP_REDUCERS`` value to a validated, ordered,
+    deduplicated tuple.  ``count`` is mandatory: the device fold always
+    runs — a set that pretends otherwise would stamp artifacts with a
+    reducer set the runtime cannot honor."""
+    names = [s.strip() for s in str(spec).split(",") if s.strip()]
+    seen: list = []
+    for n in names:
+        if n not in KNOWN_REDUCERS:
+            raise ValueError(
+                f"HEATMAP_REDUCERS names unknown reducer {n!r}; known: "
+                f"{','.join(KNOWN_REDUCERS)}")
+        if n not in seen:
+            seen.append(n)
+    if "count" not in seen:
+        raise ValueError(
+            "HEATMAP_REDUCERS must include 'count' (the fused device "
+            "fold always runs; extra reducers ride its batches)")
+    # canonical order = KNOWN_REDUCERS order, so artifact stamps and
+    # regression-family comparisons never see two spellings of one set
+    return tuple(n for n in KNOWN_REDUCERS if n in seen)
+
+
+def build_reducers(cfg, metrics=None, registry=None, clock=None) -> list:
+    """Instantiate the configured reducer set (count first)."""
+    from heatmap_tpu.infer.engine import InferenceEngine
+
+    out: list = []
+    for name in cfg.reducers:
+        if name == "count":
+            out.append(CountReducer())
+        elif name == "kalman":
+            out.append(KalmanReducer(InferenceEngine(
+                cfg, metrics=metrics, registry=registry, clock=clock)))
+    return out

@@ -213,6 +213,7 @@ def publish_member_snapshot(channel_path: str, tag: str, *, role: str,
                             cq: dict | None = None,
                             hist: dict | None = None,
                             delivery: dict | None = None,
+                            infer: dict | None = None,
                             left: bool = False) -> None:
     """Atomic write of one member's full observability snapshot:
     Prometheus exposition text of its registry, its freshness summary,
@@ -268,6 +269,14 @@ def publish_member_snapshot(channel_path: str, tag: str, *, role: str,
         # without subscribers or with HEATMAP_DELIVERY off, keeping
         # snapshots byte-compatible
         payload["delivery"] = delivery
+    if infer:
+        # the member's streaming-inference block (infer.engine
+        # InferenceEngine.member_block: entity-table occupancy/capacity,
+        # seed/evict/reseed counts, per-reason anomaly totals) — what
+        # ``obs_top --fleet`` renders per runtime shard; absent on
+        # members without the kalman reducer, keeping snapshots
+        # byte-compatible
+        payload["infer"] = infer
     if left:
         payload["left"] = True
     try:

@@ -24,6 +24,20 @@ Query menu (one registered spec each, compiled once by query.geom):
                   so "entity" here means "occupied cell".
 - ``threshold`` — per-cell count threshold: ``above``/``below`` edge
                   alerts for cells crossing it.
+- ``anomaly``   — per-entity anomaly subscription over the streaming
+                  inference engine's event feed (infer.engine): a
+                  reason-tagged event (stopped / teleport / deviation)
+                  whose cell falls inside the registered region pushes
+                  a match naming the entity and reason.  Events ride
+                  the same replicated mutation stream as tile applies
+                  (``kind="anomaly"`` records, matview.publish_
+                  anomalies), so the zero-writer-cost property holds
+                  identically: the writer carries no per-anomaly work
+                  for queries registered on replicas.  Unlike the four
+                  tile-shaped types, an anomaly query keeps NO edge
+                  state — it is a pure filtered event stream, so
+                  resync/reset mints nothing and replays skip on seq
+                  idempotently.
 
 Evaluation is O(changed), never O(registered): each query's compiled
 ``CellSet`` is filed in two per-grid inverted indexes — sliver cells
@@ -64,7 +78,7 @@ from heatmap_tpu.query.pyramid import cell_to_parent
 
 log = logging.getLogger(__name__)
 
-QUERY_TYPES = ("range", "topk", "geofence", "threshold")
+QUERY_TYPES = ("range", "topk", "geofence", "threshold", "anomaly")
 
 
 def _chain_ids(fine, coarse, all_q):
@@ -92,8 +106,8 @@ class Query:
     evaluation is a pure shadow scan)."""
 
     __slots__ = ("id", "spec", "type", "grid", "cellset", "k",
-                 "threshold", "expires_mono", "created_unix", "state",
-                 "counts", "events", "ev_next", "matches",
+                 "threshold", "reasons", "expires_mono", "created_unix",
+                 "state", "counts", "events", "ev_next", "matches",
                  "index_keys")
 
     def __init__(self, qid: str, spec: dict, grid: str, cellset,
@@ -106,6 +120,9 @@ class Query:
         self.cellset = cellset          # geom.CellSet | None (whole grid)
         self.k = k
         self.threshold = threshold
+        # anomaly: accepted reason tags (None = every reason)
+        self.reasons = (frozenset(spec["reasons"])
+                        if spec.get("reasons") else None)
         self.expires_mono = expires_mono
         self.created_unix = time.time()
         self.state: set = set()         # geofence occupied / threshold above
@@ -128,6 +145,8 @@ class Query:
             d["k"] = self.k
         if self.type == "threshold":
             d["threshold"] = self.threshold
+        if self.type == "anomaly" and self.reasons is not None:
+            d["reasons"] = sorted(self.reasons)
         if self.expires_mono is not None:
             d["expires_in_s"] = round(
                 max(0.0, self.expires_mono - time.monotonic()), 1)
@@ -324,8 +343,19 @@ class ContinuousQueryEngine:
                 raise ValueError(
                     "polygon must be [[lon, lat], ...] with >= 3 points")
             out["polygon"] = [[float(x), float(y)] for x, y in p]
-        elif qtype == "geofence":
-            raise ValueError("geofence queries need a bbox or polygon")
+        elif qtype in ("geofence", "anomaly"):
+            raise ValueError(f"{qtype} queries need a bbox or polygon")
+        if qtype == "anomaly":
+            reasons = spec.get("reasons")
+            if reasons is not None:
+                from heatmap_tpu.infer import ANOMALY_REASONS
+
+                if (not isinstance(reasons, (list, tuple)) or not reasons
+                        or any(r not in ANOMALY_REASONS for r in reasons)):
+                    raise ValueError(
+                        f"reasons must be a non-empty list drawn from "
+                        f"{'/'.join(ANOMALY_REASONS)}, got {reasons!r}")
+                out["reasons"] = sorted(set(reasons))
         if qtype == "topk":
             k = spec.get("k", 10)
             if not isinstance(k, int) or not 1 <= k <= 1000:
@@ -571,6 +601,48 @@ class ContinuousQueryEngine:
                 g.wins[int(ws)] = {d["cellId"]: int(d.get("count", 0))
                                    for d in docs}
             self._retarget(grid, g, seq)
+        elif kind == "anomaly":
+            self._anomaly_record(rec, seq)
+
+    def _anomaly_record(self, rec: dict, seq: int) -> None:
+        """Match one inference anomaly batch against anomaly
+        subscribers through the same inverted indexes the tile types
+        use — O(events x candidates-of-their-cells), never
+        O(registered).  Event cells are snapped at the grid's base res
+        by the inference engine (infer.engine._raise_events), so index
+        membership is exact here too."""
+        grid = rec.get("grid") or ""
+        g = self._grids.get(grid)
+        if g is None:
+            return
+        ws = g.latest() or 0
+        for ev in rec.get("events") or []:
+            cid = ev.get("cell")
+            reason = ev.get("reason")
+            if not cid or not reason:
+                continue
+            try:
+                ci = int(cid, 16)
+            except ValueError:
+                continue
+            fine = g.index.get(ci)
+            coarse = g.pindex.get(cell_to_parent(ci, g.index_res))
+            for qid in list(_chain_ids(fine, coarse, g.all)):
+                q = self._queries.get(qid)
+                if q is None or q.type != "anomaly":
+                    continue
+                if q.reasons is not None and reason not in q.reasons:
+                    continue
+                if self._c_evals is not None:
+                    self._c_evals.inc()
+                self._emit(q, "anomaly", seq, grid, ws, cid=cid,
+                           extra={"entity": ev.get("entity"),
+                                  "reason": reason,
+                                  "score": ev.get("score"),
+                                  "lat": ev.get("lat"),
+                                  "lon": ev.get("lon"),
+                                  "speedKmh": ev.get("speedKmh"),
+                                  "eventT": ev.get("t")})
 
     def _apply_record(self, docs, seq: int) -> None:
         """One apply record, evaluated at RECORD granularity.  A window
@@ -766,7 +838,8 @@ class ContinuousQueryEngine:
 
     def _emit(self, q: Query, kind: str, seq: int, grid: str, ws: int,
               cid: str | None = None, count: int | None = None,
-              topk: list | None = None) -> None:
+              topk: list | None = None,
+              extra: dict | None = None) -> None:
         ev = {"id": q.ev_next, "query": q.id, "kind": kind, "seq": seq,
               "grid": grid, "windowStart": ws,
               "t": round(time.time(), 3)}
@@ -776,6 +849,8 @@ class ContinuousQueryEngine:
             ev["count"] = int(count)
         if topk is not None:
             ev["topk"] = topk
+        if extra:
+            ev.update({k: v for k, v in extra.items() if v is not None})
         q.ev_next += 1
         q.matches += 1
         q.events.append(ev)

@@ -441,6 +441,27 @@ class TileMatView:
                 self._emit(rec)
             return len(applied)
 
+    def publish_anomalies(self, grid: str, events: list) -> None:
+        """Fan an inference anomaly batch (infer.engine event dicts)
+        into the mutation feed: one seq bump, one ``kind="anomaly"``
+        record through the hook + watchers.  Runs on the writer thread
+        via submit_mark, AFTER the batch's tile writes — an anomaly is
+        never announced before the window state that produced it is
+        durable.  Deliberately does NOT touch mod_seq / window_seq or
+        the digest table: events are not tile content, so tile ETags,
+        delta logs, and window digests stay byte-identical to a run
+        with the reducer off.  Replicas relay the record verbatim
+        (replica_apply advances seq on unknown kinds), so a replica's
+        continuous-query engine sees the same stream as the writer's."""
+        if not events:
+            return
+        with self._cond:
+            self._seq += 1
+            rec = {"kind": "anomaly", "seq": self._seq, "grid": grid,
+                   "events": list(events)}
+            self._cond.notify_all()
+            self._emit(rec)
+
     def poison(self) -> None:
         """An apply failed: the view may have diverged from the store.
         Serving falls back to direct Store renders; SSE waiters wake."""

@@ -32,6 +32,12 @@ Contract parity notes (all against /root/reference/app.py):
     between the windows anchored at t0 and t1 (day-over-day diffs).
   - GET /api/hist/index | /api/hist/chunk?name= → the chunk store
     re-exported for remote replicas (cold-start backfill + range).
+- GET /api/tiles/forecast?h=<seconds>[&res=] → short-horizon occupancy
+  forecast (infer.engine, HEATMAP_REDUCERS=count,kalman): tracked
+  entities advected along their filtered velocities for h seconds,
+  snapped and counted per cell; 503 on workers without the engine.
+  ``baseTs`` stamps the prediction's anchor so tools/score_forecast.py
+  can line it up against the history tier retroactively.
 - GET /api/tiles/delta?since=<seq> → changed cells only since view seq
   ``since`` + the next seq: {"mode": "delta"|"full", "seq", "grid",
   "windowStart", "features": [...]}.  mode="full" means REPLACE the
@@ -49,9 +55,10 @@ Contract parity notes (all against /root/reference/app.py):
   home is the replica fleet, where standing-query load scales
   horizontally at zero writer cost):
   - POST /api/queries — register a standing query: JSON body
-    {"type": "range"|"topk"|"geofence"|"threshold", "grid"?, "bbox"?
-    [minLon,minLat,maxLon,maxLat] (minLon>maxLon wraps the
+    {"type": "range"|"topk"|"geofence"|"threshold"|"anomaly", "grid"?,
+    "bbox"? [minLon,minLat,maxLon,maxLat] (minLon>maxLon wraps the
     antimeridian), "polygon"? [[lon,lat],...], "k"?, "threshold"?,
+    "reasons"? (anomaly: subset of stopped/teleport/deviation),
     "ttl_s"? (0 = never expires)} → the query description with its
     ``id``; 400 with the validation error otherwise.
   - DELETE /api/queries?id= → unregister; GET /api/queries[?id=] →
@@ -178,7 +185,8 @@ def _tile_props(doc: dict) -> dict:
         "windowStart": _iso(doc["windowStart"]),
         "windowEnd": _iso(doc["windowEnd"]),
     }
-    for extra in ("p95SpeedKmh", "stddevSpeedKmh", "windowMinutes"):
+    for extra in ("p95SpeedKmh", "stddevSpeedKmh", "windowMinutes",
+                  "vxKmh", "vyKmh"):
         if extra in doc:
             props[extra] = doc[extra]
     return props
@@ -958,6 +966,7 @@ _ADMIT_PATHS = {
     "/api/tiles/range": "range",
     "/api/tiles/at": "at",
     "/api/tiles/diff": "diff",
+    "/api/tiles/forecast": "forecast",
 }
 
 
@@ -2308,6 +2317,55 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 _mk("lookup")
                 if span is not None:
                     span.scan = histmod.last_scan()
+                ctype = "application/json"
+            elif path == "/api/tiles/forecast":
+                # short-horizon occupancy forecast (infer.engine): every
+                # tracked entity advected along its filtered velocity
+                # for h seconds, snapped, counted — answered straight
+                # off the entity table, so it needs the runtime's
+                # inference engine (HEATMAP_REDUCERS=count,kalman) in
+                # THIS process; serve-only replicas 503 (the table
+                # never replicates — it is filter state, not view
+                # content)
+                endpoint = "forecast"
+                infer_eng = (getattr(runtime, "infer", None)
+                             if runtime is not None else None)
+                if infer_eng is None:
+                    return _unavailable(
+                        "occupancy forecasts need the streaming "
+                        "inference engine (HEATMAP_REDUCERS="
+                        "count,kalman) in the serving process")
+                params = _qs_params(environ.get("QUERY_STRING", ""))
+                h_s = _qs_int(params, "h", 60, 3600)
+                if h_s <= 0:
+                    return _bad_request("h= must be in 1..3600 seconds")
+                res, err = _parse_res(params)
+                if err:
+                    return _bad_request(err)
+                if res is None:
+                    res = infer_eng.base_res
+                _mk("parse")
+                cells = infer_eng.forecast_cells(float(h_s), res)
+                blk = infer_eng.member_block()
+                feats = []
+                for ci in sorted(cells):
+                    cid = format(ci, "x")
+                    props = {"cellId": cid, "count": cells[ci]}
+                    feats.append(
+                        '{"type": "Feature", "geometry": '
+                        + _cell_geometry_json(cid)
+                        + ', "properties": ' + json.dumps(props) + '}')
+                # baseTs: the newest folded event timestamp — the
+                # forecast predicts baseTs + h, which is what
+                # tools/score_forecast.py lines up against the history
+                # tier retroactively
+                head = json.dumps({"h": h_s, "res": res,
+                                   "baseTs": blk["max_event_ts"],
+                                   "entities": blk["entities"]})
+                data = (head[:-1] + ', "features": ['
+                        + ", ".join(feats) + ']}').encode("utf-8")
+                _account_render(endpoint, data)
+                _mk("lookup")
                 ctype = "application/json"
             elif path.startswith("/api/hist/"):
                 # the chunk store re-exported over HTTP: what a remote

@@ -26,7 +26,16 @@ a live ``EventSource`` on ``/api/queries/stream`` — a pushed match
 flashes the fence outline and, when the matched cell is on the map,
 the cell polygon itself.  Workers without the engine (404/503) skip
 the layer silently; the query list refreshes once a minute so fences
-registered after page load appear."""
+registered after page load appear.
+
+Streaming-inference overlays (PR 19, infer.engine) degrade the same
+way: tiles carrying the optional ``vxKmh``/``vyKmh`` velocity columns
+draw a per-cell arrow along the smoothed field (absent columns — the
+count-only configuration — draw nothing), and ``anomaly`` standing
+queries ride the same EventSource as fences: a pushed anomaly match
+drops a pulsing marker at the event position naming the entity and
+reason, with the plain fence flash as the fallback when the event has
+no coordinates."""
 
 from __future__ import annotations
 
@@ -104,11 +113,17 @@ const hexes = L.geoJSON(null, {
                `<br/>avg speed: ${Number(p.avgSpeedKmh).toFixed(1)} km/h`;
     if (p.p95SpeedKmh !== undefined)
       html += `<br/>p95 speed: ${Number(p.p95SpeedKmh).toFixed(1)} km/h`;
+    if (p.vxKmh !== undefined && p.vyKmh !== undefined)
+      html += `<br/>velocity: ${Math.hypot(Number(p.vxKmh),
+               Number(p.vyKmh)).toFixed(1)} km/h`;
     layer.bindPopup(html);
     cellLayers.set(p.cellId, layer);
   }
 }).addTo(map);
 const vehicles = L.layerGroup().addTo(map);
+// inference velocity-field arrows (optional vxKmh/vyKmh tile columns)
+const velArrows = L.layerGroup().addTo(map);
+const arrowLayers = new Map();              // cellId -> arrow layer
 
 function rampColor(c) {
   let col = RAMP[0][1];
@@ -161,6 +176,35 @@ let jsonPerFeat = 600;  // learned from real full-JSON bodies
 function clearHexes() {
   hexes.clearLayers();
   cellLayers.clear();
+  velArrows.clearLayers();
+  arrowLayers.clear();
+}
+
+// one arrow along the cell's smoothed velocity: shaft = ~30 s of
+// travel at the field speed, head = two short back-swept segments.
+// No-op (and removes a stale arrow) when the tile carries no velocity
+// columns — the count-only configuration renders exactly as before.
+function updateArrow(cellId, p, center) {
+  const old = arrowLayers.get(cellId);
+  if (old) { velArrows.removeLayer(old); arrowLayers.delete(cellId); }
+  if (p.vxKmh === undefined || p.vyKmh === undefined || !center) return;
+  const vx = Number(p.vxKmh), vy = Number(p.vyKmh);
+  const spd = Math.hypot(vx, vy);
+  if (!(spd > 0.5)) return;           // parked cells stay clean
+  const mPerDeg = 111320;
+  const cos = Math.max(Math.cos(center.lat * Math.PI / 180), 1e-6);
+  const dLat = (vy / 3.6) * 30 / mPerDeg;
+  const dLng = (vx / 3.6) * 30 / (mPerDeg * cos);
+  const tip = [center.lat + dLat, center.lng + dLng];
+  const ang = Math.atan2(dLat, dLng * cos);
+  const hl = Math.hypot(dLat, dLng * cos) * 0.35;
+  const head = a => [tip[0] - hl * Math.sin(a),
+                     tip[1] - hl * Math.cos(a) / cos];
+  const arrow = L.polyline(
+    [[center.lat, center.lng], tip, head(ang + 0.5), tip, head(ang - 0.5)],
+    {color: '#083d77', weight: 2, opacity: 0.85, interactive: false});
+  velArrows.addLayer(arrow);
+  arrowLayers.set(cellId, arrow);
 }
 
 function applyFeatures(features) {
@@ -168,6 +212,10 @@ function applyFeatures(features) {
     const old = cellLayers.get(f.properties.cellId);
     if (old) hexes.removeLayer(old);
     hexes.addData(f);  // onEachFeature re-registers the cellId
+    const layer = cellLayers.get(f.properties.cellId);
+    if (layer && layer.getBounds)
+      updateArrow(f.properties.cellId, f.properties,
+                  layer.getBounds().getCenter());
   }
 }
 
@@ -212,13 +260,22 @@ function decodeWireFrame(buf) {
     }
     return out;
   }
-  let np = 0, ns = 0;
-  for (const f of dflags) { if (f & 1) np++; if (f & 2) ns++; }
+  let np = 0, ns = 0, nw = 0, no = 0, nx = 0, ny = 0;
+  for (const f of dflags) {
+    if (f & 1) np++; if (f & 2) ns++; if (f & 4) nw++;
+    if (f & 8) no++; if (f & 16) nx++; if (f & 32) ny++;
+  }
   const speeds = fcol(n), p95 = fcol(np); fcol(ns);  // stddev unused
-  const feats = []; let ip = 0;
+  for (let i = 0; i < nw; i++) varint();  // windowMinutes unused
+  pos += 16 * no;                         // per-doc window overrides
+  // velocity columns are present only when some doc is flagged
+  const vx = nx ? fcol(nx) : [], vy = ny ? fcol(ny) : [];
+  const feats = []; let ip = 0, xp = 0, yp = 0;
   for (let i = 0; i < n; i++) {
     const f = {cellId: cells[i], count: counts[i], avgSpeedKmh: speeds[i]};
     if (dflags[i] & 1) f.p95SpeedKmh = p95[ip++];
+    if (dflags[i] & 16) f.vxKmh = vx[xp++];
+    if (dflags[i] & 32) f.vyKmh = vy[yp++];
     feats.push(f);
   }
   return {mode: (flags & 1) ? 'full' : 'delta', seq: seq, features: feats};
@@ -232,9 +289,14 @@ function updateCellInPlace(layer, p) {
              `<br/>avg speed: ${Number(p.avgSpeedKmh).toFixed(1)} km/h`;
   if (p.p95SpeedKmh !== undefined)
     html += `<br/>p95 speed: ${Number(p.p95SpeedKmh).toFixed(1)} km/h`;
+  if (p.vxKmh !== undefined && p.vyKmh !== undefined)
+    html += `<br/>velocity: ${Math.hypot(Number(p.vxKmh),
+             Number(p.vyKmh)).toFixed(1)} km/h`;
   layer.setPopupContent ? layer.setPopupContent(html) : layer.bindPopup(html);
   if (layer.feature && layer.feature.properties)
     Object.assign(layer.feature.properties, p);
+  if (layer.getBounds)
+    updateArrow(p.cellId, p, layer.getBounds().getCenter());
 }
 
 async function fetchFullJson(gridQS) {
@@ -386,7 +448,8 @@ function flash(layer, color) {
 }
 
 function fenceOutline(q) {
-  const style = {color: q.type === 'geofence' ? '#7b1fa2' : '#1451c4',
+  const style = {color: q.type === 'geofence' ? '#7b1fa2'
+                        : q.type === 'anomaly' ? '#c62828' : '#1451c4',
                  weight: 1.5, dashArray: '6 4', fill: false};
   if (q.bbox) {
     const [w, s, e, n] = q.bbox;
@@ -401,6 +464,23 @@ function fenceOutline(q) {
   return null;
 }
 
+const anomalyMarks = L.layerGroup().addTo(map);
+
+function anomalyPulse(m) {
+  const mk = L.circleMarker([Number(m.lat), Number(m.lon)],
+    {radius: 10, weight: 2, color: '#c62828', fillColor: '#ff5252',
+     fillOpacity: 0.6});
+  mk.bindPopup(`<b>${esc(m.reason || 'anomaly')}</b> ` +
+               `${esc(m.entity || '?')}` +
+               (m.score !== undefined
+                ? `<br/>score: ${Number(m.score).toFixed(1)}` : '') +
+               (m.speedKmh !== undefined
+                ? `<br/>speed: ${Number(m.speedKmh).toFixed(1)} km/h` : ''));
+  anomalyMarks.addLayer(mk);
+  // fade after 15 s so a busy stream never accumulates markers
+  setTimeout(() => anomalyMarks.removeLayer(mk), 15000);
+}
+
 function subscribeFence(q) {
   if (fenceStreams.size >= MAX_FENCE_STREAMS ||
       fenceStreams.has(q.id) || !window.EventSource) return;
@@ -409,6 +489,17 @@ function subscribeFence(q) {
   es.addEventListener('match', ev => {
     let m;
     try { m = JSON.parse(ev.data); } catch (e) { return; }
+    if (m.kind === 'anomaly') {
+      // inference anomaly push: pulse a marker at the event position
+      // naming entity + reason; no coordinates (older server) falls
+      // back to the plain fence/cell flash below
+      if (m.lat !== undefined && m.lon !== undefined)
+        anomalyPulse(m);
+      flash(fenceLayers.get(q.id), '#c62828');
+      if (m.cell) flash(cellLayers.get(m.cell), '#c62828');
+      status(`anomaly ${esc(m.reason || '?')} ${esc(m.entity || '?')}`);
+      return;
+    }
     flash(fenceLayers.get(q.id), m.kind === 'exit' ? '#607d8b' : '#e91e63');
     if (m.cell) flash(cellLayers.get(m.cell), '#e91e63');
     status(`${q.type} ${m.kind}${m.cell ? ' ' + esc(m.cell) : ''}`);
@@ -431,7 +522,8 @@ async function refreshQueries() {
         const layer = fenceOutline(q);
         if (layer) { fences.addLayer(layer); fenceLayers.set(q.id, layer); }
       }
-      if (q.type === 'geofence' || q.type === 'range') subscribeFence(q);
+      if (q.type === 'geofence' || q.type === 'range' ||
+          q.type === 'anomaly') subscribeFence(q);
     }
     for (const [id, layer] of fenceLayers) {
       if (!seen.has(id)) {  // expired/deleted: drop outline + stream

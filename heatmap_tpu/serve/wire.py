@@ -18,7 +18,9 @@ Frame layout (all little-endian)::
     varint n_docs
     u8[n] per-doc flags          bit0 p95, bit1 stddev,
                                  bit2 windowMinutes, bit3 per-doc
-                                 window override
+                                 window override, bit4 vxKmh,
+                                 bit5 vyKmh (inference velocity
+                                 field, infer.engine)
     cells   n zigzag varints     delta vs the PREVIOUS cell id (H3
                                  uint64; same-area ids share high
                                  bits, so deltas are short), doc
@@ -35,6 +37,12 @@ Frame layout (all little-endian)::
     stddev  u8 enc + values      only docs flagged bit1
     wmin    varints              only docs flagged bit2
     overrides i64 pairs          (ws_us, we_us) for docs flagged bit3
+    vx      u8 enc + values      only docs flagged bit4 — APPENDED
+    vy      u8 enc + values      only docs flagged bit5, and present
+                                 only when some doc carries the flag,
+                                 so a velocity-free frame is byte-
+                                 identical to the pre-velocity layout
+                                 (the count-path differential pin)
 
 ``decode(encode(docs))`` reproduces the doc values EXACTLY (datetimes
 through integer-µs epoch math, floats bit-for-bit), so rendering the
@@ -79,6 +87,8 @@ _D_P95 = 0x01
 _D_STD = 0x02
 _D_WMIN = 0x04
 _D_WOVR = 0x08
+_D_VX = 0x10   # vxKmh (east) — inference velocity field
+_D_VY = 0x20   # vyKmh (north)
 
 ENC_F64 = 0
 ENC_FIXED = 1  # x100 zigzag varint; engaged only when exact
@@ -174,8 +184,8 @@ def _prep_float_col(vals: list) -> tuple[int, list]:
 # -------------------------------------------------------------- encoding
 def _column_arrays(docs, ws_dt, we_dt):
     """(flags, cell_deltas, counts, speeds, p95, stddev, wmin,
-    overrides) lists for the column section; raises ValueError on docs
-    the layout cannot represent exactly."""
+    overrides, vx, vy) lists for the column section; raises ValueError
+    on docs the layout cannot represent exactly."""
     flags: list = []
     deltas: list = []
     counts: list = []
@@ -184,6 +194,8 @@ def _column_arrays(docs, ws_dt, we_dt):
     stddev: list = []
     wmin: list = []
     overrides: list = []
+    vx: list = []
+    vy: list = []
     prev = 0
     for doc in docs:
         f = 0
@@ -223,6 +235,14 @@ def _column_arrays(docs, ws_dt, we_dt):
                                  "int")
             f |= _D_WMIN
             wmin.append(v)
+        for key, bit, col in (("vxKmh", _D_VX, vx),
+                              ("vyKmh", _D_VY, vy)):
+            v = doc.get(key)
+            if v is not None:
+                if type(v) is not float:
+                    raise ValueError(f"{key} is not a float")
+                f |= bit
+                col.append(v)
         d_ws, d_we = doc["windowStart"], doc["windowEnd"]
         if d_ws != ws_dt or d_we != we_dt:
             if (d_ws.tzinfo is None) != (ws_dt.tzinfo is None):
@@ -231,7 +251,8 @@ def _column_arrays(docs, ws_dt, we_dt):
             overrides.append(_dt_us(d_ws))
             overrides.append(_dt_us(d_we))
         flags.append(f)
-    return flags, deltas, counts, speeds, p95, stddev, wmin, overrides
+    return (flags, deltas, counts, speeds, p95, stddev, wmin, overrides,
+            vx, vy)
 
 
 def _encode_float_column(buf: bytearray, vals: list) -> None:
@@ -277,8 +298,12 @@ def encode(mode: str, seq: int, grid: str, window_start, docs,
     if not docs:
         return bytes(head)
     cols = _column_arrays(docs, ws_dt, we_dt)
-    if native is not None:
-        body = _encode_body_native(native, *cols)
+    # the native column writer predates the velocity columns: use it
+    # only for frames without them (the count-path common case, which
+    # therefore stays byte-identical through the C++ path), and let
+    # velocity-carrying frames take the Python writer
+    if native is not None and not cols[8] and not cols[9]:
+        body = _encode_body_native(native, *cols[:8])
         if body is not None:
             return bytes(head) + body
     return bytes(head) + encode_body_py(*cols)
@@ -321,10 +346,12 @@ def _encode_body_native(native, flags, deltas, counts, speeds, p95,
 
 
 def encode_body_py(flags, deltas, counts, speeds, p95, stddev, wmin,
-                   overrides) -> bytes:
+                   overrides, vx=(), vy=()) -> bytes:
     """The column section, pure Python — the portable fallback and the
     correctness oracle the native encoder is differential-tested
-    against (byte-identical output required)."""
+    against (byte-identical output required).  The velocity columns
+    are appended only when non-empty, so a velocity-free body is
+    byte-identical to the pre-velocity layout."""
     buf = bytearray(bytes(flags))
     for d in deltas:
         _put_varint(buf, _zigzag(d))
@@ -337,6 +364,10 @@ def encode_body_py(flags, deltas, counts, speeds, p95, stddev, wmin,
         _put_varint(buf, w)
     if overrides:
         buf += struct.pack(f"<{len(overrides)}q", *overrides)
+    if vx:
+        _encode_float_column(buf, list(vx))
+    if vy:
+        _encode_float_column(buf, list(vy))
     return bytes(buf)
 
 
@@ -418,6 +449,8 @@ def _decode(buf: bytes) -> dict:
     n_std = sum(1 for f in dflags if f & _D_STD)
     n_wmin = sum(1 for f in dflags if f & _D_WMIN)
     n_ovr = sum(1 for f in dflags if f & _D_WOVR)
+    n_vx = sum(1 for f in dflags if f & _D_VX)
+    n_vy = sum(1 for f in dflags if f & _D_VY)
     speeds, pos = _decode_float_column(mv, pos, n)
     p95, pos = _decode_float_column(mv, pos, n_p95)
     stddev, pos = _decode_float_column(mv, pos, n_std)
@@ -425,10 +458,15 @@ def _decode(buf: bytes) -> dict:
     for _ in range(n_wmin):
         u, pos = _get_varint(mv, pos)
         wmin.append(u)
-    overrides = list(struct.unpack_from(f"<{2 * n_ovr}q", mv, pos)) \
-        if n_ovr else []
+    if n_ovr:
+        overrides = list(struct.unpack_from(f"<{2 * n_ovr}q", mv, pos))
+        pos += 16 * n_ovr
+    else:
+        overrides = []
+    vx, pos = _decode_float_column(mv, pos, n_vx) if n_vx else ([], pos)
+    vy, pos = _decode_float_column(mv, pos, n_vy) if n_vy else ([], pos)
     docs = []
-    ip = sp = wp = op = 0
+    ip = sp = wp = op = xp = yp = 0
     for i in range(n):
         f = dflags[i]
         if f & _D_WOVR:
@@ -449,6 +487,12 @@ def _decode(buf: bytes) -> dict:
         if f & _D_WMIN:
             doc["windowMinutes"] = wmin[wp]
             wp += 1
+        if f & _D_VX:
+            doc["vxKmh"] = vx[xp]
+            xp += 1
+        if f & _D_VY:
+            doc["vyKmh"] = vy[yp]
+            yp += 1
         docs.append(doc)
     return {"mode": "full" if flags & _F_FULL else "delta", "seq": seq,
             "grid": grid, "window_start": ws_dt, "docs": docs}

@@ -91,12 +91,27 @@ class CheckpointManager:
                     f"is idempotent) or replay with the writing version")
             return TileState(**{k: z[k] for k in TileState._fields})
 
+    def load_extra(self, name: str, epoch: int | None = None) -> dict | None:
+        """A named extras payload committed alongside the window state
+        (``extra-<name>.npz``), or None when the commit predates it —
+        e.g. the inference engine's entity table (infer.engine).  Extras
+        are auxiliary: absence never blocks a resume."""
+        d = self._commit_dir(epoch)
+        if d is None:
+            return None
+        path = os.path.join(d, f"extra-{name}.npz")
+        if not os.path.exists(path):
+            return None
+        with np.load(path) as z:
+            return {k: z[k] for k in z.files}
+
     # --- write ----------------------------------------------------------
     def commit(self, offset: Any, max_event_ts: int, epoch: int,
                states: dict[tuple[int, int], TileState] | None = None,
                shards: int | None = None,
                snap_impl: str | None = None,
-               mesh_mode: str | None = None) -> None:
+               mesh_mode: str | None = None,
+               extras: dict[str, dict] | None = None) -> None:
         """``shards``: the writer's local shard-block count.  Recorded so
         a restart can tell a capacity change (absorbable: pad/grow) from a
         shard-count change (NOT absorbable: rows would be reinterpreted as
@@ -114,7 +129,13 @@ class CheckpointManager:
         vs "partitioned" (H3 parent cell, PartitionedAggregator).  Same
         shape, different key ownership: restoring one into the other
         would silently duplicate groups across devices, so the resume
-        refuses a mismatch (stream.runtime._maybe_resume)."""
+        refuses a mismatch (stream.runtime._maybe_resume).
+
+        ``extras``: named auxiliary payloads ({name: {key: array}}) —
+        reducer state riding the same atomic commit as the window state
+        it must stay consistent with (torn against each other, a resume
+        would re-fold replayed batches into already-folded filter
+        state)."""
         name = f"commit-{epoch:012d}"
         cdir = os.path.join(self.dir, name)
         tmp = cdir + ".tmp"
@@ -123,6 +144,9 @@ class CheckpointManager:
         for (res, win), st in (states or {}).items():
             np.savez(os.path.join(tmp, f"state-{res}-{win}.npz"),
                      **{k: np.asarray(v) for k, v in st._asdict().items()})
+        for ename, payload in (extras or {}).items():
+            np.savez(os.path.join(tmp, f"extra-{ename}.npz"),
+                     **{k: np.asarray(v) for k, v in payload.items()})
         meta = {"offset": offset, "max_event_ts": int(max_event_ts),
                 "epoch": int(epoch)}
         if shards is not None:

@@ -47,16 +47,23 @@ GAUGE_NAMES = frozenset({
 #                 every poll — labeled apart so partition-skew drops
 #                 don't read as misrouted-topic trouble
 #   exchange      all_to_all lane-skew overflow (parallel.sharded)
+#   handoff       cross-shard entity handoff re-seeds (infer.engine):
+#                 the event itself WAS folded by the count path — the
+#                 tag records the Kalman reducer discarding an entity's
+#                 cross-shard filter history, so it is always accounted
+#                 with audit=False (outside the event-conservation
+#                 identity, which stays closed without it)
 # ``Metrics.drop`` validates against this set (tests pin it closed) and
 # keeps the legacy flat counters in lockstep.
 DROP_REASONS = ("invalid", "late", "out_of_shard", "oversample",
-                "exchange")
+                "exchange", "handoff")
 _DROP_LEGACY = {
     "invalid": "events_invalid",
     "late": "events_late",
     "out_of_shard": "events_out_of_shard",
     "oversample": "events_out_of_shard",
     "exchange": "events_bucket_dropped",
+    "handoff": "infer_handoff_reseed",
 }
 
 
@@ -107,9 +114,10 @@ class Metrics:
         self.dropped = self.registry.counter(
             "heatmap_events_dropped_total",
             "events discarded per closed drop reason (invalid, late, "
-            "out_of_shard, oversample, exchange) — the conservation "
-            "ledger's dropped{reason} term; an untagged drop path is a "
-            "permanent audit residual",
+            "out_of_shard, oversample, exchange, handoff) — the "
+            "conservation ledger's dropped{reason} term; an untagged "
+            "drop path is a permanent audit residual (handoff is "
+            "filter-state-only and rides outside the ledger)",
             labels=("reason",))
         for r in DROP_REASONS:
             self.dropped.labels(reason=r)

@@ -288,6 +288,25 @@ class MicroBatchRuntime:
                               repl_pub=self.repl_pub)
             self.writer.audit = self.audit
             self.metrics.audit = self.audit
+        # Streaming inference engine (heatmap_tpu.infer): the reducer
+        # set riding the dispatched columnar batches.  With the default
+        # HEATMAP_REDUCERS=count NOTHING is constructed here and no
+        # per-batch work is added — the count path stays byte-identical
+        # to the pre-reducer runtime by construction.  With kalman on,
+        # the engine folds every dispatched batch (post-ownership-filter
+        # on sharded runs: this shard's entities only), raises anomaly
+        # events through the view feed, and enriches tile docs with the
+        # per-cell velocity field.
+        self.infer = None
+        if "kalman" in cfg.reducers:
+            from heatmap_tpu.infer import InferenceEngine
+
+            self.infer = InferenceEngine(cfg, metrics=self.metrics)
+            log.info("inference engine on: reducers=%s capacity=%d "
+                     "partition=%s", ",".join(cfg.reducers),
+                     cfg.entity_capacity,
+                     self.infer.partition.n_shards
+                     if self.infer.partition is not None else 1)
         # lineage ids are origin-tagged so the fleet aggregator
         # (obs.fleet) can stitch this shard's stage contributions with
         # other members' (e.g. a serve worker's view_apply) by lid
@@ -1056,6 +1075,15 @@ class MicroBatchRuntime:
                         f"restore STATE_CAPACITY_LOG2/SPEED_HIST_BINS or "
                         f"clear {self.cfg.checkpoint_dir}"
                     ) from e2
+        if self.infer is not None:
+            # extras are auxiliary: a commit predating the reducer (or
+            # written with kalman off) yields None and the engine simply
+            # starts cold — filters re-seed from the replayed stream
+            data = self.ckpt.load_extra("infer", epoch=at_epoch)
+            if data is not None:
+                self.infer.restore(data, self._intern_v)
+                log.info("restored inference entity table: %d entities",
+                         self.infer.table.occupancy)
 
     @property
     def _snap_impl_name(self) -> str:
@@ -1276,7 +1304,8 @@ class MicroBatchRuntime:
             self.ckpt.commit(self._offsets_dispatched, self.max_event_ts,
                              self.epoch, states, shards=self._local_shards,
                              snap_impl=self._snap_impl_name,
-                             mesh_mode=self._mesh_mode)
+                             mesh_mode=self._mesh_mode,
+                             extras=self._infer_extras())
             self.metrics.count("checkpoints")
             return
         # Single host: capture fresh-buffer device copies + offsets now
@@ -1290,6 +1319,11 @@ class MicroBatchRuntime:
         }
         offset = self._offsets_dispatched
         epoch, max_ts = self.epoch, self.max_event_ts
+        # reducer state is captured SYNCHRONOUSLY on the step thread —
+        # it must cover exactly the dispatched batches the offsets
+        # cover, and the next step's fold would mutate it under the
+        # background thread
+        extras = self._infer_extras()
 
         def commit():
             try:
@@ -1301,7 +1335,8 @@ class MicroBatchRuntime:
                 self.ckpt.commit(offset, max_ts, epoch, states,
                                  shards=self._local_shards,
                                  snap_impl=self._snap_impl_name,
-                                 mesh_mode=self._mesh_mode)
+                                 mesh_mode=self._mesh_mode,
+                                 extras=extras)
                 self.metrics.count("checkpoints")
             except BaseException as e:  # surfaced on the step thread
                 self._ckpt_err = e
@@ -1309,6 +1344,15 @@ class MicroBatchRuntime:
         self._ckpt_thread = threading.Thread(target=commit,
                                              name="ckpt-commit", daemon=True)
         self._ckpt_thread.start()
+
+    def _infer_extras(self) -> dict | None:
+        """Checkpoint extras payload: the inference engine's entity
+        table, committed atomically WITH the window state + offsets
+        (torn, a resume would re-fold replayed batches into
+        already-folded filter state)."""
+        if self.infer is None:
+            return None
+        return {"infer": self.infer.snapshot()}
 
     def _ckpt_join(self, raise_errors: bool = True) -> None:
         t = self._ckpt_thread
@@ -1419,19 +1463,46 @@ class MicroBatchRuntime:
         n_docs = int(np.count_nonzero(
             (body[:, 8] != 0) & (body[:, 3].view(np.int32) > 0)))
         if n_docs:
-            self.writer.submit_tiles_packed(body, self._pack_meta[(res, wmin)])
-            if self.audit is not None:
-                # integrity observatory: the emit-side ledger stamp and
-                # THIS shard's digest table (obs.audit) — decoded with
-                # the same oracle the store/view use, so the table is
-                # exactly the docs downstream will hold for this
-                # shard's (disjoint) cell space.  Audit-on cost only;
-                # observe-only either way.
+            vel = (self.infer.velocity_field(res)
+                   if self.infer is not None else None)
+            if vel:
+                # kalman reducer on: decode the packed rows host-side and
+                # ride the smoothed per-cell velocity field into the docs
+                # as optional columns.  The audit digest table applies the
+                # SAME enriched docs, so digest coverage of the new
+                # columns is automatic (doc_hash spans every key).  With
+                # count-only reducers self.infer is None and this branch
+                # is dead — the packed fast path below stays byte-for-
+                # byte what it was.
                 from heatmap_tpu.sink.base import packed_tile_docs
 
-                self.audit.add("docs_emitted", n_docs)
-                self.audit.shard_table(shard).apply_docs(
-                    packed_tile_docs(body, self._pack_meta[(res, wmin)]))
+                docs = packed_tile_docs(body, self._pack_meta[(res, wmin)])
+                for d in docs:
+                    v = vel.get(int(d["cellId"], 16))
+                    if v is not None:
+                        # round(·, 2) keeps the serve wire's fixed-point
+                        # x100 encoding exact (serve/wire.py ENC_FIXED)
+                        d["vxKmh"] = round(v[0], 2)
+                        d["vyKmh"] = round(v[1], 2)
+                self.writer.submit_tiles(docs)
+                if self.audit is not None:
+                    self.audit.add("docs_emitted", n_docs)
+                    self.audit.shard_table(shard).apply_docs(docs)
+            else:
+                self.writer.submit_tiles_packed(
+                    body, self._pack_meta[(res, wmin)])
+                if self.audit is not None:
+                    # integrity observatory: the emit-side ledger stamp
+                    # and THIS shard's digest table (obs.audit) — decoded
+                    # with the same oracle the store/view use, so the
+                    # table is exactly the docs downstream will hold for
+                    # this shard's (disjoint) cell space.  Audit-on cost
+                    # only; observe-only either way.
+                    from heatmap_tpu.sink.base import packed_tile_docs
+
+                    self.audit.add("docs_emitted", n_docs)
+                    self.audit.shard_table(shard).apply_docs(
+                        packed_tile_docs(body, self._pack_meta[(res, wmin)]))
         self.metrics.count("tiles_emitted", n_docs)
         return self._account_stats(res, wmin, stats, epoch, shard=shard)
 
@@ -1626,6 +1697,8 @@ class MicroBatchRuntime:
                        if self.audit is not None else None),
                 hist=(self.hist_compactor.member_block()
                       if self.hist_compactor is not None else None),
+                infer=(self.infer.member_block()
+                       if self.infer is not None else None),
                 left=left)
         except Exception:  # noqa: BLE001 - never kill the step loop
             log.warning("fleet member snapshot publish failed",
@@ -2221,6 +2294,32 @@ class MicroBatchRuntime:
             wm_max - self.cfg.watermark_minutes * 60
             if wm_max > I32_MIN else I32_MIN
         )
+        infer_s = 0.0
+        if self.infer is not None and cols is not None:
+            # reducer fold BEFORE the device dispatch, not after: the
+            # Kalman scan shares the XLA CPU queue with the window-fold
+            # program, and a scan dispatched right after step_packed
+            # serializes behind that entire program (~8x the idle-device
+            # scan time, measured) — whereas here the ring flush above
+            # has already drained the device, so the scan runs against
+            # an idle queue and the window fold then overlaps the NEXT
+            # batch's feed exactly as before
+            t_inf = time.monotonic()
+            self.infer.fold_batch(cols)
+            ievents = self.infer.drain_anomalies()
+            if ievents and self.matview is not None:
+                # anomaly records ride the writer thread like every view
+                # mutation (single-writer discipline), then fan out via
+                # the view's feed hook + watchers: repl followers and
+                # the anomaly continuous-query engine see them at zero
+                # extra writer cost.  They carry no doc mutations, so
+                # queueing ahead of this batch's (deferred) doc applies
+                # is order-safe.
+                grid = self.cfg.default_grid()
+                view = self.matview
+                self.writer.submit_mark(
+                    lambda: view.publish_anomalies(grid, ievents))
+            infer_s = time.monotonic() - t_inf
         t_ready = time.monotonic()
         prekeys = entry.prekeys
         if cols is None and self._host_snap is not None:
@@ -2329,7 +2428,6 @@ class MicroBatchRuntime:
             if prows is not None:
                 self.writer.submit_positions_packed(prows)
                 self.metrics.count("positions_emitted", len(prows.ts_ms))
-
         self.epoch += 1
         t_sink = time.monotonic()
         # refill the prefetch queue AFTER the dispatch: the next batch's
@@ -2370,6 +2468,12 @@ class MicroBatchRuntime:
                   "shard_filter"):
             if k in espans:
                 spans[k] = espans[k]
+        if self.infer is not None:
+            # reducer-set fold cost as ITS OWN span (it runs pre-
+            # dispatch, between feed and device, so no other span
+            # absorbs it) — a composed-fold regression shows up here,
+            # not as a mystery elsewhere
+            spans["infer"] = infer_s
         self.metrics.observe_batch(t_end - t0, spans)
         # structured trace record (obs.tracebuf -> /trace/recent, JSONL).
         # Late/overflow counts account up to emit_flush_k batches behind

@@ -121,6 +121,20 @@ class MemorySource(Source):
     def offset(self):
         return self._consumed
 
+    def seek(self, offset) -> None:
+        """Fast-forward to a committed offset (checkpoint resume over a
+        freshly re-fed deque).  The deque is consume-once, so rewinding
+        below the consumed position is impossible — refuse loudly
+        rather than silently replaying rows a resume already covered."""
+        target = int(offset or 0)
+        if target < self._consumed:
+            raise ValueError(
+                f"MemorySource cannot rewind: consumed {self._consumed}, "
+                f"seek target {target}; re-feed the deque from the start")
+        while self._consumed < target and self._q:
+            self._q.popleft()
+            self._consumed += 1
+
     @property
     def exhausted(self) -> bool:
         return self._done and not self._q

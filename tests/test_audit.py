@@ -104,7 +104,7 @@ def test_drop_reason_set_is_closed():
     from heatmap_tpu.stream.metrics import DROP_REASONS, Metrics
 
     assert DROP_REASONS == ("invalid", "late", "out_of_shard",
-                            "oversample", "exchange")
+                            "oversample", "exchange", "handoff")
     m = Metrics()
     for r in DROP_REASONS:
         m.drop(r, 2)
@@ -112,6 +112,7 @@ def test_drop_reason_set_is_closed():
     assert m.counters["events_late"] == 2
     assert m.counters["events_out_of_shard"] == 4  # + oversample
     assert m.counters["events_bucket_dropped"] == 2
+    assert m.counters["infer_handoff_reseed"] == 2
     text = m.registry.expose_text()
     for r in DROP_REASONS:
         assert f'heatmap_events_dropped_total{{reason="{r}"}} 2' in text

@@ -1,0 +1,415 @@
+"""Streaming inference engine units (ISSUE 19): reducer selection,
+the bounded entity table, the vmapped Kalman rounds scan, and the
+engine's policy layer (anomalies, velocity fields, forecasts) — plus
+the retroactive forecast scorer's pure functions.
+
+The load-bearing invariant everywhere: per-entity observation order is
+(ts, stream order), a total order invariant under ANY re-batching, so
+filter state / velocity fields / forecasts are byte-identical whether
+a stream arrives as one batch or many — and anomaly event sets are
+exactly reproducible (publication order may differ across batch
+boundaries; the tests compare sorted multisets).
+"""
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+from heatmap_tpu.config import load_config
+from heatmap_tpu.infer.engine import InferenceEngine
+from heatmap_tpu.infer.entities import EntityTable
+from heatmap_tpu.infer.kalman import M_PER_DEG, filter_rounds
+from heatmap_tpu.infer.reducer import (
+    CountReducer,
+    build_reducers,
+    parse_reducers,
+)
+from heatmap_tpu.stream.events import columns_from_arrays
+
+LAT0, LNG0 = 42.36, -71.06
+
+
+def _cfg(**kw):
+    kw.setdefault("store", "memory")
+    kw.setdefault("serve_port", 0)
+    kw.setdefault("reducers", ("count", "kalman"))
+    return load_config({}, **kw)
+
+
+# ------------------------------------------------------------ reducers
+def test_parse_reducers_normalizes_and_validates():
+    assert parse_reducers("count") == ("count",)
+    # canonical order + dedup: one spelling per set, however written
+    assert parse_reducers("kalman,count") == ("count", "kalman")
+    assert parse_reducers(" count , kalman , count ") == ("count", "kalman")
+    with pytest.raises(ValueError, match="unknown reducer"):
+        parse_reducers("count,sgd")
+    with pytest.raises(ValueError, match="must include 'count'"):
+        parse_reducers("kalman")
+
+
+def test_build_reducers_composition():
+    rs = build_reducers(_cfg())
+    assert [r.name for r in rs] == ["count", "kalman"]
+    # count alone constructs no engine — the byte-identity pin holds
+    # by construction on the default path
+    only = build_reducers(_cfg(reducers=("count",)))
+    assert len(only) == 1 and isinstance(only[0], CountReducer)
+    assert only[0].emit() == {} and only[0].snapshot() == {}
+
+
+# -------------------------------------------------------- entity table
+def test_entity_table_seed_lookup_ttl_lru():
+    t = EntityTable(8)
+    vids = np.arange(8, dtype=np.int64)
+    t.seed(vids, [f"v{i}" for i in range(8)],
+           np.full(8, LAT0, np.float32), np.full(8, LNG0, np.float32),
+           np.arange(1000, 1008, dtype=np.int64),
+           np.zeros(8, np.int16), now_ts=1008, ttl_s=900.0,
+           p0_pos=625.0, p0_vel=100.0)
+    assert t.occupancy == 8
+    assert list(t.slots_of(vids)) == sorted(t.slots_of(vids))
+    # TTL: entities silent past the ttl free their slots
+    assert t.evict_ttl(now_ts=1004 + 900, ttl_s=900.0) == 4
+    assert t.occupancy == 4
+    assert (t.slots_of(vids[:4]) < 0).all()
+    assert (t.slots_of(vids[4:]) >= 0).all()
+    # LRU: a full table evicts the globally oldest last-observation
+    # slots first, exactly as many as the shortfall (now_ts close
+    # enough that the TTL sweep can't free anything first)
+    newv = np.arange(8, 14, dtype=np.int64)
+    t.seed(newv, [f"v{i}" for i in newv],
+           np.full(6, LAT0, np.float32), np.full(6, LNG0, np.float32),
+           np.full(6, 1500, np.int64), np.zeros(6, np.int16),
+           now_ts=1500, ttl_s=900.0, p0_pos=625.0, p0_vel=100.0)
+    assert t.occupancy == 8
+    assert t.n_evicted_lru == 2  # v4, v5 were oldest
+    assert (t.slots_of(np.array([4, 5])) < 0).all()
+    assert (t.slots_of(np.array([6, 7])) >= 0).all()
+    # conservation: every seed is still tracked or accounted evicted
+    assert t.n_seeded == t.occupancy + t.n_evicted_ttl + t.n_evicted_lru
+
+
+def test_entity_table_snapshot_restore_roundtrip():
+    t = EntityTable(16)
+    vids = np.arange(5, dtype=np.int64)
+    t.seed(vids, [f"veh-{i}" for i in range(5)],
+           np.full(5, LAT0, np.float32), np.full(5, LNG0, np.float32),
+           np.arange(100, 105, dtype=np.int64), np.zeros(5, np.int16),
+           now_ts=105, ttl_s=900.0, p0_pos=625.0, p0_vel=100.0)
+    t.x[t.slots_of(vids)] = np.arange(20, dtype=np.float32).reshape(5, 4)
+    snap = t.snapshot()
+    # restore into a FRESH intern map: names are the stable key,
+    # intern ids are not
+    t2 = EntityTable(16)
+    intern = {}
+    assert t2.restore(snap, intern) == 5
+    assert t2.occupancy == 5
+    s2 = t2.slots_of(np.asarray([intern[f"veh-{i}"] for i in range(5)],
+                                np.int64))
+    assert (s2 >= 0).all()
+    np.testing.assert_array_equal(
+        t2.x[s2], t.x[t.slots_of(vids)])
+    # capacity shrink keeps the most recently observed entities
+    t3 = EntityTable(8)
+    big = EntityTable(16)
+    vids = np.arange(12, dtype=np.int64)
+    big.seed(vids, [f"veh-{i}" for i in range(12)],
+             np.full(12, LAT0, np.float32), np.full(12, LNG0, np.float32),
+             np.arange(100, 112, dtype=np.int64), np.zeros(12, np.int16),
+             now_ts=112, ttl_s=900.0, p0_pos=625.0, p0_vel=100.0)
+    assert t3.restore(big.snapshot(), {}) == 8
+    kept = {n for n in t3.names if n}
+    assert kept == {f"veh-{i}" for i in range(4, 12)}
+
+
+def test_entity_table_capacity_floor():
+    with pytest.raises(ValueError, match=">= 8"):
+        EntityTable(4)
+
+
+# ------------------------------------------------------------- kalman
+def _run_rounds(z, dt, valid=None, reseed=None, x=None, P=None):
+    k, m = z.shape[:2]
+    if valid is None:
+        valid = np.ones((k, m), bool)
+    if reseed is None:
+        reseed = np.zeros((k, m), bool)
+    if x is None:
+        x = np.zeros((m, 4), np.float32)
+    if P is None:
+        P = np.zeros((m, 4, 4), np.float32)
+        P[:, 0, 0] = P[:, 1, 1] = 625.0
+        P[:, 2, 2] = P[:, 3, 3] = 100.0
+    return filter_rounds(x, P, z.astype(np.float32),
+                         dt.astype(np.float32), valid, reseed,
+                         q=0.5, r_m=25.0, gate=13.816,
+                         p0_pos=625.0, p0_vel=100.0)
+
+
+def test_kalman_converges_on_constant_velocity():
+    vn, ve = 8.0, -3.0
+    k = 24
+    t = np.arange(1, k + 1, dtype=np.float64) * 5.0
+    z = np.stack([vn * t, ve * t], axis=1)[:, None, :]
+    dt = np.full((k, 1), 5.0)
+    x, P, nis, tele, spd = _run_rounds(z, dt)
+    assert not tele.any()
+    assert abs(x[0, 2] - vn) < 0.5 and abs(x[0, 3] - ve) < 0.5
+    # filtered speed output tracks the true speed once warm
+    true_spd = float(np.hypot(vn, ve))
+    assert abs(spd[-1, 0] - true_spd) < 0.5
+    # covariance stays symmetric positive-diagonal (Joseph + compact
+    # symmetric storage: exact by construction)
+    np.testing.assert_array_equal(P[0], P[0].T)
+    assert (np.diag(P[0]) > 0).all()
+
+
+def test_kalman_gate_reseeds_on_teleport():
+    z = np.array([[[10.0, 0.0]], [[20.0, 0.0]], [[50_000.0, 0.0]]])
+    dt = np.full((3, 1), 5.0)
+    x, P, nis, tele, spd = _run_rounds(z, dt)
+    assert not tele[0, 0] and not tele[1, 0]
+    assert tele[2, 0]
+    # the gated observation does NOT update: state re-seeds at z with
+    # zero velocity and the seed prior
+    np.testing.assert_allclose(x[0, :2], [50_000.0, 0.0])
+    np.testing.assert_allclose(x[0, 2:], [0.0, 0.0])
+    assert P[0, 0, 0] == pytest.approx(625.0)
+    # NIS stays visible on the teleport round — it is the score
+    assert nis[2, 0] > 13.816
+
+
+def test_kalman_handoff_reseed_precedence_over_gate():
+    # an explicit reseed round with an impossible jump is a handoff,
+    # NOT a teleport anomaly
+    z = np.array([[[10.0, 0.0]], [[80_000.0, 0.0]]])
+    dt = np.full((2, 1), 5.0)
+    rs = np.array([[False], [True]])
+    x, P, nis, tele, spd = _run_rounds(z, dt, reseed=rs)
+    assert not tele.any()
+    np.testing.assert_allclose(x[0, :2], [80_000.0, 0.0])
+    assert nis[1, 0] == 0.0  # reseed rounds carry no score
+
+
+def test_kalman_padding_and_dt_clamp_invariance():
+    rng = np.random.default_rng(3)
+    k, m = 5, 6
+    z = rng.normal(0, 50, (k, m, 2))
+    dt = rng.uniform(1, 10, (k, m))
+    out_a = _run_rounds(z.copy(), dt.copy())
+    # wider M (extra always-invalid entities) must not perturb the
+    # original lanes: padding is masked out exactly
+    z2 = np.concatenate([z, rng.normal(0, 50, (k, 3, 2))], axis=1)
+    dt2 = np.concatenate([dt, rng.uniform(1, 10, (k, 3))], axis=1)
+    valid2 = np.ones((k, m + 3), bool)
+    valid2[:, m:] = False
+    out_b = _run_rounds(z2, dt2, valid=valid2)
+    np.testing.assert_array_equal(out_a[0], out_b[0][:m])        # x
+    np.testing.assert_array_equal(out_a[1], out_b[1][:m])        # P
+    for a, b in zip(out_a[2:], out_b[2:]):                       # K x M
+        np.testing.assert_array_equal(a, b[:, :m])
+    # negative dt clamps to a same-time measurement, never negative
+    # time in the transition
+    zc = np.array([[[5.0, 5.0]], [[6.0, 5.0]]])
+    neg = _run_rounds(zc, np.array([[2.0], [-7.0]]))
+    zero = _run_rounds(zc, np.array([[2.0], [0.0]]))
+    np.testing.assert_array_equal(neg[0], zero[0])
+
+
+# ------------------------------------------------------------- engine
+def _fleet_cols(n, t0, rounds, cadence=5.0, v_ms=10.0, stop_after=None):
+    """n vehicles advancing north at v_ms, one observation per round;
+    vehicle i offset east so entities land in distinct cells."""
+    lat, lng, spd, ts, vid = [], [], [], [], []
+    for r in range(rounds):
+        t = t0 + r * cadence
+        for i in range(n):
+            moving = stop_after is None or r < stop_after
+            d = (r * cadence if moving else stop_after * cadence) * v_ms
+            lat.append(LAT0 + d / M_PER_DEG)
+            lng.append(LNG0 + i * 0.02)
+            spd.append(v_ms * 3.6 if moving else 0.0)
+            ts.append(int(t))
+            vid.append(i)
+    return (np.asarray(lat), np.asarray(lng), np.asarray(spd),
+            np.asarray(ts, np.int64), np.asarray(vid, np.int32),
+            [f"veh-{i}" for i in range(n)])
+
+
+def _cols_slice(fleet, sel):
+    lat, lng, spd, ts, vid, names = fleet
+    return columns_from_arrays(lat[sel], lng[sel], spd[sel], ts[sel],
+                               vehicle_id=vid[sel], vehicles=names)
+
+
+def _anom_key(e):
+    return (e["entity"], e["reason"], e["t"], e["cell"], e["score"])
+
+
+def test_engine_rebatching_byte_identity():
+    """One batch vs three batches vs shuffled rows: filter state,
+    velocity fields, and forecasts byte-identical; anomaly multisets
+    equal.  THE invariance the replay differentials build on."""
+    fleet = _fleet_cols(7, 10_000, 12)
+    n = len(fleet[0])
+    engines = []
+    for splits in ([slice(0, n)],
+                   [slice(0, n // 3), slice(n // 3, 2 * n // 3),
+                    slice(2 * n // 3, n)]):
+        eng = InferenceEngine(_cfg())
+        for s in splits:
+            eng.fold_batch(_cols_slice(fleet, s))
+        engines.append(eng)
+    # row order WITHIN a batch must not matter either: the fold sorts
+    # by (vehicle, ts, stream order)
+    rng = np.random.default_rng(5)
+    perm = rng.permutation(n)
+    # keep per-(vehicle, ts) stream order stable: our fleet has unique
+    # (vehicle, ts) pairs, so any permutation is order-safe
+    eng = InferenceEngine(_cfg())
+    eng.fold_batch(_cols_slice(fleet, perm))
+    engines.append(eng)
+    base = engines[0]
+    b_slots = base.table.slots_of(np.arange(7))
+    for other in engines[1:]:
+        o_slots = other.table.slots_of(np.arange(7))
+        np.testing.assert_array_equal(base.table.x[b_slots],
+                                      other.table.x[o_slots])
+        np.testing.assert_array_equal(base.table.P[b_slots],
+                                      other.table.P[o_slots])
+        assert base.velocity_field(8) == other.velocity_field(8)
+        assert base.forecast_cells(120.0, 8) == other.forecast_cells(
+            120.0, 8)
+        assert (sorted(map(_anom_key, base.drain_anomalies()))
+                == sorted(map(_anom_key, other.drain_anomalies())))
+
+
+def test_engine_velocity_field_and_forecast_advect_north():
+    eng = InferenceEngine(_cfg())
+    fleet = _fleet_cols(4, 50_000, 15, v_ms=12.0)
+    eng.fold_batch(_cols_slice(fleet, slice(None)))
+    vf = eng.velocity_field(eng.base_res)
+    assert vf, "warm entities must populate the field"
+    for vx_e, vy_n, cnt in vf.values():
+        # northbound fleet: vy (north) ~= 12 m/s = 43.2 km/h, vx ~ 0
+        assert abs(vy_n - 43.2) < 4.0
+        assert abs(vx_e) < 2.0
+        assert cnt >= 1
+    # the forecast advects the same state: h seconds on, the occupied
+    # cells move north of today's
+    now_cells = eng.forecast_cells(0.0, eng.base_res)
+    fut_cells = eng.forecast_cells(600.0, eng.base_res)
+    assert sum(now_cells.values()) == sum(fut_cells.values()) == 4
+    assert set(fut_cells) != set(now_cells)
+
+
+def test_engine_stopped_anomaly_edge_triggered():
+    eng = InferenceEngine(_cfg(entity_stop_s=30.0))
+    # move 10 rounds, then sit still for 20 rounds (5 s cadence)
+    fleet = _fleet_cols(2, 80_000, 30, stop_after=10)
+    eng.fold_batch(_cols_slice(fleet, slice(None)))
+    evs = eng.drain_anomalies()
+    stopped = [e for e in evs if e["reason"] == "stopped"]
+    # edge-triggered: exactly one per vehicle, not one per still round
+    assert sorted(e["entity"] for e in stopped) == ["veh-0", "veh-1"]
+    assert all(e["speedKmh"] < 3.6 for e in stopped)
+
+
+def test_engine_teleport_anomaly_and_reseed_accounting():
+    eng = InferenceEngine(_cfg())
+    fleet = _fleet_cols(1, 90_000, 8)
+    eng.fold_batch(_cols_slice(fleet, slice(None)))
+    # same vehicle, 60 km away 5 s later: an impossible innovation
+    jump = columns_from_arrays(
+        np.array([LAT0 + 0.55]), np.array([LNG0]), np.array([30.0]),
+        np.array([90_000 + 8 * 5], np.int64),
+        vehicle_id=np.array([0], np.int32), vehicles=["veh-0"])
+    eng.fold_batch(jump)
+    evs = eng.drain_anomalies()
+    tele = [e for e in evs if e["reason"] == "teleport"]
+    assert len(tele) == 1 and tele[0]["entity"] == "veh-0"
+    assert tele[0]["score"] > 13.8
+    assert eng.table.n_reseed_teleport == 1
+    # the filter recovered AT the observed position — in the SAME
+    # reference frame (frames are fixed at seed time; re-anchoring
+    # would make f32 rounding depend on batch boundaries)
+    s = eng.table.slots_of(np.array([0]))[0]
+    pn = float(eng.table.x[s, 0])  # north offset about the seed ref
+    assert abs(pn - 0.55 * M_PER_DEG) < 60.0  # f32 @ 61 km ~ few m
+    np.testing.assert_array_equal(eng.table.x[s, 2:], [0.0, 0.0])
+
+
+def test_engine_snapshot_restore_equals_uninterrupted():
+    fleet = _fleet_cols(5, 70_000, 10)
+    n = len(fleet[0])
+    solid = InferenceEngine(_cfg())
+    solid.fold_batch(_cols_slice(fleet, slice(0, n)))
+
+    first = InferenceEngine(_cfg())
+    first.fold_batch(_cols_slice(fleet, slice(0, n // 2)))
+    snap = first.snapshot()
+    resumed = InferenceEngine(_cfg())
+    intern = {}
+    assert resumed.restore(snap, intern) == 5
+    # replay the tail with the RESUMED intern ids (names are the key)
+    lat, lng, spd, ts, vid, names = fleet
+    sel = slice(n // 2, n)
+    re_vid = np.asarray([intern[names[v]] for v in vid[sel]], np.int32)
+    resumed.fold_batch(columns_from_arrays(
+        lat[sel], lng[sel], spd[sel], ts[sel],
+        vehicle_id=re_vid, vehicles=list(intern)))
+    a = solid.table.slots_of(np.arange(5))
+    b = resumed.table.slots_of(
+        np.asarray([intern[f"veh-{i}"] for i in range(5)], np.int64))
+    np.testing.assert_array_equal(solid.table.x[a], resumed.table.x[b])
+    np.testing.assert_array_equal(solid.table.P[a], resumed.table.P[b])
+    assert solid.forecast_cells(300.0, 8) == resumed.forecast_cells(
+        300.0, 8)
+
+
+def test_engine_member_block_conservation():
+    eng = InferenceEngine(_cfg(entity_capacity=8))
+    fleet = _fleet_cols(20, 60_000, 3)  # 20 entities into 8 slots
+    eng.fold_batch(_cols_slice(fleet, slice(None)))
+    blk = eng.member_block()
+    assert blk["capacity"] == 8 and blk["entities"] == 8
+    assert (blk["seeded"] == blk["entities"] + blk["evicted_ttl"]
+            + blk["evicted_lru"])
+    assert blk["events_folded"] == len(fleet[0])
+
+
+# ----------------------------------------------------- score_forecast
+def _scorer():
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        os.pardir))
+    spec = importlib.util.spec_from_file_location(
+        "score_forecast", os.path.join(repo, "tools", "score_forecast.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_score_forecast_pure_functions():
+    sf = _scorer()
+    feats = [{"cellId": "a", "count": 3}, {"cellId": "b", "count": 1},
+             {"cellId": "a", "count": 1}]
+    assert sf.features_to_counts(feats) == {"a": 4.0, "b": 1.0}
+    assert sf.normalize({"a": 4.0, "b": 1.0}) == {"a": 0.8, "b": 0.2}
+    assert sf.normalize({}) == {}
+    assert sf.mae({"a": 1.0}, {"a": 1.0}) == 0.0
+    assert sf.mae({}, {}) == 0.0
+    # unit-mismatch robustness: scaling every count 100x (events vs
+    # entities) must not move the normalized score at all
+    actual = {"a": 6.0, "b": 3.0, "c": 1.0}
+    fc = {"a": 5.0, "b": 4.0, "c": 1.0}
+    pers = {"a": 1.0, "b": 1.0, "c": 8.0}
+    s1 = sf.score_maps(fc, pers, actual)
+    s2 = sf.score_maps({k: v * 100 for k, v in fc.items()}, pers, actual)
+    assert s1["skill_vs_persistence"] == s2["skill_vs_persistence"]
+    assert s1["skill_vs_persistence"] > 0  # fc is closer than pers
+    # a perfect forecast scores 1.0
+    assert sf.score_maps(actual, pers, actual)[
+        "skill_vs_persistence"] == 1.0

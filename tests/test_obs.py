@@ -575,3 +575,64 @@ def test_obs_top_delivery_row_and_fleet_table():
         top.parse_prom('heatmap_fleet_member_up{proc="r1",role="serve"} 1\n'),
         None, 0.0, None)
     assert "delivery worst replica" not in ff2
+
+
+def test_obs_top_infer_row_and_fleet_table():
+    """The streaming-inference rows (ISSUE 19): the single-process
+    dashboard grows an infer row once the kalman reducer's families
+    exist — tracked entities, fold p50, loudest anomaly reason, table
+    churn — and the fleet view grows a per-member entity-table section
+    with an aggregate entity count."""
+    top = _load_obs_top()
+    text = (
+        "heatmap_infer_entities 120000\n"
+        'heatmap_infer_fold_seconds_bucket{le="0.01"} 2\n'
+        'heatmap_infer_fold_seconds_bucket{le="0.1"} 10\n'
+        'heatmap_infer_fold_seconds_bucket{le="+Inf"} 10\n'
+        'heatmap_infer_anomalies_total{reason="teleport"} 4\n'
+        'heatmap_infer_anomalies_total{reason="stopped"} 1\n'
+        'heatmap_infer_anomalies_total{reason="deviation"} 0\n'
+        'heatmap_infer_entity_events_total{op="seeded"} 130000\n'
+        'heatmap_infer_entity_events_total{op="evicted_ttl"} 9000\n'
+        'heatmap_infer_entity_events_total{op="evicted_lru"} 1000\n'
+        'heatmap_infer_entity_events_total{op="reseed_teleport"} 4\n'
+        'heatmap_infer_entity_events_total{op="reseed_handoff"} 2\n')
+    m = top.parse_prom(text)
+    frame = top.render_frame(m, None, 0.0, None)
+    assert "infer" in frame
+    assert "entities    120,000" in frame
+    assert "anomalies 5 (worst teleport)" in frame
+    assert "evicted 10,000" in frame and "reseeds 6" in frame
+    # count-only build: no families, no row
+    assert "infer" not in top.render_frame({}, None, 0.0, None)
+    # all-zero anomaly counters must not name a "worst" reason
+    mz = top.parse_prom(
+        "heatmap_infer_entities 10\n"
+        'heatmap_infer_anomalies_total{reason="teleport"} 0\n')
+    assert "worst" not in top.render_frame(mz, None, 0.0, None)
+
+    fleet = top.parse_prom(
+        'heatmap_fleet_member_up{proc="s0",role="runtime"} 1\n'
+        'heatmap_fleet_member_up{proc="s1",role="runtime"} 1\n'
+        'heatmap_infer_entities{proc="s0"} 120000\n'
+        'heatmap_infer_entities{proc="s1"} 70000\n'
+        'heatmap_infer_entity_events_total{proc="s0",op="seeded"} 125000\n'
+        'heatmap_infer_entity_events_total{proc="s0",op="evicted_ttl"} 5000\n'
+        'heatmap_infer_entity_events_total{proc="s1",op="seeded"} 70000\n'
+        'heatmap_infer_anomalies_total{proc="s0",reason="teleport"} 6\n'
+        'heatmap_infer_anomalies_total{proc="s1",reason="stopped"} 2\n')
+    fleet_prev = top.parse_prom(
+        'heatmap_infer_anomalies_total{proc="s0",reason="teleport"} 2\n'
+        'heatmap_infer_anomalies_total{proc="s1",reason="stopped"} 2\n')
+    ff = top.render_fleet_frame(fleet, fleet_prev, 2.0, None)
+    assert "infer" in ff
+    assert "120,000" in ff and "70,000" in ff
+    assert "infer tracked entities 190,000 across 2 member(s)" in ff
+    # anomaly rate: (6-2)/2 s = 2.00/s on s0
+    assert "2.00" in ff
+    # without the entities gauge anywhere the section is absent
+    ff2 = top.render_fleet_frame(
+        top.parse_prom(
+            'heatmap_fleet_member_up{proc="s0",role="runtime"} 1\n'),
+        None, 0.0, None)
+    assert "infer tracked entities" not in ff2

@@ -907,6 +907,128 @@ def compare_govern(dir_path: str, threshold: float) -> int:
     return rc
 
 
+# -------------------------------------------------------- infer artifacts
+_INFER_ROUND_RE = re.compile(r"BENCH_INFER_r(\d+)\.json$")
+
+
+def infer_artifact_round(path: str) -> int | None:
+    m = _INFER_ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def reducer_set(path: str) -> tuple | None:
+    """The artifact's reducer-set provenance (``"reducers": {"set":
+    [...]}``, ISSUE 19 — stamped by bench.py / e2e_rate / bench_infer);
+    None on pre-inference artifacts."""
+    v = _stamped(path, "reducers", dict)
+    s = v.get("set") if isinstance(v, dict) else None
+    return tuple(s) if isinstance(s, (list, tuple)) else None
+
+
+def infer_metrics(path: str) -> tuple | None:
+    """(entities_per_sec, forecast_skill, overhead_frac, entities) of
+    one BENCH_INFER_r*.json streaming-inference artifact
+    (tools/bench_infer.py).  entities_per_sec and skill are
+    HIGHER-is-better, overhead_frac LOWER-is-better.  None when the
+    run failed its own gates or the numbers don't parse."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            art = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(art, dict) or art.get("rc", 0) != 0:
+        return None
+    eps = art.get("entities_per_sec")
+    skill = art.get("forecast_skill")
+    over = art.get("overhead_frac")
+    ents = art.get("entities")
+    if not isinstance(eps, (int, float)) or eps <= 0 \
+            or not isinstance(skill, (int, float)) \
+            or not isinstance(over, (int, float)):
+        return None
+    return (float(eps), float(skill), float(over),
+            int(ents) if isinstance(ents, int) else None)
+
+
+def compare_infer(dir_path: str, threshold: float) -> int:
+    """Ratchet the newest two BENCH_INFER_r*.json artifacts: filter
+    throughput (entities/s) and forecast skill may not DROP past
+    ``threshold``, composed-fold overhead may not GROW past it (on a
+    0.10 floor base — overhead near zero would otherwise fail on
+    noise-level point moves).  Pairs banked under DIFFERENT reducer
+    sets are REFUSED (exit 1): a count+kalman fold's cost cannot be
+    ratcheted against a richer or leaner reducer composition — not the
+    same experiment.  Composes with the audit and SLO refusals like
+    every family."""
+    arts = []
+    for p in glob.glob(os.path.join(glob.escape(dir_path),
+                                    "BENCH_INFER_r*.json")):
+        rnd = infer_artifact_round(p)
+        if rnd is None:
+            continue
+        arts.append((rnd, p, infer_metrics(p)))
+    arts.sort()
+    usable = [(r, p, m) for r, p, m in arts if m is not None]
+    for r, p, m in arts:
+        if m is None:
+            print(f"note: skipping infer r{r:02d} "
+                  f"({os.path.basename(p)}): failed gates or no "
+                  f"parseable entities/s + skill + overhead")
+    if len(usable) < 2:
+        print(f"OK: {len(usable)} usable infer artifact(s) — nothing "
+              f"to compare")
+        return 0
+    (r_prev, p_prev, m_prev), (r_new, p_new, m_new) = \
+        usable[-2], usable[-1]
+    if audit_refused(p_prev, f"infer r{r_prev:02d}") \
+            or audit_refused(p_new, f"infer r{r_new:02d}") \
+            or slo_refused(p_prev, f"infer r{r_prev:02d}") \
+            or slo_refused(p_new, f"infer r{r_new:02d}") \
+            or slo_mixed_refused(p_prev, p_new, f"infer r{r_prev:02d}",
+                                 f"infer r{r_new:02d}"):
+        return 1
+    rs_prev, rs_new = reducer_set(p_prev), reducer_set(p_new)
+    if rs_prev is not None and rs_new is not None and rs_prev != rs_new:
+        print(f"FAIL: reducer-set mismatch — infer r{r_prev:02d} "
+              f"folded {','.join(rs_prev)} but r{r_new:02d} folded "
+              f"{','.join(rs_new)}; the composed fold's cost and skill "
+              f"scale with the reducer set, so the pair is not the "
+              f"same experiment (and would mask its regression) — "
+              f"re-run the bench with the same HEATMAP_REDUCERS",
+              file=sys.stderr)
+        return 1
+    (eps_prev, sk_prev, ov_prev, _e_prev) = m_prev
+    (eps_new, sk_new, ov_new, _e_new) = m_new
+    rc = 0
+    for name, prev, new in (("entities_per_sec", eps_prev, eps_new),
+                            ("forecast_skill", sk_prev, sk_new)):
+        if prev <= 0:
+            continue
+        drop = (prev - new) / prev
+        line = (f"infer r{r_prev:02d} {name} {prev:,.4g} -> "
+                f"r{r_new:02d} {new:,.4g} ({-drop:+.1%})")
+        if drop > threshold:
+            print(f"FAIL: infer regression beyond {threshold:.0%}: "
+                  f"{line}", file=sys.stderr)
+            rc = 1
+        else:
+            print(f"OK: {line} within the {threshold:.0%} threshold")
+    # overhead is lower-is-better and typically near zero; growth is
+    # judged against max(prev, 0.10) so a 1% -> 2% point move doesn't
+    # read as a 2x regression while 1% -> 10%+ still fails
+    growth = (ov_new - ov_prev) / max(ov_prev, 0.10)
+    line = (f"infer r{r_prev:02d} overhead_frac {ov_prev:.4f} -> "
+            f"r{r_new:02d} {ov_new:.4f}")
+    if growth > threshold:
+        print(f"FAIL: composed-fold overhead regression beyond "
+              f"{threshold:.0%} of the floored base: {line}",
+              file=sys.stderr)
+        rc = 1
+    else:
+        print(f"OK: {line} within the {threshold:.0%} threshold")
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--dir", default=REPO,
@@ -924,6 +1046,7 @@ def main(argv=None) -> int:
     serve_rc = compare_multichip(args.dir, args.threshold) or serve_rc
     serve_rc = compare_cq(args.dir, args.threshold) or serve_rc
     serve_rc = compare_hist(args.dir, args.threshold) or serve_rc
+    serve_rc = compare_infer(args.dir, args.threshold) or serve_rc
 
     arts = newest_pair(args.dir)
     usable = [(r, p, v) for r, p, v in arts if v is not None]
@@ -979,6 +1102,15 @@ def main(argv=None) -> int:
               f"aggregate cannot stand in for a single-shard headline "
               f"(or mask its regression) — re-run the bench at the same "
               f"shard count", file=sys.stderr)
+        return 1
+    rs_prev, rs_new = reducer_set(p_prev), reducer_set(p_new)
+    if rs_prev is not None and rs_new is not None and rs_prev != rs_new:
+        print(f"FAIL: reducer-set mismatch — r{r_prev:02d} folded "
+              f"{','.join(rs_prev)} but r{r_new:02d} folded "
+              f"{','.join(rs_new)}; a composed-reducer round cannot "
+              f"stand in for a count-only headline (or mask its "
+              f"regression) — re-run the bench with the same "
+              f"HEATMAP_REDUCERS", file=sys.stderr)
         return 1
     drop = (prev - new) / prev
     line = (f"r{r_prev:02d} {prev:,.0f} ev/s -> r{r_new:02d} "

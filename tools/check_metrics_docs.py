@@ -43,6 +43,7 @@ def _smoke_runtime():
             "lon": -71.0, "speedKmh": 1.0, "ts": t0} for i in range(32)]
     cfg = load_config({}, batch_size=16, state_capacity_log2=8,
                       speed_hist_bins=4, store="memory", serve_port=0,
+                      reducers=("count", "kalman"),
                       checkpoint_dir=tempfile.mkdtemp(
                           prefix="metrics-docs-"))
     src = MemorySource(evs)

@@ -246,6 +246,12 @@ def _shard_fleet_child(q, a: dict, index: int) -> None:
         # DIFFERENT effective batch sizes, and the artifact shows it
         "govern": (dict(enabled=True, **rt.governor.snapshot())
                    if rt.governor is not None else {"enabled": False}),
+        "reducers": {"set": list(cfg.reducers)},
+        # per-shard entity table (kalman reducer): tables follow the
+        # H3 partition, so the fleet artifact shows per-shard tracking
+        # occupancy alongside per-shard rate
+        "infer": (rt.infer.member_block()
+                  if getattr(rt, "infer", None) is not None else None),
     })
 
 
@@ -346,6 +352,9 @@ def shard_fleet_main(args) -> int:
             max(steadies) / (sum(steadies) / len(steadies)), 3)
         if len(steadies) > 1 else None,
         "govern": {"enabled": bool(args.govern)},
+        # every child parses the same env, so shard 0's reducer-set
+        # stamp speaks for the fleet
+        "reducers": (results[0].get("reducers") if results else None),
         "per_shard": results,
     }
     from heatmap_tpu.obs.fleet import repl_stamp
@@ -532,7 +541,10 @@ def ramp_main(args) -> int:
         "slo_freshness_p50_ms": float(os.environ.get(
             "HEATMAP_SLO_FRESHNESS_P50_MS", "10000") or 10000),
         "freshness": rt.metrics.freshness_summary(),
+        "reducers": {"set": list(cfg.reducers)},
     }
+    if getattr(rt, "infer", None) is not None:
+        out["infer"] = rt.infer.member_block()
     print(json.dumps(out))
     return 0
 
@@ -929,7 +941,17 @@ def main() -> int:
         # prefetch sweep that buys rate by parking batches longer is
         # visible in the same JSON line
         "freshness": rt.metrics.freshness_summary(),
+        # reducer-set provenance (ISSUE 19): which fold reducers this
+        # run executed — kalman pays per-entity work a count-only run
+        # never sees, so check_bench_regress refuses to compare
+        # artifacts across differing sets
+        "reducers": {"set": list(cfg.reducers)},
     }
+    # entity slot-table outcome when the kalman reducer ran: occupancy
+    # vs capacity, seed/evict/reseed churn, anomaly totals — the
+    # artifact says how much tracking state the rate was earned with
+    if getattr(rt, "infer", None) is not None:
+        out["infer"] = rt.infer.member_block()
     # mesh provenance (ISSUE 11): device count + partitioned-vs-shuffle
     # mode, and on the partitioned path the per-shard accounting the
     # acceptance reads — steady rate, emit pulls vs pulled batches (the

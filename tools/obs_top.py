@@ -34,7 +34,12 @@ serve-wire table: per-worker open clients, negotiated-format mix
 and the SSE fan-out send-queue high-water.  Members running the
 space-time history tier (query/history.py) add a history row (single
 view) and a per-member history table in ``--fleet``: chunks on disk,
-covered span, compaction lag, replica backfills.  With delivery
+covered span, compaction lag, replica backfills.  Members running the
+streaming inference engine (heatmap_tpu.infer, kalman in
+HEATMAP_REDUCERS) add an infer row (tracked entities, fold p50,
+anomaly totals with the loudest reason, table churn) and a per-member
+entity-table section in ``--fleet`` — entity tables follow the H3
+shard partition, so skewed partitions show as skewed entity counts.  With delivery
 lineage on (HEATMAP_DELIVERY=1, obs.delivery) a delivery row joins the
 single view — delivered-age p50/p99 to the subscriber socket, worst
 stage, slow-request count, worst SSE write stall — and ``--fleet``
@@ -274,6 +279,13 @@ def render_frame(m: dict, prev: dict | None, dt: float,
             f"last adjust {fmt(age, ' s ago', digits=0)}"
             + (f" ({last})" if last else "")
             + ("   FROZEN" if frozen else ""))
+    # streaming inference engine (heatmap_tpu.infer, HEATMAP_REDUCERS
+    # with kalman): tracked entities in the slot table, fold latency,
+    # anomaly totals with the loudest reason named, and table churn —
+    # absent entirely when the reducer set is count-only
+    irow = _infer_row(m, prev)
+    if irow is not None:
+        lines.append(irow)
     # space-time history tier (query/history.py, HEATMAP_HIST_DIR):
     # chunks on disk, the wall-clock span they cover, the compaction
     # lag healthz gates on, and replica backfills — absent entirely
@@ -404,6 +416,53 @@ def _audit_row(m: dict) -> str | None:
     return row
 
 
+def _infer_row(m: dict, prev: dict | None) -> str | None:
+    """The streaming-inference dashboard row, or None when the
+    heatmap_infer_* families are absent (reducer set is count-only —
+    the engine only registers with kalman in HEATMAP_REDUCERS)."""
+    ents = _val(m, "heatmap_infer_entities")
+    if ents is None:
+        return None
+    cur = m.get("heatmap_infer_fold_seconds_bucket")
+    p50 = None
+    if cur:
+        pb = (prev or {}).get("heatmap_infer_fold_seconds_bucket")
+        p50 = hist_quantile(cur, pb, 0.5)
+    anom: dict = {}
+    for labels, v in (m.get("heatmap_infer_anomalies_total")
+                      or {}).items():
+        r = _label_of(labels, "reason")
+        if r is not None:
+            anom[r] = anom.get(r, 0.0) + v
+    loudest = (max(anom, key=anom.get)
+               if anom and max(anom.values()) > 0 else None)
+    churn = _label_sums(m, "heatmap_infer_entity_events_total", "op")
+    evicted = churn.get("evicted_ttl", 0.0) + churn.get("evicted_lru", 0.0)
+    reseeds = (churn.get("reseed_handoff", 0.0)
+               + churn.get("reseed_teleport", 0.0))
+
+    def fmt(v, unit="", scale=1.0, digits=0):
+        return "--" if v is None else f"{v * scale:,.{digits}f}{unit}"
+
+    return (f"  infer     entities {fmt(ents):>10}   "
+            f"fold p50 {fmt(p50, ' ms', 1e3, 1)}   "
+            f"anomalies {fmt(sum(anom.values()) if anom else None)}"
+            + (f" (worst {loudest})" if loudest else "")
+            + f"   evicted {fmt(evicted)}   reseeds {fmt(reseeds)}")
+
+
+def _label_sums(m: dict | None, name: str, key: str) -> dict:
+    """{label_value: summed value} for one family keyed by one label
+    (e.g. the per-``op`` entity lifecycle counters folded across any
+    other labels present)."""
+    out: dict = {}
+    for labels, v in ((m or {}).get(name) or {}).items():
+        lv = _label_of(labels, key)
+        if lv is not None:
+            out[lv] = out.get(lv, 0.0) + v
+    return out
+
+
 def _last_adjust(m: dict, prev: dict | None) -> str | None:
     """The governor adjust-counter labelset that grew since the last
     scrape, rendered ``dir/reason`` — the most recent adjustment's
@@ -456,6 +515,19 @@ def _by_proc_shard(m: dict | None, name: str) -> dict:
         s = _label_of(labels, "shard")
         if s is not None:
             out[(_label_of(labels, "proc") or "", s)] = v
+    return out
+
+
+def _by_proc_label_sum(m: dict | None, name: str, key: str,
+                       wants: tuple) -> dict:
+    """{proc_tag: summed value} over one family's samples whose
+    ``key`` label is in ``wants`` (e.g. the eviction ops of the entity
+    lifecycle counter folded into one per-member column)."""
+    out: dict = {}
+    for labels, v in ((m or {}).get(name) or {}).items():
+        p = _label_of(labels, "proc")
+        if p is not None and _label_of(labels, key) in wants:
+            out[p] = out.get(p, 0.0) + v
     return out
 
 
@@ -824,6 +896,46 @@ def render_fleet_frame(m: dict, prev: dict | None, dt: float,
         lines.append(f"  cq total registered "
                      f"{fmt(sum(cq_reg.values()), digits=0)} across "
                      f"{len(cq_tags)} member(s)")
+    # streaming inference engine (heatmap_tpu.infer): one row per
+    # member running the kalman reducer — tracked entities in its
+    # per-shard slot table, table churn (seeds/evictions/reseeds), and
+    # reason-tagged anomaly totals + rate.  Entity tables are per
+    # runtime shard (they follow the H3 partition), so a skewed
+    # partition shows up as skewed entity counts here.  Absent when no
+    # member has kalman in HEATMAP_REDUCERS.
+    inf_ents = _by_proc(m, "heatmap_infer_entities")
+    if inf_ents:
+        inf_seed = _by_proc_label_sum(
+            m, "heatmap_infer_entity_events_total", "op", ("seeded",))
+        inf_evict = _by_proc_label_sum(
+            m, "heatmap_infer_entity_events_total", "op",
+            ("evicted_ttl", "evicted_lru"))
+        inf_reseed = _by_proc_label_sum(
+            m, "heatmap_infer_entity_events_total", "op",
+            ("reseed_handoff", "reseed_teleport"))
+        inf_anom = _by_proc_sum(m, "heatmap_infer_anomalies_total")
+        inf_anom_prev = _by_proc_sum(prev,
+                                     "heatmap_infer_anomalies_total")
+        lines.append("")
+        lines.append(f"  {'infer':<14}{'entities':>10}{'seeded':>10}"
+                     f"{'evicted':>9}{'reseeds':>9}{'anomalies':>11}"
+                     f"{'anom/s':>8}")
+        for tag in sorted(inf_ents):
+            arate = None
+            if dt > 0 and tag in inf_anom and tag in inf_anom_prev:
+                d = counter_increase(inf_anom[tag],
+                                     inf_anom_prev[tag])
+                arate = None if d is None else d / dt
+            lines.append(
+                f"  {tag:<14}{fmt(inf_ents[tag], digits=0):>10}"
+                f"{fmt(inf_seed.get(tag), digits=0):>10}"
+                f"{fmt(inf_evict.get(tag), digits=0):>9}"
+                f"{fmt(inf_reseed.get(tag), digits=0):>9}"
+                f"{fmt(inf_anom.get(tag), digits=0):>11}"
+                f"{fmt(arate, digits=2):>8}")
+        lines.append(f"  infer tracked entities "
+                     f"{fmt(sum(inf_ents.values()), digits=0)} across "
+                     f"{len(inf_ents)} member(s)")
     if health is not None:
         status = health.get("status", "?")
         bad = [k for k, c in health.get("checks", {}).items()

@@ -1,0 +1,189 @@
+#!/usr/bin/env python3
+"""Retroactive forecast scoring against the history tier (ISSUE 19).
+
+Two subcommands bracket a forecast's horizon:
+
+``capture``
+    GET ``/api/tiles/forecast?h=&res=`` from a running serve host and
+    save the body verbatim.  The response's ``baseTs`` (newest folded
+    event timestamp) anchors the prediction: the forecast claims the
+    occupancy shape at ``baseTs + h``.
+
+``score``
+    After the horizon has elapsed, fetch the history tier
+    (``/api/tiles/range``) around ``baseTs + h`` (the outcome) and
+    around ``baseTs`` (the persistence baseline — "the city stays
+    where it was"), and score the captured forecast against both.
+
+Units: the forecast counts ENTITIES per cell; history windows count
+EVENTS folded per cell.  The two differ by the fleet's report cadence
+x window length, so raw MAE would score the unit mismatch.  Both
+predictions and the outcome are normalized to occupancy FRACTIONS
+(cell share of the total) before MAE — scale-free, shape-only scoring:
+
+    skill = 1 - mae(forecast_frac, actual_frac)
+              / mae(persistence_frac, actual_frac)
+
+skill > 0 means the forecast beat persistence; 1.0 is a perfect hit.
+``bench_infer.py`` scores the same skill formula against synthetic
+ground truth at bank time; this tool is the serve-side retroactive
+check against what the history tier actually recorded.
+
+Usage::
+
+    python tools/score_forecast.py capture --base http://127.0.0.1:8323 \
+        --h 120 --out /tmp/fc.json
+    # ... wait >= h seconds while the pipeline keeps folding ...
+    python tools/score_forecast.py score --capture /tmp/fc.json \
+        --base http://127.0.0.1:8323
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def _get_json(base: str, path: str) -> dict:
+    with urllib.request.urlopen(base.rstrip("/") + path, timeout=30) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def features_to_counts(features) -> dict:
+    """{cellId: count} from a features list (forecast or range docs)."""
+    out: dict = {}
+    for f in features or ():
+        cid = f.get("cellId")
+        if cid is None:
+            continue
+        out[str(cid)] = out.get(str(cid), 0.0) + float(f.get("count", 0))
+    return out
+
+
+def normalize(counts: dict) -> dict:
+    """Counts -> occupancy fractions (sum 1.0); {} stays {}."""
+    total = sum(counts.values())
+    if total <= 0:
+        return {}
+    return {k: v / total for k, v in counts.items()}
+
+
+def mae(pred: dict, actual: dict) -> float:
+    keys = set(pred) | set(actual)
+    if not keys:
+        return 0.0
+    return sum(abs(pred.get(k, 0.0) - actual.get(k, 0.0))
+               for k in keys) / len(keys)
+
+
+def score_maps(forecast: dict, persistence: dict, actual: dict) -> dict:
+    """Shape-only skill of normalized forecast vs persistence."""
+    f, p, a = normalize(forecast), normalize(persistence), normalize(actual)
+    mae_f, mae_p = mae(f, a), mae(p, a)
+    skill = (1.0 - mae_f / mae_p) if mae_p > 0 else None
+    return {
+        "cells_forecast": len(f),
+        "cells_persistence": len(p),
+        "cells_actual": len(a),
+        "mae_forecast": round(mae_f, 6),
+        "mae_persistence": round(mae_p, 6),
+        "skill_vs_persistence": round(skill, 4)
+        if skill is not None else None,
+    }
+
+
+def _range_counts(base: str, grid: str | None, res: int | None,
+                  t0: float, t1: float) -> dict:
+    q = f"/api/tiles/range?t0={t0:.0f}&t1={t1:.0f}"
+    if grid:
+        q += f"&grid={grid}"
+    if res is not None:
+        q += f"&res={res}"
+    body = _get_json(base, q)
+    return features_to_counts(body.get("aggregate", {}).get("features"))
+
+
+def cmd_capture(args) -> int:
+    q = f"/api/tiles/forecast?h={args.h:g}"
+    if args.res is not None:
+        q += f"&res={args.res}"
+    body = _get_json(args.base, q)
+    if body.get("baseTs") is None:
+        print("FAIL: forecast has no baseTs (engine has folded no "
+              "events yet?)", file=sys.stderr)
+        return 1
+    cap = {"captured_from": args.base, "grid": args.grid, "body": body}
+    with open(args.out, "w") as f:
+        json.dump(cap, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"h": body.get("h"), "res": body.get("res"),
+                      "baseTs": body.get("baseTs"),
+                      "entities": body.get("entities"),
+                      "cells": len(body.get("features") or ()),
+                      "out": args.out}))
+    return 0
+
+
+def cmd_score(args) -> int:
+    with open(args.capture) as f:
+        cap = json.load(f)
+    body = cap["body"]
+    h, res, base_ts = body["h"], body.get("res"), body["baseTs"]
+    grid = args.grid or cap.get("grid")
+    w = args.window
+    # the outcome: history around baseTs + h; the baseline: history
+    # around baseTs itself (what persistence predicts for baseTs + h)
+    actual = _range_counts(args.base, grid, res,
+                           base_ts + h - w, base_ts + h + 1)
+    persist = _range_counts(args.base, grid, res,
+                            base_ts - w, base_ts + 1)
+    forecast = features_to_counts(body.get("features"))
+    out = {"h": h, "res": res, "baseTs": base_ts, "window_s": w,
+           **score_maps(forecast, persist, actual)}
+    rc = 0
+    if not actual:
+        print("FAIL: history tier returned no cells around baseTs+h — "
+              "scored too early, or HEATMAP_HIST_DIR is off",
+              file=sys.stderr)
+        rc = 1
+    elif args.require_skill and (out["skill_vs_persistence"] is None
+                                 or out["skill_vs_persistence"] <= 0):
+        print("FAIL: forecast did not beat persistence", file=sys.stderr)
+        rc = 1
+    print(json.dumps(out, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    cap = sub.add_parser("capture", help="save a live forecast")
+    cap.add_argument("--base", required=True,
+                     help="serve base URL, e.g. http://127.0.0.1:8323")
+    cap.add_argument("--h", type=float, default=120.0)
+    cap.add_argument("--res", type=int, default=None)
+    cap.add_argument("--grid", default=None,
+                     help="grid name for the later range scoring")
+    cap.add_argument("--out", required=True)
+    cap.set_defaults(fn=cmd_capture)
+    sc = sub.add_parser("score", help="score a captured forecast")
+    sc.add_argument("--base", required=True)
+    sc.add_argument("--capture", required=True)
+    sc.add_argument("--grid", default=None)
+    sc.add_argument("--window", type=float, default=300.0,
+                    help="history lookback seconds for each sample")
+    sc.add_argument("--require-skill", action="store_true",
+                    help="exit 1 unless the forecast beats persistence")
+    sc.add_argument("--out", default=None)
+    sc.set_defaults(fn=cmd_score)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
