@@ -781,6 +781,7 @@ def main() -> dict:
     # repl block (replica count + max seq lag) rides along when a
     # replicated serve fleet is attached to the channel
     from heatmap_tpu.obs.fleet import fleet_stamp, repl_stamp
+    from heatmap_tpu.obs.quality import quality_stamp
     from heatmap_tpu.obs.slo import slo_stamp
 
     result.update(fleet_stamp(eps))
@@ -790,6 +791,10 @@ def main() -> dict:
     # earned while the pipeline was violating its own SLOs must never
     # become the bar — check_bench_regress refuses such artifacts.
     result.update(slo_stamp())
+    # inference-quality provenance (obs.quality, HEATMAP_QUALITY):
+    # knob state + drift alerts fired during the round — a number
+    # earned while the model was drifting must never become the bar
+    result.update(quality_stamp())
     if dev.platform == "cpu":
         result.update(_cpu_headline_bank(
             eps, info, res=res, pipeline=pipeline, impl=impl, h3=h3,

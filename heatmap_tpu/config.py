@@ -487,6 +487,37 @@ class Config:
                                        # this long (after having moved)
                                        # raises the stopped-vehicle
                                        # anomaly
+    quality: bool = False              # HEATMAP_QUALITY: the inference
+                                       # quality observatory
+                                       # (obs/quality.py) — live
+                                       # forecast scoring, filter-
+                                       # calibration ledgers, drift
+                                       # SLOs.  0 (the default)
+                                       # disables: no families, no
+                                       # scorecards, runtime byte-
+                                       # identical to pre-quality
+                                       # builds.
+    quality_window_s: float = 600.0    # HEATMAP_QUALITY_WINDOW_S:
+                                       # rolling event-time window for
+                                       # the calibration ledger (NIS
+                                       # coverage, bias, anomaly rates)
+    quality_lookback_s: float = 300.0  # HEATMAP_QUALITY_LOOKBACK_S:
+                                       # history span summed around the
+                                       # base/target instants when
+                                       # scoring (matches the offline
+                                       # CLI's --window default, so the
+                                       # differential is exact)
+    quality_mature_s: float = 60.0     # HEATMAP_QUALITY_MATURE_S:
+                                       # event-time slack past a
+                                       # scorecard's target before it
+                                       # scores (lets the target span
+                                       # finish filling)
+    quality_ttl_s: float = 3600.0      # HEATMAP_QUALITY_TTL_S: a
+                                       # matured scorecard whose span
+                                       # stays unanswerable this long
+                                       # expires as expired_unscorable
+                                       # (the conservation identity's
+                                       # second sink)
 
     @property
     def tile_seconds(self) -> int:
@@ -635,6 +666,15 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
                            Config.entity_shards),
         entity_stop_s=_float(e, "HEATMAP_ENTITY_STOP_S",
                              Config.entity_stop_s),
+        quality=e.get("HEATMAP_QUALITY", "0") not in ("0", "false", ""),
+        quality_window_s=_float(e, "HEATMAP_QUALITY_WINDOW_S",
+                                Config.quality_window_s),
+        quality_lookback_s=_float(e, "HEATMAP_QUALITY_LOOKBACK_S",
+                                  Config.quality_lookback_s),
+        quality_mature_s=_float(e, "HEATMAP_QUALITY_MATURE_S",
+                                Config.quality_mature_s),
+        quality_ttl_s=_float(e, "HEATMAP_QUALITY_TTL_S",
+                             Config.quality_ttl_s),
         cq=e.get("HEATMAP_CQ", "1") not in ("0", "false", ""),
         cq_max_queries=_int(e, "HEATMAP_CQ_MAX_QUERIES",
                             Config.cq_max_queries),
@@ -811,6 +851,23 @@ def load_config(env: Mapping[str, str] | None = None, **overrides) -> Config:
         raise ValueError(
             f"HEATMAP_ENTITY_STOP_S must be > 0, "
             f"got {cfg.entity_stop_s}")
+    if cfg.quality_window_s <= 0:
+        raise ValueError(
+            f"HEATMAP_QUALITY_WINDOW_S must be > 0, "
+            f"got {cfg.quality_window_s}")
+    if cfg.quality_lookback_s <= 0:
+        raise ValueError(
+            f"HEATMAP_QUALITY_LOOKBACK_S must be > 0, "
+            f"got {cfg.quality_lookback_s}")
+    if cfg.quality_mature_s < 0:
+        raise ValueError(
+            f"HEATMAP_QUALITY_MATURE_S must be >= 0, "
+            f"got {cfg.quality_mature_s}")
+    if cfg.quality_ttl_s < cfg.quality_mature_s:
+        raise ValueError(
+            f"HEATMAP_QUALITY_TTL_S ({cfg.quality_ttl_s}) below "
+            f"HEATMAP_QUALITY_MATURE_S ({cfg.quality_mature_s}) — a "
+            f"scorecard cannot expire before it matures")
     if cfg.cq_max_queries < 1:
         raise ValueError(
             f"HEATMAP_CQ_MAX_QUERIES must be >= 1, "

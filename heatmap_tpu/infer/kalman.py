@@ -139,19 +139,23 @@ def _scan_fn():
         # NIS stays visible on teleport rounds (it is the anomaly
         # score); only handoff/pad rounds zero it
         nis_out = jnp.where(valid & ~rs, nis, 0.0)
+        # raw innovations, masked the same way: the calibration ledger
+        # needs the mean innovation vector (bias) over update rounds
+        inn = jnp.stack([jnp.where(valid & ~rs, y0, 0.0),
+                         jnp.where(valid & ~rs, y1, 0.0)], axis=1)
         # post-round filtered speed per entity: the engine's
         # stopped-vehicle detector reads it PER OBSERVATION, so the
         # decision sequence is invariant under batch re-partitioning
         spd = jnp.where(valid, jnp.hypot(x2[:, 2], x2[:, 3]), 0.0)
-        return (x2, p2), (nis_out, tele, spd)
+        return (x2, p2), (nis_out, tele, spd, inn)
 
     @functools.partial(jax.jit, static_argnums=())
     def scan(x, P, z, dt, valid, rs, q, r2, gate, p0_pos, p0_vel):
         p10 = P[:, _IU, _JU]              # full -> compact (symmetrize)
-        (x, p10), (nis, tele, spd) = jax.lax.scan(
+        (x, p10), (nis, tele, spd, inn) = jax.lax.scan(
             lambda c, o: _round(c, o, q, r2, gate, p0_pos, p0_vel),
             (x, p10), (z, dt, valid, rs))
-        return x, p10[:, _SYM], nis, tele, spd
+        return x, p10[:, _SYM], nis, tele, spd, inn
 
     return scan
 
@@ -166,8 +170,10 @@ def filter_rounds(x: np.ndarray, P: np.ndarray, z: np.ndarray,
     entities; ``z`` (K,M,2) measured local-frame positions, ``dt``
     (K,M) seconds since each entity's previous observation, ``valid``
     (K,M) round-occupancy mask, ``reseed`` (K,M) handoff re-seed
-    rounds.  Returns (x', P', nis (K,M), teleport (K,M), speed (K,M))
-    trimmed back to the caller's K and M."""
+    rounds.  Returns (x', P', nis (K,M), teleport (K,M), speed (K,M),
+    innovation (K,M,2)) trimmed back to the caller's K and M; the
+    innovation rows are zeroed outside non-reseed valid rounds, the
+    same mask as ``nis``."""
     k, m = valid.shape
     kp, mp = pad_pow2(max(k, 1), floor=1), pad_pow2(max(m, 1))
     f32 = np.float32
@@ -185,12 +191,12 @@ def filter_rounds(x: np.ndarray, P: np.ndarray, z: np.ndarray,
     rp = np.zeros((kp, mp), bool)
     rp[:k, :m] = reseed
     scan = _scan_fn()
-    xo, Po, nis, tele, spd = scan(xp_, Pp_, zp, dtp, vp, rp, f32(q),
-                                  f32(r_m * r_m), f32(gate), f32(p0_pos),
-                                  f32(p0_vel))
+    xo, Po, nis, tele, spd, inn = scan(xp_, Pp_, zp, dtp, vp, rp, f32(q),
+                                       f32(r_m * r_m), f32(gate),
+                                       f32(p0_pos), f32(p0_vel))
     return (np.asarray(xo)[:m], np.asarray(Po)[:m],
             np.asarray(nis)[:k, :m], np.asarray(tele)[:k, :m],
-            np.asarray(spd)[:k, :m])
+            np.asarray(spd)[:k, :m], np.asarray(inn)[:k, :m])
 
 
 def local_xy(lat_deg: np.ndarray, lng_deg: np.ndarray,

@@ -583,6 +583,17 @@ class FleetAggregator:
         out["stale_members"] = sorted(skipped)
         return out
 
+    def quality(self) -> dict:
+        """``/fleet/quality``: member inference-quality blocks stitched
+        cross-process (scorecard ledgers summed + the conservation
+        identity re-checked, calibration coverage update-weighted) with
+        the worst shard named — see :func:`fleet_quality`."""
+        members, skipped = self.collect()
+        out = fleet_quality(members)
+        out["member_tags"] = sorted(members)
+        out["stale_members"] = sorted(skipped)
+        return out
+
 
 def _hex_digest(v) -> int | None:
     try:
@@ -704,6 +715,85 @@ def fleet_audit(members: dict) -> dict:
         "combine": combine,
         "combine_mismatches": combine_mismatches,
         "ok": (mismatches == 0 and combine_mismatches == 0),
+    }
+
+
+def fleet_quality(members: dict) -> dict:
+    """The cross-process inference-quality stitch behind
+    ``/fleet/quality`` (obs/quality.py is the per-member half):
+    scorecard ledgers are plain-summed and the summed conservation
+    identity re-checked (registered == scored + expired_unscorable +
+    pending must hold for the fleet exactly as for each member),
+    calibration coverage is update-weighted across shards, and the
+    WORST shard is named — worst calibration drift (band error) first,
+    worst live skill as the tiebreak — so a fleet-level drift page
+    starts with the shard to look at."""
+    per_member: dict = {}
+    ledger = {"registered": 0, "scored": 0, "expired_unscorable": 0,
+              "pending": 0}
+    upd_total = 0
+    inside_total = 0
+    anom: dict = {}
+    worst = None          # (band_err desc, skill asc) -> naming block
+    worst_key = None
+    for tag in sorted(members):
+        blk = members[tag].get("quality")
+        if not isinstance(blk, dict):
+            continue
+        cards = blk.get("scorecards") or {}
+        nis = blk.get("nis") or {}
+        skill = blk.get("skill") or {}
+        per_member[tag] = {
+            "scorecards": cards,
+            "nis": nis,
+            "skill": skill,
+            "anomaly_rate": blk.get("anomaly_rate") or {},
+            "table": blk.get("table") or {},
+        }
+        for k in ledger:
+            v = cards.get(k)
+            if isinstance(v, (int, float)):
+                ledger[k] += int(v)
+        upd = nis.get("updates")
+        cov = nis.get("coverage")
+        if isinstance(upd, (int, float)) and isinstance(
+                cov, (int, float)):
+            upd_total += int(upd)
+            inside_total += int(round(cov * upd))
+        for r, v in (blk.get("anomaly_rate") or {}).items():
+            if isinstance(v, (int, float)):
+                anom[r] = round(anom.get(r, 0.0) + v, 4)
+        band_err = nis.get("band_error")
+        band_err = float(band_err) if isinstance(
+            band_err, (int, float)) else 0.0
+        skills = [v for v in skill.values()
+                  if isinstance(v, (int, float))]
+        min_skill = min(skills) if skills else None
+        key = (-band_err, min_skill if min_skill is not None
+               else float("inf"))
+        if worst_key is None or key < worst_key:
+            worst_key = key
+            worst = {"tag": tag, "band_error": band_err,
+                     "min_skill": min_skill}
+            if skills:
+                gh = min((k for k, v in skill.items()
+                          if isinstance(v, (int, float))),
+                         key=lambda k: skill[k])
+                grid, _, h = gh.partition("|")
+                worst.update({"grid": grid, "h": h})
+    ident_ok = (ledger["registered"] == ledger["scored"]
+                + ledger["expired_unscorable"] + ledger["pending"])
+    return {
+        "members": per_member,
+        "scorecards": {**ledger, "ok": ident_ok},
+        "nis": {
+            "updates": upd_total,
+            "coverage": (round(inside_total / upd_total, 4)
+                         if upd_total else None),
+        },
+        "anomaly_rate": anom,
+        "worst_shard": worst,
+        "ok": ident_ok,
     }
 
 

@@ -67,6 +67,13 @@ class SloSpec:
       traffic SINCE the previous tick (cumulative-bucket diff) is bad
       when ``> threshold``; a tick with no traffic contributes no
       sample (no data is neither good nor bad).
+
+    ``op`` flips the badness direction for objectives where LOWER is
+    worse (the quality observatory's forecast-skill floor): ``"gt"``
+    (default) marks a sample bad when it exceeds the threshold,
+    ``"lt"`` when it falls below.  For multi-series gauges the
+    aggregate follows the direction too — worst case is the max for
+    ``gt``, the min for ``lt``.
     """
 
     name: str
@@ -75,6 +82,7 @@ class SloSpec:
     threshold: float
     q: float = 0.5
     labels: tuple = ()
+    op: str = "gt"
 
     def label_map(self) -> dict:
         return dict(self.labels)
@@ -121,6 +129,17 @@ def default_specs(env: Mapping[str, str] | None = None) -> tuple:
                 "heatmap_audit_digest_mismatch_total", 0.0),
         SloSpec("retraces", "counter",
                 "heatmap_retrace_after_warmup_total", 0.0),
+        # quality-drift objectives (obs.quality, HEATMAP_QUALITY=1):
+        # inert when the observatory is off — the series never exist,
+        # so no tick produces a sample.  Skill is the first
+        # lower-is-worse objective (op="lt": a forecast WORSE than the
+        # configured floor burns budget); band error is a distance
+        # (0 inside the band), so any positive sample is bad.
+        SloSpec("forecast_skill", "gauge",
+                "heatmap_quality_forecast_skill",
+                f("HEATMAP_SLO_FORECAST_SKILL", 0.0), op="lt"),
+        SloSpec("nis_band", "gauge",
+                "heatmap_quality_nis_band_error", 0.0),
     )
 
 
@@ -227,7 +246,10 @@ class SloEngine:
                 p = self.rec.latest(k)
                 if p is not None and p[0] >= t - self.rec.scrape_s * 1.5:
                     vals.append(p[1])
-            return (max(vals), True) if vals else (None, False)
+            if not vals:
+                return (None, False)
+            # worst case across series follows the badness direction
+            return (min(vals) if spec.op == "lt" else max(vals), True)
         if spec.kind == "counter":
             total_inc = 0.0
             seen = False
@@ -304,7 +326,8 @@ class SloEngine:
         value, has = self._observe(spec, st, t)
         if not has:
             return
-        bad = value > spec.threshold
+        bad = (value < spec.threshold if spec.op == "lt"
+               else value > spec.threshold)
         st.samples.append((t, 1 if bad else 0))
         st.last_t, st.last_value, st.last_bad = t, value, bad
         if bad and self._m_bad is not None:

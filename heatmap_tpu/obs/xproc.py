@@ -214,6 +214,7 @@ def publish_member_snapshot(channel_path: str, tag: str, *, role: str,
                             hist: dict | None = None,
                             delivery: dict | None = None,
                             infer: dict | None = None,
+                            quality: dict | None = None,
                             left: bool = False) -> None:
     """Atomic write of one member's full observability snapshot:
     Prometheus exposition text of its registry, its freshness summary,
@@ -277,6 +278,15 @@ def publish_member_snapshot(channel_path: str, tag: str, *, role: str,
         # members without the kalman reducer, keeping snapshots
         # byte-compatible
         payload["infer"] = infer
+    if quality:
+        # the member's inference-quality block (obs.quality
+        # QualityObservatory.member_block: scorecard conservation
+        # identity, rolling live skill per (grid, horizon), NIS
+        # coverage vs the calibration band, anomaly rates, entity-table
+        # pressure) — /fleet/quality plain-sums these and names the
+        # worst shard; absent with HEATMAP_QUALITY off, keeping
+        # snapshots byte-compatible
+        payload["quality"] = quality
     if left:
         payload["left"] = True
     try:

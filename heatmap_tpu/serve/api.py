@@ -485,6 +485,19 @@ def healthz_payload(runtime, extra_checks=None) -> tuple[dict, bool]:
                 degraded |= a_deg
             except Exception:  # noqa: BLE001 - observe-only, never 500
                 log.exception("audit healthz checks failed")
+        quality = getattr(runtime, "quality", None)
+        if quality is not None:
+            # quality observatory (obs.quality, HEATMAP_QUALITY=1):
+            # NIS coverage outside the calibration band / worst live
+            # skill below the SLO floor degrades NAMING (grid,
+            # reducer, shard); a scorecard conservation-identity
+            # violation degrades with the counts
+            try:
+                qc, q_deg = quality.healthz_checks()
+                checks.update(qc)
+                degraded |= q_deg
+            except Exception:  # noqa: BLE001 - observe-only, never 500
+                log.exception("quality healthz checks failed")
         if runtime.writer.poisoned:
             checks["sink"] = {"value": "poisoned", "ok": False}
             down = True
@@ -2365,6 +2378,21 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                 data = (head[:-1] + ', "features": ['
                         + ", ".join(feats) + ']}').encode("utf-8")
                 _account_render(endpoint, data)
+                # quality observatory (HEATMAP_QUALITY=1): every served
+                # horizon becomes a pending scorecard, scored when its
+                # target matures in the view/history.  AFTER the body
+                # is built and guarded — registration can never change
+                # the response bytes or fail the request
+                quality = (getattr(runtime, "quality", None)
+                           if runtime is not None else None)
+                if quality is not None:
+                    try:
+                        quality.register_forecast(
+                            res, float(h_s), blk["max_event_ts"] or None,
+                            cells)
+                    except Exception:  # noqa: BLE001 - observe-only
+                        log.warning("scorecard registration failed",
+                                    exc_info=True)
                 _mk("lookup")
                 ctype = "application/json"
             elif path.startswith("/api/hist/"):
@@ -2587,6 +2615,33 @@ def make_wsgi_app(store: Store, cfg=None, runtime=None):
                         "fleet surfaces need a supervisor channel "
                         "(HEATMAP_SUPERVISOR_CHANNEL)")
                 body = json.dumps(agg.audit())
+                ctype = "application/json"
+            elif path == "/fleet/quality":
+                # cross-process inference-quality stitch
+                # (obs.fleet.fleet_quality): member scorecard ledgers
+                # plain-summed with the conservation identity
+                # re-checked, calibration coverage update-weighted,
+                # worst shard named (band error, then live skill)
+                agg = _fleet_agg()
+                if agg is None:
+                    return _unavailable(
+                        "fleet surfaces need a supervisor channel "
+                        "(HEATMAP_SUPERVISOR_CHANNEL)")
+                body = json.dumps(agg.quality())
+                ctype = "application/json"
+            elif path == "/debug/quality":
+                # this process's quality observatory: scorecard
+                # conservation identity, rolling live skill per (grid,
+                # horizon), NIS calibration, pending-card tail
+                # (obs.quality)
+                q_obs = (getattr(runtime, "quality", None)
+                         if runtime is not None else None)
+                if q_obs is None:
+                    return _unavailable(
+                        "the quality observatory needs "
+                        "HEATMAP_QUALITY=1 and the kalman reducer in "
+                        "the serving process")
+                body = json.dumps(q_obs.snapshot())
                 ctype = "application/json"
             elif path == "/debug/timeline":
                 # retrospective incident timeline (obs.tsdb): healthz

@@ -307,6 +307,24 @@ class MicroBatchRuntime:
                      cfg.entity_capacity,
                      self.infer.partition.n_shards
                      if self.infer.partition is not None else 1)
+        # Inference quality observatory (obs.quality): live forecast
+        # scoring + filter-calibration ledgers + drift SLOs, attached
+        # to the engine's fold.  Gated HEATMAP_QUALITY=1 AND the kalman
+        # reducer: knob-off nothing is constructed, no family
+        # registers, the runtime stays byte-identical; knob-ON it is
+        # observe-only (registration after the forecast body, scoring
+        # never mutates view state) so the same surfaces stay
+        # byte-identical too.
+        self.quality = None
+        if cfg.quality and self.infer is not None:
+            from heatmap_tpu.obs.quality import QualityObservatory
+
+            self.quality = QualityObservatory(
+                cfg, registry=self.metrics.registry,
+                view=self.matview, tag=self._fresh_tag)
+            self.infer.quality = self.quality
+            log.info("quality observatory on: band=%s skill_floor=%s",
+                     self.quality.band, self.quality.skill_floor)
         # lineage ids are origin-tagged so the fleet aggregator
         # (obs.fleet) can stitch this shard's stage contributions with
         # other members' (e.g. a serve worker's view_apply) by lid
@@ -341,6 +359,11 @@ class MicroBatchRuntime:
             # only when HEATMAP_AUDIT=1)
             fr.add_source("audit", lambda: (self.audit.snapshot()
                                             if self.audit else None))
+            # quality-observatory enrichment: the calibration picture
+            # (NIS coverage, skill ledger, pending scorecards) rides
+            # every dump — including the SLO engine's drift-burn dump
+            fr.add_source("quality", lambda: (self.quality.snapshot()
+                                              if self.quality else None))
             # runtime-introspection enrichment (obs.runtimeinfo /
             # obs.prof): compile counts + memory watermarks + the
             # stack-sample tail ride every dump — crash AND the SLO
@@ -1084,6 +1107,15 @@ class MicroBatchRuntime:
                 self.infer.restore(data, self._intern_v)
                 log.info("restored inference entity table: %d entities",
                          self.infer.table.occupancy)
+        if self.quality is not None:
+            # pending scorecards survive the restart and score against
+            # the HISTORY tier when their target spans have already
+            # left the rebuilt live view
+            data = self.ckpt.load_extra("quality", epoch=at_epoch)
+            if data is not None:
+                n = self.quality.restore_extra(data)
+                log.info("restored quality ledger: %d pending "
+                         "scorecards", n)
 
     @property
     def _snap_impl_name(self) -> str:
@@ -1349,10 +1381,15 @@ class MicroBatchRuntime:
         """Checkpoint extras payload: the inference engine's entity
         table, committed atomically WITH the window state + offsets
         (torn, a resume would re-fold replayed batches into
-        already-folded filter state)."""
+        already-folded filter state).  The quality ledger's pending
+        scorecards ride the same commit — torn, a resume would double-
+        count or lose cards and break the conservation identity."""
         if self.infer is None:
             return None
-        return {"infer": self.infer.snapshot()}
+        out = {"infer": self.infer.snapshot()}
+        if self.quality is not None:
+            out["quality"] = self.quality.snapshot_extra()
+        return out
 
     def _ckpt_join(self, raise_errors: bool = True) -> None:
         t = self._ckpt_thread
@@ -1699,6 +1736,8 @@ class MicroBatchRuntime:
                       if self.hist_compactor is not None else None),
                 infer=(self.infer.member_block()
                        if self.infer is not None else None),
+                quality=(self.quality.member_block()
+                         if self.quality is not None else None),
                 left=left)
         except Exception:  # noqa: BLE001 - never kill the step loop
             log.warning("fleet member snapshot publish failed",

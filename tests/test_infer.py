@@ -143,10 +143,12 @@ def _run_rounds(z, dt, valid=None, reseed=None, x=None, P=None):
         P = np.zeros((m, 4, 4), np.float32)
         P[:, 0, 0] = P[:, 1, 1] = 625.0
         P[:, 2, 2] = P[:, 3, 3] = 100.0
+    # drop the trailing innovation output: these tests pin the state/
+    # gate behavior; obs.quality's calibration tests cover innovations
     return filter_rounds(x, P, z.astype(np.float32),
                          dt.astype(np.float32), valid, reseed,
                          q=0.5, r_m=25.0, gate=13.816,
-                         p0_pos=625.0, p0_vel=100.0)
+                         p0_pos=625.0, p0_vel=100.0)[:5]
 
 
 def test_kalman_converges_on_constant_velocity():

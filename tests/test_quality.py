@@ -1,0 +1,621 @@
+"""Inference quality observatory (obs/quality.py, ISSUE 20).
+
+The pins, in dependency order:
+
+1. **One scoring implementation** — the offline CLI's scoring
+   functions ARE the observatory's (identity, not equality), and a
+   live-scored card equals the CLI's math over the same spans.
+2. **Scorecard conservation** — registered == scored +
+   expired_unscorable + pending across window advance, event-time TTL
+   expiry, bounded-pending eviction, and a kill+resume restart that
+   scores via the history tier (cards ride the checkpoint extras).
+3. **Closed anomaly reason set** — an unknown reason raises; the
+   ledger never silently bins a new detector.
+4. **Knob-off byte identity** — HEATMAP_QUALITY=0 runs byte-identical
+   to a pre-quality build (tiles, positions, conservation counters,
+   view state, forecast response bytes), and knob-ON is observe-only:
+   the same surfaces stay identical while scorecards accrue.
+5. **Drift → incident** — a skill collapse burns the lower-is-worse
+   SLO (op="lt"), claims exactly ONE correlated episode, dumps a
+   calibration-enriched flight record, and recovery clears it;
+   /healthz naming carries (grid, reducer, shard).
+6. **Surfaces** — member block / fleet stitch naming the worst shard,
+   obs_top rows, bench provenance stamps + check_bench_regress
+   refusals and the live-skill ratchet.
+"""
+
+import copy
+import datetime as dt
+import importlib.util
+import json
+import os
+
+import numpy as np
+
+from heatmap_tpu import hexgrid
+from heatmap_tpu.config import load_config
+from heatmap_tpu.obs import quality as qmod
+from heatmap_tpu.obs.quality import (QualityObservatory, parse_nis_band,
+                                     quality_enabled, quality_stamp,
+                                     score_maps)
+from heatmap_tpu.obs.registry import Registry
+from heatmap_tpu.query import TileMatView
+from heatmap_tpu.sink import MemoryStore
+from heatmap_tpu.sink.base import TileDoc, UTC
+from heatmap_tpu.stream import MemorySource, MicroBatchRuntime
+
+BASE = 1_754_000_000                      # fixed event-time anchor
+H = 120.0                                 # forecast horizon under test
+CELLS = []
+for _i in range(12):
+    _c = hexgrid.latlng_to_cell(42.30 + _i * 7e-3, -71.05, 8)
+    _c = int(_c, 16) if isinstance(_c, str) else int(_c)
+    if _c not in CELLS:
+        CELLS.append(_c)
+C0, C1 = CELLS[0], CELLS[1]
+
+
+def _load_tool(name):
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        os.pardir))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(repo, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _doc(cell, ws_epoch, count):
+    ws = dt.datetime.fromtimestamp(ws_epoch, UTC)
+    return TileDoc("bos", 8, format(int(cell), "x"), ws,
+                   ws + dt.timedelta(minutes=5), count=count,
+                   avg_speed_kmh=30.0, avg_lat=42.3, avg_lon=-71.05,
+                   ttl_minutes=10 ** 6, grid="h3r8")
+
+
+def _qcfg(**kw):
+    kw.setdefault("quality", True)
+    kw.setdefault("quality_lookback_s", 60.0)
+    kw.setdefault("quality_mature_s", 60.0)
+    kw.setdefault("quality_ttl_s", 600.0)
+    return load_config({}, **kw)
+
+
+def _view(windows):
+    """A live view holding {ws_epoch: {cell: count}} windows."""
+    v = TileMatView()
+    for ws, counts in windows.items():
+        v.apply_docs([_doc(c, ws, n) for c, n in counts.items()])
+    return v
+
+
+# --------------------------------------------------- knob & band parsing
+def test_knob_and_band_parsing():
+    assert quality_enabled({}) is False
+    assert quality_enabled({"HEATMAP_QUALITY": "0"}) is False
+    assert quality_enabled({"HEATMAP_QUALITY": "1"}) is True
+    assert parse_nis_band({}) == qmod.DEFAULT_NIS_BAND
+    assert parse_nis_band({"HEATMAP_SLO_NIS_BAND": "0.9,0.99"}) \
+        == (0.9, 0.99)
+    # malformed bands fall back, never raise (observe-only tier)
+    for bad in ("backwards", "0.99,0.9", "1.5,2.0", "0.9"):
+        assert parse_nis_band({"HEATMAP_SLO_NIS_BAND": bad}) \
+            == qmod.DEFAULT_NIS_BAND
+
+
+# --------------------------------------------- one scoring implementation
+def test_offline_cli_is_the_live_scorer():
+    sf = _load_tool("score_forecast")
+    # the CLI re-exports the observatory's functions — the same object,
+    # so the two CANNOT diverge
+    assert sf.score_maps is qmod.score_maps
+    assert sf.features_to_counts is qmod.features_to_counts
+    assert sf.normalize is qmod.normalize
+    assert sf.mae is qmod.mae
+
+
+def test_live_scored_card_equals_offline_cli_math():
+    target = int(BASE + H)
+    persist = {C0: 5, C1: 5}
+    actual = {C0: 8, C1: 2}
+    view = _view({BASE - 30: persist, target - 30: actual})
+    obs = QualityObservatory(_qcfg(), view=view, tag="s0")
+    forecast = {C0: 7.0, C1: 3.0}
+    obs.register_forecast(8, H, BASE, forecast)
+    assert obs.identity() == {"registered": 1, "scored": 0,
+                              "expired_unscorable": 0, "pending": 1,
+                              "ok": True}
+    obs.mature(target + 60)
+    ident = obs.identity()
+    assert ident["scored"] == 1 and ident["ok"]
+    # the differential: the live score IS the CLI's score_maps over the
+    # same hex-keyed maps (the /api/tiles/range aggregate semantics)
+    hx = {format(int(c), "x"): float(n) for c, n in forecast.items()}
+    expect = score_maps(
+        hx, {format(int(c), "x"): float(n) for c, n in persist.items()},
+        {format(int(c), "x"): float(n) for c, n in actual.items()})
+    assert obs._last_score["skill_vs_persistence"] \
+        == expect["skill_vs_persistence"] == 0.6667
+    assert obs._last_score["mae_forecast"] == expect["mae_forecast"]
+
+
+# ---------------------------------------------- scorecard conservation
+def test_conservation_window_advance_ttl_and_bounded_pending(
+        monkeypatch):
+    target = int(BASE + H)
+    view = _view({BASE - 30: {C0: 5, C1: 5},
+                  target - 30: {C0: 8, C1: 2}})
+    reg = Registry()
+    obs = QualityObservatory(_qcfg(), registry=reg, view=view, tag="s0")
+    # two horizons: H (answerable) and a far one whose target span the
+    # view will never hold (unscorable)
+    obs.register_forecast(8, H, BASE, {C0: 7.0, C1: 3.0})
+    obs.register_forecast(8, 10_000.0, BASE, {C0: 7.0})
+    assert obs.identity()["pending"] == 2 and obs.identity()["ok"]
+    # window advance: not yet mature — nothing moves
+    obs.mature(target + 30)
+    assert obs.identity()["pending"] == 2 and obs.identity()["ok"]
+    # first card matures and scores; the far one stays pending
+    obs.mature(target + 60)
+    assert obs.identity() == {"registered": 2, "scored": 1,
+                              "expired_unscorable": 0, "pending": 1,
+                              "ok": True}
+    # fake-clock eviction: the far card matures with an EMPTY span and
+    # re-pends until the event-time TTL calls it unscorable — a
+    # function of the event stream, never the wall clock
+    far_target = int(BASE + 10_000)
+    obs.mature(far_target + 60)
+    assert obs.identity()["pending"] == 1      # re-pended, not dropped
+    obs.mature(far_target + 600)               # past quality_ttl_s
+    assert obs.identity() == {"registered": 2, "scored": 1,
+                              "expired_unscorable": 1, "pending": 0,
+                              "ok": True}
+    # the counter family carries the same ledger the identity checks
+    snap = reg.expose_text()
+    assert 'heatmap_quality_scorecards_total{outcome="scored"} 1' \
+        in snap
+    assert ('heatmap_quality_scorecards_total'
+            '{outcome="expired_unscorable"} 1') in snap
+    # bounded pending: past MAX_PENDING the OLDEST card leaves as
+    # expired_unscorable — accounted, never silently dropped
+    monkeypatch.setattr(qmod, "MAX_PENDING", 2)
+    for _ in range(4):
+        obs.register_forecast(8, H, BASE, {C0: 1.0})
+    ident = obs.identity()
+    assert ident["pending"] == 2
+    assert ident["expired_unscorable"] == 3 and ident["ok"]
+
+
+def test_kill_resume_scores_via_history_tier(tmp_path):
+    """A card registered before a kill scores AFTER the restart from
+    the history tier: the pending set rides the checkpoint extras and
+    the restored observatory reads the compacted chunks (no live view
+    needed)."""
+    import tempfile
+
+    from heatmap_tpu.obs.audit import DigestTable
+    from heatmap_tpu.query.history import HistoryCompactor, HistoryLog
+    from heatmap_tpu.query.repl import DeltaLogPublisher
+
+    target = int(BASE + H)
+    # the clock anchors retention: chunks are pruned relative to "now",
+    # so the fake clock sits just past the event-time windows
+    clock = {"t": float(BASE + 900)}
+    feed = tempfile.mkdtemp(dir=str(tmp_path))
+    hist = tempfile.mkdtemp(dir=str(tmp_path))
+    w = TileMatView(now_fn=lambda: clock["t"])
+    w.audit_table = DigestTable()
+    pub = DeltaLogPublisher(w, feed, start=False, hist=HistoryLog(hist))
+    for ws, counts in ((BASE - 30, {C0: 5, C1: 5}),
+                       (target - 30, {C0: 8, C1: 2})):
+        w.apply_docs([_doc(c, ws, n) for c, n in counts.items()])
+        pub.flush()
+    # "process 1": register against the live view, then die before the
+    # card matures
+    obs1 = QualityObservatory(_qcfg(), view=w, tag="s0")
+    obs1.register_forecast(8, H, BASE, {C0: 7.0, C1: 3.0})
+    blob = obs1.snapshot_extra()
+    assert blob["state"].dtype == np.uint8      # checkpoint-extra shape
+    pub.close()
+    comp = HistoryCompactor(hist, feed_dir=feed,
+                            clock=lambda: clock["t"])
+    assert comp.step() > 0
+    # "process 2": NO view — only the compacted history tier
+    reg = Registry()
+    obs2 = QualityObservatory(_qcfg(hist_dir=hist), registry=reg,
+                              view=None, tag="s0")
+    assert obs2.restore_extra(blob) == 1
+    assert obs2.identity() == {"registered": 1, "scored": 0,
+                               "expired_unscorable": 0, "pending": 1,
+                               "ok": True}
+    obs2.mature(target + 60)
+    assert obs2.identity()["scored"] == 1 and obs2.identity()["ok"]
+    assert obs2._last_score["skill_vs_persistence"] == 0.6667
+    assert 'heatmap_quality_forecast_skill{grid="h3r8",h="120"} 0.6667' \
+        in reg.expose_text()
+    # a corrupt blob starts cold instead of raising
+    bad = {"state": np.frombuffer(b"not json", dtype=np.uint8)}
+    assert QualityObservatory(_qcfg(), tag="x").restore_extra(bad) == 0
+
+
+# ------------------------------------------------- closed anomaly reasons
+def test_anomaly_reason_set_is_pinned_closed():
+    from heatmap_tpu.infer.engine import ANOMALY_REASONS
+
+    assert ANOMALY_REASONS == ("stopped", "teleport", "deviation")
+    obs = QualityObservatory(_qcfg(), tag="s0")
+    kw = dict(t=BASE, updates=10, inside=9, inn_n=1.0, inn_e=1.0,
+              table={})
+    obs.note_fold(anomalies={"teleport": 3, "stopped": 1}, **kw)
+    try:
+        obs.note_fold(anomalies={"teleport": 4, "wormhole": 1}, **kw)
+    except ValueError as e:
+        assert "wormhole" in str(e) and "closed" in str(e)
+    else:
+        raise AssertionError("unknown anomaly reason must raise")
+
+
+# --------------------------------------------- calibration & /healthz
+def test_calibration_window_healthz_naming_and_recovery():
+    target = int(BASE + H)
+    # a BAD forecast (inverted shape) so the scored skill goes negative
+    view = _view({BASE - 30: {C0: 5, C1: 5},
+                  target - 30: {C0: 8, C1: 2}})
+    obs = QualityObservatory(_qcfg(quality_window_s=100.0), view=view,
+                             tag="shard3")
+    obs.register_forecast(8, H, BASE, {C0: 1.0, C1: 9.0})
+    obs.mature(target + 60)
+    # miscalibrated fold stream: coverage 0.5, far below the band
+    obs.note_fold(t=BASE, updates=100, inside=50, inn_n=200.0,
+                  inn_e=0.0, anomalies={"teleport": 2},
+                  table={"entities": 10, "capacity": 100,
+                         "evicted_ttl": 0, "evicted_lru": 0,
+                         "reseed_handoff": 0, "reseed_teleport": 0})
+    obs.note_fold(t=BASE + 50, updates=100, inside=50, inn_n=200.0,
+                  inn_e=0.0, anomalies={"teleport": 6},
+                  table={"entities": 12, "capacity": 100,
+                         "evicted_ttl": 1, "evicted_lru": 3,
+                         "reseed_handoff": 0, "reseed_teleport": 2})
+    checks, degraded = obs.healthz_checks()
+    assert degraded
+    cov = checks["quality_nis_coverage"]
+    assert cov["ok"] is False and cov["value"] == 0.5
+    assert "reducer=kalman" in cov["detail"]
+    assert "shard=shard3" in cov["detail"]
+    sk = checks["quality_forecast_skill"]
+    assert sk["ok"] is False and sk["value"] < 0
+    assert "grid=h3r8" in sk["detail"] and "h=120" in sk["detail"]
+    assert "shard=shard3" in sk["detail"]
+    # the member block carries the same picture for /fleet/quality
+    blk = obs.member_block()
+    assert blk["enabled"] and blk["nis"]["coverage"] == 0.5
+    assert blk["nis"]["band_error"] > 0
+    assert blk["skill"]["h3r8|120"] < 0
+    assert blk["anomaly_rate"]["teleport"] == round(6 / 50, 4)
+    assert blk["table"]["occupancy"] == 12
+    assert blk["table"]["lru_evict_frac"] == 0.75
+    # recovery: the rolling window advances past the bad folds and a
+    # calibrated stream clears the coverage check
+    for i in (200, 260):
+        obs.note_fold(t=BASE + i, updates=100, inside=95, inn_n=0.0,
+                      inn_e=0.0, anomalies={"teleport": 6}, table={})
+    checks, _ = obs.healthz_checks()
+    assert checks["quality_nis_coverage"]["ok"] is True
+    # the snapshot (flightrec source) adds the last score + pending tail
+    snap = obs.snapshot()
+    assert snap["last_score"]["skill_vs_persistence"] < 0
+    assert snap["pending_tail"] == []
+
+
+# -------------------------------------------------- knob-off differential
+def _mk_stream():
+    rng = np.random.default_rng(7)
+    pos = {v: (42.3 + 0.1 * rng.random(), -71.1 + 0.1 * rng.random())
+           for v in range(17)}
+    out = []
+    for i in range(3 * 128):
+        v = i % 17
+        la, lo = pos[v]
+        pos[v] = (la + 6e-5, lo - 6e-5)
+        out.append({"provider": "mbta", "vehicleId": f"veh-{v}",
+                    "lat": la, "lon": lo, "speedKmh": 25.0,
+                    "bearing": 0.0, "accuracyM": 5.0,
+                    "ts": BASE + 5 * (i // 17)})
+    return out
+
+
+def _run_rt(tmp_path, events, store, tag, view, quality):
+    cfg = load_config(
+        {}, batch_size=128, state_capacity_log2=10, speed_hist_bins=8,
+        store="memory", reducers=("count", "kalman"), quality=quality,
+        quality_lookback_s=60.0,
+        checkpoint_dir=str(tmp_path / f"ckpt-{tag}"))
+    src = MemorySource(copy.deepcopy(events))
+    src.finish()
+    rt = MicroBatchRuntime(cfg, src, store, checkpoint_every=0,
+                           view=view)
+    rt.run()
+    return rt
+
+
+def _get(app, path, query=""):
+    out = {}
+
+    def start_response(status, headers):
+        out["status"] = status
+
+    body = b"".join(app({"PATH_INFO": path, "REQUEST_METHOD": "GET",
+                         "QUERY_STRING": query}, start_response))
+    return out["status"], body
+
+
+def test_knob_off_byte_identity_and_observe_only_registration(tmp_path):
+    from heatmap_tpu.serve.api import make_wsgi_app
+
+    events = _mk_stream()
+    off_store, on_store = MemoryStore(), MemoryStore()
+    off_view, on_view = TileMatView(), TileMatView()
+    rt_off = _run_rt(tmp_path, events, off_store, "off", off_view,
+                     quality=False)
+    rt_on = _run_rt(tmp_path, events, on_store, "on", on_view,
+                    quality=True)
+    assert rt_off.quality is None and rt_on.quality is not None
+    # tiles, positions, conservation counters: byte-identical — the
+    # observatory observes the fold, it never touches it
+    assert off_store._tiles == on_store._tiles
+    assert off_store._positions == on_store._positions
+    keys = ("events_valid", "events_invalid", "events_late", "batches",
+            "tiles_emitted", "positions_emitted")
+    s_off, s_on = rt_off.metrics.snapshot(), rt_on.metrics.snapshot()
+    assert {k: s_off.get(k) for k in keys} \
+        == {k: s_on.get(k) for k in keys}
+    # view state: same seqs, same windows, same docs
+    assert off_view.export_state() == on_view.export_state()
+    # exposition: knob-off registers NO quality family at all
+    assert "heatmap_quality_" not in rt_off.metrics.registry \
+        .expose_text()
+    assert "heatmap_quality_nis_coverage" in rt_on.metrics.registry \
+        .expose_text()
+    # the forecast RESPONSE is byte-identical too, while knob-on
+    # registration accrues scorecards behind it (observe-only)
+    app_off = make_wsgi_app(off_store, rt_off.cfg, runtime=rt_off)
+    app_on = make_wsgi_app(on_store, rt_on.cfg, runtime=rt_on)
+    st_off, b_off = _get(app_off, "/api/tiles/forecast", "h=120")
+    st_on, b_on = _get(app_on, "/api/tiles/forecast", "h=120")
+    assert st_off.startswith("200") and st_off == st_on
+    assert b_off == b_on
+    ident = rt_on.quality.identity()
+    assert ident["registered"] == 1 and ident["ok"]
+    # /debug/quality: the live snapshot knob-on, 503 knob-off
+    st, body = _get(app_on, "/debug/quality")
+    assert st.startswith("200")
+    assert json.loads(body)["scorecards"]["registered"] == 1
+    st, _ = _get(app_off, "/debug/quality")
+    assert st.startswith("503")
+
+
+# ------------------------------------------------------ drift -> incident
+def test_skill_drift_burns_one_episode_with_enriched_flightrec(
+        tmp_path):
+    from heatmap_tpu.obs.flightrec import FlightRecorder
+    from heatmap_tpu.obs.slo import BurnRule, SloEngine, default_specs
+    from heatmap_tpu.obs.tsdb import TsdbRecorder
+    from heatmap_tpu.obs.xproc import episode_path
+
+    # the default-spec wiring: the floor env feeds an op="lt" spec
+    specs = {s.name: s for s in default_specs(
+        {"HEATMAP_SLO_FORECAST_SKILL": "0.1"})}
+    spec = specs["forecast_skill"]
+    assert spec.op == "lt" and spec.threshold == 0.1
+    assert specs["nis_band"].op == "gt"
+
+    state = {"v": 0.5}
+
+    def expo():
+        # two horizons: the WORST one (min) must drive the lt-spec
+        return ("# TYPE heatmap_quality_forecast_skill gauge\n"
+                'heatmap_quality_forecast_skill'
+                '{grid="h3r8",h="120"} 0.9\n'
+                'heatmap_quality_forecast_skill'
+                f'{{grid="h3r8",h="300"}} {state["v"]}\n')
+
+    obs = QualityObservatory(_qcfg(), tag="s0")
+    obs.note_fold(t=BASE, updates=10, inside=9, inn_n=0.0, inn_e=0.0,
+                  anomalies={}, table={})
+    fr = FlightRecorder(str(tmp_path / "fr"))
+    fr.add_source("quality", obs.snapshot)
+    chan = str(tmp_path / "chan.json")
+    clk = [0.0]
+    rec = TsdbRecorder(expo, tag="s0", scrape_s=1.0,
+                       clock=lambda: clk[0])
+    eng = SloEngine(rec, tag="s0", specs=(spec,),
+                    rules=(BurnRule("r", 4.0, 20.0, 2.5),),
+                    budget_frac=0.2, budget_window_s=100.0,
+                    channel_path=chan, flightrec=fr)
+    st = eng._state["forecast_skill"]
+    for t in range(1, 100):
+        clk[0] = float(t)
+        rec.scrape_once()
+    assert st.firing is None and st.alerts_total == 0
+    state["v"] = -0.5                           # the drift
+    for t in range(100, 115):
+        clk[0] = float(t)
+        rec.scrape_once()
+    # exactly ONE correlated episode: edge-triggered alert, claimed
+    # episode, healthz degraded
+    assert st.firing == "r" and st.alerts_total == 1
+    assert st.episode and os.path.exists(episode_path(chan))
+    check = eng.healthz_checks()["slo_forecast_skill"]
+    assert check["ok"] is False
+    # the flight record carries the calibration-enriched quality block
+    dumps = os.listdir(str(tmp_path / "fr"))
+    assert len(dumps) == 1
+    with open(str(tmp_path / "fr" / dumps[0])) as fh:
+        dump = json.load(fh)
+    assert dump["episode_id"] == st.episode
+    assert dump["quality"]["nis"]["coverage"] == 0.9
+    assert dump["quality"]["scorecards"]["ok"] is True
+    # recovery clears it: skill back above the floor, episode released
+    state["v"] = 0.5
+    for t in range(115, 140):
+        clk[0] = float(t)
+        rec.scrape_once()
+    assert st.firing is None and st.episode is None
+    assert not os.path.exists(episode_path(chan))
+    assert st.alerts_total == 1                 # never re-fired
+
+
+# ------------------------------------------------------- fleet stitching
+def _member(skill, cov, band_err, registered, scored, pending,
+            expired=0):
+    return {"quality": {
+        "enabled": True,
+        "scorecards": {"registered": registered, "scored": scored,
+                       "expired_unscorable": expired,
+                       "pending": pending,
+                       "ok": registered == scored + expired + pending},
+        "skill": skill,
+        "nis": {"coverage": cov, "band_error": band_err,
+                "updates": 1000, "band": [0.85, 0.995], "bias_m": 1.0},
+        "anomaly_rate": {"teleport": 0.1},
+        "table": {},
+    }}
+
+
+def test_fleet_quality_sums_and_names_worst_shard():
+    from heatmap_tpu.obs.fleet import fleet_quality
+
+    members = {
+        "shard0": _member({"h3r8|120": 0.6}, 0.95, 0.0, 10, 8, 2),
+        "shard1": _member({"h3r8|120": 0.4, "h3r8|300": -0.2},
+                          0.70, 0.15, 6, 3, 2, expired=1),
+    }
+    out = fleet_quality(members)
+    assert out["scorecards"] == {"registered": 16, "scored": 11,
+                                 "expired_unscorable": 1, "pending": 4,
+                                 "ok": True}
+    assert out["nis"]["updates"] == 2000
+    assert out["nis"]["coverage"] == round((950 + 700) / 2000, 4)
+    assert out["anomaly_rate"]["teleport"] == 0.2
+    worst = out["worst_shard"]
+    assert worst["tag"] == "shard1" and worst["band_error"] == 0.15
+    assert worst["min_skill"] == -0.2
+    assert worst["grid"] == "h3r8" and worst["h"] == "300"
+    # a member without the block contributes nothing and breaks nothing
+    out = fleet_quality({"s": {"up": True}})
+    assert out["scorecards"]["registered"] == 0
+    assert out["worst_shard"] is None
+
+
+# ------------------------------------------------------------ obs_top
+def test_obs_top_renders_quality_rows():
+    top = _load_tool("obs_top")
+    m = {
+        "heatmap_quality_forecast_skill": {
+            '{grid="h3r8",h="120"}': 0.62,
+            '{grid="h3r8",h="300"}': -0.31},
+        "heatmap_quality_nis_coverage": {"": 0.71},
+        "heatmap_quality_nis_band_error": {"": 0.14},
+        "heatmap_quality_pending_scorecards": {"": 3.0},
+        "heatmap_quality_anomaly_rate": {'{reason="teleport"}': 0.25,
+                                         '{reason="stopped"}': 0.05},
+    }
+    frame = top.render_frame(m, None, 0.0, None)
+    assert "quality" in frame
+    assert "-0.31" in frame and "h3r8|300s" in frame   # WORST horizon
+    assert "0.71" in frame and "band err 0.14" in frame
+    assert "pending 3" in frame and "0.30" in frame
+    # knob-off: no row at all
+    assert "quality" not in top.render_frame({}, None, 0.0, None)
+
+    fleet_text = """\
+heatmap_fleet_member_up{proc="shard0",role="runtime"} 1
+heatmap_fleet_member_up{proc="shard1",role="runtime"} 1
+heatmap_quality_forecast_skill{proc="shard0",grid="h3r8",h="120"} 0.62
+heatmap_quality_forecast_skill{proc="shard1",grid="h3r8",h="120"} -0.31
+heatmap_quality_nis_coverage{proc="shard0"} 0.95
+heatmap_quality_nis_coverage{proc="shard1"} 0.71
+heatmap_quality_nis_band_error{proc="shard0"} 0
+heatmap_quality_nis_band_error{proc="shard1"} 0.14
+heatmap_quality_pending_scorecards{proc="shard0"} 1
+heatmap_quality_pending_scorecards{proc="shard1"} 3
+heatmap_quality_scorecards_total{proc="shard0",outcome="scored"} 9
+heatmap_quality_scorecards_total{proc="shard1",outcome="scored"} 4
+heatmap_quality_scorecards_total{proc="shard1",\
+outcome="expired_unscorable"} 2
+"""
+    fm = top.parse_prom(fleet_text)
+    frame = top.render_fleet_frame(fm, None, 0.0, None)
+    assert "quality" in frame and "shard0" in frame
+    assert "quality worst shard shard1" in frame
+    assert "band err 0.140" in frame
+    # quality-less members render no quality table
+    up_only = top.parse_prom(
+        'heatmap_fleet_member_up{proc="s",role="serve"} 1\n')
+    assert "quality" not in top.render_fleet_frame(up_only, None, 0.0,
+                                                   None)
+
+
+# ------------------------------------------------------ bench provenance
+def test_quality_stamp_knob_gated_and_counts_drift_alerts(tmp_path):
+    assert quality_stamp(env={}) == {}
+    assert quality_stamp(env={"HEATMAP_QUALITY": "0"}) == {}
+    blk = _member({"h3r8|120": 0.6, "h3r8|300": 0.2}, 0.95, 0.0,
+                  4, 4, 0)["quality"]
+    for tag, alerts in (("a", 2), ("b", 1)):
+        d = tmp_path / tag
+        d.mkdir()
+        (d / "slo-state.json").write_text(json.dumps({
+            "tag": tag,
+            "specs": {"forecast_skill": {"alerts_total": alerts},
+                      "repl_lag": {"alerts_total": 7}}}))
+    out = quality_stamp(blk, env={"HEATMAP_QUALITY": "1",
+                                  "HEATMAP_TSDB_DIR": str(tmp_path)})
+    assert out == {"quality": {"enabled": True, "live_skill": 0.2,
+                               "nis_coverage": 0.95,
+                               "drift_alerts": 3}}
+    # no tsdb dir: enabled stamp with zero alert provenance
+    out = quality_stamp(None, env={"HEATMAP_QUALITY": "1"})
+    assert out["quality"]["drift_alerts"] == 0
+    assert out["quality"]["live_skill"] is None
+
+
+def _infer_art(dir_path, rnd, skill=0.5, quality=None, rc=0):
+    art = {"rc": rc, "entities_per_sec": 1e6, "forecast_skill": 0.4,
+           "overhead_frac": 0.05, "entities": 100000,
+           "reducers": {"set": ["count", "kalman"]}}
+    if quality is not None:
+        art["quality"] = dict({"enabled": True, "live_skill": skill,
+                               "nis_coverage": 0.95,
+                               "drift_alerts": 0}, **quality)
+    p = dir_path / f"BENCH_INFER_r{rnd:02d}.json"
+    p.write_text(json.dumps(art))
+    return p
+
+
+def test_regress_quality_refusals_and_live_skill_ratchet(tmp_path,
+                                                         capsys):
+    m = _load_tool("check_bench_regress")
+    # clean pair, small live-skill move: OK
+    _infer_art(tmp_path, 1, skill=0.50, quality={})
+    _infer_art(tmp_path, 2, skill=0.49, quality={})
+    assert m.compare_infer(str(tmp_path), 0.05) == 0
+    assert "live_skill" in capsys.readouterr().out
+    # live-skill collapse: the ratchet fails the pair
+    _infer_art(tmp_path, 2, skill=0.10, quality={})
+    assert m.compare_infer(str(tmp_path), 0.05) == 1
+    assert "live forecast-skill regression" in capsys.readouterr().err
+    # a drift-alerted artifact is refused outright
+    _infer_art(tmp_path, 2, skill=0.50, quality={"drift_alerts": 2})
+    assert m.compare_infer(str(tmp_path), 0.05) == 1
+    assert "drift alert" in capsys.readouterr().err
+    # a mixed quality-knob pair is refused even when both are clean
+    _infer_art(tmp_path, 2, skill=0.50)        # knob-off round
+    assert m.compare_infer(str(tmp_path), 0.05) == 1
+    assert "quality knob-state mismatch" in capsys.readouterr().err
+    # same knob both sides, no stamps at all: pre-quality pairs ratchet
+    # exactly as before (byte-compatible provenance)
+    _infer_art(tmp_path, 1)
+    _infer_art(tmp_path, 2)
+    assert m.compare_infer(str(tmp_path), 0.05) == 0
+    capsys.readouterr()

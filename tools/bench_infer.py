@@ -228,6 +228,8 @@ def _overhead_run(cols, batch: int, reducers, audit: bool) -> dict:
     }
     if rt.infer is not None:
         out["infer"] = rt.infer.member_block()
+    if rt.quality is not None:
+        out["quality"] = rt.quality.member_block()
     if rt.audit is not None:
         out["audit"] = rt.audit.bench_stamp()
     rt.close()
@@ -359,9 +361,15 @@ def main(argv=None) -> int:
     # conservation provenance of the composed overhead run, when audited
     if isinstance(over["composed"].get("audit"), dict):
         out["audit"] = over["composed"]["audit"]
+    from heatmap_tpu.obs.quality import quality_stamp
     from heatmap_tpu.obs.slo import slo_stamp
 
     out.update(slo_stamp())
+    # quality provenance of the composed overhead run (HEATMAP_QUALITY):
+    # knob state, live skill/coverage, drift alerts — check_bench_regress
+    # refuses mixed-knob pairs and drift-alerted artifacts, and ratchets
+    # live_skill
+    out.update(quality_stamp(over["composed"].get("quality")))
     print(json.dumps(out, indent=2))
     if args.out:
         with open(args.out, "w") as f:

@@ -295,6 +295,54 @@ def slo_mixed_refused(p_prev: str, p_new: str, lbl_prev: str,
     return True
 
 
+def quality_stamp_of(path: str) -> dict | None:
+    """The artifact's inference-quality provenance (``"quality"``
+    stamp, ISSUE 20 — obs.quality.quality_stamp); None on knob-off or
+    pre-quality artifacts."""
+    v = _stamped(path, "quality", dict)
+    return v if isinstance(v, dict) else None
+
+
+def quality_refused(path: str, label: str) -> bool:
+    """True (and prints the FAIL) when the artifact's ``quality`` stamp
+    says the run FIRED a drift alert (forecast-skill collapse or NIS
+    coverage out of band) — a number earned while the model was
+    drifting must never be banked or ratcheted against.  Unstamped /
+    knob-off artifacts pass untouched."""
+    v = quality_stamp_of(path)
+    if not isinstance(v, dict) or not v.get("enabled"):
+        return False
+    alerts = v.get("drift_alerts")
+    if not isinstance(alerts, (int, float)) or alerts <= 0:
+        return False
+    print(f"FAIL: {label} ({os.path.basename(path)}) fired "
+          f"{alerts:g} quality drift alert(s) during the run "
+          f"(forecast-skill / NIS-band SLO burn) — a number earned "
+          f"while the model was drifting must never become the bar; "
+          f"fix the calibration, re-run, re-bank", file=sys.stderr)
+    return True
+
+
+def quality_mixed_refused(p_prev: str, p_new: str, lbl_prev: str,
+                          lbl_new: str) -> bool:
+    """True (and prints the FAIL) when exactly one side of the pair ran
+    with the quality observatory on (``quality.enabled``) — scorecard
+    registration and the per-fold calibration ledger are part of what
+    a stamped round measures, so a knob-on round and a knob-off (or
+    pre-quality) one are different experiments."""
+    on_prev = bool((quality_stamp_of(p_prev) or {}).get("enabled"))
+    on_new = bool((quality_stamp_of(p_new) or {}).get("enabled"))
+    if on_prev == on_new:
+        return False
+    print(f"FAIL: quality knob-state mismatch — {lbl_prev} ran with "
+          f"HEATMAP_QUALITY {'on' if on_prev else 'off'} but "
+          f"{lbl_new} ran with it {'on' if on_new else 'off'}; the "
+          f"observatory's per-fold ledger is part of what a stamped "
+          f"round measures, so the pair is not the same experiment — "
+          f"re-run with the same knob state", file=sys.stderr)
+    return True
+
+
 def newest_pair(dir_path: str) -> list:
     """[(round, path, rate)] for every parseable artifact, round-sorted."""
     out = []
@@ -985,7 +1033,12 @@ def compare_infer(dir_path: str, threshold: float) -> int:
             or slo_refused(p_prev, f"infer r{r_prev:02d}") \
             or slo_refused(p_new, f"infer r{r_new:02d}") \
             or slo_mixed_refused(p_prev, p_new, f"infer r{r_prev:02d}",
-                                 f"infer r{r_new:02d}"):
+                                 f"infer r{r_new:02d}") \
+            or quality_refused(p_prev, f"infer r{r_prev:02d}") \
+            or quality_refused(p_new, f"infer r{r_new:02d}") \
+            or quality_mixed_refused(p_prev, p_new,
+                                     f"infer r{r_prev:02d}",
+                                     f"infer r{r_new:02d}"):
         return 1
     rs_prev, rs_new = reducer_set(p_prev), reducer_set(p_new)
     if rs_prev is not None and rs_new is not None and rs_prev != rs_new:
@@ -1026,6 +1079,28 @@ def compare_infer(dir_path: str, threshold: float) -> int:
         rc = 1
     else:
         print(f"OK: {line} within the {threshold:.0%} threshold")
+    # live-skill ratchet (ISSUE 20): when both rounds carry the quality
+    # observatory's stamp, the LIVE skill (scored against what the
+    # pipeline actually served, not synthetic ground truth) may not
+    # drop past the threshold either.  Skill is signed and can sit
+    # near zero, so the drop is judged against max(prev, 0.10) like
+    # overhead growth — point moves at noise level pass, collapses
+    # fail.
+    q_prev = quality_stamp_of(p_prev) or {}
+    q_new = quality_stamp_of(p_new) or {}
+    ls_prev, ls_new = q_prev.get("live_skill"), q_new.get("live_skill")
+    if isinstance(ls_prev, (int, float)) \
+            and isinstance(ls_new, (int, float)):
+        drop = (ls_prev - ls_new) / max(ls_prev, 0.10)
+        line = (f"infer r{r_prev:02d} live_skill {ls_prev:.4f} -> "
+                f"r{r_new:02d} {ls_new:.4f}")
+        if drop > threshold:
+            print(f"FAIL: live forecast-skill regression beyond "
+                  f"{threshold:.0%} of the floored base: {line}",
+                  file=sys.stderr)
+            rc = 1
+        else:
+            print(f"OK: {line} within the {threshold:.0%} threshold")
     return rc
 
 
@@ -1058,14 +1133,17 @@ def main(argv=None) -> int:
     # neither be banked NOR serve as the ratchet baseline
     for rnd, path, _v in usable[-2:]:
         if audit_refused(path, f"r{rnd:02d}") \
-                or slo_refused(path, f"r{rnd:02d}"):
+                or slo_refused(path, f"r{rnd:02d}") \
+                or quality_refused(path, f"r{rnd:02d}"):
             return 1
     if len(usable) < 2:
         print(f"OK: {len(usable)} usable artifact(s) — nothing to compare")
         return serve_rc
     (r_prev, p_prev, prev), (r_new, p_new, new) = usable[-2], usable[-1]
     if slo_mixed_refused(p_prev, p_new, f"r{r_prev:02d}",
-                         f"r{r_new:02d}"):
+                         f"r{r_new:02d}") \
+            or quality_mixed_refused(p_prev, p_new, f"r{r_prev:02d}",
+                                     f"r{r_new:02d}"):
         return 1
     bp_prev, bp_new = backend_path(p_prev), backend_path(p_new)
     if bp_prev and bp_new and bp_prev != bp_new:

@@ -220,6 +220,22 @@ def _smoke_tsdb():
     return list(reg._families.values())
 
 
+def _smoke_quality():
+    """CONSTRUCTED inference-quality observatory (obs/quality.py): the
+    ``heatmap_quality_*`` families only register under
+    HEATMAP_QUALITY=1 with the kalman reducer, which no runtime smoke
+    above enables.  Construction alone registers them — no scoring
+    runs, nothing touches the history tier."""
+    from heatmap_tpu.config import load_config
+    from heatmap_tpu.obs.quality import QualityObservatory
+    from heatmap_tpu.obs.registry import Registry
+
+    cfg = load_config({}, quality=True)
+    reg = Registry()
+    QualityObservatory(cfg, registry=reg, tag="docsgate")
+    return list(reg._families.values())
+
+
 def main() -> int:
     os.environ.setdefault("HEATMAP_PLATFORM", "cpu")
     # the mesh smoke needs >= 2 devices; force 2 CPU host devices
@@ -261,6 +277,8 @@ def main() -> int:
     fams += [f for f in _smoke_cq() if f.name not in seen]
     seen = {f.name for f in fams}
     fams += [f for f in _smoke_tsdb() if f.name not in seen]
+    seen = {f.name for f in fams}
+    fams += [f for f in _smoke_quality() if f.name not in seen]
     for fam in fams:
         if not fam.help.strip():
             failures.append(f"{fam.name}: empty HELP string")

@@ -313,6 +313,13 @@ def render_frame(m: dict, prev: dict | None, dt: float,
     aud = _audit_row(m)
     if aud is not None:
         lines.append(aud)
+    # inference quality observatory (obs.quality, HEATMAP_QUALITY=1):
+    # worst live forecast skill with its (grid, horizon) named, NIS
+    # coverage vs the chi-square band, pending scorecards, and the
+    # summed anomaly rate — absent entirely when the observatory is off
+    qrow = _quality_row(m)
+    if qrow is not None:
+        lines.append(qrow)
     if health is not None:
         status = health.get("status", "?")
         bad = [k for k, c in health.get("checks", {}).items()
@@ -449,6 +456,35 @@ def _infer_row(m: dict, prev: dict | None) -> str | None:
             f"anomalies {fmt(sum(anom.values()) if anom else None)}"
             + (f" (worst {loudest})" if loudest else "")
             + f"   evicted {fmt(evicted)}   reseeds {fmt(reseeds)}")
+
+
+def _quality_row(m: dict) -> str | None:
+    """The inference-quality dashboard row, or None when the
+    heatmap_quality_* families are absent (HEATMAP_QUALITY is off —
+    the observatory registers nothing when disabled)."""
+    skills = m.get("heatmap_quality_forecast_skill") or {}
+    cov = _val(m, "heatmap_quality_nis_coverage")
+    if not skills and cov is None:
+        return None
+    worst_k, worst_v = None, None
+    for labels, v in skills.items():
+        if worst_v is None or v < worst_v:
+            g = _label_of(labels, "grid") or "?"
+            h = _label_of(labels, "h") or "?"
+            worst_k, worst_v = f"{g}|{h}s", v
+    band = _val(m, "heatmap_quality_nis_band_error")
+    pend = _val(m, "heatmap_quality_pending_scorecards")
+    rates = _label_sums(m, "heatmap_quality_anomaly_rate", "reason")
+
+    def fmt(v, unit="", digits=2):
+        return "--" if v is None else f"{v:,.{digits}f}{unit}"
+
+    return (f"  quality   skill {fmt(worst_v):>8}"
+            + (f" ({worst_k})" if worst_k else "")
+            + f"   nis cov {fmt(cov)}"
+            + (f" (band err {fmt(band)})" if band else "")
+            + f"   pending {fmt(pend, digits=0)}   "
+            f"anom/s {fmt(sum(rates.values()) if rates else None)}")
 
 
 def _label_sums(m: dict | None, name: str, key: str) -> dict:
@@ -936,6 +972,55 @@ def render_fleet_frame(m: dict, prev: dict | None, dt: float,
         lines.append(f"  infer tracked entities "
                      f"{fmt(sum(inf_ents.values()), digits=0)} across "
                      f"{len(inf_ents)} member(s)")
+    # inference quality observatory (obs.quality): one row per member
+    # running with HEATMAP_QUALITY=1 — worst live forecast skill with
+    # its (grid, horizon), NIS coverage vs the chi-square band,
+    # scorecard ledger, pending cards.  The total line names the worst
+    # shard (largest band error, then lowest skill) — the same ranking
+    # /fleet/quality serves.  Absent when no member has quality on.
+    q_cov = _by_proc(m, "heatmap_quality_nis_coverage")
+    if q_cov:
+        q_skill: dict = {}
+        for labels, v in (m.get("heatmap_quality_forecast_skill")
+                          or {}).items():
+            p = _label_of(labels, "proc")
+            if p is None:
+                continue
+            if p not in q_skill or v < q_skill[p][0]:
+                q_skill[p] = (v, f"{_label_of(labels, 'grid') or '?'}|"
+                              f"{_label_of(labels, 'h') or '?'}s")
+        q_band = _by_proc(m, "heatmap_quality_nis_band_error")
+        q_pend = _by_proc(m, "heatmap_quality_pending_scorecards")
+        q_scored = _by_proc_label_sum(
+            m, "heatmap_quality_scorecards_total", "outcome", ("scored",))
+        q_exp = _by_proc_label_sum(
+            m, "heatmap_quality_scorecards_total", "outcome",
+            ("expired_unscorable",))
+        lines.append("")
+        lines.append(f"  {'quality':<14}{'skill':>8}  {'grid|h':<12}"
+                     f"{'nis cov':>9}{'band err':>10}{'scored':>9}"
+                     f"{'expired':>9}{'pending':>9}")
+        for tag in sorted(q_cov):
+            sv, sk = q_skill.get(tag, (None, None))
+            lines.append(
+                f"  {tag:<14}{fmt(sv, digits=2):>8}  "
+                f"{(sk or '-'):<12}"
+                f"{fmt(q_cov.get(tag), digits=2):>9}"
+                f"{fmt(q_band.get(tag), digits=3):>10}"
+                f"{fmt(q_scored.get(tag), digits=0):>9}"
+                f"{fmt(q_exp.get(tag), digits=0):>9}"
+                f"{fmt(q_pend.get(tag), digits=0):>9}")
+
+        def _rank(tag):
+            sv = q_skill.get(tag, (None,))[0]
+            return (-(q_band.get(tag) or 0.0),
+                    sv if sv is not None else float("inf"))
+
+        worst = min(sorted(q_cov), key=_rank)
+        lines.append(f"  quality worst shard {worst} "
+                     f"(band err {fmt(q_band.get(worst), digits=3)}, "
+                     f"skill {fmt(q_skill.get(worst, (None,))[0], digits=2)})"
+                     f" across {len(q_cov)} member(s)")
     if health is not None:
         status = health.get("status", "?")
         bad = [k for k, c in health.get("checks", {}).items()

@@ -41,56 +41,30 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import urllib.request
+
+# THE scoring math lives with the live observatory (obs/quality.py,
+# ISSUE 20): the offline CLI and the in-process scorer share one
+# implementation by construction — the differential test pins that a
+# live-scored card equals this CLI over the same publish->compact span
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from heatmap_tpu.obs.quality import (  # noqa: E402
+    features_to_counts,
+    mae,
+    normalize,
+    score_maps,
+)
+
+__all__ = ["features_to_counts", "normalize", "mae", "score_maps",
+           "main"]
 
 
 def _get_json(base: str, path: str) -> dict:
     with urllib.request.urlopen(base.rstrip("/") + path, timeout=30) as r:
         return json.loads(r.read().decode("utf-8"))
-
-
-def features_to_counts(features) -> dict:
-    """{cellId: count} from a features list (forecast or range docs)."""
-    out: dict = {}
-    for f in features or ():
-        cid = f.get("cellId")
-        if cid is None:
-            continue
-        out[str(cid)] = out.get(str(cid), 0.0) + float(f.get("count", 0))
-    return out
-
-
-def normalize(counts: dict) -> dict:
-    """Counts -> occupancy fractions (sum 1.0); {} stays {}."""
-    total = sum(counts.values())
-    if total <= 0:
-        return {}
-    return {k: v / total for k, v in counts.items()}
-
-
-def mae(pred: dict, actual: dict) -> float:
-    keys = set(pred) | set(actual)
-    if not keys:
-        return 0.0
-    return sum(abs(pred.get(k, 0.0) - actual.get(k, 0.0))
-               for k in keys) / len(keys)
-
-
-def score_maps(forecast: dict, persistence: dict, actual: dict) -> dict:
-    """Shape-only skill of normalized forecast vs persistence."""
-    f, p, a = normalize(forecast), normalize(persistence), normalize(actual)
-    mae_f, mae_p = mae(f, a), mae(p, a)
-    skill = (1.0 - mae_f / mae_p) if mae_p > 0 else None
-    return {
-        "cells_forecast": len(f),
-        "cells_persistence": len(p),
-        "cells_actual": len(a),
-        "mae_forecast": round(mae_f, 6),
-        "mae_persistence": round(mae_p, 6),
-        "skill_vs_persistence": round(skill, 4)
-        if skill is not None else None,
-    }
 
 
 def _range_counts(base: str, grid: str | None, res: int | None,
